@@ -1,0 +1,23 @@
+"""rlo_tpu — TPU-native rootless collective operations framework.
+
+A ground-up rebuild of the capabilities of mierl/rootless-coll-mpi-ops
+("Rootless Operations for MPI", reference at /root/reference) designed for
+TPU: JAX/XLA collectives over ICI device meshes, Pallas fused reduction
+kernels, a static-schedule lowering of the skip-ring overlay, plus a native
+C core and an in-process loopback transport for CPU-side parity testing.
+
+Capability map (reference -> here; modules land incrementally, topology first):
+  - skip-ring overlay topology (rootless_ops.c:1412-1579)  -> rlo_tpu.topology
+  - message + wire format (rootless_ops.h:84-146)          -> rlo_tpu.wire
+  - progress engine + queues (rootless_ops.c:202-658)      -> rlo_tpu.engine
+  - rootless broadcast (rootless_ops.c:1581,1104)          -> rlo_tpu.ops.bcast
+  - IAR leaderless consensus (rootless_ops.c:668-932)      -> rlo_tpu.ops.consensus
+  - transports (MPI P2P / vestigial RMA, rma_util.c)       -> rlo_tpu.transport.*
+  - data collectives (net-new, per BASELINE.json)          -> rlo_tpu.ops.collectives,
+                                                              rlo_tpu.ops.tpu_collectives
+  - native C core (reference is C11)                       -> rlo_tpu.native
+"""
+
+__version__ = "0.1.0"
+
+from rlo_tpu import topology  # noqa: F401

@@ -1,0 +1,284 @@
+"""Skip-ring overlay topology and static communication schedules.
+
+Pure functions — no transport, no state. Two families live here:
+
+1. **Skip-ring math**, semantically equivalent to the reference bcomm
+   (`/root/reference/rootless_ops.c:1412-1579`): per-rank level, last_wall,
+   send lists (including non-power-of-2 truncation), the duplicate-suppression
+   predicate and the expected-votes predictor used by the IAR consensus op.
+
+2. **Static schedules** for the TPU backend. XLA/ICI has no MPI_ANY_SOURCE —
+   every communication step must compile to a static permutation
+   (`lax.ppermute` / CollectivePermute). The reactive forwarding state machine
+   of the reference is therefore precomputed here into per-round (src, dst)
+   edge lists: spanning-tree broadcast rounds, ring reduce-scatter/all-gather
+   schedules, and recursive-doubling exchange rounds.
+
+Everything is cached — topology is queried on hot paths by the progress
+engine and at trace time by the TPU lowering.
+"""
+
+from __future__ import annotations
+
+import functools
+from collections import deque
+from dataclasses import dataclass
+from typing import List, Sequence, Tuple
+
+
+# ---------------------------------------------------------------------------
+# Skip-ring math (reference parity: rootless_ops.c:1412-1579)
+# ---------------------------------------------------------------------------
+
+def is_power_of_2(n: int) -> bool:
+    """True iff n is a positive power of two (rootless_ops.c:1416)."""
+    return n > 0 and (n & (n - 1)) == 0
+
+
+def level(world_size: int, rank: int) -> int:
+    """Skip-ring level of `rank` (rootless_ops.c:1427-1441).
+
+    For rank != 0 this is the number of trailing zero bits (so odd ranks are
+    leaves at level 0). Rank 0 is special-cased: log2(ws)-1 for power-of-2
+    worlds, floor(log2(ws)) otherwise — rank 0 acts as the highest-level hub.
+    """
+    if rank == 0:
+        ws_log = world_size.bit_length() - 1  # floor(log2(world_size))
+        return ws_log - 1 if is_power_of_2(world_size) else ws_log
+    return (rank & -rank).bit_length() - 1  # count of trailing zeros
+
+
+def last_wall(world_size: int, rank: int) -> int:
+    """Nearest rank with a strictly higher level (rootless_ops.c:1444-1452).
+
+    For rank != 0 that is `rank` with its lowest set bit cleared. Rank 0 uses
+    2**level(ws, 0) (rootless_ops.c:1478-1481): messages arriving from ranks
+    above that threshold trigger a full-fan forward.
+    """
+    if rank == 0:
+        return 1 << level(world_size, 0)
+    return rank & (rank - 1)  # clear lowest set bit
+
+
+@functools.lru_cache(maxsize=None)
+def send_list(world_size: int, rank: int) -> Tuple[Tuple[int, ...], int]:
+    """Per-rank forward targets `(targets, send_channel_cnt)`.
+
+    Mirrors bcomm_init (rootless_ops.c:1483-1515): target i is
+    (rank + 2**i) mod ws for i in [0, level]. In non-power-of-2 worlds the
+    list is truncated at the first overflow past ws-1, that slot is pointed
+    at rank 0, and the channel count shrinks accordingly (the last rank keeps
+    only [0] with zero channels).
+    """
+    lvl = level(world_size, rank)
+    channel_cnt = lvl
+    targets: List[int] = []
+    if is_power_of_2(world_size):
+        targets = [(rank + (1 << i)) % world_size for i in range(lvl + 1)]
+    else:
+        for i in range(lvl + 1):
+            dest = rank + (1 << i)
+            if dest >= world_size:
+                if rank == world_size - 1:
+                    channel_cnt = 0
+                    targets = [0]
+                else:
+                    channel_cnt = i
+                    targets = targets[:i] + [0]
+                break
+            targets.append(dest)
+    return tuple(targets), channel_cnt
+
+
+def check_passed_origin(world_size: int, my_rank: int, origin: int,
+                        to_rank: int) -> bool:
+    """True if forwarding to `to_rank` would pass the broadcast origin on the
+    ring and must be suppressed (rootless_ops.c:1534-1556).
+
+    The overlay is a ring of skips; a message wrapping past its origin would
+    be a duplicate. The predicate treats rank order modulo the ring with the
+    origin as the cut point.
+    """
+    if to_rank == origin:
+        return True
+    if my_rank >= origin:
+        if to_rank > my_rank:
+            return False
+        # to_rank < my_rank: duplicate iff it already wrapped into
+        # [origin, my_rank)
+        return not (0 <= to_rank < origin)
+    # my_rank < origin: safe only while staying inside (my_rank, origin)
+    return not (my_rank < to_rank < origin)
+
+
+@functools.lru_cache(maxsize=1 << 16)  # key space is O(ws^2); bound it
+def fwd_targets(world_size: int, rank: int, origin: int,
+                from_rank: int) -> Tuple[int, ...]:
+    """Destinations `rank` forwards a broadcast to, furthest-first.
+
+    Mirrors _bc_forward (rootless_ops.c:1104-1225): leaves (level 0) never
+    forward; a message arriving from beyond `last_wall` fans out to the whole
+    send list; otherwise only channels below `send_channel_cnt` are used,
+    filtered by check_passed_origin.
+    """
+    if level(world_size, rank) == 0:
+        return ()
+    targets, channel_cnt = send_list(world_size, rank)
+    if from_rank > last_wall(world_size, rank):
+        return tuple(reversed(targets))
+    upper = channel_cnt - 1
+    if upper < 0:
+        return ()
+    return tuple(t for t in (targets[j] for j in range(upper, -1, -1))
+                 if not check_passed_origin(world_size, rank, origin, t))
+
+
+def fwd_send_cnt(world_size: int, rank: int, origin: int,
+                 from_rank: int) -> int:
+    """Number of forwards `rank` performs for a broadcast — equivalently the
+    number of child votes an IAR consensus participant must collect before
+    voting back to its parent (rootless_ops.c:1559-1579)."""
+    return len(fwd_targets(world_size, rank, origin, from_rank))
+
+
+def initiator_targets(world_size: int, rank: int) -> Tuple[int, ...]:
+    """Destinations the *origin* of a broadcast sends to, furthest-first
+    (RLO_bcast_gen, rootless_ops.c:1586-1591): the full send list."""
+    targets, _ = send_list(world_size, rank)
+    return tuple(reversed(targets))
+
+
+# ---------------------------------------------------------------------------
+# Static schedules (TPU lowering; also reused by engine-level collectives)
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class BcastSchedule:
+    """Precomputed broadcast wavefront: rounds of (src, dst) edges.
+
+    Within a round every src and every dst appears at most once, so each
+    round lowers directly to one `lax.ppermute` permutation list.
+    """
+    world_size: int
+    origin: int
+    rounds: Tuple[Tuple[Tuple[int, int], ...], ...]
+
+    @property
+    def num_rounds(self) -> int:
+        return len(self.rounds)
+
+
+@functools.lru_cache(maxsize=None)
+def skip_ring_bcast_schedule(world_size: int, origin: int) -> BcastSchedule:
+    """Unroll the reactive skip-ring forwarding into static ppermute rounds.
+
+    First the spanning tree is built by replaying the reference forwarding
+    rules (initiator_targets at the origin, fwd_targets everywhere else) in
+    BFS order. Then tree edges are greedily packed into rounds under the
+    CollectivePermute constraints — within one round every src and every dst
+    appears at most once, and an edge may only fire once its src has already
+    received the message in an earlier round. A rank fanning out to k
+    children therefore occupies k rounds (ppermute has no multicast), which
+    is why binomial_bcast_schedule is the default lowering; this schedule is
+    kept for behavioral parity with the reference overlay.
+    """
+    # Build spanning-tree edges in reference issue order (furthest-first BFS)
+    edges: List[Tuple[int, int]] = []
+    q = deque([(origin, None)])
+    seen = {origin}
+    while q:
+        rank, frm = q.popleft()
+        targets = (initiator_targets(world_size, rank) if frm is None
+                   else fwd_targets(world_size, rank, origin, frm))
+        for dst in targets:
+            if dst in seen:
+                continue  # defensive; the overlay is exactly-once in practice
+            seen.add(dst)
+            edges.append((rank, dst))
+            q.append((dst, rank))
+
+    # Greedy round packing
+    ready = {origin: 0}
+    rounds: List[Tuple[Tuple[int, int], ...]] = []
+    pending = edges
+    while pending:
+        rnd: List[Tuple[int, int]] = []
+        used_src, used_dst = set(), set()
+        rest: List[Tuple[int, int]] = []
+        for src, dst in pending:
+            if (src in ready and ready[src] <= len(rounds)
+                    and src not in used_src and dst not in used_dst):
+                rnd.append((src, dst))
+                used_src.add(src)
+                used_dst.add(dst)
+                ready[dst] = len(rounds) + 1
+            else:
+                rest.append((src, dst))
+        assert rnd, "schedule packing stalled"
+        rounds.append(tuple(rnd))
+        pending = rest
+
+    return BcastSchedule(world_size, origin, tuple(rounds))
+
+
+@functools.lru_cache(maxsize=None)
+def binomial_bcast_schedule(world_size: int, origin: int) -> BcastSchedule:
+    """Clean binomial-tree broadcast in ceil(log2(ws)) rounds.
+
+    Round i: every rank at relative position r < 2**i sends to r + 2**i
+    (relative to origin, mod ws). Exactly-once for any world size; this is
+    the default TPU lowering (the skip-ring schedule is kept for parity).
+    """
+    rounds = []
+    i = 0
+    while (1 << i) < world_size:
+        step = 1 << i
+        edges = tuple(
+            (((r + origin) % world_size), ((r + step + origin) % world_size))
+            for r in range(step) if r + step < world_size)
+        rounds.append(edges)
+        i += 1
+    return BcastSchedule(world_size, origin, tuple(rounds))
+
+
+def ring_perm(world_size: int, offset: int = 1) -> Tuple[Tuple[int, int], ...]:
+    """The ring permutation rank -> rank+offset (mod ws) — one ppermute."""
+    return tuple((i, (i + offset) % world_size) for i in range(world_size))
+
+
+@functools.lru_cache(maxsize=None)
+def recursive_doubling_rounds(world_size: int) -> Tuple[Tuple[Tuple[int, int], ...], ...]:
+    """Pairwise-exchange rounds for power-of-2 allreduce: round i swaps
+    rank <-> rank XOR 2**i. Each round is a single self-inverse permutation."""
+    if not is_power_of_2(world_size):
+        raise ValueError("recursive doubling requires power-of-2 world size")
+    rounds = []
+    i = 0
+    while (1 << i) < world_size:
+        step = 1 << i
+        rounds.append(tuple((r, r ^ step) for r in range(world_size)))
+        i += 1
+    return tuple(rounds)
+
+
+def ring_reduce_scatter_chunk(world_size: int, rank: int, step: int) -> int:
+    """Chunk index `rank` sends at `step` of a ring reduce-scatter.
+
+    Standard ring: at step s (0-based, ws-1 steps), rank sends chunk
+    (rank - s) mod ws to rank+1 and receives/accumulates chunk
+    (rank - s - 1) mod ws. After ws-1 steps rank owns the full reduction of
+    chunk (rank + 1) mod ws.
+    """
+    return (rank - step) % world_size
+
+
+def describe(world_size: int) -> str:
+    """Human-readable topology table (debugging aid)."""
+    lines = [f"world_size={world_size} (pow2={is_power_of_2(world_size)})"]
+    for r in range(world_size):
+        targets, cc = send_list(world_size, r)
+        lines.append(
+            f"  rank {r:3d}: level={level(world_size, r)} "
+            f"last_wall={last_wall(world_size, r)} "
+            f"send_list={list(targets)} channels={cc}")
+    return "\n".join(lines)

@@ -1,0 +1,21 @@
+"""Test configuration: force an 8-device virtual CPU mesh.
+
+The container's sitecustomize registers a single-chip TPU ("axon") backend at
+interpreter startup, so jax is already imported by the time pytest runs. JAX
+backends initialize lazily, which lets us still retarget to CPU here — this
+must happen before the first jax.devices()/jit call.
+"""
+
+import os
+
+N_VIRTUAL_DEVICES = 8
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + f" --xla_force_host_platform_device_count={N_VIRTUAL_DEVICES}"
+)
+os.environ["PALLAS_AXON_POOL_IPS"] = ""  # disable axon TPU registration path
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,139 @@
+"""Unit + property tests for the skip-ring topology and static schedules.
+
+The oracles mirror the reference's implicit invariants
+(/root/reference/rootless_ops.c:1412-1579): exactly-once delivery for every
+(world_size, origin) pair, vote-count consistency between fwd_send_cnt and the
+actual forward fan-out, and schedule well-formedness (unique ppermute
+src/dst per round).
+"""
+
+from collections import Counter, deque
+
+import pytest
+
+from rlo_tpu import topology as T
+
+WORLD_SIZES = list(range(2, 34)) + [48, 64, 100, 128]
+
+
+def simulate_skip_ring_bcast(ws: int, origin: int) -> Counter:
+    """Replay the reference forwarding rules event-by-event; return per-rank
+    delivery counts (forward on every arrival, as _bc_forward does)."""
+    deliveries = Counter()
+    q = deque((dst, origin) for dst in T.initiator_targets(ws, origin))
+    while q:
+        rank, frm = q.popleft()
+        deliveries[rank] += 1
+        assert deliveries[rank] <= ws, "forwarding loop detected"
+        for dst in T.fwd_targets(ws, rank, origin, frm):
+            q.append((dst, rank))
+    return deliveries
+
+
+class TestLevels:
+    def test_known_levels_ws8(self):
+        # odd ranks are leaves; level counts trailing zeros; rank 0 is hub
+        assert [T.level(8, r) for r in range(8)] == [2, 0, 1, 0, 2, 0, 1, 0]
+
+    def test_rank0_non_pow2(self):
+        assert T.level(6, 0) == 2  # floor(log2(6))
+        assert T.level(9, 0) == 3
+
+    def test_last_wall(self):
+        assert T.last_wall(8, 6) == 4  # clear lowest set bit
+        assert T.last_wall(8, 5) == 4
+        assert T.last_wall(8, 4) == 0
+        assert T.last_wall(8, 0) == 4  # rank 0: 2**level
+
+    def test_send_list_pow2(self):
+        assert T.send_list(8, 0) == ((1, 2, 4), 2)
+        assert T.send_list(8, 4) == ((5, 6, 0), 2)
+        assert T.send_list(8, 3) == ((4,), 0)
+
+    def test_send_list_non_pow2_truncation(self):
+        # last rank in a non-pow2 world points only at 0
+        targets, cc = T.send_list(6, 5)
+        assert targets == (0,) and cc == 0
+        # a rank whose 2**i hop overflows truncates and redirects to 0:
+        # rank 4 in ws=6 has level 2 but 4+2=6 overflows, so channel 1 -> 0
+        assert T.send_list(6, 4) == ((5, 0), 1)
+
+    @pytest.mark.parametrize("ws", WORLD_SIZES)
+    def test_send_list_in_range(self, ws):
+        for r in range(ws):
+            targets, cc = T.send_list(ws, r)
+            assert len(targets) == cc + 1
+            assert all(0 <= t < ws for t in targets)
+            assert r not in targets
+
+
+class TestBcastDelivery:
+    @pytest.mark.parametrize("ws", WORLD_SIZES)
+    def test_exactly_once_all_origins(self, ws):
+        for origin in range(ws):
+            deliveries = simulate_skip_ring_bcast(ws, origin)
+            assert deliveries.get(origin, 0) == 0, "origin must not self-deliver"
+            others = set(range(ws)) - {origin}
+            assert set(deliveries) == others
+            assert all(c == 1 for c in deliveries.values()), (
+                f"duplicate delivery ws={ws} origin={origin}: {deliveries}")
+
+    @pytest.mark.parametrize("ws", [2, 3, 4, 6, 8, 11, 16, 23, 32])
+    def test_fwd_send_cnt_matches_targets(self, ws):
+        # fwd_send_cnt is the IAR votes_needed predictor — it must equal the
+        # actual forward fan-out for every (rank, origin, from) reachable state
+        for origin in range(ws):
+            q = deque((dst, origin) for dst in T.initiator_targets(ws, origin))
+            while q:
+                rank, frm = q.popleft()
+                n = T.fwd_send_cnt(ws, rank, origin, frm)
+                targets = T.fwd_targets(ws, rank, origin, frm)
+                assert n == len(targets)
+                for dst in targets:
+                    q.append((dst, rank))
+
+
+def check_schedule(sched: T.BcastSchedule):
+    ws, origin = sched.world_size, sched.origin
+    reached = {origin}
+    for rnd in sched.rounds:
+        srcs = [e[0] for e in rnd]
+        dsts = [e[1] for e in rnd]
+        assert len(set(srcs)) == len(srcs), "ppermute srcs must be unique"
+        assert len(set(dsts)) == len(dsts), "ppermute dsts must be unique"
+        for src, dst in rnd:
+            assert src in reached, "sender must already hold the message"
+            assert dst not in reached, "exactly-once violated"
+        reached.update(dsts)
+    assert reached == set(range(ws))
+
+
+class TestSchedules:
+    @pytest.mark.parametrize("ws", WORLD_SIZES)
+    def test_skip_ring_schedule_valid(self, ws):
+        for origin in range(min(ws, 9)):
+            check_schedule(T.skip_ring_bcast_schedule(ws, origin))
+
+    @pytest.mark.parametrize("ws", WORLD_SIZES)
+    def test_binomial_schedule_valid(self, ws):
+        for origin in range(min(ws, 9)):
+            sched = T.binomial_bcast_schedule(ws, origin)
+            check_schedule(sched)
+            assert sched.num_rounds == (ws - 1).bit_length()
+
+    def test_ring_perm(self):
+        assert T.ring_perm(4) == ((0, 1), (1, 2), (2, 3), (3, 0))
+
+    def test_recursive_doubling(self):
+        rounds = T.recursive_doubling_rounds(8)
+        assert len(rounds) == 3
+        for rnd in rounds:
+            # self-inverse pairing covering all ranks
+            m = dict(rnd)
+            assert all(m[m[s]] == s for s in m)
+        with pytest.raises(ValueError):
+            T.recursive_doubling_rounds(6)
+
+    def test_describe_smoke(self):
+        out = T.describe(6)
+        assert "rank   5" in out or "rank 5" in out.replace("  ", " ")
