@@ -1,0 +1,6 @@
+from rlo_tpu.transport.base import (Transport, SendHandle, make_world,
+                                    register_transport)
+from rlo_tpu.transport import loopback  # registers "loopback"
+
+__all__ = ["Transport", "SendHandle", "make_world", "register_transport",
+           "loopback"]

@@ -1,0 +1,125 @@
+"""In-process loopback transport: N ranks in one process.
+
+The reference has no equivalent — its tests need ``mpirun`` even on one host
+(SURVEY.md §4 calls this gap out). The loopback world lets every state
+machine (engine, bcast, consensus, collectives) run deterministically in a
+single process, optionally with seeded cross-pair reordering and delivery
+latency to shake out ordering assumptions the way real networks would.
+
+Guarantees (matching MPI): per-(src, dst) FIFO order — even with latency
+injection — and reliable delivery. Cross-pair order is unspecified and is
+exactly what the fuzzing knobs perturb.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from collections import deque
+from typing import List, Optional, Tuple
+
+from rlo_tpu.transport.base import (COMPLETED_SEND, SendHandle, Transport,
+                                    register_transport)
+
+
+class _PendingSend(SendHandle):
+    def __init__(self):
+        self.delivered = False
+
+    def done(self) -> bool:
+        return self.delivered
+
+
+class LoopbackTransport(Transport):
+    def __init__(self, world: "LoopbackWorld", rank: int):
+        self.world = world
+        self.rank = rank
+        self.world_size = world.world_size
+
+    def isend(self, dst: int, tag: int, data: bytes) -> SendHandle:
+        return self.world._send(self.rank, dst, tag, data)
+
+    def poll(self) -> Optional[Tuple[int, int, bytes]]:
+        return self.world._poll(self.rank)
+
+
+@register_transport("loopback")
+class LoopbackWorld:
+    """Shared mailbox array for ``world_size`` in-process ranks.
+
+    ``latency``: when > 0, each message is held for a seeded-random number of
+    ticks in [0, latency]; a tick elapses every time any rank polls. Per-pair
+    FIFO is preserved by keying held messages on (src, dst) channels.
+    """
+
+    def __init__(self, world_size: int, latency: int = 0,
+                 seed: Optional[int] = None):
+        if world_size < 2:
+            # reference rejects this at bcomm_init (rootless_ops.c:1464)
+            raise ValueError(f"world_size must be >= 2, got {world_size}")
+        self.world_size = world_size
+        self.latency = latency
+        self.rng = random.Random(seed)
+        self.lock = threading.RLock()
+        self.inboxes: List[deque] = [deque() for _ in range(world_size)]
+        # per-(src, dst) FIFO channels of held messages:
+        # (deliver_at_tick, tag, data, handle). Only channel heads can become
+        # due, which gives FIFO for free and keeps delivery O(channels).
+        self.channels: dict = {}
+        self.tick = 0
+        self.sent_cnt = 0
+        self.delivered_cnt = 0
+        self.transports = [LoopbackTransport(self, r)
+                           for r in range(world_size)]
+
+    def transport(self, rank: int) -> LoopbackTransport:
+        return self.transports[rank]
+
+    # -- internal ----------------------------------------------------------
+    def _send(self, src: int, dst: int, tag: int, data: bytes) -> SendHandle:
+        if not 0 <= dst < self.world_size:
+            raise ValueError(f"bad destination rank {dst}")
+        with self.lock:
+            self.sent_cnt += 1
+            if self.latency <= 0:
+                self.inboxes[dst].append((src, tag, bytes(data)))
+                self.delivered_cnt += 1
+                return COMPLETED_SEND
+            h = _PendingSend()
+            deliver_at = self.tick + self.rng.randint(0, self.latency)
+            self.channels.setdefault((src, dst), deque()).append(
+                (deliver_at, tag, bytes(data), h))
+            return h
+
+    def _deliver_due(self) -> None:
+        if not self.channels:
+            return
+        emptied = []
+        for chan, q in self.channels.items():
+            src, dst = chan
+            while q and q[0][0] <= self.tick:
+                _, tag, data, h = q.popleft()
+                self.inboxes[dst].append((src, tag, data))
+                self.delivered_cnt += 1
+                h.delivered = True
+            if not q:
+                emptied.append(chan)
+        for chan in emptied:
+            del self.channels[chan]
+
+    def _poll(self, rank: int) -> Optional[Tuple[int, int, bytes]]:
+        with self.lock:
+            self.tick += 1
+            self._deliver_due()
+            if self.inboxes[rank]:
+                return self.inboxes[rank].popleft()
+            return None
+
+    # -- observability -----------------------------------------------------
+    def quiescent(self) -> bool:
+        """True when nothing is in flight or queued anywhere — the loopback
+        analogue of the reference's termination-detection drain
+        (rootless_ops.c:1606-1647)."""
+        with self.lock:
+            return not self.channels and all(
+                not box for box in self.inboxes)

@@ -1,0 +1,75 @@
+"""Message types and wire format.
+
+Reference parity: the PBuf layout (`/root/reference/rootless_ops.c:64-73,
+1369-1410`) is ``[origin:int][pid:int][vote:int][data_len:size_t][data]`` —
+a 4-byte origin prefix written by RLO_msg_new_bc (rootless_ops.c:307) followed
+by the serialized proposal buffer. We keep the same logical fields in one
+little-endian header and send **variable-size frames** — the reference always
+ships the full 32 KB buffer regardless of payload (rootless_ops.c:1588), a
+known perf flaw SURVEY.md §7 says not to replicate.
+
+The ``vote`` field doubles as a type discriminator in the reference
+(0/1 vote, -1 proposal, -2 decision — rootless_ops.h:88); here message kind
+travels out-of-band as the transport tag (mirroring MPI_TAG dispatch in
+make_progress_gen, rootless_ops.c:582-621), and ``vote`` only carries votes
+and decisions.
+"""
+
+from __future__ import annotations
+
+import enum
+import struct
+from dataclasses import dataclass, field
+
+
+class Tag(enum.IntEnum):
+    """Transport-level message tags (reference RLO_COMM_TAGS,
+    rootless_ops.h:50-61). Values 0-8 match the reference enum order;
+    DATA/BARRIER are net-new for the data-carrying collectives."""
+    BCAST = 0
+    JOB_DONE = 1
+    IAR_PROPOSAL = 2
+    IAR_VOTE = 3
+    IAR_DECISION = 4
+    BC_TEARDOWN = 5
+    IAR_TEARDOWN = 6
+    P2P = 7
+    SYS = 8
+    DATA = 9
+    BARRIER = 10
+
+
+#: Tags that are store-and-forward broadcast over the skip-ring overlay.
+BCAST_TAGS = frozenset({Tag.BCAST, Tag.IAR_PROPOSAL, Tag.IAR_DECISION})
+
+_HEADER = struct.Struct("<iiiQ")  # origin, pid, vote, data_len
+HEADER_SIZE = _HEADER.size
+
+#: Default engine cap, matching RLO_MSG_SIZE_MAX (rootless_ops.h:49). Frames
+#: themselves are variable-size; this only bounds a single message payload.
+MSG_SIZE_MAX = 32768
+
+
+@dataclass
+class Frame:
+    """One wire message. ``origin`` is the broadcast initiator (not the
+    immediate sender — that is transport metadata, like MPI_SOURCE)."""
+    origin: int
+    pid: int = -1
+    vote: int = -1
+    payload: bytes = b""
+
+    def encode(self) -> bytes:
+        return _HEADER.pack(self.origin, self.pid, self.vote,
+                            len(self.payload)) + self.payload
+
+    @classmethod
+    def decode(cls, raw: bytes) -> "Frame":
+        if len(raw) < HEADER_SIZE:
+            raise ValueError(f"frame too short: {len(raw)} < {HEADER_SIZE}")
+        origin, pid, vote, n = _HEADER.unpack_from(raw)
+        payload = bytes(raw[HEADER_SIZE:HEADER_SIZE + n])
+        if len(payload) != n:
+            raise ValueError(f"truncated frame: want {n} payload bytes, "
+                             f"have {len(raw) - HEADER_SIZE}")
+        return cls(origin, pid, vote, payload)

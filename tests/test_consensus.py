@@ -1,0 +1,199 @@
+"""IAR leaderless-consensus parity tests.
+
+Oracles mirror testcases.c: single proposal with parameterized
+agree/disagree outcome (:243-332), concurrent engines on the same ranks
+(:110-241), and multiple simultaneous proposers (:401-594). The key
+invariants: every rank sees every decision exactly once, all ranks agree on
+each decision value, and the action callback runs exactly on approving
+ranks that held the proposal.
+"""
+
+import pytest
+
+from rlo_tpu.engine import ProgressEngine, EngineManager, ReqState, drain
+from rlo_tpu.transport import make_world
+from rlo_tpu.wire import Tag
+
+
+class Ctx:
+    """Per-rank application context recording callback activity."""
+
+    def __init__(self, rank, veto=False):
+        self.rank = rank
+        self.veto = veto
+        self.judged = []
+        self.actions = []
+
+
+def judge(payload, ctx: Ctx) -> int:
+    ctx.judged.append(bytes(payload))
+    return 0 if ctx.veto else 1
+
+
+def action(payload, ctx: Ctx):
+    ctx.actions.append(bytes(payload))
+
+
+def build(ws, veto_ranks=(), latency=0, seed=None):
+    world = make_world("loopback", ws, latency=latency, seed=seed)
+    manager = EngineManager()
+    ctxs = [Ctx(r, veto=(r in veto_ranks)) for r in range(ws)]
+    engines = [ProgressEngine(world.transport(r), judge_cb=judge,
+                              app_ctx=ctxs[r], action_cb=action,
+                              manager=manager)
+               for r in range(ws)]
+    return world, engines, ctxs
+
+
+def decisions_of(eng):
+    out = []
+    while (m := eng.pickup_next()) is not None:
+        if m.type == Tag.IAR_DECISION:
+            out.append(m)
+    return out
+
+
+WORLD_SIZES = [2, 3, 4, 5, 7, 8, 16, 23]
+
+
+class TestSingleProposal:
+    @pytest.mark.parametrize("ws", WORLD_SIZES)
+    @pytest.mark.parametrize("proposer", [0, 1])
+    def test_all_approve(self, ws, proposer):
+        proposer = proposer % ws
+        world, engines, ctxs = build(ws)
+        engines[proposer].submit_proposal(b"prop", pid=proposer)
+        drain([world], engines)
+        assert engines[proposer].vote_my_proposal() == 1
+        assert engines[proposer].check_proposal_state() == ReqState.COMPLETED
+        for r in range(ws):
+            if r == proposer:
+                continue
+            # every non-proposer judged it, executed it, and saw the decision
+            assert ctxs[r].judged == [b"prop"]
+            assert ctxs[r].actions == [b"prop"]
+            ds = decisions_of(engines[r])
+            assert len(ds) == 1 and ds[0].vote == 1 and ds[0].pid == proposer
+
+    @pytest.mark.parametrize("ws", WORLD_SIZES)
+    def test_one_veto_declines(self, ws):
+        veto_rank = ws - 1
+        world, engines, ctxs = build(ws, veto_ranks={veto_rank})
+        engines[0].submit_proposal(b"prop", pid=0)
+        drain([world], engines)
+        assert engines[0].vote_my_proposal() == 0
+        for r in range(1, ws):
+            ds = decisions_of(engines[r])
+            assert len(ds) == 1 and ds[0].vote == 0
+            assert ctxs[r].actions == []  # declined: no one executes
+
+    @pytest.mark.parametrize("ws", [4, 8, 16])
+    def test_proposer_self_veto_via_rejudge(self, ws):
+        """The proposer re-judges its own proposal after collecting yes
+        votes (rootless_ops.c:773) — a proposer whose context turned veto
+        must decline its own proposal."""
+        world, engines, ctxs = build(ws)
+        ctxs[0].veto = True  # context changes after submission is simulated
+        engines[0].submit_proposal(b"prop", pid=0)
+        drain([world], engines)
+        assert engines[0].vote_my_proposal() == 0
+
+    @pytest.mark.parametrize("ws,latency,seed", [(8, 4, 0), (16, 6, 1),
+                                                 (23, 5, 2)])
+    def test_under_latency_fuzz(self, ws, latency, seed):
+        world, engines, ctxs = build(ws, latency=latency, seed=seed)
+        engines[2].submit_proposal(b"zz", pid=2)
+        drain([world], engines)
+        assert engines[2].vote_my_proposal() == 1
+        for r in range(ws):
+            if r != 2:
+                assert len(ctxs[r].actions) == 1
+
+
+class TestMultiProposal:
+    @pytest.mark.parametrize("ws", [4, 8, 16])
+    def test_two_proposers_consistent(self, ws):
+        """Two simultaneous proposers: all ranks must agree on every
+        decision, and each rank sees exactly two decisions
+        (testcases.c:401-486 counts decisions the same way)."""
+        world, engines, ctxs = build(ws)
+        engines[0].submit_proposal(b"A", pid=0)
+        engines[1].submit_proposal(b"B", pid=1)
+        drain([world], engines)
+        by_pid = {}
+        for r in range(ws):
+            ds = decisions_of(engines[r])
+            expect = 2 if r not in (0, 1) else 1  # proposers skip their own
+            assert len(ds) == expect, f"rank {r}: {ds}"
+            for d in ds:
+                by_pid.setdefault(d.pid, set()).add(d.vote)
+        by_pid.setdefault(0, set()).add(engines[0].vote_my_proposal())
+        by_pid.setdefault(1, set()).add(engines[1].vote_my_proposal())
+        assert set(by_pid) == {0, 1}
+        for pid, votes in by_pid.items():
+            assert len(votes) == 1, f"inconsistent decision for pid {pid}"
+
+    @pytest.mark.parametrize("ws", [8, 16])
+    def test_conflicting_proposals_lexicographic(self, ws):
+        """Conflict resolution delegated to the judgement callback, like
+        is_proposal_approved_cb (testcases.c:18-37): approve only proposals
+        lexicographically >= my own submission."""
+        world = make_world("loopback", ws)
+        manager = EngineManager()
+        my_prop = {0: b"apple", 1: b"banana"}
+
+        class LexCtx:
+            def __init__(self, rank):
+                self.rank = rank
+                self.actions = []
+
+        def lex_judge(payload, ctx):
+            mine = my_prop.get(ctx.rank)
+            if mine is None:
+                return 1
+            return 1 if bytes(payload) >= mine else 0
+
+        def lex_action(payload, ctx):
+            ctx.actions.append(bytes(payload))
+
+        ctxs = [LexCtx(r) for r in range(ws)]
+        engines = [ProgressEngine(world.transport(r), judge_cb=lex_judge,
+                                  app_ctx=ctxs[r], action_cb=lex_action,
+                                  manager=manager)
+                   for r in range(ws)]
+        engines[0].submit_proposal(b"apple", pid=0)
+        engines[1].submit_proposal(b"banana", pid=1)
+        drain([world], engines)
+        # banana >= apple: rank 0 approves banana; apple < banana: rank 1
+        # vetoes apple. So pid 1 approved, pid 0 declined.
+        assert engines[1].vote_my_proposal() == 1
+        assert engines[0].vote_my_proposal() == 0
+
+
+class TestEngineMultiplexing:
+    @pytest.mark.parametrize("ws", [4, 8])
+    def test_two_engines_concurrently(self, ws):
+        """Two engines per rank over independent transports progress each
+        other (testcases.c:110-241: concurrent IAR on two engines)."""
+        manager = EngineManager()
+        world_a = make_world("loopback", ws)
+        world_b = make_world("loopback", ws)
+        ctx_a = [Ctx(r) for r in range(ws)]
+        ctx_b = [Ctx(r) for r in range(ws)]
+        eng_a = [ProgressEngine(world_a.transport(r), judge_cb=judge,
+                                app_ctx=ctx_a[r], action_cb=action,
+                                manager=manager) for r in range(ws)]
+        eng_b = [ProgressEngine(world_b.transport(r), judge_cb=judge,
+                                app_ctx=ctx_b[r], action_cb=action,
+                                manager=manager) for r in range(ws)]
+        eng_a[0].submit_proposal(b"on-a", pid=0)
+        eng_b[1].submit_proposal(b"on-b", pid=1)
+        eng_a[2].bcast(b"plain")
+        drain([world_a, world_b], eng_a + eng_b)
+        assert eng_a[0].vote_my_proposal() == 1
+        assert eng_b[1].vote_my_proposal() == 1
+        for r in range(ws):
+            if r != 0:
+                assert ctx_a[r].actions == [b"on-a"]
+            if r != 1:
+                assert ctx_b[r].actions == [b"on-b"]

@@ -1,0 +1,105 @@
+"""Wire format round-trips and loopback transport semantics."""
+
+import pytest
+
+from rlo_tpu import wire
+from rlo_tpu.transport import make_world
+from rlo_tpu.wire import Frame, Tag
+
+
+class TestFrame:
+    def test_roundtrip(self):
+        f = Frame(origin=3, pid=7, vote=1, payload=b"hello world")
+        assert Frame.decode(f.encode()) == f
+
+    def test_roundtrip_empty_payload(self):
+        f = Frame(origin=0)
+        raw = f.encode()
+        assert len(raw) == wire.HEADER_SIZE
+        assert Frame.decode(raw) == f
+
+    def test_variable_size(self):
+        # the reference always ships 32 KB (rootless_ops.c:1588); we must not
+        small = Frame(origin=1, payload=b"x").encode()
+        big = Frame(origin=1, payload=b"x" * 10000).encode()
+        assert len(small) == wire.HEADER_SIZE + 1
+        assert len(big) == wire.HEADER_SIZE + 10000
+
+    def test_truncated_raises(self):
+        raw = Frame(origin=1, payload=b"abcdef").encode()
+        with pytest.raises(ValueError):
+            Frame.decode(raw[:-1])
+        with pytest.raises(ValueError):
+            Frame.decode(raw[:3])
+
+    def test_negative_sentinels(self):
+        f = Frame(origin=5, pid=-1, vote=-2, payload=b"")
+        assert Frame.decode(f.encode()).vote == -2
+
+
+class TestLoopback:
+    def test_basic_delivery(self):
+        w = make_world("loopback", 4)
+        t0, t3 = w.transport(0), w.transport(3)
+        h = t0.isend(3, Tag.BCAST, b"payload")
+        assert h.done()
+        assert t3.poll() == (0, Tag.BCAST, b"payload")
+        assert t3.poll() is None
+
+    def test_fifo_per_pair(self):
+        w = make_world("loopback", 2)
+        for i in range(10):
+            w.transport(0).isend(1, Tag.DATA, bytes([i]))
+        got = [w.transport(1).poll()[2][0] for _ in range(10)]
+        assert got == list(range(10))
+
+    def test_fifo_preserved_under_latency(self):
+        w = make_world("loopback", 2, latency=5, seed=42)
+        for i in range(50):
+            w.transport(0).isend(1, Tag.DATA, bytes([i]))
+        got = []
+        t1 = w.transport(1)
+        spins = 0
+        while len(got) < 50:
+            m = t1.poll()
+            spins += 1
+            assert spins < 10000
+            if m:
+                got.append(m[2][0])
+        assert got == list(range(50))
+
+    def test_latency_handles_complete_eventually(self):
+        w = make_world("loopback", 2, latency=3, seed=7)
+        h = w.transport(0).isend(1, Tag.BCAST, b"z")
+        t1 = w.transport(1)
+        got = []
+        spins = 0
+        while not h.done() or not got:
+            m = t1.poll()
+            if m:
+                got.append(m)
+            spins += 1
+            assert spins < 1000
+        assert got == [(0, Tag.BCAST, b"z")]
+
+    def test_quiescent(self):
+        w = make_world("loopback", 3)
+        assert w.quiescent()
+        w.transport(0).isend(2, Tag.BCAST, b"q")
+        assert not w.quiescent()
+        w.transport(2).poll()
+        assert w.quiescent()
+
+    def test_world_too_small(self):
+        # reference rejects ws < 2 at bcomm_init (rootless_ops.c:1464)
+        with pytest.raises(ValueError):
+            make_world("loopback", 1)
+
+    def test_bad_destination(self):
+        w = make_world("loopback", 2)
+        with pytest.raises(ValueError):
+            w.transport(0).isend(5, Tag.BCAST, b"")
+
+    def test_unknown_backend(self):
+        with pytest.raises(ValueError):
+            make_world("nope", 4)
