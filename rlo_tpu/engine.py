@@ -249,19 +249,17 @@ class ProgressEngine:
     def pickup_next(self) -> Optional[UserMsg]:
         """Next delivered message, or None. Messages still forwarding are
         eligible (wait_and_pickup first, then pickup — reference order)."""
-        for msg in self.queue_wait_and_pickup:
-            if not msg.pickup_done:
-                msg.pickup_done = True
-                self.queue_wait_and_pickup.remove(msg)
-                self.queue_wait.append(msg)
-                self.total_pickup += 1
-                return self._to_user(msg)
-        while self.queue_pickup:
+        if self.queue_wait_and_pickup:
+            msg = self.queue_wait_and_pickup.pop(0)
+            msg.pickup_done = True
+            self.queue_wait.append(msg)  # keep tracking its forwards
+            self.total_pickup += 1
+            return self._to_user(msg)
+        if self.queue_pickup:
             msg = self.queue_pickup.popleft()
-            if not msg.pickup_done:
-                msg.pickup_done = True
-                self.total_pickup += 1
-                return self._to_user(msg)
+            msg.pickup_done = True
+            self.total_pickup += 1
+            return self._to_user(msg)
         return None
 
     @staticmethod
@@ -301,16 +299,14 @@ class ProgressEngine:
             else:
                 self._on_other(msg)
 
-        # (c) wait_and_pickup sweep (~_wait_and_pickup_queue_process :995)
+        # (c) wait_and_pickup sweep (~_wait_and_pickup_queue_process :995).
+        # Messages here are never picked up (pickup_next moves them to
+        # queue_wait when it claims them), so completion always delivers.
         for msg in list(self.queue_wait_and_pickup):
             if msg.sends_done():
                 msg.fwd_done = True
                 self.queue_wait_and_pickup.remove(msg)
-                if not msg.pickup_done:
-                    self.queue_pickup.append(msg)
-            elif msg.pickup_done:
-                self.queue_wait_and_pickup.remove(msg)
-                self.queue_wait.append(msg)
+                self.queue_pickup.append(msg)
 
         # (d) wait-only sweep (~_wait_only_queue_cleanup :1015)
         for msg in list(self.queue_wait):
@@ -467,9 +463,15 @@ def drain(worlds, engines, max_spins: int = 100_000) -> None:
     outbound work is complete — the loopback analogue of the reference's
     termination-detection drain (MPI_Iallreduce over bcast counts + spin,
     rootless_ops.c:1613-1625)."""
+    managers = []
+    for e in engines:
+        if e.manager not in managers:
+            managers.append(e.manager)
     for _ in range(max_spins):
-        for e in engines:
-            e._progress_once()
+        # drive through the managers so the re-entrancy guard covers
+        # handler-initiated broadcasts (e.g. the decision bcast)
+        for m in managers:
+            m.progress_all()
         if all(w.quiescent() for w in worlds) and all(
                 e.idle() for e in engines):
             return

@@ -1,0 +1,299 @@
+"""Data-carrying collectives over the byte transport layer.
+
+Net-new surface required by BASELINE.json: the reference moves only opaque
+<=32 KB blobs (bcast) and single-bit votes (IAR); the rebuild extends the op
+set to tensor allreduce / reduce-scatter / all-gather / barrier so the same
+substrate can be benchmarked against `lax.psum` (config 1: float32 allreduce,
+8 ranks, 1 MB buffer — here over the loopback transport; the TPU lowering
+lives in rlo_tpu.ops.tpu_collectives).
+
+Algorithms (classic, schedule math shared with rlo_tpu.topology):
+  - allreduce: recursive doubling for power-of-2 worlds; non-power-of-2
+    folds the surplus ranks onto the largest power-of-2 subset first and
+    unfolds at the end. O(log n) rounds, full vector per round — right for
+    small/medium payloads.
+  - allreduce(algorithm='ring'): ring reduce-scatter + ring all-gather,
+    2*(n-1) rounds of 1/n-sized chunks — bandwidth-optimal for large
+    payloads.
+  - reduce_scatter / all_gather: the ring halves exposed directly.
+  - barrier: dissemination barrier, ceil(log2(n)) rounds, any world size.
+
+Execution model: collectives are **coroutines** (generators). Each rank
+builds its op via its `Comm`; a driver advances all ranks' coroutines
+round-robin in one process (`run_collectives`), or each rank can spin its
+own coroutine on a thread (`run_blocking`) — both drive the same state
+machine, mirroring how the reference's progress engine is cooperatively
+polled rather than threaded (rootless_ops.c:538-549).
+
+Message matching: SPMD programs issue collectives in identical order on
+every rank, so a per-Comm monotonically increasing op id (carried in the
+frame `pid` field) plus the round number (in `vote`) uniquely identifies
+every transfer; out-of-order arrivals are parked until their (src, op,
+round) is awaited.
+"""
+
+from __future__ import annotations
+
+import itertools
+import struct
+from typing import Dict, Generator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from rlo_tpu.topology import ring_reduce_scatter_chunk
+from rlo_tpu.transport.base import Transport
+from rlo_tpu.wire import Frame, Tag
+
+OPS = {
+    "sum": np.add,
+    "prod": np.multiply,
+    "min": np.minimum,
+    "max": np.maximum,
+    "and": np.bitwise_and,  # the IAR vote merge, generalized to tensors
+    "or": np.bitwise_or,
+}
+
+#: Identity element per op — used to pad ragged chunks so padding never
+#: perturbs the reduction (zeros would corrupt min/prod).
+_IDENTITY = {"sum": 0, "prod": 1, "min": "maxval", "max": "minval",
+             "and": 1, "or": 0}
+
+
+def _identity_for(op: str, dtype: np.dtype):
+    ident = _IDENTITY[op]
+    if ident == "maxval":
+        return np.inf if np.issubdtype(dtype, np.floating) else \
+            np.iinfo(dtype).max
+    if ident == "minval":
+        return -np.inf if np.issubdtype(dtype, np.floating) else \
+            np.iinfo(dtype).min
+    return ident
+
+_ARR_HEADER = struct.Struct("<B")  # ndim; then dtype-str, dims
+
+
+def _pack_array(x: np.ndarray) -> bytes:
+    dt = np.dtype(x.dtype).str.encode()
+    dims = struct.pack(f"<{x.ndim}q", *x.shape)
+    return (_ARR_HEADER.pack(x.ndim) + struct.pack("<B", len(dt)) + dt
+            + dims + np.ascontiguousarray(x).tobytes())
+
+
+def _unpack_array(raw: bytes) -> np.ndarray:
+    ndim = _ARR_HEADER.unpack_from(raw, 0)[0]
+    dtlen = struct.unpack_from("<B", raw, 1)[0]
+    off = 2
+    dt = np.dtype(raw[off:off + dtlen].decode())
+    off += dtlen
+    shape = struct.unpack_from(f"<{ndim}q", raw, off)
+    off += 8 * ndim
+    return np.frombuffer(raw, dtype=dt, offset=off).reshape(shape).copy()
+
+
+class Comm:
+    """One rank's collective communicator over a transport endpoint."""
+
+    def __init__(self, transport: Transport):
+        self.tp = transport
+        self.rank = transport.rank
+        self.world_size = transport.world_size
+        self._opid = itertools.count()
+        # parked out-of-order arrivals: (src, opid, round) -> payload
+        self._pending: Dict[Tuple[int, int, int], bytes] = {}
+
+    # -- plumbing ----------------------------------------------------------
+    def _send(self, dst: int, opid: int, rnd: int, x: np.ndarray) -> None:
+        frame = Frame(origin=self.rank, pid=opid, vote=rnd,
+                      payload=_pack_array(x))
+        self.tp.isend(dst, int(Tag.DATA), frame.encode())
+
+    def _recv(self, src: int, opid: int, rnd: int):
+        """Coroutine: yield until the (src, opid, round) message arrives."""
+        key = (src, opid, rnd)
+        while key not in self._pending:
+            m = self.tp.poll()
+            if m is None:
+                yield
+                continue
+            s, tag, raw = m
+            if tag != Tag.DATA:
+                raise RuntimeError(
+                    f"rank {self.rank}: unexpected tag {tag} on a "
+                    f"collective-only Comm")
+            f = Frame.decode(raw)
+            self._pending[(s, f.pid, f.vote)] = f.payload
+        return _unpack_array(self._pending.pop(key))
+
+    def _exchange(self, peer: int, opid: int, rnd: int, x: np.ndarray):
+        self._send(peer, opid, rnd, x)
+        other = yield from self._recv(peer, opid, rnd)
+        return other
+
+    # -- ops ---------------------------------------------------------------
+    def allreduce(self, x: np.ndarray, op: str = "sum",
+                  algorithm: str = "auto"):
+        """Coroutine computing the elementwise reduction of ``x`` across all
+        ranks; every rank returns the full result."""
+        x = np.asarray(x)
+        if algorithm == "auto":
+            algorithm = "ring" if x.nbytes >= (1 << 20) else \
+                "recursive_doubling"
+        if algorithm == "recursive_doubling":
+            return (yield from self._allreduce_rd(x, op))
+        if algorithm == "ring":
+            return (yield from self._allreduce_ring(x, op))
+        raise ValueError(f"unknown algorithm {algorithm!r}")
+
+    def _allreduce_rd(self, x: np.ndarray, op: str):
+        """Recursive doubling with non-power-of-2 fold/unfold."""
+        fn = OPS[op]
+        opid = next(self._opid)
+        ws, rank = self.world_size, self.rank
+        p = 1 << (ws.bit_length() - 1)  # largest power of 2 <= ws
+        if p == ws:
+            p_rank, in_core = rank, True
+        else:
+            surplus = ws - p
+            # ranks [p, ws) fold onto [0, surplus)
+            if rank >= p:
+                self._send(rank - p, opid, 0, x)
+                in_core = False
+            else:
+                if rank < surplus:
+                    other = yield from self._recv(rank + p, opid, 0)
+                    x = fn(x, other)
+                in_core = True
+            p_rank = rank
+        acc = x
+        if in_core:
+            i = 0
+            while (1 << i) < p:
+                peer = p_rank ^ (1 << i)
+                other = yield from self._exchange(peer, opid, i + 1, acc)
+                acc = fn(acc, other)
+                i += 1
+        # unfold
+        if p != ws:
+            if in_core and rank < ws - p:
+                self._send(rank + p, opid, 99, acc)
+            if not in_core:
+                acc = yield from self._recv(rank - p, opid, 99)
+        return acc
+
+    def _allreduce_ring(self, x: np.ndarray, op: str):
+        """Ring reduce-scatter then ring all-gather (bandwidth-optimal)."""
+        chunks, meta = _chunk(x, self.world_size, op)
+        reduced = yield from self._ring_reduce_scatter(chunks, op)
+        gathered = yield from self._ring_all_gather_chunks(reduced)
+        return _unchunk(gathered, meta)
+
+    def _ring_reduce_scatter(self, chunks: List[np.ndarray], op: str):
+        """After n-1 steps, returns (my_chunk_index, reduced_chunk)."""
+        fn = OPS[op]
+        opid = next(self._opid)
+        ws, rank = self.world_size, self.rank
+        nxt, prv = (rank + 1) % ws, (rank - 1) % ws
+        chunks = [c.copy() for c in chunks]
+        for s in range(ws - 1):
+            send_idx = ring_reduce_scatter_chunk(ws, rank, s)
+            recv_idx = ring_reduce_scatter_chunk(ws, rank, s + 1)
+            self._send(nxt, opid, s, chunks[send_idx])
+            other = yield from self._recv(prv, opid, s)
+            chunks[recv_idx] = fn(chunks[recv_idx], other)
+        own = (rank + 1) % ws
+        return own, chunks[own]
+
+    def _ring_all_gather_chunks(self, own: Tuple[int, np.ndarray]):
+        """Ring all-gather of per-rank chunks -> full ordered chunk list."""
+        opid = next(self._opid)
+        ws, rank = self.world_size, self.rank
+        nxt, prv = (rank + 1) % ws, (rank - 1) % ws
+        idx, chunk = own
+        out: List[Optional[np.ndarray]] = [None] * ws
+        out[idx] = chunk
+        cur = chunk
+        for s in range(ws - 1):
+            self._send(nxt, opid, s, cur)
+            cur = yield from self._recv(prv, opid, s)
+            out[(idx - s - 1) % ws] = cur
+        return out
+
+    def reduce_scatter(self, x: np.ndarray, op: str = "sum"):
+        """Coroutine: rank r returns the r-th equal chunk of the reduction
+        (flattened + zero-padded to a multiple of world_size)."""
+        chunks, _ = _chunk(np.asarray(x), self.world_size, op)
+        idx, reduced = yield from self._ring_reduce_scatter(chunks, op)
+        # after the ring RS, rank holds chunk (rank+1): rotate one hop
+        # forward so every rank returns ITS chunk index
+        if idx != self.rank:
+            opid = next(self._opid)
+            self._send(idx % self.world_size, opid, 0, reduced)
+            reduced = yield from self._recv(
+                (self.rank - 1) % self.world_size, opid, 0)
+        return reduced
+
+    def all_gather(self, x: np.ndarray):
+        """Coroutine: concatenation of every rank's ``x`` along axis 0."""
+        x = np.asarray(x)
+        gathered = yield from self._ring_all_gather_chunks((self.rank, x))
+        return np.concatenate([np.atleast_1d(g) for g in gathered], axis=0)
+
+    def barrier(self):
+        """Coroutine: dissemination barrier — ceil(log2(n)) rounds, works
+        for any world size."""
+        opid = next(self._opid)
+        ws, rank = self.world_size, self.rank
+        token = np.zeros((), np.int8)
+        k = 0
+        while (1 << k) < ws:
+            step = 1 << k
+            self._send((rank + step) % ws, opid, k, token)
+            yield from self._recv((rank - step) % ws, opid, k)
+            k += 1
+        return True
+
+
+def _chunk(x: np.ndarray, n: int, op: str = "sum"):
+    """Flatten + identity-pad to n equal chunks; meta for reassembly."""
+    flat = np.ascontiguousarray(x).reshape(-1)
+    pad = (-len(flat)) % n
+    if pad:
+        fill = np.full(pad, _identity_for(op, flat.dtype), dtype=flat.dtype)
+        flat = np.concatenate([flat, fill])
+    return list(flat.reshape(n, -1)), (x.shape, x.dtype, len(flat) - pad)
+
+
+def _unchunk(chunks: Sequence[np.ndarray], meta) -> np.ndarray:
+    shape, dtype, size = meta
+    flat = np.concatenate(chunks)[:size]
+    return flat.reshape(shape).astype(dtype, copy=False)
+
+
+# ---------------------------------------------------------------------------
+# Drivers
+# ---------------------------------------------------------------------------
+
+def run_collectives(coros: Sequence[Generator], max_spins: int = 1_000_000):
+    """Advance all ranks' coroutines round-robin until every one returns;
+    returns their results in rank order (single-process SPMD driver)."""
+    results = [None] * len(coros)
+    alive = set(range(len(coros)))
+    for _ in range(max_spins):
+        for i in list(alive):
+            try:
+                next(coros[i])
+            except StopIteration as e:
+                results[i] = e.value
+                alive.discard(i)
+        if not alive:
+            return results
+    raise RuntimeError("collective did not complete (deadlock?)")
+
+
+def run_blocking(coro: Generator):
+    """Spin one rank's coroutine to completion (per-rank thread driver)."""
+    while True:
+        try:
+            next(coro)
+        except StopIteration as e:
+            return e.value

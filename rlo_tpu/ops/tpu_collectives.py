@@ -1,0 +1,259 @@
+"""TPU-native collectives: static ppermute schedules under shard_map.
+
+This is the ``tpu`` transport of the framework — the role BASELINE.json
+assigns to the reference's abandoned one-sided RMA experiment
+(/root/reference/rma_util.c:29-62): one-sided remote writes become
+`jax.lax.ppermute` (XLA CollectivePermute, ICI remote-DMA). There is no
+MPI_ANY_SOURCE on ICI, so the reference's reactive tag-dispatch loop
+(rootless_ops.c:582-621) is reformulated as precomputed static schedules
+from rlo_tpu.topology (SURVEY.md §7 design stance).
+
+Everything here is a **per-shard function**: call it inside `jax.shard_map`
+over a mesh axis (helpers in rlo_tpu.parallel.mesh wrap that for you). The
+per-step partial reduction can run as the Pallas fused kernel
+(rlo_tpu.pallas.reduce) or as plain XLA ops.
+
+Op map (reference -> here):
+  - RLO_bcast_gen (rootless_ops.c:1581)  -> rootless_bcast (binomial or
+    skip-ring schedule; 'gather' strategy for traced origins)
+  - IAR consensus (rootless_ops.c:876)   -> consensus = pmin over int32
+    votes (the AND-vote is a min-reduce over {0,1}); judgement/action
+    callbacks stay on the host around the device step
+  - net-new data collectives             -> allreduce (ring /
+    recursive-doubling / psum), reduce_scatter, all_gather, barrier
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from rlo_tpu import topology
+from rlo_tpu.pallas import reduce as pallas_reduce
+
+_JNP_OPS = {"sum": jnp.add, "min": jnp.minimum, "max": jnp.maximum,
+            "and": jnp.bitwise_and, "or": jnp.bitwise_or}
+_PSUM_OPS = {"sum": lax.psum, "min": lax.pmin, "max": lax.pmax}
+
+
+def _combiner(op: str, use_pallas: bool) -> Callable:
+    if use_pallas:
+        return functools.partial(pallas_reduce.fused_combine, op=op)
+    return _JNP_OPS[op]
+
+
+# ---------------------------------------------------------------------------
+# Rootless broadcast
+# ---------------------------------------------------------------------------
+
+def rootless_bcast(x, origin: int, axis: str, *, schedule: str = "binomial"):
+    """Broadcast ``x`` from shard ``origin`` to every shard on ``axis``.
+
+    Any rank may be the origin — the rootless property. ``origin`` must be a
+    Python int (each origin compiles its own static ppermute schedule, which
+    jit caches). For a traced origin use strategy 'gather'.
+
+    schedule: 'binomial' (ceil(log2 n) rounds, default), 'skip_ring'
+    (reference-overlay parity, more rounds since CollectivePermute cannot
+    multicast), or 'gather' (all_gather + dynamic index — works with traced
+    origins).
+    """
+    ws = lax.axis_size(axis)
+    if schedule == "gather":
+        full = lax.all_gather(x, axis)
+        return lax.dynamic_index_in_dim(full, origin, 0, keepdims=False)
+    if schedule == "binomial":
+        sched = topology.binomial_bcast_schedule(ws, origin)
+    elif schedule == "skip_ring":
+        sched = topology.skip_ring_bcast_schedule(ws, origin)
+    else:
+        raise ValueError(f"unknown schedule {schedule!r}")
+    idx = lax.axis_index(axis)
+    for rnd in sched.rounds:
+        recv = lax.ppermute(x, axis, list(rnd))
+        dsts = jnp.asarray([d for _, d in rnd])
+        is_dst = jnp.any(idx == dsts)
+        x = jnp.where(is_dst, recv, x)
+    return x
+
+
+# ---------------------------------------------------------------------------
+# Allreduce / reduce-scatter / all-gather
+# ---------------------------------------------------------------------------
+
+def allreduce(x, axis: str, *, op: str = "sum", algorithm: str = "auto",
+              use_pallas: Optional[bool] = None):
+    """Reduction of per-shard ``x`` across ``axis``; result replicated.
+
+    algorithm: 'psum' lowers to one XLA AllReduce (the baseline to beat);
+    'ring' is reduce-scatter + all-gather over explicit ppermute steps with
+    the Pallas fused combine (bandwidth-optimal, overlappable); 'recursive
+    doubling' is log2(n) full-vector exchanges (small payloads, pow2 only).
+    'auto': psum — XLA already picks near-optimal ICI strategies; the manual
+    schedules exist to host fused per-step compute and for parity studies.
+    """
+    if use_pallas is None:
+        use_pallas = jax.default_backend() == "tpu"
+    if algorithm == "auto":
+        algorithm = "psum"
+    if algorithm == "psum":
+        if op in _PSUM_OPS:
+            return _PSUM_OPS[op](x, axis)
+        if op in ("and", "or"):  # min/max over {0,1} == and/or
+            f = lax.pmin if op == "and" else lax.pmax
+            return f(x, axis)
+        raise ValueError(f"unknown op {op!r}")
+    if algorithm == "recursive_doubling":
+        return _allreduce_rd(x, axis, op, use_pallas)
+    if algorithm == "ring":
+        chunks, meta = _chunk_shard(x, lax.axis_size(axis))
+        _, reduced = _ring_reduce_scatter(chunks, axis, op, use_pallas)
+        gathered = _ring_all_gather_rolled(reduced, axis)
+        return _unchunk_shard(gathered, meta)
+    raise ValueError(f"unknown algorithm {algorithm!r}")
+
+
+def _allreduce_rd(x, axis: str, op: str, use_pallas: bool):
+    ws = lax.axis_size(axis)
+    if not topology.is_power_of_2(ws):
+        raise ValueError("recursive_doubling requires power-of-2 axis size")
+    combine = _combiner(op, use_pallas)
+    for rnd in topology.recursive_doubling_rounds(ws):
+        other = lax.ppermute(x, axis, list(rnd))
+        x = combine(x, other)
+    return x
+
+
+def _chunk_shard(x, ws: int):
+    """Flatten + zero-pad per-shard data into (ws, chunk) rows."""
+    flat = x.reshape(-1)
+    pad = (-flat.size) % ws
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros(pad, flat.dtype)])
+    return flat.reshape(ws, -1), (x.shape, x.dtype, flat.size - pad)
+
+
+def _unchunk_shard(chunks, meta):
+    """Reassemble (ws, chunk) rows — already in global index order — into
+    the original per-shard shape."""
+    shape, _, size = meta
+    return chunks.reshape(-1)[:size].reshape(shape)
+
+
+def _ring_reduce_scatter(chunks, axis: str, op: str, use_pallas: bool):
+    """ws-1 ppermute steps; returns (owned_chunk_index, reduced_chunk).
+
+    After the loop, shard r owns the fully-reduced chunk (r+1) mod ws.
+    The per-step combine is the Pallas fused kernel when enabled.
+    """
+    ws = chunks.shape[0]
+    idx = lax.axis_index(axis)
+    combine = _combiner(op, use_pallas)
+    perm = list(topology.ring_perm(ws))
+
+    def step(s, chunks):
+        # schedule per topology.ring_reduce_scatter_chunk (traced indices)
+        send_idx = (idx - s) % ws
+        send = lax.dynamic_index_in_dim(chunks, send_idx, 0, keepdims=False)
+        recv = lax.ppermute(send, axis, perm)
+        recv_idx = (idx - s - 1) % ws
+        cur = lax.dynamic_index_in_dim(chunks, recv_idx, 0, keepdims=False)
+        new = combine(cur, recv)
+        return lax.dynamic_update_index_in_dim(chunks, new, recv_idx, 0)
+
+    chunks = lax.fori_loop(0, ws - 1, step, chunks)
+    own_idx = (idx + 1) % ws
+    return own_idx, lax.dynamic_index_in_dim(chunks, own_idx, 0,
+                                             keepdims=False)
+
+
+def _ring_all_gather_rolled(chunk, axis: str):
+    """Ring all-gather of one chunk per shard -> (ws, chunk) ordered rows.
+
+    Shard r starts holding chunk (r+1); after ws-1 forwarding steps every
+    shard reassembles all chunks in index order.
+    """
+    ws = lax.axis_size(axis)
+    idx = lax.axis_index(axis)
+    perm = list(topology.ring_perm(ws))
+    out = jnp.zeros((ws,) + chunk.shape, chunk.dtype)
+    own_idx = (idx + 1) % ws
+    out = lax.dynamic_update_index_in_dim(out, chunk, own_idx, 0)
+
+    def step(s, carry):
+        out, cur = carry
+        nxt = lax.ppermute(cur, axis, perm)
+        # what arrives at step s is chunk (idx - s) mod ws
+        arr_idx = (idx - s) % ws
+        out = lax.dynamic_update_index_in_dim(out, nxt, arr_idx, 0)
+        return out, nxt
+
+    out, _ = lax.fori_loop(0, ws - 1, step, (out, chunk))
+    return out
+
+
+def reduce_scatter(x, axis: str, *, op: str = "sum",
+                   use_pallas: Optional[bool] = None):
+    """Shard r returns the r-th equal chunk of the reduction of ``x``
+    (flattened, zero-padded to a multiple of the axis size)."""
+    if use_pallas is None:
+        use_pallas = jax.default_backend() == "tpu"
+    ws = lax.axis_size(axis)
+    chunks, _ = _chunk_shard(x, ws)
+    own_idx, reduced = _ring_reduce_scatter(chunks, axis, op, use_pallas)
+    # rotate one hop forward so shard r holds chunk r
+    back_perm = list(topology.ring_perm(ws, 1))
+    return lax.ppermute(reduced, axis, back_perm)
+
+
+def all_gather(x, axis: str, *, algorithm: str = "xla"):
+    """Concatenate every shard's ``x`` along a new leading axis.
+
+    'xla' lowers to one AllGather; 'ring' uses explicit ppermute steps.
+    """
+    if algorithm == "xla":
+        return lax.all_gather(x, axis)
+    ws = lax.axis_size(axis)
+    idx = lax.axis_index(axis)
+    perm = list(topology.ring_perm(ws))
+    out = jnp.zeros((ws,) + x.shape, x.dtype)
+    out = lax.dynamic_update_index_in_dim(out, x, idx, 0)
+    cur = x
+
+    def step(s, carry):
+        out, cur = carry
+        nxt = lax.ppermute(cur, axis, perm)
+        arr_idx = (idx - s - 1) % ws
+        out = lax.dynamic_update_index_in_dim(out, nxt, arr_idx, 0)
+        return out, nxt
+
+    out, _ = lax.fori_loop(0, ws - 1, step, (out, cur))
+    return out
+
+
+def barrier(axis: str):
+    """Synchronize all shards on ``axis`` (an AllReduce of a unit token —
+    the engine-level analogue is the dissemination barrier in
+    rlo_tpu.ops.collectives)."""
+    return lax.psum(jnp.zeros((), jnp.int32), axis)
+
+
+# ---------------------------------------------------------------------------
+# Consensus (IAR) on device
+# ---------------------------------------------------------------------------
+
+def consensus(vote, axis: str):
+    """Leaderless consensus decision: AND of every shard's {0,1} vote —
+    a min-reduce, exactly the reference's ``vote &= v`` merge
+    (rootless_ops.c:1060) collapsed into one tree reduction.
+
+    The reference's judgement callback runs on the host *before* this step
+    (producing ``vote``); the action callback runs after, gated on the
+    returned decision — see rlo_tpu.parallel.consensus_step for the full
+    host-side protocol wrapper.
+    """
+    return lax.pmin(vote.astype(jnp.int32), axis)

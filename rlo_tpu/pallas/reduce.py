@@ -1,0 +1,107 @@
+"""Pallas fused combine kernels — the per-step partial reduction of the
+ring/recursive-doubling collectives.
+
+BASELINE.json's north star asks for "the per-step partial reduction fused as
+a Pallas kernel" (the TPU analogue of the reference's in-place vote merge
+``vote &= v``, rootless_ops.c:1060, generalized from 1-bit AND to tensor
+sum/min/max/and). The kernel fuses: upcast to f32 accumulation (for bf16
+payloads), the combine, and the downcast — one VMEM-resident pass instead of
+three HBM round-trips.
+
+On non-TPU platforms the same kernel runs in Pallas interpret mode so tests
+exercise the identical code path; tile shapes follow the v5e constraints
+(lane dim 128, sublane multiples of 8 for f32 / 16 for bf16 — see
+/opt/skills/guides/pallas_guide.md "Tiling Constraints").
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_LANE = 128
+_DEFAULT_BLOCK_ROWS = 512  # 512*128*4B = 256 KB/operand in VMEM
+
+
+def _on_tpu() -> bool:
+    return jax.default_backend() == "tpu"
+
+
+_F32_OPS = {"sum": jnp.add, "min": jnp.minimum, "max": jnp.maximum}
+_INT_OPS = {"and": jnp.bitwise_and, "or": jnp.bitwise_or}
+
+
+def _combine_kernel(op_name: str, out_dtype):
+    if op_name in _F32_OPS:
+        fn = _F32_OPS[op_name]
+
+        def kernel(a_ref, b_ref, o_ref):
+            a = a_ref[...].astype(jnp.float32)
+            b = b_ref[...].astype(jnp.float32)
+            o_ref[...] = fn(a, b).astype(out_dtype)
+    else:
+        fn = _INT_OPS[op_name]
+
+        def kernel(a_ref, b_ref, o_ref):
+            o_ref[...] = fn(a_ref[...], b_ref[...])
+    return kernel
+
+
+def _out_struct(a):
+    """ShapeDtypeStruct matching ``a``, propagating the varying-mesh-axes
+    annotation so the kernel works inside shard_map (check_vma=True)."""
+    try:
+        vma = jax.typeof(a).vma
+    except (AttributeError, TypeError):
+        vma = None
+    if vma:
+        return jax.ShapeDtypeStruct(a.shape, a.dtype, vma=vma)
+    return jax.ShapeDtypeStruct(a.shape, a.dtype)
+
+
+def _fused_combine_2d(a, b, op: str, block_rows: int, interpret: bool):
+    rows = a.shape[0]
+    grid = (pl.cdiv(rows, block_rows),)
+    spec = pl.BlockSpec((block_rows, _LANE), lambda i: (i, 0))
+    return pl.pallas_call(
+        _combine_kernel(op, a.dtype),
+        out_shape=_out_struct(a),
+        grid=grid,
+        in_specs=[spec, spec],
+        out_specs=spec,
+        interpret=interpret,
+    )(a, b)
+
+
+def fused_combine(a, b, op: str = "sum", block_rows: int = _DEFAULT_BLOCK_ROWS,
+                  interpret: bool | None = None):
+    """Elementwise ``op(a, b)`` with f32 accumulation, as one Pallas kernel.
+
+    Accepts any shape/dtype; internally lays the data out as (rows, 128)
+    lanes, padding the tail. ``interpret=None`` auto-selects: compiled on
+    TPU, interpreter elsewhere.
+    """
+    if a.shape != b.shape or a.dtype != b.dtype:
+        raise ValueError(f"operand mismatch: {a.shape}/{a.dtype} vs "
+                         f"{b.shape}/{b.dtype}")
+    if op not in _F32_OPS and op not in _INT_OPS:
+        raise ValueError(f"unknown op {op!r}")
+    if interpret is None:
+        interpret = not _on_tpu()
+    orig_shape = a.shape
+    n = a.size
+    rows = -(-n // _LANE)
+    # sublane alignment: round rows up so every grid block is full
+    sub = 16 if a.dtype == jnp.bfloat16 else 8
+    rows = -(-rows // sub) * sub
+    pad = rows * _LANE - n
+    af = jnp.concatenate([a.reshape(-1), jnp.zeros(pad, a.dtype)]) \
+        .reshape(rows, _LANE)
+    bf = jnp.concatenate([b.reshape(-1), jnp.zeros(pad, b.dtype)]) \
+        .reshape(rows, _LANE)
+    block = min(block_rows, rows)
+    out = _fused_combine_2d(af, bf, op, block, interpret)
+    return out.reshape(-1)[:n].reshape(orig_shape)

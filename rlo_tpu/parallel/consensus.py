@@ -1,0 +1,67 @@
+"""Host-side IAR consensus protocol over the TPU collective backend.
+
+The reference's consensus is host-reactive: arbitrary C judgement callbacks
+run in the middle of the vote tree (rootless_ops.c:698, 773) and the action
+callback fires on decision (:842). On TPU the vote aggregation is one
+device-side min-reduction (rlo_tpu.ops.tpu_collectives.consensus); the
+callbacks stay on the host around that sync point — the host/device split
+SURVEY.md §7 calls the "hard part" of this mapping.
+
+Protocol per submit() (mirrors RLO_submit_proposal -> judge -> vote merge ->
+decision -> action, rootless_ops.c:876-932):
+  1. host: judge_cb(proposal, app_ctx) -> my vote in {0,1}
+  2. device: pmin over every shard's vote on the mesh axis
+  3. host: if approved, action_cb(proposal, app_ctx)
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from rlo_tpu.ops import tpu_collectives
+
+
+class TpuConsensus:
+    """Leaderless consensus context bound to one mesh axis.
+
+    In multi-controller deployments every host process judges its own
+    proposal copy and contributes the votes of its local shards; in
+    single-controller tests per-shard votes can be injected directly via
+    ``decide_votes`` to model heterogeneous judges.
+    """
+
+    def __init__(self, mesh: Mesh, axis: str,
+                 judge_cb: Optional[Callable[[bytes, object], int]] = None,
+                 app_ctx: object = None,
+                 action_cb: Optional[Callable[[bytes, object], object]] = None):
+        self.mesh = mesh
+        self.axis = axis
+        self.judge_cb = judge_cb
+        self.app_ctx = app_ctx
+        self.action_cb = action_cb
+        self.axis_size = mesh.shape[axis]
+        self._decide = jax.jit(jax.shard_map(
+            lambda v: tpu_collectives.consensus(v, axis),
+            mesh=mesh, in_specs=P(axis), out_specs=P(axis)))
+
+    def decide_votes(self, votes) -> int:
+        """Device-side AND over per-shard votes; returns the decision."""
+        votes = jnp.asarray(votes, jnp.int32).reshape(self.axis_size)
+        out = np.asarray(self._decide(votes))
+        return int(out[0])
+
+    def submit(self, proposal: bytes) -> int:
+        """Full propose/judge/decide/act round; returns 1 approved, 0
+        declined."""
+        my_vote = 1 if self.judge_cb is None else \
+            int(self.judge_cb(proposal, self.app_ctx))
+        votes = np.full((self.axis_size,), my_vote, np.int32)
+        decision = self.decide_votes(votes)
+        if decision and self.action_cb is not None:
+            self.action_cb(proposal, self.app_ctx)
+        return decision

@@ -1,0 +1,47 @@
+"""Device mesh discovery and shard_map helpers.
+
+TPU equivalent of the reference's communicator setup (MPI_Comm_dup +
+size/rank discovery in bcomm_init, /root/reference/rootless_ops.c:1461-1468):
+on TPU the "communicator" is a `jax.sharding.Mesh` over the ICI topology and
+"ranks" are mesh axis indices.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def make_mesh(shape: Optional[Sequence[int]] = None,
+              axis_names: Optional[Sequence[str]] = None) -> Mesh:
+    """Build a mesh over the available devices.
+
+    Default: 1-D mesh named 'x' over all devices. Pass e.g.
+    shape=(2, 4), axis_names=('dp', 'tp') for multi-axis layouts.
+    """
+    devices = np.asarray(jax.devices())
+    if shape is None:
+        shape = (len(devices),)
+    if axis_names is None:
+        axis_names = ("x",) if len(shape) == 1 else \
+            tuple(f"axis{i}" for i in range(len(shape)))
+    need = math.prod(shape)
+    if need > len(devices):
+        raise ValueError(f"mesh shape {tuple(shape)} needs {need} devices, "
+                         f"have {len(devices)}")
+    return Mesh(devices[:need].reshape(shape), tuple(axis_names))
+
+
+def shard_jit(fn, mesh: Mesh, in_specs, out_specs):
+    """jit(shard_map(fn)) — one SPMD program over the mesh.
+
+    check_vma is disabled: the Pallas interpreter used on non-TPU backends
+    loses varying-mesh-axes annotations in its internal grid loop, which
+    would spuriously reject kernels that are correct on TPU.
+    """
+    return jax.jit(jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs, check_vma=False))

@@ -1,0 +1,181 @@
+"""Engine-substrate data collectives vs numpy oracles.
+
+BASELINE.json config 1 analogue: float32 allreduce across 8 ranks with a
+1 MB buffer — run in-process over the loopback transport, plus
+reduce-scatter / all-gather / barrier and latency-fuzz and threaded-driver
+variants.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from rlo_tpu.ops.collectives import Comm, run_blocking, run_collectives
+from rlo_tpu.transport import make_world
+
+WORLD_SIZES = [2, 3, 4, 5, 7, 8, 16]
+
+
+def make_comms(ws, **kw):
+    world = make_world("loopback", ws, **kw)
+    return world, [Comm(world.transport(r)) for r in range(ws)]
+
+
+def rank_data(ws, shape=(64,), dtype=np.float32, seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.standard_normal(shape).astype(dtype) + r
+            for r in range(ws)]
+
+
+class TestAllreduce:
+    @pytest.mark.parametrize("ws", WORLD_SIZES)
+    @pytest.mark.parametrize("algorithm", ["recursive_doubling", "ring"])
+    def test_sum_matches_numpy(self, ws, algorithm):
+        world, comms = make_comms(ws)
+        xs = rank_data(ws, shape=(33, 7))
+        want = np.sum(xs, axis=0)
+        got = run_collectives(
+            [c.allreduce(x, algorithm=algorithm) for c, x in zip(comms, xs)])
+        for g in got:
+            np.testing.assert_allclose(g, want, rtol=1e-5)
+
+    @pytest.mark.parametrize("op,npop", [("min", np.min), ("max", np.max),
+                                         ("prod", np.prod)])
+    def test_other_ops(self, op, npop):
+        ws = 8
+        world, comms = make_comms(ws)
+        xs = rank_data(ws)
+        want = npop(np.stack(xs), axis=0)
+        got = run_collectives(
+            [c.allreduce(x, op=op) for c, x in zip(comms, xs)])
+        for g in got:
+            np.testing.assert_allclose(g, want, rtol=1e-5)
+
+    def test_vote_and_reduce(self):
+        """The IAR AND-merge generalized to tensors (int32 votes)."""
+        ws = 7
+        world, comms = make_comms(ws)
+        xs = [np.ones(5, np.int32) for _ in range(ws)]
+        xs[3][2] = 0  # one dissenter on element 2
+        got = run_collectives(
+            [c.allreduce(x, op="and") for c, x in zip(comms, xs)])
+        for g in got:
+            np.testing.assert_array_equal(g, [1, 1, 0, 1, 1])
+
+    def test_ring_min_with_padding(self):
+        """Ring algorithm + min op + ragged size: identity padding must not
+        leak into results."""
+        ws = 8
+        world, comms = make_comms(ws)
+        xs = rank_data(ws, shape=(ws * 2 + 3,))
+        want = np.min(np.stack(xs), axis=0)
+        got = run_collectives(
+            [c.allreduce(x, op="min", algorithm="ring")
+             for c, x in zip(comms, xs)])
+        for g in got:
+            np.testing.assert_allclose(g, want, rtol=1e-5)
+
+    def test_1mb_float32_8ranks(self):
+        """BASELINE config 1 shape: 1 MB float32, 8 ranks."""
+        ws = 8
+        world, comms = make_comms(ws)
+        n = (1 << 20) // 4
+        xs = rank_data(ws, shape=(n,))
+        want = np.sum(xs, axis=0)
+        got = run_collectives(
+            [c.allreduce(x) for c, x in zip(comms, xs)])  # auto -> ring
+        for g in got:
+            np.testing.assert_allclose(g, want, rtol=1e-4)
+
+    @pytest.mark.parametrize("ws", [3, 8])
+    def test_under_latency_fuzz(self, ws):
+        world, comms = make_comms(ws, latency=5, seed=11)
+        xs = rank_data(ws)
+        want = np.sum(xs, axis=0)
+        got = run_collectives([c.allreduce(x) for c, x in zip(comms, xs)])
+        for g in got:
+            np.testing.assert_allclose(g, want, rtol=1e-5)
+
+    def test_threaded_blocking_driver(self):
+        ws = 8
+        world, comms = make_comms(ws)
+        xs = rank_data(ws)
+        want = np.sum(xs, axis=0)
+        got = [None] * ws
+
+        def work(r):
+            got[r] = run_blocking(comms[r].allreduce(xs[r]))
+
+        threads = [threading.Thread(target=work, args=(r,))
+                   for r in range(ws)]
+        [t.start() for t in threads]
+        [t.join(timeout=30) for t in threads]
+        for g in got:
+            np.testing.assert_allclose(g, want, rtol=1e-5)
+
+    def test_back_to_back_ops_stay_matched(self):
+        """Two sequential collectives must not cross-match messages."""
+        ws = 4
+        world, comms = make_comms(ws, latency=3, seed=2)
+        xs = rank_data(ws)
+        ys = rank_data(ws, seed=1)
+
+        def both(c, x, y):
+            a = yield from c.allreduce(x)
+            b = yield from c.allreduce(y, algorithm="ring")
+            return a, b
+
+        got = run_collectives(
+            [both(c, x, y) for c, x, y in zip(comms, xs, ys)])
+        for a, b in got:
+            np.testing.assert_allclose(a, np.sum(xs, axis=0), rtol=1e-5)
+            np.testing.assert_allclose(b, np.sum(ys, axis=0), rtol=1e-5)
+
+
+class TestReduceScatterAllGather:
+    @pytest.mark.parametrize("ws", WORLD_SIZES)
+    def test_reduce_scatter(self, ws):
+        world, comms = make_comms(ws)
+        xs = rank_data(ws, shape=(ws * 3 + 1,))  # force padding
+        full = np.sum(xs, axis=0)
+        pad = (-len(full)) % ws
+        padded = np.concatenate([full, np.zeros(pad, np.float32)])
+        want_chunks = padded.reshape(ws, -1)
+        got = run_collectives(
+            [c.reduce_scatter(x) for c, x in zip(comms, xs)])
+        for r, g in enumerate(got):
+            np.testing.assert_allclose(g, want_chunks[r], rtol=1e-5)
+
+    @pytest.mark.parametrize("ws", WORLD_SIZES)
+    def test_all_gather(self, ws):
+        world, comms = make_comms(ws)
+        xs = [np.full((2, 3), r, np.float32) for r in range(ws)]
+        got = run_collectives([c.all_gather(x) for c, x in zip(comms, xs)])
+        want = np.concatenate(xs, axis=0)
+        for g in got:
+            np.testing.assert_array_equal(g, want)
+
+    def test_rs_ag_composition_equals_allreduce(self):
+        ws = 8
+        world, comms = make_comms(ws)
+        xs = rank_data(ws, shape=(ws * 5,))
+
+        def rs_ag(c, x):
+            chunk = yield from c.reduce_scatter(x)
+            full = yield from c.all_gather(chunk)
+            return full
+
+        got = run_collectives([rs_ag(c, x) for c, x in zip(comms, xs)])
+        want = np.sum(xs, axis=0)
+        for g in got:
+            np.testing.assert_allclose(g, want, rtol=1e-5)
+
+
+class TestBarrier:
+    @pytest.mark.parametrize("ws", WORLD_SIZES)
+    def test_barrier_completes(self, ws):
+        world, comms = make_comms(ws, latency=4, seed=3)
+        got = run_collectives([c.barrier() for c in comms])
+        assert got == [True] * ws
+        assert world.quiescent()

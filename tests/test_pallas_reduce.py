@@ -1,0 +1,63 @@
+"""Direct unit tests of the Pallas fused-combine kernel (interpret mode on
+the CPU backend; the identical kernel compiles on TPU)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rlo_tpu.pallas.reduce import fused_combine
+
+
+class TestFusedCombine:
+    @pytest.mark.parametrize("shape", [(8, 128), (1024,), (3, 5, 7),
+                                       (1,), (513,)])
+    def test_sum_arbitrary_shapes(self, shape):
+        rng = np.random.default_rng(0)
+        a = jnp.asarray(rng.standard_normal(shape), jnp.float32)
+        b = jnp.asarray(rng.standard_normal(shape), jnp.float32)
+        np.testing.assert_allclose(np.asarray(fused_combine(a, b)),
+                                   np.asarray(a) + np.asarray(b), rtol=1e-6)
+
+    @pytest.mark.parametrize("op,npop", [("min", np.minimum),
+                                         ("max", np.maximum)])
+    def test_min_max(self, op, npop):
+        rng = np.random.default_rng(1)
+        a = jnp.asarray(rng.standard_normal((64, 64)), jnp.float32)
+        b = jnp.asarray(rng.standard_normal((64, 64)), jnp.float32)
+        np.testing.assert_array_equal(
+            np.asarray(fused_combine(a, b, op=op)),
+            npop(np.asarray(a), np.asarray(b)))
+
+    def test_int_and(self):
+        a = jnp.ones((16, 128), jnp.int32).at[3, 4].set(0)
+        b = jnp.ones((16, 128), jnp.int32).at[5, 6].set(0)
+        got = np.asarray(fused_combine(a, b, op="and"))
+        assert got[3, 4] == 0 and got[5, 6] == 0 and got.sum() == 16 * 128 - 2
+
+    def test_bf16_f32_accumulation(self):
+        # values whose bf16 sum would lose precision without f32 accum
+        a = jnp.full((256,), 1.001, jnp.bfloat16)
+        b = jnp.full((256,), 1e-3, jnp.bfloat16)
+        got = np.asarray(fused_combine(a, b), np.float32)
+        want = (np.full(256, np.float32(jnp.bfloat16(1.001)))
+                + np.full(256, np.float32(jnp.bfloat16(1e-3))))
+        # result re-quantizes to bf16 at the end; error bounded by one ulp
+        np.testing.assert_allclose(got, want, rtol=4e-3)
+
+    def test_blocking_covers_multiple_grid_steps(self):
+        rng = np.random.default_rng(2)
+        a = jnp.asarray(rng.standard_normal((4096, 128)), jnp.float32)
+        b = jnp.asarray(rng.standard_normal((4096, 128)), jnp.float32)
+        got = fused_combine(a, b, block_rows=256)
+        np.testing.assert_allclose(np.asarray(got),
+                                   np.asarray(a) + np.asarray(b), rtol=1e-6)
+
+    def test_mismatched_operands_raise(self):
+        with pytest.raises(ValueError):
+            fused_combine(jnp.zeros((4,)), jnp.zeros((5,)))
+        with pytest.raises(ValueError):
+            fused_combine(jnp.zeros((4,)), jnp.zeros((4,), jnp.int32))
+
+    def test_unknown_op_raises(self):
+        with pytest.raises(ValueError):
+            fused_combine(jnp.zeros(4), jnp.zeros(4), op="xor")
