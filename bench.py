@@ -1,0 +1,166 @@
+"""Headline benchmark. Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Adaptive to the hardware the driver runs on:
+  - multi-device TPU: BASELINE.json north star — ring-allreduce bus
+    bandwidth (GB/s/chip) on a 256 MB fp32 buffer vs `lax.psum`
+    (vs_baseline = ours / psum; target >= 0.9).
+  - single device (the tunneled v5e chip): the building block that bounds
+    the allreduce — the Pallas fused-combine kernel's HBM throughput vs the
+    identical XLA-fused combine (vs_baseline = pallas / xla).
+
+Timing methodology: the tunneled device has ~80 ms host<->device round-trip
+latency and an async dispatch whose block_until_ready does not synchronize,
+so single-op wall timing is meaningless. Each measurement chains K
+serially-dependent iterations of the op inside ONE jit (lax.fori_loop),
+forces completion with a scalar device-to-host readback, measures the fixed
+readback overhead with an empty chain, and reports (t_chain - t_overhead)/K.
+
+Diagnostics go to stderr; stdout carries exactly the one JSON line.
+"""
+
+import json
+import sys
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+ITERS = 5
+CHAIN = 64
+
+
+def _sync_scalar(x):
+    """Force completion: pull one dependent element to the host."""
+    return np.asarray(jax.device_get(x.reshape(-1)[0]))
+
+
+def _wall(fn, *args, iters=ITERS):
+    fn(*args)  # warmup/compile
+    ts = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        fn(*args)
+        ts.append(time.perf_counter() - t0)
+    return float(np.median(ts))
+
+
+def _chain_time(loop_fn, x0, *rest, k=CHAIN):
+    """Median wall time of a k-iteration chained jit, minus the fixed
+    dispatch+readback overhead, per iteration."""
+    def run(kk):
+        out = loop_fn(x0, *rest, kk)
+        _sync_scalar(out)
+
+    t_full = _wall(run, k)
+    t_empty = _wall(run, 0)
+    per_op = (t_full - t_empty) / k
+    print(f"chain k={k}: {t_full*1e3:.1f} ms, empty {t_empty*1e3:.1f} ms "
+          f"-> {per_op*1e3:.3f} ms/op", file=sys.stderr)
+    return max(per_op, 1e-9)
+
+
+def bench_single_chip():
+    """Pallas fused combine vs XLA fused combine, 256 MB fp32 operands."""
+    from rlo_tpu.pallas.reduce import fused_combine
+
+    rows, lane = 512 * 1024, 128  # 512Ki x 128 x 4B = 256 MB per operand
+    rng = np.random.default_rng(0)
+    a = jnp.asarray(rng.standard_normal((rows, lane)), jnp.float32)
+    b = jnp.asarray(rng.standard_normal((rows, lane)), jnp.float32)
+    nbytes = a.size * 4
+
+    @partial(jax.jit, static_argnames=("k",))
+    def pallas_loop(x, y, k):
+        return jax.lax.fori_loop(
+            0, k, lambda i, acc: fused_combine(acc, y, op="sum"), x)
+
+    @partial(jax.jit, static_argnames=("k",))
+    def xla_loop(x, y, k):
+        return jax.lax.fori_loop(0, k, lambda i, acc: acc + y, x)
+
+    t_pallas = _chain_time(pallas_loop, a, b)
+    t_xla = _chain_time(xla_loop, a, b)
+    gbps = 3 * nbytes / t_pallas / 1e9      # read acc + read y + write acc
+    base_gbps = 3 * nbytes / t_xla / 1e9
+    print(f"pallas: {t_pallas*1e3:.3f} ms ({gbps:.1f} GB/s)  "
+          f"xla: {t_xla*1e3:.3f} ms ({base_gbps:.1f} GB/s)", file=sys.stderr)
+    return {
+        "metric": "pallas fused-combine HBM throughput, 256MB fp32 "
+                  "(per-step reduction of ring allreduce), single v5e chip",
+        "value": round(gbps, 2),
+        "unit": "GB/s",
+        "vs_baseline": round(gbps / base_gbps, 4),
+    }
+
+
+def bench_multi_chip():
+    """Ring allreduce bus bandwidth vs lax.psum, 256 MB fp32 across the
+    mesh (BASELINE.json north-star configuration)."""
+    from jax.sharding import PartitionSpec as P
+
+    from rlo_tpu.ops import tpu_collectives as tc
+    from rlo_tpu.parallel.mesh import make_mesh
+
+    n_dev = len(jax.devices())
+    mesh = make_mesh((n_dev,), ("x",))
+    # each shard contributes a full 256 MB buffer (the north-star config:
+    # "256MB float32 allreduce" = 256 MB reduced per rank, not split)
+    per_shard = (256 << 20) // 4
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((n_dev, per_shard)), jnp.float32)
+    nbytes_per_shard = per_shard * 4
+
+    def chained(algorithm):
+        def body(v):
+            def it(i, acc):
+                return tc.allreduce(acc, "x", algorithm=algorithm) \
+                    / jnp.float32(n_dev)  # keep magnitude bounded
+            return lambda k: jax.lax.fori_loop(0, k, it, v)
+
+        inner = jax.shard_map(
+            lambda v, k: body(v)(k), mesh=mesh,
+            in_specs=(P("x"), P()), out_specs=P("x"), check_vma=False)
+        return jax.jit(inner, static_argnames=())
+
+    ours_fn = chained("ring")
+    base_fn = chained("psum")
+
+    def make_loop(fn):
+        def loop(v, k):
+            return fn(v, jnp.int32(k))
+        return loop
+
+    t_ours = _chain_time(make_loop(ours_fn), x)
+    t_base = _chain_time(make_loop(base_fn), x)
+    # ring allreduce bus traffic per chip: 2*(n-1)/n of the buffer size
+    bus_bytes = 2 * (n_dev - 1) / n_dev * nbytes_per_shard
+    bw_ours = bus_bytes / t_ours / 1e9
+    bw_base = bus_bytes / t_base / 1e9
+    print(f"ring: {t_ours*1e3:.2f} ms ({bw_ours:.1f} GB/s/chip)  "
+          f"psum: {t_base*1e3:.2f} ms ({bw_base:.1f} GB/s/chip)",
+          file=sys.stderr)
+    return {
+        "metric": f"ring allreduce bus bandwidth, 256MB fp32, "
+                  f"{n_dev} chips, vs lax.psum",
+        "value": round(bw_ours, 2),
+        "unit": "GB/s/chip",
+        "vs_baseline": round(t_base / t_ours, 4),
+    }
+
+
+def main():
+    n_dev = len(jax.devices())
+    backend = jax.default_backend()
+    print(f"backend={backend} devices={n_dev}", file=sys.stderr)
+    if n_dev > 1:
+        result = bench_multi_chip()
+    else:
+        result = bench_single_chip()
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()
