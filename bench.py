@@ -49,17 +49,30 @@ def _wall(fn, *args, iters=ITERS):
 
 def _chain_time(loop_fn, x0, *rest, k=CHAIN):
     """Median wall time of a k-iteration chained jit, minus the fixed
-    dispatch+readback overhead, per iteration."""
+    dispatch+readback overhead, per iteration.
+
+    If the k-iteration chain doesn't rise clearly above the empty-chain
+    dispatch overhead (~75 ms with a few ms of noise on the tunneled
+    device), the measurement is below the noise floor — escalate k rather
+    than report a garbage number."""
     def run(kk):
         out = loop_fn(x0, *rest, kk)
         _sync_scalar(out)
 
-    t_full = _wall(run, k)
     t_empty = _wall(run, 0)
-    per_op = (t_full - t_empty) / k
-    print(f"chain k={k}: {t_full*1e3:.1f} ms, empty {t_empty*1e3:.1f} ms "
-          f"-> {per_op*1e3:.3f} ms/op", file=sys.stderr)
-    return max(per_op, 1e-9)
+    while True:
+        t_full = _wall(run, k)
+        per_op = (t_full - t_empty) / k
+        print(f"chain k={k}: {t_full*1e3:.1f} ms, empty {t_empty*1e3:.1f} ms "
+              f"-> {per_op*1e3:.3f} ms/op", file=sys.stderr)
+        if t_full - t_empty > 0.25 * t_empty or k >= 4096:
+            break
+        k *= 4
+    if per_op <= 0:
+        raise RuntimeError(
+            f"measurement below noise floor even at k={k} "
+            f"(full {t_full*1e3:.1f} ms <= empty {t_empty*1e3:.1f} ms)")
+    return per_op
 
 
 def bench_single_chip():
@@ -104,26 +117,36 @@ def bench_multi_chip():
     from rlo_tpu.ops import tpu_collectives as tc
     from rlo_tpu.parallel.mesh import make_mesh
 
+    from jax.sharding import NamedSharding
+
+    from rlo_tpu.parallel.mesh import shard_jit
+
     n_dev = len(jax.devices())
     mesh = make_mesh((n_dev,), ("x",))
     # each shard contributes a full 256 MB buffer (the north-star config:
-    # "256MB float32 allreduce" = 256 MB reduced per rank, not split)
+    # "256MB float32 allreduce" = 256 MB reduced per rank, not split);
+    # materialize per-shard on its own device — never the full global
+    # buffer on the host or on chip 0.
     per_shard = (256 << 20) // 4
-    rng = np.random.default_rng(0)
-    x = jnp.asarray(rng.standard_normal((n_dev, per_shard)), jnp.float32)
+    sharding = NamedSharding(mesh, P("x"))
+
+    def _make_shard(idx):
+        rows = idx[0]
+        seed = rows.start if isinstance(rows, slice) else int(rows)
+        rng = np.random.default_rng(seed)
+        return rng.standard_normal((1, per_shard)).astype(np.float32)
+
+    x = jax.make_array_from_callback((n_dev, per_shard), sharding,
+                                     _make_shard)
     nbytes_per_shard = per_shard * 4
 
     def chained(algorithm):
-        def body(v):
+        def inner(v, k):
             def it(i, acc):
                 return tc.allreduce(acc, "x", algorithm=algorithm) \
                     / jnp.float32(n_dev)  # keep magnitude bounded
-            return lambda k: jax.lax.fori_loop(0, k, it, v)
-
-        inner = jax.shard_map(
-            lambda v, k: body(v)(k), mesh=mesh,
-            in_specs=(P("x"), P()), out_specs=P("x"), check_vma=False)
-        return jax.jit(inner, static_argnames=())
+            return jax.lax.fori_loop(0, k, it, v)
+        return shard_jit(inner, mesh, (P("x"), P()), P("x"))
 
     ours_fn = chained("ring")
     base_fn = chained("psum")
