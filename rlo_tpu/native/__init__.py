@@ -1,0 +1,7 @@
+"""Native C core: the reference is a C11 library (SURVEY.md §2 — every
+native component gets a native equivalent). C sources + Makefile live here;
+`rlo_tpu.native.bindings` builds on demand and exposes ctypes wrappers
+(NativeWorld / NativeEngine) mirroring the Python engine API.
+"""
+
+from rlo_tpu.native.build import build, lib_path  # noqa: F401

@@ -1,0 +1,333 @@
+"""ctypes bindings for the native C core.
+
+Python surface mirrors rlo_tpu.engine (ProgressEngine over the loopback
+transport) so tests can run identical scenarios against both
+implementations and compare outcomes. pybind11 is deliberately not used —
+plain ctypes over the C ABI in rlo_core.h.
+"""
+
+from __future__ import annotations
+
+import ctypes as C
+from dataclasses import dataclass
+from typing import Callable, List, Optional
+
+from rlo_tpu.native.build import build
+
+# error codes (rlo_core.h enum rlo_err; -1 is the "nothing yet" sentinel)
+OK = 0
+ERR_ARG = -10
+ERR_TOO_BIG = -11
+ERR_BUSY = -12
+ERR_PROTO = -13
+ERR_NOMEM = -14
+ERR_STALL = -15
+
+# states (enum rlo_state)
+COMPLETED = 0
+IN_PROGRESS = 1
+FAILED = 2
+INVALID = 3
+
+from rlo_tpu.wire import MSG_SIZE_MAX  # single shared engine-wide cap
+
+_JUDGE_CB = C.CFUNCTYPE(C.c_int, C.POINTER(C.c_uint8), C.c_int64,
+                        C.c_void_p)
+_ACTION_CB = C.CFUNCTYPE(None, C.POINTER(C.c_uint8), C.c_int64, C.c_void_p)
+
+_lib = None
+
+
+def load() -> C.CDLL:
+    """Build (if stale) and load the shared library, declaring signatures."""
+    global _lib
+    if _lib is not None:
+        return _lib
+    lib = C.CDLL(str(build()))
+
+    def sig(name, restype, argtypes):
+        fn = getattr(lib, name)
+        fn.restype = restype
+        fn.argtypes = argtypes
+
+    p = C.c_void_p
+    u8p = C.POINTER(C.c_uint8)
+    sig("rlo_is_pow2", C.c_int, [C.c_int])
+    sig("rlo_level", C.c_int, [C.c_int, C.c_int])
+    sig("rlo_last_wall", C.c_int, [C.c_int, C.c_int])
+    sig("rlo_send_list", C.c_int,
+        [C.c_int, C.c_int, C.POINTER(C.c_int), C.c_int,
+         C.POINTER(C.c_int)])
+    sig("rlo_check_passed_origin", C.c_int,
+        [C.c_int, C.c_int, C.c_int, C.c_int])
+    sig("rlo_fwd_targets", C.c_int,
+        [C.c_int, C.c_int, C.c_int, C.c_int, C.POINTER(C.c_int), C.c_int])
+    sig("rlo_fwd_send_cnt", C.c_int, [C.c_int, C.c_int, C.c_int, C.c_int])
+    sig("rlo_initiator_targets", C.c_int,
+        [C.c_int, C.c_int, C.POINTER(C.c_int), C.c_int])
+    sig("rlo_frame_encode", C.c_int64,
+        [u8p, C.c_int64, C.c_int32, C.c_int32, C.c_int32, u8p, C.c_int64])
+    sig("rlo_frame_decode", C.c_int64,
+        [u8p, C.c_int64, C.POINTER(C.c_int32), C.POINTER(C.c_int32),
+         C.POINTER(C.c_int32), C.POINTER(u8p)])
+    sig("rlo_world_new", p, [C.c_int, C.c_int, C.c_uint64])
+    sig("rlo_world_free", None, [p])
+    sig("rlo_world_size", C.c_int, [p])
+    sig("rlo_world_quiescent", C.c_int, [p])
+    sig("rlo_world_sent_cnt", C.c_int64, [p])
+    sig("rlo_world_delivered_cnt", C.c_int64, [p])
+    sig("rlo_engine_new", p,
+        [p, C.c_int, C.c_int, _JUDGE_CB, p, _ACTION_CB, p, C.c_int64])
+    sig("rlo_engine_free", None, [p])
+    sig("rlo_progress_all", None, [p])
+    sig("rlo_bcast", C.c_int, [p, u8p, C.c_int64])
+    sig("rlo_submit_proposal", C.c_int, [p, u8p, C.c_int64, C.c_int])
+    sig("rlo_check_proposal_state", C.c_int, [p])
+    sig("rlo_vote_my_proposal", C.c_int, [p])
+    sig("rlo_proposal_reset", None, [p])
+    sig("rlo_pickup_next", C.c_int64,
+        [p, C.POINTER(C.c_int), C.POINTER(C.c_int), C.POINTER(C.c_int),
+         C.POINTER(C.c_int), u8p, C.c_int64])
+    sig("rlo_engine_idle", C.c_int, [p])
+    sig("rlo_engine_err", C.c_int, [p])
+    sig("rlo_engine_total_pickup", C.c_int64, [p])
+    sig("rlo_engine_sent_bcast", C.c_int64, [p])
+    sig("rlo_engine_recved_bcast", C.c_int64, [p])
+    sig("rlo_drain", C.c_int, [p, C.c_int])
+    sig("rlo_now_usec", C.c_uint64, [])
+    _lib = lib
+    return lib
+
+
+def _buf(data: bytes):
+    return (C.c_uint8 * len(data)).from_buffer_copy(data) if data else None
+
+
+@dataclass
+class NativeUserMsg:
+    """Mirror of rlo_tpu.engine.UserMsg for cross-implementation tests."""
+    type: int
+    origin: int
+    pid: int = -1
+    vote: int = -1
+    data: bytes = b""
+
+
+class NativeWorld:
+    """Owns an rlo_world (in-process loopback transport)."""
+
+    def __init__(self, world_size: int, latency: int = 0, seed: int = 1):
+        self._lib = load()
+        self._w = self._lib.rlo_world_new(world_size, latency, seed)
+        if not self._w:
+            raise ValueError(f"world_size must be >= 2, got {world_size}")
+        self.world_size = world_size
+        self.engines: List["NativeEngine"] = []
+
+    def progress_all(self) -> None:
+        self._lib.rlo_progress_all(self._w)
+
+    def quiescent(self) -> bool:
+        return bool(self._lib.rlo_world_quiescent(self._w))
+
+    @property
+    def sent_cnt(self) -> int:
+        return self._lib.rlo_world_sent_cnt(self._w)
+
+    @property
+    def delivered_cnt(self) -> int:
+        return self._lib.rlo_world_delivered_cnt(self._w)
+
+    def drain(self, max_spins: int = 100_000) -> int:
+        rc = self._lib.rlo_drain(self._w, max_spins)
+        if rc == ERR_STALL:
+            raise RuntimeError("native drain did not reach quiescence")
+        return rc
+
+    def close(self) -> None:
+        for e in list(self.engines):
+            e.close()
+        if self._w:
+            self._lib.rlo_world_free(self._w)
+            self._w = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class NativeEngine:
+    """One rank's progress engine in a NativeWorld."""
+
+    def __init__(self, world: NativeWorld, rank: int, comm: int = 0,
+                 judge_cb: Optional[Callable[[bytes, object], int]] = None,
+                 app_ctx: object = None,
+                 action_cb: Optional[Callable[[bytes, object], None]] = None,
+                 msg_size_max: int = MSG_SIZE_MAX):
+        self._lib = load()
+        self.world = world
+        self.rank = rank
+        self.world_size = world.world_size
+        self.msg_size_max = msg_size_max
+        self.app_ctx = app_ctx
+
+        # keep CFUNCTYPE wrappers alive for the engine's lifetime
+        if judge_cb is not None:
+            self._judge = _JUDGE_CB(
+                lambda buf, n, _ctx: int(
+                    judge_cb(bytes(C.cast(
+                        buf, C.POINTER(C.c_uint8 * n)).contents) if n else
+                        b"", app_ctx)))
+        else:
+            self._judge = C.cast(None, _JUDGE_CB)
+        if action_cb is not None:
+            self._action = _ACTION_CB(
+                lambda buf, n, _ctx: action_cb(
+                    bytes(C.cast(
+                        buf, C.POINTER(C.c_uint8 * n)).contents) if n else
+                    b"", app_ctx))
+        else:
+            self._action = C.cast(None, _ACTION_CB)
+
+        self._e = self._lib.rlo_engine_new(
+            world._w, rank, comm, self._judge, None, self._action, None,
+            msg_size_max)
+        if not self._e:
+            raise RuntimeError(f"engine creation failed (rank {rank})")
+        world.engines.append(self)
+        self._pickup_buf = (C.c_uint8 * msg_size_max)()
+
+    def _check(self, rc: int) -> int:
+        if rc == ERR_BUSY:
+            raise RuntimeError("proposal still in progress")
+        if rc == ERR_TOO_BIG:
+            raise ValueError("payload exceeds msg_size_max")
+        if rc in (ERR_ARG, ERR_PROTO, ERR_NOMEM):
+            raise RuntimeError(f"native error {rc}")
+        return rc
+
+    def bcast(self, payload: bytes) -> None:
+        self._check(self._lib.rlo_bcast(
+            self._e, _buf(payload), len(payload)))
+
+    def submit_proposal(self, proposal: bytes, pid: int) -> int:
+        return self._check(self._lib.rlo_submit_proposal(
+            self._e, _buf(proposal), len(proposal), pid))
+
+    def check_proposal_state(self) -> int:
+        return self._lib.rlo_check_proposal_state(self._e)
+
+    def vote_my_proposal(self) -> int:
+        return self._lib.rlo_vote_my_proposal(self._e)
+
+    def proposal_reset(self) -> None:
+        self._lib.rlo_proposal_reset(self._e)
+
+    def pickup_next(self) -> Optional[NativeUserMsg]:
+        tag = C.c_int()
+        origin = C.c_int()
+        pid = C.c_int()
+        vote = C.c_int()
+        n = self._lib.rlo_pickup_next(
+            self._e, C.byref(tag), C.byref(origin), C.byref(pid),
+            C.byref(vote), self._pickup_buf, self.msg_size_max)
+        if n < 0:
+            if n == -1:
+                return None
+            self._check(int(n))
+        return NativeUserMsg(type=tag.value, origin=origin.value,
+                             pid=pid.value, vote=vote.value,
+                             data=bytes(self._pickup_buf[:n]))
+
+    def idle(self) -> bool:
+        return bool(self._lib.rlo_engine_idle(self._e))
+
+    @property
+    def err(self) -> int:
+        return self._lib.rlo_engine_err(self._e)
+
+    @property
+    def total_pickup(self) -> int:
+        return self._lib.rlo_engine_total_pickup(self._e)
+
+    @property
+    def sent_bcast_cnt(self) -> int:
+        return self._lib.rlo_engine_sent_bcast(self._e)
+
+    @property
+    def recved_bcast_cnt(self) -> int:
+        return self._lib.rlo_engine_recved_bcast(self._e)
+
+    def close(self) -> None:
+        if self._e:
+            self._lib.rlo_engine_free(self._e)
+            self._e = None
+        if self in self.world.engines:
+            self.world.engines.remove(self)
+
+
+# -- pure-function wrappers for parity tests --------------------------------
+
+def level(ws: int, rank: int) -> int:
+    return load().rlo_level(ws, rank)
+
+
+def last_wall(ws: int, rank: int) -> int:
+    return load().rlo_last_wall(ws, rank)
+
+
+def send_list(ws: int, rank: int):
+    out = (C.c_int * 64)()
+    chan = C.c_int()
+    n = load().rlo_send_list(ws, rank, out, 64, C.byref(chan))
+    assert n >= 0
+    return tuple(out[:n]), chan.value
+
+
+def check_passed_origin(ws: int, my_rank: int, origin: int,
+                        to_rank: int) -> bool:
+    return bool(load().rlo_check_passed_origin(ws, my_rank, origin,
+                                               to_rank))
+
+
+def fwd_targets(ws: int, rank: int, origin: int, from_rank: int):
+    out = (C.c_int * 64)()
+    n = load().rlo_fwd_targets(ws, rank, origin, from_rank, out, 64)
+    assert n >= 0
+    return tuple(out[:n])
+
+
+def fwd_send_cnt(ws: int, rank: int, origin: int, from_rank: int) -> int:
+    return load().rlo_fwd_send_cnt(ws, rank, origin, from_rank)
+
+
+def initiator_targets(ws: int, rank: int):
+    out = (C.c_int * 64)()
+    n = load().rlo_initiator_targets(ws, rank, out, 64)
+    assert n >= 0
+    return tuple(out[:n])
+
+
+def frame_roundtrip(origin: int, pid: int, vote: int, payload: bytes):
+    """Encode then decode one frame through the C wire format."""
+    lib = load()
+    cap = 20 + len(payload)
+    raw = (C.c_uint8 * cap)()
+    n = lib.rlo_frame_encode(raw, cap, origin, pid, vote, _buf(payload),
+                             len(payload))
+    assert n == cap, n
+    o = C.c_int32()
+    p = C.c_int32()
+    v = C.c_int32()
+    pp = C.POINTER(C.c_uint8)()
+    m = lib.rlo_frame_decode(raw, n, C.byref(o), C.byref(p), C.byref(v),
+                             C.byref(pp))
+    assert m >= 0, m
+    data = bytes(C.cast(pp, C.POINTER(C.c_uint8 * m)).contents) if m else b""
+    return o.value, p.value, v.value, data, bytes(raw)
+
+
+def now_usec() -> int:
+    return load().rlo_now_usec()

@@ -1,0 +1,45 @@
+"""On-demand build of the native core.
+
+Compiles librlo_core.so from the C sources next to this file the first time
+the bindings are imported (and whenever a source is newer than the built
+library), so a fresh checkout needs no manual make step. Uses the plain C
+toolchain only — no MPI, no pybind11 (bindings are ctypes).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+from pathlib import Path
+
+_DIR = Path(__file__).resolve().parent
+SOURCES = ["rlo_topology.c", "rlo_wire.c", "rlo_loopback.c", "rlo_engine.c"]
+HEADERS = ["rlo_core.h", "rlo_internal.h"]
+LIB_NAME = "librlo_core.so"
+
+
+def lib_path() -> Path:
+    return _DIR / LIB_NAME
+
+
+def _stale(lib: Path) -> bool:
+    if not lib.exists():
+        return True
+    lib_mtime = lib.stat().st_mtime
+    return any((_DIR / f).stat().st_mtime > lib_mtime
+               for f in SOURCES + HEADERS)
+
+
+def build(force: bool = False) -> Path:
+    """Build (if needed) and return the shared-library path."""
+    lib = lib_path()
+    if not force and not _stale(lib):
+        return lib
+    cc = os.environ.get("CC", "cc")
+    cmd = [cc, "-O2", "-g", "-std=c11", "-Wall", "-Wextra", "-fPIC",
+           "-shared", "-o", str(lib)] + [str(_DIR / s) for s in SOURCES]
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"native core build failed ({' '.join(cmd)}):\n{proc.stderr}")
+    return lib

@@ -1,0 +1,188 @@
+/* rlo_core — native C core of the rlo_tpu framework.
+ *
+ * The reference (/root/reference/, "Rootless Operations for MPI") is a C11
+ * library; this is its native-parity counterpart in the rebuild: skip-ring
+ * overlay topology (reference rootless_ops.c:1412-1579), variable-size wire
+ * frames (pbuf_serialize, rootless_ops.c:1369-1410 — minus the fixed 32 KB
+ * frame flaw), intrusive message queues (rootless_ops.c:54-58, 345-404),
+ * a cooperatively-polled progress engine (make_progress_gen,
+ * rootless_ops.c:551-658), rootless broadcast (RLO_bcast_gen :1581,
+ * _bc_forward :1104) and IAR leaderless consensus (:668-932), all over an
+ * in-process loopback transport world (net-new: the reference can only run
+ * under mpirun).
+ *
+ * Semantics are kept in lockstep with the Python engine
+ * (rlo_tpu/engine.py) so the two implementations cross-check each other in
+ * tests. Deliberate departures from the reference mirror the Python side:
+ * nonblocking votes, variable-size frames, explicit state enums, and hard
+ * errors instead of printf-warnings on protocol violations.
+ *
+ * Everything is single-threaded and cooperatively polled — there is no
+ * background thread, matching the reference's documented model
+ * (rootless_ops.h:216).
+ */
+#ifndef RLO_CORE_H
+#define RLO_CORE_H
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* ---- message tags (reference RLO_COMM_TAGS, rootless_ops.h:50-61) ---- */
+enum rlo_tag {
+    RLO_TAG_BCAST = 0,
+    RLO_TAG_JOB_DONE = 1,
+    RLO_TAG_IAR_PROPOSAL = 2,
+    RLO_TAG_IAR_VOTE = 3,
+    RLO_TAG_IAR_DECISION = 4,
+    RLO_TAG_BC_TEARDOWN = 5,
+    RLO_TAG_IAR_TEARDOWN = 6,
+    RLO_TAG_P2P = 7,
+    RLO_TAG_SYS = 8,
+    RLO_TAG_DATA = 9,
+    RLO_TAG_BARRIER = 10,
+};
+
+/* ---- request/proposal states (reference RLO_Req_stat) ---- */
+enum rlo_state {
+    RLO_COMPLETED = 0,
+    RLO_IN_PROGRESS = 1,
+    RLO_FAILED = 2,
+    RLO_INVALID = 3,
+};
+
+/* ---- error codes (negative returns) ----
+ * Numbering starts at -10 so errors never collide with the -1 "nothing
+ * yet / still pending" sentinel used by pickup and submit_proposal. */
+enum rlo_err {
+    RLO_OK = 0,
+    RLO_ERR_ARG = -10,      /* bad argument */
+    RLO_ERR_TOO_BIG = -11,  /* payload exceeds msg_size_max */
+    RLO_ERR_BUSY = -12,     /* own proposal still in progress */
+    RLO_ERR_PROTO = -13,    /* protocol violation (dup pid, unknown vote) */
+    RLO_ERR_NOMEM = -14,
+    RLO_ERR_STALL = -15,    /* drain did not reach quiescence */
+};
+
+/* default per-message payload cap (reference RLO_MSG_SIZE_MAX,
+ * rootless_ops.h:49); frames themselves are variable-size */
+#define RLO_MSG_SIZE_MAX 32768
+
+/* ------------------------------------------------------------------ */
+/* Topology: pure skip-ring math (reference rootless_ops.c:1412-1579). */
+/* ------------------------------------------------------------------ */
+int rlo_is_pow2(int n);
+int rlo_level(int world_size, int rank);
+int rlo_last_wall(int world_size, int rank);
+/* Fills out[] with the raw send list, returns its length; *channel_cnt
+ * (optional) receives the forwarding-channel count. cap must be >= 32. */
+int rlo_send_list(int world_size, int rank, int *out, int cap,
+                  int *channel_cnt);
+int rlo_check_passed_origin(int world_size, int my_rank, int origin,
+                            int to_rank);
+/* Forward targets for a broadcast arriving at `rank` from `from_rank`
+ * (furthest-first). Returns count. */
+int rlo_fwd_targets(int world_size, int rank, int origin, int from_rank,
+                    int *out, int cap);
+int rlo_fwd_send_cnt(int world_size, int rank, int origin, int from_rank);
+/* Targets the broadcast origin itself sends to (furthest-first). */
+int rlo_initiator_targets(int world_size, int rank, int *out, int cap);
+
+/* ------------------------------------------------------------------ */
+/* Wire format: little-endian [origin:i32][pid:i32][vote:i32][len:u64]  */
+/* header + payload (reference pbuf layout, rootless_ops.c:64-73).      */
+/* ------------------------------------------------------------------ */
+#define RLO_HEADER_SIZE 20
+/* Encodes into dst (cap >= RLO_HEADER_SIZE + len); returns frame size. */
+int64_t rlo_frame_encode(uint8_t *dst, int64_t cap, int32_t origin,
+                         int32_t pid, int32_t vote, const uint8_t *payload,
+                         int64_t len);
+/* Decodes header; returns payload length or RLO_ERR_ARG on truncation.
+ * *payload points into raw. */
+int64_t rlo_frame_decode(const uint8_t *raw, int64_t rawlen, int32_t *origin,
+                         int32_t *pid, int32_t *vote,
+                         const uint8_t **payload);
+
+/* ------------------------------------------------------------------ */
+/* Loopback transport world: N in-process ranks, per-(src,dst,comm)     */
+/* FIFO channels, optional seeded delivery latency in poll ticks.       */
+/* ------------------------------------------------------------------ */
+typedef struct rlo_world rlo_world;
+typedef struct rlo_engine rlo_engine;
+
+rlo_world *rlo_world_new(int world_size, int latency, uint64_t seed);
+void rlo_world_free(rlo_world *w);
+int rlo_world_size(const rlo_world *w);
+/* 1 when no frames are in flight or waiting in any inbox */
+int rlo_world_quiescent(const rlo_world *w);
+int64_t rlo_world_sent_cnt(const rlo_world *w);
+int64_t rlo_world_delivered_cnt(const rlo_world *w);
+
+/* ------------------------------------------------------------------ */
+/* Progress engine (reference struct progress_engine + EngineManager).  */
+/* ------------------------------------------------------------------ */
+/* judgement callback: 1 approve / 0 decline (reference iar_cb_func_t,
+ * rootless_ops.h:77) */
+typedef int (*rlo_judge_cb)(const uint8_t *payload, int64_t len, void *ctx);
+/* action callback: executed on every rank when a proposal is approved */
+typedef void (*rlo_action_cb)(const uint8_t *payload, int64_t len,
+                              void *ctx);
+
+/* Engines on the same `comm` id across ranks form one communicator;
+ * different comm ids on one world are fully isolated (the analogue of the
+ * reference's dup'ed MPI comm per engine, rootless_ops.c:1461). */
+rlo_engine *rlo_engine_new(rlo_world *w, int rank, int comm,
+                           rlo_judge_cb judge, void *judge_ctx,
+                           rlo_action_cb action, void *action_ctx,
+                           int64_t msg_size_max);
+void rlo_engine_free(rlo_engine *e);
+
+/* Step every engine in the world once (reference RLO_make_progress_all,
+ * rootless_ops.c:538-549); re-entrant calls are no-ops. */
+void rlo_progress_all(rlo_world *w);
+
+/* Rootless broadcast from this rank (reference RLO_bcast_gen :1581). */
+int rlo_bcast(rlo_engine *e, const uint8_t *payload, int64_t len);
+
+/* IAR leaderless consensus (reference RLO_submit_proposal :876).
+ * Returns the decision (0/1) if it completed within this call, else -1
+ * (poll with rlo_check_proposal_state / rlo_vote_my_proposal), or a
+ * negative rlo_err. pids must be unique across concurrent proposers. */
+int rlo_submit_proposal(rlo_engine *e, const uint8_t *proposal, int64_t len,
+                        int pid);
+int rlo_check_proposal_state(rlo_engine *e);     /* enum rlo_state */
+int rlo_vote_my_proposal(rlo_engine *e);         /* -1 / 0 / 1 */
+void rlo_proposal_reset(rlo_engine *e);
+
+/* Delivery (reference RLO_user_pickup_next/RLO_user_msg_recycle
+ * :938-992). Copies the payload into buf (cap bytes) and returns its
+ * length, filling tag/origin/pid/vote; returns -1 when nothing is
+ * deliverable, RLO_ERR_TOO_BIG if cap is too small (message stays
+ * queued). */
+int64_t rlo_pickup_next(rlo_engine *e, int *tag, int *origin, int *pid,
+                        int *vote, uint8_t *buf, int64_t cap);
+
+/* 1 when this engine has no outstanding forwards or pending decision */
+int rlo_engine_idle(const rlo_engine *e);
+int rlo_engine_err(const rlo_engine *e);         /* sticky first error */
+int64_t rlo_engine_total_pickup(const rlo_engine *e);
+int64_t rlo_engine_sent_bcast(const rlo_engine *e);
+int64_t rlo_engine_recved_bcast(const rlo_engine *e);
+
+/* Termination-detection drain (reference cleanup drain,
+ * rootless_ops.c:1613-1625): progress until the world is quiescent and
+ * every engine idle. Returns spins used, or RLO_ERR_STALL. */
+int rlo_drain(rlo_world *w, int max_spins);
+
+/* ------------------------------------------------------------------ */
+/* Timing utils (reference RLO_get_time_usec, rootless_ops.c:128-132).  */
+/* ------------------------------------------------------------------ */
+uint64_t rlo_now_usec(void);
+
+#ifdef __cplusplus
+}
+#endif
+#endif /* RLO_CORE_H */

@@ -1,0 +1,692 @@
+/* Progress engine: cooperatively-polled state machine driving the rootless
+ * broadcast and IAR leaderless-consensus ops.
+ *
+ * Native counterpart of rlo_tpu/engine.py; both mirror the reference
+ * (struct progress_engine rootless_ops.c:202-253, make_progress_gen :551,
+ * RLO_bcast_gen :1581, _bc_forward :1104, IAR handlers :668-932, pickup
+ * :938-992) with the deliberate departures listed in rlo_core.h.
+ */
+#include "rlo_internal.h"
+
+/* ---------------- intrusive message queue (reference queue_append/
+ * queue_remove, rootless_ops.c:345-404) ---------------- */
+
+typedef struct rlo_msg rlo_msg;
+
+typedef struct rlo_queue {
+    rlo_msg *head, *tail;
+    int len;
+} rlo_queue;
+
+/* ---------------- per-proposal consensus bookkeeping (reference
+ * Proposal_state, rootless_ops.c:184-194) ---------------- */
+
+typedef struct rlo_prop {
+    int pid;
+    int recv_from; /* parent in the vote tree */
+    int vote;
+    int votes_needed, votes_recved;
+    int state; /* enum rlo_state */
+    uint8_t *payload;
+    int64_t len;
+    rlo_handle **decision_handles;
+    int n_decision;
+    int decision_pending;
+} rlo_prop;
+
+/* ---------------- in-flight message (reference RLO_msg_t,
+ * rootless_ops.h:93-146) ---------------- */
+
+struct rlo_msg {
+    rlo_msg *prev, *next;
+    int tag, src; /* src = immediate sender (~MPI_SOURCE) */
+    int32_t origin, pid, vote;
+    uint8_t *payload;
+    int64_t len;
+    rlo_handle **handles;
+    int n_handles, cap_handles;
+    int pickup_done, fwd_done;
+    rlo_prop *ps; /* for relayed IAR proposals */
+};
+
+struct rlo_engine {
+    rlo_world *w;
+    int rank, ws, comm;
+    int64_t msg_size_max;
+    rlo_judge_cb judge;
+    void *judge_ctx;
+    rlo_action_cb action;
+    void *action_ctx;
+    int my_level;
+    int init_targets[64];
+    int n_init;
+    rlo_queue q_wait, q_wait_pickup, q_pickup, q_iar_pending;
+    int64_t sent_bcast, recved_bcast, total_pickup;
+    rlo_prop own; /* my_own_proposal; own.payload = my proposal bytes */
+    int err; /* sticky first protocol error */
+};
+
+/* ---------------- queue ops ---------------- */
+
+static void q_append(rlo_queue *q, rlo_msg *m)
+{
+    m->next = 0;
+    m->prev = q->tail;
+    if (q->tail)
+        q->tail->next = m;
+    else
+        q->head = m;
+    q->tail = m;
+    q->len++;
+}
+
+static void q_remove(rlo_queue *q, rlo_msg *m)
+{
+    if (m->prev)
+        m->prev->next = m->next;
+    else
+        q->head = m->next;
+    if (m->next)
+        m->next->prev = m->prev;
+    else
+        q->tail = m->prev;
+    m->prev = m->next = 0;
+    q->len--;
+}
+
+/* ---------------- msg lifecycle ---------------- */
+
+static rlo_msg *msg_new(int tag, int src, int32_t origin, int32_t pid,
+                        int32_t vote, const uint8_t *payload, int64_t len)
+{
+    rlo_msg *m = (rlo_msg *)calloc(1, sizeof(*m));
+    if (!m)
+        return 0;
+    m->tag = tag;
+    m->src = src;
+    m->origin = origin;
+    m->pid = pid;
+    m->vote = vote;
+    m->len = len;
+    if (len > 0) {
+        m->payload = (uint8_t *)malloc((size_t)len);
+        if (!m->payload) {
+            free(m);
+            return 0;
+        }
+        memcpy(m->payload, payload, (size_t)len);
+    }
+    return m;
+}
+
+static void prop_free(rlo_prop *p)
+{
+    if (!p)
+        return;
+    for (int i = 0; i < p->n_decision; i++)
+        rlo_handle_unref(p->decision_handles[i]);
+    free(p->decision_handles);
+    free(p->payload);
+    free(p);
+}
+
+static void msg_free(rlo_msg *m)
+{
+    if (!m)
+        return;
+    for (int i = 0; i < m->n_handles; i++)
+        rlo_handle_unref(m->handles[i]);
+    free(m->handles);
+    free(m->payload);
+    prop_free(m->ps);
+    free(m);
+}
+
+static int msg_track(rlo_msg *m, rlo_handle *h)
+{
+    if (m->n_handles == m->cap_handles) {
+        int cap = m->cap_handles ? m->cap_handles * 2 : 4;
+        rlo_handle **p = (rlo_handle **)realloc(
+            m->handles, (size_t)cap * sizeof(void *));
+        if (!p)
+            return RLO_ERR_NOMEM;
+        m->handles = p;
+        m->cap_handles = cap;
+    }
+    m->handles[m->n_handles++] = h;
+    return RLO_OK;
+}
+
+static int msg_sends_done(const rlo_msg *m)
+{
+    for (int i = 0; i < m->n_handles; i++)
+        if (!m->handles[i]->delivered)
+            return 0;
+    return 1;
+}
+
+/* ---------------- send helper ---------------- */
+
+/* Encode and isend one frame; when track_in != NULL the completion handle
+ * is retained on that message (votes pass NULL — fire and forget, but
+ * still reliable: the loopback world owns the in-flight node). */
+static int eng_isend(rlo_engine *e, int dst, int tag, int32_t origin,
+                     int32_t pid, int32_t vote, const uint8_t *payload,
+                     int64_t len, rlo_msg *track_in)
+{
+    int64_t cap = RLO_HEADER_SIZE + len;
+    uint8_t stack_buf[256];
+    uint8_t *raw = cap <= (int64_t)sizeof(stack_buf)
+                       ? stack_buf
+                       : (uint8_t *)malloc((size_t)cap);
+    if (!raw)
+        return RLO_ERR_NOMEM;
+    int64_t n = rlo_frame_encode(raw, cap, origin, pid, vote, payload, len);
+    int rc = (int)n;
+    if (n > 0) {
+        rlo_handle *h = 0;
+        rc = rlo_world_isend(e->w, e->rank, dst, e->comm, tag, raw, n,
+                             track_in ? &h : 0);
+        if (rc == RLO_OK && track_in)
+            rc = msg_track(track_in, h);
+    }
+    if (raw != stack_buf)
+        free(raw);
+    return rc;
+}
+
+/* ---------------- engine create/free ---------------- */
+
+rlo_engine *rlo_engine_new(rlo_world *w, int rank, int comm,
+                           rlo_judge_cb judge, void *judge_ctx,
+                           rlo_action_cb action, void *action_ctx,
+                           int64_t msg_size_max)
+{
+    if (!w || rank < 0 || rank >= rlo_world_size(w))
+        return 0;
+    rlo_engine *e = (rlo_engine *)calloc(1, sizeof(*e));
+    if (!e)
+        return 0;
+    e->w = w;
+    e->rank = rank;
+    e->ws = rlo_world_size(w);
+    e->comm = comm;
+    e->judge = judge;
+    e->judge_ctx = judge_ctx;
+    e->action = action;
+    e->action_ctx = action_ctx;
+    e->msg_size_max = msg_size_max > 0 ? msg_size_max : RLO_MSG_SIZE_MAX;
+    e->my_level = rlo_level(e->ws, rank);
+    e->n_init = rlo_initiator_targets(e->ws, rank, e->init_targets, 64);
+    e->own.state = RLO_INVALID;
+    e->own.pid = -1;
+    if (e->n_init < 0 || rlo_world_register(w, e) != RLO_OK) {
+        free(e);
+        return 0;
+    }
+    return e;
+}
+
+static void q_free_all(rlo_queue *q)
+{
+    for (rlo_msg *m = q->head; m;) {
+        rlo_msg *nm = m->next;
+        msg_free(m);
+        m = nm;
+    }
+    q->head = q->tail = 0;
+    q->len = 0;
+}
+
+void rlo_engine_free(rlo_engine *e)
+{
+    if (!e)
+        return;
+    rlo_world_unregister(e->w, e);
+    q_free_all(&e->q_wait);
+    q_free_all(&e->q_wait_pickup);
+    q_free_all(&e->q_pickup);
+    q_free_all(&e->q_iar_pending);
+    for (int i = 0; i < e->own.n_decision; i++)
+        rlo_handle_unref(e->own.decision_handles[i]);
+    free(e->own.decision_handles);
+    free(e->own.payload);
+    free(e);
+}
+
+/* ---------------- rootless broadcast ---------------- */
+
+/* Initiate without progressing (handlers use this; the public entry
+ * progresses after). Returns the tracking msg via *out. */
+static int bcast_init(rlo_engine *e, int tag, int32_t pid, int32_t vote,
+                      const uint8_t *payload, int64_t len, rlo_msg **out)
+{
+    if (len < 0 || len > e->msg_size_max)
+        return RLO_ERR_TOO_BIG;
+    rlo_msg *m = msg_new(tag, -1, e->rank, pid, vote, payload, len);
+    if (!m)
+        return RLO_ERR_NOMEM;
+    for (int i = 0; i < e->n_init; i++) { /* furthest-first */
+        int rc = eng_isend(e, e->init_targets[i], tag, e->rank, pid, vote,
+                           payload, len, m);
+        if (rc != RLO_OK) {
+            msg_free(m);
+            return rc;
+        }
+    }
+    q_append(&e->q_wait, m);
+    e->sent_bcast++;
+    if (out)
+        *out = m;
+    return RLO_OK;
+}
+
+int rlo_bcast(rlo_engine *e, const uint8_t *payload, int64_t len)
+{
+    int rc = bcast_init(e, RLO_TAG_BCAST, -1, -1, payload, len, 0);
+    if (rc == RLO_OK)
+        rlo_progress_all(e->w);
+    return rc;
+}
+
+/* Forward a received broadcast along the overlay (reference _bc_forward,
+ * rootless_ops.c:1104-1225). Returns the number of forwards or <0. */
+static int bc_forward(rlo_engine *e, rlo_msg *m)
+{
+    int targets[64];
+    int n = rlo_fwd_targets(e->ws, e->rank, m->origin, m->src, targets, 64);
+    if (n < 0)
+        return n;
+    for (int i = 0; i < n; i++) {
+        int rc = eng_isend(e, targets[i], m->tag, m->origin, m->pid,
+                           m->vote, m->payload, m->len, m);
+        if (rc != RLO_OK)
+            return rc;
+    }
+    if (m->tag == RLO_TAG_IAR_PROPOSAL) {
+        /* proposals are engine-internal: parked for the decision, never
+         * user-visible (make_progress_gen :591-596) */
+        q_append(&e->q_iar_pending, m);
+    } else if (m->tag == RLO_TAG_IAR_DECISION) {
+        /* delivery handled by on_decision */
+    } else if (n > 0) {
+        q_append(&e->q_wait_pickup, m);
+    } else {
+        m->fwd_done = 1;
+        q_append(&e->q_pickup, m);
+    }
+    return n;
+}
+
+/* ---------------- IAR consensus ---------------- */
+
+static int eng_judge(rlo_engine *e, const uint8_t *payload, int64_t len)
+{
+    if (!e->judge)
+        return 1;
+    return e->judge(payload, len, e->judge_ctx) ? 1 : 0;
+}
+
+/* Send my (merged) vote to the rank the proposal came from (reference
+ * _vote_back :728-741; nonblocking here). */
+static int vote_back(rlo_engine *e, const rlo_prop *ps, int vote)
+{
+    return eng_isend(e, ps->recv_from, RLO_TAG_IAR_VOTE, e->rank, ps->pid,
+                     vote, 0, 0, 0);
+}
+
+static rlo_msg *find_proposal_msg(rlo_engine *e, int pid)
+{
+    for (rlo_msg *m = e->q_iar_pending.head; m; m = m->next)
+        if (m->ps && m->ps->pid == pid)
+            return m;
+    return 0;
+}
+
+static void set_err(rlo_engine *e, int err)
+{
+    if (e->err == RLO_OK)
+        e->err = err;
+}
+
+static void on_proposal(rlo_engine *e, rlo_msg *m)
+{
+    if (e->own.state == RLO_IN_PROGRESS && m->pid == e->own.pid) {
+        /* pid collision with my active proposal — the reference only
+         * printf-warns (:690-692) and corrupts vote accounting; fail
+         * loudly instead (matches the Python engine) */
+        set_err(e, RLO_ERR_PROTO);
+        msg_free(m);
+        return;
+    }
+    rlo_prop *ps = (rlo_prop *)calloc(1, sizeof(*ps));
+    if (!ps) {
+        set_err(e, RLO_ERR_NOMEM);
+        msg_free(m);
+        return;
+    }
+    ps->pid = m->pid;
+    ps->recv_from = m->src;
+    ps->vote = 1;
+    ps->state = RLO_IN_PROGRESS;
+    ps->votes_needed =
+        rlo_fwd_send_cnt(e->ws, e->rank, m->origin, m->src);
+    m->ps = ps;
+    if (!eng_judge(e, m->payload, m->len)) {
+        /* decline: NO to parent immediately, don't forward — the subtree
+         * below only ever sees the decision */
+        vote_back(e, ps, 0);
+        msg_free(m); /* frees ps too */
+        return;
+    }
+    int sent = bc_forward(e, m); /* parks m in q_iar_pending */
+    if (sent < 0) {
+        /* bc_forward only fails before queueing — reclaim the msg */
+        set_err(e, sent);
+        msg_free(m);
+    } else if (sent == 0) {
+        vote_back(e, ps, 1); /* leaf: nothing to wait for */
+    }
+}
+
+static void decision_bcast(rlo_engine *e)
+{
+    rlo_prop *p = &e->own;
+    rlo_msg *m = 0;
+    int rc = bcast_init(e, RLO_TAG_IAR_DECISION, p->pid, p->vote, 0, 0, &m);
+    if (rc != RLO_OK) {
+        set_err(e, rc);
+        return;
+    }
+    /* retain the decision sends: the proposal completes only once the
+     * decision has fanned out (reference :554-566) */
+    p->decision_handles = (rlo_handle **)malloc(
+        (size_t)(m->n_handles ? m->n_handles : 1) * sizeof(void *));
+    if (!p->decision_handles) {
+        set_err(e, RLO_ERR_NOMEM);
+        return;
+    }
+    p->n_decision = m->n_handles;
+    for (int i = 0; i < m->n_handles; i++) {
+        p->decision_handles[i] = m->handles[i];
+        m->handles[i]->refs++;
+    }
+    p->decision_pending = 1;
+}
+
+static void on_vote(rlo_engine *e, rlo_msg *m)
+{
+    int pid = m->pid, vote = m->vote;
+    rlo_prop *p = &e->own;
+    if (pid == p->pid && p->state == RLO_IN_PROGRESS) {
+        p->votes_recved++;
+        p->vote &= vote;
+        if (p->votes_recved == p->votes_needed) {
+            if (p->vote)
+                /* re-judge: a competing proposal may have changed app
+                 * state since submission (reference :773) */
+                p->vote = eng_judge(e, p->payload, p->len);
+            decision_bcast(e);
+        }
+        msg_free(m);
+        return;
+    }
+    rlo_msg *pm = find_proposal_msg(e, pid);
+    if (!pm) {
+        set_err(e, RLO_ERR_PROTO);
+        msg_free(m);
+        return;
+    }
+    pm->ps->vote &= vote;
+    pm->ps->votes_recved++;
+    if (pm->ps->votes_recved == pm->ps->votes_needed)
+        vote_back(e, pm->ps, pm->ps->vote);
+    msg_free(m);
+}
+
+static void on_decision(rlo_engine *e, rlo_msg *m)
+{
+    rlo_msg *pm = find_proposal_msg(e, m->pid);
+    int rc = bc_forward(e, m); /* forward first; delivery below */
+    if (rc < 0)
+        set_err(e, rc);
+    if (pm) {
+        if (m->vote && e->action)
+            e->action(pm->payload, pm->len, e->action_ctx);
+        q_remove(&e->q_iar_pending, pm);
+        msg_free(pm);
+    }
+    /* deliver the decision to the user either way (reference :852-854) */
+    q_append(&e->q_pickup, m);
+}
+
+int rlo_submit_proposal(rlo_engine *e, const uint8_t *proposal, int64_t len,
+                        int pid)
+{
+    rlo_prop *p = &e->own;
+    if (p->state == RLO_IN_PROGRESS)
+        return RLO_ERR_BUSY;
+    if (len < 0 || len > e->msg_size_max)
+        return RLO_ERR_TOO_BIG;
+    free(p->payload);
+    for (int i = 0; i < p->n_decision; i++)
+        rlo_handle_unref(p->decision_handles[i]);
+    free(p->decision_handles);
+    memset(p, 0, sizeof(*p));
+    p->pid = pid;
+    p->vote = 1;
+    p->votes_needed = e->n_init;
+    p->state = RLO_IN_PROGRESS;
+    p->len = len;
+    if (len > 0) {
+        p->payload = (uint8_t *)malloc((size_t)len);
+        if (!p->payload)
+            return RLO_ERR_NOMEM;
+        memcpy(p->payload, proposal, (size_t)len);
+    }
+    int rc = bcast_init(e, RLO_TAG_IAR_PROPOSAL, pid, 1, proposal, len, 0);
+    if (rc != RLO_OK) {
+        p->state = RLO_FAILED;
+        return rc;
+    }
+    rlo_progress_all(e->w);
+    if (p->state == RLO_COMPLETED)
+        return p->vote;
+    return -1;
+}
+
+int rlo_check_proposal_state(rlo_engine *e)
+{
+    rlo_progress_all(e->w);
+    return e->own.state;
+}
+
+int rlo_vote_my_proposal(rlo_engine *e)
+{
+    rlo_progress_all(e->w);
+    if (e->own.state != RLO_COMPLETED)
+        return -1;
+    return e->own.vote;
+}
+
+void rlo_proposal_reset(rlo_engine *e)
+{
+    rlo_prop *p = &e->own;
+    free(p->payload);
+    for (int i = 0; i < p->n_decision; i++)
+        rlo_handle_unref(p->decision_handles[i]);
+    free(p->decision_handles);
+    memset(p, 0, sizeof(*p));
+    p->pid = -1;
+    p->vote = 1;
+    p->state = RLO_INVALID;
+}
+
+/* ---------------- delivery ---------------- */
+
+static int64_t copy_out(rlo_msg *m, int *tag, int *origin, int *pid,
+                        int *vote, uint8_t *buf, int64_t cap)
+{
+    if (m->len > cap)
+        return RLO_ERR_TOO_BIG;
+    if (tag)
+        *tag = m->tag;
+    if (origin)
+        *origin = m->origin;
+    if (pid)
+        *pid = m->pid;
+    if (vote)
+        *vote = m->vote;
+    if (m->len > 0)
+        memcpy(buf, m->payload, (size_t)m->len);
+    return m->len;
+}
+
+int64_t rlo_pickup_next(rlo_engine *e, int *tag, int *origin, int *pid,
+                        int *vote, uint8_t *buf, int64_t cap)
+{
+    /* still-forwarding messages are eligible first (reference order,
+     * RLO_user_pickup_next :938-979) */
+    rlo_msg *m = e->q_wait_pickup.head;
+    if (m) {
+        int64_t n = copy_out(m, tag, origin, pid, vote, buf, cap);
+        if (n < 0)
+            return n;
+        q_remove(&e->q_wait_pickup, m);
+        m->pickup_done = 1;
+        q_append(&e->q_wait, m); /* keep tracking its forwards */
+        e->total_pickup++;
+        return n;
+    }
+    m = e->q_pickup.head;
+    if (m) {
+        int64_t n = copy_out(m, tag, origin, pid, vote, buf, cap);
+        if (n < 0)
+            return n;
+        q_remove(&e->q_pickup, m);
+        e->total_pickup++;
+        msg_free(m);
+        return n;
+    }
+    return -1;
+}
+
+/* ---------------- the gear (reference make_progress_gen :551-641) ------ */
+
+void rlo_engine_progress_once(rlo_engine *e)
+{
+    /* (a) my own decision fan-out completion -> proposal COMPLETED */
+    rlo_prop *p = &e->own;
+    if (p->state == RLO_IN_PROGRESS && p->decision_pending) {
+        int done = 1;
+        for (int i = 0; i < p->n_decision; i++)
+            if (!p->decision_handles[i]->delivered)
+                done = 0;
+        if (done) {
+            p->state = RLO_COMPLETED;
+            p->decision_pending = 0;
+        }
+    }
+
+    /* (b) drain the transport, dispatch on tag (:569-624) */
+    for (;;) {
+        rlo_wire_node *n = rlo_world_poll(e->w, e->rank, e->comm);
+        if (!n)
+            break;
+        int32_t origin, pid, vote;
+        const uint8_t *payload;
+        int64_t plen = rlo_frame_decode(n->data, n->len, &origin, &pid,
+                                        &vote, &payload);
+        if (plen < 0) {
+            set_err(e, RLO_ERR_PROTO);
+            rlo_handle_unref(n->handle);
+            free(n);
+            continue;
+        }
+        rlo_msg *m =
+            msg_new(n->tag, n->src, origin, pid, vote, payload, plen);
+        rlo_handle_unref(n->handle);
+        free(n);
+        if (!m) {
+            set_err(e, RLO_ERR_NOMEM);
+            continue;
+        }
+        switch (m->tag) {
+        case RLO_TAG_BCAST: {
+            e->recved_bcast++;
+            int rc = bc_forward(e, m);
+            if (rc < 0) {
+                /* bc_forward only fails before queueing — reclaim */
+                set_err(e, rc);
+                msg_free(m);
+            }
+            break;
+        }
+        case RLO_TAG_IAR_PROPOSAL:
+            on_proposal(e, m);
+            break;
+        case RLO_TAG_IAR_VOTE:
+            on_vote(e, m);
+            break;
+        case RLO_TAG_IAR_DECISION:
+            e->recved_bcast++;
+            on_decision(e, m);
+            break;
+        default:
+            /* aux tags go straight to pickup */
+            m->fwd_done = 1;
+            q_append(&e->q_pickup, m);
+            break;
+        }
+    }
+
+    /* (c) wait_and_pickup sweep (:995-1013): forwards done -> deliverable */
+    for (rlo_msg *m = e->q_wait_pickup.head; m;) {
+        rlo_msg *nm = m->next;
+        if (msg_sends_done(m)) {
+            m->fwd_done = 1;
+            q_remove(&e->q_wait_pickup, m);
+            q_append(&e->q_pickup, m);
+        }
+        m = nm;
+    }
+
+    /* (d) wait-only sweep (:1015-1034): completed sends are released */
+    for (rlo_msg *m = e->q_wait.head; m;) {
+        rlo_msg *nm = m->next;
+        if (msg_sends_done(m)) {
+            m->fwd_done = 1;
+            q_remove(&e->q_wait, m);
+            msg_free(m);
+        }
+        m = nm;
+    }
+}
+
+/* ---------------- introspection ---------------- */
+
+int rlo_engine_idle(const rlo_engine *e)
+{
+    return e->q_wait.len == 0 && e->q_wait_pickup.len == 0 &&
+           !e->own.decision_pending;
+}
+
+int rlo_engine_err(const rlo_engine *e)
+{
+    return e->err;
+}
+
+int64_t rlo_engine_total_pickup(const rlo_engine *e)
+{
+    return e->total_pickup;
+}
+
+int64_t rlo_engine_sent_bcast(const rlo_engine *e)
+{
+    return e->sent_bcast;
+}
+
+int64_t rlo_engine_recved_bcast(const rlo_engine *e)
+{
+    return e->recved_bcast;
+}

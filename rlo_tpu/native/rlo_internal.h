@@ -1,0 +1,54 @@
+/* Internal structures shared between the loopback world and the engine. */
+#ifndef RLO_INTERNAL_H
+#define RLO_INTERNAL_H
+
+#include "rlo_core.h"
+
+#include <stdlib.h>
+#include <string.h>
+
+/* Refcounted send-completion handle (~MPI_Request tested by MPI_Test;
+ * reference keeps per-destination isend req arrays, rootless_ops.c:296).
+ * One ref is held by the in-flight wire node, one by the tracking message
+ * (when the sender tracks completion at all — votes don't). */
+typedef struct rlo_handle {
+    int delivered;
+    int refs;
+} rlo_handle;
+
+static inline rlo_handle *rlo_handle_new(int refs)
+{
+    rlo_handle *h = (rlo_handle *)calloc(1, sizeof(*h));
+    if (h)
+        h->refs = refs;
+    return h;
+}
+
+static inline void rlo_handle_unref(rlo_handle *h)
+{
+    if (h && --h->refs == 0)
+        free(h);
+}
+
+/* One in-flight or delivered wire frame. Owned by the world until the
+ * receiving engine polls it off its inbox; then owned by the engine. */
+typedef struct rlo_wire_node {
+    struct rlo_wire_node *next;
+    int src, dst, tag, comm;
+    uint64_t due; /* deliver-at tick (latency injection) */
+    rlo_handle *handle;
+    int64_t len;
+    uint8_t data[]; /* encoded frame */
+} rlo_wire_node;
+
+/* World-side transport API used by the engine. */
+int rlo_world_isend(rlo_world *w, int src, int dst, int comm, int tag,
+                    const uint8_t *raw, int64_t len, rlo_handle **out);
+rlo_wire_node *rlo_world_poll(rlo_world *w, int rank, int comm);
+int rlo_world_register(rlo_world *w, rlo_engine *e);
+void rlo_world_unregister(rlo_world *w, rlo_engine *e);
+
+/* Engine-side hook the world's progress loop drives. */
+void rlo_engine_progress_once(rlo_engine *e);
+
+#endif /* RLO_INTERNAL_H */

@@ -1,0 +1,306 @@
+/* In-process loopback transport world: N ranks in one address space.
+ *
+ * Native counterpart of rlo_tpu/transport/loopback.py. The reference has no
+ * equivalent — its tests need mpirun even on one host (SURVEY.md §4).
+ * Guarantees mirror MPI and the Python loopback: per-(src,dst,comm) FIFO
+ * order even under latency injection, reliable delivery, unspecified
+ * cross-pair order (which the seeded latency deliberately perturbs).
+ *
+ * Single-threaded by design: the engine model is cooperative polling
+ * (reference rootless_ops.h:216 documents thread-unsafety; we keep the
+ * model and make it explicit).
+ */
+#include "rlo_internal.h"
+
+/* per-(src,dst,comm) FIFO of frames still "in flight" */
+typedef struct rlo_channel {
+    struct rlo_channel *next;
+    int src, dst, comm;
+    rlo_wire_node *head, *tail;
+} rlo_channel;
+
+struct rlo_world {
+    int world_size;
+    int latency;
+    uint64_t rng;
+    uint64_t tick;
+    int64_t sent_cnt, delivered_cnt;
+    rlo_channel *channels;
+    rlo_wire_node **inbox_head; /* per-rank delivered FIFO */
+    rlo_wire_node **inbox_tail;
+    rlo_engine **engines;
+    int n_engines, cap_engines;
+    int stepping; /* re-entrancy guard for rlo_progress_all */
+};
+
+static uint64_t xorshift64(uint64_t *s)
+{
+    uint64_t x = *s;
+    x ^= x << 13;
+    x ^= x >> 7;
+    x ^= x << 17;
+    return *s = x;
+}
+
+rlo_world *rlo_world_new(int world_size, int latency, uint64_t seed)
+{
+    if (world_size < 2) /* reference rejects at bcomm_init :1464 */
+        return 0;
+    rlo_world *w = (rlo_world *)calloc(1, sizeof(*w));
+    if (!w)
+        return 0;
+    w->world_size = world_size;
+    w->latency = latency;
+    w->rng = seed ? seed : 0x9e3779b97f4a7c15ull;
+    w->inbox_head =
+        (rlo_wire_node **)calloc((size_t)world_size, sizeof(void *));
+    w->inbox_tail =
+        (rlo_wire_node **)calloc((size_t)world_size, sizeof(void *));
+    if (!w->inbox_head || !w->inbox_tail) {
+        free(w->inbox_head);
+        free(w->inbox_tail);
+        free(w);
+        return 0;
+    }
+    return w;
+}
+
+static void free_node(rlo_wire_node *n)
+{
+    rlo_handle_unref(n->handle);
+    free(n);
+}
+
+void rlo_world_free(rlo_world *w)
+{
+    if (!w)
+        return;
+    for (rlo_channel *c = w->channels; c;) {
+        rlo_channel *nc = c->next;
+        for (rlo_wire_node *n = c->head; n;) {
+            rlo_wire_node *nn = n->next;
+            free_node(n);
+            n = nn;
+        }
+        free(c);
+        c = nc;
+    }
+    for (int r = 0; r < w->world_size; r++) {
+        for (rlo_wire_node *n = w->inbox_head[r]; n;) {
+            rlo_wire_node *nn = n->next;
+            free_node(n);
+            n = nn;
+        }
+    }
+    free(w->inbox_head);
+    free(w->inbox_tail);
+    free(w->engines);
+    free(w);
+}
+
+int rlo_world_size(const rlo_world *w)
+{
+    return w->world_size;
+}
+
+int64_t rlo_world_sent_cnt(const rlo_world *w)
+{
+    return w->sent_cnt;
+}
+
+int64_t rlo_world_delivered_cnt(const rlo_world *w)
+{
+    return w->delivered_cnt;
+}
+
+int rlo_world_quiescent(const rlo_world *w)
+{
+    for (const rlo_channel *c = w->channels; c; c = c->next)
+        if (c->head)
+            return 0;
+    for (int r = 0; r < w->world_size; r++)
+        if (w->inbox_head[r])
+            return 0;
+    return 1;
+}
+
+static void inbox_push(rlo_world *w, rlo_wire_node *n)
+{
+    n->next = 0;
+    if (w->inbox_tail[n->dst])
+        w->inbox_tail[n->dst]->next = n;
+    else
+        w->inbox_head[n->dst] = n;
+    w->inbox_tail[n->dst] = n;
+    n->handle->delivered = 1;
+    w->delivered_cnt++;
+}
+
+static rlo_channel *get_channel(rlo_world *w, int src, int dst, int comm)
+{
+    for (rlo_channel *c = w->channels; c; c = c->next)
+        if (c->src == src && c->dst == dst && c->comm == comm)
+            return c;
+    rlo_channel *c = (rlo_channel *)calloc(1, sizeof(*c));
+    if (!c)
+        return 0;
+    c->src = src;
+    c->dst = dst;
+    c->comm = comm;
+    c->next = w->channels;
+    w->channels = c;
+    return c;
+}
+
+int rlo_world_isend(rlo_world *w, int src, int dst, int comm, int tag,
+                    const uint8_t *raw, int64_t len, rlo_handle **out)
+{
+    if (dst < 0 || dst >= w->world_size || len < 0)
+        return RLO_ERR_ARG;
+    int caller_tracks = out != 0;
+    rlo_handle *h = rlo_handle_new(caller_tracks ? 2 : 1);
+    rlo_wire_node *n =
+        (rlo_wire_node *)malloc(sizeof(*n) + (size_t)len);
+    if (!h || !n) {
+        free(h);
+        free(n);
+        return RLO_ERR_NOMEM;
+    }
+    n->next = 0;
+    n->src = src;
+    n->dst = dst;
+    n->tag = tag;
+    n->comm = comm;
+    n->handle = h;
+    n->len = len;
+    if (len > 0)
+        memcpy(n->data, raw, (size_t)len);
+    w->sent_cnt++;
+    if (w->latency <= 0) {
+        inbox_push(w, n);
+    } else {
+        n->due = w->tick + xorshift64(&w->rng) % (uint64_t)(w->latency + 1);
+        rlo_channel *c = get_channel(w, src, dst, comm);
+        if (!c) {
+            free_node(n);
+            return RLO_ERR_NOMEM;
+        }
+        if (c->tail)
+            c->tail->next = n;
+        else
+            c->head = n;
+        c->tail = n;
+        n->next = 0;
+    }
+    if (out)
+        *out = h;
+    return RLO_OK;
+}
+
+/* Move every due channel head to its inbox. Only heads can become due,
+ * which preserves per-channel FIFO under latency injection. */
+static void pump(rlo_world *w)
+{
+    w->tick++;
+    for (rlo_channel *c = w->channels; c; c = c->next) {
+        while (c->head && c->head->due <= w->tick) {
+            rlo_wire_node *n = c->head;
+            c->head = n->next;
+            if (!c->head)
+                c->tail = 0;
+            inbox_push(w, n);
+        }
+    }
+}
+
+rlo_wire_node *rlo_world_poll(rlo_world *w, int rank, int comm)
+{
+    pump(w);
+    rlo_wire_node *prev = 0;
+    for (rlo_wire_node *n = w->inbox_head[rank]; n;
+         prev = n, n = n->next) {
+        if (n->comm != comm)
+            continue;
+        if (prev)
+            prev->next = n->next;
+        else
+            w->inbox_head[rank] = n->next;
+        if (w->inbox_tail[rank] == n)
+            w->inbox_tail[rank] = prev;
+        n->next = 0;
+        return n;
+    }
+    return 0;
+}
+
+int rlo_world_register(rlo_world *w, rlo_engine *e)
+{
+    if (w->n_engines == w->cap_engines) {
+        int cap = w->cap_engines ? w->cap_engines * 2 : 8;
+        rlo_engine **p = (rlo_engine **)realloc(
+            w->engines, (size_t)cap * sizeof(void *));
+        if (!p)
+            return RLO_ERR_NOMEM;
+        w->engines = p;
+        w->cap_engines = cap;
+    }
+    w->engines[w->n_engines++] = e;
+    return RLO_OK;
+}
+
+void rlo_world_unregister(rlo_world *w, rlo_engine *e)
+{
+    for (int i = 0; i < w->n_engines; i++) {
+        if (w->engines[i] == e) {
+            memmove(&w->engines[i], &w->engines[i + 1],
+                    (size_t)(w->n_engines - i - 1) * sizeof(void *));
+            w->n_engines--;
+            return;
+        }
+    }
+}
+
+void rlo_progress_all(rlo_world *w)
+{
+    /* handlers may initiate broadcasts (decision bcast inside the vote
+     * handler) which re-enter; make nested turns no-ops (mirrors
+     * EngineManager._stepping, rlo_tpu/engine.py) */
+    if (w->stepping)
+        return;
+    w->stepping = 1;
+    /* step over a snapshot: callbacks may register/unregister engines
+     * mid-turn (the Python side iterates a copy for the same reason) */
+    int n = w->n_engines;
+    rlo_engine **snap =
+        (rlo_engine **)malloc((size_t)(n ? n : 1) * sizeof(void *));
+    if (snap) {
+        memcpy(snap, w->engines, (size_t)n * sizeof(void *));
+        for (int i = 0; i < n; i++) {
+            /* skip engines freed by an earlier engine's callback */
+            int live = 0;
+            for (int j = 0; j < w->n_engines; j++)
+                if (w->engines[j] == snap[i])
+                    live = 1;
+            if (live)
+                rlo_engine_progress_once(snap[i]);
+        }
+        free(snap);
+    }
+    w->stepping = 0;
+}
+
+int rlo_drain(rlo_world *w, int max_spins)
+{
+    for (int i = 0; i < max_spins; i++) {
+        rlo_progress_all(w);
+        if (rlo_world_quiescent(w)) {
+            int idle = 1;
+            for (int j = 0; j < w->n_engines; j++)
+                if (!rlo_engine_idle(w->engines[j]))
+                    idle = 0;
+            if (idle)
+                return i;
+        }
+    }
+    return RLO_ERR_STALL;
+}

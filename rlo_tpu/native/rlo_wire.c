@@ -1,0 +1,74 @@
+/* Wire format: one variable-size frame per message.
+ *
+ * Layout (little-endian, matching rlo_tpu/wire.py `<iiiQ>`):
+ *   [origin:i32][pid:i32][vote:i32][len:u64][payload bytes]
+ * The reference's pbuf (rootless_ops.c:1369-1410) carries the same logical
+ * fields but always ships a fixed 32 KB buffer (:1588); frames here are
+ * exactly header + payload.
+ */
+#include "rlo_core.h"
+
+#include <string.h>
+
+static void put_i32(uint8_t *p, int32_t v)
+{
+    p[0] = (uint8_t)(v & 0xff);
+    p[1] = (uint8_t)((v >> 8) & 0xff);
+    p[2] = (uint8_t)((v >> 16) & 0xff);
+    p[3] = (uint8_t)((v >> 24) & 0xff);
+}
+
+static int32_t get_i32(const uint8_t *p)
+{
+    return (int32_t)((uint32_t)p[0] | ((uint32_t)p[1] << 8) |
+                     ((uint32_t)p[2] << 16) | ((uint32_t)p[3] << 24));
+}
+
+static void put_u64(uint8_t *p, uint64_t v)
+{
+    for (int i = 0; i < 8; i++)
+        p[i] = (uint8_t)((v >> (8 * i)) & 0xff);
+}
+
+static uint64_t get_u64(const uint8_t *p)
+{
+    uint64_t v = 0;
+    for (int i = 0; i < 8; i++)
+        v |= (uint64_t)p[i] << (8 * i);
+    return v;
+}
+
+int64_t rlo_frame_encode(uint8_t *dst, int64_t cap, int32_t origin,
+                         int32_t pid, int32_t vote, const uint8_t *payload,
+                         int64_t len)
+{
+    if (len < 0 || cap < RLO_HEADER_SIZE + len)
+        return RLO_ERR_ARG;
+    put_i32(dst, origin);
+    put_i32(dst + 4, pid);
+    put_i32(dst + 8, vote);
+    put_u64(dst + 12, (uint64_t)len);
+    if (len > 0)
+        memcpy(dst + RLO_HEADER_SIZE, payload, (size_t)len);
+    return RLO_HEADER_SIZE + len;
+}
+
+int64_t rlo_frame_decode(const uint8_t *raw, int64_t rawlen, int32_t *origin,
+                         int32_t *pid, int32_t *vote,
+                         const uint8_t **payload)
+{
+    if (rawlen < RLO_HEADER_SIZE)
+        return RLO_ERR_ARG;
+    uint64_t n = get_u64(raw + 12);
+    if ((int64_t)n > rawlen - RLO_HEADER_SIZE)
+        return RLO_ERR_ARG; /* truncated frame */
+    if (origin)
+        *origin = get_i32(raw);
+    if (pid)
+        *pid = get_i32(raw + 4);
+    if (vote)
+        *vote = get_i32(raw + 8);
+    if (payload)
+        *payload = raw + RLO_HEADER_SIZE;
+    return (int64_t)n;
+}

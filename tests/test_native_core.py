@@ -1,0 +1,406 @@
+"""Native C core tests: parity against the Python implementation.
+
+Three layers of oracles:
+  1. pure-function table parity — topology math (level, last_wall,
+     send_list, check_passed_origin, fwd_targets, fwd_send_cnt) must agree
+     exactly with rlo_tpu.topology for every (ws, rank, origin, from);
+  2. wire-format parity — C frame encode/decode interoperates byte-for-byte
+     with rlo_tpu.wire.Frame;
+  3. behavioral parity — bcast delivery counts, IAR decision agreement,
+     callback activity, and drain termination, mirroring the reference
+     integration suite (testcases.c) like the Python engine tests do.
+"""
+
+import random
+
+import pytest
+
+from rlo_tpu import topology
+from rlo_tpu.native import bindings as nb
+from rlo_tpu.wire import Frame, Tag
+
+WORLD_SIZES = [2, 3, 4, 5, 6, 7, 8, 11, 16, 23, 32, 33]
+
+
+# ---------------------------------------------------------------------------
+# 1. topology parity
+# ---------------------------------------------------------------------------
+
+class TestTopologyParity:
+    @pytest.mark.parametrize("ws", WORLD_SIZES)
+    def test_level_last_wall_send_list(self, ws):
+        for r in range(ws):
+            assert nb.level(ws, r) == topology.level(ws, r)
+            assert nb.last_wall(ws, r) == topology.last_wall(ws, r)
+            assert nb.send_list(ws, r) == topology.send_list(ws, r)
+            assert nb.initiator_targets(ws, r) == \
+                topology.initiator_targets(ws, r)
+
+    @pytest.mark.parametrize("ws", [2, 3, 5, 8, 11, 16])
+    def test_check_passed_origin(self, ws):
+        for me in range(ws):
+            for origin in range(ws):
+                for to in range(ws):
+                    assert nb.check_passed_origin(ws, me, origin, to) == \
+                        topology.check_passed_origin(ws, me, origin, to), \
+                        (ws, me, origin, to)
+
+    @pytest.mark.parametrize("ws", [2, 3, 5, 8, 11, 16, 23])
+    def test_fwd_targets_and_cnt(self, ws):
+        for rank in range(ws):
+            for origin in range(ws):
+                for frm in range(-1, ws):
+                    assert nb.fwd_targets(ws, rank, origin, frm) == \
+                        topology.fwd_targets(ws, rank, origin, frm), \
+                        (ws, rank, origin, frm)
+                    assert nb.fwd_send_cnt(ws, rank, origin, frm) == \
+                        topology.fwd_send_cnt(ws, rank, origin, frm)
+
+
+# ---------------------------------------------------------------------------
+# 2. wire parity
+# ---------------------------------------------------------------------------
+
+class TestWireParity:
+    @pytest.mark.parametrize("origin,pid,vote,payload", [
+        (0, -1, -1, b""),
+        (3, 7, 1, b"hello"),
+        (31, -2, 0, bytes(range(256)) * 4),
+    ])
+    def test_roundtrip_matches_python(self, origin, pid, vote, payload):
+        o, p, v, data, raw = nb.frame_roundtrip(origin, pid, vote, payload)
+        assert (o, p, v, data) == (origin, pid, vote, payload)
+        # byte-for-byte interop with the Python encoder
+        assert raw == Frame(origin, pid, vote, payload).encode()
+        f = Frame.decode(raw)
+        assert (f.origin, f.pid, f.vote, f.payload) == \
+            (origin, pid, vote, payload)
+
+    def test_truncated_frame_rejected(self):
+        raw = Frame(1, 2, 3, b"abcdef").encode()
+        import ctypes as C
+        lib = nb.load()
+        buf = (C.c_uint8 * len(raw)).from_buffer_copy(raw)
+        assert lib.rlo_frame_decode(buf, 10, None, None, None, None) < 0
+        assert lib.rlo_frame_decode(buf, len(raw) - 1, None, None, None,
+                                    None) < 0
+
+
+# ---------------------------------------------------------------------------
+# 3. behavioral parity
+# ---------------------------------------------------------------------------
+
+def collect_all(eng):
+    out = []
+    while (m := eng.pickup_next()) is not None:
+        out.append(m)
+    return out
+
+
+def build_world(ws, latency=0, seed=1, **kwargs):
+    world = nb.NativeWorld(ws, latency=latency, seed=seed)
+    engines = [nb.NativeEngine(world, r, **kwargs) for r in range(ws)]
+    return world, engines
+
+
+class TestNativeBcast:
+    @pytest.mark.parametrize("ws", WORLD_SIZES)
+    def test_single_root_counts(self, ws):
+        with nb.NativeWorld(ws) as world:
+            engines = [nb.NativeEngine(world, r) for r in range(ws)]
+            cnt = 5
+            root = ws // 2
+            for i in range(cnt):
+                engines[root].bcast(f"msg-{i}".encode())
+            world.drain()
+            for r, eng in enumerate(engines):
+                msgs = collect_all(eng)
+                if r == root:
+                    assert msgs == []
+                else:
+                    assert len(msgs) == cnt, (ws, r)
+                    assert [m.data.decode() for m in msgs] == \
+                        [f"msg-{i}" for i in range(cnt)]
+                    assert all(m.origin == root for m in msgs)
+                    assert all(m.type == Tag.BCAST for m in msgs)
+                assert eng.err == 0
+
+    @pytest.mark.parametrize("ws", WORLD_SIZES)
+    def test_every_rank_broadcasts(self, ws):
+        with nb.NativeWorld(ws) as world:
+            engines = [nb.NativeEngine(world, r) for r in range(ws)]
+            for r in range(ws):
+                engines[r].bcast(f"from-{r}".encode())
+            world.drain()
+            for r, eng in enumerate(engines):
+                msgs = collect_all(eng)
+                assert len(msgs) == ws - 1
+                assert {m.data.decode() for m in msgs} == \
+                    {f"from-{o}" for o in range(ws) if o != r}
+
+    @pytest.mark.parametrize("ws,latency,seed", [
+        (4, 3, 10), (7, 5, 11), (8, 4, 12), (16, 6, 13), (23, 8, 14)])
+    def test_bcast_under_latency_fuzz(self, ws, latency, seed):
+        with nb.NativeWorld(ws, latency=latency, seed=seed) as world:
+            engines = [nb.NativeEngine(world, r) for r in range(ws)]
+            for r in range(ws):
+                engines[r].bcast(f"fuzz-{r}".encode())
+            world.drain()
+            for eng in engines:
+                assert len(collect_all(eng)) == ws - 1
+                assert eng.err == 0
+
+    @pytest.mark.parametrize("ws", [4, 8, 16])
+    def test_hacky_sack(self, ws):
+        """All-to-all stress (testcases.c:638-697): every throw is a bcast;
+        total pickups must be rounds * (ws-1)."""
+        with nb.NativeWorld(ws, latency=2, seed=99) as world:
+            engines = [nb.NativeEngine(world, r) for r in range(ws)]
+            rng = random.Random(7)
+            rounds = 20
+            holder = 0
+            for i in range(rounds):
+                engines[holder].bcast(f"ball-{i}".encode())
+                holder = rng.choice([r for r in range(ws) if r != holder])
+            world.drain()
+            total = sum(len(collect_all(e)) for e in engines)
+            assert total == rounds * (ws - 1)
+
+    def test_counters_match_python_semantics(self):
+        with nb.NativeWorld(4) as world:
+            engines = [nb.NativeEngine(world, r) for r in range(4)]
+            engines[1].bcast(b"a")
+            world.drain()
+            assert engines[1].sent_bcast_cnt == 1
+            assert sum(e.recved_bcast_cnt for e in engines) == 3
+            assert world.sent_cnt == world.delivered_cnt
+
+    def test_payload_too_large(self):
+        with nb.NativeWorld(2) as world:
+            e = nb.NativeEngine(world, 0)
+            nb.NativeEngine(world, 1)
+            with pytest.raises(ValueError):
+                e.bcast(b"x" * (e.msg_size_max + 1))
+
+    def test_world_size_one_rejected(self):
+        with pytest.raises(ValueError):
+            nb.NativeWorld(1)
+
+
+class Ctx:
+    def __init__(self, rank, veto=False):
+        self.rank = rank
+        self.veto = veto
+        self.judged = []
+        self.actions = []
+
+
+def judge(payload, ctx):
+    ctx.judged.append(bytes(payload))
+    return 0 if ctx.veto else 1
+
+
+def action(payload, ctx):
+    ctx.actions.append(bytes(payload))
+
+
+def build_iar(ws, veto_ranks=(), latency=0, seed=1):
+    world = nb.NativeWorld(ws, latency=latency, seed=seed)
+    ctxs = [Ctx(r, veto=(r in veto_ranks)) for r in range(ws)]
+    engines = [nb.NativeEngine(world, r, judge_cb=judge, app_ctx=ctxs[r],
+                               action_cb=action) for r in range(ws)]
+    return world, engines, ctxs
+
+
+def decisions_of(eng):
+    return [m for m in collect_all(eng) if m.type == Tag.IAR_DECISION]
+
+
+IAR_SIZES = [2, 3, 4, 5, 7, 8, 16, 23]
+
+
+class TestNativeConsensus:
+    @pytest.mark.parametrize("ws", IAR_SIZES)
+    @pytest.mark.parametrize("proposer", [0, 1])
+    def test_all_approve(self, ws, proposer):
+        proposer = proposer % ws
+        world, engines, ctxs = build_iar(ws)
+        with world:
+            engines[proposer].submit_proposal(b"prop", pid=proposer)
+            world.drain()
+            assert engines[proposer].vote_my_proposal() == 1
+            assert engines[proposer].check_proposal_state() == nb.COMPLETED
+            for r in range(ws):
+                if r == proposer:
+                    continue
+                assert ctxs[r].judged == [b"prop"]
+                assert ctxs[r].actions == [b"prop"]
+                ds = decisions_of(engines[r])
+                assert len(ds) == 1 and ds[0].vote == 1
+                assert ds[0].pid == proposer
+
+    @pytest.mark.parametrize("ws", IAR_SIZES)
+    def test_one_veto_declines(self, ws):
+        world, engines, ctxs = build_iar(ws, veto_ranks={ws - 1})
+        with world:
+            engines[0].submit_proposal(b"prop", pid=0)
+            world.drain()
+            assert engines[0].vote_my_proposal() == 0
+            for r in range(1, ws):
+                ds = decisions_of(engines[r])
+                assert len(ds) == 1 and ds[0].vote == 0
+                assert ctxs[r].actions == []
+
+    @pytest.mark.parametrize("ws", [4, 8, 16])
+    def test_proposer_self_veto_via_rejudge(self, ws):
+        world, engines, ctxs = build_iar(ws)
+        with world:
+            ctxs[0].veto = True  # app state changes before votes return
+            engines[0].submit_proposal(b"prop", pid=0)
+            world.drain()
+            assert engines[0].vote_my_proposal() == 0
+
+    @pytest.mark.parametrize("ws,latency,seed", [
+        (5, 4, 21), (8, 3, 22), (16, 6, 23)])
+    def test_under_latency_fuzz(self, ws, latency, seed):
+        world, engines, ctxs = build_iar(ws, latency=latency, seed=seed)
+        with world:
+            engines[ws // 2].submit_proposal(b"p", pid=ws // 2)
+            world.drain()
+            assert engines[ws // 2].vote_my_proposal() == 1
+
+    @pytest.mark.parametrize("ws", [4, 8, 16, 23])
+    def test_two_proposers_consistent(self, ws):
+        """Two simultaneous proposers with distinct pids: both complete,
+        every other rank sees both decisions (testcases.c:401-486)."""
+        world, engines, ctxs = build_iar(ws, latency=2, seed=31)
+        with world:
+            a, b = 0, ws // 2
+            engines[a].submit_proposal(b"A", pid=a)
+            engines[b].submit_proposal(b"B", pid=b)
+            world.drain()
+            assert engines[a].vote_my_proposal() == 1
+            assert engines[b].vote_my_proposal() == 1
+            for r in range(ws):
+                ds = decisions_of(engines[r])
+                expect = sum(1 for p in (a, b) if p != r)
+                assert len(ds) == expect, (r, ds)
+                assert all(d.vote == 1 for d in ds)
+                assert all(e.err == 0 for e in engines)
+
+    def test_busy_proposer_rejected(self):
+        # latency keeps the first proposal in flight
+        world, engines, ctxs = build_iar(4, latency=50, seed=3)
+        with world:
+            engines[0].submit_proposal(b"one", pid=0)
+            if engines[0].check_proposal_state() == nb.IN_PROGRESS:
+                with pytest.raises(RuntimeError):
+                    engines[0].submit_proposal(b"two", pid=100)
+            world.drain()
+
+    def test_proposal_reset_allows_reuse(self):
+        world, engines, ctxs = build_iar(4)
+        with world:
+            assert engines[0].submit_proposal(b"one", pid=0) in (-1, 1)
+            world.drain()
+            assert engines[0].vote_my_proposal() == 1
+            engines[0].proposal_reset()
+            engines[0].submit_proposal(b"two", pid=10)
+            world.drain()
+            assert engines[0].vote_my_proposal() == 1
+            # second round delivered on every other rank too
+            for r in range(1, 4):
+                ds = decisions_of(engines[r])
+                assert [d.pid for d in ds] == [0, 10]
+
+
+class TestEngineMultiplex:
+    @pytest.mark.parametrize("ws", [4, 8])
+    def test_two_comms_isolated(self, ws):
+        """Two engines per rank on different comm ids (the analogue of the
+        reference's two engines over dup'ed comms, testcases.c:110-241):
+        traffic must not cross."""
+        with nb.NativeWorld(ws, latency=1, seed=5) as world:
+            ea = [nb.NativeEngine(world, r, comm=0) for r in range(ws)]
+            eb = [nb.NativeEngine(world, r, comm=1) for r in range(ws)]
+            ea[0].bcast(b"on-comm-0")
+            eb[1].bcast(b"on-comm-1")
+            world.drain()
+            for r in range(ws):
+                ma = collect_all(ea[r])
+                mb = collect_all(eb[r])
+                if r != 0:
+                    assert [m.data for m in ma] == [b"on-comm-0"]
+                else:
+                    assert ma == []
+                if r != 1:
+                    assert [m.data for m in mb] == [b"on-comm-1"]
+                else:
+                    assert mb == []
+
+
+class TestCrossImplementation:
+    """Run the same scenario on the Python engine and the C engine; compare
+    delivery outcomes exactly."""
+
+    @pytest.mark.parametrize("ws,latency,seed", [
+        (5, 0, 1), (8, 3, 42), (11, 5, 7), (16, 2, 9)])
+    def test_bcast_outcomes_match(self, ws, latency, seed):
+        from rlo_tpu.engine import ProgressEngine, EngineManager, drain
+        from rlo_tpu.transport import make_world
+
+        # python side
+        pw = make_world("loopback", ws, latency=latency, seed=seed)
+        mgr = EngineManager()
+        pes = [ProgressEngine(pw.transport(r), manager=mgr)
+               for r in range(ws)]
+        for r in range(ws):
+            pes[r].bcast(f"x-{r}".encode())
+        drain([pw], pes)
+        py_out = [sorted(m.data for m in collect_all(e)) for e in pes]
+
+        # native side
+        with nb.NativeWorld(ws, latency=latency, seed=seed + 1) as world:
+            nes = [nb.NativeEngine(world, r) for r in range(ws)]
+            for r in range(ws):
+                nes[r].bcast(f"x-{r}".encode())
+            world.drain()
+            nat_out = [sorted(m.data for m in collect_all(e)) for e in nes]
+
+        assert py_out == nat_out
+
+    @pytest.mark.parametrize("ws", [4, 8, 23])
+    @pytest.mark.parametrize("veto", [(), (2,)])
+    def test_consensus_outcomes_match(self, ws, veto):
+        from rlo_tpu.engine import ProgressEngine, EngineManager, drain
+        from rlo_tpu.transport import make_world
+
+        veto = tuple(v for v in veto if v < ws)
+
+        pw = make_world("loopback", ws)
+        mgr = EngineManager()
+        pcs = [Ctx(r, veto=(r in veto)) for r in range(ws)]
+        pes = [ProgressEngine(pw.transport(r), judge_cb=judge,
+                              app_ctx=pcs[r], action_cb=action, manager=mgr)
+               for r in range(ws)]
+        pes[0].submit_proposal(b"prop", pid=0)
+        drain([pw], pes)
+        py_vote = pes[0].vote_my_proposal()
+        py_actions = [len(c.actions) for c in pcs]
+
+        world, nes, ncs = build_iar(ws, veto_ranks=veto)
+        with world:
+            nes[0].submit_proposal(b"prop", pid=0)
+            world.drain()
+            nat_vote = nes[0].vote_my_proposal()
+            nat_actions = [len(c.actions) for c in ncs]
+
+        assert py_vote == nat_vote
+        assert py_actions == nat_actions
+
+
+class TestUtils:
+    def test_now_usec_monotonicish(self):
+        a = nb.now_usec()
+        b = nb.now_usec()
+        assert b >= a > 1_000_000_000_000  # after 2001 in usec
