@@ -116,10 +116,34 @@ typedef struct rlo_engine rlo_engine;
 rlo_world *rlo_world_new(int world_size, int latency, uint64_t seed);
 void rlo_world_free(rlo_world *w);
 int rlo_world_size(const rlo_world *w);
+/* bound rank for one-process-per-rank transports (shm/mpi); -1 when this
+ * process hosts every rank (loopback) */
+int rlo_world_my_rank(const rlo_world *w);
+/* transport name: "loopback" / "shm" / "mpi" */
+const char *rlo_world_transport(const rlo_world *w);
 /* 1 when no frames are in flight or waiting in any inbox */
 int rlo_world_quiescent(const rlo_world *w);
+/* 1 when the world is dead (a peer rank's process failed/aborted);
+ * always 0 for in-process transports. Spin loops should poll this. */
+int rlo_world_failed(const rlo_world *w);
 int64_t rlo_world_sent_cnt(const rlo_world *w);
 int64_t rlo_world_delivered_cnt(const rlo_world *w);
+
+/* ------------------------------------------------------------------ */
+/* SHM transport: N real OS processes as ranks over a shared-memory     */
+/* segment of SPSC ring channels — the `mpirun -n N` analogue           */
+/* (reference Makefile:5). The launcher forks world_size children; each */
+/* child receives a world bound to its rank and runs `fn`.              */
+/* ------------------------------------------------------------------ */
+typedef int (*rlo_rank_fn)(rlo_world *w, int rank, void *ctx);
+/* Returns 0 when every rank returned 0, else the first nonzero child
+ * status (or a negative rlo_err for setup failures). ring_bytes <= 0
+ * picks a default (256 KB per src->dst channel). */
+int rlo_shm_launch(int world_size, int64_t ring_bytes, rlo_rank_fn fn,
+                   void *ctx);
+/* Collective barrier across all ranks of an shm world (sense-reversing;
+ * spins with sched_yield). No-op on other transports. */
+void rlo_shm_barrier(rlo_world *w);
 
 /* ------------------------------------------------------------------ */
 /* Progress engine (reference struct progress_engine + EngineManager).  */
@@ -174,7 +198,9 @@ int64_t rlo_engine_recved_bcast(const rlo_engine *e);
 
 /* Termination-detection drain (reference cleanup drain,
  * rootless_ops.c:1613-1625): progress until the world is quiescent and
- * every engine idle. Returns spins used, or RLO_ERR_STALL. */
+ * every engine idle. Returns spins used, or RLO_ERR_STALL. Collective on
+ * multi-process transports (every rank must call it, like the
+ * reference's MPI_Iallreduce-based drain). */
 int rlo_drain(rlo_world *w, int max_spins);
 
 /* ------------------------------------------------------------------ */

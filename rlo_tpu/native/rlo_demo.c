@@ -1,0 +1,404 @@
+/* `demo` — multi-process scenario runner over the SHM transport.
+ *
+ * The analogue of the reference's one test binary driven by
+ * `mpirun -n N ./demo` (reference Makefile:5, testcases.c:742-780): each
+ * rank is a real OS process; scenarios replicate the reference suite
+ * (SURVEY.md §4) with its behavior-level oracles:
+ *
+ *   bcast    ~ test_gen_bcast (testcases.c:59-108): one root broadcasts
+ *              `cnt` messages, every other rank spin-picks-up exactly cnt
+ *   wrapper  ~ test_wrapper_bcast (:699-724): every rank roots in turn
+ *   hacky    ~ hacky_sack_progress_engine (:638-697): random ball
+ *              passing; every catch triggers a new broadcast; per-rank
+ *              pickup-count oracle
+ *   iar      ~ test_IAllReduce_single_proposal (:243-332): one proposer,
+ *              optional dissenting rank; decision verified on every rank
+ *   iar2     ~ test_concurrent_iar_single_proposal (:110-241): two
+ *              engines on one world, concurrent proposals, both verified
+ *   multi    ~ test_iar_multi_proposal (:401-486): several simultaneous
+ *              proposers; every rank counts the expected decisions
+ *
+ * Usage: ./rlo_demo [-n ranks] [-c case|all] [-m msgs] [-v]
+ * Exit status 0 iff every rank's oracle held.
+ */
+#include "rlo_core.h"
+
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+typedef struct demo_cfg {
+    int msgs;     /* bcast count / hacky rounds */
+    int veto;     /* iar: rank that votes NO (-1 = none) */
+    int verbose;
+} demo_cfg;
+
+#define RCHECK(cond)                                                       \
+    do {                                                                   \
+        if (!(cond)) {                                                     \
+            fprintf(stderr, "rank %d FAIL %s:%d: %s\n", rank, __FILE__,    \
+                    __LINE__, #cond);                                      \
+            return 1;                                                      \
+        }                                                                  \
+    } while (0)
+
+#define DRAIN_SPINS 50000000
+
+/* spin progress until pickup returns something, a peer rank dies, or the
+ * budget runs out */
+static int64_t pickup_spin(rlo_world *w, rlo_engine *e, int *tag,
+                           int *origin, int *pid, int *vote, uint8_t *buf,
+                           int64_t cap)
+{
+    for (long i = 0; i < 200000000L; i++) {
+        int64_t n = rlo_pickup_next(e, tag, origin, pid, vote, buf, cap);
+        if (n >= 0)
+            return n;
+        if (rlo_world_failed(w))
+            return -1;
+        rlo_progress_all(w);
+    }
+    return -1;
+}
+
+/* spin until my own proposal leaves IN_PROGRESS; 0 on success */
+static int proposal_spin(rlo_world *w, rlo_engine *e)
+{
+    for (long i = 0; i < 200000000L; i++) {
+        if (rlo_check_proposal_state(e) != RLO_IN_PROGRESS)
+            return 0;
+        if (rlo_world_failed(w))
+            return -1;
+    }
+    return -1;
+}
+
+/* ---- bcast: root broadcasts cnt msgs; others expect exactly cnt ---- */
+static int case_bcast(rlo_world *w, int rank, void *vcfg)
+{
+    const demo_cfg *cfg = (const demo_cfg *)vcfg;
+    int ws = rlo_world_size(w);
+    int cnt = cfg->msgs;
+    rlo_engine *e = rlo_engine_new(w, rank, 0, 0, 0, 0, 0, 0);
+    RCHECK(e);
+    uint64_t t0 = rlo_now_usec();
+    if (rank == 0) {
+        for (int i = 0; i < cnt; i++) {
+            char buf[64];
+            int n = snprintf(buf, sizeof buf, "bcast-%d", i);
+            RCHECK(rlo_bcast(e, (const uint8_t *)buf, n) == RLO_OK);
+        }
+    } else {
+        for (int i = 0; i < cnt; i++) {
+            uint8_t buf[64];
+            int tag, origin, pid, vote;
+            int64_t n = pickup_spin(w, e, &tag, &origin, &pid, &vote, buf,
+                                    sizeof buf);
+            RCHECK(n >= 0);
+            RCHECK(origin == 0 && tag == RLO_TAG_BCAST);
+        }
+    }
+    RCHECK(rlo_drain(w, DRAIN_SPINS) >= 0);
+    RCHECK(rlo_engine_total_pickup(e) == (rank == 0 ? 0 : cnt));
+    RCHECK(rlo_engine_err(e) == RLO_OK);
+    if (cfg->verbose && rank == 0)
+        fprintf(stderr, "bcast: %d msgs x %d ranks in %llu usec\n", cnt,
+                ws, (unsigned long long)(rlo_now_usec() - t0));
+    rlo_engine_free(e);
+    return 0;
+}
+
+/* ---- wrapper: every rank roots one round in turn ---- */
+static int case_wrapper(rlo_world *w, int rank, void *vcfg)
+{
+    const demo_cfg *cfg = (const demo_cfg *)vcfg;
+    (void)cfg;
+    int ws = rlo_world_size(w);
+    rlo_engine *e = rlo_engine_new(w, rank, 0, 0, 0, 0, 0, 0);
+    RCHECK(e);
+    for (int root = 0; root < ws; root++) {
+        if (rank == root) {
+            char buf[64];
+            int n = snprintf(buf, sizeof buf, "round-%d", root);
+            RCHECK(rlo_bcast(e, (const uint8_t *)buf, n) == RLO_OK);
+        } else {
+            uint8_t buf[64];
+            int tag, origin, pid, vote;
+            int64_t n = pickup_spin(w, e, &tag, &origin, &pid, &vote, buf,
+                                    sizeof buf);
+            RCHECK(n >= 0);
+            RCHECK(origin == root);
+        }
+        RCHECK(rlo_drain(w, DRAIN_SPINS) >= 0);
+        rlo_shm_barrier(w); /* keep rounds from bleeding into oracles */
+    }
+    RCHECK(rlo_engine_total_pickup(e) == ws - 1);
+    RCHECK(rlo_engine_err(e) == RLO_OK);
+    rlo_engine_free(e);
+    return 0;
+}
+
+/* ---- hacky sack: every catch triggers a new broadcast ----
+ * Ball payload = {round, holder}. Rank 0 throws round 0; whoever the
+ * ball names as holder throws the next round until `msgs` rounds are
+ * out. Oracle (reference :691-692 adapted): pickups == rounds_total -
+ * my_throws, since a rank sees every ball but its own. */
+static int case_hacky(rlo_world *w, int rank, void *vcfg)
+{
+    const demo_cfg *cfg = (const demo_cfg *)vcfg;
+    int ws = rlo_world_size(w);
+    int rounds = cfg->msgs;
+    rlo_engine *e = rlo_engine_new(w, rank, 0, 0, 0, 0, 0, 0);
+    RCHECK(e);
+    uint64_t t0 = rlo_now_usec();
+    int my_throws = 0;
+    int32_t ball[2];
+    if (rank == 0) { /* round 0 */
+        ball[0] = 0;
+        ball[1] = (int32_t)(1 % ws);
+        RCHECK(rlo_bcast(e, (const uint8_t *)ball, sizeof ball) == RLO_OK);
+        my_throws++;
+    }
+    int seen = 0;
+    /* every rank sees rounds 0..rounds-1 except the ones it threw */
+    while (seen + my_throws < rounds) {
+        uint8_t buf[64];
+        int tag, origin, pid, vote;
+        int64_t n = pickup_spin(w, e, &tag, &origin, &pid, &vote, buf,
+                                sizeof buf);
+        RCHECK(n == sizeof ball);
+        memcpy(ball, buf, sizeof ball);
+        seen++;
+        int rnd = ball[0], holder = ball[1];
+        if (holder == rank && rnd + 1 < rounds) {
+            /* deterministic "random" next holder, never myself */
+            int32_t nxt = (int32_t)((rank + rnd * 2654435761u) % ws);
+            if (nxt == rank)
+                nxt = (int32_t)((nxt + 1) % ws);
+            int32_t nb[2] = {(int32_t)(rnd + 1), nxt};
+            RCHECK(rlo_bcast(e, (const uint8_t *)nb, sizeof nb) == RLO_OK);
+            my_throws++;
+        }
+    }
+    RCHECK(rlo_drain(w, DRAIN_SPINS) >= 0);
+    /* a final sweep: nothing further may arrive */
+    RCHECK(rlo_engine_total_pickup(e) + my_throws == rounds);
+    RCHECK(rlo_engine_err(e) == RLO_OK);
+    if (cfg->verbose && rank == 0)
+        fprintf(stderr, "hacky: %d rounds x %d ranks in %llu usec\n",
+                rounds, ws, (unsigned long long)(rlo_now_usec() - t0));
+    rlo_engine_free(e);
+    return 0;
+}
+
+/* ---- IAR single proposal (veto rank optional) ---- */
+typedef struct iar_ctx {
+    int veto;
+    int actions;
+} iar_ctx;
+
+static int judge_cb(const uint8_t *p, int64_t n, void *vc)
+{
+    (void)p;
+    (void)n;
+    return ((iar_ctx *)vc)->veto ? 0 : 1;
+}
+
+static void action_cb(const uint8_t *p, int64_t n, void *vc)
+{
+    (void)p;
+    (void)n;
+    ((iar_ctx *)vc)->actions++;
+}
+
+static int case_iar(rlo_world *w, int rank, void *vcfg)
+{
+    const demo_cfg *cfg = (const demo_cfg *)vcfg;
+    int ws = rlo_world_size(w);
+    int expect = cfg->veto >= 0 && cfg->veto < ws ? 0 : 1;
+    iar_ctx ctx = {.veto = rank == cfg->veto, .actions = 0};
+    rlo_engine *e =
+        rlo_engine_new(w, rank, 0, judge_cb, &ctx, action_cb, &ctx, 0);
+    RCHECK(e);
+    if (rank == 0) {
+        int rc = rlo_submit_proposal(e, (const uint8_t *)"move-x", 6, 0);
+        RCHECK(rc == -1 || rc == expect);
+        /* poll to completion (reference spin on check_proposal_state,
+         * testcases.c:262-266) */
+        RCHECK(proposal_spin(w, e) == 0);
+        RCHECK(rlo_vote_my_proposal(e) == expect);
+    } else {
+        /* every non-proposer must see the decision in its pickup */
+        uint8_t buf[64];
+        int tag, origin, pid, vote;
+        int64_t n = pickup_spin(w, e, &tag, &origin, &pid, &vote, buf,
+                                sizeof buf);
+        RCHECK(n >= 0);
+        RCHECK(tag == RLO_TAG_IAR_DECISION);
+        RCHECK(pid == 0 && vote == expect);
+        /* approved proposals ran the action exactly once — except on a
+         * vetoing rank, which never forwards and never acts */
+        RCHECK(ctx.actions == (expect && !ctx.veto ? 1 : 0));
+    }
+    RCHECK(rlo_drain(w, DRAIN_SPINS) >= 0);
+    RCHECK(rlo_engine_err(e) == RLO_OK);
+    rlo_engine_free(e);
+    return 0;
+}
+
+/* ---- two engines on one world, concurrent proposals ---- */
+static int case_iar2(rlo_world *w, int rank, void *vcfg)
+{
+    const demo_cfg *cfg = (const demo_cfg *)vcfg;
+    (void)cfg;
+    int ws = rlo_world_size(w);
+    rlo_engine *a = rlo_engine_new(w, rank, 0, 0, 0, 0, 0, 0);
+    rlo_engine *b = rlo_engine_new(w, rank, 1, 0, 0, 0, 0, 0);
+    RCHECK(a && b);
+    int pa = 0, pb = 1 % ws; /* proposer ranks per engine */
+    if (rank == pa)
+        RCHECK(rlo_submit_proposal(a, (const uint8_t *)"on-A", 4, pa) >=
+               -1);
+    if (rank == pb)
+        RCHECK(rlo_submit_proposal(b, (const uint8_t *)"on-B", 4, pb) >=
+               -1);
+    /* both engines progress each other through the shared world */
+    if (rank == pa) {
+        RCHECK(proposal_spin(w, a) == 0);
+        RCHECK(rlo_vote_my_proposal(a) == 1);
+    } else {
+        uint8_t buf[64];
+        int tag, origin, pid, vote;
+        RCHECK(pickup_spin(w, a, &tag, &origin, &pid, &vote, buf,
+                           sizeof buf) >= 0);
+        RCHECK(tag == RLO_TAG_IAR_DECISION && pid == pa && vote == 1);
+    }
+    if (rank == pb) {
+        RCHECK(proposal_spin(w, b) == 0);
+        RCHECK(rlo_vote_my_proposal(b) == 1);
+    } else {
+        uint8_t buf[64];
+        int tag, origin, pid, vote;
+        RCHECK(pickup_spin(w, b, &tag, &origin, &pid, &vote, buf,
+                           sizeof buf) >= 0);
+        RCHECK(tag == RLO_TAG_IAR_DECISION && pid == pb && vote == 1);
+    }
+    RCHECK(rlo_drain(w, DRAIN_SPINS) >= 0);
+    RCHECK(rlo_engine_err(a) == RLO_OK && rlo_engine_err(b) == RLO_OK);
+    rlo_engine_free(a);
+    rlo_engine_free(b);
+    return 0;
+}
+
+/* ---- several simultaneous proposers on one engine ---- */
+static int case_multi(rlo_world *w, int rank, void *vcfg)
+{
+    const demo_cfg *cfg = (const demo_cfg *)vcfg;
+    (void)cfg;
+    int ws = rlo_world_size(w);
+    rlo_engine *e = rlo_engine_new(w, rank, 0, 0, 0, 0, 0, 0);
+    RCHECK(e);
+    /* proposers: rank 1 plus every rank = 0 mod 4 (reference active_1 +
+     * active_2_mod pattern, testcases.c:401-486); pid = rank */
+    int am_proposer = rank == 1 % ws || rank % 4 == 0;
+    int n_prop = 0;
+    for (int r = 0; r < ws; r++)
+        if (r == 1 % ws || r % 4 == 0)
+            n_prop++;
+    if (am_proposer)
+        RCHECK(rlo_submit_proposal(e, (const uint8_t *)"multi", 5, rank) >=
+               -1);
+    /* expect decisions for every proposal but my own via pickup */
+    int want = n_prop - (am_proposer ? 1 : 0);
+    int seen[256] = {0};
+    for (int i = 0; i < want; i++) {
+        uint8_t buf[64];
+        int tag, origin, pid, vote;
+        int64_t n = pickup_spin(w, e, &tag, &origin, &pid, &vote, buf,
+                                sizeof buf);
+        RCHECK(n >= 0);
+        RCHECK(tag == RLO_TAG_IAR_DECISION && vote == 1);
+        RCHECK(pid >= 0 && pid < 256 && !seen[pid]);
+        seen[pid] = 1;
+    }
+    if (am_proposer) {
+        RCHECK(proposal_spin(w, e) == 0);
+        RCHECK(rlo_vote_my_proposal(e) == 1);
+    }
+    RCHECK(rlo_drain(w, DRAIN_SPINS) >= 0);
+    RCHECK(rlo_engine_err(e) == RLO_OK);
+    rlo_engine_free(e);
+    return 0;
+}
+
+/* ------------------------------------------------------------------ */
+
+typedef struct demo_case {
+    const char *name;
+    rlo_rank_fn fn;
+} demo_case;
+
+static const demo_case CASES[] = {
+    {"bcast", case_bcast},   {"wrapper", case_wrapper},
+    {"hacky", case_hacky},   {"iar", case_iar},
+    {"iar2", case_iar2},     {"multi", case_multi},
+};
+#define N_CASES (int)(sizeof CASES / sizeof *CASES)
+
+int main(int argc, char **argv)
+{
+    int ws = 8;
+    const char *which = "all";
+    demo_cfg cfg = {.msgs = 16, .veto = -1, .verbose = 0};
+    for (int i = 1; i < argc; i++) {
+        if (!strcmp(argv[i], "-n") && i + 1 < argc)
+            ws = atoi(argv[++i]);
+        else if (!strcmp(argv[i], "-c") && i + 1 < argc)
+            which = argv[++i];
+        else if (!strcmp(argv[i], "-m") && i + 1 < argc)
+            cfg.msgs = atoi(argv[++i]);
+        else if (!strcmp(argv[i], "-veto") && i + 1 < argc)
+            cfg.veto = atoi(argv[++i]);
+        else if (!strcmp(argv[i], "-v"))
+            cfg.verbose = 1;
+        else {
+            fprintf(stderr,
+                    "usage: %s [-n ranks] [-c case|all] [-m msgs] "
+                    "[-veto rank] [-v]\ncases:",
+                    argv[0]);
+            for (int c = 0; c < N_CASES; c++)
+                fprintf(stderr, " %s", CASES[c].name);
+            fprintf(stderr, "\n");
+            return 2;
+        }
+    }
+    int failures = 0, matched = 0;
+    for (int c = 0; c < N_CASES; c++) {
+        if (strcmp(which, "all") && strcmp(which, CASES[c].name))
+            continue;
+        matched++;
+        /* iar additionally runs the dissent variant (reference
+         * parameterized agree/disagree, testcases.c:243-332) */
+        int reps = !strcmp(CASES[c].name, "iar") && cfg.veto < 0 ? 2 : 1;
+        for (int rep = 0; rep < reps; rep++) {
+            demo_cfg run = cfg;
+            if (reps == 2 && rep == 1)
+                run.veto = ws - 1;
+            uint64_t t0 = rlo_now_usec();
+            int rc = rlo_shm_launch(ws, 0, CASES[c].fn, &run);
+            printf("%-8s n=%-3d %s (%llu usec)%s\n", CASES[c].name, ws,
+                   rc == 0 ? "PASS" : "FAIL",
+                   (unsigned long long)(rlo_now_usec() - t0),
+                   reps == 2 && rep == 1 ? " [veto]" : "");
+            if (rc != 0)
+                failures++;
+        }
+    }
+    if (!matched) {
+        fprintf(stderr, "unknown case '%s'\n", which);
+        return 2;
+    }
+    if (failures)
+        fprintf(stderr, "%d case(s) FAILED\n", failures);
+    return failures ? 1 : 0;
+}

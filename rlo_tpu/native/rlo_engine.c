@@ -204,6 +204,9 @@ rlo_engine *rlo_engine_new(rlo_world *w, int rank, int comm,
 {
     if (!w || rank < 0 || rank >= rlo_world_size(w))
         return 0;
+    /* one-process-per-rank transports (shm/mpi) bind the world to a rank */
+    if (rlo_world_my_rank(w) >= 0 && rank != rlo_world_my_rank(w))
+        return 0;
     rlo_engine *e = (rlo_engine *)calloc(1, sizeof(*e));
     if (!e)
         return 0;

@@ -1,4 +1,12 @@
-/* Internal structures shared between the loopback world and the engine. */
+/* Internal structures shared between transport worlds and the engine.
+ *
+ * The world is polymorphic — a transport vtable (SURVEY.md §7 "transport
+ * vtable" design stance): the engine only ever talks through
+ * rlo_world_isend / rlo_world_poll / rlo_world_register, and each
+ * transport (in-process loopback, POSIX-SHM multi-process, compile-gated
+ * MPI) supplies the ops. This is the seam the reference lacks — its MPI
+ * calls are hard-wired throughout rootless_ops.c (SURVEY.md §2 C11).
+ */
 #ifndef RLO_INTERNAL_H
 #define RLO_INTERNAL_H
 
@@ -41,14 +49,48 @@ typedef struct rlo_wire_node {
     uint8_t data[]; /* encoded frame */
 } rlo_wire_node;
 
-/* World-side transport API used by the engine. */
+/* ---- transport vtable ---- */
+typedef struct rlo_transport_ops {
+    const char *name;
+    int (*isend)(rlo_world *w, int src, int dst, int comm, int tag,
+                 const uint8_t *raw, int64_t len, rlo_handle **out);
+    /* next frame addressed to (rank, comm), or NULL; caller owns it */
+    rlo_wire_node *(*poll)(rlo_world *w, int rank, int comm);
+    int (*quiescent)(const rlo_world *w);
+    int64_t (*sent_cnt)(const rlo_world *w);
+    int64_t (*delivered_cnt)(const rlo_world *w);
+    /* transport-specific termination detection (reference cleanup drain,
+     * rootless_ops.c:1613-1625); collective for multi-process transports */
+    int (*drain)(rlo_world *w, int max_spins);
+    /* 1 when the world is dead (a peer process failed); NULL = never */
+    int (*failed)(const rlo_world *w);
+    void (*free_)(rlo_world *w);
+} rlo_transport_ops;
+
+/* Base world: first member of every transport's world struct. */
+struct rlo_world {
+    const rlo_transport_ops *ops;
+    int world_size;
+    int my_rank; /* bound rank for one-process-per-rank transports; -1 =
+                    this process hosts every rank (loopback) */
+    rlo_engine **engines;
+    int n_engines, cap_engines;
+    int stepping; /* re-entrancy guard for rlo_progress_all */
+};
+
+/* World-side transport API used by the engine (dispatch wrappers in
+ * rlo_world_common.c). */
 int rlo_world_isend(rlo_world *w, int src, int dst, int comm, int tag,
                     const uint8_t *raw, int64_t len, rlo_handle **out);
 rlo_wire_node *rlo_world_poll(rlo_world *w, int rank, int comm);
 int rlo_world_register(rlo_world *w, rlo_engine *e);
 void rlo_world_unregister(rlo_world *w, rlo_engine *e);
 
-/* Engine-side hook the world's progress loop drives. */
+/* Engine-side hooks the world's progress loop drives. */
 void rlo_engine_progress_once(rlo_engine *e);
+
+/* Drain loop for transports whose quiescent() is globally accurate from
+ * one process. */
+int rlo_drain_local(rlo_world *w, int max_spins);
 
 #endif /* RLO_INTERNAL_H */

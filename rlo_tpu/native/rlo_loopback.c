@@ -19,8 +19,8 @@ typedef struct rlo_channel {
     rlo_wire_node *head, *tail;
 } rlo_channel;
 
-struct rlo_world {
-    int world_size;
+typedef struct rlo_loop_world {
+    rlo_world base;
     int latency;
     uint64_t rng;
     uint64_t tick;
@@ -28,10 +28,7 @@ struct rlo_world {
     rlo_channel *channels;
     rlo_wire_node **inbox_head; /* per-rank delivered FIFO */
     rlo_wire_node **inbox_tail;
-    rlo_engine **engines;
-    int n_engines, cap_engines;
-    int stepping; /* re-entrancy guard for rlo_progress_all */
-};
+} rlo_loop_world;
 
 static uint64_t xorshift64(uint64_t *s)
 {
@@ -42,39 +39,15 @@ static uint64_t xorshift64(uint64_t *s)
     return *s = x;
 }
 
-rlo_world *rlo_world_new(int world_size, int latency, uint64_t seed)
-{
-    if (world_size < 2) /* reference rejects at bcomm_init :1464 */
-        return 0;
-    rlo_world *w = (rlo_world *)calloc(1, sizeof(*w));
-    if (!w)
-        return 0;
-    w->world_size = world_size;
-    w->latency = latency;
-    w->rng = seed ? seed : 0x9e3779b97f4a7c15ull;
-    w->inbox_head =
-        (rlo_wire_node **)calloc((size_t)world_size, sizeof(void *));
-    w->inbox_tail =
-        (rlo_wire_node **)calloc((size_t)world_size, sizeof(void *));
-    if (!w->inbox_head || !w->inbox_tail) {
-        free(w->inbox_head);
-        free(w->inbox_tail);
-        free(w);
-        return 0;
-    }
-    return w;
-}
-
 static void free_node(rlo_wire_node *n)
 {
     rlo_handle_unref(n->handle);
     free(n);
 }
 
-void rlo_world_free(rlo_world *w)
+static void loop_free(rlo_world *base)
 {
-    if (!w)
-        return;
+    rlo_loop_world *w = (rlo_loop_world *)base;
     for (rlo_channel *c = w->channels; c;) {
         rlo_channel *nc = c->next;
         for (rlo_wire_node *n = c->head; n;) {
@@ -85,7 +58,7 @@ void rlo_world_free(rlo_world *w)
         free(c);
         c = nc;
     }
-    for (int r = 0; r < w->world_size; r++) {
+    for (int r = 0; r < base->world_size; r++) {
         for (rlo_wire_node *n = w->inbox_head[r]; n;) {
             rlo_wire_node *nn = n->next;
             free_node(n);
@@ -94,37 +67,33 @@ void rlo_world_free(rlo_world *w)
     }
     free(w->inbox_head);
     free(w->inbox_tail);
-    free(w->engines);
+    free(base->engines);
     free(w);
 }
 
-int rlo_world_size(const rlo_world *w)
+static int64_t loop_sent(const rlo_world *base)
 {
-    return w->world_size;
+    return ((const rlo_loop_world *)base)->sent_cnt;
 }
 
-int64_t rlo_world_sent_cnt(const rlo_world *w)
+static int64_t loop_delivered(const rlo_world *base)
 {
-    return w->sent_cnt;
+    return ((const rlo_loop_world *)base)->delivered_cnt;
 }
 
-int64_t rlo_world_delivered_cnt(const rlo_world *w)
+static int loop_quiescent(const rlo_world *base)
 {
-    return w->delivered_cnt;
-}
-
-int rlo_world_quiescent(const rlo_world *w)
-{
+    const rlo_loop_world *w = (const rlo_loop_world *)base;
     for (const rlo_channel *c = w->channels; c; c = c->next)
         if (c->head)
             return 0;
-    for (int r = 0; r < w->world_size; r++)
+    for (int r = 0; r < base->world_size; r++)
         if (w->inbox_head[r])
             return 0;
     return 1;
 }
 
-static void inbox_push(rlo_world *w, rlo_wire_node *n)
+static void inbox_push(rlo_loop_world *w, rlo_wire_node *n)
 {
     n->next = 0;
     if (w->inbox_tail[n->dst])
@@ -136,7 +105,8 @@ static void inbox_push(rlo_world *w, rlo_wire_node *n)
     w->delivered_cnt++;
 }
 
-static rlo_channel *get_channel(rlo_world *w, int src, int dst, int comm)
+static rlo_channel *get_channel(rlo_loop_world *w, int src, int dst,
+                                int comm)
 {
     for (rlo_channel *c = w->channels; c; c = c->next)
         if (c->src == src && c->dst == dst && c->comm == comm)
@@ -152,10 +122,11 @@ static rlo_channel *get_channel(rlo_world *w, int src, int dst, int comm)
     return c;
 }
 
-int rlo_world_isend(rlo_world *w, int src, int dst, int comm, int tag,
-                    const uint8_t *raw, int64_t len, rlo_handle **out)
+static int loop_isend(rlo_world *base, int src, int dst, int comm, int tag,
+                      const uint8_t *raw, int64_t len, rlo_handle **out)
 {
-    if (dst < 0 || dst >= w->world_size || len < 0)
+    rlo_loop_world *w = (rlo_loop_world *)base;
+    if (dst < 0 || dst >= base->world_size || len < 0)
         return RLO_ERR_ARG;
     int caller_tracks = out != 0;
     rlo_handle *h = rlo_handle_new(caller_tracks ? 2 : 1);
@@ -199,7 +170,7 @@ int rlo_world_isend(rlo_world *w, int src, int dst, int comm, int tag,
 
 /* Move every due channel head to its inbox. Only heads can become due,
  * which preserves per-channel FIFO under latency injection. */
-static void pump(rlo_world *w)
+static void pump(rlo_loop_world *w)
 {
     w->tick++;
     for (rlo_channel *c = w->channels; c; c = c->next) {
@@ -213,8 +184,9 @@ static void pump(rlo_world *w)
     }
 }
 
-rlo_wire_node *rlo_world_poll(rlo_world *w, int rank, int comm)
+static rlo_wire_node *loop_poll(rlo_world *base, int rank, int comm)
 {
+    rlo_loop_world *w = (rlo_loop_world *)base;
     pump(w);
     rlo_wire_node *prev = 0;
     for (rlo_wire_node *n = w->inbox_head[rank]; n;
@@ -233,74 +205,38 @@ rlo_wire_node *rlo_world_poll(rlo_world *w, int rank, int comm)
     return 0;
 }
 
-int rlo_world_register(rlo_world *w, rlo_engine *e)
-{
-    if (w->n_engines == w->cap_engines) {
-        int cap = w->cap_engines ? w->cap_engines * 2 : 8;
-        rlo_engine **p = (rlo_engine **)realloc(
-            w->engines, (size_t)cap * sizeof(void *));
-        if (!p)
-            return RLO_ERR_NOMEM;
-        w->engines = p;
-        w->cap_engines = cap;
-    }
-    w->engines[w->n_engines++] = e;
-    return RLO_OK;
-}
+static const rlo_transport_ops LOOP_OPS = {
+    .name = "loopback",
+    .isend = loop_isend,
+    .poll = loop_poll,
+    .quiescent = loop_quiescent,
+    .sent_cnt = loop_sent,
+    .delivered_cnt = loop_delivered,
+    .drain = rlo_drain_local,
+    .free_ = loop_free,
+};
 
-void rlo_world_unregister(rlo_world *w, rlo_engine *e)
+rlo_world *rlo_world_new(int world_size, int latency, uint64_t seed)
 {
-    for (int i = 0; i < w->n_engines; i++) {
-        if (w->engines[i] == e) {
-            memmove(&w->engines[i], &w->engines[i + 1],
-                    (size_t)(w->n_engines - i - 1) * sizeof(void *));
-            w->n_engines--;
-            return;
-        }
+    if (world_size < 2) /* reference rejects at bcomm_init :1464 */
+        return 0;
+    rlo_loop_world *w = (rlo_loop_world *)calloc(1, sizeof(*w));
+    if (!w)
+        return 0;
+    w->base.ops = &LOOP_OPS;
+    w->base.world_size = world_size;
+    w->base.my_rank = -1; /* hosts every rank */
+    w->latency = latency;
+    w->rng = seed ? seed : 0x9e3779b97f4a7c15ull;
+    w->inbox_head =
+        (rlo_wire_node **)calloc((size_t)world_size, sizeof(void *));
+    w->inbox_tail =
+        (rlo_wire_node **)calloc((size_t)world_size, sizeof(void *));
+    if (!w->inbox_head || !w->inbox_tail) {
+        free(w->inbox_head);
+        free(w->inbox_tail);
+        free(w);
+        return 0;
     }
-}
-
-void rlo_progress_all(rlo_world *w)
-{
-    /* handlers may initiate broadcasts (decision bcast inside the vote
-     * handler) which re-enter; make nested turns no-ops (mirrors
-     * EngineManager._stepping, rlo_tpu/engine.py) */
-    if (w->stepping)
-        return;
-    w->stepping = 1;
-    /* step over a snapshot: callbacks may register/unregister engines
-     * mid-turn (the Python side iterates a copy for the same reason) */
-    int n = w->n_engines;
-    rlo_engine **snap =
-        (rlo_engine **)malloc((size_t)(n ? n : 1) * sizeof(void *));
-    if (snap) {
-        memcpy(snap, w->engines, (size_t)n * sizeof(void *));
-        for (int i = 0; i < n; i++) {
-            /* skip engines freed by an earlier engine's callback */
-            int live = 0;
-            for (int j = 0; j < w->n_engines; j++)
-                if (w->engines[j] == snap[i])
-                    live = 1;
-            if (live)
-                rlo_engine_progress_once(snap[i]);
-        }
-        free(snap);
-    }
-    w->stepping = 0;
-}
-
-int rlo_drain(rlo_world *w, int max_spins)
-{
-    for (int i = 0; i < max_spins; i++) {
-        rlo_progress_all(w);
-        if (rlo_world_quiescent(w)) {
-            int idle = 1;
-            for (int j = 0; j < w->n_engines; j++)
-                if (!rlo_engine_idle(w->engines[j]))
-                    idle = 0;
-            if (idle)
-                return i;
-        }
-    }
-    return RLO_ERR_STALL;
+    return &w->base;
 }

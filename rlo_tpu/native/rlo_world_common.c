@@ -1,0 +1,144 @@
+/* Transport-independent world machinery: engine registry, cooperative
+ * progress loop, and dispatch to the transport vtable.
+ *
+ * The registry + progress loop mirror the reference's EngineManager /
+ * Active_Engines / RLO_make_progress_all (rootless_ops.c:33-47, 407-466,
+ * 538-549): multiple engines may live on one world (each on its own comm
+ * id — the analogue of the dup'ed MPI communicator per engine,
+ * rootless_ops.c:1461), and one progress turn steps all of them so
+ * engines co-progress each other (testcases.c:110-241 relies on this).
+ */
+#include "rlo_internal.h"
+
+int rlo_world_size(const rlo_world *w)
+{
+    return w->world_size;
+}
+
+int rlo_world_my_rank(const rlo_world *w)
+{
+    return w->my_rank;
+}
+
+const char *rlo_world_transport(const rlo_world *w)
+{
+    return w->ops->name;
+}
+
+int64_t rlo_world_sent_cnt(const rlo_world *w)
+{
+    return w->ops->sent_cnt(w);
+}
+
+int64_t rlo_world_delivered_cnt(const rlo_world *w)
+{
+    return w->ops->delivered_cnt(w);
+}
+
+int rlo_world_quiescent(const rlo_world *w)
+{
+    return w->ops->quiescent(w);
+}
+
+int rlo_world_failed(const rlo_world *w)
+{
+    return w->ops->failed ? w->ops->failed(w) : 0;
+}
+
+void rlo_world_free(rlo_world *w)
+{
+    if (!w)
+        return;
+    w->ops->free_(w);
+}
+
+int rlo_world_isend(rlo_world *w, int src, int dst, int comm, int tag,
+                    const uint8_t *raw, int64_t len, rlo_handle **out)
+{
+    return w->ops->isend(w, src, dst, comm, tag, raw, len, out);
+}
+
+rlo_wire_node *rlo_world_poll(rlo_world *w, int rank, int comm)
+{
+    return w->ops->poll(w, rank, comm);
+}
+
+int rlo_world_register(rlo_world *w, rlo_engine *e)
+{
+    if (w->n_engines == w->cap_engines) {
+        int cap = w->cap_engines ? w->cap_engines * 2 : 8;
+        rlo_engine **p = (rlo_engine **)realloc(
+            w->engines, (size_t)cap * sizeof(void *));
+        if (!p)
+            return RLO_ERR_NOMEM;
+        w->engines = p;
+        w->cap_engines = cap;
+    }
+    w->engines[w->n_engines++] = e;
+    return RLO_OK;
+}
+
+void rlo_world_unregister(rlo_world *w, rlo_engine *e)
+{
+    for (int i = 0; i < w->n_engines; i++) {
+        if (w->engines[i] == e) {
+            memmove(&w->engines[i], &w->engines[i + 1],
+                    (size_t)(w->n_engines - i - 1) * sizeof(void *));
+            w->n_engines--;
+            return;
+        }
+    }
+}
+
+void rlo_progress_all(rlo_world *w)
+{
+    /* handlers may initiate broadcasts (decision bcast inside the vote
+     * handler) which re-enter; make nested turns no-ops (mirrors
+     * EngineManager._stepping, rlo_tpu/engine.py) */
+    if (w->stepping)
+        return;
+    w->stepping = 1;
+    /* step over a snapshot: callbacks may register/unregister engines
+     * mid-turn (the Python side iterates a copy for the same reason) */
+    int n = w->n_engines;
+    rlo_engine **snap =
+        (rlo_engine **)malloc((size_t)(n ? n : 1) * sizeof(void *));
+    if (snap) {
+        memcpy(snap, w->engines, (size_t)n * sizeof(void *));
+        for (int i = 0; i < n; i++) {
+            /* skip engines freed by an earlier engine's callback */
+            int live = 0;
+            for (int j = 0; j < w->n_engines; j++)
+                if (w->engines[j] == snap[i])
+                    live = 1;
+            if (live)
+                rlo_engine_progress_once(snap[i]);
+        }
+        free(snap);
+    }
+    w->stepping = 0;
+}
+
+int rlo_drain(rlo_world *w, int max_spins)
+{
+    return w->ops->drain(w, max_spins);
+}
+
+/* Shared single-process drain loop used by transports whose quiescent()
+ * predicate is globally accurate from one process (loopback; MPI uses its
+ * own collective protocol). */
+int rlo_drain_local(rlo_world *w, int max_spins)
+{
+    for (int i = 0; i < max_spins; i++) {
+        rlo_progress_all(w);
+        if (rlo_world_quiescent(w)) {
+            int idle = 1;
+            for (int j = 0; j < w->n_engines; j++)
+                if (!rlo_engine_idle(w->engines[j]))
+                    idle = 0;
+            if (idle)
+                return i;
+        }
+    }
+    return RLO_ERR_STALL;
+}

@@ -1,0 +1,55 @@
+"""Multi-process SHM transport: run the native demo binary end-to-end.
+
+The demo is the framework's `mpirun -n N ./demo` analogue (reference
+Makefile:5, testcases.c:742-780): rlo_shm_launch forks N real OS
+processes that communicate through SPSC shared-memory rings, replicating
+the reference integration scenarios (SURVEY.md §4) with their
+behavior-level oracles. pytest drives the binary the way the reference
+suite is driven by mpirun.
+"""
+
+import subprocess
+from pathlib import Path
+
+import pytest
+
+NATIVE = Path(__file__).resolve().parent.parent / "rlo_tpu" / "native"
+
+
+@pytest.fixture(scope="module")
+def demo_bin():
+    subprocess.run(["make", "demo"], cwd=NATIVE, check=True,
+                   capture_output=True)
+    return NATIVE / "rlo_demo"
+
+
+def run_demo(demo_bin, *args, timeout=300):
+    proc = subprocess.run([str(demo_bin), *map(str, args)],
+                          capture_output=True, text=True, timeout=timeout)
+    assert proc.returncode == 0, (
+        f"demo failed (rc={proc.returncode})\n"
+        f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr}")
+    return proc.stdout
+
+
+@pytest.mark.parametrize("ws", [2, 3, 5, 8])
+def test_all_cases(demo_bin, ws):
+    out = run_demo(demo_bin, "-n", ws, "-m", 8)
+    assert "FAIL" not in out
+    # one PASS line per case (+1: iar runs agree and veto variants)
+    assert out.count("PASS") == 7
+
+
+def test_bcast_many_messages(demo_bin):
+    out = run_demo(demo_bin, "-n", 6, "-c", "bcast", "-m", 200)
+    assert out.count("PASS") == 1
+
+
+def test_explicit_veto_rank(demo_bin):
+    out = run_demo(demo_bin, "-n", 8, "-c", "iar", "-veto", 3)
+    assert out.count("PASS") == 1
+
+
+def test_nonpow2_stress(demo_bin):
+    out = run_demo(demo_bin, "-n", 13, "-c", "hacky", "-m", 32)
+    assert out.count("PASS") == 1
