@@ -21,3 +21,4 @@ Capability map (reference -> here; modules land incrementally, topology first):
 __version__ = "0.1.0"
 
 from rlo_tpu import topology  # noqa: F401
+from rlo_tpu.backend import init  # noqa: F401  (ROOTLESS_BACKEND switch)

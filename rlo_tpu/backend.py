@@ -1,0 +1,546 @@
+"""ROOTLESS_BACKEND runtime switch — one facade over every transport.
+
+The north star (BASELINE.json) requires a `ROOTLESS_BACKEND={mpi,tpu}`
+switch at init that picks the execution backend while the op surface
+stays the same, the way the reference's testcases would run unmodified
+on either a CPU MPI cluster or a TPU pod. `init()` resolves the backend
+from its argument, then the ROOTLESS_BACKEND environment variable, then
+autodetection, and returns a facade with a uniform single-controller op
+surface:
+
+    bcast(origin, x)         rootless broadcast      (~RLO_bcast_gen)
+    consensus(votes)         leaderless IAR decision (~RLO_submit_proposal)
+    allreduce(xs, op=...)    data collectives        (net-new, BASELINE)
+    reduce_scatter(xs, op=...)
+    all_gather(xs)
+    barrier()
+
+Per-rank data is passed/returned as a list with one numpy array per rank
+(on the TPU backend the list maps onto mesh devices). Backends:
+
+  tpu       jax shard_map + static ppermute schedules + Pallas combine
+            (rlo_tpu.ops.tpu_collectives) over a device mesh
+  loopback  pure-Python engines + coroutine collectives over the
+            in-process loopback transport (deterministic, fuzzable)
+  native    the C core (rlo_tpu/native) through ctypes; data collectives
+            run as bcast-gather over the rootless broadcast overlay —
+            the reference's "IAllReduce" spirit generalized to tensors
+  shm       C-only multi-process transport; from Python use the
+            rlo_demo binary (rlo_tpu/native/rlo_demo.c)
+  mpi       compile-gated MPI transport (rlo_mpi.c); available only in
+            builds where mpi.h exists, under mpirun
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+_FACTORIES: Dict[str, Callable] = {}
+
+
+def _register(name: str):
+    def deco(cls):
+        _FACTORIES[name] = cls
+        return cls
+    return deco
+
+
+def init(backend: Optional[str] = None, world_size: Optional[int] = None,
+         **kwargs):
+    """Create a backend facade. Resolution order: argument >
+    $ROOTLESS_BACKEND > auto (tpu when a TPU/multi-device jax backend is
+    live, else loopback)."""
+    name = backend or os.environ.get("ROOTLESS_BACKEND") or _auto_backend()
+    try:
+        factory = _FACTORIES[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown ROOTLESS_BACKEND {name!r}; "
+            f"known: {sorted(_FACTORIES)}") from None
+    return factory(world_size=world_size, **kwargs)
+
+
+def _auto_backend() -> str:
+    try:
+        import jax
+        if jax.default_backend() == "tpu" or len(jax.devices()) > 1:
+            return "tpu"
+    except Exception:
+        pass
+    return "loopback"
+
+
+class Backend:
+    """Uniform single-controller op surface; see module docstring."""
+
+    name: str
+    world_size: int
+
+    def bcast(self, origin: int, x: np.ndarray) -> List[np.ndarray]:
+        raise NotImplementedError
+
+    def consensus(self, votes: Sequence[int]) -> int:
+        raise NotImplementedError
+
+    def allreduce(self, xs: Sequence[np.ndarray],
+                  op: str = "sum") -> List[np.ndarray]:
+        raise NotImplementedError
+
+    def reduce_scatter(self, xs: Sequence[np.ndarray],
+                       op: str = "sum") -> List[np.ndarray]:
+        raise NotImplementedError
+
+    def all_gather(self, xs: Sequence[np.ndarray]) -> List[np.ndarray]:
+        raise NotImplementedError
+
+    def barrier(self) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def _check_xs(self, xs) -> List[np.ndarray]:
+        xs = [np.asarray(x) for x in xs]
+        if len(xs) != self.world_size:
+            raise ValueError(
+                f"need one array per rank ({self.world_size}), got "
+                f"{len(xs)}")
+        return xs
+
+
+@_register("tpu")
+class TpuBackend(Backend):
+    """Static-schedule XLA collectives over a jax device mesh."""
+
+    name = "tpu"
+
+    def __init__(self, world_size: Optional[int] = None, **kwargs):
+        import jax
+        from jax.sharding import PartitionSpec as P
+        from rlo_tpu.parallel.mesh import make_mesh, shard_jit
+        from rlo_tpu.ops import tpu_collectives as tc
+
+        n_dev = len(jax.devices())
+        ws = world_size or n_dev
+        if ws > n_dev:
+            raise ValueError(f"world_size {ws} > {n_dev} devices")
+        self.world_size = ws
+        self.mesh = make_mesh((ws,), ("x",))
+        self._P = P
+        self._tc = tc
+        self._shard_jit = shard_jit
+        self._cache: Dict = {}
+
+    def _op(self, key, fn):
+        if key not in self._cache:
+            P = self._P
+            self._cache[key] = self._shard_jit(
+                fn, self.mesh, (P("x"),), P("x"))
+        return self._cache[key]
+
+    def _run(self, key, fn, xs):
+        xs = self._check_xs(xs)
+        stacked = np.stack(xs)
+        out = np.asarray(self._op(key, fn)(stacked))
+        return [out[i] for i in range(self.world_size)]
+
+    def bcast(self, origin: int, x: np.ndarray) -> List[np.ndarray]:
+        tc = self._tc
+        x = np.asarray(x)
+        xs = [x if r == origin else np.zeros_like(x)
+              for r in range(self.world_size)]
+        return self._run(("bcast", int(origin), x.shape, str(x.dtype)),
+                         lambda v: tc.rootless_bcast(
+                             v, origin=int(origin), axis="x"), xs)
+
+    def consensus(self, votes: Sequence[int]) -> int:
+        tc = self._tc
+        xs = [np.asarray([int(v)], np.int32) for v in votes]
+        out = self._run(("consensus",), lambda v: tc.consensus(v, "x"), xs)
+        return int(out[0][0])
+
+    def allreduce(self, xs, op: str = "sum") -> List[np.ndarray]:
+        tc = self._tc
+        shape = np.asarray(xs[0]).shape
+        dt = str(np.asarray(xs[0]).dtype)
+        return self._run(("allreduce", op, shape, dt),
+                         lambda v: tc.allreduce(v, "x", op=op), xs)
+
+    def reduce_scatter(self, xs, op: str = "sum") -> List[np.ndarray]:
+        # v arrives as this shard's (1, ...) slice of the stacked input;
+        # the op changes the per-shard shape, so drop the stacked dim
+        # going in and restore it coming out to keep out_specs=P("x")
+        # reassembling one row per rank
+        tc = self._tc
+        shape = np.asarray(xs[0]).shape
+        dt = str(np.asarray(xs[0]).dtype)
+        return self._run(("reduce_scatter", op, shape, dt),
+                         lambda v: tc.reduce_scatter(
+                             v[0], "x", op=op)[None], xs)
+
+    def all_gather(self, xs) -> List[np.ndarray]:
+        shape = np.asarray(xs[0]).shape
+        dt = str(np.asarray(xs[0]).dtype)
+        tc = self._tc
+        return self._run(("all_gather", shape, dt),
+                         lambda v: tc.all_gather(v[0], "x")[None], xs)
+
+    def barrier(self) -> None:
+        tc = self._tc
+        self._run(("barrier",),
+                  lambda v: v + tc.barrier("x"),
+                  [np.zeros((1,), np.int32)] * self.world_size)
+
+
+@_register("loopback")
+class LoopbackBackend(Backend):
+    """Pure-Python engines + coroutine collectives, one process."""
+
+    name = "loopback"
+
+    def __init__(self, world_size: Optional[int] = None, latency: int = 0,
+                 seed: Optional[int] = None, **kwargs):
+        from rlo_tpu.engine import ProgressEngine, EngineManager, drain
+        from rlo_tpu.transport.loopback import LoopbackWorld
+        from rlo_tpu.ops.collectives import Comm, run_collectives
+
+        self.world_size = world_size or 4
+        # engines and data collectives ride separate worlds — the
+        # analogue of the reference's dup'ed communicator per engine
+        self._eng_world = LoopbackWorld(self.world_size, latency, seed)
+        self._coll_world = LoopbackWorld(self.world_size, latency, seed)
+        self._manager = EngineManager()
+        self._engines = [
+            ProgressEngine(self._eng_world.transport(r),
+                           manager=self._manager)
+            for r in range(self.world_size)]
+        self._comms = [Comm(self._coll_world.transport(r))
+                       for r in range(self.world_size)]
+        self._run = run_collectives
+        self._drain = drain
+
+    def bcast(self, origin: int, x: np.ndarray) -> List[np.ndarray]:
+        from rlo_tpu.ops.collectives import _pack_array, _unpack_array
+        x = np.asarray(x)
+        self._engines[origin].bcast(_pack_array(x))
+        self._drain([self._eng_world], self._engines)
+        out: List[Optional[np.ndarray]] = [None] * self.world_size
+        for r, e in enumerate(self._engines):
+            if r == origin:
+                out[r] = x.copy()
+                continue
+            msg = e.pickup_next()
+            assert msg is not None, f"rank {r} missed the broadcast"
+            out[r] = _unpack_array(msg.data)
+        return out
+
+    def consensus(self, votes: Sequence[int]) -> int:
+        votes = list(votes)
+        if len(votes) != self.world_size:
+            raise ValueError("need one vote per rank")
+        # judge callback: each rank votes its slot (reference judgement
+        # cb, rootless_ops.h:77); proposer = rank 0. A fresh world so
+        # the consensus engines never steal the facade engines' traffic.
+        from rlo_tpu.engine import ProgressEngine, EngineManager
+        from rlo_tpu.transport.loopback import LoopbackWorld
+
+        world = LoopbackWorld(self.world_size)
+        mgr = EngineManager()
+        engines = [ProgressEngine(
+            world.transport(r),
+            judge_cb=lambda payload, ctx, r=r: votes[r],
+            manager=mgr) for r in range(self.world_size)]
+        try:
+            engines[0].submit_proposal(b"facade", pid=0)
+            for _ in range(1_000_000):
+                mgr.progress_all()
+                if engines[0].vote_my_proposal() != -1:
+                    break
+            decision = engines[0].vote_my_proposal()
+            assert decision != -1, "consensus did not complete"
+            self._drain([world], engines)
+            return int(decision)
+        finally:
+            for e in engines:
+                e.cleanup()
+
+    def _collective(self, method: str, xs, **kw) -> List[np.ndarray]:
+        xs = self._check_xs(xs)
+        coros = [getattr(c, method)(x, **kw)
+                 for c, x in zip(self._comms, xs)]
+        return self._run(coros)
+
+    def allreduce(self, xs, op: str = "sum") -> List[np.ndarray]:
+        return self._collective("allreduce", xs, op=op)
+
+    def reduce_scatter(self, xs, op: str = "sum") -> List[np.ndarray]:
+        return self._collective("reduce_scatter", xs, op=op)
+
+    def all_gather(self, xs) -> List[np.ndarray]:
+        shape = np.asarray(xs[0]).shape
+        outs = self._collective("all_gather", xs)
+        # Comm.all_gather concatenates along axis 0; the facade contract
+        # (matching lax.all_gather) stacks along a new leading axis
+        return [o.reshape((self.world_size,) + shape) for o in outs]
+
+    def barrier(self) -> None:
+        self._run([c.barrier() for c in self._comms])
+
+    def close(self) -> None:
+        for e in self._engines:
+            e.cleanup()
+
+
+@_register("native")
+class NativeBackend(Backend):
+    """The C core through ctypes. Data collectives run bcast-gather over
+    the rootless broadcast overlay: every rank broadcasts its tensor and
+    reduces what it picks up — the reference's any-rank-initiates
+    "IAllReduce" notion (rootless_ops.c:876) generalized from one vote
+    bit to tensors."""
+
+    name = "native"
+
+    def __init__(self, world_size: Optional[int] = None, latency: int = 0,
+                 seed: int = 1, **kwargs):
+        from rlo_tpu.native.bindings import NativeWorld, NativeEngine
+
+        self.world_size = world_size or 4
+        self.world = NativeWorld(self.world_size, latency, seed)
+        self.engines = [NativeEngine(self.world, r, msg_size_max=1 << 22)
+                        for r in range(self.world_size)]
+
+    def bcast(self, origin: int, x: np.ndarray) -> List[np.ndarray]:
+        from rlo_tpu.ops.collectives import _pack_array, _unpack_array
+        x = np.asarray(x)
+        self.engines[origin].bcast(_pack_array(x))
+        self.world.drain()
+        out: List[Optional[np.ndarray]] = [None] * self.world_size
+        for r, e in enumerate(self.engines):
+            if r == origin:
+                out[r] = x.copy()
+                continue
+            msg = e.pickup_next()
+            assert msg is not None, f"rank {r} missed the broadcast"
+            out[r] = _unpack_array(msg.data)
+        return out
+
+    def consensus(self, votes: Sequence[int]) -> int:
+        from rlo_tpu.native.bindings import NativeWorld, NativeEngine
+
+        votes = list(votes)
+        if len(votes) != self.world_size:
+            raise ValueError("need one vote per rank")
+        world = NativeWorld(self.world_size)
+        try:
+            engines = [NativeEngine(
+                world, r, judge_cb=lambda payload, ctx, r=r: votes[r])
+                for r in range(self.world_size)]
+            rc = engines[0].submit_proposal(b"facade", pid=0)
+            if rc == -1:
+                world.drain()
+                rc = engines[0].vote_my_proposal()
+            assert rc in (0, 1), f"consensus incomplete ({rc})"
+            world.drain()
+            return int(rc)
+        finally:
+            world.close()
+
+    def _bcast_gather(self, xs) -> List[List[np.ndarray]]:
+        """Every rank broadcasts its tensor; returns per-rank lists of
+        all world_size tensors in origin order."""
+        from rlo_tpu.ops.collectives import _pack_array, _unpack_array
+        xs = self._check_xs(xs)
+        for r, e in enumerate(self.engines):
+            e.bcast(_pack_array(xs[r]))
+        self.world.drain()
+        out: List[List[Optional[np.ndarray]]] = []
+        for r, e in enumerate(self.engines):
+            got: List[Optional[np.ndarray]] = [None] * self.world_size
+            got[r] = xs[r]
+            while True:
+                msg = e.pickup_next()
+                if msg is None:
+                    break
+                got[msg.origin] = _unpack_array(msg.data)
+            assert all(g is not None for g in got), \
+                f"rank {r} missed a broadcast"
+            out.append(got)
+        return out
+
+    def allreduce(self, xs, op: str = "sum") -> List[np.ndarray]:
+        from rlo_tpu.ops.collectives import OPS
+        fn = OPS[op]
+        gathered = self._bcast_gather(xs)
+        outs = []
+        for got in gathered:
+            acc = got[0].copy()
+            for g in got[1:]:
+                acc = fn(acc, g)
+            outs.append(acc)
+        return outs
+
+    def reduce_scatter(self, xs, op: str = "sum") -> List[np.ndarray]:
+        full = self.allreduce(xs, op=op)
+        outs = []
+        for r in range(self.world_size):
+            flat = full[r].reshape(-1)
+            pad = (-flat.size) % self.world_size
+            if pad:
+                flat = np.concatenate(
+                    [flat, np.zeros(pad, flat.dtype)])
+            outs.append(flat.reshape(self.world_size, -1)[r])
+        return outs
+
+    def all_gather(self, xs) -> List[np.ndarray]:
+        gathered = self._bcast_gather(xs)
+        return [np.stack(got) for got in gathered]
+
+    def barrier(self) -> None:
+        self.world.drain()
+
+    def close(self) -> None:
+        self.world.close()
+
+
+@_register("shm")
+class ShmBackend(Backend):
+    """Pointer to the C-only multi-process path."""
+
+    name = "shm"
+
+    def __init__(self, **kwargs):
+        raise RuntimeError(
+            "the shm transport is one-process-per-rank and C-only; run "
+            "scenarios via the native demo binary "
+            "(cd rlo_tpu/native && make demo && ./rlo_demo -n 8), or use "
+            "backend='native' for the in-process C core")
+
+
+@_register("mpi")
+class MpiBackend(Backend):
+    """Per-rank SPMD facade over the compile-gated MPI transport.
+
+    Unlike the single-controller backends above, every MPI process is ONE
+    rank (run under mpirun), so ops take and return this rank's array:
+    ``allreduce(x)`` not ``allreduce([x0, .., xN])``. Collectives run as
+    bcast-gather over the rootless broadcast overlay, like NativeBackend.
+    """
+
+    name = "mpi"
+
+    def __init__(self, world_size: Optional[int] = None, **kwargs):
+        from rlo_tpu.native.bindings import load, NativeWorld, NativeEngine
+        lib = load()
+        if not lib.rlo_mpi_available():
+            raise RuntimeError(
+                "this build has no MPI (mpi.h was absent at compile "
+                "time); rebuild the native core on a host with MPI and "
+                "launch under mpirun. The rlo_mpi.c transport is "
+                "compile-gated on RLO_HAVE_MPI.")
+        w = lib.rlo_mpi_world_new()
+        if not w:
+            raise RuntimeError(
+                "MPI world creation failed (need mpirun with >= 2 ranks)")
+        # adopt the C world into the NativeWorld wrapper so NativeEngine
+        # and drain work unchanged
+        self.world = NativeWorld.__new__(NativeWorld)
+        self.world._lib = lib
+        self.world._w = w
+        self.world.world_size = lib.rlo_world_size(w)
+        self.world.engines = []
+        self.world_size = self.world.world_size
+        self.rank = lib.rlo_world_my_rank(w)
+        # the judge callback reads this rank's current vote (set by
+        # consensus() before each round)
+        self._my_vote = 1
+        self.engine = NativeEngine(
+            self.world, self.rank, msg_size_max=1 << 22,
+            judge_cb=lambda payload, ctx: self._my_vote)
+
+    def _spin_pickup(self, want: int, max_spins: int = 200_000_000):
+        """Progress until `want` messages are picked up; returns them."""
+        got = []
+        for _ in range(max_spins):
+            msg = self.engine.pickup_next()
+            if msg is not None:
+                got.append(msg)
+                if len(got) == want:
+                    return got
+                continue
+            self.world.progress_all()
+        raise RuntimeError(f"rank {self.rank}: expected {want} messages, "
+                           f"got {len(got)}")
+
+    def bcast(self, origin: int, x: Optional[np.ndarray] = None):
+        from rlo_tpu.ops.collectives import _pack_array, _unpack_array
+        if self.rank == origin:
+            self.engine.bcast(_pack_array(np.asarray(x)))
+            self.world.drain()
+            return np.asarray(x)
+        (msg,) = self._spin_pickup(1)
+        self.world.drain()
+        return _unpack_array(msg.data)
+
+    def consensus(self, my_vote: int) -> int:
+        from rlo_tpu.wire import Tag
+        self._my_vote = int(my_vote)  # read by this rank's judge cb
+        if self.rank == 0:
+            rc = self.engine.submit_proposal(b"facade", pid=0)
+            while rc == -1:
+                self.world.progress_all()
+                rc = self.engine.vote_my_proposal()
+            self.world.drain()
+            self.engine.proposal_reset()
+            return int(rc)
+        (msg,) = self._spin_pickup(1)
+        assert msg.type == int(Tag.IAR_DECISION)
+        self.world.drain()
+        return int(msg.vote)
+
+    def allreduce(self, x: np.ndarray, op: str = "sum") -> np.ndarray:
+        from rlo_tpu.ops.collectives import (OPS, _pack_array,
+                                             _unpack_array)
+        x = np.asarray(x)
+        self.engine.bcast(_pack_array(x))
+        msgs = self._spin_pickup(self.world_size - 1)
+        self.world.drain()
+        acc = x.copy()
+        for m in msgs:
+            acc = OPS[op](acc, _unpack_array(m.data))
+        return acc
+
+    def all_gather(self, x: np.ndarray) -> np.ndarray:
+        from rlo_tpu.ops.collectives import _pack_array, _unpack_array
+        x = np.asarray(x)
+        self.engine.bcast(_pack_array(x))
+        msgs = self._spin_pickup(self.world_size - 1)
+        self.world.drain()
+        parts = [None] * self.world_size
+        parts[self.rank] = x
+        for m in msgs:
+            parts[m.origin] = _unpack_array(m.data)
+        return np.stack(parts)
+
+    def reduce_scatter(self, x: np.ndarray, op: str = "sum") -> np.ndarray:
+        full = self.allreduce(x, op=op)
+        flat = full.reshape(-1)
+        pad = (-flat.size) % self.world_size
+        if pad:
+            flat = np.concatenate([flat, np.zeros(pad, flat.dtype)])
+        return flat.reshape(self.world_size, -1)[self.rank]
+
+    def barrier(self) -> None:
+        self.world.drain()
+
+    def close(self) -> None:
+        self.world.close()

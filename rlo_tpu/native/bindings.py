@@ -73,6 +73,11 @@ def load() -> C.CDLL:
     sig("rlo_world_new", p, [C.c_int, C.c_int, C.c_uint64])
     sig("rlo_world_free", None, [p])
     sig("rlo_world_size", C.c_int, [p])
+    sig("rlo_world_my_rank", C.c_int, [p])
+    sig("rlo_world_transport", C.c_char_p, [p])
+    sig("rlo_world_failed", C.c_int, [p])
+    sig("rlo_mpi_available", C.c_int, [])
+    sig("rlo_mpi_world_new", p, [])
     sig("rlo_world_quiescent", C.c_int, [p])
     sig("rlo_world_sent_cnt", C.c_int64, [p])
     sig("rlo_world_delivered_cnt", C.c_int64, [p])

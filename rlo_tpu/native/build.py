@@ -14,7 +14,7 @@ from pathlib import Path
 
 _DIR = Path(__file__).resolve().parent
 SOURCES = ["rlo_topology.c", "rlo_wire.c", "rlo_world_common.c",
-           "rlo_loopback.c", "rlo_shm.c", "rlo_engine.c"]
+           "rlo_loopback.c", "rlo_shm.c", "rlo_mpi.c", "rlo_engine.c"]
 HEADERS = ["rlo_core.h", "rlo_internal.h"]
 LIB_NAME = "librlo_core.so"
 
@@ -31,14 +31,26 @@ def _stale(lib: Path) -> bool:
                for f in SOURCES + HEADERS)
 
 
+def _have_mpi(cc: str) -> bool:
+    """True when a tiny MPI program compiles AND links — a header-only
+    install must not break the whole native-core build with -lmpi."""
+    probe = subprocess.run(
+        [cc, "-xc", "-", "-lmpi", "-o", os.devnull],
+        input="#include <mpi.h>\nint main(void){return MPI_Init(0,0);}\n",
+        capture_output=True, text=True)
+    return probe.returncode == 0
+
+
 def build(force: bool = False) -> Path:
     """Build (if needed) and return the shared-library path."""
     lib = lib_path()
     if not force and not _stale(lib):
         return lib
     cc = os.environ.get("CC", "cc")
+    extra = ["-DRLO_HAVE_MPI", "-lmpi"] if _have_mpi(cc) else []
     cmd = [cc, "-O2", "-g", "-std=c11", "-Wall", "-Wextra", "-fPIC",
-           "-shared", "-o", str(lib)] + [str(_DIR / s) for s in SOURCES]
+           "-shared", "-o", str(lib)] + \
+        [str(_DIR / s) for s in SOURCES] + extra
     proc = subprocess.run(cmd, capture_output=True, text=True)
     if proc.returncode != 0:
         raise RuntimeError(

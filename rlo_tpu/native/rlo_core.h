@@ -146,6 +146,16 @@ int rlo_shm_launch(int world_size, int64_t ring_bytes, rlo_rank_fn fn,
 void rlo_shm_barrier(rlo_world *w);
 
 /* ------------------------------------------------------------------ */
+/* MPI transport: CPU-cluster parity with the reference's backend       */
+/* (nonblocking MPI P2P, rootless_ops.c passim). Compile-gated on       */
+/* RLO_HAVE_MPI — rlo_mpi_available() reports whether this build has    */
+/* it; without it rlo_mpi_world_new returns NULL. Requires a process    */
+/* launched under mpirun; initializes MPI if the app hasn't.            */
+/* ------------------------------------------------------------------ */
+int rlo_mpi_available(void);
+rlo_world *rlo_mpi_world_new(void);
+
+/* ------------------------------------------------------------------ */
 /* Progress engine (reference struct progress_engine + EngineManager).  */
 /* ------------------------------------------------------------------ */
 /* judgement callback: 1 approve / 0 decline (reference iar_cb_func_t,

@@ -1,0 +1,112 @@
+"""ROOTLESS_BACKEND switch: one op surface, every backend.
+
+The north-star requirement (BASELINE.json): a runtime backend switch at
+init so the same program runs on a CPU transport or the TPU lowering.
+Each backend facade must produce numerically identical collectives; the
+mpi/shm entries must fail with actionable messages in this build (no MPI
+installation; shm is one-process-per-rank C-only).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import rlo_tpu
+
+WS = 4
+BACKENDS = ["loopback", "native", "tpu"]
+
+
+def make(backend):
+    return rlo_tpu.init(backend=backend, world_size=WS)
+
+
+def rand_xs(seed, shape=(3, 5), dtype=np.float32):
+    rng = np.random.default_rng(seed)
+    return [rng.standard_normal(shape).astype(dtype) for _ in range(WS)]
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+class TestFacadeOps:
+    def test_allreduce_matches_numpy(self, backend):
+        with make(backend) as b:
+            xs = rand_xs(0)
+            want = np.sum(xs, axis=0)
+            outs = b.allreduce(xs)
+            for o in outs:
+                np.testing.assert_allclose(o, want, rtol=1e-5)
+
+    def test_allreduce_min(self, backend):
+        with make(backend) as b:
+            xs = rand_xs(1)
+            want = np.minimum.reduce(xs)
+            for o in b.allreduce(xs, op="min"):
+                np.testing.assert_allclose(o, want, rtol=1e-6)
+
+    def test_bcast_any_origin(self, backend):
+        with make(backend) as b:
+            for origin in (0, 2, WS - 1):  # rootless: any rank initiates
+                x = np.arange(12, dtype=np.int32).reshape(3, 4) + origin
+                outs = b.bcast(origin, x)
+                for o in outs:
+                    np.testing.assert_array_equal(o, x)
+
+    def test_consensus_and_of_votes(self, backend):
+        with make(backend) as b:
+            assert b.consensus([1] * WS) == 1
+            votes = [1] * WS
+            votes[WS - 1] = 0
+            assert b.consensus(votes) == 0
+
+    def test_reduce_scatter_chunks(self, backend):
+        with make(backend) as b:
+            xs = rand_xs(2, shape=(WS * 2,))
+            full = np.sum(xs, axis=0)
+            outs = b.reduce_scatter(xs)
+            for r, o in enumerate(outs):
+                np.testing.assert_allclose(
+                    o.reshape(-1), full.reshape(WS, -1)[r], rtol=1e-5)
+
+    def test_all_gather_stacks(self, backend):
+        with make(backend) as b:
+            xs = rand_xs(3, shape=(2, 3))
+            want = np.stack(xs)
+            for o in b.all_gather(xs):
+                np.testing.assert_allclose(o, want, rtol=1e-6)
+
+    def test_barrier_completes(self, backend):
+        with make(backend) as b:
+            b.barrier()
+
+
+class TestSwitch:
+    def test_env_var_selects(self, monkeypatch):
+        monkeypatch.setenv("ROOTLESS_BACKEND", "loopback")
+        with rlo_tpu.init(world_size=WS) as b:
+            assert b.name == "loopback"
+
+    def test_argument_overrides_env(self, monkeypatch):
+        monkeypatch.setenv("ROOTLESS_BACKEND", "native")
+        with rlo_tpu.init(backend="loopback", world_size=WS) as b:
+            assert b.name == "loopback"
+
+    def test_unknown_backend_lists_known(self):
+        with pytest.raises(ValueError, match="loopback"):
+            rlo_tpu.init(backend="nonsense")
+
+    def test_mpi_unavailable_is_actionable(self):
+        # this image has no MPI; the switch must say so, not segfault
+        with pytest.raises(RuntimeError, match="[Mm]pi|MPI"):
+            rlo_tpu.init(backend="mpi")
+
+    def test_shm_points_to_demo(self):
+        with pytest.raises(RuntimeError, match="rlo_demo"):
+            rlo_tpu.init(backend="shm")
+
+    def test_auto_on_cpu_mesh_is_tpu_multidevice(self):
+        # conftest forces an 8-device CPU platform -> auto picks the
+        # mesh-collective backend
+        with rlo_tpu.init(world_size=WS) as b:
+            assert b.name == "tpu"
+            assert b.world_size == WS
