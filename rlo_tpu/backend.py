@@ -116,6 +116,36 @@ class Backend:
                 f"{len(xs)}")
         return xs
 
+    def _engine_bcast(self, engines, drain, origin: int,
+                      x: np.ndarray) -> List[np.ndarray]:
+        """Shared bcast path for single-controller engine backends:
+        origin's engine broadcasts the packed tensor, the world drains,
+        every other rank picks up exactly one message."""
+        from rlo_tpu.ops.collectives import _pack_array, _unpack_array
+        x = np.asarray(x)
+        engines[origin].bcast(_pack_array(x))
+        drain()
+        out: List[Optional[np.ndarray]] = [None] * self.world_size
+        for r, e in enumerate(engines):
+            if r == origin:
+                out[r] = x.copy()
+                continue
+            msg = e.pickup_next()
+            if msg is None:
+                raise RuntimeError(f"rank {r} missed the broadcast")
+            out[r] = _unpack_array(msg.data)
+        return out
+
+
+def _rank_chunk(full: np.ndarray, ws: int, rank: int) -> np.ndarray:
+    """Rank's equal chunk of the flattened, zero-padded tensor — the
+    facade reduce_scatter contract (matches tpu_collectives)."""
+    flat = full.reshape(-1)
+    pad = (-flat.size) % ws
+    if pad:
+        flat = np.concatenate([flat, np.zeros(pad, flat.dtype)])
+    return flat.reshape(ws, -1)[rank]
+
 
 @_register("tpu")
 class TpuBackend(Backend):
@@ -229,19 +259,10 @@ class LoopbackBackend(Backend):
         self._drain = drain
 
     def bcast(self, origin: int, x: np.ndarray) -> List[np.ndarray]:
-        from rlo_tpu.ops.collectives import _pack_array, _unpack_array
-        x = np.asarray(x)
-        self._engines[origin].bcast(_pack_array(x))
-        self._drain([self._eng_world], self._engines)
-        out: List[Optional[np.ndarray]] = [None] * self.world_size
-        for r, e in enumerate(self._engines):
-            if r == origin:
-                out[r] = x.copy()
-                continue
-            msg = e.pickup_next()
-            assert msg is not None, f"rank {r} missed the broadcast"
-            out[r] = _unpack_array(msg.data)
-        return out
+        return self._engine_bcast(
+            self._engines,
+            lambda: self._drain([self._eng_world], self._engines),
+            origin, x)
 
     def consensus(self, votes: Sequence[int]) -> int:
         votes = list(votes)
@@ -266,7 +287,8 @@ class LoopbackBackend(Backend):
                 if engines[0].vote_my_proposal() != -1:
                     break
             decision = engines[0].vote_my_proposal()
-            assert decision != -1, "consensus did not complete"
+            if decision == -1:
+                raise RuntimeError("consensus did not complete")
             self._drain([world], engines)
             return int(decision)
         finally:
@@ -320,19 +342,8 @@ class NativeBackend(Backend):
                         for r in range(self.world_size)]
 
     def bcast(self, origin: int, x: np.ndarray) -> List[np.ndarray]:
-        from rlo_tpu.ops.collectives import _pack_array, _unpack_array
-        x = np.asarray(x)
-        self.engines[origin].bcast(_pack_array(x))
-        self.world.drain()
-        out: List[Optional[np.ndarray]] = [None] * self.world_size
-        for r, e in enumerate(self.engines):
-            if r == origin:
-                out[r] = x.copy()
-                continue
-            msg = e.pickup_next()
-            assert msg is not None, f"rank {r} missed the broadcast"
-            out[r] = _unpack_array(msg.data)
-        return out
+        return self._engine_bcast(self.engines, self.world.drain,
+                                  origin, x)
 
     def consensus(self, votes: Sequence[int]) -> int:
         from rlo_tpu.native.bindings import NativeWorld, NativeEngine
@@ -349,7 +360,8 @@ class NativeBackend(Backend):
             if rc == -1:
                 world.drain()
                 rc = engines[0].vote_my_proposal()
-            assert rc in (0, 1), f"consensus incomplete ({rc})"
+            if rc not in (0, 1):
+                raise RuntimeError(f"consensus incomplete ({rc})")
             world.drain()
             return int(rc)
         finally:
@@ -391,15 +403,8 @@ class NativeBackend(Backend):
 
     def reduce_scatter(self, xs, op: str = "sum") -> List[np.ndarray]:
         full = self.allreduce(xs, op=op)
-        outs = []
-        for r in range(self.world_size):
-            flat = full[r].reshape(-1)
-            pad = (-flat.size) % self.world_size
-            if pad:
-                flat = np.concatenate(
-                    [flat, np.zeros(pad, flat.dtype)])
-            outs.append(flat.reshape(self.world_size, -1)[r])
-        return outs
+        return [_rank_chunk(full[r], self.world_size, r)
+                for r in range(self.world_size)]
 
     def all_gather(self, xs) -> List[np.ndarray]:
         gathered = self._bcast_gather(xs)
@@ -496,9 +501,14 @@ class MpiBackend(Backend):
         self._my_vote = int(my_vote)  # read by this rank's judge cb
         if self.rank == 0:
             rc = self.engine.submit_proposal(b"facade", pid=0)
-            while rc == -1:
+            for _ in range(200_000_000):
+                if rc != -1:
+                    break
                 self.world.progress_all()
                 rc = self.engine.vote_my_proposal()
+            else:
+                raise RuntimeError(
+                    "consensus did not complete (a peer rank stalled?)")
             self.world.drain()
             self.engine.proposal_reset()
             return int(rc)
@@ -533,11 +543,7 @@ class MpiBackend(Backend):
 
     def reduce_scatter(self, x: np.ndarray, op: str = "sum") -> np.ndarray:
         full = self.allreduce(x, op=op)
-        flat = full.reshape(-1)
-        pad = (-flat.size) % self.world_size
-        if pad:
-            flat = np.concatenate([flat, np.zeros(pad, flat.dtype)])
-        return flat.reshape(self.world_size, -1)[self.rank]
+        return _rank_chunk(full, self.world_size, self.rank)
 
     def barrier(self) -> None:
         self.world.drain()

@@ -1,0 +1,199 @@
+"""Flagship model: decoder-only transformer, sequence-parallel by ring
+attention, data-parallel by the framework's ring allreduce.
+
+The reference ships no model code (SURVEY.md §5 records the absence);
+this is the net-new capability demonstrating the substrate end-to-end on
+a 2-D mesh (dp, sp):
+
+  - the sequence axis is sharded over `sp`: attention runs as
+    rlo_tpu.ops.ring_attention (K/V streaming over the ppermute ring),
+    every other sublayer is position-local and needs no communication;
+  - the batch axis is sharded over `dp`: gradients are combined with the
+    framework's ring allreduce + Pallas fused combine
+    (rlo_tpu.ops.tpu_collectives.allreduce), the data-collective path the
+    BASELINE.json configs benchmark;
+  - cross-shard label shift (next-token prediction across the sp
+    boundary) is one ppermute of the first token column.
+
+Pure-functional JAX: params are a pytree, `train_step` is jit/shard_map
+compatible, bfloat16 activations with float32 params and accumulation.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from rlo_tpu import topology
+from rlo_tpu.ops import tpu_collectives as tc
+from rlo_tpu.ops.ring_attention import full_attention, ring_attention
+
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    vocab: int = 256
+    d_model: int = 128
+    n_heads: int = 4
+    n_layers: int = 2
+    d_ff: int = 512
+    dtype: str = "bfloat16"  # activation dtype; params stay float32
+
+    @property
+    def head_dim(self) -> int:
+        assert self.d_model % self.n_heads == 0
+        return self.d_model // self.n_heads
+
+    @property
+    def act_dtype(self):
+        return jnp.dtype(self.dtype)
+
+
+def init_params(rng: jax.Array, cfg: TransformerConfig) -> dict:
+    """Scaled-normal init; embedding tied with the output head."""
+    keys = jax.random.split(rng, 2 + 6 * cfg.n_layers)
+    d, f = cfg.d_model, cfg.d_ff
+
+    def norm(key, shape, scale):
+        return (jax.random.normal(key, shape, jnp.float32) * scale)
+
+    params = {
+        "embed": norm(keys[0], (cfg.vocab, d), 0.02),
+        "ln_f": {"g": jnp.ones((d,), jnp.float32)},
+        "layers": [],
+    }
+    k = 2
+    for _ in range(cfg.n_layers):
+        params["layers"].append({
+            "ln1": {"g": jnp.ones((d,), jnp.float32)},
+            "wqkv": norm(keys[k], (d, 3 * d), d ** -0.5),
+            "wo": norm(keys[k + 1], (d, d), (2 * d * cfg.n_layers) ** -0.5),
+            "ln2": {"g": jnp.ones((d,), jnp.float32)},
+            "w1": norm(keys[k + 2], (d, f), d ** -0.5),
+            "w2": norm(keys[k + 3], (f, d), (2 * f * cfg.n_layers) ** -0.5),
+        })
+        k += 6
+    return params
+
+
+def _rmsnorm(x, g):
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1,
+                   keepdims=True)
+    return (x * jax.lax.rsqrt(var + 1e-6)).astype(x.dtype) * g.astype(
+        x.dtype)
+
+
+def _sincos(pos, d_model, dtype):
+    """Sinusoidal positions for GLOBAL token positions (works sharded)."""
+    half = d_model // 2
+    freqs = jnp.exp(-np.log(10000.0) * jnp.arange(half) / half)
+    ang = pos[:, None].astype(jnp.float32) * freqs[None, :]
+    return jnp.concatenate([jnp.sin(ang), jnp.cos(ang)],
+                           axis=-1).astype(dtype)
+
+
+def forward(params: dict, tokens: jax.Array, cfg: TransformerConfig,
+            sp_axis: Optional[str] = None) -> jax.Array:
+    """Logits for next-token prediction; causal.
+
+    tokens: (batch, block) int32 — `block` is the LOCAL sequence slice
+    when sp_axis is set (shard r holds tokens [r*block, (r+1)*block)).
+    """
+    b, blk = tokens.shape
+    dt = cfg.act_dtype
+    if sp_axis is not None:
+        pos0 = lax.axis_index(sp_axis) * blk
+    else:
+        pos0 = 0
+    pos = pos0 + jnp.arange(blk)
+
+    x = params["embed"][tokens].astype(dt) + _sincos(pos, cfg.d_model, dt)
+
+    for layer in params["layers"]:
+        h = _rmsnorm(x, layer["ln1"]["g"])
+        qkv = h @ layer["wqkv"].astype(dt)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads(t):
+            return t.reshape(b, blk, cfg.n_heads, cfg.head_dim)
+
+        q, k, v = heads(q), heads(k), heads(v)
+        if sp_axis is None:
+            att = jax.vmap(lambda q_, k_, v_: full_attention(
+                q_, k_, v_, causal=True))(q, k, v)
+        else:
+            att = jax.vmap(lambda q_, k_, v_: ring_attention(
+                q_, k_, v_, sp_axis, causal=True), in_axes=0)(q, k, v)
+        att = att.reshape(b, blk, cfg.d_model)
+        x = x + att @ layer["wo"].astype(dt)
+
+        h = _rmsnorm(x, layer["ln2"]["g"])
+        h = jax.nn.gelu(h @ layer["w1"].astype(dt))
+        x = x + h @ layer["w2"].astype(dt)
+
+    x = _rmsnorm(x, params["ln_f"]["g"])
+    return (x @ params["embed"].T.astype(dt)).astype(jnp.float32)
+
+
+def loss_fn(params: dict, tokens: jax.Array, cfg: TransformerConfig,
+            sp_axis: Optional[str] = None) -> jax.Array:
+    """Mean next-token cross-entropy. With sp sharding, the label for a
+    shard's last position is the next shard's first token — one ppermute
+    — and the final global position is masked out."""
+    logits = forward(params, tokens, cfg, sp_axis)
+    b, blk = tokens.shape
+    if sp_axis is None:
+        targets = jnp.concatenate(
+            [tokens[:, 1:], jnp.zeros((b, 1), tokens.dtype)], axis=1)
+        valid = jnp.concatenate(
+            [jnp.ones((b, blk - 1), jnp.float32),
+             jnp.zeros((b, 1), jnp.float32)], axis=1)
+    else:
+        ws = lax.axis_size(sp_axis)
+        idx = lax.axis_index(sp_axis)
+        # shard r receives shard (r+1)'s first column: ppermute r+1 -> r
+        nxt_first = lax.ppermute(tokens[:, :1], sp_axis,
+                                 list(topology.ring_perm(ws, -1)))
+        targets = jnp.concatenate([tokens[:, 1:], nxt_first], axis=1)
+        is_last_shard = (idx == ws - 1)
+        valid = jnp.concatenate(
+            [jnp.ones((b, blk - 1), jnp.float32),
+             jnp.where(is_last_shard, 0.0, 1.0) * jnp.ones(
+                 (b, 1), jnp.float32)], axis=1)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    local = jnp.sum(nll * valid)
+    count = jnp.sum(valid)
+    if sp_axis is not None:
+        local = lax.psum(local, sp_axis)
+        count = lax.psum(count, sp_axis)
+    return local / count
+
+
+def train_step(params: dict, tokens: jax.Array, cfg: TransformerConfig,
+               lr: float = 1e-2, sp_axis: Optional[str] = None,
+               dp_axis: Optional[str] = None,
+               grad_algorithm: str = "psum"):
+    """One SGD step; returns (new_params, loss).
+
+    Gradients combine over `dp_axis` with the framework's allreduce —
+    grad_algorithm='ring' uses the explicit ppermute ring with the Pallas
+    fused per-step combine (the BASELINE benchmark path), 'psum' the XLA
+    collective.
+    """
+    loss, grads = jax.value_and_grad(loss_fn)(params, tokens, cfg, sp_axis)
+    if sp_axis is not None:
+        # params are replicated over sp: sum the per-shard grad shards
+        grads = jax.tree.map(lambda g: lax.psum(g, sp_axis), grads)
+    if dp_axis is not None:
+        n = lax.axis_size(dp_axis)
+        grads = jax.tree.map(
+            lambda g: tc.allreduce(g, dp_axis, algorithm=grad_algorithm)
+            / n, grads)
+        loss = lax.pmean(loss, dp_axis)
+    new_params = jax.tree.map(lambda p, g: p - lr * g, params, grads)
+    return new_params, loss

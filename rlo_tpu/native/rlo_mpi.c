@@ -247,13 +247,19 @@ static void mpi_free(rlo_world *base)
     mpi_test_sends(w);
     for (mpi_send_node *n = w->sends; n;) {
         mpi_send_node *nn = n->next;
-        /* completing (cancelled or delivered) before freeing the buffer
-         * — MPI may still be reading it until the wait returns */
-        MPI_Cancel(&n->req);
-        MPI_Wait(&n->req, MPI_STATUS_IGNORE);
+        /* Never MPI_Cancel a send: Open MPI >= 4 aborts on it and a
+         * cancel that no-ops would leave MPI_Wait blocking on a dead
+         * receiver. Bounded test loop; on timeout leak the request AND
+         * the buffer (MPI may still be reading it) — this path is only
+         * reachable after a failed drain, where the job is lost anyway. */
+        int done = 0;
+        for (long t = 0; t < 100000000L && !done; t++)
+            MPI_Test(&n->req, &done, MPI_STATUS_IGNORE);
         rlo_handle_unref(n->handle);
-        free(n->buf);
-        free(n);
+        if (done) {
+            free(n->buf);
+            free(n);
+        }
         n = nn;
     }
     for (rlo_wire_node *n = w->inbox_head; n;) {

@@ -1,0 +1,122 @@
+"""Ring attention: sequence-parallel attention over the ppermute ring.
+
+Long-context support, first-class on the same substrate as the data
+collectives: the sequence axis is sharded over a mesh axis, Q blocks stay
+resident, and K/V blocks rotate around the ring with `jax.lax.ppermute`
+(the identical `topology.ring_perm` schedule the ring allreduce uses —
+the skip-ring neighbor structure of the reference generalized from 32 KB
+control frames, rootless_ops.c:1489, to streaming KV blocks). Softmax is
+accumulated online (running max / denominator / weighted sum), so no
+shard ever materializes the full attention matrix — memory per shard is
+O(block² / ws) while supporting sequences ws× longer than one chip holds.
+
+Why this shape on TPU: each ring step is one CollectivePermute (ICI
+remote-DMA) overlapped by XLA with the block matmuls on the MXU; the
+per-step state update (rescale + accumulate) is exactly the fused-combine
+pattern of rlo_tpu.pallas.reduce applied to the (o, m, l) triple.
+
+The reference has no attention (SURVEY.md §5 records the absence); this
+is the net-new long-context capability the rebuild is required to carry.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from rlo_tpu import topology
+
+_NEG = -1e30  # large-negative mask value (finite: keeps exp/max NaN-free)
+
+
+def _block_update(q, k, v, m, l, o, q_pos, k_pos, causal, scale):
+    """One online-softmax update of (m, l, o) with a K/V block.
+
+    q: (Lq, H, D); k, v: (Lk, H, D); m, l: (H, Lq); o: (Lq, H, D).
+    q_pos: (Lq,) and k_pos: (Lk,) are global token positions for masking.
+    """
+    s = jnp.einsum("qhd,khd->hqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        mask = (k_pos[None, :] <= q_pos[:, None])[None, :, :]  # (1,Lq,Lk)
+        s = jnp.where(mask, s, _NEG)
+    m_new = jnp.maximum(m, s.max(axis=-1))
+    p = jnp.exp(s - m_new[..., None])
+    if causal:
+        p = jnp.where(mask, p, 0.0)
+    corr = jnp.exp(m - m_new)  # (H, Lq)
+    l_new = l * corr + p.sum(axis=-1)
+    o_new = o * corr.T[..., None] + jnp.einsum(
+        "hqk,khd->qhd", p, v.astype(jnp.float32))
+    return m_new, l_new, o_new
+
+
+def ring_attention(q, k, v, axis: str, *, causal: bool = False,
+                   scale: Optional[float] = None):
+    """Sequence-parallel attention; call inside shard_map over ``axis``.
+
+    q, k, v: this shard's (block_len, n_heads, head_dim) slice of the
+    sequence (sharded contiguously: shard r holds tokens
+    [r*block, (r+1)*block)). Returns the (block_len, n_heads, head_dim)
+    attention output for the local Q block, numerically equal to full
+    softmax attention over the whole sequence.
+    """
+    ws = lax.axis_size(axis)
+    idx = lax.axis_index(axis)
+    blk, h, d = q.shape
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    # K/V travel rank -> rank+1, so the block held at step s originated
+    # at shard (idx - s) mod ws — same schedule as the ring allreduce.
+    perm = list(topology.ring_perm(ws))
+
+    q32 = q.astype(jnp.float32)
+    q_pos = idx * blk + jnp.arange(blk)
+
+    def update(s, kc, vc, m, l, o):
+        src = (idx - s) % ws
+        k_pos = src * blk + jnp.arange(blk)
+        return _block_update(q32, kc.astype(jnp.float32), vc, m, l, o,
+                             q_pos, k_pos, causal, scale)
+
+    def step(s, carry):
+        kc, vc, m, l, o = carry
+        m, l, o = update(s, kc, vc, m, l, o)
+        kc = lax.ppermute(kc, axis, perm)
+        vc = lax.ppermute(vc, axis, perm)
+        return kc, vc, m, l, o
+
+    m0 = jnp.full((h, blk), _NEG, jnp.float32)
+    l0 = jnp.zeros((h, blk), jnp.float32)
+    o0 = jnp.zeros((blk, h, d), jnp.float32)
+    # ws-1 rotate-and-update steps, then the last arrived block outside
+    # the loop — the final rotation would only be thrown away, and
+    # collectives inside fori_loop are never dead-code-eliminated
+    kc, vc, m, l, o = lax.fori_loop(0, ws - 1, step, (k, v, m0, l0, o0))
+    m, l, o = update(ws - 1, kc, vc, m, l, o)
+
+    # causal guarantees l > 0 (every q sees itself); for safety against
+    # fully-masked rows divide-where
+    denom = jnp.where(l.T[..., None] > 0, l.T[..., None], 1.0)
+    return (o / denom).astype(q.dtype)
+
+
+def full_attention(q, k, v, *, causal: bool = False,
+                   scale: Optional[float] = None):
+    """Unsharded reference implementation (the test oracle)."""
+    qn, h, d = q.shape
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    s = jnp.einsum("qhd,khd->hqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32),
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        kn = k.shape[0]
+        mask = jnp.arange(kn)[None, :] <= jnp.arange(qn)[:, None]
+        s = jnp.where(mask[None], s, _NEG)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("hqk,khd->qhd", p, v.astype(jnp.float32))
+    return out.astype(q.dtype)

@@ -1,0 +1,107 @@
+"""Flagship transformer: sharded == unsharded, and training decreases loss.
+
+Oracles:
+  1. forward parity — logits from the (dp=2, sp=4) sharded model equal
+     the single-device model on the same batch;
+  2. loss parity — the sp-sharded next-token loss (cross-shard label
+     shift via ppermute) equals the unsharded loss;
+  3. training works — a few sharded SGD steps on a learnable pattern
+     reduce the loss, with ring-allreduce gradient combining.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from rlo_tpu.models.transformer import (TransformerConfig, forward,
+                                        init_params, loss_fn, train_step)
+from rlo_tpu.parallel.mesh import make_mesh, shard_jit
+
+CFG = TransformerConfig(vocab=64, d_model=64, n_heads=4, n_layers=2,
+                        d_ff=128, dtype="float32")
+BATCH, SEQ = 4, 32
+DP, SP = 2, 4
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(jax.random.PRNGKey(0), CFG)
+
+
+@pytest.fixture(scope="module")
+def tokens():
+    rng = np.random.default_rng(0)
+    return jnp.asarray(rng.integers(0, CFG.vocab, (BATCH, SEQ)), jnp.int32)
+
+
+def test_forward_parity_2d_mesh(params, tokens):
+    want = np.asarray(forward(params, tokens, CFG))
+    mesh = make_mesh((DP, SP), ("dp", "sp"))
+    fn = shard_jit(
+        lambda p, t: forward(p, t, CFG, sp_axis="sp"),
+        mesh, (P(), P("dp", "sp")), P("dp", "sp"))
+    got = np.asarray(fn(params, tokens))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_loss_parity_sp_shift(params, tokens):
+    want = float(loss_fn(params, tokens, CFG))
+    mesh = make_mesh((SP,), ("sp",))
+    fn = shard_jit(
+        lambda p, t: loss_fn(p, t, CFG, sp_axis="sp"),
+        mesh, (P(), P(None, "sp")), P())
+    got = float(fn(params, tokens))
+    assert abs(got - want) < 2e-4, (got, want)
+
+
+@pytest.mark.parametrize("grad_algorithm", ["psum", "ring"])
+def test_training_reduces_loss(grad_algorithm):
+    cfg = TransformerConfig(vocab=16, d_model=32, n_heads=2, n_layers=1,
+                            d_ff=64, dtype="float32")
+    params = init_params(jax.random.PRNGKey(1), cfg)
+    # learnable data: token t follows t-1 (mod vocab)
+    rows = []
+    rng = np.random.default_rng(1)
+    for _ in range(DP * 2):
+        start = rng.integers(0, cfg.vocab)
+        rows.append((start + np.arange(SEQ)) % cfg.vocab)
+    tokens = jnp.asarray(np.stack(rows), jnp.int32)
+
+    mesh = make_mesh((DP, SP), ("dp", "sp"))
+    step = shard_jit(
+        lambda p, t: train_step(p, t, cfg, lr=0.2, sp_axis="sp",
+                                dp_axis="dp",
+                                grad_algorithm=grad_algorithm),
+        mesh, (P(), P("dp", "sp")), (P(), P()))
+    losses = []
+    for _ in range(60):
+        params, loss = step(params, tokens)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
+
+
+def test_grad_parity_ring_vs_psum():
+    cfg = TransformerConfig(vocab=16, d_model=32, n_heads=2, n_layers=1,
+                            d_ff=64, dtype="float32")
+    p0 = init_params(jax.random.PRNGKey(2), cfg)
+    rng = np.random.default_rng(2)
+    tokens = jnp.asarray(rng.integers(0, cfg.vocab, (DP * 2, SEQ)),
+                         jnp.int32)
+    mesh = make_mesh((DP, SP), ("dp", "sp"))
+
+    def run(alg):
+        step = shard_jit(
+            lambda p, t: train_step(p, t, cfg, lr=0.2, sp_axis="sp",
+                                    dp_axis="dp", grad_algorithm=alg),
+            mesh, (P(), P("dp", "sp")), (P(), P()))
+        new_p, loss = step(p0, tokens)
+        return new_p, float(loss)
+
+    p_ring, l_ring = run("ring")
+    p_psum, l_psum = run("psum")
+    assert abs(l_ring - l_psum) < 1e-5
+    for a, b in zip(jax.tree.leaves(p_ring), jax.tree.leaves(p_psum)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
