@@ -31,6 +31,7 @@ from typing import Callable, List, Optional
 
 from rlo_tpu import topology
 from rlo_tpu.transport.base import SendHandle, Transport
+from rlo_tpu.utils.tracing import TRACER, Ev
 from rlo_tpu.wire import Frame, Tag, BCAST_TAGS, MSG_SIZE_MAX
 
 
@@ -197,6 +198,7 @@ class ProgressEngine:
             msg.send_handles.append(self.transport.isend(dst, int(tag), raw))
         self.queue_wait.append(msg)
         self.sent_bcast_cnt += 1
+        TRACER.emit(self.rank, Ev.BCAST_INIT, int(tag), len(payload))
         self.manager.progress_all()
         return msg
 
@@ -224,6 +226,7 @@ class ProgressEngine:
         p.decision_handles = []
         p.decision_pending = False
         self.my_proposal_payload = bytes(proposal)
+        TRACER.emit(self.rank, Ev.PROPOSAL_SUBMIT, pid)
         self.bcast(proposal, tag=Tag.IAR_PROPOSAL, pid=pid, vote=1)
         if p.state == ReqState.COMPLETED:
             return p.vote
@@ -254,11 +257,13 @@ class ProgressEngine:
             msg.pickup_done = True
             self.queue_wait.append(msg)  # keep tracking its forwards
             self.total_pickup += 1
+            TRACER.emit(self.rank, Ev.DELIVER, msg.tag, msg.frame.origin)
             return self._to_user(msg)
         if self.queue_pickup:
             msg = self.queue_pickup.popleft()
             msg.pickup_done = True
             self.total_pickup += 1
+            TRACER.emit(self.rank, Ev.DELIVER, msg.tag, msg.frame.origin)
             return self._to_user(msg)
         return None
 
@@ -325,6 +330,8 @@ class ProgressEngine:
                 raw = msg.frame.encode()
             msg.send_handles.append(
                 self.transport.isend(dst, msg.tag, raw))
+        if targets:
+            TRACER.emit(self.rank, Ev.BCAST_FWD, msg.tag, len(targets))
 
         if msg.tag == Tag.IAR_PROPOSAL:
             # proposals are engine-internal: parked for the decision, never
@@ -342,16 +349,20 @@ class ProgressEngine:
         return len(targets)
 
     # -- IAR handlers (~rootless_ops.c:668-859) ---------------------------
-    def _judge(self, payload: bytes) -> int:
+    def _judge(self, payload: bytes, pid: int) -> int:
         if self.judge_cb is None:
-            return 1
-        return int(self.judge_cb(payload, self.app_ctx))
+            verdict = 1
+        else:
+            verdict = int(self.judge_cb(payload, self.app_ctx))
+        TRACER.emit(self.rank, Ev.JUDGE, pid, verdict)
+        return verdict
 
     def _vote_back(self, ps: ProposalState, vote: int) -> None:
         """Send my (merged) vote to the rank I got the proposal from
         (~_vote_back :728-741, nonblocking here)."""
         frame = Frame(origin=self.rank, pid=ps.pid, vote=int(vote))
         self.transport.isend(ps.recv_from, int(Tag.IAR_VOTE), frame.encode())
+        TRACER.emit(self.rank, Ev.VOTE, ps.pid, int(vote))
 
     def _on_proposal(self, msg: _Msg) -> None:
         """~_iar_proposal_handler (:668-726)."""
@@ -374,7 +385,7 @@ class ProgressEngine:
                 self.world_size, self.rank, origin, msg.src),
         )
         msg.prop_state = ps
-        judgment = self._judge(msg.frame.payload)
+        judgment = self._judge(msg.frame.payload, ps.pid)
         if judgment == 0:
             # decline: vote NO to parent immediately, do not forward — the
             # subtree below never sees the proposal, only the decision
@@ -395,7 +406,7 @@ class ProgressEngine:
                 if p.vote:
                     # re-judge own proposal: a competing proposal may have
                     # changed the app state since submission (:773)
-                    p.vote = self._judge(self.my_proposal_payload)
+                    p.vote = self._judge(self.my_proposal_payload, p.pid)
                 self._decision_bcast(p)
             return
         # vote for a proposal I'm relaying
@@ -416,6 +427,7 @@ class ProgressEngine:
         msg = self.bcast(b"", tag=Tag.IAR_DECISION, pid=p.pid, vote=p.vote)
         p.decision_handles = list(msg.send_handles)
         p.decision_pending = True
+        TRACER.emit(self.rank, Ev.DECISION, p.pid, p.vote)
 
     def _on_decision(self, msg: _Msg) -> None:
         """~_iar_decision_handler (:814-859) + forward along the overlay."""

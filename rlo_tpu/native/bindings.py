@@ -35,6 +35,12 @@ _JUDGE_CB = C.CFUNCTYPE(C.c_int, C.POINTER(C.c_uint8), C.c_int64,
                         C.c_void_p)
 _ACTION_CB = C.CFUNCTYPE(None, C.POINTER(C.c_uint8), C.c_int64, C.c_void_p)
 
+
+class _TraceEvent(C.Structure):
+    """Mirror of rlo_trace_event (rlo_core.h)."""
+    _fields_ = [("ts_usec", C.c_uint64), ("rank", C.c_int32),
+                ("kind", C.c_int32), ("a", C.c_int32), ("b", C.c_int32)]
+
 _lib = None
 
 
@@ -100,6 +106,11 @@ def load() -> C.CDLL:
     sig("rlo_engine_recved_bcast", C.c_int64, [p])
     sig("rlo_drain", C.c_int, [p, C.c_int])
     sig("rlo_now_usec", C.c_uint64, [])
+    sig("rlo_trace_set", None, [C.c_int])
+    sig("rlo_trace_enabled", C.c_int, [])
+    sig("rlo_trace_drain", C.c_int, [C.POINTER(_TraceEvent), C.c_int])
+    sig("rlo_trace_dropped", C.c_int64, [])
+    sig("rlo_trace_clear", None, [])
     _lib = lib
     return lib
 
@@ -336,3 +347,27 @@ def frame_roundtrip(origin: int, pid: int, vote: int, payload: bytes):
 
 def now_usec() -> int:
     return load().rlo_now_usec()
+
+
+# -- native tracing (twin of rlo_tpu.utils.tracing) --------------------------
+
+def trace_set(enabled: bool) -> None:
+    load().rlo_trace_set(1 if enabled else 0)
+
+
+def trace_clear() -> None:
+    load().rlo_trace_clear()
+
+
+def trace_dropped() -> int:
+    return load().rlo_trace_dropped()
+
+
+def trace_drain(max_events: int = 65536):
+    """Drain native trace events as dicts matching Event.to_dict()."""
+    from rlo_tpu.utils.tracing import Ev
+    buf = (_TraceEvent * max_events)()
+    n = load().rlo_trace_drain(buf, max_events)
+    return [{"ts_usec": buf[i].ts_usec, "rank": buf[i].rank,
+             "kind": Ev(buf[i].kind).name, "a": buf[i].a, "b": buf[i].b}
+            for i in range(n)]

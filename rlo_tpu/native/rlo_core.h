@@ -218,6 +218,40 @@ int rlo_drain(rlo_world *w, int max_spins);
 /* ------------------------------------------------------------------ */
 uint64_t rlo_now_usec(void);
 
+/* ------------------------------------------------------------------ */
+/* Structured event tracing. The reference has none beyond printf       */
+/* tracepoints and an unused Log global (SURVEY.md §5). Event kinds and */
+/* semantics are shared with the Python tracer                          */
+/* (rlo_tpu/utils/tracing.py); disabled by default — one branch per     */
+/* emit when off. Process-local ring; oldest events drop when full.     */
+/* ------------------------------------------------------------------ */
+enum rlo_ev {
+    RLO_EV_BCAST_INIT = 1, /* a = tag, b = payload len */
+    RLO_EV_BCAST_FWD = 2,  /* a = tag, b = #targets */
+    RLO_EV_DELIVER = 3,    /* a = tag, b = origin */
+    RLO_EV_PROPOSAL_SUBMIT = 4, /* a = pid */
+    RLO_EV_JUDGE = 5,      /* a = pid of the judged proposal, b = verdict */
+    RLO_EV_VOTE = 6,       /* a = pid, b = merged vote */
+    RLO_EV_DECISION = 7,   /* a = pid, b = decision */
+    RLO_EV_DRAIN = 8,      /* a = spins */
+};
+
+typedef struct rlo_trace_event {
+    uint64_t ts_usec;
+    int32_t rank;
+    int32_t kind; /* enum rlo_ev */
+    int32_t a, b;
+} rlo_trace_event;
+
+void rlo_trace_set(int enabled);
+int rlo_trace_enabled(void);
+void rlo_trace_emit(int rank, int kind, int a, int b);
+/* Copies up to max oldest-first events into out and removes them;
+ * returns the count. */
+int rlo_trace_drain(rlo_trace_event *out, int max);
+int64_t rlo_trace_dropped(void);
+void rlo_trace_clear(void);
+
 #ifdef __cplusplus
 }
 #endif

@@ -279,6 +279,7 @@ static int bcast_init(rlo_engine *e, int tag, int32_t pid, int32_t vote,
     }
     q_append(&e->q_wait, m);
     e->sent_bcast++;
+    rlo_trace_emit(e->rank, RLO_EV_BCAST_INIT, tag, (int)len);
     if (out)
         *out = m;
     return RLO_OK;
@@ -306,6 +307,8 @@ static int bc_forward(rlo_engine *e, rlo_msg *m)
         if (rc != RLO_OK)
             return rc;
     }
+    if (n > 0)
+        rlo_trace_emit(e->rank, RLO_EV_BCAST_FWD, m->tag, n);
     if (m->tag == RLO_TAG_IAR_PROPOSAL) {
         /* proposals are engine-internal: parked for the decision, never
          * user-visible (make_progress_gen :591-596) */
@@ -323,17 +326,20 @@ static int bc_forward(rlo_engine *e, rlo_msg *m)
 
 /* ---------------- IAR consensus ---------------- */
 
-static int eng_judge(rlo_engine *e, const uint8_t *payload, int64_t len)
+static int eng_judge(rlo_engine *e, const uint8_t *payload, int64_t len,
+                     int pid)
 {
-    if (!e->judge)
-        return 1;
-    return e->judge(payload, len, e->judge_ctx) ? 1 : 0;
+    int verdict = e->judge ? (e->judge(payload, len, e->judge_ctx) ? 1 : 0)
+                           : 1;
+    rlo_trace_emit(e->rank, RLO_EV_JUDGE, pid, verdict);
+    return verdict;
 }
 
 /* Send my (merged) vote to the rank the proposal came from (reference
  * _vote_back :728-741; nonblocking here). */
 static int vote_back(rlo_engine *e, const rlo_prop *ps, int vote)
 {
+    rlo_trace_emit(e->rank, RLO_EV_VOTE, ps->pid, vote);
     return eng_isend(e, ps->recv_from, RLO_TAG_IAR_VOTE, e->rank, ps->pid,
                      vote, 0, 0, 0);
 }
@@ -375,7 +381,7 @@ static void on_proposal(rlo_engine *e, rlo_msg *m)
     ps->votes_needed =
         rlo_fwd_send_cnt(e->ws, e->rank, m->origin, m->src);
     m->ps = ps;
-    if (!eng_judge(e, m->payload, m->len)) {
+    if (!eng_judge(e, m->payload, m->len, ps->pid)) {
         /* decline: NO to parent immediately, don't forward — the subtree
          * below only ever sees the decision */
         vote_back(e, ps, 0);
@@ -415,6 +421,7 @@ static void decision_bcast(rlo_engine *e)
         m->handles[i]->refs++;
     }
     p->decision_pending = 1;
+    rlo_trace_emit(e->rank, RLO_EV_DECISION, p->pid, p->vote);
 }
 
 static void on_vote(rlo_engine *e, rlo_msg *m)
@@ -428,7 +435,7 @@ static void on_vote(rlo_engine *e, rlo_msg *m)
             if (p->vote)
                 /* re-judge: a competing proposal may have changed app
                  * state since submission (reference :773) */
-                p->vote = eng_judge(e, p->payload, p->len);
+                p->vote = eng_judge(e, p->payload, p->len, p->pid);
             decision_bcast(e);
         }
         msg_free(m);
@@ -487,6 +494,7 @@ int rlo_submit_proposal(rlo_engine *e, const uint8_t *proposal, int64_t len,
             return RLO_ERR_NOMEM;
         memcpy(p->payload, proposal, (size_t)len);
     }
+    rlo_trace_emit(e->rank, RLO_EV_PROPOSAL_SUBMIT, pid, 0);
     int rc = bcast_init(e, RLO_TAG_IAR_PROPOSAL, pid, 1, proposal, len, 0);
     if (rc != RLO_OK) {
         p->state = RLO_FAILED;
@@ -559,6 +567,7 @@ int64_t rlo_pickup_next(rlo_engine *e, int *tag, int *origin, int *pid,
         m->pickup_done = 1;
         q_append(&e->q_wait, m); /* keep tracking its forwards */
         e->total_pickup++;
+        rlo_trace_emit(e->rank, RLO_EV_DELIVER, m->tag, m->origin);
         return n;
     }
     m = e->q_pickup.head;
@@ -568,6 +577,7 @@ int64_t rlo_pickup_next(rlo_engine *e, int *tag, int *origin, int *pid,
             return n;
         q_remove(&e->q_pickup, m);
         e->total_pickup++;
+        rlo_trace_emit(e->rank, RLO_EV_DELIVER, m->tag, m->origin);
         msg_free(m);
         return n;
     }

@@ -249,11 +249,12 @@ static void mpi_free(rlo_world *base)
         mpi_send_node *nn = n->next;
         /* Never MPI_Cancel a send: Open MPI >= 4 aborts on it and a
          * cancel that no-ops would leave MPI_Wait blocking on a dead
-         * receiver. Bounded test loop; on timeout leak the request AND
+         * receiver. Real-time deadline; on timeout leak the request AND
          * the buffer (MPI may still be reading it) — this path is only
          * reachable after a failed drain, where the job is lost anyway. */
         int done = 0;
-        for (long t = 0; t < 100000000L && !done; t++)
+        uint64_t deadline = rlo_now_usec() + 5 * 1000 * 1000;
+        while (!done && rlo_now_usec() < deadline)
             MPI_Test(&n->req, &done, MPI_STATUS_IGNORE);
         rlo_handle_unref(n->handle);
         if (done) {

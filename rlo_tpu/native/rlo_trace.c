@@ -1,0 +1,65 @@
+/* Structured event tracing — native twin of rlo_tpu/utils/tracing.py.
+ *
+ * The reference's only observability is gettimeofday timestamps and
+ * commented-out printf tracepoints (SURVEY.md §5); this replaces them
+ * with a bounded process-local ring of typed events the engine emits at
+ * every protocol step. Single-threaded like the rest of the core (the
+ * engine model is cooperative polling, rlo_core.h header note).
+ */
+#include "rlo_internal.h"
+
+#define TRACE_CAP 65536
+
+static rlo_trace_event ring[TRACE_CAP];
+static int head;    /* next write slot */
+static int count;   /* live events */
+static int enabled;
+static int64_t dropped;
+
+void rlo_trace_set(int on)
+{
+    enabled = on;
+}
+
+int rlo_trace_enabled(void)
+{
+    return enabled;
+}
+
+void rlo_trace_emit(int rank, int kind, int a, int b)
+{
+    if (!enabled)
+        return;
+    rlo_trace_event *e = &ring[head];
+    e->ts_usec = rlo_now_usec();
+    e->rank = rank;
+    e->kind = kind;
+    e->a = a;
+    e->b = b;
+    head = (head + 1) % TRACE_CAP;
+    if (count < TRACE_CAP)
+        count++;
+    else
+        dropped++;
+}
+
+int rlo_trace_drain(rlo_trace_event *out, int max)
+{
+    int n = count < max ? count : max;
+    int start = (head - count + TRACE_CAP) % TRACE_CAP;
+    for (int i = 0; i < n; i++)
+        out[i] = ring[(start + i) % TRACE_CAP];
+    count -= n;
+    return n;
+}
+
+int64_t rlo_trace_dropped(void)
+{
+    return dropped;
+}
+
+void rlo_trace_clear(void)
+{
+    head = count = 0;
+    dropped = 0;
+}

@@ -1,0 +1,126 @@
+"""Structured per-op event tracing + jax.profiler integration.
+
+The reference has no tracing beyond gettimeofday timestamps bracketing
+test loops and commented-out printf tracepoints (SURVEY.md §5:
+rootless_ops.c:128-132, the unused Log/DEBUG_MODE globals :116-121).
+This is the rebuild's replacement:
+
+  - a process-local structured event log (`Tracer`): bounded ring of
+    (usec, rank, kind, fields) records appended by the progress engine
+    at every protocol step — bcast initiate/forward/deliver, proposal
+    judge/vote/decision — cheap enough to leave compiled in (one branch
+    when disabled), drainable as dicts or JSONL;
+  - device-side: `annotate(name)` wraps jax.profiler.TraceAnnotation so
+    collective launches show up named in TPU profiles, and
+    `profile(logdir)` wraps jax.profiler.trace for a capture window.
+
+The native C core has the same facility (rlo_trace_* in rlo_core.h);
+tests assert both sides emit the same event sequence for the same
+scenario.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from enum import IntEnum
+from typing import Deque, Dict, Iterator, List, Optional
+
+
+class Ev(IntEnum):
+    """Event kinds — numbering shared with the C core (rlo_core.h)."""
+    BCAST_INIT = 1      # a = tag, b = payload len
+    BCAST_FWD = 2       # a = tag, b = #targets
+    DELIVER = 3         # a = tag, b = origin
+    PROPOSAL_SUBMIT = 4  # a = pid
+    JUDGE = 5           # a = pid, b = verdict
+    VOTE = 6            # a = pid, b = merged vote
+    DECISION = 7        # a = pid, b = decision
+    DRAIN = 8           # a = spins
+
+
+@dataclass
+class Event:
+    ts_usec: int
+    rank: int
+    kind: Ev
+    a: int = 0
+    b: int = 0
+
+    def to_dict(self) -> Dict:
+        return {"ts_usec": self.ts_usec, "rank": self.rank,
+                "kind": self.kind.name, "a": self.a, "b": self.b}
+
+
+@dataclass
+class Tracer:
+    """Bounded structured event log; disabled by default."""
+    capacity: int = 65536
+    enabled: bool = False
+    _events: Deque[Event] = field(default_factory=deque)
+    dropped: int = 0
+
+    def emit(self, rank: int, kind: Ev, a: int = 0, b: int = 0) -> None:
+        if not self.enabled:
+            return
+        if len(self._events) >= self.capacity:
+            self._events.popleft()
+            self.dropped += 1
+        self._events.append(
+            Event(int(time.time() * 1e6), rank, kind, a, b))
+
+    def events(self, kind: Optional[Ev] = None,
+               rank: Optional[int] = None) -> List[Event]:
+        return [e for e in self._events
+                if (kind is None or e.kind == kind)
+                and (rank is None or e.rank == rank)]
+
+    def clear(self) -> None:
+        self._events.clear()
+        self.dropped = 0
+
+    def dump_jsonl(self, path: str) -> int:
+        with open(path, "w") as f:
+            for e in self._events:
+                f.write(json.dumps(e.to_dict()) + "\n")
+        return len(self._events)
+
+    @contextlib.contextmanager
+    def enable(self) -> Iterator["Tracer"]:
+        prev = self.enabled
+        self.enabled = True
+        try:
+            yield self
+        finally:
+            self.enabled = prev
+
+
+#: default process-wide tracer the engines emit into
+TRACER = Tracer()
+
+
+# ---------------------------------------------------------------------------
+# Device-side: jax.profiler hooks
+# ---------------------------------------------------------------------------
+
+@contextlib.contextmanager
+def annotate(name: str):
+    """Named trace annotation around device work — shows up as a labeled
+    region in TPU profiles (xplane/tensorboard)."""
+    import jax.profiler
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+@contextlib.contextmanager
+def profile(logdir: str):
+    """Capture a jax profiler trace window into ``logdir``."""
+    import jax.profiler
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
