@@ -92,7 +92,10 @@ def allreduce(x, axis: str, *, op: str = "sum", algorithm: str = "auto",
     algorithm: 'psum' lowers to one XLA AllReduce (the baseline to beat);
     'ring' is reduce-scatter + all-gather over explicit ppermute steps with
     the Pallas fused combine (bandwidth-optimal, overlappable); 'recursive
-    doubling' is log2(n) full-vector exchanges (small payloads, pow2 only).
+    doubling' is log2(n) full-vector exchanges (small payloads, pow2 only);
+    'halving_doubling' is recursive-halving reduce-scatter + recursive-
+    doubling all-gather (Rabenseifner — bandwidth-optimal in log2(n) rounds,
+    pow2 only; BASELINE config 4).
     'auto': psum — XLA already picks near-optimal ICI strategies; the manual
     schedules exist to host fused per-step compute and for parity studies.
     """
@@ -113,6 +116,11 @@ def allreduce(x, axis: str, *, op: str = "sum", algorithm: str = "auto",
         chunks, meta = _chunk_shard(x, lax.axis_size(axis))
         _, reduced = _ring_reduce_scatter(chunks, axis, op, use_pallas)
         gathered = _ring_all_gather_rolled(reduced, axis)
+        return _unchunk_shard(gathered, meta)
+    if algorithm == "halving_doubling":
+        chunks, meta = _chunk_shard(x, lax.axis_size(axis))
+        reduced = _halving_reduce_scatter(chunks, axis, op, use_pallas)
+        gathered = _doubling_all_gather(reduced, axis)
         return _unchunk_shard(gathered, meta)
     raise ValueError(f"unknown algorithm {algorithm!r}")
 
@@ -196,14 +204,75 @@ def _ring_all_gather_rolled(chunk, axis: str):
     return out
 
 
+def _halving_reduce_scatter(chunks, axis: str, op: str, use_pallas: bool):
+    """Recursive-halving reduce-scatter (the first phase of halving-doubling
+    / Rabenseifner allreduce). log2(ws) exchange rounds with descending
+    distances ws/2 .. 1: each round, a shard exchanges the half of its
+    current chunk-range that its XOR-partner's subtree owns, and combines
+    the received half into the half it keeps. Shard r ends owning the fully
+    reduced chunk r. Power-of-2 axis sizes only.
+    """
+    ws = chunks.shape[0]
+    idx = lax.axis_index(axis)
+    combine = _combiner(op, use_pallas)
+    cur = chunks  # my current responsibility range; halves every round
+    for dist in topology.halving_doubling_distances(ws):
+        perm = list(topology.xor_perm(ws, dist))
+        # ranks with bit `dist` set keep the upper half of their range
+        in_upper = jnp.bitwise_and(idx, dist) != 0
+        keep = lax.dynamic_slice_in_dim(
+            cur, jnp.where(in_upper, dist, 0), dist, 0)
+        send = lax.dynamic_slice_in_dim(
+            cur, jnp.where(in_upper, 0, dist), dist, 0)
+        recv = lax.ppermute(send, axis, perm)
+        cur = combine(keep, recv)
+    # kept-range starts accumulated (idx & dist) over every bit — the one
+    # remaining chunk is global chunk idx
+    return cur[0]
+
+
+def _doubling_all_gather(chunk, axis: str):
+    """Recursive-doubling all-gather (second phase of halving-doubling).
+
+    Input: shard r holds chunk r. log2(ws) rounds with ascending distances
+    1 .. ws/2: each round a shard exchanges its currently-assembled block
+    with partner rank XOR dist, doubling the block. Returns (ws, chunk)
+    rows in global index order on every shard. Power-of-2 only.
+    """
+    ws = lax.axis_size(axis)
+    idx = lax.axis_index(axis)
+    out = jnp.zeros((ws,) + chunk.shape, chunk.dtype)
+    out = lax.dynamic_update_index_in_dim(out, chunk, idx, 0)
+    for dist in reversed(topology.halving_doubling_distances(ws)):
+        perm = list(topology.xor_perm(ws, dist))
+        start = (idx // dist) * dist  # my block of `dist` assembled rows
+        blk = lax.dynamic_slice_in_dim(out, start, dist, 0)
+        recv = lax.ppermute(blk, axis, perm)
+        out = lax.dynamic_update_slice_in_dim(
+            out, recv, jnp.bitwise_xor(start, dist), 0)
+    return out
+
+
 def reduce_scatter(x, axis: str, *, op: str = "sum",
+                   algorithm: str = "auto",
                    use_pallas: Optional[bool] = None):
     """Shard r returns the r-th equal chunk of the reduction of ``x``
-    (flattened, zero-padded to a multiple of the axis size)."""
+    (flattened, zero-padded to a multiple of the axis size).
+
+    algorithm: 'ring' (ws-1 chunk-sized steps, any axis size),
+    'halving' (log2(ws) recursive-halving rounds, power-of-2 only),
+    'auto' (halving when the axis size is a power of 2, else ring).
+    """
     if use_pallas is None:
         use_pallas = jax.default_backend() == "tpu"
     ws = lax.axis_size(axis)
+    if algorithm == "auto":
+        algorithm = "halving" if topology.is_power_of_2(ws) else "ring"
     chunks, _ = _chunk_shard(x, ws)
+    if algorithm == "halving":
+        return _halving_reduce_scatter(chunks, axis, op, use_pallas)
+    if algorithm != "ring":
+        raise ValueError(f"unknown algorithm {algorithm!r}")
     own_idx, reduced = _ring_reduce_scatter(chunks, axis, op, use_pallas)
     # rotate one hop forward so shard r holds chunk r
     back_perm = list(topology.ring_perm(ws, 1))
@@ -213,10 +282,15 @@ def reduce_scatter(x, axis: str, *, op: str = "sum",
 def all_gather(x, axis: str, *, algorithm: str = "xla"):
     """Concatenate every shard's ``x`` along a new leading axis.
 
-    'xla' lowers to one AllGather; 'ring' uses explicit ppermute steps.
+    'xla' lowers to one AllGather; 'ring' uses ws-1 ppermute steps;
+    'doubling' uses log2(ws) recursive-doubling exchanges (power-of-2 only).
     """
     if algorithm == "xla":
         return lax.all_gather(x, axis)
+    if algorithm == "doubling":
+        return _doubling_all_gather(x, axis)
+    if algorithm != "ring":
+        raise ValueError(f"unknown algorithm {algorithm!r}")
     ws = lax.axis_size(axis)
     idx = lax.axis_index(axis)
     perm = list(topology.ring_perm(ws))

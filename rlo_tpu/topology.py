@@ -255,10 +255,27 @@ def recursive_doubling_rounds(world_size: int) -> Tuple[Tuple[Tuple[int, int], .
     rounds = []
     i = 0
     while (1 << i) < world_size:
-        step = 1 << i
-        rounds.append(tuple((r, r ^ step) for r in range(world_size)))
+        rounds.append(xor_perm(world_size, 1 << i))
         i += 1
     return tuple(rounds)
+
+
+def xor_perm(world_size: int, dist: int) -> Tuple[Tuple[int, int], ...]:
+    """The pairwise-exchange permutation rank <-> rank XOR dist — one
+    self-inverse ppermute (both directions of the exchange in one
+    CollectivePermute)."""
+    return tuple((r, r ^ dist) for r in range(world_size))
+
+
+@functools.lru_cache(maxsize=None)
+def halving_doubling_distances(world_size: int) -> Tuple[int, ...]:
+    """Exchange distances for the recursive-halving reduce-scatter phase,
+    largest first: ws/2, ws/4, ..., 1. Reversed, they are the
+    recursive-doubling all-gather phase — together the halving-doubling
+    (Rabenseifner) allreduce for large tensors (BASELINE config 4)."""
+    if not is_power_of_2(world_size):
+        raise ValueError("halving/doubling requires power-of-2 world size")
+    return tuple(world_size >> k for k in range(1, world_size.bit_length()))
 
 
 def ring_reduce_scatter_chunk(world_size: int, rank: int, step: int) -> int:

@@ -137,3 +137,29 @@ class TestSchedules:
     def test_describe_smoke(self):
         out = T.describe(6)
         assert "rank   5" in out or "rank 5" in out.replace("  ", " ")
+
+
+class TestHalvingDoubling:
+    def test_distances(self):
+        assert T.halving_doubling_distances(8) == (4, 2, 1)
+        assert T.halving_doubling_distances(2) == (1,)
+        with pytest.raises(ValueError, match="power-of-2"):
+            T.halving_doubling_distances(6)
+
+    def test_xor_perm_self_inverse(self):
+        for ws, d in [(8, 4), (8, 2), (8, 1), (16, 8)]:
+            m = dict(T.xor_perm(ws, d))
+            assert sorted(m) == list(range(ws))
+            assert sorted(m.values()) == list(range(ws))
+            assert all(m[m[s]] == s for s in m)
+
+    def test_halving_chunk_ownership(self):
+        """Simulating the halving schedule on plain ints: after all rounds,
+        rank r's kept-range start equals r (shard r owns chunk r)."""
+        ws = 16
+        for rank in range(ws):
+            lo, size = 0, ws
+            for d in T.halving_doubling_distances(ws):
+                lo += d if (rank & d) else 0
+                size //= 2
+            assert (lo, size) == (rank, 1)

@@ -31,7 +31,8 @@ def sharded_rand(shape, dtype=jnp.float32, seed=0):
 
 class TestAllreduce:
     @pytest.mark.parametrize("algorithm", ["psum", "ring",
-                                           "recursive_doubling"])
+                                           "recursive_doubling",
+                                           "halving_doubling"])
     @pytest.mark.parametrize("op", ["sum", "min", "max"])
     def test_matches_psum(self, mesh, algorithm, op):
         x = sharded_rand((WS, 16, 33))  # ragged inner size: forces padding
@@ -98,9 +99,11 @@ class TestAllreduce:
 
 
 class TestReduceScatterAllGather:
-    def test_reduce_scatter_chunks(self, mesh):
+    @pytest.mark.parametrize("algorithm", ["ring", "halving", "auto"])
+    def test_reduce_scatter_chunks(self, mesh, algorithm):
         x = sharded_rand((WS, WS * 5 + 3))  # ragged: padding path
-        f = shard_jit(lambda v: tc.reduce_scatter(v, "x", use_pallas=False),
+        f = shard_jit(lambda v: tc.reduce_scatter(v, "x", algorithm=algorithm,
+                                                  use_pallas=False),
                       mesh, P("x"), P("x"))
         got = np.asarray(f(x))  # (WS * chunk,) concatenated shards
         full = np.asarray(x).sum(0)
@@ -108,14 +111,49 @@ class TestReduceScatterAllGather:
         padded = np.concatenate([full, np.zeros(pad, np.float32)])
         np.testing.assert_allclose(got, padded, rtol=1e-5)
 
-    def test_ring_all_gather_matches_xla(self, mesh):
+    @pytest.mark.parametrize("algorithm", ["ring", "doubling"])
+    def test_all_gather_matches_xla(self, mesh, algorithm):
         x = sharded_rand((WS, 3, 5))
-        ring = shard_jit(lambda v: tc.all_gather(v, "x", algorithm="ring"),
-                         mesh, P("x"), P("x"))
+        man = shard_jit(lambda v: tc.all_gather(v, "x", algorithm=algorithm),
+                        mesh, P("x"), P("x"))
         xla = shard_jit(lambda v: tc.all_gather(v, "x"),
                         mesh, P("x"), P("x"))
-        np.testing.assert_allclose(np.asarray(ring(x)), np.asarray(xla(x)),
+        np.testing.assert_allclose(np.asarray(man(x)), np.asarray(xla(x)),
                                    rtol=1e-6)
+
+    def test_halving_rejects_non_pow2(self):
+        sub = make_mesh((6,), ("x",))
+        x = jnp.ones((6, 12))
+        f = shard_jit(lambda v: tc.reduce_scatter(v, "x",
+                                                  algorithm="halving",
+                                                  use_pallas=False),
+                      sub, P("x"), P("x"))
+        with pytest.raises(ValueError, match="power-of-2"):
+            f(x)
+
+    def test_auto_falls_back_to_ring_non_pow2(self):
+        sub = make_mesh((6,), ("x",))
+        rng = np.random.default_rng(3)
+        x = jnp.asarray(rng.standard_normal((6, 14)), jnp.float32)
+        f = shard_jit(lambda v: tc.reduce_scatter(v, "x", use_pallas=False),
+                      sub, P("x"), P("x"))
+        got = np.asarray(f(x))
+        full = np.asarray(x).sum(0)
+        pad = (-full.size) % 6
+        padded = np.concatenate([full, np.zeros(pad, np.float32)])
+        np.testing.assert_allclose(got, padded, rtol=1e-5)
+
+    def test_halving_doubling_with_pallas_combine(self, mesh):
+        """Pallas fused combine (interpret mode on CPU) inside the halving
+        schedule; bf16 payload (BASELINE config 4 dtype path)."""
+        x = sharded_rand((WS, 16, 128), jnp.bfloat16)
+        f = shard_jit(
+            lambda v: tc.allreduce(v, "x", algorithm="halving_doubling",
+                                   use_pallas=True),
+            mesh, P("x"), P("x"))
+        want = np.asarray(x, np.float32).sum(0)
+        got = np.asarray(f(x), np.float32)
+        np.testing.assert_allclose(got[0], want, rtol=2e-2, atol=0.06)
 
     def test_rs_ag_equals_allreduce(self, mesh):
         x = sharded_rand((WS, 24))
