@@ -20,7 +20,9 @@ Op map (reference -> here):
     votes (the AND-vote is a min-reduce over {0,1}); judgement/action
     callbacks stay on the host around the device step
   - net-new data collectives             -> allreduce (ring /
-    recursive-doubling / psum), reduce_scatter, all_gather, barrier
+    recursive-doubling / halving-doubling / psum), reduce_scatter (ring /
+    halving; auto picks halving on power-of-2 axes), all_gather (xla /
+    ring / doubling), barrier
 """
 
 from __future__ import annotations

@@ -1,0 +1,234 @@
+"""Checkpoint / resume subsystem.
+
+The reference has no checkpointing anywhere (SURVEY.md §5: "Checkpoint /
+resume: none"). The rebuild adds it TPU-natively:
+
+- **Pytree checkpoints** (train state: params, optimizer state, step) via
+  orbax — async save, sharding-aware restore (each shard is written and read
+  by the device that owns it; restoring onto a different mesh re-shards from
+  the template). A pure-numpy ``.npz`` backend serves as a dependency-free
+  fallback and as the format for host-side engine state.
+- **Retention**: `CheckpointManager` keeps the newest `max_to_keep` steps
+  under ``<dir>/step_<n>`` and prunes older ones after each successful save.
+- **Engine snapshot/restore**: a `ProgressEngine`'s durable identity —
+  bcast/pickup counters and its own-proposal bookkeeping (the reference's
+  `sent_bcast_cnt`/`recved_bcast_cnt`, rootless_ops.c:217-219) — can be
+  captured while idle and re-applied after a process restart, so drained
+  engines resume exactly where they stopped. In-flight messages are *not*
+  checkpointable (same contract as the reference's cleanup drain,
+  rootless_ops.c:1606-1647: quiesce first).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import shutil
+from typing import Any, List, Optional
+
+import jax
+import numpy as np
+
+try:  # gated: the subsystem still works without orbax via the npz backend
+    import orbax.checkpoint as ocp
+    _HAVE_ORBAX = True
+except Exception:  # pragma: no cover - orbax is baked into this image
+    ocp = None
+    _HAVE_ORBAX = False
+
+_STEP_RE = re.compile(r"^step_(\d+)$")
+
+
+def _abstract_like(tree):
+    """Shape/dtype/sharding template for a sharded restore."""
+    return jax.tree.map(
+        lambda a: jax.ShapeDtypeStruct(
+            np.shape(a), np.asarray(a).dtype if not hasattr(a, "dtype")
+            else a.dtype, sharding=getattr(a, "sharding", None)), tree)
+
+
+# ---------------------------------------------------------------------------
+# npz backend (fallback + host-side state)
+# ---------------------------------------------------------------------------
+
+def _flatten_paths(tree):
+    leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
+    return {jax.tree_util.keystr(path): leaf for path, leaf in leaves}
+
+
+def _npz_save(path: str, tree) -> None:
+    os.makedirs(path, exist_ok=True)
+    arrays = {k: np.asarray(v) for k, v in _flatten_paths(tree).items()}
+    np.savez(os.path.join(path, "state.npz"), **arrays)
+
+
+def _npz_restore(path: str, like):
+    if like is None:
+        raise ValueError("npz backend requires a `like` template tree")
+    with np.load(os.path.join(path, "state.npz")) as data:
+        flat = dict(data)
+    keys = [jax.tree_util.keystr(p)
+            for p, _ in jax.tree_util.tree_flatten_with_path(like)[0]]
+    missing = [k for k in keys if k not in flat]
+    if missing:
+        raise KeyError(f"checkpoint at {path} missing leaves {missing}")
+    treedef = jax.tree_util.tree_structure(like)
+    leaves = [flat[k] for k in keys]
+    out = jax.tree_util.tree_unflatten(treedef, leaves)
+    # re-impose the template's shardings/dtypes where given
+    def place(a, t):
+        a = np.asarray(a).astype(getattr(t, "dtype", np.asarray(a).dtype))
+        sharding = getattr(t, "sharding", None)
+        return jax.device_put(a, sharding) if sharding is not None \
+            else jax.numpy.asarray(a)
+    return jax.tree.map(place, out, like)
+
+
+# ---------------------------------------------------------------------------
+# Pytree save/restore
+# ---------------------------------------------------------------------------
+
+def save_pytree(path: str, tree, *, backend: str = "auto") -> None:
+    """Write `tree` (any pytree of arrays/scalars) under directory `path`.
+
+    backend 'orbax' (async write, then waited to completion here so the
+    checkpoint is durable on return), 'npz', or 'auto' (orbax if present).
+    """
+    path = os.path.abspath(path)
+    if backend == "auto":
+        backend = "orbax" if _HAVE_ORBAX else "npz"
+    if os.path.exists(path):
+        shutil.rmtree(path)
+    if backend == "orbax":
+        ck = ocp.StandardCheckpointer()
+        ck.save(path, tree)
+        ck.wait_until_finished()
+    elif backend == "npz":
+        _npz_save(path, tree)
+    else:
+        raise ValueError(f"unknown backend {backend!r}")
+    with open(os.path.join(path, "RLO_BACKEND"), "w") as f:
+        f.write(backend)
+
+
+def restore_pytree(path: str, like=None):
+    """Restore the pytree written by `save_pytree`.
+
+    `like` is a template (concrete arrays or ShapeDtypeStructs); when its
+    leaves carry shardings the restore places each shard on its owning
+    device — restoring onto a different mesh re-shards accordingly.
+    """
+    path = os.path.abspath(path)
+    marker = os.path.join(path, "RLO_BACKEND")
+    backend = open(marker).read().strip() if os.path.exists(marker) \
+        else ("orbax" if _HAVE_ORBAX else "npz")
+    if backend == "orbax":
+        ck = ocp.StandardCheckpointer()
+        return ck.restore(path, _abstract_like(like)) if like is not None \
+            else ck.restore(path)
+    return _npz_restore(path, like)
+
+
+class CheckpointManager:
+    """Stepped checkpoints with retention: ``<directory>/step_<n>``.
+
+    save(step, tree) prunes to the newest `max_to_keep` steps on success;
+    restore() with no step loads the latest.
+    """
+
+    def __init__(self, directory: str, *, max_to_keep: int = 3,
+                 backend: str = "auto"):
+        self.directory = os.path.abspath(directory)
+        self.max_to_keep = max_to_keep
+        self.backend = backend
+        os.makedirs(self.directory, exist_ok=True)
+
+    def _step_dir(self, step: int) -> str:
+        return os.path.join(self.directory, f"step_{step}")
+
+    def all_steps(self) -> List[int]:
+        steps = []
+        for name in os.listdir(self.directory):
+            m = _STEP_RE.match(name)
+            if m:
+                steps.append(int(m.group(1)))
+        return sorted(steps)
+
+    def latest_step(self) -> Optional[int]:
+        steps = self.all_steps()
+        return steps[-1] if steps else None
+
+    def save(self, step: int, tree) -> str:
+        path = self._step_dir(step)
+        save_pytree(path, tree, backend=self.backend)
+        for old in self.all_steps()[:-self.max_to_keep or None]:
+            if old != step:
+                shutil.rmtree(self._step_dir(old), ignore_errors=True)
+        return path
+
+    def restore(self, step: Optional[int] = None, like=None):
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                raise FileNotFoundError(
+                    f"no checkpoints under {self.directory}")
+        return restore_pytree(self._step_dir(step), like)
+
+
+# ---------------------------------------------------------------------------
+# Progress-engine snapshot/restore (host-side, quiesced engines only)
+# ---------------------------------------------------------------------------
+
+def engine_state_dict(engine) -> dict:
+    """Snapshot a quiesced ProgressEngine's durable state.
+
+    Requires the engine to be idle (all queues drained) — in-flight
+    store-and-forward traffic cannot be checkpointed, matching the
+    reference's quiesce-then-teardown contract (rootless_ops.c:1606-1647).
+    """
+    if not engine.idle():
+        raise RuntimeError(
+            "engine has in-flight messages; drain before checkpointing")
+    p = engine.my_own_proposal
+    return {
+        "rank": engine.rank,
+        "world_size": engine.world_size,
+        "sent_bcast_cnt": engine.sent_bcast_cnt,
+        "recved_bcast_cnt": engine.recved_bcast_cnt,
+        "total_pickup": engine.total_pickup,
+        "proposal": {"pid": p.pid, "state": int(p.state), "vote": p.vote,
+                     "votes_needed": p.votes_needed,
+                     "votes_recved": p.votes_recved},
+    }
+
+
+def load_engine_state(engine, state: dict) -> None:
+    """Re-apply a snapshot onto a freshly constructed engine of the same
+    rank/world shape."""
+    if (state["rank"], state["world_size"]) != (engine.rank,
+                                               engine.world_size):
+        raise ValueError(
+            f"snapshot is for rank {state['rank']}/{state['world_size']}, "
+            f"engine is rank {engine.rank}/{engine.world_size}")
+    engine.sent_bcast_cnt = state["sent_bcast_cnt"]
+    engine.recved_bcast_cnt = state["recved_bcast_cnt"]
+    engine.total_pickup = state["total_pickup"]
+    p = engine.my_own_proposal
+    snap = state["proposal"]
+    p.pid, p.vote = snap["pid"], snap["vote"]
+    p.state = type(p.state)(snap["state"])
+    p.votes_needed, p.votes_recved = snap["votes_needed"], snap["votes_recved"]
+
+
+def save_engine_state(path: str, engines) -> None:
+    """Write every rank's engine snapshot as one JSON file."""
+    snaps = [engine_state_dict(e) for e in engines]
+    os.makedirs(os.path.dirname(os.path.abspath(path)) or ".", exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(snaps, f)
+
+
+def load_engine_state_file(path: str) -> List[dict]:
+    with open(path) as f:
+        return json.load(f)

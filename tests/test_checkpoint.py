@@ -1,0 +1,176 @@
+"""Checkpoint/resume subsystem (rlo_tpu.utils.checkpoint).
+
+The reference has no checkpointing (SURVEY.md §5); these tests define the
+rebuild's contract: sharded pytree round-trips, retention, bit-exact
+resume-training equivalence, and quiesced engine snapshot/restore.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from rlo_tpu.engine import ProgressEngine, drain
+from rlo_tpu.models.transformer import (TransformerConfig, init_params,
+                                        train_step)
+from rlo_tpu.parallel.mesh import make_mesh
+from rlo_tpu.transport.loopback import LoopbackWorld
+from rlo_tpu.utils import checkpoint as ck
+
+WS = 8
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh((WS,), ("x",))
+
+
+def sharded_tree(mesh):
+    sh = NamedSharding(mesh, P("x"))
+    return {
+        "params": {"w": jax.device_put(
+            jnp.arange(float(WS * 4)).reshape(WS, 4), sh)},
+        "step": jnp.int32(7),
+    }
+
+
+class TestPytreeRoundTrip:
+    @pytest.mark.parametrize("backend", ["orbax", "npz"])
+    def test_round_trip_preserves_values_and_sharding(self, mesh, tmp_path,
+                                                      backend):
+        tree = sharded_tree(mesh)
+        path = str(tmp_path / "ckpt")
+        ck.save_pytree(path, tree, backend=backend)
+        out = ck.restore_pytree(path, like=tree)
+        np.testing.assert_array_equal(np.asarray(out["params"]["w"]),
+                                      np.asarray(tree["params"]["w"]))
+        assert int(out["step"]) == 7
+        assert out["params"]["w"].sharding == tree["params"]["w"].sharding
+
+    def test_restore_onto_different_sharding(self, mesh, tmp_path):
+        """Template controls placement: save sharded, restore replicated."""
+        tree = sharded_tree(mesh)
+        path = str(tmp_path / "ckpt")
+        ck.save_pytree(path, tree)
+        like = jax.tree.map(
+            lambda a: jax.ShapeDtypeStruct(
+                a.shape, a.dtype, sharding=NamedSharding(mesh, P())), tree)
+        out = ck.restore_pytree(path, like=like)
+        assert out["params"]["w"].sharding.spec == P()
+        np.testing.assert_array_equal(np.asarray(out["params"]["w"]),
+                                      np.asarray(tree["params"]["w"]))
+
+    def test_npz_requires_template(self, tmp_path):
+        path = str(tmp_path / "ckpt")
+        ck.save_pytree(path, {"a": np.ones(3)}, backend="npz")
+        with pytest.raises(ValueError, match="template"):
+            ck._npz_restore(path, None)
+
+    def test_npz_missing_leaf(self, tmp_path):
+        path = str(tmp_path / "ckpt")
+        ck.save_pytree(path, {"a": np.ones(3)}, backend="npz")
+        with pytest.raises(KeyError, match="missing"):
+            ck.restore_pytree(path, like={"a": np.ones(3), "b": np.ones(2)})
+
+
+class TestManager:
+    def test_retention_and_latest(self, tmp_path):
+        mgr = ck.CheckpointManager(str(tmp_path / "run"), max_to_keep=3,
+                                   backend="npz")
+        for step in (1, 2, 5, 9, 10):
+            mgr.save(step, {"x": np.full(2, float(step))})
+        assert mgr.all_steps() == [5, 9, 10]
+        assert mgr.latest_step() == 10
+        out = mgr.restore(like={"x": np.zeros(2)})
+        np.testing.assert_array_equal(out["x"], [10.0, 10.0])
+        out5 = mgr.restore(step=5, like={"x": np.zeros(2)})
+        np.testing.assert_array_equal(out5["x"], [5.0, 5.0])
+
+    def test_restore_empty_raises(self, tmp_path):
+        mgr = ck.CheckpointManager(str(tmp_path / "empty"))
+        with pytest.raises(FileNotFoundError):
+            mgr.restore()
+
+
+class TestResumeTraining:
+    def test_resume_matches_uninterrupted(self, tmp_path):
+        """Train 4 steps straight vs train 2, checkpoint, restore into a
+        fresh pytree, train 2 more — parameters must match bit-exactly."""
+        cfg = TransformerConfig(vocab=32, d_model=32, n_heads=2, n_layers=1,
+                                d_ff=64, dtype="float32")
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        rng = np.random.default_rng(0)
+        batches = [jnp.asarray(rng.integers(0, cfg.vocab, (2, 16)), jnp.int32)
+                   for _ in range(4)]
+        step = jax.jit(lambda p, t: train_step(p, t, cfg, lr=1e-2))
+
+        straight = params
+        for b in batches:
+            straight, _ = step(straight, b)
+
+        half = params
+        for b in batches[:2]:
+            half, _ = step(half, b)
+        mgr = ck.CheckpointManager(str(tmp_path / "run"))
+        mgr.save(2, {"params": half, "step": jnp.int32(2)})
+
+        restored = mgr.restore(like={"params": half, "step": jnp.int32(0)})
+        assert int(restored["step"]) == 2
+        resumed = restored["params"]
+        for b in batches[2:]:
+            resumed, _ = step(resumed, b)
+
+        for a, b in zip(jax.tree.leaves(straight), jax.tree.leaves(resumed)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+class TestEngineSnapshot:
+    def test_snapshot_restore_counters(self, tmp_path):
+        world = LoopbackWorld(4)
+        engines = [ProgressEngine(world.transport(r)) for r in range(4)]
+        engines[1].bcast(b"hello")
+        engines[3].bcast(b"again")
+        drain([world], engines)
+        for e in engines:
+            while e.pickup_next() is not None:
+                pass
+        path = str(tmp_path / "engines.json")
+        ck.save_engine_state(path, engines)
+        snaps = ck.load_engine_state_file(path)
+        for e in engines:
+            e.cleanup()
+
+        world2 = LoopbackWorld(4)
+        fresh = [ProgressEngine(world2.transport(r)) for r in range(4)]
+        for e, s in zip(fresh, snaps):
+            ck.load_engine_state(e, s)
+        assert fresh[1].sent_bcast_cnt == 1
+        assert fresh[3].sent_bcast_cnt == 1
+        assert fresh[0].recved_bcast_cnt == 2
+        # resumed engines keep working
+        fresh[2].bcast(b"after-resume")
+        drain([world2], fresh)
+        assert fresh[2].sent_bcast_cnt == 1
+        assert fresh[0].recved_bcast_cnt == 3
+        for e in fresh:
+            e.cleanup()
+
+    def test_snapshot_rejects_busy_engine(self):
+        world = LoopbackWorld(2)
+        engines = [ProgressEngine(world.transport(r)) for r in range(2)]
+        engines[0].queue_wait.append(object())  # simulate in-flight send
+        with pytest.raises(RuntimeError, match="drain"):
+            ck.engine_state_dict(engines[0])
+        engines[0].queue_wait.clear()
+        for e in engines:
+            e.cleanup()
+
+    def test_snapshot_rank_mismatch(self):
+        world = LoopbackWorld(2)
+        engines = [ProgressEngine(world.transport(r)) for r in range(2)]
+        snap = ck.engine_state_dict(engines[0])
+        with pytest.raises(ValueError, match="rank"):
+            ck.load_engine_state(engines[1], snap)
+        for e in engines:
+            e.cleanup()
