@@ -25,9 +25,10 @@ from __future__ import annotations
 
 import enum
 import itertools
+import time
 from collections import deque
 from dataclasses import dataclass, field
-from typing import Callable, List, Optional
+from typing import Callable, List, Optional, Set
 
 from rlo_tpu import topology
 from rlo_tpu.transport.base import SendHandle, Transport
@@ -73,6 +74,10 @@ class ProposalState:
     proposal_payload: bytes = b""
     decision_handles: List[SendHandle] = field(default_factory=list)
     decision_pending: bool = False
+    # direct children whose (subtree-merged) votes are still outstanding;
+    # lets the failure detector discount a dead child so consensus
+    # completes instead of waiting forever (net-new vs the reference)
+    await_from: List[int] = field(default_factory=list)
 
 
 @dataclass
@@ -143,7 +148,21 @@ class ProgressEngine:
                  app_ctx: object = None,
                  action_cb: Optional[ActionCb] = None,
                  msg_size_max: int = MSG_SIZE_MAX,
-                 manager: EngineManager = MANAGER):
+                 manager: EngineManager = MANAGER,
+                 failure_timeout: Optional[float] = None,
+                 heartbeat_interval: Optional[float] = None,
+                 failure_cb: Optional[Callable[[int, bool], None]] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        """``failure_timeout`` (seconds) enables the net-new failure
+        detector (the reference defines RLO_FAILED but never assigns it,
+        SURVEY.md §5): ranks heartbeat their ring successor every
+        ``heartbeat_interval`` (default timeout/4) and declare their
+        predecessor failed after ``failure_timeout`` of silence, then
+        notify the world with a rootless FAILURE broadcast. Survivors
+        elastically re-form the overlay (topology recomputed over the
+        alive set) so broadcasts and consensus keep working.
+        ``failure_cb(rank, detected_locally)`` fires once per learned
+        failure. ``clock`` is injectable for deterministic tests."""
         ws = transport.world_size
         if ws < 2:  # bcomm_init rejects this (rootless_ops.c:1464)
             raise ValueError(f"world_size must be >= 2, got {ws}")
@@ -174,6 +193,21 @@ class ProgressEngine:
         self.my_own_proposal = ProposalState()
         self.my_proposal_payload: bytes = b""
 
+        # failure detection (net-new; SURVEY.md §5 "failure detection:
+        # none" in the reference)
+        self.failure_timeout = failure_timeout
+        self.heartbeat_interval = heartbeat_interval or (
+            failure_timeout / 4 if failure_timeout else None)
+        self.failure_cb = failure_cb
+        self.clock = clock
+        self.failed: Set[int] = set()
+        self.suspected_self = False
+        self._orphaned_props: dict = {}  # pid -> payload (aborted relays)
+        self._alive: List[int] = list(range(ws))
+        self._v = {r: r for r in range(ws)}  # real rank -> virtual rank
+        self._hb_last_sent = float("-inf")
+        self._hb_seen: dict = {}  # sender rank -> last heartbeat clock
+
         self.manager = manager
         self.engine_id = manager.append(self)
 
@@ -194,7 +228,7 @@ class ProgressEngine:
         frame = Frame(origin=self.rank, pid=pid, vote=vote, payload=payload)
         raw = frame.encode()
         msg = _Msg(frame=frame, tag=int(tag))
-        for dst in self.initiator_targets:  # furthest-first
+        for dst in self._cur_initiator_targets():  # furthest-first
             msg.send_handles.append(self.transport.isend(dst, int(tag), raw))
         self.queue_wait.append(msg)
         self.sent_bcast_cnt += 1
@@ -220,7 +254,8 @@ class ProgressEngine:
                 f"progress; wait for completion before submitting another")
         p.pid = pid
         p.vote = 1
-        p.votes_needed = len(self.initiator_targets)
+        p.await_from = list(self._cur_initiator_targets())
+        p.votes_needed = len(p.await_from)
         p.votes_recved = 0
         p.state = ReqState.IN_PROGRESS
         p.decision_handles = []
@@ -301,8 +336,16 @@ class ProgressEngine:
             elif tag == Tag.IAR_DECISION:
                 self.recved_bcast_cnt += 1
                 self._on_decision(msg)
+            elif tag == Tag.HEARTBEAT:
+                self._hb_seen[src] = self.clock()
+            elif tag == Tag.FAILURE:
+                self._on_failure(msg)
             else:
                 self._on_other(msg)
+
+        # (b2) liveness: heartbeat my ring successor, watch my predecessor
+        if self.failure_timeout is not None:
+            self._failure_tick()
 
         # (c) wait_and_pickup sweep (~_wait_and_pickup_queue_process :995).
         # Messages here are never picked up (pickup_next moves them to
@@ -322,8 +365,7 @@ class ProgressEngine:
     # -- broadcast forwarding (~_bc_forward, rootless_ops.c:1104-1225) ----
     def _bc_forward(self, msg: _Msg) -> int:
         origin = msg.frame.origin
-        targets = topology.fwd_targets(self.world_size, self.rank, origin,
-                                       msg.src)
+        targets = self._fwd_targets(origin, msg.src)
         raw = None
         for dst in targets:
             if raw is None:
@@ -376,13 +418,16 @@ class ProgressEngine:
                 f"rank {self.rank}: received a proposal with the pid of my "
                 f"own active proposal ({msg.frame.pid}); pids must be "
                 f"unique across concurrent proposers")
+        # equal to _bc_forward's target list by construction, including
+        # after elastic re-forming (~fwd_send_cnt :1559)
+        children = list(self._fwd_targets(origin, msg.src))
         ps = ProposalState(
             pid=msg.frame.pid,
             recv_from=msg.src,
             state=ReqState.IN_PROGRESS,
             proposal_payload=msg.frame.payload,
-            votes_needed=topology.fwd_send_cnt(
-                self.world_size, self.rank, origin, msg.src),
+            votes_needed=len(children),
+            await_from=children,
         )
         msg.prop_state = ps
         judgment = self._judge(msg.frame.payload, ps.pid)
@@ -399,26 +444,42 @@ class ProgressEngine:
         """~_iar_vote_handler (:743-812). Votes AND-merge upward."""
         pid, vote = msg.frame.pid, msg.frame.vote
         p = self.my_own_proposal
-        if pid == p.pid and p.state == ReqState.IN_PROGRESS:
+        if pid == p.pid:
+            # only votes from children still awaited count: a vote from a
+            # discounted (suspected-dead) child after its subtree was
+            # written off, or arriving after the round completed, must
+            # not advance the count past a live child's pending veto
+            if p.state != ReqState.IN_PROGRESS or msg.src not in \
+                    p.await_from:
+                return
+            p.await_from.remove(msg.src)
             p.votes_recved += 1
             p.vote &= vote
             if p.votes_recved == p.votes_needed:
-                if p.vote:
-                    # re-judge own proposal: a competing proposal may have
-                    # changed the app state since submission (:773)
-                    p.vote = self._judge(self.my_proposal_payload, p.pid)
-                self._decision_bcast(p)
+                self._complete_own_proposal(p)
             return
         # vote for a proposal I'm relaying
         pm = self._find_proposal_msg(pid)
         if pm is None:
+            if self.failure_timeout is not None or self.failed:
+                return  # orphaned by a membership change; drop
             raise RuntimeError(
                 f"rank {self.rank}: vote for unknown proposal pid={pid}")
         ps = pm.prop_state
+        if msg.src not in ps.await_from:
+            return  # late/duplicate vote from a discounted child
+        ps.await_from.remove(msg.src)
         ps.vote &= vote
         ps.votes_recved += 1
         if ps.votes_recved == ps.votes_needed:
             self._vote_back(ps, ps.vote)
+
+    def _complete_own_proposal(self, p: ProposalState) -> None:
+        if p.vote:
+            # re-judge own proposal: a competing proposal may have
+            # changed the app state since submission (:773)
+            p.vote = self._judge(self.my_proposal_payload, p.pid)
+        self._decision_bcast(p)
 
     def _decision_bcast(self, p: ProposalState) -> None:
         """Proposer broadcasts the final decision (~_iar_decision_bcast
@@ -442,8 +503,172 @@ class ProgressEngine:
                                    self.app_ctx)
                 pm.prop_state.state = ReqState.COMPLETED
             self.queue_iar_pending.remove(pm)
+        elif pid in self._orphaned_props:
+            # relay aborted when my vote-tree parent died, but the
+            # proposer survived and its decision reached me through the
+            # re-formed overlay: still honor the action callback
+            if vote and self.action_cb is not None:
+                self.action_cb(self._orphaned_props[pid], self.app_ctx)
+            del self._orphaned_props[pid]
         # deliver the decision to the user either way (:852-854)
         self.queue_pickup.append(msg)
+
+    # ------------------------------------------------------------------
+    # Failure detection + elastic re-forming (net-new; the reference
+    # defines RLO_FAILED, rootless_ops.h:66, but never assigns it and has
+    # no timeouts/retry/rank-failure handling — SURVEY.md §5)
+    #
+    # Consistency contract: membership changes are NOT view-synchronous.
+    # Broadcasts initiated after every survivor has adopted the failure
+    # (and consensus rounds, via vote discounting) are exactly-once; a
+    # broadcast *in flight across* the view change can be forwarded by a
+    # mix of old- and new-topology trees and may reach a survivor twice
+    # or not at all. Applications needing stronger guarantees should
+    # quiesce (drain) after a failure notice before initiating new
+    # traffic — the same quiesce-first discipline the reference requires
+    # for teardown (rootless_ops.c:1606-1647).
+    # ------------------------------------------------------------------
+    def _cur_initiator_targets(self):
+        """Initiator send list over the current alive set. Identity to the
+        static topology while nothing has failed."""
+        if not self.failed:
+            return self.initiator_targets
+        alive = self._alive
+        if len(alive) < 2:
+            return ()
+        vt = topology.initiator_targets(len(alive), self._v[self.rank])
+        return tuple(alive[v] for v in vt)
+
+    def _fwd_targets(self, origin: int, src: int):
+        """Forward targets over the current alive set. Messages routed by
+        a pre-failure view (dead origin/sender) are delivered locally but
+        not re-forwarded — survivors re-broadcast if they need fan-out."""
+        if not self.failed:
+            return topology.fwd_targets(self.world_size, self.rank,
+                                        origin, src)
+        if origin in self.failed or src in self.failed:
+            return ()
+        alive = self._alive
+        if len(alive) < 2:
+            return ()
+        vt = topology.fwd_targets(len(alive), self._v[self.rank],
+                                  self._v[origin], self._v[src])
+        return tuple(alive[v] for v in vt)
+
+    def _ring_neighbors(self):
+        alive = self._alive
+        i = alive.index(self.rank)
+        return alive[(i + 1) % len(alive)], alive[(i - 1) % len(alive)]
+
+    def _failure_tick(self) -> None:
+        if len(self._alive) < 2:
+            return
+        now = self.clock()
+        succ, pred = self._ring_neighbors()
+        if now - self._hb_last_sent >= self.heartbeat_interval:
+            frame = Frame(origin=self.rank)
+            self.transport.isend(succ, int(Tag.HEARTBEAT), frame.encode())
+            self._hb_last_sent = now
+            TRACER.emit(self.rank, Ev.HEARTBEAT, succ)
+        seen = self._hb_seen.setdefault(pred, now)  # grace on first watch
+        if now - seen > self.failure_timeout:
+            self._declare_failed(pred)
+
+    def _declare_failed(self, rank: int) -> None:
+        """Local detection: mark, then tell the world — the failure notice
+        itself rides the rootless broadcast overlay (any rank can detect
+        and announce; no coordinator)."""
+        if not self._mark_failed(rank):
+            return
+        TRACER.emit(self.rank, Ev.FAILURE, rank, 1)
+        self.bcast(b"", tag=Tag.FAILURE, pid=rank)
+        if self.failure_cb is not None:
+            self.failure_cb(rank, True)
+
+    def _on_failure(self, msg: _Msg) -> None:
+        """A FAILURE notification arrived: adopt the new membership BEFORE
+        forwarding so the whole propagation runs on the survivor overlay,
+        then deliver the notice to the user (pid = failed rank)."""
+        rank = msg.frame.pid
+        if rank == self.rank:
+            # somebody suspects me — a false positive from delays; there
+            # is no un-fail protocol (matching the reference's absence of
+            # recovery), so just record it for the application
+            self.suspected_self = True
+            self._bc_forward(msg)
+            return
+        fresh = self._mark_failed(rank)
+        if fresh:
+            TRACER.emit(self.rank, Ev.FAILURE, rank, 0)
+        self._bc_forward(msg)
+        if fresh and self.failure_cb is not None:
+            self.failure_cb(rank, False)
+
+    def _mark_failed(self, rank: int) -> bool:
+        """Adopt a failure into the membership view; returns False if it
+        was already known (idempotent). Re-forms the virtual topology over
+        the survivors — the elastic-recovery step."""
+        if rank in self.failed or rank == self.rank or not (
+                0 <= rank < self.world_size):
+            return False
+        old_pred = (self._ring_neighbors()[1]
+                    if self.failure_timeout is not None
+                    and len(self._alive) >= 2 else None)
+        self.failed.add(rank)
+        self._alive = [r for r in self._alive if r != rank]
+        self._v = {r: v for v, r in enumerate(self._alive)}
+        self._hb_seen.pop(rank, None)
+        if self.failure_timeout is not None and len(self._alive) >= 2:
+            # fresh grace period — but only when my predecessor actually
+            # changed; re-arming an unchanged predecessor's timer on every
+            # learned failure would let a correlated multi-failure defer
+            # detection of an already-silent peer indefinitely
+            _, pred = self._ring_neighbors()
+            if pred != old_pred:
+                self._hb_seen[pred] = self.clock()
+        self._discount_failed_voter(rank)
+        self._abort_orphaned_proposals(rank)
+        return True
+
+    def _discount_failed_voter(self, rank: int) -> None:
+        """A consensus participant died mid-round: its subtree's merged
+        vote will never arrive (sends to it blackhole). Discount it from
+        every pending proposal — a dead rank cannot veto — and complete
+        rounds that were only waiting on it."""
+        p = self.my_own_proposal
+        if (p.state == ReqState.IN_PROGRESS and rank in p.await_from
+                and not p.decision_pending):
+            p.await_from.remove(rank)
+            p.votes_needed -= 1
+            if p.votes_recved == p.votes_needed:
+                self._complete_own_proposal(p)
+        for pm in list(self.queue_iar_pending):
+            ps = pm.prop_state
+            if ps is not None and rank in ps.await_from:
+                ps.await_from.remove(rank)
+                ps.votes_needed -= 1
+                if ps.votes_recved == ps.votes_needed:
+                    self._vote_back(ps, ps.vote)
+
+    def _abort_orphaned_proposals(self, rank: int) -> None:
+        """Relayed proposals whose proposer or vote-tree parent is the
+        dead rank can never resolve (the decision will never be broadcast
+        / the vote-back would blackhole): mark them FAILED and unpark
+        them, so the engine is checkpointable again and the pid is freed.
+        This is the one place the rebuild assigns the reference's
+        otherwise-dead RLO_FAILED state (rootless_ops.h:66)."""
+        for pm in list(self.queue_iar_pending):
+            ps = pm.prop_state
+            if ps is None:
+                continue
+            if pm.frame.origin == rank or ps.recv_from == rank:
+                ps.state = ReqState.FAILED
+                self.queue_iar_pending.remove(pm)
+                if pm.frame.origin != rank:
+                    # proposer may still be alive (only my parent died):
+                    # keep the payload so a decision that reaches me via
+                    # the re-formed overlay can still run the action cb
+                    self._orphaned_props[ps.pid] = ps.proposal_payload
 
     def _on_other(self, msg: _Msg) -> None:
         """Unknown/aux tags go straight to pickup (reference prints and

@@ -82,6 +82,7 @@ def load() -> C.CDLL:
     sig("rlo_world_my_rank", C.c_int, [p])
     sig("rlo_world_transport", C.c_char_p, [p])
     sig("rlo_world_failed", C.c_int, [p])
+    sig("rlo_world_peer_alive", C.c_int, [p, C.c_int, C.c_uint64])
     sig("rlo_mpi_available", C.c_int, [])
     sig("rlo_mpi_world_new", p, [])
     sig("rlo_world_quiescent", C.c_int, [p])
@@ -145,6 +146,13 @@ class NativeWorld:
 
     def quiescent(self) -> bool:
         return bool(self._lib.rlo_world_quiescent(self._w))
+
+    def peer_alive(self, rank: int, timeout_usec: int = 1_000_000) -> bool:
+        """Net-new failure detection (SURVEY.md §5): False when `rank`
+        showed no transport activity for timeout_usec. Always True on
+        transports without a liveness signal (in-process loopback)."""
+        return bool(self._lib.rlo_world_peer_alive(self._w, rank,
+                                                   timeout_usec))
 
     @property
     def sent_cnt(self) -> int:

@@ -44,6 +44,8 @@ enum rlo_tag {
     RLO_TAG_SYS = 8,
     RLO_TAG_DATA = 9,
     RLO_TAG_BARRIER = 10,
+    RLO_TAG_HEARTBEAT = 11, /* point-to-point ring liveness probe */
+    RLO_TAG_FAILURE = 12,   /* rootless failure notification */
 };
 
 /* ---- request/proposal states (reference RLO_Req_stat) ---- */
@@ -126,6 +128,15 @@ int rlo_world_quiescent(const rlo_world *w);
 /* 1 when the world is dead (a peer rank's process failed/aborted);
  * always 0 for in-process transports. Spin loops should poll this. */
 int rlo_world_failed(const rlo_world *w);
+/* Liveness of one peer: 1 when `rank`'s process showed activity within
+ * the last timeout_usec (net-new failure detection — the reference
+ * defines RLO_FAILED, rootless_ops.h:66, but never assigns it and has no
+ * timeouts or rank-failure handling, SURVEY.md §5). Transports without a
+ * liveness signal (loopback: in-process) always return 1. On shm, every
+ * rank stamps a shared heartbeat slot whenever it pumps its rings, so a
+ * crashed or exited peer goes stale within one timeout. */
+int rlo_world_peer_alive(const rlo_world *w, int rank,
+                         uint64_t timeout_usec);
 int64_t rlo_world_sent_cnt(const rlo_world *w);
 int64_t rlo_world_delivered_cnt(const rlo_world *w);
 
@@ -234,6 +245,8 @@ enum rlo_ev {
     RLO_EV_VOTE = 6,       /* a = pid, b = merged vote */
     RLO_EV_DECISION = 7,   /* a = pid, b = decision */
     RLO_EV_DRAIN = 8,      /* a = spins */
+    RLO_EV_HEARTBEAT = 9,  /* a = destination rank */
+    RLO_EV_FAILURE = 10,   /* a = failed rank, b = 1 local / 0 learned */
 };
 
 typedef struct rlo_trace_event {

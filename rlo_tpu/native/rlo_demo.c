@@ -17,6 +17,9 @@
  *              engines on one world, concurrent proposals, both verified
  *   multi    ~ test_iar_multi_proposal (:401-486): several simultaneous
  *              proposers; every rank counts the expected decisions
+ *   fail     net-new (no reference analogue): one rank crashes; the
+ *              others detect it through shm heartbeat staleness
+ *              (rlo_world_peer_alive) instead of hanging in a drain
  *
  * Usage: ./rlo_demo [-n ranks] [-c case|all] [-m msgs] [-v]
  * Exit status 0 iff every rank's oracle held.
@@ -331,6 +334,50 @@ static int case_multi(rlo_world *w, int rank, void *vcfg)
     return 0;
 }
 
+/* ---- fail: a rank dies; survivors detect it via shm heartbeats ----
+ * Net-new failure detection (the reference defines RLO_FAILED,
+ * rootless_ops.h:66, but never assigns it; no timeouts or rank-failure
+ * handling anywhere — SURVEY.md §5). The victim (last rank) exits right
+ * after the start barrier without draining, simulating a crash: its
+ * heartbeat slot goes stale. Survivors spin progress (which pumps rings
+ * and stamps their own heartbeats) until rlo_world_peer_alive reports
+ * the victim dead, while confirming no false positive on launch-fresh
+ * peers. No global drain — that is the point: a dead rank would hang
+ * the reference's MPI_Iallreduce-style drain forever. */
+static int case_fail(rlo_world *w, int rank, void *vcfg)
+{
+    const demo_cfg *cfg = (const demo_cfg *)vcfg;
+    int ws = rlo_world_size(w);
+    int victim = ws - 1;
+    const uint64_t timeout_usec = 300 * 1000;
+    /* everyone is up and launch-stamped: no peer may look dead yet
+     * against a generous window */
+    for (int r = 0; r < ws; r++)
+        RCHECK(rlo_world_peer_alive(w, r, 60 * 1000 * 1000));
+    rlo_shm_barrier(w);
+    if (rank == victim)
+        return 0; /* "crash": stop pumping, heartbeat goes stale */
+    rlo_engine *e = rlo_engine_new(w, rank, 0, 0, 0, 0, 0, 0);
+    RCHECK(e);
+    uint64_t t0 = rlo_now_usec();
+    int detected = 0;
+    while (rlo_now_usec() - t0 < 30ull * 1000 * 1000) {
+        rlo_progress_all(w); /* pumps rings -> stamps my heartbeat */
+        if (!rlo_world_peer_alive(w, victim, timeout_usec)) {
+            detected = 1;
+            break;
+        }
+    }
+    RCHECK(detected);
+    RCHECK(rlo_world_peer_alive(w, rank, timeout_usec)); /* self alive */
+    if (cfg->verbose)
+        fprintf(stderr, "rank %d: victim %d detected dead in %llu usec\n",
+                rank, victim,
+                (unsigned long long)(rlo_now_usec() - t0));
+    rlo_engine_free(e);
+    return 0;
+}
+
 /* ------------------------------------------------------------------ */
 
 typedef struct demo_case {
@@ -342,6 +389,7 @@ static const demo_case CASES[] = {
     {"bcast", case_bcast},   {"wrapper", case_wrapper},
     {"hacky", case_hacky},   {"iar", case_iar},
     {"iar2", case_iar2},     {"multi", case_multi},
+    {"fail", case_fail},
 };
 #define N_CASES (int)(sizeof CASES / sizeof *CASES)
 

@@ -64,6 +64,9 @@ typedef struct rlo_transport_ops {
     int (*drain)(rlo_world *w, int max_spins);
     /* 1 when the world is dead (a peer process failed); NULL = never */
     int (*failed)(const rlo_world *w);
+    /* 1 when `rank` showed liveness within timeout_usec; NULL = the
+     * transport has no liveness signal (peers always considered alive) */
+    int (*peer_alive)(const rlo_world *w, int rank, uint64_t timeout_usec);
     void (*free_)(rlo_world *w);
 } rlo_transport_ops;
 

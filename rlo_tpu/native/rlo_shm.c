@@ -76,6 +76,11 @@ typedef struct shm_hdr {
     _Atomic int barrier_gen;
     _Atomic int abort_flag; /* a rank hit a fatal error */
     _Atomic int idle_flag[SHM_MAX_RANKS];
+    /* per-rank heartbeat (usec clock): stamped on every ring pump so a
+     * crashed/exited peer goes stale within one failure timeout — the
+     * net-new failure-detection signal (the reference has none,
+     * SURVEY.md §5); read by rlo_world_peer_alive */
+    _Atomic uint64_t hb_usec[SHM_MAX_RANKS];
 } shm_hdr;
 
 typedef struct rlo_shm_world {
@@ -152,6 +157,8 @@ static int shm_pump(rlo_shm_world *w)
     int moved = 0;
     int ws = w->base.world_size;
     int me = w->base.my_rank;
+    atomic_store_explicit(&w->hdr->hb_usec[me], rlo_now_usec(),
+                          memory_order_relaxed);
     int64_t cap = w->hdr->ring_bytes;
     for (int src = 0; src < ws; src++) {
         if (src == me)
@@ -413,6 +420,18 @@ static int shm_failed(const rlo_world *base)
     return atomic_load(&((const rlo_shm_world *)base)->hdr->abort_flag);
 }
 
+static int shm_peer_alive(const rlo_world *base, int rank,
+                          uint64_t timeout_usec)
+{
+    const rlo_shm_world *w = (const rlo_shm_world *)base;
+    if (rank == base->my_rank)
+        return 1;
+    uint64_t last = atomic_load_explicit(&w->hdr->hb_usec[rank],
+                                         memory_order_relaxed);
+    uint64_t now = rlo_now_usec();
+    return now < last || now - last <= timeout_usec;
+}
+
 static const rlo_transport_ops SHM_OPS = {
     .name = "shm",
     .isend = shm_isend,
@@ -422,6 +441,7 @@ static const rlo_transport_ops SHM_OPS = {
     .delivered_cnt = shm_delivered,
     .drain = shm_drain,
     .failed = shm_failed,
+    .peer_alive = shm_peer_alive,
     .free_ = shm_free,
 };
 
@@ -463,6 +483,10 @@ int rlo_shm_launch(int world_size, int64_t ring_bytes, rlo_rank_fn fn,
     memset(h, 0, sizeof(*h));
     h->world_size = world_size;
     h->ring_bytes = ring_bytes;
+    /* stamp every heartbeat slot now so no rank reads stale-at-birth */
+    uint64_t now = rlo_now_usec();
+    for (int r = 0; r < world_size; r++)
+        atomic_store(&h->hb_usec[r], now);
 
     pid_t pids[SHM_MAX_RANKS];
     int nforked = 0;

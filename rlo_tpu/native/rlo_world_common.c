@@ -45,6 +45,16 @@ int rlo_world_failed(const rlo_world *w)
     return w->ops->failed ? w->ops->failed(w) : 0;
 }
 
+int rlo_world_peer_alive(const rlo_world *w, int rank,
+                         uint64_t timeout_usec)
+{
+    if (rank < 0 || rank >= w->world_size)
+        return 0;
+    if (!w->ops->peer_alive)
+        return 1; /* no liveness signal: in-process peers can't die */
+    return w->ops->peer_alive(w, rank, timeout_usec);
+}
+
 void rlo_world_free(rlo_world *w)
 {
     if (!w)

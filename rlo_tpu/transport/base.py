@@ -27,6 +27,12 @@ from typing import Callable, Dict, Optional, Tuple
 class SendHandle(abc.ABC):
     """Completion handle for a nonblocking send (~ MPI_Request)."""
 
+    #: True when the send terminated without delivering (peer dead or the
+    #: message was dropped by fault injection). ``done()`` still returns
+    #: True — the request is no longer in flight, mirroring an MPI send
+    #: completing with MPI_ERR_* in its status rather than hanging.
+    failed: bool = False
+
     @abc.abstractmethod
     def done(self) -> bool:
         """Test for completion; must be cheap and non-blocking."""
@@ -40,6 +46,18 @@ class CompletedSend(SendHandle):
 
 
 COMPLETED_SEND = CompletedSend()
+
+
+class FailedSend(SendHandle):
+    """Handle for a send that terminated without delivery."""
+
+    failed = True
+
+    def done(self) -> bool:
+        return True
+
+
+FAILED_SEND = FailedSend()
 
 
 class Transport(abc.ABC):

@@ -18,8 +18,8 @@ import threading
 from collections import deque
 from typing import List, Optional, Tuple
 
-from rlo_tpu.transport.base import (COMPLETED_SEND, SendHandle, Transport,
-                                    register_transport)
+from rlo_tpu.transport.base import (COMPLETED_SEND, FAILED_SEND, SendHandle,
+                                    Transport, register_transport)
 
 
 class _PendingSend(SendHandle):
@@ -61,6 +61,9 @@ class LoopbackWorld:
         self.latency = latency
         self.rng = random.Random(seed)
         self.lock = threading.RLock()
+        self.dead: set = set()      # killed ranks (fault injection)
+        self._drops: dict = {}      # (src, dst) -> #messages to drop
+        self.dropped_cnt = 0
         self.inboxes: List[deque] = [deque() for _ in range(world_size)]
         # per-(src, dst) FIFO channels of held messages:
         # (deliver_at_tick, tag, data, handle). Only channel heads can become
@@ -80,6 +83,16 @@ class LoopbackWorld:
         if not 0 <= dst < self.world_size:
             raise ValueError(f"bad destination rank {dst}")
         with self.lock:
+            if src in self.dead or dst in self.dead:
+                # a dead host's packets never leave it; packets to a dead
+                # host vanish. The handle completes failed so the sender's
+                # queues drain instead of hanging.
+                return FAILED_SEND
+            pending = self._drops.get((src, dst), 0)
+            if pending:  # message-loss injection
+                self._drops[(src, dst)] = pending - 1
+                self.dropped_cnt += 1
+                return FAILED_SEND
             self.sent_cnt += 1
             if self.latency <= 0:
                 self.inboxes[dst].append((src, tag, bytes(data)))
@@ -109,11 +122,38 @@ class LoopbackWorld:
 
     def _poll(self, rank: int) -> Optional[Tuple[int, int, bytes]]:
         with self.lock:
+            if rank in self.dead:
+                return None
             self.tick += 1
             self._deliver_due()
             if self.inboxes[rank]:
                 return self.inboxes[rank].popleft()
             return None
+
+    # -- fault injection ---------------------------------------------------
+    def kill_rank(self, rank: int) -> None:
+        """Simulate a rank's process dying: its inbox is discarded, frames
+        in flight to or from it are dropped (their handles complete
+        ``failed``), future traffic involving it is blackholed, and its
+        polls return nothing. The reference has no failure handling at all
+        (SURVEY.md §5: RLO_FAILED is never assigned) — this is the
+        injection side of the net-new failure-detection subsystem."""
+        if not 0 <= rank < self.world_size:
+            raise ValueError(f"bad rank {rank}")
+        with self.lock:
+            self.dead.add(rank)
+            self.inboxes[rank].clear()
+            for chan in [c for c in self.channels
+                         if c[0] == rank or c[1] == rank]:
+                for _, _, _, h in self.channels[chan]:
+                    h.delivered = True
+                    h.failed = True
+                del self.channels[chan]
+
+    def drop_next(self, src: int, dst: int, count: int = 1) -> None:
+        """Silently drop the next ``count`` messages sent src -> dst."""
+        with self.lock:
+            self._drops[(src, dst)] = self._drops.get((src, dst), 0) + count
 
     # -- observability -----------------------------------------------------
     def quiescent(self) -> bool:

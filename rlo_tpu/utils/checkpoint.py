@@ -21,6 +21,7 @@ resume: none"). The rebuild adds it TPU-natively:
 
 from __future__ import annotations
 
+import base64
 import json
 import os
 import re
@@ -89,27 +90,57 @@ def _npz_restore(path: str, like):
 # Pytree save/restore
 # ---------------------------------------------------------------------------
 
+def _recover_swap(path: str) -> None:
+    """Heal a crash inside save_pytree's rename swap: if `path` is gone
+    but a COMPLETE copy (RLO_BACKEND marker present) sits at the .tmp-rlo
+    (newer) or .old-rlo (previous) sibling, promote it back into place
+    before anything deletes it."""
+    if os.path.exists(path):
+        return
+    for cand in (path + ".tmp-rlo", path + ".old-rlo"):
+        if os.path.exists(os.path.join(cand, "RLO_BACKEND")):
+            os.rename(cand, path)
+            return
+
+
 def save_pytree(path: str, tree, *, backend: str = "auto") -> None:
     """Write `tree` (any pytree of arrays/scalars) under directory `path`.
 
     backend 'orbax' (async write, then waited to completion here so the
     checkpoint is durable on return), 'npz', or 'auto' (orbax if present).
+
+    Crash-atomic: the checkpoint is assembled in a sibling temp directory
+    (the RLO_BACKEND marker written last) and swapped in with atomic
+    renames; save and restore first heal any crash inside the swap window
+    itself (promote a complete .tmp-rlo/.old-rlo sibling back into
+    place), so a kill at any point leaves a complete checkpoint
+    reachable at `path` — never a partial. A directory without the
+    marker is a crashed partial and is never a valid checkpoint
+    (CheckpointManager skips and prunes them).
     """
     path = os.path.abspath(path)
     if backend == "auto":
         backend = "orbax" if _HAVE_ORBAX else "npz"
-    if os.path.exists(path):
-        shutil.rmtree(path)
+    _recover_swap(path)
+    tmp, old = path + ".tmp-rlo", path + ".old-rlo"
+    for stale in (tmp, old):
+        if os.path.exists(stale):
+            shutil.rmtree(stale)
     if backend == "orbax":
         ck = ocp.StandardCheckpointer()
-        ck.save(path, tree)
+        ck.save(tmp, tree)
         ck.wait_until_finished()
     elif backend == "npz":
-        _npz_save(path, tree)
+        _npz_save(tmp, tree)
     else:
         raise ValueError(f"unknown backend {backend!r}")
-    with open(os.path.join(path, "RLO_BACKEND"), "w") as f:
+    with open(os.path.join(tmp, "RLO_BACKEND"), "w") as f:
         f.write(backend)
+    if os.path.exists(path):
+        os.rename(path, old)
+    os.rename(tmp, path)
+    if os.path.exists(old):
+        shutil.rmtree(old)
 
 
 def restore_pytree(path: str, like=None):
@@ -120,6 +151,7 @@ def restore_pytree(path: str, like=None):
     device — restoring onto a different mesh re-shards accordingly.
     """
     path = os.path.abspath(path)
+    _recover_swap(path)
     marker = os.path.join(path, "RLO_BACKEND")
     backend = open(marker).read().strip() if os.path.exists(marker) \
         else ("orbax" if _HAVE_ORBAX else "npz")
@@ -148,10 +180,21 @@ class CheckpointManager:
         return os.path.join(self.directory, f"step_{step}")
 
     def all_steps(self) -> List[int]:
+        """Steps with a COMPLETE checkpoint. Partial directories left by
+        a crash mid-save lack the RLO_BACKEND marker (written last) and
+        are excluded, so restore() falls back to the last good step.
+        Complete checkpoints stranded mid-swap (.tmp-rlo/.old-rlo) are
+        first promoted back into place."""
+        for name in os.listdir(self.directory):
+            for suffix in (".tmp-rlo", ".old-rlo"):
+                if name.endswith(suffix):
+                    _recover_swap(os.path.join(self.directory,
+                                               name[:-len(suffix)]))
         steps = []
         for name in os.listdir(self.directory):
             m = _STEP_RE.match(name)
-            if m:
+            if m and os.path.exists(
+                    os.path.join(self.directory, name, "RLO_BACKEND")):
                 steps.append(int(m.group(1)))
         return sorted(steps)
 
@@ -165,6 +208,14 @@ class CheckpointManager:
         for old in self.all_steps()[:-self.max_to_keep or None]:
             if old != step:
                 shutil.rmtree(self._step_dir(old), ignore_errors=True)
+        # sweep crashed partials (unmarked step dirs, leftover swap dirs)
+        for name in os.listdir(self.directory):
+            full = os.path.join(self.directory, name)
+            stale = name.endswith((".tmp-rlo", ".old-rlo")) or (
+                _STEP_RE.match(name)
+                and not os.path.exists(os.path.join(full, "RLO_BACKEND")))
+            if stale and full != path:
+                shutil.rmtree(full, ignore_errors=True)
         return path
 
     def restore(self, step: Optional[int] = None, like=None):
@@ -183,14 +234,30 @@ class CheckpointManager:
 def engine_state_dict(engine) -> dict:
     """Snapshot a quiesced ProgressEngine's durable state.
 
-    Requires the engine to be idle (all queues drained) — in-flight
-    store-and-forward traffic cannot be checkpointed, matching the
-    reference's quiesce-then-teardown contract (rootless_ops.c:1606-1647).
+    Requires the engine to be idle (no outbound work in flight) and not
+    mid-consensus — an own proposal awaiting votes or a relayed proposal
+    awaiting subtree votes cannot be checkpointed, because the votes
+    would arrive at a process that no longer exists; complete or drain
+    the round first (the reference's quiesce-then-teardown contract,
+    rootless_ops.c:1606-1647). Delivered-but-unpicked messages ARE
+    captured (and restored), so applications resume with their pickup
+    queue intact.
     """
+    from rlo_tpu.engine import ReqState
+
     if not engine.idle():
         raise RuntimeError(
             "engine has in-flight messages; drain before checkpointing")
     p = engine.my_own_proposal
+    if p.state == ReqState.IN_PROGRESS or engine.queue_iar_pending:
+        raise RuntimeError(
+            "engine is mid-consensus (own proposal awaiting votes or "
+            "relayed proposals pending); complete the round before "
+            "checkpointing")
+    pickup = [{"tag": m.tag, "origin": m.frame.origin, "pid": m.frame.pid,
+               "vote": m.frame.vote,
+               "data": base64.b64encode(m.frame.payload).decode()}
+              for m in engine.queue_pickup]
     return {
         "rank": engine.rank,
         "world_size": engine.world_size,
@@ -200,6 +267,7 @@ def engine_state_dict(engine) -> dict:
         "proposal": {"pid": p.pid, "state": int(p.state), "vote": p.vote,
                      "votes_needed": p.votes_needed,
                      "votes_recved": p.votes_recved},
+        "pickup": pickup,
     }
 
 
@@ -211,6 +279,9 @@ def load_engine_state(engine, state: dict) -> None:
         raise ValueError(
             f"snapshot is for rank {state['rank']}/{state['world_size']}, "
             f"engine is rank {engine.rank}/{engine.world_size}")
+    from rlo_tpu.engine import _Msg
+    from rlo_tpu.wire import Frame
+
     engine.sent_bcast_cnt = state["sent_bcast_cnt"]
     engine.recved_bcast_cnt = state["recved_bcast_cnt"]
     engine.total_pickup = state["total_pickup"]
@@ -219,6 +290,11 @@ def load_engine_state(engine, state: dict) -> None:
     p.pid, p.vote = snap["pid"], snap["vote"]
     p.state = type(p.state)(snap["state"])
     p.votes_needed, p.votes_recved = snap["votes_needed"], snap["votes_recved"]
+    for m in state.get("pickup", []):
+        frame = Frame(origin=m["origin"], pid=m["pid"], vote=m["vote"],
+                      payload=base64.b64decode(m["data"]))
+        engine.queue_pickup.append(
+            _Msg(frame=frame, tag=m["tag"], fwd_done=True))
 
 
 def save_engine_state(path: str, engines) -> None:

@@ -40,6 +40,8 @@ class Ev(IntEnum):
     VOTE = 6            # a = pid, b = merged vote
     DECISION = 7        # a = pid, b = decision
     DRAIN = 8           # a = spins
+    HEARTBEAT = 9       # a = destination rank
+    FAILURE = 10        # a = failed rank, b = 1 local detection / 0 learned
 
 
 @dataclass

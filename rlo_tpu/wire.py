@@ -37,10 +37,13 @@ class Tag(enum.IntEnum):
     SYS = 8
     DATA = 9
     BARRIER = 10
+    HEARTBEAT = 11   # point-to-point ring liveness probe (net-new)
+    FAILURE = 12     # rootless failure notification; pid = failed rank
 
 
 #: Tags that are store-and-forward broadcast over the skip-ring overlay.
-BCAST_TAGS = frozenset({Tag.BCAST, Tag.IAR_PROPOSAL, Tag.IAR_DECISION})
+BCAST_TAGS = frozenset({Tag.BCAST, Tag.IAR_PROPOSAL, Tag.IAR_DECISION,
+                        Tag.FAILURE})
 
 _HEADER = struct.Struct("<iiiQ")  # origin, pid, vote, data_len
 HEADER_SIZE = _HEADER.size

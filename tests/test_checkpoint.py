@@ -87,6 +87,63 @@ class TestManager:
         out5 = mgr.restore(step=5, like={"x": np.zeros(2)})
         np.testing.assert_array_equal(out5["x"], [5.0, 5.0])
 
+    def test_partial_checkpoint_falls_back_to_last_good(self, tmp_path):
+        """A crash mid-save leaves a step dir without the RLO_BACKEND
+        marker (it is written last); restore() must skip it and load the
+        newest COMPLETE step instead of failing."""
+        import os
+        mgr = ck.CheckpointManager(str(tmp_path), backend="npz")
+        mgr.save(9, {"w": np.arange(4.0)})
+        # simulate a kill mid-save of step 10: dir + truncated payload,
+        # no marker
+        partial = os.path.join(str(tmp_path), "step_10")
+        os.makedirs(partial)
+        with open(os.path.join(partial, "state.npz"), "wb") as f:
+            f.write(b"\x00\x01truncated")
+        assert mgr.latest_step() == 9
+        out = mgr.restore(like={"w": np.zeros(4)})
+        np.testing.assert_array_equal(np.asarray(out["w"]), np.arange(4.0))
+        # the next successful save sweeps the crashed partial
+        mgr.save(11, {"w": np.arange(4.0) + 1})
+        assert not os.path.exists(partial)
+
+    def test_overwrite_is_swap_not_delete_first(self, tmp_path):
+        """save_pytree over an existing checkpoint assembles the new one
+        in a temp dir and swaps by rename — at no point is the directory
+        a half-written mix."""
+        import os
+        path = str(tmp_path / "ckpt")
+        ck.save_pytree(path, {"w": np.arange(3.0)}, backend="npz")
+        ck.save_pytree(path, {"w": np.arange(3.0) * 2}, backend="npz")
+        out = ck.restore_pytree(path, like={"w": np.zeros(3)})
+        np.testing.assert_array_equal(np.asarray(out["w"]),
+                                      np.arange(3.0) * 2)
+        leftovers = [n for n in os.listdir(str(tmp_path))
+                     if n.endswith((".tmp-rlo", ".old-rlo"))]
+        assert leftovers == []
+
+    def test_crash_inside_swap_window_recovers(self, tmp_path):
+        """A kill between save_pytree's two renames leaves the complete
+        new checkpoint at .tmp-rlo and nothing at the path; restore and
+        the manager must promote it back instead of losing both copies."""
+        import os
+        path = str(tmp_path / "ckpt")
+        ck.save_pytree(path, {"w": np.arange(5.0)}, backend="npz")
+        # simulate the window: old renamed away, tmp complete, not swapped
+        os.rename(path, path + ".old-rlo")
+        shutil_copytree = __import__("shutil").copytree
+        shutil_copytree(path + ".old-rlo", path + ".tmp-rlo")
+        out = ck.restore_pytree(path, like={"w": np.zeros(5)})
+        np.testing.assert_array_equal(np.asarray(out["w"]), np.arange(5.0))
+        assert os.path.exists(path)
+        # manager-level: a stranded step promotes during all_steps()
+        mgr = ck.CheckpointManager(str(tmp_path / "m"), backend="npz")
+        mgr.save(4, {"w": np.arange(2.0)})
+        os.rename(mgr._step_dir(4), mgr._step_dir(4) + ".tmp-rlo")
+        assert mgr.latest_step() == 4
+        out = mgr.restore(like={"w": np.zeros(2)})
+        np.testing.assert_array_equal(np.asarray(out["w"]), np.arange(2.0))
+
     def test_restore_empty_raises(self, tmp_path):
         mgr = ck.CheckpointManager(str(tmp_path / "empty"))
         with pytest.raises(FileNotFoundError):
@@ -164,6 +221,51 @@ class TestEngineSnapshot:
             ck.engine_state_dict(engines[0])
         engines[0].queue_wait.clear()
         for e in engines:
+            e.cleanup()
+
+    def test_snapshot_carries_pickup_queue(self, tmp_path):
+        """Delivered-but-unpicked messages survive a snapshot/restore, so
+        an application resumes with its pickup queue intact."""
+        world = LoopbackWorld(3)
+        engines = [ProgressEngine(world.transport(r)) for r in range(3)]
+        engines[0].bcast(b"undelivered-payload")
+        drain([world], engines)
+        snap = ck.engine_state_dict(engines[2])  # NOT picked up yet
+        for e in engines:
+            e.cleanup()
+        world2 = LoopbackWorld(3)
+        fresh = ProgressEngine(world2.transport(2))
+        ck.load_engine_state(fresh, snap)
+        msg = fresh.pickup_next()
+        assert msg is not None and msg.data == b"undelivered-payload"
+        assert msg.origin == 0
+        assert fresh.pickup_next() is None
+        fresh.cleanup()
+
+    def test_snapshot_rejects_mid_consensus(self):
+        """An own proposal awaiting votes cannot be checkpointed — the
+        votes would arrive at a process that no longer exists. Split
+        managers so the proposer's sends complete (idle) while the peers
+        have not judged yet: the mid-consensus gate, not the in-flight
+        gate, must catch this."""
+        from rlo_tpu.engine import EngineManager
+        world = LoopbackWorld(4)
+        mgr_p, mgr_o = EngineManager(), EngineManager()
+        proposer = ProgressEngine(world.transport(0), manager=mgr_p)
+        others = [ProgressEngine(world.transport(r), manager=mgr_o)
+                  for r in range(1, 4)]
+        rc = proposer.submit_proposal(b"p", pid=0)
+        assert rc == -1 and proposer.idle()  # sends done, votes pending
+        with pytest.raises(RuntimeError, match="mid-consensus"):
+            ck.engine_state_dict(proposer)
+        for _ in range(1000):
+            mgr_o.progress_all()
+            mgr_p.progress_all()
+            if proposer.vote_my_proposal() != -1:
+                break
+        assert proposer.vote_my_proposal() == 1
+        drain([world], [proposer] + others)
+        for e in [proposer] + others:
             e.cleanup()
 
     def test_snapshot_rank_mismatch(self):

@@ -1,0 +1,358 @@
+"""Failure detection + elastic recovery + fault injection.
+
+The reference has NO failure handling: RLO_FAILED exists in the status
+enum (/root/reference/rootless_ops.h:66) but is never assigned, and there
+are no timeouts, retries, or rank-failure paths (SURVEY.md §5). This is
+the net-new subsystem's test suite: ring-heartbeat liveness detection,
+rootless FAILURE notification over the broadcast overlay, elastic
+re-forming of the survivor topology, and the loopback transport's fault
+injection (rank kill, message drop).
+"""
+
+import pytest
+
+from rlo_tpu.engine import EngineManager, ProgressEngine, drain
+from rlo_tpu.transport.loopback import LoopbackWorld
+from rlo_tpu.wire import Tag
+
+
+class FakeClock:
+    """Deterministic injectable clock shared by every engine."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def make_world(ws, timeout=8.0, interval=1.0, **kw):
+    clock = FakeClock()
+    world = LoopbackWorld(ws)
+    mgr = EngineManager()
+    notices = []
+    engines = [
+        ProgressEngine(world.transport(r), manager=mgr,
+                       failure_timeout=timeout,
+                       heartbeat_interval=interval,
+                       failure_cb=lambda rank, local, r=r: notices.append(
+                           (r, rank, local)),
+                       clock=clock, **kw)
+        for r in range(ws)
+    ]
+    return world, mgr, engines, clock, notices
+
+
+def kill(world, mgr, engines, rank):
+    """Fault injection: the rank's process dies."""
+    world.kill_rank(rank)
+    engines[rank].cleanup()  # a dead process stops turning its gears
+
+
+def spin(mgr, clock, ticks, dt=0.5):
+    for _ in range(ticks):
+        clock.advance(dt)
+        mgr.progress_all()
+
+
+# ---------------------------------------------------------------------------
+# Transport-level fault injection
+# ---------------------------------------------------------------------------
+
+class TestInjection:
+    def test_kill_blackholes_traffic(self):
+        world = LoopbackWorld(4)
+        world.kill_rank(2)
+        h = world.transport(0).isend(2, int(Tag.BCAST), b"x")
+        assert h.done() and h.failed
+        h2 = world.transport(2).isend(0, int(Tag.BCAST), b"y")
+        assert h2.done() and h2.failed
+        assert world.transport(2).poll() is None
+        assert world.transport(0).poll() is None  # nothing arrived
+        assert world.quiescent()
+
+    def test_kill_drops_in_flight(self):
+        world = LoopbackWorld(4, latency=10, seed=3)
+        h = world.transport(0).isend(3, int(Tag.BCAST), b"x")
+        assert not h.done()
+        world.kill_rank(3)
+        assert h.done() and h.failed
+        assert world.quiescent()
+
+    def test_drop_next(self):
+        world = LoopbackWorld(2)
+        world.drop_next(0, 1, count=1)
+        h = world.transport(0).isend(1, int(Tag.BCAST), b"lost")
+        assert h.done() and h.failed
+        world.transport(0).isend(1, int(Tag.BCAST), b"kept")
+        src, tag, data = world.transport(1).poll()
+        assert data == b"kept" and world.dropped_cnt == 1
+
+
+# ---------------------------------------------------------------------------
+# Detection
+# ---------------------------------------------------------------------------
+
+class TestDetection:
+    def test_no_false_positive_while_healthy(self):
+        world, mgr, engines, clock, notices = make_world(5)
+        spin(mgr, clock, 100)  # 50 time units >> timeout, but all alive
+        assert notices == []
+        assert all(not e.failed for e in engines)
+
+    def test_successor_detects_and_world_learns(self):
+        ws = 6
+        world, mgr, engines, clock, notices = make_world(ws)
+        spin(mgr, clock, 8)  # establish heartbeats
+        kill(world, mgr, engines, 2)
+        spin(mgr, clock, 40)
+        survivors = [e for e in engines if e.rank != 2]
+        assert all(e.failed == {2} for e in survivors)
+        # rank 3 (ring successor of 2) detected locally; others learned
+        local = {r for (r, rank, loc) in notices if loc and rank == 2}
+        assert local == {3}
+        learned = {r for (r, rank, loc) in notices if not loc and rank == 2}
+        assert learned == {0, 1, 4, 5}
+
+    def test_failure_notice_delivered_to_user(self):
+        ws = 4
+        world, mgr, engines, clock, notices = make_world(ws)
+        spin(mgr, clock, 8)
+        kill(world, mgr, engines, 1)
+        spin(mgr, clock, 40)
+        detector = 2  # ring successor of the dead rank
+        for e in engines:
+            if e.rank == 1:
+                continue
+            got = []
+            while True:
+                m = e.pickup_next()
+                if m is None:
+                    break
+                got.append(m)
+            fails = [m for m in got if m.type == int(Tag.FAILURE)]
+            if e.rank == detector:
+                # the detector initiated the notice; like any broadcast
+                # initiator it does not deliver its own message — it
+                # already saw the failure through failure_cb
+                assert fails == []
+            else:
+                assert len(fails) == 1 and fails[0].pid == 1
+
+    def test_callback_fires_once_per_failure(self):
+        ws = 5
+        world, mgr, engines, clock, notices = make_world(ws)
+        spin(mgr, clock, 8)
+        kill(world, mgr, engines, 4)
+        spin(mgr, clock, 60)
+        per_rank = {}
+        for (r, rank, _) in notices:
+            per_rank[(r, rank)] = per_rank.get((r, rank), 0) + 1
+        assert all(v == 1 for v in per_rank.values())
+
+    def test_detection_disabled_by_default(self):
+        world = LoopbackWorld(3)
+        mgr = EngineManager()
+        engines = [ProgressEngine(world.transport(r), manager=mgr)
+                   for r in range(3)]
+        for _ in range(50):
+            mgr.progress_all()
+        assert all(e.failed == set() for e in engines)
+        assert world.sent_cnt == 0  # no heartbeat traffic
+
+
+# ---------------------------------------------------------------------------
+# Elastic recovery: the survivor overlay keeps working
+# ---------------------------------------------------------------------------
+
+class TestElasticRecovery:
+    @pytest.mark.parametrize("ws,dead", [(4, 1), (6, 0), (7, 3), (8, 7)])
+    def test_bcast_among_survivors(self, ws, dead):
+        world, mgr, engines, clock, _ = make_world(ws)
+        spin(mgr, clock, 8)
+        kill(world, mgr, engines, dead)
+        spin(mgr, clock, 60)
+        survivors = [e for e in engines if e.rank != dead]
+        for e in survivors:  # flush FAILURE notices
+            while e.pickup_next() is not None:
+                pass
+        origin = survivors[0].rank
+        engines[origin].bcast(b"after-failure")
+        drain([world], survivors)
+        for e in survivors:
+            if e.rank == origin:
+                continue
+            msgs = []
+            while True:
+                m = e.pickup_next()
+                if m is None:
+                    break
+                msgs.append(m)
+            assert [m.data for m in msgs] == [b"after-failure"], \
+                f"rank {e.rank} got {msgs}"
+
+    def test_consensus_among_survivors(self):
+        ws = 6
+        world, mgr, engines, clock, _ = make_world(ws)
+        spin(mgr, clock, 8)
+        kill(world, mgr, engines, 5)
+        spin(mgr, clock, 60)
+        survivors = [e for e in engines if e.rank != 5]
+        for e in survivors:
+            while e.pickup_next() is not None:
+                pass
+        engines[0].submit_proposal(b"p", pid=0)
+        for _ in range(10_000):
+            mgr.progress_all()
+            if engines[0].vote_my_proposal() != -1:
+                break
+        assert engines[0].vote_my_proposal() == 1
+        drain([world], survivors)
+
+    def test_sequential_double_failure(self):
+        ws = 8
+        world, mgr, engines, clock, _ = make_world(ws)
+        spin(mgr, clock, 8)
+        kill(world, mgr, engines, 3)
+        spin(mgr, clock, 60)
+        kill(world, mgr, engines, 6)
+        spin(mgr, clock, 60)
+        survivors = [e for e in engines if e.rank not in (3, 6)]
+        assert all(e.failed == {3, 6} for e in survivors)
+        for e in survivors:
+            while e.pickup_next() is not None:
+                pass
+        engines[1].bcast(b"two-down")
+        drain([world], survivors)
+        for e in survivors:
+            if e.rank == 1:
+                continue
+            m = e.pickup_next()
+            assert m is not None and m.data == b"two-down"
+            assert e.pickup_next() is None
+
+    @pytest.mark.parametrize("ws,victim", [(6, 4), (8, 2), (5, 1)])
+    def test_consensus_completes_when_voter_dies_mid_round(self, ws,
+                                                           victim):
+        """A participant dies after the proposal went out but before its
+        subtree voted: detection must discount the dead subtree so the
+        round completes instead of waiting forever (a dead rank cannot
+        veto)."""
+        world, mgr, engines, clock, _ = make_world(ws)
+        spin(mgr, clock, 8)
+        # crash the victim, then immediately propose — before detection,
+        # so the proposal's vote accounting still counts the dead subtree
+        kill(world, mgr, engines, victim)
+        proposer = 0
+        rc = engines[proposer].submit_proposal(b"mid-round", pid=0)
+        assert rc == -1  # cannot complete: a vote will never arrive
+        spin(mgr, clock, 80)
+        assert engines[proposer].vote_my_proposal() == 1
+        survivors = [e for e in engines if e.rank != victim]
+        drain([world], survivors)
+        # and the engine is free for the next round
+        engines[proposer].submit_proposal(b"next", pid=1)
+        for _ in range(10_000):
+            mgr.progress_all()
+            if engines[proposer].vote_my_proposal() != -1:
+                break
+        assert engines[proposer].vote_my_proposal() == 1
+
+    def test_false_positive_vote_cannot_mask_live_veto(self):
+        """A falsely-suspected child's vote arriving after it was
+        discounted must not complete the round while a live child's veto
+        is outstanding — and the late vote must not crash the engine."""
+        from rlo_tpu.engine import EngineManager, ProgressEngine
+        from rlo_tpu.wire import Frame, Tag
+        world = LoopbackWorld(4)
+        mgr_p, mgr_o = EngineManager(), EngineManager()
+        proposer = ProgressEngine(world.transport(0), manager=mgr_p,
+                                  failure_timeout=1e9,  # no auto detection
+                                  clock=lambda: 0.0)
+        _others = [ProgressEngine(world.transport(r), manager=mgr_o)
+                   for r in range(1, 4)]
+        assert proposer.submit_proposal(b"p", pid=0) == -1
+        assert sorted(proposer.my_own_proposal.await_from) == [1, 2]
+        # a FAILURE notice about rank 2 (actually alive) discounts it
+        proposer._mark_failed(2)
+        assert proposer.my_own_proposal.votes_needed == 1
+        # rank 2's in-flight YES arrives anyway: must NOT complete
+        world.transport(2).isend(
+            0, int(Tag.IAR_VOTE), Frame(origin=2, pid=0, vote=1).encode())
+        mgr_p.progress_all()
+        assert proposer.vote_my_proposal() == -1
+        # rank 1's veto decides the round
+        world.transport(1).isend(
+            0, int(Tag.IAR_VOTE), Frame(origin=1, pid=0, vote=0).encode())
+        mgr_p.progress_all()
+        assert proposer.vote_my_proposal() == 0
+        # another stray late vote is dropped, not a RuntimeError
+        world.transport(2).isend(
+            0, int(Tag.IAR_VOTE), Frame(origin=2, pid=0, vote=1).encode())
+        mgr_p.progress_all()
+
+    def test_dead_proposer_unparks_relayed_proposals(self):
+        """When the proposer dies mid-round, survivors must abort the
+        relayed proposal (state FAILED, unparked) so they stay
+        checkpointable and the pid is freed."""
+        from rlo_tpu.engine import EngineManager, ProgressEngine, ReqState
+        from rlo_tpu.utils import checkpoint as ck
+        clock = FakeClock()
+        world = LoopbackWorld(4)
+        mgr_p, mgr_o = EngineManager(), EngineManager()
+        proposer = ProgressEngine(world.transport(0), manager=mgr_p)
+        others = [ProgressEngine(world.transport(r), manager=mgr_o,
+                                 failure_timeout=8.0,
+                                 heartbeat_interval=1.0, clock=clock)
+                  for r in range(1, 4)]
+        proposer.submit_proposal(b"p", pid=0)
+        world.kill_rank(0)
+        proposer.cleanup()
+        for _ in range(10):  # others receive + park + vote (blackholed)
+            mgr_o.progress_all()
+        parked = [e for e in others if e.queue_iar_pending]
+        assert parked, "no survivor parked the relayed proposal"
+        states = [pm.prop_state for e in others
+                  for pm in e.queue_iar_pending]
+        for _ in range(60):  # heartbeat detection of the dead proposer
+            clock.advance(0.5)
+            mgr_o.progress_all()
+        assert all(e.failed == {0} for e in others)
+        assert all(not e.queue_iar_pending for e in others)
+        assert all(ps.state == ReqState.FAILED for ps in states)
+        for e in others:
+            while e.pickup_next() is not None:
+                pass
+            ck.engine_state_dict(e)  # checkpointable again
+
+    def test_learned_failure_does_not_rearm_pred_timer(self):
+        """A learned failure elsewhere must not reset the heartbeat grace
+        of an unchanged predecessor (correlated failures would otherwise
+        defer detection indefinitely)."""
+        world, mgr, engines, clock, _ = make_world(6)
+        spin(mgr, clock, 8)
+        e3 = engines[3]
+        before = e3._hb_seen[2]  # rank 3 watches rank 2
+        e3._mark_failed(5)       # unrelated learned failure
+        assert e3._hb_seen[2] == before
+        e3._mark_failed(2)       # pred dies -> new pred gets fresh grace
+        assert e3._hb_seen[1] == clock()
+
+    def test_adjacent_failure_shifts_monitor(self):
+        """Kill the detector's own predecessor twice over: after rank 2
+        dies, rank 3 watches rank 1; killing rank 1 must then be detected
+        by rank 3 as well."""
+        ws = 5
+        world, mgr, engines, clock, notices = make_world(ws)
+        spin(mgr, clock, 8)
+        kill(world, mgr, engines, 2)
+        spin(mgr, clock, 60)
+        kill(world, mgr, engines, 1)
+        spin(mgr, clock, 60)
+        local = {(r, rank) for (r, rank, loc) in notices if loc}
+        assert (3, 2) in local and (3, 1) in local
+        survivors = [e for e in engines if e.rank in (0, 3, 4)]
+        assert all(e.failed == {1, 2} for e in survivors)

@@ -404,3 +404,14 @@ class TestUtils:
         a = nb.now_usec()
         b = nb.now_usec()
         assert b >= a > 1_000_000_000_000  # after 2001 in usec
+
+    def test_peer_alive_loopback_always_true(self):
+        # the in-process loopback transport has no liveness signal: peers
+        # share the process and cannot die independently; out-of-range
+        # ranks are dead by definition. The shm transport's real
+        # heartbeat-staleness path is exercised by the demo binary's
+        # `fail` case (tests/test_shm_demo.py::test_failure_detection).
+        with nb.NativeWorld(4) as w:
+            assert all(w.peer_alive(r, timeout_usec=1) for r in range(4))
+            assert not w.peer_alive(4)
+            assert not w.peer_alive(-1)

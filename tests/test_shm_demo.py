@@ -37,7 +37,12 @@ def test_all_cases(demo_bin, ws):
     out = run_demo(demo_bin, "-n", ws, "-m", 8)
     assert "FAIL" not in out
     # one PASS line per case (+1: iar runs agree and veto variants)
-    assert out.count("PASS") == 7
+    assert out.count("PASS") == 8
+
+
+def test_failure_detection(demo_bin):
+    out = run_demo(demo_bin, "-n", 4, "-c", "fail")
+    assert out.count("PASS") == 1
 
 
 def test_bcast_many_messages(demo_bin):
