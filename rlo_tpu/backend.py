@@ -333,12 +333,13 @@ class NativeBackend(Backend):
     name = "native"
 
     def __init__(self, world_size: Optional[int] = None, latency: int = 0,
-                 seed: int = 1, **kwargs):
+                 seed: int = 1, msg_size_max: int = 1 << 22, **kwargs):
         from rlo_tpu.native.bindings import NativeWorld, NativeEngine
 
         self.world_size = world_size or 4
         self.world = NativeWorld(self.world_size, latency, seed)
-        self.engines = [NativeEngine(self.world, r, msg_size_max=1 << 22)
+        self.engines = [NativeEngine(self.world, r,
+                                     msg_size_max=msg_size_max)
                         for r in range(self.world_size)]
 
     def bcast(self, origin: int, x: np.ndarray) -> List[np.ndarray]:

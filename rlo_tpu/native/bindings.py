@@ -100,6 +100,11 @@ def load() -> C.CDLL:
     sig("rlo_pickup_next", C.c_int64,
         [p, C.POINTER(C.c_int), C.POINTER(C.c_int), C.POINTER(C.c_int),
          C.POINTER(C.c_int), u8p, C.c_int64])
+    sig("rlo_pickup_peek", C.c_int64,
+        [p, C.POINTER(C.c_int), C.POINTER(C.c_int), C.POINTER(C.c_int),
+         C.POINTER(C.c_int), C.POINTER(C.POINTER(C.c_uint8))])
+    sig("rlo_pickup_consume", C.c_int, [p])
+    sig("rlo_bench_allreduce", C.c_double, [C.c_int, C.c_int64, C.c_int])
     sig("rlo_engine_idle", C.c_int, [p])
     sig("rlo_engine_err", C.c_int, [p])
     sig("rlo_engine_total_pickup", C.c_int64, [p])
@@ -221,7 +226,6 @@ class NativeEngine:
         if not self._e:
             raise RuntimeError(f"engine creation failed (rank {rank})")
         world.engines.append(self)
-        self._pickup_buf = (C.c_uint8 * msg_size_max)()
 
     def _check(self, rc: int) -> int:
         if rc == ERR_BUSY:
@@ -250,20 +254,25 @@ class NativeEngine:
         self._lib.rlo_proposal_reset(self._e)
 
     def pickup_next(self) -> Optional[NativeUserMsg]:
+        # zero-copy peek + consume: the single copy is string_at pulling
+        # the payload out of the engine-owned frame blob into a Python
+        # bytes (the engine's buffer is only valid until the next call)
         tag = C.c_int()
         origin = C.c_int()
         pid = C.c_int()
         vote = C.c_int()
-        n = self._lib.rlo_pickup_next(
+        payload = C.POINTER(C.c_uint8)()
+        n = self._lib.rlo_pickup_peek(
             self._e, C.byref(tag), C.byref(origin), C.byref(pid),
-            C.byref(vote), self._pickup_buf, self.msg_size_max)
+            C.byref(vote), C.byref(payload))
         if n < 0:
             if n == -1:
                 return None
             self._check(int(n))
+        data = C.string_at(payload, int(n)) if n else b""
+        self._check(self._lib.rlo_pickup_consume(self._e))
         return NativeUserMsg(type=tag.value, origin=origin.value,
-                             pid=pid.value, vote=vote.value,
-                             data=bytes(self._pickup_buf[:n]))
+                             pid=pid.value, vote=vote.value, data=data)
 
     def idle(self) -> bool:
         return bool(self._lib.rlo_engine_idle(self._e))
@@ -351,6 +360,16 @@ def frame_roundtrip(origin: int, pid: int, vote: int, payload: bytes):
     assert m >= 0, m
     data = bytes(C.cast(pp, C.POINTER(C.c_uint8 * m)).contents) if m else b""
     return o.value, p.value, v.value, data, bytes(raw)
+
+
+def bench_allreduce(world_size: int, count: int, reps: int = 5) -> float:
+    """Median usec per wholly-native bcast-gather fp32 allreduce of
+    `count` floats per rank (no Python in the measured loop); raises on
+    native failure."""
+    rc = load().rlo_bench_allreduce(world_size, count, reps)
+    if rc < 0:
+        raise RuntimeError(f"native bench failed ({int(rc)})")
+    return float(rc)
 
 
 def now_usec() -> int:

@@ -1,0 +1,112 @@
+/* Wholly-native micro-benchmark entry points, driven by one ctypes call.
+ *
+ * The BASELINE.json config-1 comparison ("allreduce on the engine
+ * substrate") must measure the C engines themselves, not the Python
+ * driver's ctypes boundary copies — so the full workload (bcast-gather
+ * allreduce over the rootless broadcast overlay, the NativeBackend
+ * data-collective algorithm) runs inside the library: every rank
+ * broadcasts its fp32 buffer, the world drains, every rank sums what it
+ * picks up through the zero-copy peek/consume path. The reference's own
+ * benchmark harnesses are likewise all-native timing loops
+ * (/root/reference/testcases.c:71-98, rootless_ops.c:1675-1709).
+ */
+#include "rlo_internal.h"
+
+#include <stdio.h>
+
+/* Median usec per allreduce over `reps` runs of a bcast-gather fp32
+ * allreduce of `count` floats per rank, world_size in-process loopback
+ * ranks. Returns <0 (rlo_err) on failure or a wrong reduction result. */
+double rlo_bench_allreduce(int world_size, int64_t count, int reps)
+{
+    if (world_size < 2 || count <= 0 || reps <= 0 || reps > 1000)
+        return RLO_ERR_ARG;
+    rlo_world *w = rlo_world_new(world_size, 0, 0);
+    if (!w)
+        return RLO_ERR_NOMEM;
+    double rc = RLO_ERR_NOMEM;
+    int64_t nbytes = count * (int64_t)sizeof(float);
+    rlo_engine **engines = 0;
+    float **bufs = 0;   /* per-rank payloads */
+    float *acc = 0;
+    double *times = 0;
+
+    engines = (rlo_engine **)calloc((size_t)world_size, sizeof(void *));
+    bufs = (float **)calloc((size_t)world_size, sizeof(void *));
+    acc = (float *)malloc((size_t)nbytes);
+    times = (double *)calloc((size_t)reps, sizeof(double));
+    if (!engines || !bufs || !acc || !times)
+        goto out;
+    for (int r = 0; r < world_size; r++) {
+        engines[r] = rlo_engine_new(w, r, 0, 0, 0, 0, 0, nbytes + 64);
+        bufs[r] = (float *)malloc((size_t)nbytes);
+        if (!engines[r] || !bufs[r])
+            goto out;
+        for (int64_t i = 0; i < count; i++)
+            bufs[r][i] = (float)((r + 1) * ((i % 13) + 1));
+    }
+
+    for (int rep = 0; rep < reps; rep++) {
+        uint64_t t0 = rlo_now_usec();
+        for (int r = 0; r < world_size; r++) {
+            int src = rlo_bcast(engines[r], (const uint8_t *)bufs[r],
+                                nbytes);
+            if (src != RLO_OK) {
+                rc = src;
+                goto out;
+            }
+        }
+        int spun = rlo_drain(w, 1000000);
+        if (spun < 0) {
+            rc = spun;
+            goto out;
+        }
+        for (int r = 0; r < world_size; r++) {
+            memcpy(acc, bufs[r], (size_t)nbytes);
+            for (int got = 0; got < world_size - 1; got++) {
+                const uint8_t *payload = 0;
+                int64_t n = rlo_pickup_peek(engines[r], 0, 0, 0, 0,
+                                            &payload);
+                if (n != nbytes) {
+                    rc = RLO_ERR_PROTO;
+                    goto out;
+                }
+                const float *f = (const float *)payload;
+                for (int64_t i = 0; i < count; i++)
+                    acc[i] += f[i];
+                rlo_pickup_consume(engines[r]);
+            }
+        }
+        times[rep] = (double)(rlo_now_usec() - t0);
+        /* oracle: sum over ranks of (r+1)*k = k * ws*(ws+1)/2 */
+        double want =
+            (double)world_size * (world_size + 1) / 2.0 * ((0 % 13) + 1);
+        if (acc[0] != (float)want) {
+            rc = RLO_ERR_PROTO;
+            goto out;
+        }
+    }
+    /* median */
+    for (int i = 0; i < reps; i++)
+        for (int j = i + 1; j < reps; j++)
+            if (times[j] < times[i]) {
+                double t = times[i];
+                times[i] = times[j];
+                times[j] = t;
+            }
+    rc = times[reps / 2];
+
+out:
+    if (engines)
+        for (int r = 0; r < world_size; r++)
+            rlo_engine_free(engines[r]);
+    if (bufs)
+        for (int r = 0; r < world_size; r++)
+            free(bufs[r]);
+    free(engines);
+    free(bufs);
+    free(acc);
+    free(times);
+    rlo_world_free(w);
+    return rc;
+}

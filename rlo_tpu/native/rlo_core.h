@@ -210,6 +210,22 @@ void rlo_proposal_reset(rlo_engine *e);
 int64_t rlo_pickup_next(rlo_engine *e, int *tag, int *origin, int *pid,
                         int *vote, uint8_t *buf, int64_t cap);
 
+/* Zero-copy delivery, the native analogue of the reference's
+ * pickup-then-recycle pair (the payload stays in the engine's buffer
+ * while the app reads it, like RLO_user_pickup_next handing out the
+ * engine's own msg buffer until RLO_user_msg_recycle :981-992):
+ * rlo_pickup_peek exposes the head deliverable message — fills the
+ * fields, points *payload into engine-owned memory, returns the length —
+ * without consuming it; rlo_pickup_consume (the `recycle`) then retires
+ * exactly the message last peeked, even if progress turns ran in
+ * between and changed the queue heads. The payload pointer is valid
+ * only until the next call into the engine. peek returns -1 when
+ * nothing is deliverable; consume without a pending peek is
+ * RLO_ERR_ARG. */
+int64_t rlo_pickup_peek(rlo_engine *e, int *tag, int *origin, int *pid,
+                        int *vote, const uint8_t **payload);
+int rlo_pickup_consume(rlo_engine *e);
+
 /* 1 when this engine has no outstanding forwards or pending decision */
 int rlo_engine_idle(const rlo_engine *e);
 int rlo_engine_err(const rlo_engine *e);         /* sticky first error */

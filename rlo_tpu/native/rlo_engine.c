@@ -41,7 +41,8 @@ struct rlo_msg {
     rlo_msg *prev, *next;
     int tag, src; /* src = immediate sender (~MPI_SOURCE) */
     int32_t origin, pid, vote;
-    uint8_t *payload;
+    rlo_blob *frame;        /* the encoded frame (owned ref) */
+    const uint8_t *payload; /* aliases frame->data past the header */
     int64_t len;
     rlo_handle **handles;
     int n_handles, cap_handles;
@@ -64,6 +65,7 @@ struct rlo_engine {
     int64_t sent_bcast, recved_bcast, total_pickup;
     rlo_prop own; /* my_own_proposal; own.payload = my proposal bytes */
     int err; /* sticky first protocol error */
+    rlo_msg *peeked; /* message exposed by rlo_pickup_peek, not consumed */
 };
 
 /* ---------------- queue ops ---------------- */
@@ -96,26 +98,52 @@ static void q_remove(rlo_queue *q, rlo_msg *m)
 
 /* ---------------- msg lifecycle ---------------- */
 
-static rlo_msg *msg_new(int tag, int src, int32_t origin, int32_t pid,
-                        int32_t vote, const uint8_t *payload, int64_t len)
+/* Encode one frame into a fresh blob (the single copy a send makes;
+ * every fan-out edge then shares it by ref). */
+static rlo_blob *frame_blob(int32_t origin, int32_t pid, int32_t vote,
+                            const uint8_t *payload, int64_t len)
 {
-    rlo_msg *m = (rlo_msg *)calloc(1, sizeof(*m));
-    if (!m)
+    rlo_blob *b = rlo_blob_new(RLO_HEADER_SIZE + len);
+    if (!b)
         return 0;
+    if (rlo_frame_encode(b->data, b->len, origin, pid, vote, payload,
+                         len) < 0) {
+        rlo_blob_unref(b);
+        return 0;
+    }
+    return b;
+}
+
+/* Wrap a received or freshly-encoded frame blob into a message; STEALS
+ * the caller's blob ref (unrefs it on failure, storing RLO_ERR_PROTO or
+ * RLO_ERR_NOMEM in *err so callers report the true cause). */
+static rlo_msg *msg_from_frame(int tag, int src, rlo_blob *frame, int *err)
+{
+    int32_t origin, pid, vote;
+    const uint8_t *payload;
+    int64_t plen = rlo_frame_decode(frame->data, frame->len, &origin,
+                                    &pid, &vote, &payload);
+    if (plen < 0) {
+        if (err)
+            *err = RLO_ERR_PROTO;
+        rlo_blob_unref(frame);
+        return 0;
+    }
+    rlo_msg *m = (rlo_msg *)calloc(1, sizeof(*m));
+    if (!m) {
+        if (err)
+            *err = RLO_ERR_NOMEM;
+        rlo_blob_unref(frame);
+        return 0;
+    }
     m->tag = tag;
     m->src = src;
     m->origin = origin;
     m->pid = pid;
     m->vote = vote;
-    m->len = len;
-    if (len > 0) {
-        m->payload = (uint8_t *)malloc((size_t)len);
-        if (!m->payload) {
-            free(m);
-            return 0;
-        }
-        memcpy(m->payload, payload, (size_t)len);
-    }
+    m->frame = frame;
+    m->payload = payload;
+    m->len = plen;
     return m;
 }
 
@@ -137,7 +165,7 @@ static void msg_free(rlo_msg *m)
     for (int i = 0; i < m->n_handles; i++)
         rlo_handle_unref(m->handles[i]);
     free(m->handles);
-    free(m->payload);
+    rlo_blob_unref(m->frame);
     prop_free(m->ps);
     free(m);
 }
@@ -167,31 +195,30 @@ static int msg_sends_done(const rlo_msg *m)
 
 /* ---------------- send helper ---------------- */
 
-/* Encode and isend one frame; when track_in != NULL the completion handle
- * is retained on that message (votes pass NULL — fire and forget, but
- * still reliable: the loopback world owns the in-flight node). */
+/* isend one already-encoded frame blob; when track_in != NULL the
+ * completion handle is retained on that message (votes pass NULL — fire
+ * and forget, but still reliable: the world owns the in-flight node). */
+static int eng_isend_frame(rlo_engine *e, int dst, int tag,
+                           rlo_blob *frame, rlo_msg *track_in)
+{
+    rlo_handle *h = 0;
+    int rc = rlo_world_isend(e->w, e->rank, dst, e->comm, tag, frame,
+                             track_in ? &h : 0);
+    if (rc == RLO_OK && track_in)
+        rc = msg_track(track_in, h);
+    return rc;
+}
+
+/* Encode + send a one-off frame (votes). */
 static int eng_isend(rlo_engine *e, int dst, int tag, int32_t origin,
                      int32_t pid, int32_t vote, const uint8_t *payload,
                      int64_t len, rlo_msg *track_in)
 {
-    int64_t cap = RLO_HEADER_SIZE + len;
-    uint8_t stack_buf[256];
-    uint8_t *raw = cap <= (int64_t)sizeof(stack_buf)
-                       ? stack_buf
-                       : (uint8_t *)malloc((size_t)cap);
-    if (!raw)
+    rlo_blob *frame = frame_blob(origin, pid, vote, payload, len);
+    if (!frame)
         return RLO_ERR_NOMEM;
-    int64_t n = rlo_frame_encode(raw, cap, origin, pid, vote, payload, len);
-    int rc = (int)n;
-    if (n > 0) {
-        rlo_handle *h = 0;
-        rc = rlo_world_isend(e->w, e->rank, dst, e->comm, tag, raw, n,
-                             track_in ? &h : 0);
-        if (rc == RLO_OK && track_in)
-            rc = msg_track(track_in, h);
-    }
-    if (raw != stack_buf)
-        free(raw);
+    int rc = eng_isend_frame(e, dst, tag, frame, track_in);
+    rlo_blob_unref(frame);
     return rc;
 }
 
@@ -266,12 +293,16 @@ static int bcast_init(rlo_engine *e, int tag, int32_t pid, int32_t vote,
 {
     if (len < 0 || len > e->msg_size_max)
         return RLO_ERR_TOO_BIG;
-    rlo_msg *m = msg_new(tag, -1, e->rank, pid, vote, payload, len);
-    if (!m)
+    /* encode ONCE; every fan-out edge shares the blob by ref */
+    rlo_blob *frame = frame_blob(e->rank, pid, vote, payload, len);
+    if (!frame)
         return RLO_ERR_NOMEM;
+    int err = RLO_ERR_NOMEM;
+    rlo_msg *m = msg_from_frame(tag, -1, frame, &err); /* steals the ref */
+    if (!m)
+        return err;
     for (int i = 0; i < e->n_init; i++) { /* furthest-first */
-        int rc = eng_isend(e, e->init_targets[i], tag, e->rank, pid, vote,
-                           payload, len, m);
+        int rc = eng_isend_frame(e, e->init_targets[i], tag, m->frame, m);
         if (rc != RLO_OK) {
             msg_free(m);
             return rc;
@@ -302,8 +333,8 @@ static int bc_forward(rlo_engine *e, rlo_msg *m)
     if (n < 0)
         return n;
     for (int i = 0; i < n; i++) {
-        int rc = eng_isend(e, targets[i], m->tag, m->origin, m->pid,
-                           m->vote, m->payload, m->len, m);
+        /* zero-copy store-and-forward: every hop shares the one blob */
+        int rc = eng_isend_frame(e, targets[i], m->tag, m->frame, m);
         if (rc != RLO_OK)
             return rc;
     }
@@ -553,35 +584,92 @@ static int64_t copy_out(rlo_msg *m, int *tag, int *origin, int *pid,
     return m->len;
 }
 
-int64_t rlo_pickup_next(rlo_engine *e, int *tag, int *origin, int *pid,
-                        int *vote, uint8_t *buf, int64_t cap)
+/* Head deliverable message: still-forwarding messages are eligible
+ * first (reference order, RLO_user_pickup_next :938-979). */
+static rlo_msg *pickup_head(rlo_engine *e, int *from_wait)
 {
-    /* still-forwarding messages are eligible first (reference order,
-     * RLO_user_pickup_next :938-979) */
-    rlo_msg *m = e->q_wait_pickup.head;
-    if (m) {
-        int64_t n = copy_out(m, tag, origin, pid, vote, buf, cap);
-        if (n < 0)
-            return n;
+    if (e->q_wait_pickup.head) {
+        *from_wait = 1;
+        return e->q_wait_pickup.head;
+    }
+    *from_wait = 0;
+    return e->q_pickup.head;
+}
+
+/* Retire one deliverable message (shared by pickup_next and
+ * peek/consume). */
+static void pickup_retire(rlo_engine *e, rlo_msg *m, int from_wait)
+{
+    e->total_pickup++;
+    rlo_trace_emit(e->rank, RLO_EV_DELIVER, m->tag, m->origin);
+    if (m == e->peeked)
+        e->peeked = 0;
+    if (from_wait) {
         q_remove(&e->q_wait_pickup, m);
         m->pickup_done = 1;
         q_append(&e->q_wait, m); /* keep tracking its forwards */
-        e->total_pickup++;
-        rlo_trace_emit(e->rank, RLO_EV_DELIVER, m->tag, m->origin);
-        return n;
-    }
-    m = e->q_pickup.head;
-    if (m) {
-        int64_t n = copy_out(m, tag, origin, pid, vote, buf, cap);
-        if (n < 0)
-            return n;
+    } else {
         q_remove(&e->q_pickup, m);
-        e->total_pickup++;
-        rlo_trace_emit(e->rank, RLO_EV_DELIVER, m->tag, m->origin);
         msg_free(m);
-        return n;
     }
-    return -1;
+}
+
+/* Which delivery queue currently holds `m` (a progress turn may have
+ * moved it from wait_and_pickup to pickup when its forwards finished). */
+static int in_wait_pickup(const rlo_engine *e, const rlo_msg *m)
+{
+    for (const rlo_msg *x = e->q_wait_pickup.head; x; x = x->next)
+        if (x == m)
+            return 1;
+    return 0;
+}
+
+int64_t rlo_pickup_next(rlo_engine *e, int *tag, int *origin, int *pid,
+                        int *vote, uint8_t *buf, int64_t cap)
+{
+    int from_wait;
+    rlo_msg *m = pickup_head(e, &from_wait);
+    if (!m)
+        return -1;
+    int64_t n = copy_out(m, tag, origin, pid, vote, buf, cap);
+    if (n < 0)
+        return n;
+    pickup_retire(e, m, from_wait);
+    return n;
+}
+
+int64_t rlo_pickup_peek(rlo_engine *e, int *tag, int *origin, int *pid,
+                        int *vote, const uint8_t **payload)
+{
+    int from_wait;
+    rlo_msg *m = pickup_head(e, &from_wait);
+    if (!m)
+        return -1;
+    e->peeked = m;
+    if (tag)
+        *tag = m->tag;
+    if (origin)
+        *origin = m->origin;
+    if (pid)
+        *pid = m->pid;
+    if (vote)
+        *vote = m->vote;
+    if (payload)
+        *payload = m->payload;
+    return m->len;
+}
+
+int rlo_pickup_consume(rlo_engine *e)
+{
+    /* retire exactly the peeked message — a progress turn between peek
+     * and consume may have changed the queue heads (or moved the peeked
+     * message between delivery queues), and retiring whatever is head
+     * now would silently swallow an undelivered message */
+    rlo_msg *m = e->peeked;
+    if (!m)
+        return RLO_ERR_ARG;
+    pickup_retire(e, m, in_wait_pickup(e, m));
+    return RLO_OK;
 }
 
 /* ---------------- the gear (reference make_progress_gen :551-641) ------ */
@@ -606,22 +694,13 @@ void rlo_engine_progress_once(rlo_engine *e)
         rlo_wire_node *n = rlo_world_poll(e->w, e->rank, e->comm);
         if (!n)
             break;
-        int32_t origin, pid, vote;
-        const uint8_t *payload;
-        int64_t plen = rlo_frame_decode(n->data, n->len, &origin, &pid,
-                                        &vote, &payload);
-        if (plen < 0) {
-            set_err(e, RLO_ERR_PROTO);
-            rlo_handle_unref(n->handle);
-            free(n);
-            continue;
-        }
-        rlo_msg *m =
-            msg_new(n->tag, n->src, origin, pid, vote, payload, plen);
+        /* steal the node's frame ref into the message — no copy */
+        int err = RLO_ERR_PROTO;
+        rlo_msg *m = msg_from_frame(n->tag, n->src, n->frame, &err);
         rlo_handle_unref(n->handle);
         free(n);
         if (!m) {
-            set_err(e, RLO_ERR_NOMEM);
+            set_err(e, err);
             continue;
         }
         switch (m->tag) {

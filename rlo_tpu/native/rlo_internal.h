@@ -38,22 +38,62 @@ static inline void rlo_handle_unref(rlo_handle *h)
         free(h);
 }
 
+/* Refcounted immutable frame blob. One encoded frame is shared across
+ * every fan-out send, the engine's tracking message, and (for in-process
+ * transports) the receiver — the native analogue of the Python engine
+ * passing one immutable `bytes` to every isend, and the zero-copy spirit
+ * of the one-sided remote-write transport the reference abandoned
+ * (rma_util.c:29-62). Single-threaded refcounts (the engine model is
+ * cooperative polling; rootless_ops.h:216). */
+typedef struct rlo_blob {
+    int refs;
+    int64_t len;
+    uint8_t data[];
+} rlo_blob;
+
+static inline rlo_blob *rlo_blob_new(int64_t len)
+{
+    rlo_blob *b =
+        (rlo_blob *)malloc(sizeof(*b) + (size_t)(len > 0 ? len : 0));
+    if (b) {
+        b->refs = 1;
+        b->len = len;
+    }
+    return b;
+}
+
+static inline rlo_blob *rlo_blob_ref(rlo_blob *b)
+{
+    b->refs++;
+    return b;
+}
+
+static inline void rlo_blob_unref(rlo_blob *b)
+{
+    if (b && --b->refs == 0)
+        free(b);
+}
+
 /* One in-flight or delivered wire frame. Owned by the world until the
- * receiving engine polls it off its inbox; then owned by the engine. */
+ * receiving engine polls it off its inbox; then owned by the engine
+ * (which steals the frame ref). */
 typedef struct rlo_wire_node {
     struct rlo_wire_node *next;
     int src, dst, tag, comm;
     uint64_t due; /* deliver-at tick (latency injection) */
     rlo_handle *handle;
-    int64_t len;
-    uint8_t data[]; /* encoded frame */
+    rlo_blob *frame; /* encoded frame bytes */
 } rlo_wire_node;
 
 /* ---- transport vtable ---- */
 typedef struct rlo_transport_ops {
     const char *name;
+    /* Send one encoded frame. The transport takes its own ref on `frame`
+     * if it retains it (in-process delivery, pending MPI request);
+     * cross-process transports may instead copy out of it. The caller
+     * keeps its ref. */
     int (*isend)(rlo_world *w, int src, int dst, int comm, int tag,
-                 const uint8_t *raw, int64_t len, rlo_handle **out);
+                 rlo_blob *frame, rlo_handle **out);
     /* next frame addressed to (rank, comm), or NULL; caller owns it */
     rlo_wire_node *(*poll)(rlo_world *w, int rank, int comm);
     int (*quiescent)(const rlo_world *w);
@@ -84,7 +124,7 @@ struct rlo_world {
 /* World-side transport API used by the engine (dispatch wrappers in
  * rlo_world_common.c). */
 int rlo_world_isend(rlo_world *w, int src, int dst, int comm, int tag,
-                    const uint8_t *raw, int64_t len, rlo_handle **out);
+                    rlo_blob *frame, rlo_handle **out);
 rlo_wire_node *rlo_world_poll(rlo_world *w, int rank, int comm);
 int rlo_world_register(rlo_world *w, rlo_engine *e);
 void rlo_world_unregister(rlo_world *w, rlo_engine *e);

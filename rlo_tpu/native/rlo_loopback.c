@@ -42,6 +42,7 @@ static uint64_t xorshift64(uint64_t *s)
 static void free_node(rlo_wire_node *n)
 {
     rlo_handle_unref(n->handle);
+    rlo_blob_unref(n->frame);
     free(n);
 }
 
@@ -123,15 +124,14 @@ static rlo_channel *get_channel(rlo_loop_world *w, int src, int dst,
 }
 
 static int loop_isend(rlo_world *base, int src, int dst, int comm, int tag,
-                      const uint8_t *raw, int64_t len, rlo_handle **out)
+                      rlo_blob *frame, rlo_handle **out)
 {
     rlo_loop_world *w = (rlo_loop_world *)base;
-    if (dst < 0 || dst >= base->world_size || len < 0)
+    if (dst < 0 || dst >= base->world_size || !frame || frame->len < 0)
         return RLO_ERR_ARG;
     int caller_tracks = out != 0;
     rlo_handle *h = rlo_handle_new(caller_tracks ? 2 : 1);
-    rlo_wire_node *n =
-        (rlo_wire_node *)malloc(sizeof(*n) + (size_t)len);
+    rlo_wire_node *n = (rlo_wire_node *)malloc(sizeof(*n));
     if (!h || !n) {
         free(h);
         free(n);
@@ -143,9 +143,7 @@ static int loop_isend(rlo_world *base, int src, int dst, int comm, int tag,
     n->tag = tag;
     n->comm = comm;
     n->handle = h;
-    n->len = len;
-    if (len > 0)
-        memcpy(n->data, raw, (size_t)len);
+    n->frame = rlo_blob_ref(frame); /* zero-copy in-process delivery */
     w->sent_cnt++;
     if (w->latency <= 0) {
         inbox_push(w, n);

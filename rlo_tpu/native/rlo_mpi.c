@@ -54,7 +54,7 @@ typedef struct mpi_send_node {
     struct mpi_send_node *next;
     MPI_Request req;
     rlo_handle *handle;
-    uint8_t *buf;
+    rlo_blob *frame; /* ref held until MPI_Test reports completion */
 } mpi_send_node;
 
 typedef struct rlo_mpi_world {
@@ -75,7 +75,7 @@ static void mpi_test_sends(rlo_mpi_world *w)
         if (done) {
             n->handle->delivered = 1;
             rlo_handle_unref(n->handle);
-            free(n->buf);
+            rlo_blob_unref(n->frame);
             *pp = n->next;
             free(n);
         } else {
@@ -85,30 +85,29 @@ static void mpi_test_sends(rlo_mpi_world *w)
 }
 
 static int mpi_isend(rlo_world *base, int src, int dst, int comm, int tag,
-                     const uint8_t *raw, int64_t len, rlo_handle **out)
+                     rlo_blob *frame, rlo_handle **out)
 {
     rlo_mpi_world *w = (rlo_mpi_world *)base;
-    if (dst < 0 || dst >= base->world_size || len < 0 ||
+    if (dst < 0 || dst >= base->world_size || !frame || frame->len < 0 ||
         src != base->my_rank)
         return RLO_ERR_ARG;
+    int64_t len = frame->len;
     mpi_send_node *n = (mpi_send_node *)calloc(1, sizeof(*n));
-    uint8_t *buf = (uint8_t *)malloc(len > 0 ? (size_t)len : 1);
     /* world ref + optional caller ref */
     rlo_handle *h = rlo_handle_new(out ? 2 : 1);
-    if (!n || !buf || !h) {
+    if (!n || !h) {
         free(n);
-        free(buf);
         free(h);
         return RLO_ERR_NOMEM;
     }
-    if (len > 0)
-        memcpy(buf, raw, (size_t)len);
-    n->buf = buf;
+    /* zero-copy: MPI sends straight from the shared frame blob, whose
+     * ref is held until MPI_Test reports completion */
+    n->frame = rlo_blob_ref(frame);
     n->handle = h;
-    if (MPI_Isend(buf, (int)len, MPI_BYTE, dst,
+    if (MPI_Isend(frame->data, (int)len, MPI_BYTE, dst,
                   comm * MPI_TAG_STRIDE + tag, w->comm,
                   &n->req) != MPI_SUCCESS) {
-        free(buf);
+        rlo_blob_unref(n->frame);
         free(n);
         free(h);
         return RLO_ERR_PROTO;
@@ -132,24 +131,28 @@ static int mpi_pump(rlo_mpi_world *w)
             return RLO_OK;
         int nbytes = 0;
         MPI_Get_count(&st, MPI_BYTE, &nbytes);
-        rlo_wire_node *n =
-            (rlo_wire_node *)malloc(sizeof(*n) + (size_t)nbytes);
-        if (!n)
+        rlo_wire_node *n = (rlo_wire_node *)malloc(sizeof(*n));
+        rlo_blob *frame = rlo_blob_new(nbytes);
+        if (!n || !frame) {
+            free(n);
+            rlo_blob_unref(frame);
             return RLO_ERR_NOMEM;
+        }
         n->next = 0;
         n->src = st.MPI_SOURCE;
         n->dst = w->base.my_rank;
         n->tag = st.MPI_TAG % MPI_TAG_STRIDE;
         n->comm = st.MPI_TAG / MPI_TAG_STRIDE;
         n->due = 0;
-        n->len = nbytes;
+        n->frame = frame;
         n->handle = rlo_handle_new(1);
         if (!n->handle) {
             free(n);
+            rlo_blob_unref(frame);
             return RLO_ERR_NOMEM;
         }
         n->handle->delivered = 1;
-        MPI_Recv(n->data, nbytes, MPI_BYTE, st.MPI_SOURCE, st.MPI_TAG,
+        MPI_Recv(frame->data, nbytes, MPI_BYTE, st.MPI_SOURCE, st.MPI_TAG,
                  w->comm, MPI_STATUS_IGNORE);
         w->recv_cnt++;
         if (w->inbox_tail)
@@ -258,7 +261,7 @@ static void mpi_free(rlo_world *base)
             MPI_Test(&n->req, &done, MPI_STATUS_IGNORE);
         rlo_handle_unref(n->handle);
         if (done) {
-            free(n->buf);
+            rlo_blob_unref(n->frame);
             free(n);
         }
         n = nn;
@@ -266,6 +269,7 @@ static void mpi_free(rlo_world *base)
     for (rlo_wire_node *n = w->inbox_head; n;) {
         rlo_wire_node *nn = n->next;
         rlo_handle_unref(n->handle);
+        rlo_blob_unref(n->frame);
         free(n);
         n = nn;
     }

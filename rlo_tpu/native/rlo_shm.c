@@ -173,25 +173,29 @@ static int shm_pump(rlo_shm_world *w)
                 break;
             shm_rec rec;
             ring_read(r, cap, tail, &rec, sizeof(rec));
-            rlo_wire_node *n = (rlo_wire_node *)malloc(
-                sizeof(*n) + (size_t)rec.len);
-            if (!n)
+            rlo_wire_node *n = (rlo_wire_node *)malloc(sizeof(*n));
+            rlo_blob *frame = rlo_blob_new(rec.len);
+            if (!n || !frame) {
+                free(n);
+                rlo_blob_unref(frame);
                 return RLO_ERR_NOMEM;
+            }
             n->next = 0;
             n->src = rec.src;
             n->dst = me;
             n->tag = rec.tag;
             n->comm = rec.comm;
             n->due = 0;
-            n->len = rec.len;
+            n->frame = frame;
             n->handle = rlo_handle_new(1);
             if (!n->handle) {
                 free(n);
+                rlo_blob_unref(frame);
                 return RLO_ERR_NOMEM;
             }
             n->handle->delivered = 1;
             if (rec.len > 0)
-                ring_read(r, cap, tail + sizeof(rec), n->data,
+                ring_read(r, cap, tail + sizeof(rec), frame->data,
                           (size_t)rec.len);
             atomic_store_explicit(&r->tail, tail + rec.size,
                                   memory_order_release);
@@ -205,11 +209,15 @@ static int shm_pump(rlo_shm_world *w)
 /* ---- vtable ops ---- */
 
 static int shm_isend(rlo_world *base, int src, int dst, int comm, int tag,
-                     const uint8_t *raw, int64_t len, rlo_handle **out)
+                     rlo_blob *frame, rlo_handle **out)
 {
     rlo_shm_world *w = (rlo_shm_world *)base;
-    if (dst < 0 || dst >= base->world_size || len < 0 ||
+    if (dst < 0 || dst >= base->world_size || !frame ||
         src != base->my_rank)
+        return RLO_ERR_ARG;
+    const uint8_t *raw = frame->data;
+    int64_t len = frame->len;
+    if (len < 0)
         return RLO_ERR_ARG;
     if (dst == src)
         return RLO_ERR_ARG; /* overlay never self-sends */
@@ -406,6 +414,7 @@ static void shm_free(rlo_world *base)
     for (rlo_wire_node *n = w->inbox_head; n;) {
         rlo_wire_node *nn = n->next;
         rlo_handle_unref(n->handle);
+        rlo_blob_unref(n->frame);
         free(n);
         n = nn;
     }

@@ -63,9 +63,9 @@ void rlo_world_free(rlo_world *w)
 }
 
 int rlo_world_isend(rlo_world *w, int src, int dst, int comm, int tag,
-                    const uint8_t *raw, int64_t len, rlo_handle **out)
+                    rlo_blob *frame, rlo_handle **out)
 {
-    return w->ops->isend(w, src, dst, comm, tag, raw, len, out);
+    return w->ops->isend(w, src, dst, comm, tag, frame, out);
 }
 
 rlo_wire_node *rlo_world_poll(rlo_world *w, int rank, int comm)

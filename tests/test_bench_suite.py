@@ -1,0 +1,38 @@
+"""Smoke tests for the BASELINE-config benchmark suite.
+
+Each config must run end-to-end at --tiny sizes and print exactly one
+valid JSON line with the contract fields. Configs 2-4 set up their own
+jax backend (forced CPU mesh when multi-chip is absent), so every config
+runs in a subprocess, exactly as `--config all` drives them.
+"""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+SUITE = Path(__file__).resolve().parent.parent / "benchmarks" / "suite.py"
+
+
+@pytest.mark.parametrize("config", [1, 2, 3, 4, 5])
+def test_config_emits_json_line(config):
+    proc = subprocess.run(
+        [sys.executable, str(SUITE), "--config", str(config), "--tiny"],
+        capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, proc.stderr
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1, proc.stdout
+    rec = json.loads(lines[0])
+    assert rec["config"] == config
+    assert set(rec) >= {"config", "metric", "value", "unit", "vs_baseline"}
+    assert rec["value"] > 0 and rec["vs_baseline"] > 0
+
+
+def test_native_bench_allreduce_correctness_gate():
+    # the C-side harness self-verifies the reduction; a wrong result
+    # raises instead of reporting a time
+    from rlo_tpu.native.bindings import bench_allreduce
+    t = bench_allreduce(4, 1024, reps=3)
+    assert t > 0

@@ -405,6 +405,37 @@ class TestUtils:
         b = nb.now_usec()
         assert b >= a > 1_000_000_000_000  # after 2001 in usec
 
+    def test_consume_retires_peeked_message_not_new_head(self):
+        """rlo_pickup_consume must retire exactly the peeked message even
+        if progress ran in between and a newer message became the
+        delivery-queue head (it would otherwise be swallowed unseen)."""
+        lib = nb.load()
+        import ctypes as C
+        with nb.NativeWorld(4) as w:
+            engines = [nb.NativeEngine(w, r) for r in range(4)]
+            engines[0].bcast(b"first")
+            w.drain()
+            e3 = engines[3]
+            tag = C.c_int()
+            origin = C.c_int()
+            pid = C.c_int()
+            vote = C.c_int()
+            payload = C.POINTER(C.c_uint8)()
+            n = lib.rlo_pickup_peek(e3._e, C.byref(tag), C.byref(origin),
+                                    C.byref(pid), C.byref(vote),
+                                    C.byref(payload))
+            assert n == 5 and C.string_at(payload, 5) == b"first"
+            # a second broadcast lands between peek and consume
+            engines[1].bcast(b"second")
+            w.drain()
+            assert lib.rlo_pickup_consume(e3._e) == 0
+            # the second message must still be delivered intact
+            msg = e3.pickup_next()
+            assert msg is not None and msg.data == b"second"
+            assert e3.pickup_next() is None
+            # consume with no pending peek is an error
+            assert lib.rlo_pickup_consume(e3._e) < 0
+
     def test_peer_alive_loopback_always_true(self):
         # the in-process loopback transport has no liveness signal: peers
         # share the process and cannot die independently; out-of-range
