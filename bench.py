@@ -121,13 +121,15 @@ def bench_multi_chip():
 
     from rlo_tpu.parallel.mesh import shard_jit
 
+    import os
     n_dev = len(jax.devices())
     mesh = make_mesh((n_dev,), ("x",))
     # each shard contributes a full 256 MB buffer (the north-star config:
     # "256MB float32 allreduce" = 256 MB reduced per rank, not split);
     # materialize per-shard on its own device — never the full global
-    # buffer on the host or on chip 0.
-    per_shard = (256 << 20) // 4
+    # buffer on the host or on chip 0. RLO_BENCH_BYTES overrides the
+    # buffer size (validation on virtual CPU meshes).
+    per_shard = int(os.environ.get("RLO_BENCH_BYTES", 256 << 20)) // 4
     sharding = NamedSharding(mesh, P("x"))
 
     def _make_shard(idx):
@@ -140,11 +142,16 @@ def bench_multi_chip():
                                      _make_shard)
     nbytes_per_shard = per_shard * 4
 
+    from rlo_tpu.parallel.mesh import vary_like
+
     def chained(algorithm):
         def inner(v, k):
             def it(i, acc):
-                return tc.allreduce(acc, "x", algorithm=algorithm) \
+                out = tc.allreduce(acc, "x", algorithm=algorithm) \
                     / jnp.float32(n_dev)  # keep magnitude bounded
+                # psum results are typed invariant under vma; cast back
+                # to the carry's varying type for a stable fori_loop
+                return vary_like(out, v)
             return jax.lax.fori_loop(0, k, it, v)
         return shard_jit(inner, mesh, (P("x"), P()), P("x"))
 
@@ -165,8 +172,10 @@ def bench_multi_chip():
     print(f"ring: {t_ours*1e3:.2f} ms ({bw_ours:.1f} GB/s/chip)  "
           f"psum: {t_base*1e3:.2f} ms ({bw_base:.1f} GB/s/chip)",
           file=sys.stderr)
+    size = (f"{nbytes_per_shard >> 20}MB" if nbytes_per_shard >= 1 << 20
+            else f"{nbytes_per_shard >> 10}KB")
     return {
-        "metric": f"ring allreduce bus bandwidth, 256MB fp32, "
+        "metric": f"ring allreduce bus bandwidth, {size} fp32, "
                   f"{n_dev} chips, vs lax.psum",
         "value": round(bw_ours, 2),
         "unit": "GB/s/chip",

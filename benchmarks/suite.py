@@ -200,7 +200,7 @@ def bench_config3(tiny: bool) -> None:
     from jax.sharding import PartitionSpec as P
 
     from rlo_tpu.ops import tpu_collectives as tc
-    from rlo_tpu.parallel.mesh import shard_jit
+    from rlo_tpu.parallel.mesh import shard_jit, vary_like
 
     on_tpu = backend == "tpu"
     per = ((64 << 10) if tiny else (1 << 20) if not on_tpu
@@ -212,7 +212,10 @@ def bench_config3(tiny: bool) -> None:
             def it(i, acc):
                 out = tc.allreduce(acc, "x", algorithm=algorithm,
                                    use_pallas=on_tpu)
-                return (out / jnp.bfloat16(n)).astype(v.dtype)
+                # psum results are typed invariant; cast back to the
+                # carry's varying type so the fori_loop carry is stable
+                return vary_like((out / jnp.bfloat16(n)).astype(v.dtype),
+                                 v)
             return lax.fori_loop(0, k, it, v)
         f = shard_jit(inner, mesh, (P("x"), P()), P("x"))
         return lambda v, k: f(v, k)
@@ -235,7 +238,7 @@ def bench_config4(tiny: bool) -> None:
     from jax.sharding import PartitionSpec as P
 
     from rlo_tpu.ops import tpu_collectives as tc
-    from rlo_tpu.parallel.mesh import shard_jit
+    from rlo_tpu.parallel.mesh import shard_jit, vary_like
 
     on_tpu = backend == "tpu"
     # BASELINE asks for 256 MB gradient tensors on TPU; scale down on CPU
@@ -250,12 +253,12 @@ def bench_config4(tiny: bool) -> None:
                                    use_pallas=on_tpu)
             ag = tc.all_gather(rs, "x", algorithm="doubling")
             out = ag.reshape(-1)[:flat.size] / jnp.float32(n)
-            return out[None]
+            return vary_like(out[None], v)
         return lax.fori_loop(0, k, it, v)
 
     def inner_base(v, k):
         def it(i, acc):
-            return lax.psum(acc, "x") / jnp.float32(n)
+            return vary_like(lax.psum(acc, "x") / jnp.float32(n), v)
         return lax.fori_loop(0, k, it, v)
 
     f_ours = shard_jit(inner_ours, mesh, (P("x"), P()), P("x"))

@@ -1,13 +1,20 @@
 """Flagship model: decoder-only transformer, sequence-parallel by ring
-attention, data-parallel by the framework's ring allreduce.
+attention, tensor-parallel Megatron-style, data-parallel by the
+framework's ring allreduce.
 
 The reference ships no model code (SURVEY.md §5 records the absence);
 this is the net-new capability demonstrating the substrate end-to-end on
-a 2-D mesh (dp, sp):
+a (dp, sp, tp) mesh:
 
   - the sequence axis is sharded over `sp`: attention runs as
     rlo_tpu.ops.ring_attention (K/V streaming over the ppermute ring),
     every other sublayer is position-local and needs no communication;
+  - attention heads and FFN hidden units are sharded over `tp`
+    (column-parallel wqkv/w1, row-parallel wo/w2): each device computes
+    its local heads/hidden slice and the partial output projections are
+    summed with the framework's allreduce — the two classic
+    tensor-parallel collectives per layer (`param_pspecs` gives the
+    matching PartitionSpec tree);
   - the batch axis is sharded over `dp`: gradients are combined with the
     framework's ring allreduce + Pallas fused combine
     (rlo_tpu.ops.tpu_collectives.allreduce), the data-collective path the
@@ -54,7 +61,12 @@ class TransformerConfig:
 
 
 def init_params(rng: jax.Array, cfg: TransformerConfig) -> dict:
-    """Scaled-normal init; embedding tied with the output head."""
+    """Scaled-normal init; embedding tied with the output head.
+
+    ``wqkv`` has shape (d, 3, d): axis 1 selects q/k/v and axis 2 is
+    (heads x head_dim) flattened, so sharding axis 2 over `tp` splits
+    each of q, k, v by head (the memory layout equals the fused
+    (d, 3*d) [q|k|v] matrix)."""
     keys = jax.random.split(rng, 2 + 6 * cfg.n_layers)
     d, f = cfg.d_model, cfg.d_ff
 
@@ -70,7 +82,7 @@ def init_params(rng: jax.Array, cfg: TransformerConfig) -> dict:
     for _ in range(cfg.n_layers):
         params["layers"].append({
             "ln1": {"g": jnp.ones((d,), jnp.float32)},
-            "wqkv": norm(keys[k], (d, 3 * d), d ** -0.5),
+            "wqkv": norm(keys[k], (d, 3, d), d ** -0.5),
             "wo": norm(keys[k + 1], (d, d), (2 * d * cfg.n_layers) ** -0.5),
             "ln2": {"g": jnp.ones((d,), jnp.float32)},
             "w1": norm(keys[k + 2], (d, f), d ** -0.5),
@@ -78,6 +90,28 @@ def init_params(rng: jax.Array, cfg: TransformerConfig) -> dict:
         })
         k += 6
     return params
+
+
+def param_pspecs(cfg: TransformerConfig, tp_axis: Optional[str] = None):
+    """PartitionSpec tree matching `init_params` output.
+
+    With ``tp_axis``: wqkv and w1 are column-parallel (outputs sharded by
+    head / hidden unit), wo and w2 row-parallel (inputs sharded);
+    everything else is replicated. Pass as shard_map in/out specs for the
+    params argument."""
+    from jax.sharding import PartitionSpec as P
+    t = tp_axis
+    layer = {
+        "ln1": {"g": P()},
+        "wqkv": P(None, None, t),
+        "wo": P(t, None),
+        "ln2": {"g": P()},
+        "w1": P(None, t),
+        "w2": P(t, None),
+    }
+    return {"embed": P(), "ln_f": {"g": P()},
+            "layers": [dict(layer, ln1={"g": P()}, ln2={"g": P()})
+                       for _ in range(cfg.n_layers)]}
 
 
 def _rmsnorm(x, g):
@@ -97,14 +131,33 @@ def _sincos(pos, d_model, dtype):
 
 
 def forward(params: dict, tokens: jax.Array, cfg: TransformerConfig,
-            sp_axis: Optional[str] = None) -> jax.Array:
+            sp_axis: Optional[str] = None,
+            tp_axis: Optional[str] = None,
+            tp_algorithm: str = "psum") -> jax.Array:
     """Logits for next-token prediction; causal.
 
     tokens: (batch, block) int32 — `block` is the LOCAL sequence slice
     when sp_axis is set (shard r holds tokens [r*block, (r+1)*block)).
+
+    With ``tp_axis`` the layer weights arrive sharded per `param_pspecs`:
+    this device computes its n_heads/tp heads and d_ff/tp hidden units,
+    and the row-parallel output projections produce partial sums that
+    are combined with the framework allreduce (``tp_algorithm`` picks
+    psum / ring / recursive_doubling / halving_doubling).
     """
     b, blk = tokens.shape
     dt = cfg.act_dtype
+    ntp = lax.axis_size(tp_axis) if tp_axis is not None else 1
+    assert cfg.n_heads % ntp == 0 and cfg.d_ff % ntp == 0, \
+        f"n_heads {cfg.n_heads} and d_ff {cfg.d_ff} must divide tp={ntp}"
+    nh_local = cfg.n_heads // ntp
+
+    def tp_sum(t):
+        if tp_axis is None:
+            return t
+        return tc.allreduce(t, tp_axis, algorithm=tp_algorithm).astype(
+            t.dtype)
+
     if sp_axis is not None:
         pos0 = lax.axis_index(sp_axis) * blk
     else:
@@ -115,11 +168,12 @@ def forward(params: dict, tokens: jax.Array, cfg: TransformerConfig,
 
     for layer in params["layers"]:
         h = _rmsnorm(x, layer["ln1"]["g"])
-        qkv = h @ layer["wqkv"].astype(dt)
+        w = layer["wqkv"].astype(dt)       # (d, 3, local heads x hd)
+        qkv = h @ w.reshape(w.shape[0], -1)
         q, k, v = jnp.split(qkv, 3, axis=-1)
 
         def heads(t):
-            return t.reshape(b, blk, cfg.n_heads, cfg.head_dim)
+            return t.reshape(b, blk, nh_local, cfg.head_dim)
 
         q, k, v = heads(q), heads(k), heads(v)
         if sp_axis is None:
@@ -128,23 +182,24 @@ def forward(params: dict, tokens: jax.Array, cfg: TransformerConfig,
         else:
             att = jax.vmap(lambda q_, k_, v_: ring_attention(
                 q_, k_, v_, sp_axis, causal=True), in_axes=0)(q, k, v)
-        att = att.reshape(b, blk, cfg.d_model)
-        x = x + att @ layer["wo"].astype(dt)
+        att = att.reshape(b, blk, nh_local * cfg.head_dim)
+        x = x + tp_sum(att @ layer["wo"].astype(dt))
 
         h = _rmsnorm(x, layer["ln2"]["g"])
         h = jax.nn.gelu(h @ layer["w1"].astype(dt))
-        x = x + h @ layer["w2"].astype(dt)
+        x = x + tp_sum(h @ layer["w2"].astype(dt))
 
     x = _rmsnorm(x, params["ln_f"]["g"])
     return (x @ params["embed"].T.astype(dt)).astype(jnp.float32)
 
 
 def loss_fn(params: dict, tokens: jax.Array, cfg: TransformerConfig,
-            sp_axis: Optional[str] = None) -> jax.Array:
+            sp_axis: Optional[str] = None,
+            tp_axis: Optional[str] = None) -> jax.Array:
     """Mean next-token cross-entropy. With sp sharding, the label for a
     shard's last position is the next shard's first token — one ppermute
     — and the final global position is masked out."""
-    logits = forward(params, tokens, cfg, sp_axis)
+    logits = forward(params, tokens, cfg, sp_axis, tp_axis)
     b, blk = tokens.shape
     if sp_axis is None:
         targets = jnp.concatenate(
@@ -174,26 +229,62 @@ def loss_fn(params: dict, tokens: jax.Array, cfg: TransformerConfig,
     return local / count
 
 
+def _vma_active(axis: str) -> bool:
+    """Whether varying-manual-axes typing is live for ``axis``.
+
+    Probed by pcasting a fresh scalar to varying: under check_vma=True
+    the result's vma contains the axis; under check_vma=False `.vma` is
+    an empty frozenset for EVERYTHING — which must not be mistaken for
+    'already reduced'."""
+    try:
+        probe = lax.pcast(jnp.zeros(()), (axis,), to="varying")
+        return axis in jax.typeof(probe).vma
+    except (AttributeError, TypeError, ValueError):
+        return False
+
+
 def train_step(params: dict, tokens: jax.Array, cfg: TransformerConfig,
                lr: float = 1e-2, sp_axis: Optional[str] = None,
                dp_axis: Optional[str] = None,
+               tp_axis: Optional[str] = None,
                grad_algorithm: str = "psum"):
-    """One SGD step; returns (new_params, loss).
+    """One SGD step; returns (new_params, loss). Run under shard_jit
+    (check_vma=True by default).
 
-    Gradients combine over `dp_axis` with the framework's allreduce —
-    grad_algorithm='ring' uses the explicit ppermute ring with the Pallas
-    fused per-step combine (the BASELINE benchmark path), 'psum' the XLA
-    collective.
+    Gradient synchronization. Under varying-manual-axes typing, the
+    reductions of replicated-param grads over sp, tp, AND dp are
+    inserted by shard_map's AD itself (lowering to XLA AllReduce — the
+    optimal 2(n-1)/n schedule; grads of tp-sharded matrices stay local,
+    as they must); this function then only rescales by the dp size.
+    The EXPLICIT framework combine — grad_algorithm='ring': ppermute
+    ring with the Pallas fused per-step combine, the BASELINE benchmark
+    path — engages on a pure-dp mesh under shard_jit(...,
+    check_vma=False), where per-shard grads are well-defined without vma
+    bookkeeping (no collective appears in the forward). A manual-ring
+    result cannot be typed invariant under vma (only psum is), so vma
+    runs route dp through the automatic path regardless of
+    grad_algorithm.
     """
-    loss, grads = jax.value_and_grad(loss_fn)(params, tokens, cfg, sp_axis)
-    if sp_axis is not None:
-        # params are replicated over sp: sum the per-shard grad shards
-        grads = jax.tree.map(lambda g: lax.psum(g, sp_axis), grads)
+    if sp_axis is not None or tp_axis is not None:
+        # without vma typing the sp/tp cotangent reductions never happen
+        # and every shard would silently take a different step
+        assert _vma_active(sp_axis or tp_axis), (
+            "sp/tp training requires shard_jit's vma typing "
+            "(check_vma=True); only the pure-dp explicit-ring path may "
+            "run with check_vma=False")
+    loss, grads = jax.value_and_grad(loss_fn)(params, tokens, cfg, sp_axis,
+                                              tp_axis)
     if dp_axis is not None:
         n = lax.axis_size(dp_axis)
-        grads = jax.tree.map(
-            lambda g: tc.allreduce(g, dp_axis, algorithm=grad_algorithm)
-            / n, grads)
+        if _vma_active(dp_axis):
+            # vma AD already summed grads over dp; just rescale
+            grads = jax.tree.map(lambda g: g / n, grads)
+        else:
+            # explicit framework combine of per-shard grads
+            grads = jax.tree.map(
+                lambda g: tc.allreduce(g, dp_axis,
+                                       algorithm=grad_algorithm) / n,
+                grads)
         loss = lax.pmean(loss, dp_axis)
     new_params = jax.tree.map(lambda p, g: p - lr * g, params, grads)
     return new_params, loss

@@ -29,6 +29,8 @@ from jax import lax
 
 from rlo_tpu import topology
 
+from rlo_tpu.parallel.mesh import vary_like as _vary_like
+
 _NEG = -1e30  # large-negative mask value (finite: keeps exp/max NaN-free)
 
 
@@ -89,9 +91,9 @@ def ring_attention(q, k, v, axis: str, *, causal: bool = False,
         vc = lax.ppermute(vc, axis, perm)
         return kc, vc, m, l, o
 
-    m0 = jnp.full((h, blk), _NEG, jnp.float32)
-    l0 = jnp.zeros((h, blk), jnp.float32)
-    o0 = jnp.zeros((blk, h, d), jnp.float32)
+    m0 = _vary_like(jnp.full((h, blk), _NEG, jnp.float32), q)
+    l0 = _vary_like(jnp.zeros((h, blk), jnp.float32), q)
+    o0 = _vary_like(jnp.zeros((blk, h, d), jnp.float32), q)
     # ws-1 rotate-and-update steps, then the last arrived block outside
     # the loop — the final rotation would only be thrown away, and
     # collectives inside fori_loop are never dead-code-eliminated

@@ -36,6 +36,7 @@ from jax import lax
 
 from rlo_tpu import topology
 from rlo_tpu.pallas import reduce as pallas_reduce
+from rlo_tpu.parallel.mesh import vary_like as _vary_like
 
 _JNP_OPS = {"sum": jnp.add, "min": jnp.minimum, "max": jnp.maximum,
             "and": jnp.bitwise_and, "or": jnp.bitwise_or}
@@ -190,7 +191,7 @@ def _ring_all_gather_rolled(chunk, axis: str):
     ws = lax.axis_size(axis)
     idx = lax.axis_index(axis)
     perm = list(topology.ring_perm(ws))
-    out = jnp.zeros((ws,) + chunk.shape, chunk.dtype)
+    out = _vary_like(jnp.zeros((ws,) + chunk.shape, chunk.dtype), chunk)
     own_idx = (idx + 1) % ws
     out = lax.dynamic_update_index_in_dim(out, chunk, own_idx, 0)
 
@@ -296,7 +297,7 @@ def all_gather(x, axis: str, *, algorithm: str = "xla"):
     ws = lax.axis_size(axis)
     idx = lax.axis_index(axis)
     perm = list(topology.ring_perm(ws))
-    out = jnp.zeros((ws,) + x.shape, x.dtype)
+    out = _vary_like(jnp.zeros((ws,) + x.shape, x.dtype), x)
     out = lax.dynamic_update_index_in_dim(out, x, idx, 0)
     cur = x
 

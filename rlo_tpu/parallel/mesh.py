@@ -36,12 +36,33 @@ def make_mesh(shape: Optional[Sequence[int]] = None,
     return Mesh(devices[:need].reshape(shape), tuple(axis_names))
 
 
-def shard_jit(fn, mesh: Mesh, in_specs, out_specs):
+def shard_jit(fn, mesh: Mesh, in_specs, out_specs, check_vma: bool = True):
     """jit(shard_map(fn)) — one SPMD program over the mesh.
 
-    check_vma is disabled: the Pallas interpreter used on non-TPU backends
-    loses varying-mesh-axes annotations in its internal grid loop, which
-    would spuriously reject kernels that are correct on TPU.
-    """
+    check_vma (varying-manual-axes typing) is ON by default: it makes
+    jax.grad correct under shard_map by auto-inserting the cotangent
+    psums for replicated params (without it, the transpose of psum is
+    psum and per-shard grads of replicated params are wrong). Code that
+    wants explicit control of a gradient collective (e.g. the dp ring
+    allreduce) opts out per-param with `vary_over` instead of disabling
+    the typing."""
     return jax.jit(jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
-                                 out_specs=out_specs, check_vma=False))
+                                 out_specs=out_specs, check_vma=check_vma))
+
+
+def vary_like(x, like):
+    """Mark ``x`` varying over the mesh axes ``like`` varies over.
+
+    Under check_vma, fori_loop carries must keep a constant vma type:
+    zeros-initialized accumulators start unvarying while the loop body
+    makes them varying — cast the inits up front. No-op when vma typing
+    is off or ``like`` carries no vma."""
+    try:
+        need = set(jax.typeof(like).vma) - set(jax.typeof(x).vma)
+    except (AttributeError, TypeError):
+        return x
+    if not need:
+        return x
+    return jax.lax.pcast(x, tuple(sorted(need)), to="varying")
+
+

@@ -1,0 +1,135 @@
+"""Tensor parallelism: Megatron-style head/hidden sharding on the
+flagship transformer (net-new capability; the reference has no model
+code or parallelism strategies, SURVEY.md §5).
+
+Oracle: a tp-sharded forward/train-step must match the unsharded
+single-device computation on identical params — tensor parallelism is
+an implementation detail, not a model change.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from rlo_tpu.models.transformer import (TransformerConfig, forward,
+                                        init_params, loss_fn, param_pspecs,
+                                        train_step)
+from rlo_tpu.parallel.mesh import make_mesh, shard_jit
+
+CFG = TransformerConfig(vocab=61, d_model=64, n_heads=8, n_layers=2,
+                        d_ff=128, dtype="float32")
+
+
+def _data(cfg=CFG, batch=2, seq=16):
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(0, cfg.vocab, (batch, seq)),
+                         jnp.int32)
+    return params, tokens
+
+
+class TestForwardParity:
+    @pytest.mark.parametrize("tp", [2, 4, 8])
+    def test_tp_forward_matches_unsharded(self, tp):
+        params, tokens = _data()
+        ref = forward(params, tokens, CFG)
+        mesh = make_mesh((tp,), ("tp",))
+        f = shard_jit(
+            lambda p, t: forward(p, t, CFG, tp_axis="tp"),
+            mesh, (param_pspecs(CFG, "tp"), P()), P())
+        out = f(params, tokens)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_tp_ring_allreduce_variant(self):
+        """The framework's manual ring allreduce in the tensor-parallel
+        position. A ppermute-ring result cannot be TYPED invariant under
+        vma (only psum is), so this inference-only variant runs with
+        check_vma=False — numerics still must match exactly."""
+        params, tokens = _data()
+        ref = forward(params, tokens, CFG)
+        mesh = make_mesh((4,), ("tp",))
+        f = shard_jit(
+            lambda p, t: forward(p, t, CFG, tp_axis="tp",
+                                 tp_algorithm="ring"),
+            mesh, (param_pspecs(CFG, "tp"), P()), P(), check_vma=False)
+        np.testing.assert_allclose(np.asarray(f(params, tokens)),
+                                   np.asarray(ref), rtol=2e-4, atol=2e-4)
+
+    def test_tp_loss_identical_on_all_shards(self):
+        params, tokens = _data()
+        mesh = make_mesh((4,), ("tp",))
+        f = shard_jit(
+            lambda p, t: loss_fn(p, t, CFG, tp_axis="tp")[None],
+            mesh, (param_pspecs(CFG, "tp"), P()), P("tp"))
+        losses = np.asarray(f(params, tokens))
+        assert losses.shape == (4,)
+        np.testing.assert_allclose(losses, losses[0], rtol=1e-5)
+
+
+class TestTrainParity:
+    def test_tp_train_step_matches_unsharded(self):
+        params, tokens = _data()
+        ref_params, ref_loss = jax.jit(
+            lambda p, t: train_step(p, t, CFG, lr=1e-2))(params, tokens)
+        mesh = make_mesh((4,), ("tp",))
+        specs = param_pspecs(CFG, "tp")
+        step = shard_jit(
+            lambda p, t: train_step(p, t, CFG, lr=1e-2, tp_axis="tp"),
+            mesh, (specs, P()), (specs, P()))
+        new_params, loss = step(params, tokens)
+        np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+        for (ka, a), (kb, b) in zip(
+                jax.tree_util.tree_flatten_with_path(new_params)[0],
+                jax.tree_util.tree_flatten_with_path(ref_params)[0]):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=5e-3, atol=5e-4,
+                err_msg=jax.tree_util.keystr(ka))
+
+    def test_dp_sp_tp_combined_mesh(self):
+        """The full 3-D mesh: (dp, sp, tp) = (2, 2, 2) on 8 devices."""
+        cfg = TransformerConfig(vocab=61, d_model=64, n_heads=4,
+                                n_layers=2, d_ff=128, dtype="float32")
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        rng = np.random.default_rng(1)
+        tokens = jnp.asarray(rng.integers(0, cfg.vocab, (4, 16)),
+                             jnp.int32)
+        ref_params, ref_loss = jax.jit(
+            lambda p, t: train_step(p, t, cfg, lr=1e-2))(params, tokens)
+
+        mesh = make_mesh((2, 2, 2), ("dp", "sp", "tp"))
+        specs = param_pspecs(cfg, "tp")
+        step = shard_jit(
+            lambda p, t: train_step(p, t, cfg, lr=1e-2, sp_axis="sp",
+                                    dp_axis="dp", tp_axis="tp"),
+            mesh, (specs, P("dp", "sp")), (specs, P()))
+        new_params, loss = step(params, tokens)
+        np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-4)
+        for (ka, a), (kb, b) in zip(
+                jax.tree_util.tree_flatten_with_path(new_params)[0],
+                jax.tree_util.tree_flatten_with_path(ref_params)[0]):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=5e-3, atol=5e-4,
+                err_msg=jax.tree_util.keystr(ka))
+
+
+class TestSpecs:
+    def test_param_pspecs_structure_matches_params(self):
+        params = init_params(jax.random.PRNGKey(0), CFG)
+        specs = param_pspecs(CFG, "tp")
+        assert (jax.tree_util.tree_structure(params)
+                == jax.tree_util.tree_structure(
+                    specs, is_leaf=lambda x: isinstance(x, P)))
+
+    def test_uneven_heads_rejected(self):
+        cfg = TransformerConfig(vocab=16, d_model=24, n_heads=3,
+                                n_layers=1, d_ff=64, dtype="float32")
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        tokens = jnp.zeros((1, 8), jnp.int32)
+        mesh = make_mesh((2,), ("tp",))
+        with pytest.raises(AssertionError, match="divide"):
+            shard_jit(lambda p, t: forward(p, t, cfg, tp_axis="tp"),
+                      mesh, (param_pspecs(cfg, "tp"), P()), P())(
+                          params, tokens)

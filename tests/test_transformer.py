@@ -82,6 +82,58 @@ def test_training_reduces_loss(grad_algorithm):
     assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
 
 
+def test_train_step_parity_dp_sp_vs_single_device():
+    """Sharded (dp, sp) training must take EXACTLY the step the
+    single-device model takes. Regression for the vma migration: under
+    check_vma=False the transpose-of-psum-is-psum semantics made
+    explicit sp grad syncing scale-wrong; vma AD inserts the correct
+    cotangent reductions."""
+    cfg = TransformerConfig(vocab=16, d_model=32, n_heads=2, n_layers=1,
+                            d_ff=64, dtype="float32")
+    p0 = init_params(jax.random.PRNGKey(3), cfg)
+    rng = np.random.default_rng(3)
+    tokens = jnp.asarray(rng.integers(0, cfg.vocab, (DP * 2, SEQ)),
+                         jnp.int32)
+    ref_p, ref_loss = jax.jit(
+        lambda p, t: train_step(p, t, cfg, lr=0.1))(p0, tokens)
+    mesh = make_mesh((DP, SP), ("dp", "sp"))
+    step = shard_jit(
+        lambda p, t: train_step(p, t, cfg, lr=0.1, sp_axis="sp",
+                                dp_axis="dp"),
+        mesh, (P(), P("dp", "sp")), (P(), P()))
+    new_p, loss = step(p0, tokens)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+    for (ka, a), (_, b) in zip(
+            jax.tree_util.tree_flatten_with_path(new_p)[0],
+            jax.tree_util.tree_flatten_with_path(ref_p)[0]):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5,
+                                   err_msg=jax.tree_util.keystr(ka))
+
+
+def test_train_step_explicit_ring_pure_dp_matches_single_device():
+    """The explicit framework gradient combine (ring + Pallas fused
+    per-step reduction) engages on a pure-dp mesh under check_vma=False
+    and must reproduce the single-device step exactly."""
+    cfg = TransformerConfig(vocab=16, d_model=32, n_heads=2, n_layers=1,
+                            d_ff=64, dtype="float32")
+    p0 = init_params(jax.random.PRNGKey(4), cfg)
+    rng = np.random.default_rng(4)
+    tokens = jnp.asarray(rng.integers(0, cfg.vocab, (8, SEQ)), jnp.int32)
+    ref_p, ref_loss = jax.jit(
+        lambda p, t: train_step(p, t, cfg, lr=0.1))(p0, tokens)
+    mesh = make_mesh((8,), ("dp",))
+    step = shard_jit(
+        lambda p, t: train_step(p, t, cfg, lr=0.1, dp_axis="dp",
+                                grad_algorithm="ring"),
+        mesh, (P(), P("dp")), (P(), P()), check_vma=False)
+    new_p, loss = step(p0, tokens)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(new_p), jax.tree.leaves(ref_p)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
 def test_grad_parity_ring_vs_psum():
     cfg = TransformerConfig(vocab=16, d_model=32, n_heads=2, n_layers=1,
                             d_ff=64, dtype="float32")
