@@ -1,0 +1,131 @@
+"""Mixture-of-experts FFN with expert parallelism over a mesh axis.
+
+Net-new capability (the reference has no model code or parallelism
+strategies — SURVEY.md §5); completes the framework's strategy set
+(dp / sp / tp / ep) on the same collective substrate: expert dispatch
+and return are the `all_to_all` collective (rlo_tpu.ops.tpu_collectives),
+the one communication pattern the other strategies don't use.
+
+Design (switch-style top-1 routing with static capacity, the
+TPU-friendly formulation — everything is dense one-hot einsums, no
+dynamic shapes, so XLA tiles it onto the MXU):
+
+  - router: logits = h @ wr -> softmax gate; each token goes to its
+    argmax expert, carrying the gate probability (the only path the
+    gradient needs through the discrete choice);
+  - capacity C = ceil(cap_factor * T / E) per expert per shard; tokens
+    beyond an expert's capacity are dropped (output 0 for them, the
+    residual stream carries them unchanged);
+  - dispatch: one-hot (T, E, C) tensor; expert inputs are
+    einsum('tec,td->ecd') — and the combine on the way back multiplies
+    by the gate, so dropped slots vanish;
+  - expert parallelism: experts are sharded over `ep_axis` (each shard
+    owns E/ep experts); the (E, C, d) dispatch block reshapes to
+    (ep, E_local, C, d) and one all_to_all ships every shard's slice of
+    my experts to me; after the local expert FFNs, a second all_to_all
+    ships results back;
+  - aux load-balancing loss (Switch Transformer form):
+    E * sum_e fraction_dispatched(e) * mean_gate_prob(e).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from rlo_tpu.ops import tpu_collectives as tc
+
+
+def init_moe_params(rng: jax.Array, d_model: int, d_ff: int,
+                    n_experts: int) -> dict:
+    """Router + per-expert FFN weights. Expert-indexed leading axes are
+    the ones `ep` shards (see transformer.param_pspecs)."""
+    k1, k2, k3 = jax.random.split(rng, 3)
+    scale_in = d_model ** -0.5
+    scale_out = d_ff ** -0.5
+    return {
+        "wr": jax.random.normal(k1, (d_model, n_experts),
+                                jnp.float32) * scale_in,
+        "w1": jax.random.normal(k2, (n_experts, d_model, d_ff),
+                                jnp.float32) * scale_in,
+        "w2": jax.random.normal(k3, (n_experts, d_ff, d_model),
+                                jnp.float32) * scale_out,
+    }
+
+
+def moe_ffn(params: dict, h, n_experts: int, *,
+            capacity_factor: float = 2.0,
+            ep_axis: Optional[str] = None,
+            all_to_all_algorithm: str = "xla") -> Tuple[jax.Array,
+                                                        jax.Array]:
+    """Apply the MoE FFN to ``h`` (..., d). Returns (out, aux_loss).
+
+    With ``ep_axis``: ``params['w1']/['w2']`` arrive sharded to this
+    shard's E/ep experts; ``h`` is this shard's tokens. Tokens cross
+    shards only inside the two all_to_all calls.
+    """
+    orig_shape = h.shape
+    dt = h.dtype
+    d = h.shape[-1]
+    x = h.reshape(-1, d)
+    t = x.shape[0]
+    ep = lax.axis_size(ep_axis) if ep_axis is not None else 1
+    e_local = params["w1"].shape[0]
+    n_exp = n_experts
+    assert e_local * ep == n_exp, (
+        f"expert shards {e_local}x{ep} != n_experts {n_exp}")
+    cap = max(1, math.ceil(capacity_factor * t / n_exp))
+
+    # ---- router (float32 for a stable softmax) ----
+    logits = x.astype(jnp.float32) @ params["wr"].astype(jnp.float32)
+    gates = jax.nn.softmax(logits, axis=-1)          # (T, E)
+    expert = jnp.argmax(gates, axis=-1)              # (T,)
+    prob = jnp.max(gates, axis=-1)                   # (T,)
+
+    onehot = jax.nn.one_hot(expert, n_exp, dtype=jnp.float32)   # (T, E)
+    # position of each token within its expert's queue
+    pos = (jnp.cumsum(onehot, axis=0) - onehot) * onehot         # (T, E)
+    keep = (pos < cap) * onehot                                  # (T, E)
+    slot = jax.nn.one_hot(jnp.sum(pos, axis=-1).astype(jnp.int32), cap,
+                          dtype=jnp.float32)                     # (T, C)
+    dispatch = (keep[:, :, None] * slot[:, None, :]).astype(dt)  # (T,E,C)
+
+    # aux load-balance loss: fraction routed vs mean gate mass per expert
+    frac = jnp.mean(onehot, axis=0)
+    mean_gate = jnp.mean(gates, axis=0)
+    aux = n_exp * jnp.sum(frac * mean_gate)
+
+    # the heavy einsums run in the activation dtype (bf16 on TPU — the
+    # MXU path, like the dense FFN); only the router needed float32
+    expert_in = jnp.einsum("tec,td->ecd", dispatch, x)           # (E,C,d)
+
+    if ep_axis is not None:
+        blocks = expert_in.reshape(ep, e_local, cap, d)
+        # dispatch: shard s's slice for my experts arrives at row s
+        blocks = tc.all_to_all(blocks, ep_axis,
+                               algorithm=all_to_all_algorithm)
+        xin = jnp.moveaxis(blocks, 0, 1).reshape(e_local, ep * cap, d)
+    else:
+        xin = expert_in                                          # (E,C,d)
+
+    h1 = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", xin,
+                                params["w1"].astype(dt)))
+    out_blocks = jnp.einsum("ecf,efd->ecd", h1, params["w2"].astype(dt))
+
+    if ep_axis is not None:
+        back = jnp.moveaxis(
+            out_blocks.reshape(e_local, ep, cap, d), 1, 0)
+        back = tc.all_to_all(back, ep_axis,
+                             algorithm=all_to_all_algorithm)
+        expert_out = back.reshape(n_exp, cap, d)
+    else:
+        expert_out = out_blocks
+
+    combine = dispatch * prob[:, None, None].astype(dt)          # (T,E,C)
+    out = jnp.einsum("tec,ecd->td", combine, expert_out,
+                     preferred_element_type=jnp.float32)
+    return out.reshape(orig_shape).astype(dt), aux

@@ -37,6 +37,7 @@ import numpy as np
 from jax import lax
 
 from rlo_tpu import topology
+from rlo_tpu.models import moe
 from rlo_tpu.ops import tpu_collectives as tc
 from rlo_tpu.ops.ring_attention import full_attention, ring_attention
 
@@ -49,6 +50,11 @@ class TransformerConfig:
     n_layers: int = 2
     d_ff: int = 512
     dtype: str = "bfloat16"  # activation dtype; params stay float32
+    # mixture-of-experts FFN (0 = dense). Experts shard over `ep_axis`
+    # with all_to_all dispatch/return — see rlo_tpu.models.moe.
+    n_experts: int = 0
+    capacity_factor: float = 2.0
+    moe_aux_coef: float = 1e-2
 
     @property
     def head_dim(self) -> int:
@@ -80,24 +86,33 @@ def init_params(rng: jax.Array, cfg: TransformerConfig) -> dict:
     }
     k = 2
     for _ in range(cfg.n_layers):
-        params["layers"].append({
+        layer = {
             "ln1": {"g": jnp.ones((d,), jnp.float32)},
             "wqkv": norm(keys[k], (d, 3, d), d ** -0.5),
             "wo": norm(keys[k + 1], (d, d), (2 * d * cfg.n_layers) ** -0.5),
             "ln2": {"g": jnp.ones((d,), jnp.float32)},
-            "w1": norm(keys[k + 2], (d, f), d ** -0.5),
-            "w2": norm(keys[k + 3], (f, d), (2 * f * cfg.n_layers) ** -0.5),
-        })
+        }
+        if cfg.n_experts > 0:
+            layer["moe"] = moe.init_moe_params(keys[k + 2], d, f,
+                                               cfg.n_experts)
+        else:
+            layer["w1"] = norm(keys[k + 2], (d, f), d ** -0.5)
+            layer["w2"] = norm(keys[k + 3], (f, d),
+                               (2 * f * cfg.n_layers) ** -0.5)
+        params["layers"].append(layer)
         k += 6
     return params
 
 
-def param_pspecs(cfg: TransformerConfig, tp_axis: Optional[str] = None):
+def param_pspecs(cfg: TransformerConfig, tp_axis: Optional[str] = None,
+                 ep_axis: Optional[str] = None):
     """PartitionSpec tree matching `init_params` output.
 
     With ``tp_axis``: wqkv and w1 are column-parallel (outputs sharded by
-    head / hidden unit), wo and w2 row-parallel (inputs sharded);
-    everything else is replicated. Pass as shard_map in/out specs for the
+    head / hidden unit), wo and w2 row-parallel (inputs sharded). With
+    ``ep_axis`` (MoE configs): the expert-indexed leading axis of the
+    per-expert FFN weights is sharded; the router is replicated.
+    Everything else is replicated. Pass as shard_map in/out specs for the
     params argument."""
     from jax.sharding import PartitionSpec as P
     t = tp_axis
@@ -106,9 +121,13 @@ def param_pspecs(cfg: TransformerConfig, tp_axis: Optional[str] = None):
         "wqkv": P(None, None, t),
         "wo": P(t, None),
         "ln2": {"g": P()},
-        "w1": P(None, t),
-        "w2": P(t, None),
     }
+    if cfg.n_experts > 0:
+        layer["moe"] = {"wr": P(), "w1": P(ep_axis, None, None),
+                        "w2": P(ep_axis, None, None)}
+    else:
+        layer["w1"] = P(None, t)
+        layer["w2"] = P(t, None)
     return {"embed": P(), "ln_f": {"g": P()},
             "layers": [dict(layer, ln1={"g": P()}, ln2={"g": P()})
                        for _ in range(cfg.n_layers)]}
@@ -133,8 +152,12 @@ def _sincos(pos, d_model, dtype):
 def forward(params: dict, tokens: jax.Array, cfg: TransformerConfig,
             sp_axis: Optional[str] = None,
             tp_axis: Optional[str] = None,
-            tp_algorithm: str = "psum") -> jax.Array:
-    """Logits for next-token prediction; causal.
+            tp_algorithm: str = "psum",
+            ep_axis: Optional[str] = None,
+            with_aux: bool = False):
+    """Logits for next-token prediction; causal. Returns logits, or
+    (logits, aux_loss) when ``with_aux`` (MoE load-balancing term; 0 for
+    dense configs).
 
     tokens: (batch, block) int32 — `block` is the LOCAL sequence slice
     when sp_axis is set (shard r holds tokens [r*block, (r+1)*block)).
@@ -143,7 +166,9 @@ def forward(params: dict, tokens: jax.Array, cfg: TransformerConfig,
     this device computes its n_heads/tp heads and d_ff/tp hidden units,
     and the row-parallel output projections produce partial sums that
     are combined with the framework allreduce (``tp_algorithm`` picks
-    psum / ring / recursive_doubling / halving_doubling).
+    psum / ring / recursive_doubling / halving_doubling). With
+    ``ep_axis`` (MoE configs) the per-expert FFN weights arrive sharded
+    by expert, and tokens cross shards via all_to_all (models.moe).
     """
     b, blk = tokens.shape
     dt = cfg.act_dtype
@@ -165,6 +190,7 @@ def forward(params: dict, tokens: jax.Array, cfg: TransformerConfig,
     pos = pos0 + jnp.arange(blk)
 
     x = params["embed"][tokens].astype(dt) + _sincos(pos, cfg.d_model, dt)
+    aux_total = jnp.zeros((), jnp.float32)
 
     for layer in params["layers"]:
         h = _rmsnorm(x, layer["ln1"]["g"])
@@ -186,20 +212,33 @@ def forward(params: dict, tokens: jax.Array, cfg: TransformerConfig,
         x = x + tp_sum(att @ layer["wo"].astype(dt))
 
         h = _rmsnorm(x, layer["ln2"]["g"])
-        h = jax.nn.gelu(h @ layer["w1"].astype(dt))
-        x = x + tp_sum(h @ layer["w2"].astype(dt))
+        if cfg.n_experts > 0:
+            ffn_out, aux = moe.moe_ffn(
+                layer["moe"], h, cfg.n_experts,
+                capacity_factor=cfg.capacity_factor, ep_axis=ep_axis)
+            x = x + ffn_out
+            aux_total = aux_total + aux
+        else:
+            h = jax.nn.gelu(h @ layer["w1"].astype(dt))
+            x = x + tp_sum(h @ layer["w2"].astype(dt))
 
     x = _rmsnorm(x, params["ln_f"]["g"])
-    return (x @ params["embed"].T.astype(dt)).astype(jnp.float32)
+    logits = (x @ params["embed"].T.astype(dt)).astype(jnp.float32)
+    if with_aux:
+        return logits, aux_total
+    return logits
 
 
 def loss_fn(params: dict, tokens: jax.Array, cfg: TransformerConfig,
             sp_axis: Optional[str] = None,
-            tp_axis: Optional[str] = None) -> jax.Array:
-    """Mean next-token cross-entropy. With sp sharding, the label for a
-    shard's last position is the next shard's first token — one ppermute
-    — and the final global position is masked out."""
-    logits = forward(params, tokens, cfg, sp_axis, tp_axis)
+            tp_axis: Optional[str] = None,
+            ep_axis: Optional[str] = None) -> jax.Array:
+    """Mean next-token cross-entropy (+ the MoE load-balancing aux term
+    for expert configs). With sp sharding, the label for a shard's last
+    position is the next shard's first token — one ppermute — and the
+    final global position is masked out."""
+    logits, aux = forward(params, tokens, cfg, sp_axis, tp_axis,
+                          ep_axis=ep_axis, with_aux=True)
     b, blk = tokens.shape
     if sp_axis is None:
         targets = jnp.concatenate(
@@ -226,7 +265,15 @@ def loss_fn(params: dict, tokens: jax.Array, cfg: TransformerConfig,
     if sp_axis is not None:
         local = lax.psum(local, sp_axis)
         count = lax.psum(count, sp_axis)
-    return local / count
+    loss = local / count
+    if cfg.n_experts > 0:
+        if sp_axis is not None:
+            # each sp shard routed its own token slice: average the
+            # local aux terms so the total loss is sp-invariant like
+            # the cross-entropy term
+            aux = lax.pmean(aux, sp_axis)
+        loss = loss + cfg.moe_aux_coef * aux
+    return loss
 
 
 def _vma_active(axis: str) -> bool:
@@ -247,6 +294,7 @@ def train_step(params: dict, tokens: jax.Array, cfg: TransformerConfig,
                lr: float = 1e-2, sp_axis: Optional[str] = None,
                dp_axis: Optional[str] = None,
                tp_axis: Optional[str] = None,
+               ep_axis: Optional[str] = None,
                grad_algorithm: str = "psum"):
     """One SGD step; returns (new_params, loss). Run under shard_jit
     (check_vma=True by default).
@@ -265,15 +313,15 @@ def train_step(params: dict, tokens: jax.Array, cfg: TransformerConfig,
     runs route dp through the automatic path regardless of
     grad_algorithm.
     """
-    if sp_axis is not None or tp_axis is not None:
-        # without vma typing the sp/tp cotangent reductions never happen
-        # and every shard would silently take a different step
-        assert _vma_active(sp_axis or tp_axis), (
-            "sp/tp training requires shard_jit's vma typing "
+    if sp_axis is not None or tp_axis is not None or ep_axis is not None:
+        # without vma typing the sp/tp/ep cotangent reductions never
+        # happen and every shard would silently take a different step
+        assert _vma_active(sp_axis or tp_axis or ep_axis), (
+            "sp/tp/ep training requires shard_jit's vma typing "
             "(check_vma=True); only the pure-dp explicit-ring path may "
             "run with check_vma=False")
     loss, grads = jax.value_and_grad(loss_fn)(params, tokens, cfg, sp_axis,
-                                              tp_axis)
+                                              tp_axis, ep_axis)
     if dp_axis is not None:
         n = lax.axis_size(dp_axis)
         if _vma_active(dp_axis):
@@ -286,5 +334,13 @@ def train_step(params: dict, tokens: jax.Array, cfg: TransformerConfig,
                                        algorithm=grad_algorithm) / n,
                 grads)
         loss = lax.pmean(loss, dp_axis)
+    if ep_axis is not None:
+        # ep is a second data axis: tokens are sharded over it, so the
+        # (vma-inserted) cross-shard grad sums — psum for replicated
+        # params, the all_to_all transpose for expert weights — need the
+        # same 1/n rescale as dp, and the local losses average
+        nep = lax.axis_size(ep_axis)
+        grads = jax.tree.map(lambda g: g / nep, grads)
+        loss = lax.pmean(loss, ep_axis)
     new_params = jax.tree.map(lambda p, g: p - lr * g, params, grads)
     return new_params, loss

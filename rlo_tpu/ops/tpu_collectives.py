@@ -312,6 +312,58 @@ def all_gather(x, axis: str, *, algorithm: str = "xla"):
     return out
 
 
+def all_to_all(x, axis: str, *, algorithm: str = "xla"):
+    """Transpose data across shards: shard r's chunk s (along the leading
+    axis, which must equal the axis size) is delivered to shard s at
+    position r — the dispatch/return collective of expert parallelism
+    (net-new; the reference has no tensor traffic at all, SURVEY.md §5).
+
+    x: (ws, ...) per shard. 'xla' lowers to one XLA AllToAll (the perf
+    path); 'ring' runs ws-1 ppermute steps rotating the FULL buffer and
+    keeping the chunk addressed to this shard each step — simple and
+    schedule-compatible with the other manual collectives, but ~2x the
+    bytes of an optimal ring all-to-all (ws(ws-1) chunk-hops per shard
+    vs ws(ws-1)/2 shipping only undelivered chunks). Use it for parity
+    studies, not bandwidth.
+    """
+    ws = lax.axis_size(axis)
+    if x.shape[0] != ws:
+        raise ValueError(
+            f"leading axis {x.shape[0]} != axis size {ws}")
+    if algorithm == "xla":
+        return lax.all_to_all(x, axis, split_axis=0, concat_axis=0,
+                              tiled=True)
+    if algorithm != "ring":
+        raise ValueError(f"unknown algorithm {algorithm!r}")
+    idx = lax.axis_index(axis)
+    # the ppermute inside the loop makes the carry varying over `axis`
+    # even when the input is replicated — pre-vary both carry halves
+    try:
+        if axis not in jax.typeof(x).vma:
+            x = lax.pcast(x, (axis,), to="varying")
+    except (AttributeError, TypeError):
+        pass
+    out = jnp.zeros_like(x)
+    # my own chunk stays put: out[idx] = x[idx]
+    own = lax.dynamic_index_in_dim(x, idx, 0, keepdims=False)
+    out = lax.dynamic_update_index_in_dim(out, own, idx, 0)
+    perm = list(topology.ring_perm(ws))
+
+    def step(s, carry):
+        # rotate full buffers around the ring; after s+1 hops shard idx
+        # holds the buffer of shard (idx-s-1) and keeps the chunk that
+        # shard addressed to idx
+        out, rolling = carry
+        rolling = lax.ppermute(rolling, axis, perm)
+        src = (idx - s - 1) % ws
+        mine = lax.dynamic_index_in_dim(rolling, idx, 0, keepdims=False)
+        out = lax.dynamic_update_index_in_dim(out, mine, src, 0)
+        return out, rolling
+
+    out, _ = lax.fori_loop(0, ws - 1, step, (out, x))
+    return out
+
+
 def barrier(axis: str):
     """Synchronize all shards on ``axis`` (an AllReduce of a unit token —
     the engine-level analogue is the dissemination barrier in
