@@ -1,0 +1,177 @@
+"""Pipeline parallelism: transformer layers sharded over a `pp` mesh
+axis, microbatches streamed stage-to-stage with `lax.ppermute`.
+
+Net-new capability completing the strategy set (dp/sp/tp/ep/pp; the
+reference has none — SURVEY.md §5). GPipe-style schedule expressed the
+TPU way: one SPMD program under shard_map where every stage runs the
+same `lax.scan` over M + pp - 1 pipeline ticks; at each tick a stage
+applies its local layer block and hands the activation to its successor
+through a single CollectivePermute (the chain permutation
+[(0,1), (1,2), ...] — no wraparound, so stage 0's inbound edge is the
+zeros the schedule expects during fill). Stage 0 injects a fresh
+microbatch each tick; the last stage collects finished activations and
+computes logits + loss; the per-stage work is itself a `lax.scan` over
+the stage's stacked layer parameters. No data-dependent control flow —
+bubbles are masked arithmetic, so XLA overlaps the ppermute with the
+next tick's matmuls.
+
+Parameters: `stack_layers` converts the flagship model's per-layer list
+(models.transformer.init_params) into leaves stacked over a leading
+layer axis, which `pipeline_pspecs` shards over `pp` (each stage owns
+n_layers/pp layers); embed and final-norm are replicated (the embedding
+is used by stage 0 to embed and by the last stage to unembed — its
+gradient contributions from both ends combine through vma's automatic
+psum over pp).
+
+Gradients flow through the scan + ppermute chain by ordinary reverse AD
+(the transpose of a chain ppermute is the reverse chain), so stage-local
+layer grads stay local and `train_step`-style SGD applies shard-wise.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from rlo_tpu.models.transformer import (TransformerConfig, _rmsnorm,
+                                        _sincos, _vma_active, apply_layer,
+                                        next_token_targets, nll_sum)
+
+
+def stack_layers(params: dict) -> dict:
+    """Convert init_params' per-layer list into stacked (L, ...) leaves
+    (scan-able; the leading axis is what `pp` shards)."""
+    layers = params["layers"]
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *layers)
+    return {"embed": params["embed"], "ln_f": params["ln_f"],
+            "stacked": stacked}
+
+
+def unstack_layers(pparams: dict, n_layers: int) -> dict:
+    """Inverse of `stack_layers` (global view)."""
+    layers = [jax.tree.map(lambda x: x[i], pparams["stacked"])
+              for i in range(n_layers)]
+    return {"embed": pparams["embed"], "ln_f": pparams["ln_f"],
+            "layers": layers}
+
+
+def pipeline_pspecs(pp_axis: Optional[str] = None):
+    """PartitionSpec tree for `stack_layers` output: stacked layer
+    leaves sharded over `pp` on the layer axis, embed/ln_f replicated."""
+    from jax.sharding import PartitionSpec as P
+    layer = {
+        "ln1": {"g": P(pp_axis, None)},
+        "wqkv": P(pp_axis, None, None, None),
+        "wo": P(pp_axis, None, None),
+        "ln2": {"g": P(pp_axis, None)},
+        "w1": P(pp_axis, None, None),
+        "w2": P(pp_axis, None, None),
+    }
+    return {"embed": P(), "ln_f": {"g": P()}, "stacked": layer}
+
+
+def _make_stage_fn(cfg: TransformerConfig):
+    """Apply this stage's local stacked layers to an activation block —
+    a lax.scan over transformer.apply_layer, THE layer math (shared with
+    forward, so the block cannot diverge between the two)."""
+    def one_layer(x, lp):
+        x, _aux = apply_layer(x, lp, cfg)
+        return x, None
+
+    def stage(stacked_local, x):
+        out, _ = lax.scan(one_layer, x, stacked_local)
+        return out
+
+    return stage
+
+
+def pipeline_loss(pparams: dict, tokens, cfg: TransformerConfig,
+                  pp_axis: str, n_micro: int):
+    """Mean next-token cross-entropy, computed through the pipeline.
+
+    tokens: (batch, blk), replicated across pp (batch % n_micro == 0).
+    Equals models.transformer.loss_fn on the same params/tokens exactly
+    (microbatching only reorders batch-independent work).
+    """
+    if cfg.n_experts > 0:
+        raise NotImplementedError(
+            "pipeline parallelism currently supports dense layers only; "
+            "MoE (n_experts > 0) composes with dp/sp/ep via "
+            "models.transformer.train_step instead")
+    pp = lax.axis_size(pp_axis)
+    stage_idx = lax.axis_index(pp_axis)
+    b, blk = tokens.shape
+    assert b % n_micro == 0, f"batch {b} % n_micro {n_micro} != 0"
+    mb = b // n_micro
+    dt = cfg.act_dtype
+    stage_fn = _make_stage_fn(cfg)
+    tokens_mb = tokens.reshape(n_micro, mb, blk)
+    pos = jnp.arange(blk)
+    chain = [(i, i + 1) for i in range(pp - 1)]  # no wraparound
+
+    def embed_mb(tok):
+        return (pparams["embed"][tok].astype(dt)
+                + _sincos(pos, cfg.d_model, dt))
+
+    state0 = jnp.zeros((mb, blk, cfg.d_model), dt)
+    try:
+        # the chain ppermute makes the carry varying over pp, and
+        # dp-sharded tokens make it varying over dp — pre-vary the init
+        # over both so the scan carry type is stable
+        need = ({pp_axis} | set(jax.typeof(tokens).vma)) \
+            - set(jax.typeof(state0).vma)
+        if need:
+            state0 = lax.pcast(state0, tuple(sorted(need)), to="varying")
+    except (AttributeError, TypeError):
+        pass
+
+    def tick(state, t):
+        m = jnp.clip(t, 0, n_micro - 1)
+        fresh = embed_mb(lax.dynamic_index_in_dim(tokens_mb, m, 0,
+                                                  keepdims=False))
+        inp = jnp.where(stage_idx == 0, fresh, state)
+        out = stage_fn(pparams["stacked"], inp)
+        send = lax.ppermute(out, pp_axis, chain)
+        return send, out
+
+    _, outs = lax.scan(tick, state0, jnp.arange(n_micro + pp - 1))
+    # the last stage finished microbatch m at tick m + pp - 1
+    finished = lax.dynamic_slice_in_dim(outs, pp - 1, n_micro, 0)
+
+    def mb_loss(x, tok):
+        x = _rmsnorm(x, pparams["ln_f"]["g"])
+        logits = (x @ pparams["embed"].T.astype(dt)).astype(jnp.float32)
+        targets, valid = next_token_targets(tok)
+        return nll_sum(logits, targets, valid)
+
+    sums, counts = jax.vmap(mb_loss)(finished, tokens_mb)
+    local = jnp.sum(sums) / jnp.sum(counts)
+    # only the last stage computed real losses; psum of the masked value
+    # broadcasts it (and types the result invariant over pp)
+    return lax.psum(jnp.where(stage_idx == pp - 1, local, 0.0), pp_axis)
+
+
+def pipeline_train_step(pparams: dict, tokens, cfg: TransformerConfig,
+                        pp_axis: str, n_micro: int, lr: float = 1e-2,
+                        dp_axis: Optional[str] = None
+                        ) -> Tuple[dict, jax.Array]:
+    """One SGD step through the pipeline; composes with dp (tokens
+    additionally sharded over `dp_axis`). Stage-local layer grads stay
+    on their stage; embed/ln_f grads combine over pp via vma's automatic
+    psum."""
+    # without vma typing, the cross-stage psum of embed/ln_f cotangents
+    # never happens and every stage silently takes a different step
+    assert _vma_active(pp_axis), (
+        "pipeline training requires shard_jit's vma typing "
+        "(check_vma=True)")
+    loss, grads = jax.value_and_grad(pipeline_loss)(pparams, tokens, cfg,
+                                                    pp_axis, n_micro)
+    if dp_axis is not None:
+        n = lax.axis_size(dp_axis)
+        grads = jax.tree.map(lambda g: g / n, grads)
+        loss = lax.pmean(loss, dp_axis)
+    new_params = jax.tree.map(lambda p, g: p - lr * g, pparams, grads)
+    return new_params, loss

@@ -149,6 +149,79 @@ def _sincos(pos, d_model, dtype):
                            axis=-1).astype(dtype)
 
 
+def apply_layer(x, layer: dict, cfg: TransformerConfig, *,
+                sp_axis: Optional[str] = None,
+                tp_axis: Optional[str] = None,
+                tp_algorithm: str = "psum",
+                ep_axis: Optional[str] = None):
+    """One transformer layer (attention + FFN sublayers) on activation
+    ``x`` (b, blk, d). Returns (x, aux). The single source of the layer
+    math — `forward` iterates it and the pipeline stage
+    (models.pipeline) scans it, so the block cannot silently diverge
+    between the two."""
+    b, blk, _ = x.shape
+    dt = x.dtype
+    ntp = lax.axis_size(tp_axis) if tp_axis is not None else 1
+    assert cfg.n_heads % ntp == 0 and cfg.d_ff % ntp == 0, \
+        f"n_heads {cfg.n_heads} and d_ff {cfg.d_ff} must divide tp={ntp}"
+    nh_local = cfg.n_heads // ntp
+
+    def tp_sum(t):
+        if tp_axis is None:
+            return t
+        return tc.allreduce(t, tp_axis, algorithm=tp_algorithm).astype(
+            t.dtype)
+
+    h = _rmsnorm(x, layer["ln1"]["g"])
+    w = layer["wqkv"].astype(dt)       # (d, 3, local heads x hd)
+    qkv = h @ w.reshape(w.shape[0], -1)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+
+    def heads(t):
+        return t.reshape(b, blk, nh_local, cfg.head_dim)
+
+    q, k, v = heads(q), heads(k), heads(v)
+    if sp_axis is None:
+        att = jax.vmap(lambda q_, k_, v_: full_attention(
+            q_, k_, v_, causal=True))(q, k, v)
+    else:
+        att = jax.vmap(lambda q_, k_, v_: ring_attention(
+            q_, k_, v_, sp_axis, causal=True), in_axes=0)(q, k, v)
+    att = att.reshape(b, blk, nh_local * cfg.head_dim)
+    x = x + tp_sum(att @ layer["wo"].astype(dt))
+
+    h = _rmsnorm(x, layer["ln2"]["g"])
+    if cfg.n_experts > 0:
+        ffn_out, aux = moe.moe_ffn(
+            layer["moe"], h, cfg.n_experts,
+            capacity_factor=cfg.capacity_factor, ep_axis=ep_axis)
+        x = x + ffn_out
+        return x, aux
+    h = jax.nn.gelu(h @ layer["w1"].astype(dt))
+    x = x + tp_sum(h @ layer["w2"].astype(dt))
+    return x, jnp.zeros((), jnp.float32)
+
+
+def next_token_targets(tokens):
+    """Dense (non-sp) next-token labels: shift left, zero-pad, and mask
+    each row's final position. Shared by loss_fn and the pipeline's
+    last-stage loss."""
+    b, blk = tokens.shape
+    targets = jnp.concatenate(
+        [tokens[:, 1:], jnp.zeros((b, 1), tokens.dtype)], axis=1)
+    valid = jnp.concatenate(
+        [jnp.ones((b, blk - 1), jnp.float32),
+         jnp.zeros((b, 1), jnp.float32)], axis=1)
+    return targets, valid
+
+
+def nll_sum(logits, targets, valid):
+    """Summed masked next-token NLL and the valid-token count."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return jnp.sum(nll * valid), jnp.sum(valid)
+
+
 def forward(params: dict, tokens: jax.Array, cfg: TransformerConfig,
             sp_axis: Optional[str] = None,
             tp_axis: Optional[str] = None,
@@ -172,17 +245,6 @@ def forward(params: dict, tokens: jax.Array, cfg: TransformerConfig,
     """
     b, blk = tokens.shape
     dt = cfg.act_dtype
-    ntp = lax.axis_size(tp_axis) if tp_axis is not None else 1
-    assert cfg.n_heads % ntp == 0 and cfg.d_ff % ntp == 0, \
-        f"n_heads {cfg.n_heads} and d_ff {cfg.d_ff} must divide tp={ntp}"
-    nh_local = cfg.n_heads // ntp
-
-    def tp_sum(t):
-        if tp_axis is None:
-            return t
-        return tc.allreduce(t, tp_axis, algorithm=tp_algorithm).astype(
-            t.dtype)
-
     if sp_axis is not None:
         pos0 = lax.axis_index(sp_axis) * blk
     else:
@@ -193,34 +255,10 @@ def forward(params: dict, tokens: jax.Array, cfg: TransformerConfig,
     aux_total = jnp.zeros((), jnp.float32)
 
     for layer in params["layers"]:
-        h = _rmsnorm(x, layer["ln1"]["g"])
-        w = layer["wqkv"].astype(dt)       # (d, 3, local heads x hd)
-        qkv = h @ w.reshape(w.shape[0], -1)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-
-        def heads(t):
-            return t.reshape(b, blk, nh_local, cfg.head_dim)
-
-        q, k, v = heads(q), heads(k), heads(v)
-        if sp_axis is None:
-            att = jax.vmap(lambda q_, k_, v_: full_attention(
-                q_, k_, v_, causal=True))(q, k, v)
-        else:
-            att = jax.vmap(lambda q_, k_, v_: ring_attention(
-                q_, k_, v_, sp_axis, causal=True), in_axes=0)(q, k, v)
-        att = att.reshape(b, blk, nh_local * cfg.head_dim)
-        x = x + tp_sum(att @ layer["wo"].astype(dt))
-
-        h = _rmsnorm(x, layer["ln2"]["g"])
-        if cfg.n_experts > 0:
-            ffn_out, aux = moe.moe_ffn(
-                layer["moe"], h, cfg.n_experts,
-                capacity_factor=cfg.capacity_factor, ep_axis=ep_axis)
-            x = x + ffn_out
-            aux_total = aux_total + aux
-        else:
-            h = jax.nn.gelu(h @ layer["w1"].astype(dt))
-            x = x + tp_sum(h @ layer["w2"].astype(dt))
+        x, aux = apply_layer(x, layer, cfg, sp_axis=sp_axis,
+                             tp_axis=tp_axis, tp_algorithm=tp_algorithm,
+                             ep_axis=ep_axis)
+        aux_total = aux_total + aux
 
     x = _rmsnorm(x, params["ln_f"]["g"])
     logits = (x @ params["embed"].T.astype(dt)).astype(jnp.float32)
@@ -241,11 +279,7 @@ def loss_fn(params: dict, tokens: jax.Array, cfg: TransformerConfig,
                           ep_axis=ep_axis, with_aux=True)
     b, blk = tokens.shape
     if sp_axis is None:
-        targets = jnp.concatenate(
-            [tokens[:, 1:], jnp.zeros((b, 1), tokens.dtype)], axis=1)
-        valid = jnp.concatenate(
-            [jnp.ones((b, blk - 1), jnp.float32),
-             jnp.zeros((b, 1), jnp.float32)], axis=1)
+        targets, valid = next_token_targets(tokens)
     else:
         ws = lax.axis_size(sp_axis)
         idx = lax.axis_index(sp_axis)
@@ -258,10 +292,7 @@ def loss_fn(params: dict, tokens: jax.Array, cfg: TransformerConfig,
             [jnp.ones((b, blk - 1), jnp.float32),
              jnp.where(is_last_shard, 0.0, 1.0) * jnp.ones(
                  (b, 1), jnp.float32)], axis=1)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    local = jnp.sum(nll * valid)
-    count = jnp.sum(valid)
+    local, count = nll_sum(logits, targets, valid)
     if sp_axis is not None:
         local = lax.psum(local, sp_axis)
         count = lax.psum(count, sp_axis)
