@@ -29,6 +29,10 @@ Per-rank data is passed/returned as a list with one numpy array per rank
             rlo_demo binary (rlo_tpu/native/rlo_demo.c)
   mpi       compile-gated MPI transport (rlo_mpi.c); available only in
             builds where mpi.h exists, under mpirun
+  hybrid    the C-core <-> JAX bridge (rlo_tpu.bridge): native engines
+            as the control plane (bcast/consensus), the device mesh as
+            the data plane, and propose_collective() gating TPU
+            collectives on leaderless consensus rounds
 """
 
 from __future__ import annotations
@@ -61,6 +65,19 @@ def init(backend: Optional[str] = None, world_size: Optional[int] = None,
             f"unknown ROOTLESS_BACKEND {name!r}; "
             f"known: {sorted(_FACTORIES)}") from None
     return factory(world_size=world_size, **kwargs)
+
+
+def _lazy(module: str, attr: str):
+    """Register a backend implemented in a module that itself imports
+    this one (the hybrid bridge): resolve on first use."""
+    def factory(**kwargs):
+        import importlib
+        cls = getattr(importlib.import_module(module), attr)
+        return cls(**kwargs)
+    return factory
+
+
+_FACTORIES["hybrid"] = _lazy("rlo_tpu.bridge", "HybridBackend")
 
 
 def _auto_backend() -> str:
@@ -347,26 +364,14 @@ class NativeBackend(Backend):
                                   origin, x)
 
     def consensus(self, votes: Sequence[int]) -> int:
-        from rlo_tpu.native.bindings import NativeWorld, NativeEngine
+        from rlo_tpu.native.bindings import run_judged_proposal
 
         votes = list(votes)
         if len(votes) != self.world_size:
             raise ValueError("need one vote per rank")
-        world = NativeWorld(self.world_size)
-        try:
-            engines = [NativeEngine(
-                world, r, judge_cb=lambda payload, ctx, r=r: votes[r])
-                for r in range(self.world_size)]
-            rc = engines[0].submit_proposal(b"facade", pid=0)
-            if rc == -1:
-                world.drain()
-                rc = engines[0].vote_my_proposal()
-            if rc not in (0, 1):
-                raise RuntimeError(f"consensus incomplete ({rc})")
-            world.drain()
-            return int(rc)
-        finally:
-            world.close()
+        return run_judged_proposal(
+            self.world_size, b"facade", proposer=0,
+            judge_for=lambda r: (lambda payload, ctx: votes[r]))
 
     def _bcast_gather(self, xs) -> List[List[np.ndarray]]:
         """Every rank broadcasts its tensor; returns per-rank lists of

@@ -1,0 +1,137 @@
+"""C-core <-> JAX bridge: native control plane, TPU data plane.
+
+The build plan's final integration step (SURVEY.md §7 step 8: "C-core <->
+JAX bridge (host orchestration calls into a persistent JAX runner)").
+The reference's whole purpose is *leaderless agreement about what to do
+next* — any rank proposes, every rank judges, votes AND-merge up the
+tree, the decision broadcasts (RLO_submit_proposal,
+/root/reference/rootless_ops.c:876) — while the actual work happens
+elsewhere. Here that split becomes literal:
+
+  - **control plane**: the native C engines (rlo_tpu/native, through
+    ctypes) run the rootless broadcast and IAR consensus state machines;
+  - **data plane**: a persistent jitted-collective runner over the jax
+    device mesh (the TpuBackend op cache — compiled once per
+    (op, shape, dtype), reused every round).
+
+`propose_collective` is the reference's proposal/judgement/action
+callback pattern (rootless_ops.h:73-77) applied to tensor work: the
+proposal payload describes the collective (op, reduction, shape, dtype);
+every rank's judgement callback validates the descriptor against its
+local tensor — any mismatch is a NO vote that vetoes the round before
+any device time is spent; the approved decision's action is the TPU
+collective itself.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from rlo_tpu.backend import Backend, NativeBackend, TpuBackend, _register
+
+
+def _describe(op: str, reduce_op: str, xs: Sequence[np.ndarray]) -> bytes:
+    x = np.asarray(xs[0])
+    return json.dumps({"op": op, "reduce": reduce_op,
+                       "shape": list(x.shape),
+                       "dtype": str(x.dtype)}).encode()
+
+
+@_register("hybrid")
+class HybridBackend(Backend):
+    """C engines decide; the TPU mesh executes.
+
+    Facade ops route by plane: `bcast`/`consensus` run on the native
+    engine substrate (byte frames over the C loopback world),
+    `allreduce`/`reduce_scatter`/`all_gather`/`barrier` on the device
+    mesh. `propose_collective` chains them: an IAR consensus round gates
+    the collective.
+    """
+
+    name = "hybrid"
+
+    def __init__(self, world_size: Optional[int] = None, **kwargs):
+        self._tpu = TpuBackend(world_size=world_size)
+        self.world_size = self._tpu.world_size
+        self._native = NativeBackend(world_size=self.world_size)
+
+    # ---- control plane (C engines) ----
+    def bcast(self, origin: int, x: np.ndarray) -> List[np.ndarray]:
+        return self._native.bcast(origin, x)
+
+    def consensus(self, votes: Sequence[int]) -> int:
+        return self._native.consensus(votes)
+
+    # ---- data plane (device mesh) ----
+    def allreduce(self, xs, op: str = "sum") -> List[np.ndarray]:
+        return self._tpu.allreduce(xs, op=op)
+
+    def reduce_scatter(self, xs, op: str = "sum") -> List[np.ndarray]:
+        return self._tpu.reduce_scatter(xs, op=op)
+
+    def all_gather(self, xs) -> List[np.ndarray]:
+        return self._tpu.all_gather(xs)
+
+    def barrier(self) -> None:
+        self._tpu.barrier()
+
+    # ---- the bridge ----
+    def propose_collective(self, op: str, xs: Sequence[np.ndarray],
+                           proposer: int = 0, reduce_op: str = "sum"):
+        """Leaderless-consensus-gated collective.
+
+        Rank ``proposer`` proposes running collective ``op`` on the
+        per-rank tensors ``xs``; every rank's judgement callback
+        validates the proposal descriptor against its own tensor (shape
+        and dtype must agree — the collective would be malformed
+        otherwise). The AND-merged decision gates the device work:
+
+        Returns (decision, results): decision 1 and the per-rank outputs
+        on approval; decision 0 and None when any rank vetoed.
+
+        ~RLO_submit_proposal + prop_judgement_cb + proposal_action
+        (rootless_ops.c:876, :698, :842), with the action generalized
+        from a host callback to the TPU data plane.
+        """
+        from rlo_tpu.native.bindings import run_judged_proposal
+
+        if op not in ("allreduce", "reduce_scatter", "all_gather"):
+            raise ValueError(f"unknown collective {op!r}")
+        if not 0 <= proposer < self.world_size:
+            raise ValueError(f"proposer {proposer} out of range "
+                             f"[0, {self.world_size})")
+        xs = self._check_xs(xs)
+        payload = _describe(op, reduce_op, [xs[proposer]])
+
+        def judge_for(rank: int):
+            def judge(prop: bytes, _ctx) -> int:
+                want = json.loads(prop.decode())
+                x = xs[rank]
+                ok = (want["shape"] == list(x.shape)
+                      and want["dtype"] == str(x.dtype))
+                return 1 if ok else 0
+            return judge
+
+        approved = []  # action cb fires on every approving rank (:842)
+        rc = run_judged_proposal(
+            self.world_size, payload, proposer, judge_for=judge_for,
+            action_cb=lambda rank, p: approved.append(rank))
+        if rc == 0:
+            return 0, None
+        # the action fires on every passive rank (the proposer learns
+        # the decision from its own vote merge, reference :842 vs :777)
+        want_ranks = [r for r in range(self.world_size) if r != proposer]
+        assert sorted(approved) == want_ranks, (
+            f"approval action fired on {sorted(approved)}, expected "
+            f"{want_ranks}")
+        if op == "allreduce":
+            return 1, self.allreduce(xs, op=reduce_op)
+        if op == "reduce_scatter":
+            return 1, self.reduce_scatter(xs, op=reduce_op)
+        return 1, self.all_gather(xs)
+
+    def close(self) -> None:
+        self._native.close()

@@ -362,6 +362,41 @@ def frame_roundtrip(origin: int, pid: int, vote: int, payload: bytes):
     return o.value, p.value, v.value, data, bytes(raw)
 
 
+def run_judged_proposal(world_size: int, payload: bytes, proposer: int,
+                        judge_for=None, action_cb=None, pid: int = None
+                        ) -> int:
+    """One complete IAR consensus round on a fresh in-process C world:
+    rank `proposer` submits `payload`, every rank judges it with
+    ``judge_for(rank)`` (None = approve), approving ranks fire
+    ``action_cb(rank, payload)``; returns the decision (0/1).
+
+    The shared plumbing behind NativeBackend.consensus and the hybrid
+    bridge's propose_collective (~RLO_submit_proposal + callbacks,
+    reference rootless_ops.c:876, :698, :842)."""
+    if not 0 <= proposer < world_size:
+        raise ValueError(f"proposer {proposer} out of range "
+                         f"[0, {world_size})")
+    world = NativeWorld(world_size)
+    try:
+        engines = [NativeEngine(
+            world, r,
+            judge_cb=(judge_for(r) if judge_for is not None else None),
+            action_cb=(None if action_cb is None else
+                       (lambda p, ctx, r=r: action_cb(r, p))))
+            for r in range(world_size)]
+        rc = engines[proposer].submit_proposal(
+            payload, pid=proposer if pid is None else pid)
+        if rc == -1:
+            world.drain()
+            rc = engines[proposer].vote_my_proposal()
+        if rc not in (0, 1):
+            raise RuntimeError(f"consensus incomplete ({rc})")
+        world.drain()
+        return int(rc)
+    finally:
+        world.close()
+
+
 def bench_allreduce(world_size: int, count: int, reps: int = 5) -> float:
     """Median usec per wholly-native bcast-gather fp32 allreduce of
     `count` floats per rank (no Python in the measured loop); raises on

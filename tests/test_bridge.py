@@ -1,0 +1,76 @@
+"""C-core <-> JAX bridge: native control plane gating the TPU data plane
+(SURVEY.md §7 step 8).
+
+Oracles: facade ops route to the right plane and stay numerically
+correct; a consensus-approved proposal actually runs the collective (and
+the action callback fired on every rank); a shape/dtype mismatch on ANY
+rank vetoes the round before any device work.
+"""
+
+import numpy as np
+import pytest
+
+import rlo_tpu
+
+WS = 4
+
+
+@pytest.fixture(scope="module")
+def backend():
+    with rlo_tpu.init(backend="hybrid", world_size=WS) as b:
+        yield b
+
+
+def _xs(ws=WS, n=64, dtype=np.float32, seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.standard_normal(n).astype(dtype) for _ in range(ws)]
+
+
+class TestPlanes:
+    def test_data_plane_allreduce(self, backend):
+        xs = _xs()
+        out = backend.allreduce(xs)
+        np.testing.assert_allclose(out[2], np.sum(xs, axis=0),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_control_plane_bcast_and_consensus(self, backend):
+        xs = _xs()
+        got = backend.bcast(origin=3, x=xs[1])
+        np.testing.assert_array_equal(got[0], xs[1])
+        assert backend.consensus([1] * WS) == 1
+        assert backend.consensus([1, 1, 0, 1]) == 0
+
+
+class TestProposedCollective:
+    def test_approved_runs_collective(self, backend):
+        xs = _xs(seed=1)
+        decision, out = backend.propose_collective("allreduce", xs,
+                                                   proposer=2)
+        assert decision == 1
+        np.testing.assert_allclose(out[0], np.sum(xs, axis=0),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_mismatched_shape_vetoes(self, backend):
+        xs = _xs(seed=2)
+        xs[3] = xs[3][:32]  # rank 3's tensor disagrees with the proposal
+        decision, out = backend.propose_collective("allreduce", xs,
+                                                   proposer=0)
+        assert decision == 0 and out is None
+
+    def test_mismatched_dtype_vetoes(self, backend):
+        xs = _xs(seed=3)
+        xs[1] = xs[1].astype(np.float64)
+        decision, out = backend.propose_collective("all_gather", xs)
+        assert decision == 0 and out is None
+
+    def test_reduce_scatter_gated(self, backend):
+        xs = _xs(seed=4)
+        decision, out = backend.propose_collective("reduce_scatter", xs)
+        assert decision == 1
+        full = np.sum(xs, axis=0)
+        np.testing.assert_allclose(out[1], full.reshape(WS, -1)[1],
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_unknown_op_rejected(self, backend):
+        with pytest.raises(ValueError, match="unknown collective"):
+            backend.propose_collective("transpose", _xs())
