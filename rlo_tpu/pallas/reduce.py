@@ -28,13 +28,14 @@ except ImportError:  # pragma: no cover
     pltpu = None
 
 _LANE = 128
-# 512*128*4B = 256 KB/operand per grid block. Block-shape sweep on the
-# tunneled v5e (2026-07-29, 256 MB fp32 operands, chained-iteration timing):
-# blocks >1 MB/operand fail remote compile; 512 rows beat 2048/8192; adding
-# dimension_semantics=("parallel",) raised ~475 -> ~545 GB/s and output
-# aliasing raised it further to ~687 GB/s effective, vs ~830-870 GB/s for
-# the XLA-fused equivalent. Re-measure with bench.py when retuning.
-_DEFAULT_BLOCK_ROWS = 512
+# 2048*128*4B = 1 MB/operand per grid block. Block-shape sweep on the
+# tunneled v5e (2026-07-30, 256 MB fp32 operands, k=256 chained timing,
+# benchmarks/pallas_sweep.py): 2048 rows ~731 GB/s vs 512 rows ~657 and
+# XLA-fused ~727 (parity); wider lane layouts (256-1024-wide rows) are
+# 2-3x SLOWER — the (rows, 128) native lane layout wins. Short chains
+# (k<=64) sit at the tunneled device's ~110 ms dispatch noise floor and
+# can report physically impossible numbers; retune with long chains only.
+_DEFAULT_BLOCK_ROWS = 2048
 
 
 def _on_tpu() -> bool:
@@ -75,9 +76,9 @@ def _out_struct(a):
 
 def _fused_combine_2d(a, b, op: str, block_rows: int, interpret: bool,
                       in_place: bool):
-    rows = a.shape[0]
+    rows, width = a.shape
     grid = (pl.cdiv(rows, block_rows),)
-    spec = pl.BlockSpec((block_rows, _LANE), lambda i: (i, 0))
+    spec = pl.BlockSpec((block_rows, width), lambda i: (i, 0))
     kwargs = {}
     if not interpret and pltpu is not None:
         # 'parallel' lets Mosaic pipeline block DMA with compute
@@ -99,34 +100,41 @@ def _fused_combine_2d(a, b, op: str, block_rows: int, interpret: bool,
 
 
 def fused_combine(a, b, op: str = "sum", block_rows: int = _DEFAULT_BLOCK_ROWS,
-                  interpret: bool | None = None, in_place: bool = True):
+                  interpret: bool | None = None, in_place: bool = True,
+                  lane: int = _LANE):
     """Elementwise ``op(a, b)`` with f32 accumulation, as one Pallas kernel.
 
-    Accepts any shape/dtype; internally lays the data out as (rows, 128)
-    lanes, padding the tail. ``interpret=None`` auto-selects: compiled on
-    TPU, interpreter elsewhere. ``in_place`` aliases the kernel's first
-    operand — the internal (rows, 128) staging buffer, not the caller's
-    array — into the output, dropping one 'rows x 128' allocation per call
-    on the accumulate path; the caller's ``a`` is never mutated.
+    Accepts any shape/dtype; internally lays the data out as
+    (rows, ``lane``) with the tail padded (``lane`` must be a multiple
+    of the 128-wide vector lane; wider rows mean larger contiguous DMA
+    blocks — retune with benchmarks/pallas_sweep.py). ``interpret=None``
+    auto-selects: compiled on TPU, interpreter elsewhere. ``in_place``
+    aliases the kernel's first operand — the internal staging buffer,
+    not the caller's array — into the output, dropping one staging
+    allocation per call on the accumulate path; the caller's ``a`` is
+    never mutated.
     """
     if a.shape != b.shape or a.dtype != b.dtype:
         raise ValueError(f"operand mismatch: {a.shape}/{a.dtype} vs "
                          f"{b.shape}/{b.dtype}")
     if op not in _F32_OPS and op not in _INT_OPS:
         raise ValueError(f"unknown op {op!r}")
+    if lane <= 0 or lane % _LANE:
+        raise ValueError(
+            f"lane {lane} must be a positive multiple of {_LANE}")
     if interpret is None:
         interpret = not _on_tpu()
     orig_shape = a.shape
     n = a.size
-    rows = -(-n // _LANE)
+    rows = -(-n // lane)
     # sublane alignment: round rows up so every grid block is full
     sub = 16 if a.dtype == jnp.bfloat16 else 8
     rows = -(-rows // sub) * sub
-    pad = rows * _LANE - n
+    pad = rows * lane - n
     af = jnp.concatenate([a.reshape(-1), jnp.zeros(pad, a.dtype)]) \
-        .reshape(rows, _LANE)
+        .reshape(rows, lane)
     bf = jnp.concatenate([b.reshape(-1), jnp.zeros(pad, b.dtype)]) \
-        .reshape(rows, _LANE)
+        .reshape(rows, lane)
     block = min(block_rows, rows)
     out = _fused_combine_2d(af, bf, op, block, interpret, in_place)
     return out.reshape(-1)[:n].reshape(orig_shape)

@@ -233,3 +233,28 @@ class TestMultiAxisMesh:
         want = np.asarray(x).sum(axis=1, keepdims=True)
         np.testing.assert_allclose(got, np.broadcast_to(want, got.shape),
                                    rtol=1e-5)
+
+
+class TestMultisliceMesh:
+    def test_single_slice_fallback_dcn_size_1(self):
+        """On a single-slice platform (CPU: no slice_index), the dcn
+        axis degrades to size 1 and programs run unchanged."""
+        from rlo_tpu.parallel.mesh import make_multislice_mesh
+        mesh = make_multislice_mesh((2, 4), ("dp", "tp"))
+        assert mesh.axis_names == ("dcn", "dp", "tp")
+        assert mesh.devices.shape == (1, 2, 4)
+        x = sharded_rand((2, 4, 6))
+        f = jax.jit(jax.shard_map(
+            lambda v: tc.allreduce(v, "tp") + 0 * jnp.float32(
+                jax.lax.psum(1, "dcn")),  # dcn axis is usable
+            mesh=mesh, in_specs=P(None, "dp", "tp"),
+            out_specs=P(None, "dp", "tp")))
+        got = np.asarray(f(x[None]))[0]
+        want = np.asarray(x).sum(axis=1, keepdims=True)
+        np.testing.assert_allclose(got, np.broadcast_to(want, got.shape),
+                                   rtol=1e-5)
+
+    def test_ici_shape_must_fit_in_slice(self):
+        from rlo_tpu.parallel.mesh import make_multislice_mesh
+        with pytest.raises(ValueError, match="needs"):
+            make_multislice_mesh((64,), ("x",))
