@@ -55,6 +55,11 @@ class TransformerConfig:
     n_experts: int = 0
     capacity_factor: float = 2.0
     moe_aux_coef: float = 1e-2
+    # sequence-parallel attention strategy: 'ring' (K/V streaming over
+    # the ppermute ring) or 'ulysses' (all_to_all head-scatter; needs
+    # local heads divisible by the sp size) — rlo_tpu.ops.{ring_attention,
+    # ulysses}
+    sp_attention: str = "ring"
 
     @property
     def head_dim(self) -> int:
@@ -184,9 +189,17 @@ def apply_layer(x, layer: dict, cfg: TransformerConfig, *,
     if sp_axis is None:
         att = jax.vmap(lambda q_, k_, v_: full_attention(
             q_, k_, v_, causal=True))(q, k, v)
-    else:
+    elif cfg.sp_attention == "ulysses":
+        from rlo_tpu.ops.ulysses import ulysses_attention
+        att = jax.vmap(lambda q_, k_, v_: ulysses_attention(
+            q_, k_, v_, sp_axis, causal=True), in_axes=0)(q, k, v)
+    elif cfg.sp_attention == "ring":
         att = jax.vmap(lambda q_, k_, v_: ring_attention(
             q_, k_, v_, sp_axis, causal=True), in_axes=0)(q, k, v)
+    else:
+        raise ValueError(
+            f"unknown sp_attention {cfg.sp_attention!r}; "
+            f"known: 'ring', 'ulysses'")
     att = att.reshape(b, blk, nh_local * cfg.head_dim)
     x = x + tp_sum(att @ layer["wo"].astype(dt))
 

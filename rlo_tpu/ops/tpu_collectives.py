@@ -66,27 +66,37 @@ def rootless_bcast(x, origin: int, axis: str, *, schedule: str = "binomial"):
     origins).
     """
     ws = lax.axis_size(axis)
-    if schedule == "gather":
-        full = lax.all_gather(x, axis)
-        return lax.dynamic_index_in_dim(full, origin, 0, keepdims=False)
-    if schedule == "binomial":
-        sched = topology.binomial_bcast_schedule(ws, origin)
-    elif schedule == "skip_ring":
-        sched = topology.skip_ring_bcast_schedule(ws, origin)
-    else:
-        raise ValueError(f"unknown schedule {schedule!r}")
-    idx = lax.axis_index(axis)
-    for rnd in sched.rounds:
-        recv = lax.ppermute(x, axis, list(rnd))
-        dsts = jnp.asarray([d for _, d in rnd])
-        is_dst = jnp.any(idx == dsts)
-        x = jnp.where(is_dst, recv, x)
-    return x
+    with _named(f"rootless_bcast.{schedule}"):
+        if schedule == "gather":
+            full = lax.all_gather(x, axis)
+            return lax.dynamic_index_in_dim(full, origin, 0,
+                                            keepdims=False)
+        if schedule == "binomial":
+            sched = topology.binomial_bcast_schedule(ws, origin)
+        elif schedule == "skip_ring":
+            sched = topology.skip_ring_bcast_schedule(ws, origin)
+        else:
+            raise ValueError(f"unknown schedule {schedule!r}")
+        idx = lax.axis_index(axis)
+        for rnd in sched.rounds:
+            recv = lax.ppermute(x, axis, list(rnd))
+            dsts = jnp.asarray([d for _, d in rnd])
+            is_dst = jnp.any(idx == dsts)
+            x = jnp.where(is_dst, recv, x)
+        return x
 
 
 # ---------------------------------------------------------------------------
 # Allreduce / reduce-scatter / all-gather
 # ---------------------------------------------------------------------------
+
+def _named(name: str):
+    """jax.named_scope so the lowered HLO carries the op name — the
+    collectives show up labeled in TPU profiles / xplane traces (the
+    tracing subsystem's device-side counterpart; SURVEY.md §5 asks for
+    jax.profiler integration)."""
+    return jax.named_scope(f"rlo_tpu.{name}")
+
 
 def allreduce(x, axis: str, *, op: str = "sum", algorithm: str = "auto",
               use_pallas: Optional[bool] = None):
@@ -106,25 +116,26 @@ def allreduce(x, axis: str, *, op: str = "sum", algorithm: str = "auto",
         use_pallas = jax.default_backend() == "tpu"
     if algorithm == "auto":
         algorithm = "psum"
-    if algorithm == "psum":
-        if op in _PSUM_OPS:
-            return _PSUM_OPS[op](x, axis)
-        if op in ("and", "or"):  # min/max over {0,1} == and/or
-            f = lax.pmin if op == "and" else lax.pmax
-            return f(x, axis)
-        raise ValueError(f"unknown op {op!r}")
-    if algorithm == "recursive_doubling":
-        return _allreduce_rd(x, axis, op, use_pallas)
-    if algorithm == "ring":
-        chunks, meta = _chunk_shard(x, lax.axis_size(axis))
-        _, reduced = _ring_reduce_scatter(chunks, axis, op, use_pallas)
-        gathered = _ring_all_gather_rolled(reduced, axis)
-        return _unchunk_shard(gathered, meta)
-    if algorithm == "halving_doubling":
-        chunks, meta = _chunk_shard(x, lax.axis_size(axis))
-        reduced = _halving_reduce_scatter(chunks, axis, op, use_pallas)
-        gathered = _doubling_all_gather(reduced, axis)
-        return _unchunk_shard(gathered, meta)
+    with _named(f"allreduce.{algorithm}.{op}"):
+        if algorithm == "psum":
+            if op in _PSUM_OPS:
+                return _PSUM_OPS[op](x, axis)
+            if op in ("and", "or"):  # min/max over {0,1} == and/or
+                f = lax.pmin if op == "and" else lax.pmax
+                return f(x, axis)
+            raise ValueError(f"unknown op {op!r}")
+        if algorithm == "recursive_doubling":
+            return _allreduce_rd(x, axis, op, use_pallas)
+        if algorithm == "ring":
+            chunks, meta = _chunk_shard(x, lax.axis_size(axis))
+            _, reduced = _ring_reduce_scatter(chunks, axis, op, use_pallas)
+            gathered = _ring_all_gather_rolled(reduced, axis)
+            return _unchunk_shard(gathered, meta)
+        if algorithm == "halving_doubling":
+            chunks, meta = _chunk_shard(x, lax.axis_size(axis))
+            reduced = _halving_reduce_scatter(chunks, axis, op, use_pallas)
+            gathered = _doubling_all_gather(reduced, axis)
+            return _unchunk_shard(gathered, meta)
     raise ValueError(f"unknown algorithm {algorithm!r}")
 
 
@@ -271,15 +282,17 @@ def reduce_scatter(x, axis: str, *, op: str = "sum",
     ws = lax.axis_size(axis)
     if algorithm == "auto":
         algorithm = "halving" if topology.is_power_of_2(ws) else "ring"
-    chunks, _ = _chunk_shard(x, ws)
-    if algorithm == "halving":
-        return _halving_reduce_scatter(chunks, axis, op, use_pallas)
-    if algorithm != "ring":
-        raise ValueError(f"unknown algorithm {algorithm!r}")
-    own_idx, reduced = _ring_reduce_scatter(chunks, axis, op, use_pallas)
-    # rotate one hop forward so shard r holds chunk r
-    back_perm = list(topology.ring_perm(ws, 1))
-    return lax.ppermute(reduced, axis, back_perm)
+    with _named(f"reduce_scatter.{algorithm}.{op}"):
+        chunks, _ = _chunk_shard(x, ws)
+        if algorithm == "halving":
+            return _halving_reduce_scatter(chunks, axis, op, use_pallas)
+        if algorithm != "ring":
+            raise ValueError(f"unknown algorithm {algorithm!r}")
+        own_idx, reduced = _ring_reduce_scatter(chunks, axis, op,
+                                                use_pallas)
+        # rotate one hop forward so shard r holds chunk r
+        back_perm = list(topology.ring_perm(ws, 1))
+        return lax.ppermute(reduced, axis, back_perm)
 
 
 def all_gather(x, axis: str, *, algorithm: str = "xla"):
@@ -288,28 +301,29 @@ def all_gather(x, axis: str, *, algorithm: str = "xla"):
     'xla' lowers to one AllGather; 'ring' uses ws-1 ppermute steps;
     'doubling' uses log2(ws) recursive-doubling exchanges (power-of-2 only).
     """
-    if algorithm == "xla":
-        return lax.all_gather(x, axis)
-    if algorithm == "doubling":
-        return _doubling_all_gather(x, axis)
-    if algorithm != "ring":
+    if algorithm not in ("xla", "doubling", "ring"):
         raise ValueError(f"unknown algorithm {algorithm!r}")
-    ws = lax.axis_size(axis)
-    idx = lax.axis_index(axis)
-    perm = list(topology.ring_perm(ws))
-    out = _vary_like(jnp.zeros((ws,) + x.shape, x.dtype), x)
-    out = lax.dynamic_update_index_in_dim(out, x, idx, 0)
-    cur = x
+    with _named(f"all_gather.{algorithm}"):
+        if algorithm == "xla":
+            return lax.all_gather(x, axis)
+        if algorithm == "doubling":
+            return _doubling_all_gather(x, axis)
+        ws = lax.axis_size(axis)
+        idx = lax.axis_index(axis)
+        perm = list(topology.ring_perm(ws))
+        out = _vary_like(jnp.zeros((ws,) + x.shape, x.dtype), x)
+        out = lax.dynamic_update_index_in_dim(out, x, idx, 0)
+        cur = x
 
-    def step(s, carry):
-        out, cur = carry
-        nxt = lax.ppermute(cur, axis, perm)
-        arr_idx = (idx - s - 1) % ws
-        out = lax.dynamic_update_index_in_dim(out, nxt, arr_idx, 0)
-        return out, nxt
+        def step(s, carry):
+            out, cur = carry
+            nxt = lax.ppermute(cur, axis, perm)
+            arr_idx = (idx - s - 1) % ws
+            out = lax.dynamic_update_index_in_dim(out, nxt, arr_idx, 0)
+            return out, nxt
 
-    out, _ = lax.fori_loop(0, ws - 1, step, (out, cur))
-    return out
+        out, _ = lax.fori_loop(0, ws - 1, step, (out, cur))
+        return out
 
 
 def all_to_all(x, axis: str, *, algorithm: str = "xla"):
@@ -330,11 +344,17 @@ def all_to_all(x, axis: str, *, algorithm: str = "xla"):
     if x.shape[0] != ws:
         raise ValueError(
             f"leading axis {x.shape[0]} != axis size {ws}")
-    if algorithm == "xla":
-        return lax.all_to_all(x, axis, split_axis=0, concat_axis=0,
-                              tiled=True)
-    if algorithm != "ring":
+    if algorithm not in ("xla", "ring"):
         raise ValueError(f"unknown algorithm {algorithm!r}")
+    with _named(f"all_to_all.{algorithm}"):
+        if algorithm == "xla":
+            return lax.all_to_all(x, axis, split_axis=0, concat_axis=0,
+                                  tiled=True)
+        return _all_to_all_ring(x, axis)
+
+
+def _all_to_all_ring(x, axis: str):
+    ws = lax.axis_size(axis)
     idx = lax.axis_index(axis)
     # the ppermute inside the loop makes the carry varying over `axis`
     # even when the input is replicated — pre-vary both carry halves
@@ -368,7 +388,8 @@ def barrier(axis: str):
     """Synchronize all shards on ``axis`` (an AllReduce of a unit token —
     the engine-level analogue is the dissemination barrier in
     rlo_tpu.ops.collectives)."""
-    return lax.psum(jnp.zeros((), jnp.int32), axis)
+    with _named("barrier"):
+        return lax.psum(jnp.zeros((), jnp.int32), axis)
 
 
 # ---------------------------------------------------------------------------
@@ -385,4 +406,5 @@ def consensus(vote, axis: str):
     returned decision — see rlo_tpu.parallel.consensus_step for the full
     host-side protocol wrapper.
     """
-    return lax.pmin(vote.astype(jnp.int32), axis)
+    with _named("consensus.pmin"):
+        return lax.pmin(vote.astype(jnp.int32), axis)
