@@ -263,6 +263,12 @@ class ProgressEngine:
         self.my_proposal_payload = bytes(proposal)
         TRACER.emit(self.rank, Ev.PROPOSAL_SUBMIT, pid)
         self.bcast(proposal, tag=Tag.IAR_PROPOSAL, pid=pid, vote=1)
+        if p.votes_needed == 0 and p.state == ReqState.IN_PROGRESS \
+                and not p.decision_pending:
+            # no awaited voters (sole survivor after elastic
+            # re-forming): nothing will ever call _on_vote
+            self._complete_own_proposal(p)
+            self.manager.progress_all()
         if p.state == ReqState.COMPLETED:
             return p.vote
         return -1

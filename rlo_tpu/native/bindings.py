@@ -83,6 +83,12 @@ def load() -> C.CDLL:
     sig("rlo_world_transport", C.c_char_p, [p])
     sig("rlo_world_failed", C.c_int, [p])
     sig("rlo_world_peer_alive", C.c_int, [p, C.c_int, C.c_uint64])
+    sig("rlo_world_kill_rank", C.c_int, [p, C.c_int])
+    sig("rlo_engine_enable_failure_detection", C.c_int,
+        [p, C.c_uint64, C.c_uint64])
+    sig("rlo_engine_rank_failed", C.c_int, [p, C.c_int])
+    sig("rlo_engine_failed_count", C.c_int, [p])
+    sig("rlo_engine_suspected_self", C.c_int, [p])
     sig("rlo_mpi_available", C.c_int, [])
     sig("rlo_mpi_world_new", p, [])
     sig("rlo_world_quiescent", C.c_int, [p])
@@ -158,6 +164,13 @@ class NativeWorld:
         transports without a liveness signal (in-process loopback)."""
         return bool(self._lib.rlo_world_peer_alive(self._w, rank,
                                                    timeout_usec))
+
+    def kill_rank(self, rank: int) -> None:
+        """Fault injection (loopback only): simulate `rank` crashing —
+        mirror of LoopbackWorld.kill_rank."""
+        rc = self._lib.rlo_world_kill_rank(self._w, rank)
+        if rc != 0:
+            raise RuntimeError(f"kill_rank failed ({rc})")
 
     @property
     def sent_cnt(self) -> int:
@@ -273,6 +286,26 @@ class NativeEngine:
         self._check(self._lib.rlo_pickup_consume(self._e))
         return NativeUserMsg(type=tag.value, origin=origin.value,
                              pid=pid.value, vote=vote.value, data=data)
+
+    def enable_failure_detection(self, timeout_usec: int,
+                                 interval_usec: int = 0) -> None:
+        """Ring-heartbeat liveness detection + elastic survivor
+        re-forming (mirror of ProgressEngine's failure_timeout)."""
+        rc = self._lib.rlo_engine_enable_failure_detection(
+            self._e, timeout_usec, interval_usec)
+        if rc != 0:
+            raise RuntimeError(f"enable_failure_detection failed ({rc})")
+
+    def rank_failed(self, rank: int) -> bool:
+        return bool(self._lib.rlo_engine_rank_failed(self._e, rank))
+
+    @property
+    def failed_count(self) -> int:
+        return self._lib.rlo_engine_failed_count(self._e)
+
+    @property
+    def suspected_self(self) -> bool:
+        return bool(self._lib.rlo_engine_suspected_self(self._e))
 
     def idle(self) -> bool:
         return bool(self._lib.rlo_engine_idle(self._e))

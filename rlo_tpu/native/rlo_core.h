@@ -137,6 +137,12 @@ int rlo_world_failed(const rlo_world *w);
  * crashed or exited peer goes stale within one timeout. */
 int rlo_world_peer_alive(const rlo_world *w, int rank,
                          uint64_t timeout_usec);
+/* Fault injection (loopback only): simulate `rank`'s process dying —
+ * its inbox is discarded, frames in flight to/from it are dropped
+ * (handles complete), future traffic involving it is blackholed, its
+ * polls return nothing. RLO_ERR_ARG on transports without injection.
+ * Mirror of LoopbackWorld.kill_rank (rlo_tpu/transport/loopback.py). */
+int rlo_world_kill_rank(rlo_world *w, int rank);
 int64_t rlo_world_sent_cnt(const rlo_world *w);
 int64_t rlo_world_delivered_cnt(const rlo_world *w);
 
@@ -225,6 +231,28 @@ int64_t rlo_pickup_next(rlo_engine *e, int *tag, int *origin, int *pid,
 int64_t rlo_pickup_peek(rlo_engine *e, int *tag, int *origin, int *pid,
                         int *vote, const uint8_t **payload);
 int rlo_pickup_consume(rlo_engine *e);
+
+/* ------------------------------------------------------------------ */
+/* Failure detection + elastic recovery on the engine (net-new — the    */
+/* reference defines RLO_FAILED but never assigns it, SURVEY.md §5;     */
+/* mirror of the Python engine's failure_timeout machinery): ranks      */
+/* heartbeat their ring successor every interval_usec and declare a     */
+/* silent predecessor failed after timeout_usec, announce it with a     */
+/* rootless FAILURE broadcast, and every survivor re-forms the overlay  */
+/* over the alive set so bcast and consensus keep working (pending      */
+/* consensus rounds discount dead voters; proposals orphaned by a dead  */
+/* proposer or vote-tree parent are dropped). Disabled by default.      */
+/* Unlike the Python engine, a late decision for a dropped orphaned     */
+/* proposal delivers but does not re-run the action callback.           */
+/* ------------------------------------------------------------------ */
+int rlo_engine_enable_failure_detection(rlo_engine *e,
+                                        uint64_t timeout_usec,
+                                        uint64_t interval_usec);
+/* 1 when this engine has marked `rank` failed */
+int rlo_engine_rank_failed(const rlo_engine *e, int rank);
+int rlo_engine_failed_count(const rlo_engine *e);
+/* 1 when a FAILURE notice about THIS rank arrived (false positive) */
+int rlo_engine_suspected_self(const rlo_engine *e);
 
 /* 1 when this engine has no outstanding forwards or pending decision */
 int rlo_engine_idle(const rlo_engine *e);

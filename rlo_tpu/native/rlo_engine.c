@@ -32,6 +32,11 @@ typedef struct rlo_prop {
     rlo_handle **decision_handles;
     int n_decision;
     int decision_pending;
+    /* direct children whose votes are outstanding — lets the failure
+     * detector discount a dead child (mirror of ProposalState.await_from
+     * in rlo_tpu/engine.py) */
+    int await_from[64];
+    int n_await;
 } rlo_prop;
 
 /* ---------------- in-flight message (reference RLO_msg_t,
@@ -66,6 +71,14 @@ struct rlo_engine {
     rlo_prop own; /* my_own_proposal; own.payload = my proposal bytes */
     int err; /* sticky first protocol error */
     rlo_msg *peeked; /* message exposed by rlo_pickup_peek, not consumed */
+    /* failure detection + elastic recovery (0 timeout = disabled;
+     * mirror of the Python engine's failure_timeout machinery) */
+    uint64_t fd_timeout, fd_interval;
+    uint64_t hb_last_sent;
+    uint64_t *hb_seen;  /* per rank: last heartbeat usec (0 = unseen) */
+    uint8_t *failed;    /* per rank */
+    int n_failed;
+    int suspected_self;
 };
 
 /* ---------------- queue ops ---------------- */
@@ -250,7 +263,14 @@ rlo_engine *rlo_engine_new(rlo_world *w, int rank, int comm,
     e->n_init = rlo_initiator_targets(e->ws, rank, e->init_targets, 64);
     e->own.state = RLO_INVALID;
     e->own.pid = -1;
-    if (e->n_init < 0 || rlo_world_register(w, e) != RLO_OK) {
+    /* always present so a FAILURE notice from a detecting peer is
+     * adopted even when this engine's own detector is off */
+    e->failed = (uint8_t *)calloc((size_t)e->ws, 1);
+    e->hb_seen = (uint64_t *)calloc((size_t)e->ws, sizeof(uint64_t));
+    if (e->n_init < 0 || !e->failed || !e->hb_seen ||
+        rlo_world_register(w, e) != RLO_OK) {
+        free(e->failed);
+        free(e->hb_seen);
         free(e);
         return 0;
     }
@@ -281,7 +301,78 @@ void rlo_engine_free(rlo_engine *e)
         rlo_handle_unref(e->own.decision_handles[i]);
     free(e->own.decision_handles);
     free(e->own.payload);
+    free(e->failed);
+    free(e->hb_seen);
     free(e);
+}
+
+/* ---------------- elastic topology (over the alive set) ------------
+ * Mirror of the Python engine's _cur_initiator_targets/_fwd_targets:
+ * identity to the static topology while nothing has failed; after a
+ * failure, the skip-ring math runs on virtual ranks = indices into the
+ * sorted alive set. */
+
+static int vrank_of(const rlo_engine *e, int r)
+{
+    if (!e->n_failed)
+        return r;
+    if (e->failed[r])
+        return -1;
+    int v = 0;
+    for (int i = 0; i < r; i++)
+        if (!e->failed[i])
+            v++;
+    return v;
+}
+
+static int real_of(const rlo_engine *e, int v)
+{
+    if (!e->n_failed)
+        return v;
+    for (int r = 0; r < e->ws; r++)
+        if (!e->failed[r] && v-- == 0)
+            return r;
+    return -1;
+}
+
+static int cur_init_targets(rlo_engine *e, int *out, int cap)
+{
+    if (!e->n_failed) {
+        int n = e->n_init < cap ? e->n_init : cap;
+        memcpy(out, e->init_targets, (size_t)n * sizeof(int));
+        return n;
+    }
+    int vws = e->ws - e->n_failed;
+    if (vws < 2)
+        return 0;
+    int vt[64];
+    int n = rlo_initiator_targets(vws, vrank_of(e, e->rank), vt, 64);
+    if (n < 0 || n > cap)
+        return RLO_ERR_ARG;
+    for (int i = 0; i < n; i++)
+        out[i] = real_of(e, vt[i]);
+    return n;
+}
+
+static int cur_fwd_targets(rlo_engine *e, int origin, int src, int *out,
+                           int cap)
+{
+    if (!e->n_failed)
+        return rlo_fwd_targets(e->ws, e->rank, origin, src, out, cap);
+    if (origin < 0 || origin >= e->ws || src < 0 || src >= e->ws ||
+        e->failed[origin] || e->failed[src])
+        return 0; /* stale pre-failure route: deliver-only */
+    int vws = e->ws - e->n_failed;
+    if (vws < 2)
+        return 0;
+    int vt[64];
+    int n = rlo_fwd_targets(vws, vrank_of(e, e->rank),
+                            vrank_of(e, origin), vrank_of(e, src), vt, 64);
+    if (n < 0 || n > cap)
+        return RLO_ERR_ARG;
+    for (int i = 0; i < n; i++)
+        out[i] = real_of(e, vt[i]);
+    return n;
 }
 
 /* ---------------- rootless broadcast ---------------- */
@@ -301,8 +392,14 @@ static int bcast_init(rlo_engine *e, int tag, int32_t pid, int32_t vote,
     rlo_msg *m = msg_from_frame(tag, -1, frame, &err); /* steals the ref */
     if (!m)
         return err;
-    for (int i = 0; i < e->n_init; i++) { /* furthest-first */
-        int rc = eng_isend_frame(e, e->init_targets[i], tag, m->frame, m);
+    int targets[64];
+    int nt = cur_init_targets(e, targets, 64);
+    if (nt < 0) {
+        msg_free(m);
+        return nt;
+    }
+    for (int i = 0; i < nt; i++) { /* furthest-first */
+        int rc = eng_isend_frame(e, targets[i], tag, m->frame, m);
         if (rc != RLO_OK) {
             msg_free(m);
             return rc;
@@ -329,7 +426,7 @@ int rlo_bcast(rlo_engine *e, const uint8_t *payload, int64_t len)
 static int bc_forward(rlo_engine *e, rlo_msg *m)
 {
     int targets[64];
-    int n = rlo_fwd_targets(e->ws, e->rank, m->origin, m->src, targets, 64);
+    int n = cur_fwd_targets(e, m->origin, m->src, targets, 64);
     if (n < 0)
         return n;
     for (int i = 0; i < n; i++) {
@@ -409,8 +506,17 @@ static void on_proposal(rlo_engine *e, rlo_msg *m)
     ps->recv_from = m->src;
     ps->vote = 1;
     ps->state = RLO_IN_PROGRESS;
-    ps->votes_needed =
-        rlo_fwd_send_cnt(e->ws, e->rank, m->origin, m->src);
+    /* equal to bc_forward's target list by construction, including
+     * after elastic re-forming */
+    ps->n_await = cur_fwd_targets(e, m->origin, m->src,
+                                  ps->await_from, 64);
+    if (ps->n_await < 0) {
+        set_err(e, ps->n_await);
+        m->ps = ps;
+        msg_free(m);
+        return;
+    }
+    ps->votes_needed = ps->n_await;
     m->ps = ps;
     if (!eng_judge(e, m->payload, m->len, ps->pid)) {
         /* decline: NO to parent immediately, don't forward — the subtree
@@ -455,27 +561,55 @@ static void decision_bcast(rlo_engine *e)
     rlo_trace_emit(e->rank, RLO_EV_DECISION, p->pid, p->vote);
 }
 
+/* Drop src from the awaited-children list; 0 if it was not awaited. */
+static int await_remove(rlo_prop *p, int src)
+{
+    for (int i = 0; i < p->n_await; i++)
+        if (p->await_from[i] == src) {
+            p->await_from[i] = p->await_from[--p->n_await];
+            return 1;
+        }
+    return 0;
+}
+
+static void complete_own(rlo_engine *e)
+{
+    rlo_prop *p = &e->own;
+    if (p->vote)
+        /* re-judge: a competing proposal may have changed app state
+         * since submission (reference :773) */
+        p->vote = eng_judge(e, p->payload, p->len, p->pid);
+    decision_bcast(e);
+}
+
 static void on_vote(rlo_engine *e, rlo_msg *m)
 {
     int pid = m->pid, vote = m->vote;
     rlo_prop *p = &e->own;
-    if (pid == p->pid && p->state == RLO_IN_PROGRESS) {
-        p->votes_recved++;
-        p->vote &= vote;
-        if (p->votes_recved == p->votes_needed) {
-            if (p->vote)
-                /* re-judge: a competing proposal may have changed app
-                 * state since submission (reference :773) */
-                p->vote = eng_judge(e, p->payload, p->len, p->pid);
-            decision_bcast(e);
+    if (pid == p->pid && p->state != RLO_INVALID) {
+        /* only votes from still-awaited children count: a vote from a
+         * discounted (suspected-dead) child, or after completion, must
+         * not advance the count past a live child's pending veto */
+        if (p->state == RLO_IN_PROGRESS && await_remove(p, m->src)) {
+            p->votes_recved++;
+            p->vote &= vote;
+            if (p->votes_recved == p->votes_needed)
+                complete_own(e);
         }
         msg_free(m);
         return;
     }
     rlo_msg *pm = find_proposal_msg(e, pid);
     if (!pm) {
-        set_err(e, RLO_ERR_PROTO);
+        if (e->fd_timeout || e->n_failed)
+            ; /* orphaned by a membership change; drop */
+        else
+            set_err(e, RLO_ERR_PROTO);
         msg_free(m);
+        return;
+    }
+    if (!await_remove(pm->ps, m->src)) {
+        msg_free(m); /* late/duplicate vote from a discounted child */
         return;
     }
     pm->ps->vote &= vote;
@@ -516,7 +650,10 @@ int rlo_submit_proposal(rlo_engine *e, const uint8_t *proposal, int64_t len,
     memset(p, 0, sizeof(*p));
     p->pid = pid;
     p->vote = 1;
-    p->votes_needed = e->n_init;
+    p->n_await = cur_init_targets(e, p->await_from, 64);
+    if (p->n_await < 0)
+        return p->n_await;
+    p->votes_needed = p->n_await;
     p->state = RLO_IN_PROGRESS;
     p->len = len;
     if (len > 0) {
@@ -531,6 +668,10 @@ int rlo_submit_proposal(rlo_engine *e, const uint8_t *proposal, int64_t len,
         p->state = RLO_FAILED;
         return rc;
     }
+    if (p->votes_needed == 0)
+        /* no awaited voters (sole survivor after elastic re-forming):
+         * nothing will ever call on_vote — complete immediately */
+        complete_own(e);
     rlo_progress_all(e->w);
     if (p->state == RLO_COMPLETED)
         return p->vote;
@@ -562,6 +703,168 @@ void rlo_proposal_reset(rlo_engine *e)
     p->pid = -1;
     p->vote = 1;
     p->state = RLO_INVALID;
+}
+
+/* ---------------- failure detection + elastic recovery --------------
+ * Mirror of rlo_tpu/engine.py's failure machinery (see rlo_core.h for
+ * the contract). The same non-view-synchronous caveat applies: traffic
+ * initiated after every survivor adopted the failure is exactly-once;
+ * traffic in flight across the change may duplicate or drop. */
+
+static void ring_neighbors(const rlo_engine *e, int *succ, int *pred)
+{
+    int ws = e->ws;
+    int s = -1, p = -1;
+    for (int d = 1; d < ws; d++) {
+        int r = (e->rank + d) % ws;
+        if (!e->failed[r]) {
+            s = r;
+            break;
+        }
+    }
+    for (int d = 1; d < ws; d++) {
+        int r = (e->rank - d % ws + ws) % ws;
+        if (!e->failed[r]) {
+            p = r;
+            break;
+        }
+    }
+    *succ = s;
+    *pred = p;
+}
+
+static void discount_failed_voter(rlo_engine *e, int rank)
+{
+    rlo_prop *p = &e->own;
+    if (p->state == RLO_IN_PROGRESS && !p->decision_pending &&
+        await_remove(p, rank)) {
+        p->votes_needed--;
+        if (p->votes_recved == p->votes_needed)
+            complete_own(e);
+    }
+    for (rlo_msg *pm = e->q_iar_pending.head; pm; pm = pm->next) {
+        if (pm->ps && await_remove(pm->ps, rank)) {
+            pm->ps->votes_needed--;
+            if (pm->ps->votes_recved == pm->ps->votes_needed)
+                vote_back(e, pm->ps, pm->ps->vote);
+        }
+    }
+}
+
+static void abort_orphaned_proposals(rlo_engine *e, int rank)
+{
+    /* relays whose proposer or vote-tree parent died can never resolve:
+     * unpark and drop them (unlike the Python engine we do not keep the
+     * payload for a late decision's action callback) */
+    for (rlo_msg *pm = e->q_iar_pending.head; pm;) {
+        rlo_msg *nm = pm->next;
+        if (pm->ps &&
+            (pm->origin == rank || pm->ps->recv_from == rank)) {
+            pm->ps->state = RLO_FAILED;
+            q_remove(&e->q_iar_pending, pm);
+            msg_free(pm);
+        }
+        pm = nm;
+    }
+}
+
+/* Adopt a failure; returns 1 when newly learned (idempotent). */
+static int mark_failed(rlo_engine *e, int rank)
+{
+    if (!e->failed || rank == e->rank || rank < 0 || rank >= e->ws ||
+        e->failed[rank])
+        return 0;
+    int old_succ, old_pred;
+    ring_neighbors(e, &old_succ, &old_pred);
+    e->failed[rank] = 1;
+    e->n_failed++;
+    e->hb_seen[rank] = 0;
+    if (e->fd_timeout && e->ws - e->n_failed >= 2) {
+        int succ, pred;
+        ring_neighbors(e, &succ, &pred);
+        /* fresh grace only when my predecessor actually changed */
+        if (pred >= 0 && pred != old_pred)
+            e->hb_seen[pred] = rlo_now_usec();
+    }
+    discount_failed_voter(e, rank);
+    abort_orphaned_proposals(e, rank);
+    return 1;
+}
+
+static void declare_failed(rlo_engine *e, int rank)
+{
+    if (!mark_failed(e, rank))
+        return;
+    rlo_trace_emit(e->rank, RLO_EV_FAILURE, rank, 1);
+    /* tell the world: the failure notice rides the overlay itself */
+    int rc = bcast_init(e, RLO_TAG_FAILURE, rank, -1, 0, 0, 0);
+    if (rc != RLO_OK)
+        set_err(e, rc);
+}
+
+static void on_failure(rlo_engine *e, rlo_msg *m)
+{
+    int rank = m->pid;
+    if (rank == e->rank) {
+        /* somebody suspects me — record it; there is no un-fail
+         * protocol (matching the reference's absence of recovery) */
+        e->suspected_self = 1;
+    } else if (mark_failed(e, rank)) {
+        rlo_trace_emit(e->rank, RLO_EV_FAILURE, rank, 0);
+    }
+    int rc = bc_forward(e, m); /* adopt-before-forward ordering */
+    if (rc < 0) {
+        set_err(e, rc);
+        msg_free(m);
+    }
+}
+
+static void failure_tick(rlo_engine *e)
+{
+    if (!e->fd_timeout || e->ws - e->n_failed < 2)
+        return;
+    uint64_t now = rlo_now_usec();
+    int succ, pred;
+    ring_neighbors(e, &succ, &pred);
+    if (succ >= 0 && now - e->hb_last_sent >= e->fd_interval) {
+        eng_isend(e, succ, RLO_TAG_HEARTBEAT, e->rank, -1, -1, 0, 0, 0);
+        e->hb_last_sent = now;
+        rlo_trace_emit(e->rank, RLO_EV_HEARTBEAT, succ, 0);
+    }
+    if (pred < 0)
+        return;
+    if (e->hb_seen[pred] == 0) {
+        e->hb_seen[pred] = now; /* grace on first watch */
+        return;
+    }
+    if (now - e->hb_seen[pred] > e->fd_timeout)
+        declare_failed(e, pred);
+}
+
+int rlo_engine_enable_failure_detection(rlo_engine *e,
+                                        uint64_t timeout_usec,
+                                        uint64_t interval_usec)
+{
+    if (!e || !timeout_usec)
+        return RLO_ERR_ARG;
+    e->fd_timeout = timeout_usec;
+    e->fd_interval = interval_usec ? interval_usec : timeout_usec / 4;
+    return RLO_OK;
+}
+
+int rlo_engine_rank_failed(const rlo_engine *e, int rank)
+{
+    return e->failed && rank >= 0 && rank < e->ws && e->failed[rank];
+}
+
+int rlo_engine_failed_count(const rlo_engine *e)
+{
+    return e->n_failed;
+}
+
+int rlo_engine_suspected_self(const rlo_engine *e)
+{
+    return e->suspected_self;
 }
 
 /* ---------------- delivery ---------------- */
@@ -724,6 +1027,14 @@ void rlo_engine_progress_once(rlo_engine *e)
             e->recved_bcast++;
             on_decision(e, m);
             break;
+        case RLO_TAG_HEARTBEAT:
+            if (m->src >= 0 && m->src < e->ws)
+                e->hb_seen[m->src] = rlo_now_usec();
+            msg_free(m);
+            break;
+        case RLO_TAG_FAILURE:
+            on_failure(e, m);
+            break;
         default:
             /* aux tags go straight to pickup */
             m->fwd_done = 1;
@@ -731,6 +1042,9 @@ void rlo_engine_progress_once(rlo_engine *e)
             break;
         }
     }
+
+    /* (b2) liveness: heartbeat my ring successor, watch my predecessor */
+    failure_tick(e);
 
     /* (c) wait_and_pickup sweep (:995-1013): forwards done -> deliverable */
     for (rlo_msg *m = e->q_wait_pickup.head; m;) {

@@ -107,6 +107,9 @@ typedef struct rlo_transport_ops {
     /* 1 when `rank` showed liveness within timeout_usec; NULL = the
      * transport has no liveness signal (peers always considered alive) */
     int (*peer_alive)(const rlo_world *w, int rank, uint64_t timeout_usec);
+    /* fault injection: simulate `rank`'s process dying (in-process
+     * transports only); NULL = unsupported */
+    int (*kill_rank)(rlo_world *w, int rank);
     void (*free_)(rlo_world *w);
 } rlo_transport_ops;
 

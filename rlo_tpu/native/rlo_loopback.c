@@ -28,6 +28,7 @@ typedef struct rlo_loop_world {
     rlo_channel *channels;
     rlo_wire_node **inbox_head; /* per-rank delivered FIFO */
     rlo_wire_node **inbox_tail;
+    uint8_t *dead; /* fault injection: killed ranks */
 } rlo_loop_world;
 
 static uint64_t xorshift64(uint64_t *s)
@@ -68,6 +69,7 @@ static void loop_free(rlo_world *base)
     }
     free(w->inbox_head);
     free(w->inbox_tail);
+    free(w->dead);
     free(base->engines);
     free(w);
 }
@@ -129,6 +131,18 @@ static int loop_isend(rlo_world *base, int src, int dst, int comm, int tag,
     rlo_loop_world *w = (rlo_loop_world *)base;
     if (dst < 0 || dst >= base->world_size || !frame || frame->len < 0)
         return RLO_ERR_ARG;
+    if (w->dead[src] || w->dead[dst]) {
+        /* a dead host's packets never leave it; packets to a dead host
+         * vanish — the handle completes so the sender's queues drain */
+        if (out) {
+            rlo_handle *h = rlo_handle_new(1);
+            if (!h)
+                return RLO_ERR_NOMEM;
+            h->delivered = 1;
+            *out = h;
+        }
+        return RLO_OK;
+    }
     int caller_tracks = out != 0;
     rlo_handle *h = rlo_handle_new(caller_tracks ? 2 : 1);
     rlo_wire_node *n = (rlo_wire_node *)malloc(sizeof(*n));
@@ -182,9 +196,38 @@ static void pump(rlo_loop_world *w)
     }
 }
 
+static int loop_kill_rank(rlo_world *base, int rank)
+{
+    rlo_loop_world *w = (rlo_loop_world *)base;
+    if (rank < 0 || rank >= base->world_size)
+        return RLO_ERR_ARG;
+    w->dead[rank] = 1;
+    /* drop frames in flight to or from the dead rank */
+    for (rlo_channel *c = w->channels; c; c = c->next) {
+        if (c->src != rank && c->dst != rank)
+            continue;
+        for (rlo_wire_node *n = c->head; n;) {
+            rlo_wire_node *nn = n->next;
+            n->handle->delivered = 1;
+            free_node(n);
+            n = nn;
+        }
+        c->head = c->tail = 0;
+    }
+    for (rlo_wire_node *n = w->inbox_head[rank]; n;) {
+        rlo_wire_node *nn = n->next;
+        free_node(n);
+        n = nn;
+    }
+    w->inbox_head[rank] = w->inbox_tail[rank] = 0;
+    return RLO_OK;
+}
+
 static rlo_wire_node *loop_poll(rlo_world *base, int rank, int comm)
 {
     rlo_loop_world *w = (rlo_loop_world *)base;
+    if (w->dead[rank])
+        return 0;
     pump(w);
     rlo_wire_node *prev = 0;
     for (rlo_wire_node *n = w->inbox_head[rank]; n;
@@ -211,6 +254,7 @@ static const rlo_transport_ops LOOP_OPS = {
     .sent_cnt = loop_sent,
     .delivered_cnt = loop_delivered,
     .drain = rlo_drain_local,
+    .kill_rank = loop_kill_rank,
     .free_ = loop_free,
 };
 
@@ -230,9 +274,11 @@ rlo_world *rlo_world_new(int world_size, int latency, uint64_t seed)
         (rlo_wire_node **)calloc((size_t)world_size, sizeof(void *));
     w->inbox_tail =
         (rlo_wire_node **)calloc((size_t)world_size, sizeof(void *));
-    if (!w->inbox_head || !w->inbox_tail) {
+    w->dead = (uint8_t *)calloc((size_t)world_size, 1);
+    if (!w->inbox_head || !w->inbox_tail || !w->dead) {
         free(w->inbox_head);
         free(w->inbox_tail);
+        free(w->dead);
         free(w);
         return 0;
     }

@@ -158,6 +158,147 @@ static void test_dirty_teardown(void)
     rlo_world_free(w);
 }
 
+/* Failure detection + elastic recovery: kill a rank, let heartbeat
+ * timeouts detect it, then verify broadcast and consensus still work
+ * among the survivors on the re-formed overlay (mirror of
+ * tests/test_failure.py on the Python engine). Uses real (short)
+ * timeouts; progress spins fast enough that 200 ms >> timeout. */
+static void test_elastic_recovery(int ws, int victim)
+{
+    rlo_world *w = rlo_world_new(ws, 0, 0);
+    CHECK(w);
+    rlo_engine *e[64];
+    for (int r = 0; r < ws; r++) {
+        e[r] = rlo_engine_new(w, r, 0, 0, 0, 0, 0, 0);
+        CHECK(e[r]);
+        CHECK(rlo_engine_enable_failure_detection(
+                  e[r], 20 * 1000, 5 * 1000) == RLO_OK);
+    }
+    /* settle heartbeats */
+    uint64_t t0 = rlo_now_usec();
+    while (rlo_now_usec() - t0 < 30 * 1000)
+        rlo_progress_all(w);
+    /* crash the victim */
+    CHECK(rlo_world_kill_rank(w, victim) == RLO_OK);
+    rlo_engine_free(e[victim]);
+    /* every survivor must learn of the failure */
+    t0 = rlo_now_usec();
+    for (;;) {
+        rlo_progress_all(w);
+        int all = 1;
+        for (int r = 0; r < ws; r++)
+            if (r != victim && !rlo_engine_rank_failed(e[r], victim))
+                all = 0;
+        if (all)
+            break;
+        CHECK(rlo_now_usec() - t0 < 2 * 1000 * 1000);
+        if (rlo_now_usec() - t0 >= 2 * 1000 * 1000)
+            goto out;
+    }
+    /* flush FAILURE notices */
+    CHECK(rlo_drain(w, 10000000) >= 0);
+    for (int r = 0; r < ws; r++) {
+        if (r == victim)
+            continue;
+        uint8_t buf[64];
+        while (rlo_pickup_next(e[r], 0, 0, 0, 0, buf, sizeof buf) >= 0)
+            ;
+    }
+    /* elastic bcast: one delivery per survivor */
+    int origin = victim == 0 ? 1 : 0;
+    CHECK(rlo_bcast(e[origin], (const uint8_t *)"x", 1) == RLO_OK);
+    CHECK(rlo_drain(w, 10000000) >= 0);
+    for (int r = 0; r < ws; r++) {
+        if (r == victim || r == origin)
+            continue;
+        uint8_t buf[64];
+        int got = 0;
+        while (rlo_pickup_next(e[r], 0, 0, 0, 0, buf, sizeof buf) >= 0)
+            got++;
+        CHECK(got == 1);
+    }
+    /* elastic consensus among survivors */
+    int rc = rlo_submit_proposal(e[origin], (const uint8_t *)"p", 1, 77);
+    t0 = rlo_now_usec();
+    while (rc == -1 && rlo_now_usec() - t0 < 2 * 1000 * 1000) {
+        rlo_progress_all(w);
+        rc = rlo_vote_my_proposal(e[origin]);
+    }
+    CHECK(rc == 1);
+    CHECK(rlo_drain(w, 10000000) >= 0);
+out:
+    for (int r = 0; r < ws; r++)
+        if (r != victim)
+            rlo_engine_free(e[r]);
+    rlo_world_free(w);
+}
+
+/* A voter dies mid-consensus: the proposer must discount the dead
+ * subtree and complete instead of waiting forever. */
+static void test_mid_round_voter_death(int ws, int victim)
+{
+    rlo_world *w = rlo_world_new(ws, 0, 0);
+    CHECK(w);
+    rlo_engine *e[64];
+    for (int r = 0; r < ws; r++) {
+        e[r] = rlo_engine_new(w, r, 0, 0, 0, 0, 0, 0);
+        CHECK(rlo_engine_enable_failure_detection(
+                  e[r], 20 * 1000, 5 * 1000) == RLO_OK);
+    }
+    uint64_t t0 = rlo_now_usec();
+    while (rlo_now_usec() - t0 < 30 * 1000)
+        rlo_progress_all(w);
+    /* kill BEFORE proposing, before detection: the proposal still
+     * counts the dead subtree */
+    CHECK(rlo_world_kill_rank(w, victim) == RLO_OK);
+    rlo_engine_free(e[victim]);
+    int rc = rlo_submit_proposal(e[0], (const uint8_t *)"m", 1, 3);
+    t0 = rlo_now_usec();
+    while (rc == -1 && rlo_now_usec() - t0 < 2 * 1000 * 1000) {
+        rlo_progress_all(w);
+        rc = rlo_vote_my_proposal(e[0]);
+    }
+    CHECK(rc == 1);
+    CHECK(rlo_drain(w, 10000000) >= 0);
+    for (int r = 0; r < ws; r++)
+        if (r != victim)
+            rlo_engine_free(e[r]);
+    rlo_world_free(w);
+}
+
+/* A proposal with zero awaited voters (everyone else died) completes
+ * immediately instead of polling -1 forever. */
+static void test_sole_survivor_consensus(void)
+{
+    rlo_world *w = rlo_world_new(2, 0, 0);
+    CHECK(w);
+    rlo_engine *e0 = rlo_engine_new(w, 0, 0, 0, 0, 0, 0, 0);
+    rlo_engine *e1 = rlo_engine_new(w, 1, 0, 0, 0, 0, 0, 0);
+    CHECK(rlo_engine_enable_failure_detection(e0, 20 * 1000, 5 * 1000) ==
+          RLO_OK);
+    CHECK(rlo_engine_enable_failure_detection(e1, 20 * 1000, 5 * 1000) ==
+          RLO_OK);
+    uint64_t t0 = rlo_now_usec();
+    while (rlo_now_usec() - t0 < 30 * 1000)
+        rlo_progress_all(w);
+    CHECK(rlo_world_kill_rank(w, 1) == RLO_OK);
+    rlo_engine_free(e1);
+    t0 = rlo_now_usec();
+    while (!rlo_engine_rank_failed(e0, 1) &&
+           rlo_now_usec() - t0 < 2 * 1000 * 1000)
+        rlo_progress_all(w);
+    CHECK(rlo_engine_rank_failed(e0, 1));
+    int rc = rlo_submit_proposal(e0, (const uint8_t *)"s", 1, 5);
+    t0 = rlo_now_usec();
+    while (rc == -1 && rlo_now_usec() - t0 < 1000 * 1000) {
+        rlo_progress_all(w);
+        rc = rlo_vote_my_proposal(e0);
+    }
+    CHECK(rc == 1);
+    rlo_engine_free(e0);
+    rlo_world_free(w);
+}
+
 int main(void)
 {
     static const int sizes[] = {2, 3, 5, 8, 16, 23, 32};
@@ -171,6 +312,12 @@ int main(void)
     test_concurrent_proposers(23);
     test_multiplex();
     test_dirty_teardown();
+    test_elastic_recovery(6, 2);
+    test_elastic_recovery(8, 7);
+    test_elastic_recovery(5, 0);
+    test_mid_round_voter_death(6, 4);
+    test_mid_round_voter_death(8, 2);
+    test_sole_survivor_consensus();
     if (failures) {
         fprintf(stderr, "%d FAILURES\n", failures);
         return 1;

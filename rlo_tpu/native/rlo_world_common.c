@@ -55,6 +55,13 @@ int rlo_world_peer_alive(const rlo_world *w, int rank,
     return w->ops->peer_alive(w, rank, timeout_usec);
 }
 
+int rlo_world_kill_rank(rlo_world *w, int rank)
+{
+    if (!w->ops->kill_rank)
+        return RLO_ERR_ARG;
+    return w->ops->kill_rank(w, rank);
+}
+
 void rlo_world_free(rlo_world *w)
 {
     if (!w)

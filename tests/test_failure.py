@@ -93,6 +93,57 @@ class TestInjection:
 
 
 # ---------------------------------------------------------------------------
+# Native (C) engine parity: same detect / re-form / recover behavior
+# ---------------------------------------------------------------------------
+
+class TestNativeParity:
+    def test_c_engines_detect_and_recover(self):
+        """The C core's failure machinery behaves like the Python
+        engine's: kill a rank, survivors detect by heartbeat timeout,
+        the overlay re-forms, and bcast + consensus keep working."""
+        import time
+        from rlo_tpu.native.bindings import NativeEngine, NativeWorld
+
+        ws, victim = 6, 2
+        with NativeWorld(ws) as world:
+            engines = [NativeEngine(world, r) for r in range(ws)]
+            for e in engines:
+                e.enable_failure_detection(timeout_usec=20_000,
+                                           interval_usec=5_000)
+            t0 = time.monotonic()
+            while time.monotonic() - t0 < 0.03:
+                world.progress_all()
+            world.kill_rank(victim)
+            engines[victim].close()
+            t0 = time.monotonic()
+            while time.monotonic() - t0 < 3.0:
+                world.progress_all()
+                if all(e.rank_failed(victim) for r, e in enumerate(engines)
+                       if r != victim):
+                    break
+            survivors = [e for r, e in enumerate(engines) if r != victim]
+            assert all(e.rank_failed(victim) for e in survivors)
+            world.drain()
+            for e in survivors:
+                while e.pickup_next() is not None:
+                    pass
+            engines[0].bcast(b"after-failure")
+            world.drain()
+            for e in survivors[1:]:
+                msgs = []
+                while (m := e.pickup_next()) is not None:
+                    msgs.append(m.data)
+                assert msgs == [b"after-failure"], (e.rank, msgs)
+            rc = engines[0].submit_proposal(b"p", pid=9)
+            t0 = time.monotonic()
+            while rc == -1 and time.monotonic() - t0 < 3.0:
+                world.progress_all()
+                rc = engines[0].vote_my_proposal()
+            assert rc == 1
+            world.drain()
+
+
+# ---------------------------------------------------------------------------
 # Detection
 # ---------------------------------------------------------------------------
 
@@ -340,6 +391,22 @@ class TestElasticRecovery:
         assert e3._hb_seen[2] == before
         e3._mark_failed(2)       # pred dies -> new pred gets fresh grace
         assert e3._hb_seen[1] == clock()
+
+    def test_sole_survivor_consensus_completes(self):
+        """A proposal with zero awaited voters (everyone else died) must
+        complete immediately instead of polling -1 forever."""
+        world, mgr, engines, clock, _ = make_world(2)
+        spin(mgr, clock, 8)
+        kill(world, mgr, engines, 1)
+        spin(mgr, clock, 60)
+        assert engines[0].failed == {1}
+        rc = engines[0].submit_proposal(b"alone", pid=0)
+        if rc == -1:
+            for _ in range(1000):
+                mgr.progress_all()
+                if engines[0].vote_my_proposal() != -1:
+                    break
+        assert engines[0].vote_my_proposal() == 1
 
     def test_adjacent_failure_shifts_monitor(self):
         """Kill the detector's own predecessor twice over: after rank 2
