@@ -450,13 +450,16 @@ class ProgressEngine:
         """~_iar_vote_handler (:743-812). Votes AND-merge upward."""
         pid, vote = msg.frame.pid, msg.frame.vote
         p = self.my_own_proposal
-        if pid == p.pid:
-            # only votes from children still awaited count: a vote from a
-            # discounted (suspected-dead) child after its subtree was
-            # written off, or arriving after the round completed, must
-            # not advance the count past a live child's pending veto
-            if p.state != ReqState.IN_PROGRESS or msg.src not in \
-                    p.await_from:
+        # claim the vote for my own proposal ONLY while it is in
+        # progress: a later proposer may legitimately reuse this pid
+        # (collisions are only forbidden between CONCURRENT proposals),
+        # so a completed own round must not swallow votes destined for a
+        # relayed proposal with the same pid
+        if pid == p.pid and p.state == ReqState.IN_PROGRESS:
+            # only votes from children still awaited count: a vote from
+            # a discounted (suspected-dead) child must not advance the
+            # count past a live child's pending veto
+            if msg.src not in p.await_from:
                 return
             p.await_from.remove(msg.src)
             p.votes_recved += 1
@@ -467,8 +470,9 @@ class ProgressEngine:
         # vote for a proposal I'm relaying
         pm = self._find_proposal_msg(pid)
         if pm is None:
-            if self.failure_timeout is not None or self.failed:
-                return  # orphaned by a membership change; drop
+            if (pid == p.pid and p.state != ReqState.INVALID) or \
+                    self.failure_timeout is not None or self.failed:
+                return  # late vote for my settled round / view change
             raise RuntimeError(
                 f"rank {self.rank}: vote for unknown proposal pid={pid}")
         ps = pm.prop_state

@@ -36,6 +36,17 @@ _JUDGE_CB = C.CFUNCTYPE(C.c_int, C.POINTER(C.c_uint8), C.c_int64,
 _ACTION_CB = C.CFUNCTYPE(None, C.POINTER(C.c_uint8), C.c_int64, C.c_void_p)
 
 
+class _EngineState(C.Structure):
+    """Mirror of rlo_engine_state (rlo_core.h)."""
+    _fields_ = [("rank", C.c_int32), ("world_size", C.c_int32),
+                ("sent_bcast", C.c_int64), ("recved_bcast", C.c_int64),
+                ("total_pickup", C.c_int64),
+                ("prop_pid", C.c_int32), ("prop_state", C.c_int32),
+                ("prop_vote", C.c_int32),
+                ("prop_votes_needed", C.c_int32),
+                ("prop_votes_recved", C.c_int32)]
+
+
 class _TraceEvent(C.Structure):
     """Mirror of rlo_trace_event (rlo_core.h)."""
     _fields_ = [("ts_usec", C.c_uint64), ("rank", C.c_int32),
@@ -89,6 +100,8 @@ def load() -> C.CDLL:
     sig("rlo_engine_rank_failed", C.c_int, [p, C.c_int])
     sig("rlo_engine_failed_count", C.c_int, [p])
     sig("rlo_engine_suspected_self", C.c_int, [p])
+    sig("rlo_engine_state_get", C.c_int, [p, p])
+    sig("rlo_engine_state_set", C.c_int, [p, p])
     sig("rlo_mpi_available", C.c_int, [])
     sig("rlo_mpi_world_new", p, [])
     sig("rlo_world_quiescent", C.c_int, [p])
@@ -306,6 +319,25 @@ class NativeEngine:
     @property
     def suspected_self(self) -> bool:
         return bool(self._lib.rlo_engine_suspected_self(self._e))
+
+    def state_dict(self) -> dict:
+        """Quiesced-engine snapshot (~checkpoint.engine_state_dict for
+        the C engine); raises if the engine has in-flight, pending, or
+        undelivered work."""
+        st = _EngineState()
+        rc = self._lib.rlo_engine_state_get(self._e, C.byref(st))
+        if rc != 0:
+            raise RuntimeError(
+                "engine busy: drain and pick up everything before "
+                "snapshotting" if rc == ERR_BUSY else f"error {rc}")
+        return {f: getattr(st, f) for f, _ in _EngineState._fields_}
+
+    def load_state_dict(self, state: dict) -> None:
+        st = _EngineState(**state)
+        rc = self._lib.rlo_engine_state_set(self._e, C.byref(st))
+        if rc != 0:
+            raise ValueError(f"snapshot rejected ({rc}): rank/world "
+                             f"mismatch or bad argument")
 
     def idle(self) -> bool:
         return bool(self._lib.rlo_engine_idle(self._e))

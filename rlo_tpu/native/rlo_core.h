@@ -254,6 +254,26 @@ int rlo_engine_failed_count(const rlo_engine *e);
 /* 1 when a FAILURE notice about THIS rank arrived (false positive) */
 int rlo_engine_suspected_self(const rlo_engine *e);
 
+/* ------------------------------------------------------------------ */
+/* Engine snapshot/restore (mirror of the checkpoint subsystem's        */
+/* engine_state_dict, rlo_tpu/utils/checkpoint.py): a quiesced engine's */
+/* durable identity — bcast/pickup counters and own-proposal            */
+/* bookkeeping — captured into a flat struct and re-applied onto a      */
+/* fresh engine after a process restart. state_get returns RLO_ERR_BUSY */
+/* unless the engine is idle, not mid-consensus (own proposal awaiting  */
+/* votes or relayed proposals pending), and fully picked up (unlike the */
+/* Python snapshot, undelivered pickup messages are NOT captured —      */
+/* drain them first). state_set rejects a rank/world mismatch.          */
+/* ------------------------------------------------------------------ */
+typedef struct rlo_engine_state {
+    int32_t rank, world_size;
+    int64_t sent_bcast, recved_bcast, total_pickup;
+    int32_t prop_pid, prop_state, prop_vote;
+    int32_t prop_votes_needed, prop_votes_recved;
+} rlo_engine_state;
+int rlo_engine_state_get(const rlo_engine *e, rlo_engine_state *out);
+int rlo_engine_state_set(rlo_engine *e, const rlo_engine_state *in);
+
 /* 1 when this engine has no outstanding forwards or pending decision */
 int rlo_engine_idle(const rlo_engine *e);
 int rlo_engine_err(const rlo_engine *e);         /* sticky first error */

@@ -586,11 +586,16 @@ static void on_vote(rlo_engine *e, rlo_msg *m)
 {
     int pid = m->pid, vote = m->vote;
     rlo_prop *p = &e->own;
-    if (pid == p->pid && p->state != RLO_INVALID) {
+    /* claim the vote for my own proposal ONLY while it is in progress:
+     * a later proposer may legitimately reuse this pid (pid collisions
+     * are only forbidden between CONCURRENT proposals, on_proposal
+     * errors on those), so a completed own round must not swallow votes
+     * destined for a relayed proposal with the same pid */
+    if (pid == p->pid && p->state == RLO_IN_PROGRESS) {
         /* only votes from still-awaited children count: a vote from a
-         * discounted (suspected-dead) child, or after completion, must
-         * not advance the count past a live child's pending veto */
-        if (p->state == RLO_IN_PROGRESS && await_remove(p, m->src)) {
+         * discounted (suspected-dead) child must not advance the count
+         * past a live child's pending veto */
+        if (await_remove(p, m->src)) {
             p->votes_recved++;
             p->vote &= vote;
             if (p->votes_recved == p->votes_needed)
@@ -601,8 +606,10 @@ static void on_vote(rlo_engine *e, rlo_msg *m)
     }
     rlo_msg *pm = find_proposal_msg(e, pid);
     if (!pm) {
-        if (e->fd_timeout || e->n_failed)
-            ; /* orphaned by a membership change; drop */
+        if ((pid == p->pid && p->state != RLO_INVALID) ||
+            e->fd_timeout || e->n_failed)
+            ; /* late vote for my settled round, or orphaned by a
+                 membership change; drop */
         else
             set_err(e, RLO_ERR_PROTO);
         msg_free(m);
@@ -1067,6 +1074,45 @@ void rlo_engine_progress_once(rlo_engine *e)
         }
         m = nm;
     }
+}
+
+/* ---------------- snapshot/restore (see rlo_core.h) ---------------- */
+
+int rlo_engine_state_get(const rlo_engine *e, rlo_engine_state *out)
+{
+    if (!e || !out)
+        return RLO_ERR_ARG;
+    if (!rlo_engine_idle(e) || e->own.state == RLO_IN_PROGRESS ||
+        e->q_iar_pending.len || e->q_pickup.len || e->q_wait_pickup.len)
+        return RLO_ERR_BUSY;
+    out->rank = e->rank;
+    out->world_size = e->ws;
+    out->sent_bcast = e->sent_bcast;
+    out->recved_bcast = e->recved_bcast;
+    out->total_pickup = e->total_pickup;
+    out->prop_pid = e->own.pid;
+    out->prop_state = e->own.state;
+    out->prop_vote = e->own.vote;
+    out->prop_votes_needed = e->own.votes_needed;
+    out->prop_votes_recved = e->own.votes_recved;
+    return RLO_OK;
+}
+
+int rlo_engine_state_set(rlo_engine *e, const rlo_engine_state *in)
+{
+    if (!e || !in)
+        return RLO_ERR_ARG;
+    if (in->rank != e->rank || in->world_size != e->ws)
+        return RLO_ERR_ARG;
+    e->sent_bcast = in->sent_bcast;
+    e->recved_bcast = in->recved_bcast;
+    e->total_pickup = in->total_pickup;
+    e->own.pid = in->prop_pid;
+    e->own.state = in->prop_state;
+    e->own.vote = in->prop_vote;
+    e->own.votes_needed = in->prop_votes_needed;
+    e->own.votes_recved = in->prop_votes_recved;
+    return RLO_OK;
 }
 
 /* ---------------- introspection ---------------- */

@@ -183,18 +183,17 @@ static void test_elastic_recovery(int ws, int victim)
     rlo_engine_free(e[victim]);
     /* every survivor must learn of the failure */
     t0 = rlo_now_usec();
-    for (;;) {
+    int all = 0;
+    while (!all && rlo_now_usec() - t0 < 2 * 1000 * 1000) {
         rlo_progress_all(w);
-        int all = 1;
+        all = 1;
         for (int r = 0; r < ws; r++)
             if (r != victim && !rlo_engine_rank_failed(e[r], victim))
                 all = 0;
-        if (all)
-            break;
-        CHECK(rlo_now_usec() - t0 < 2 * 1000 * 1000);
-        if (rlo_now_usec() - t0 >= 2 * 1000 * 1000)
-            goto out;
     }
+    CHECK(all);
+    if (!all)
+        goto out;
     /* flush FAILURE notices */
     CHECK(rlo_drain(w, 10000000) >= 0);
     for (int r = 0; r < ws; r++) {
@@ -299,6 +298,40 @@ static void test_sole_survivor_consensus(void)
     rlo_world_free(w);
 }
 
+/* A pid may be reused by a LATER proposer (only concurrent collisions
+ * are forbidden): a completed own round must not swallow the relayed
+ * round's votes. Regression for a review-caught deadlock. */
+static void test_pid_reuse_across_rounds(int ws)
+{
+    rlo_world *w = rlo_world_new(ws, 0, 0);
+    CHECK(w);
+    rlo_engine *e[64];
+    for (int r = 0; r < ws; r++)
+        e[r] = rlo_engine_new(w, r, 0, 0, 0, 0, 0, 0);
+    for (int proposer = 0; proposer < ws; proposer++) {
+        int rc = rlo_submit_proposal(e[proposer],
+                                     (const uint8_t *)"r", 1, 7);
+        for (long i = 0; rc == -1 && i < 100000; i++) {
+            rlo_progress_all(w);
+            rc = rlo_vote_my_proposal(e[proposer]);
+        }
+        CHECK(rc == 1);
+        CHECK(rlo_drain(w, 10000000) >= 0);
+        /* deliberately NO proposal_reset: past proposers keep pid 7 in
+         * their completed own state — the exact swallow condition */
+        uint8_t buf[64];
+        for (int r = 0; r < ws; r++)
+            while (rlo_pickup_next(e[r], 0, 0, 0, 0, buf,
+                                   sizeof buf) >= 0)
+                ;
+    }
+    for (int r = 0; r < ws; r++) {
+        CHECK(rlo_engine_err(e[r]) == RLO_OK);
+        rlo_engine_free(e[r]);
+    }
+    rlo_world_free(w);
+}
+
 int main(void)
 {
     static const int sizes[] = {2, 3, 5, 8, 16, 23, 32};
@@ -318,6 +351,8 @@ int main(void)
     test_mid_round_voter_death(6, 4);
     test_mid_round_voter_death(8, 2);
     test_sole_survivor_consensus();
+    test_pid_reuse_across_rounds(4);
+    test_pid_reuse_across_rounds(8);
     if (failures) {
         fprintf(stderr, "%d FAILURES\n", failures);
         return 1;

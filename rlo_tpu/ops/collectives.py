@@ -238,6 +238,26 @@ class Comm:
         gathered = yield from self._ring_all_gather_chunks((self.rank, x))
         return np.concatenate([np.atleast_1d(g) for g in gathered], axis=0)
 
+    def all_to_all(self, xs: "Sequence[np.ndarray]"):
+        """Coroutine: personalized exchange — ``xs[d]`` goes to rank
+        ``d``; returns the list received, indexed by source (the
+        engine-substrate counterpart of tpu_collectives.all_to_all, the
+        expert-dispatch collective)."""
+        if len(xs) != self.world_size:
+            raise ValueError(
+                f"need one chunk per rank ({self.world_size}), got "
+                f"{len(xs)}")
+        opid = next(self._opid)
+        ws, rank = self.world_size, self.rank
+        out: List[Optional[np.ndarray]] = [None] * ws
+        out[rank] = np.asarray(xs[rank])
+        for d in range(1, ws):  # round d: send d ahead, receive d behind
+            dst = (rank + d) % ws
+            src = (rank - d) % ws
+            self._send(dst, opid, d, np.asarray(xs[dst]))
+            out[src] = yield from self._recv(src, opid, d)
+        return out
+
     def barrier(self):
         """Coroutine: dissemination barrier — ceil(log2(n)) rounds, works
         for any world size."""

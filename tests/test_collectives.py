@@ -172,6 +172,26 @@ class TestReduceScatterAllGather:
             np.testing.assert_allclose(g, want, rtol=1e-5)
 
 
+class TestAllToAll:
+    @pytest.mark.parametrize("ws", WORLD_SIZES)
+    def test_matches_transpose(self, ws):
+        world, comms = make_comms(ws)
+        # chunk (r, d): rank r's payload for rank d
+        data = [[np.full((2,), 10 * r + d, np.float32)
+                 for d in range(ws)] for r in range(ws)]
+        got = run_collectives(
+            [c.all_to_all(row) for c, row in zip(comms, data)])
+        for d in range(ws):
+            for r in range(ws):
+                np.testing.assert_array_equal(got[d][r], data[r][d])
+
+    def test_wrong_chunk_count_rejected(self):
+        world, comms = make_comms(4)
+        with pytest.raises(ValueError, match="one chunk per rank"):
+            run_collectives([c.all_to_all([np.zeros(1)] * 3)
+                             for c in comms])
+
+
 class TestBarrier:
     @pytest.mark.parametrize("ws", WORLD_SIZES)
     def test_barrier_completes(self, ws):

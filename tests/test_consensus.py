@@ -10,6 +10,35 @@ ranks that held the proposal.
 
 import pytest
 
+
+def test_pid_reuse_across_sequential_rounds():
+    """A pid may be reused by a LATER proposer (only concurrent
+    collisions are forbidden): a rank whose completed own proposal
+    carries the same pid must still relay votes for the new round.
+    Regression for a review-caught deadlock."""
+    from rlo_tpu.engine import EngineManager, ProgressEngine, drain
+    from rlo_tpu.transport.loopback import LoopbackWorld
+
+    ws = 4
+    world = LoopbackWorld(ws)
+    mgr = EngineManager()
+    engines = [ProgressEngine(world.transport(r), manager=mgr)
+               for r in range(ws)]
+    for proposer in range(ws):
+        rc = engines[proposer].submit_proposal(b"round", pid=7)
+        for _ in range(100_000):
+            if rc != -1:
+                break
+            mgr.progress_all()
+            rc = engines[proposer].vote_my_proposal()
+        assert rc == 1, f"proposer {proposer} deadlocked on reused pid"
+        drain([world], engines)
+        for e in engines:
+            while e.pickup_next() is not None:
+                pass
+    for e in engines:
+        e.cleanup()
+
 from rlo_tpu.engine import ProgressEngine, EngineManager, ReqState, drain
 from rlo_tpu.transport import make_world
 from rlo_tpu.wire import Tag
