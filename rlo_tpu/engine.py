@@ -66,6 +66,7 @@ class ProposalState:
     """Per-proposal consensus bookkeeping (~Proposal_state,
     rootless_ops.c:184-194)."""
     pid: int = -1
+    gen: int = -1                # round generation (disambiguates pid reuse)
     recv_from: int = -1          # parent in the vote tree
     vote: int = 1
     votes_needed: int = 0
@@ -192,6 +193,12 @@ class ProgressEngine:
 
         self.my_own_proposal = ProposalState()
         self.my_proposal_payload: bytes = b""
+        # per-engine round counter: a proposer may reuse a pid across
+        # sequential rounds; the generation travels in the proposal
+        # frame's vote field and is echoed by every vote, so a stale
+        # vote from an earlier same-pid round can never be merged into
+        # a later one
+        self._gen_counter = itertools.count(1)
 
         # failure detection (net-new; SURVEY.md §5 "failure detection:
         # none" in the reference)
@@ -253,6 +260,9 @@ class ProgressEngine:
                 f"rank {self.rank}: proposal pid={p.pid} is still in "
                 f"progress; wait for completion before submitting another")
         p.pid = pid
+        # rank-qualified so two proposers reusing one pid can never
+        # collide on generation either
+        p.gen = (self.rank << 20) + next(self._gen_counter)
         p.vote = 1
         p.await_from = list(self._cur_initiator_targets())
         p.votes_needed = len(p.await_from)
@@ -262,7 +272,9 @@ class ProgressEngine:
         p.decision_pending = False
         self.my_proposal_payload = bytes(proposal)
         TRACER.emit(self.rank, Ev.PROPOSAL_SUBMIT, pid)
-        self.bcast(proposal, tag=Tag.IAR_PROPOSAL, pid=pid, vote=1)
+        # the proposal frame's vote field carries the round generation
+        # (the reference leaves it at the initial vote 1, :888)
+        self.bcast(proposal, tag=Tag.IAR_PROPOSAL, pid=pid, vote=p.gen)
         if p.votes_needed == 0 and p.state == ReqState.IN_PROGRESS \
                 and not p.decision_pending:
             # no awaited voters (sole survivor after elastic
@@ -407,8 +419,12 @@ class ProgressEngine:
 
     def _vote_back(self, ps: ProposalState, vote: int) -> None:
         """Send my (merged) vote to the rank I got the proposal from
-        (~_vote_back :728-741, nonblocking here)."""
-        frame = Frame(origin=self.rank, pid=ps.pid, vote=int(vote))
+        (~_vote_back :728-741, nonblocking here). The payload echoes the
+        round generation so a stale vote from an earlier same-pid round
+        can never be counted into a later one."""
+        import struct
+        frame = Frame(origin=self.rank, pid=ps.pid, vote=int(vote),
+                      payload=struct.pack("<i", ps.gen))
         self.transport.isend(ps.recv_from, int(Tag.IAR_VOTE), frame.encode())
         TRACER.emit(self.rank, Ev.VOTE, ps.pid, int(vote))
 
@@ -429,6 +445,7 @@ class ProgressEngine:
         children = list(self._fwd_targets(origin, msg.src))
         ps = ProposalState(
             pid=msg.frame.pid,
+            gen=msg.frame.vote,  # round generation (see submit_proposal)
             recv_from=msg.src,
             state=ReqState.IN_PROGRESS,
             proposal_payload=msg.frame.payload,
@@ -448,14 +465,18 @@ class ProgressEngine:
 
     def _on_vote(self, msg: _Msg) -> None:
         """~_iar_vote_handler (:743-812). Votes AND-merge upward."""
+        import struct
         pid, vote = msg.frame.pid, msg.frame.vote
+        gen = struct.unpack("<i", msg.frame.payload)[0] \
+            if len(msg.frame.payload) >= 4 else -1
         p = self.my_own_proposal
         # claim the vote for my own proposal ONLY while it is in
-        # progress: a later proposer may legitimately reuse this pid
-        # (collisions are only forbidden between CONCURRENT proposals),
-        # so a completed own round must not swallow votes destined for a
-        # relayed proposal with the same pid
-        if pid == p.pid and p.state == ReqState.IN_PROGRESS:
+        # progress AND the generations match: a later proposer may
+        # legitimately reuse this pid (collisions are only forbidden
+        # between CONCURRENT proposals), and a stale vote from an
+        # earlier same-pid round must never merge into a newer one
+        if pid == p.pid and p.state == ReqState.IN_PROGRESS \
+                and gen == p.gen:
             # only votes from children still awaited count: a vote from
             # a discounted (suspected-dead) child must not advance the
             # count past a live child's pending veto
@@ -469,10 +490,11 @@ class ProgressEngine:
             return
         # vote for a proposal I'm relaying
         pm = self._find_proposal_msg(pid)
-        if pm is None:
+        if pm is None or pm.prop_state.gen != gen:
             if (pid == p.pid and p.state != ReqState.INVALID) or \
-                    self.failure_timeout is not None or self.failed:
-                return  # late vote for my settled round / view change
+                    self.failure_timeout is not None or self.failed \
+                    or pm is not None:
+                return  # stale round / settled round / view change
             raise RuntimeError(
                 f"rank {self.rank}: vote for unknown proposal pid={pid}")
         ps = pm.prop_state

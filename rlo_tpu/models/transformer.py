@@ -60,6 +60,10 @@ class TransformerConfig:
     # local heads divisible by the sp size) — rlo_tpu.ops.{ring_attention,
     # ulysses}
     sp_attention: str = "ring"
+    # rematerialize each layer in the backward pass (jax.checkpoint):
+    # trades ~one extra forward of FLOPs for O(layers) less activation
+    # HBM — the standard long-context memory lever
+    remat: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -235,6 +239,26 @@ def nll_sum(logits, targets, valid):
     return jnp.sum(nll * valid), jnp.sum(valid)
 
 
+def opt_state_pspecs(opt_state, params: dict, param_specs):
+    """PartitionSpec tree for an optax optimizer state: subtrees shaped
+    like the param tree (Adam moments etc.) inherit the params' specs —
+    so tp/ep-sharded weights get sharded moments — and every other leaf
+    (step counts, scalars) is replicated. Pass as the opt_state in/out
+    spec for shard_jit alongside `param_pspecs`."""
+    from jax.sharding import PartitionSpec as P
+    pdef = jax.tree_util.tree_structure(params)
+
+    def params_like(node):
+        try:
+            return jax.tree_util.tree_structure(node) == pdef
+        except Exception:
+            return False
+
+    return jax.tree_util.tree_map(
+        lambda n: param_specs if params_like(n) else P(),
+        opt_state, is_leaf=params_like)
+
+
 def forward(params: dict, tokens: jax.Array, cfg: TransformerConfig,
             sp_axis: Optional[str] = None,
             tp_axis: Optional[str] = None,
@@ -267,10 +291,16 @@ def forward(params: dict, tokens: jax.Array, cfg: TransformerConfig,
     x = params["embed"][tokens].astype(dt) + _sincos(pos, cfg.d_model, dt)
     aux_total = jnp.zeros((), jnp.float32)
 
+    def block(x, layer):
+        return apply_layer(x, layer, cfg, sp_axis=sp_axis,
+                           tp_axis=tp_axis, tp_algorithm=tp_algorithm,
+                           ep_axis=ep_axis)
+
+    if cfg.remat:
+        # recompute each layer's activations in the backward pass
+        block = jax.checkpoint(block)
     for layer in params["layers"]:
-        x, aux = apply_layer(x, layer, cfg, sp_axis=sp_axis,
-                             tp_axis=tp_axis, tp_algorithm=tp_algorithm,
-                             ep_axis=ep_axis)
+        x, aux = block(x, layer)
         aux_total = aux_total + aux
 
     x = _rmsnorm(x, params["ln_f"]["g"])
@@ -357,6 +387,23 @@ def train_step(params: dict, tokens: jax.Array, cfg: TransformerConfig,
     runs route dp through the automatic path regardless of
     grad_algorithm.
     """
+    loss, grads = grads_and_loss(params, tokens, cfg, sp_axis=sp_axis,
+                                 dp_axis=dp_axis, tp_axis=tp_axis,
+                                 ep_axis=ep_axis,
+                                 grad_algorithm=grad_algorithm)
+    new_params = jax.tree.map(lambda p, g: p - lr * g, params, grads)
+    return new_params, loss
+
+
+def grads_and_loss(params: dict, tokens: jax.Array,
+                   cfg: TransformerConfig,
+                   sp_axis: Optional[str] = None,
+                   dp_axis: Optional[str] = None,
+                   tp_axis: Optional[str] = None,
+                   ep_axis: Optional[str] = None,
+                   grad_algorithm: str = "psum"):
+    """(loss, fully-synchronized grads) — the shared gradient pipeline
+    behind train_step (plain SGD) and train_step_optax."""
     if sp_axis is not None or tp_axis is not None or ep_axis is not None:
         # without vma typing the sp/tp/ep cotangent reductions never
         # happen and every shard would silently take a different step
@@ -386,5 +433,27 @@ def train_step(params: dict, tokens: jax.Array, cfg: TransformerConfig,
         nep = lax.axis_size(ep_axis)
         grads = jax.tree.map(lambda g: g / nep, grads)
         loss = lax.pmean(loss, ep_axis)
-    new_params = jax.tree.map(lambda p, g: p - lr * g, params, grads)
-    return new_params, loss
+    return loss, grads
+
+
+def train_step_optax(params: dict, opt_state, tokens: jax.Array,
+                     cfg: TransformerConfig, optimizer,
+                     sp_axis: Optional[str] = None,
+                     dp_axis: Optional[str] = None,
+                     tp_axis: Optional[str] = None,
+                     ep_axis: Optional[str] = None,
+                     grad_algorithm: str = "psum"):
+    """One optimizer step with any optax GradientTransformation
+    (`optimizer.init(params)` builds opt_state); returns
+    (new_params, new_opt_state, loss). Optimizer state mirrors the
+    param tree, so tp/ep-sharded leaves carry sharded moments — the
+    update math is elementwise and runs shard-local.
+    """
+    import optax
+
+    loss, grads = grads_and_loss(params, tokens, cfg, sp_axis=sp_axis,
+                                 dp_axis=dp_axis, tp_axis=tp_axis,
+                                 ep_axis=ep_axis,
+                                 grad_algorithm=grad_algorithm)
+    updates, opt_state = optimizer.update(grads, opt_state, params)
+    return optax.apply_updates(params, updates), opt_state, loss

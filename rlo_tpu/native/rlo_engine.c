@@ -23,6 +23,7 @@ typedef struct rlo_queue {
 
 typedef struct rlo_prop {
     int pid;
+    int gen;       /* round generation (disambiguates pid reuse) */
     int recv_from; /* parent in the vote tree */
     int vote;
     int votes_needed, votes_recved;
@@ -74,6 +75,7 @@ struct rlo_engine {
     /* failure detection + elastic recovery (0 timeout = disabled;
      * mirror of the Python engine's failure_timeout machinery) */
     uint64_t fd_timeout, fd_interval;
+    int gen_counter; /* per-engine round counter (see submit_proposal) */
     uint64_t hb_last_sent;
     uint64_t *hb_seen;  /* per rank: last heartbeat usec (0 = unseen) */
     uint8_t *failed;    /* per rank */
@@ -464,12 +466,29 @@ static int eng_judge(rlo_engine *e, const uint8_t *payload, int64_t len,
 }
 
 /* Send my (merged) vote to the rank the proposal came from (reference
- * _vote_back :728-741; nonblocking here). */
+ * _vote_back :728-741; nonblocking here). The payload echoes the round
+ * generation so a stale vote from an earlier same-pid round can never
+ * be counted into a later one. */
 static int vote_back(rlo_engine *e, const rlo_prop *ps, int vote)
 {
+    uint8_t genb[4];
+    genb[0] = (uint8_t)(ps->gen & 0xff);
+    genb[1] = (uint8_t)((ps->gen >> 8) & 0xff);
+    genb[2] = (uint8_t)((ps->gen >> 16) & 0xff);
+    genb[3] = (uint8_t)((ps->gen >> 24) & 0xff);
     rlo_trace_emit(e->rank, RLO_EV_VOTE, ps->pid, vote);
     return eng_isend(e, ps->recv_from, RLO_TAG_IAR_VOTE, e->rank, ps->pid,
-                     vote, 0, 0, 0);
+                     vote, genb, 4, 0);
+}
+
+static int vote_gen(const rlo_msg *m)
+{
+    if (m->len < 4)
+        return -1;
+    return (int)((uint32_t)m->payload[0] |
+                 ((uint32_t)m->payload[1] << 8) |
+                 ((uint32_t)m->payload[2] << 16) |
+                 ((uint32_t)m->payload[3] << 24));
 }
 
 static rlo_msg *find_proposal_msg(rlo_engine *e, int pid)
@@ -503,6 +522,7 @@ static void on_proposal(rlo_engine *e, rlo_msg *m)
         return;
     }
     ps->pid = m->pid;
+    ps->gen = m->vote; /* round generation (see rlo_submit_proposal) */
     ps->recv_from = m->src;
     ps->vote = 1;
     ps->state = RLO_IN_PROGRESS;
@@ -585,13 +605,15 @@ static void complete_own(rlo_engine *e)
 static void on_vote(rlo_engine *e, rlo_msg *m)
 {
     int pid = m->pid, vote = m->vote;
+    int gen = vote_gen(m);
     rlo_prop *p = &e->own;
-    /* claim the vote for my own proposal ONLY while it is in progress:
-     * a later proposer may legitimately reuse this pid (pid collisions
-     * are only forbidden between CONCURRENT proposals, on_proposal
-     * errors on those), so a completed own round must not swallow votes
-     * destined for a relayed proposal with the same pid */
-    if (pid == p->pid && p->state == RLO_IN_PROGRESS) {
+    /* claim the vote for my own proposal ONLY while it is in progress
+     * AND the generations match: a later proposer may legitimately
+     * reuse this pid (pid collisions are only forbidden between
+     * CONCURRENT proposals, on_proposal errors on those), and a stale
+     * vote from an earlier same-pid round must never merge into a
+     * newer one */
+    if (pid == p->pid && p->state == RLO_IN_PROGRESS && gen == p->gen) {
         /* only votes from still-awaited children count: a vote from a
          * discounted (suspected-dead) child must not advance the count
          * past a live child's pending veto */
@@ -605,11 +627,11 @@ static void on_vote(rlo_engine *e, rlo_msg *m)
         return;
     }
     rlo_msg *pm = find_proposal_msg(e, pid);
-    if (!pm) {
+    if (!pm || pm->ps->gen != gen) {
         if ((pid == p->pid && p->state != RLO_INVALID) ||
-            e->fd_timeout || e->n_failed)
-            ; /* late vote for my settled round, or orphaned by a
-                 membership change; drop */
+            e->fd_timeout || e->n_failed || pm)
+            ; /* stale round, settled own round, or a membership
+                 change; drop */
         else
             set_err(e, RLO_ERR_PROTO);
         msg_free(m);
@@ -656,6 +678,9 @@ int rlo_submit_proposal(rlo_engine *e, const uint8_t *proposal, int64_t len,
     free(p->decision_handles);
     memset(p, 0, sizeof(*p));
     p->pid = pid;
+    /* rank-qualified so two proposers reusing one pid can never
+     * collide on generation either */
+    p->gen = (e->rank << 20) + (++e->gen_counter);
     p->vote = 1;
     p->n_await = cur_init_targets(e, p->await_from, 64);
     if (p->n_await < 0)
@@ -670,7 +695,9 @@ int rlo_submit_proposal(rlo_engine *e, const uint8_t *proposal, int64_t len,
         memcpy(p->payload, proposal, (size_t)len);
     }
     rlo_trace_emit(e->rank, RLO_EV_PROPOSAL_SUBMIT, pid, 0);
-    int rc = bcast_init(e, RLO_TAG_IAR_PROPOSAL, pid, 1, proposal, len, 0);
+    /* the proposal frame's vote field carries the round generation */
+    int rc = bcast_init(e, RLO_TAG_IAR_PROPOSAL, pid, p->gen, proposal,
+                        len, 0);
     if (rc != RLO_OK) {
         p->state = RLO_FAILED;
         return rc;
@@ -1103,6 +1130,11 @@ int rlo_engine_state_set(rlo_engine *e, const rlo_engine_state *in)
     if (!e || !in)
         return RLO_ERR_ARG;
     if (in->rank != e->rank || in->world_size != e->ws)
+        return RLO_ERR_ARG;
+    /* state_get can only ever emit settled states — an IN_PROGRESS (or
+     * out-of-range) snapshot is corrupt and would wedge the engine */
+    if (in->prop_state != RLO_COMPLETED && in->prop_state != RLO_FAILED &&
+        in->prop_state != RLO_INVALID)
         return RLO_ERR_ARG;
     e->sent_bcast = in->sent_bcast;
     e->recved_bcast = in->recved_bcast;

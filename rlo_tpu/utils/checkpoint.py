@@ -279,7 +279,7 @@ def load_engine_state(engine, state: dict) -> None:
         raise ValueError(
             f"snapshot is for rank {state['rank']}/{state['world_size']}, "
             f"engine is rank {engine.rank}/{engine.world_size}")
-    from rlo_tpu.engine import _Msg
+    from rlo_tpu.engine import ReqState, _Msg
     from rlo_tpu.wire import Frame
 
     engine.sent_bcast_cnt = state["sent_bcast_cnt"]
@@ -287,6 +287,12 @@ def load_engine_state(engine, state: dict) -> None:
     engine.total_pickup = state["total_pickup"]
     p = engine.my_own_proposal
     snap = state["proposal"]
+    if ReqState(snap["state"]) == ReqState.IN_PROGRESS:
+        # engine_state_dict can only emit settled states — an
+        # IN_PROGRESS snapshot is corrupt and would wedge the engine
+        raise ValueError(
+            "corrupt snapshot: proposal state IN_PROGRESS cannot have "
+            "been captured from a quiesced engine")
     p.pid, p.vote = snap["pid"], snap["vote"]
     p.state = type(p.state)(snap["state"])
     p.votes_needed, p.votes_recved = snap["votes_needed"], snap["votes_recved"]

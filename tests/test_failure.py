@@ -316,6 +316,7 @@ class TestElasticRecovery:
         """A falsely-suspected child's vote arriving after it was
         discounted must not complete the round while a live child's veto
         is outstanding — and the late vote must not crash the engine."""
+        import struct
         from rlo_tpu.engine import EngineManager, ProgressEngine
         from rlo_tpu.wire import Frame, Tag
         world = LoopbackWorld(4)
@@ -327,22 +328,32 @@ class TestElasticRecovery:
                    for r in range(1, 4)]
         assert proposer.submit_proposal(b"p", pid=0) == -1
         assert sorted(proposer.my_own_proposal.await_from) == [1, 2]
+        gen = struct.pack("<i", proposer.my_own_proposal.gen)
         # a FAILURE notice about rank 2 (actually alive) discounts it
         proposer._mark_failed(2)
         assert proposer.my_own_proposal.votes_needed == 1
         # rank 2's in-flight YES arrives anyway: must NOT complete
         world.transport(2).isend(
-            0, int(Tag.IAR_VOTE), Frame(origin=2, pid=0, vote=1).encode())
+            0, int(Tag.IAR_VOTE),
+            Frame(origin=2, pid=0, vote=1, payload=gen).encode())
         mgr_p.progress_all()
         assert proposer.vote_my_proposal() == -1
         # rank 1's veto decides the round
         world.transport(1).isend(
-            0, int(Tag.IAR_VOTE), Frame(origin=1, pid=0, vote=0).encode())
+            0, int(Tag.IAR_VOTE),
+            Frame(origin=1, pid=0, vote=0, payload=gen).encode())
         mgr_p.progress_all()
         assert proposer.vote_my_proposal() == 0
         # another stray late vote is dropped, not a RuntimeError
         world.transport(2).isend(
-            0, int(Tag.IAR_VOTE), Frame(origin=2, pid=0, vote=1).encode())
+            0, int(Tag.IAR_VOTE),
+            Frame(origin=2, pid=0, vote=1, payload=gen).encode())
+        mgr_p.progress_all()
+        # and a stale-generation vote is ignored outright
+        world.transport(1).isend(
+            0, int(Tag.IAR_VOTE),
+            Frame(origin=1, pid=0, vote=1,
+                  payload=struct.pack("<i", 12345)).encode())
         mgr_p.progress_all()
 
     def test_dead_proposer_unparks_relayed_proposals(self):
