@@ -1,0 +1,32 @@
+#!/bin/sh
+# One-shot verification of the whole framework:
+#   sh check.sh
+# Runs the Python test suite (forced 8-device virtual CPU mesh via
+# tests/conftest.py), the ASan/UBSan native selftest, the multi-process
+# shm demo scenarios, the MPI-path syntax check, the driver entry-point
+# dryrun, and the tiny-size benchmark suite. Exits nonzero on the first
+# failure.
+set -e
+cd "$(dirname "$0")"
+
+echo "== pytest =="
+python -m pytest tests/ -q
+
+echo "== native selftest (ASan/UBSan) =="
+(cd rlo_tpu/native && make -s selftest && ./rlo_selftest)
+
+echo "== multi-process demo =="
+(cd rlo_tpu/native && make -s demo && ./rlo_demo -n 8 -m 8)
+
+echo "== MPI transport syntax check =="
+(cd rlo_tpu/native && make -s mpicheck)
+
+echo "== driver dryrun (8 virtual devices) =="
+PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python __graft_entry__.py 8
+
+echo "== benchmark suite (tiny) =="
+python benchmarks/suite.py --tiny
+
+echo "ALL CHECKS PASSED"

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import enum
 import itertools
+import struct
 import time
 from collections import deque
 from dataclasses import dataclass, field
@@ -195,10 +196,11 @@ class ProgressEngine:
         self.my_proposal_payload: bytes = b""
         # per-engine round counter: a proposer may reuse a pid across
         # sequential rounds; the generation travels in the proposal
-        # frame's vote field and is echoed by every vote, so a stale
-        # vote from an earlier same-pid round can never be merged into
-        # a later one
-        self._gen_counter = itertools.count(1)
+        # frame's vote field and is echoed by every vote and decision,
+        # so a stale message from an earlier same-pid round can never
+        # be merged into a later one. Persisted by engine snapshots so
+        # a restored engine never reissues a pre-snapshot generation.
+        self._gen_next = 1
 
         # failure detection (net-new; SURVEY.md §5 "failure detection:
         # none" in the reference)
@@ -260,9 +262,11 @@ class ProgressEngine:
                 f"rank {self.rank}: proposal pid={p.pid} is still in "
                 f"progress; wait for completion before submitting another")
         p.pid = pid
-        # rank-qualified so two proposers reusing one pid can never
-        # collide on generation either
-        p.gen = (self.rank << 20) + next(self._gen_counter)
+        # rank-qualified (counter * world_size + rank) so two proposers
+        # reusing one pid can never collide on generation either, with
+        # no overflow for any realistic rank count or round count
+        p.gen = self._gen_next * self.world_size + self.rank
+        self._gen_next += 1
         p.vote = 1
         p.await_from = list(self._cur_initiator_targets())
         p.votes_needed = len(p.await_from)
@@ -422,7 +426,6 @@ class ProgressEngine:
         (~_vote_back :728-741, nonblocking here). The payload echoes the
         round generation so a stale vote from an earlier same-pid round
         can never be counted into a later one."""
-        import struct
         frame = Frame(origin=self.rank, pid=ps.pid, vote=int(vote),
                       payload=struct.pack("<i", ps.gen))
         self.transport.isend(ps.recv_from, int(Tag.IAR_VOTE), frame.encode())
@@ -465,9 +468,8 @@ class ProgressEngine:
 
     def _on_vote(self, msg: _Msg) -> None:
         """~_iar_vote_handler (:743-812). Votes AND-merge upward."""
-        import struct
         pid, vote = msg.frame.pid, msg.frame.vote
-        gen = struct.unpack("<i", msg.frame.payload)[0] \
+        gen = struct.unpack_from("<i", msg.frame.payload)[0] \
             if len(msg.frame.payload) >= 4 else -1
         p = self.my_own_proposal
         # claim the vote for my own proposal ONLY while it is in
@@ -488,12 +490,12 @@ class ProgressEngine:
             if p.votes_recved == p.votes_needed:
                 self._complete_own_proposal(p)
             return
-        # vote for a proposal I'm relaying
-        pm = self._find_proposal_msg(pid)
-        if pm is None or pm.prop_state.gen != gen:
+        # vote for a proposal I'm relaying — matched on (pid, gen) so
+        # two queued rounds reusing one pid can never shadow each other
+        pm = self._find_proposal_msg(pid, gen)
+        if pm is None:
             if (pid == p.pid and p.state != ReqState.INVALID) or \
-                    self.failure_timeout is not None or self.failed \
-                    or pm is not None:
+                    self.failure_timeout is not None or self.failed:
                 return  # stale round / settled round / view change
             raise RuntimeError(
                 f"rank {self.rank}: vote for unknown proposal pid={pid}")
@@ -516,8 +518,9 @@ class ProgressEngine:
     def _decision_bcast(self, p: ProposalState) -> None:
         """Proposer broadcasts the final decision (~_iar_decision_bcast
         :908-917) — a regular rootless broadcast with the decision in the
-        vote field."""
-        msg = self.bcast(b"", tag=Tag.IAR_DECISION, pid=p.pid, vote=p.vote)
+        vote field and the round generation in the payload."""
+        msg = self.bcast(struct.pack("<i", p.gen), tag=Tag.IAR_DECISION,
+                         pid=p.pid, vote=p.vote)
         p.decision_handles = list(msg.send_handles)
         p.decision_pending = True
         TRACER.emit(self.rank, Ev.DECISION, p.pid, p.vote)
@@ -525,7 +528,9 @@ class ProgressEngine:
     def _on_decision(self, msg: _Msg) -> None:
         """~_iar_decision_handler (:814-859) + forward along the overlay."""
         pid, vote = msg.frame.pid, msg.frame.vote
-        pm = self._find_proposal_msg(pid)
+        gen = struct.unpack_from("<i", msg.frame.payload)[0] \
+            if len(msg.frame.payload) >= 4 else -1
+        pm = self._find_proposal_msg(pid, gen)
         self._bc_forward(msg)  # forward first; delivery below
         if pm is not None:
             if vote:
@@ -708,10 +713,13 @@ class ProgressEngine:
         msg.fwd_done = True
         self.queue_pickup.append(msg)
 
-    def _find_proposal_msg(self, pid: int) -> Optional[_Msg]:
-        """~_find_proposal_msg (:1036-1053)."""
+    def _find_proposal_msg(self, pid: int, gen: int) -> Optional[_Msg]:
+        """~_find_proposal_msg (:1036-1053), extended to match on
+        (pid, generation) so rounds reusing a pid never shadow each
+        other in the pending queue."""
         for m in self.queue_iar_pending:
-            if m.prop_state is not None and m.prop_state.pid == pid:
+            if m.prop_state is not None and m.prop_state.pid == pid \
+                    and m.prop_state.gen == gen:
                 return m
         return None
 

@@ -44,7 +44,8 @@ class _EngineState(C.Structure):
                 ("prop_pid", C.c_int32), ("prop_state", C.c_int32),
                 ("prop_vote", C.c_int32),
                 ("prop_votes_needed", C.c_int32),
-                ("prop_votes_recved", C.c_int32)]
+                ("prop_votes_recved", C.c_int32),
+                ("gen_counter", C.c_int32)]
 
 
 class _TraceEvent(C.Structure):

@@ -270,6 +270,10 @@ typedef struct rlo_engine_state {
     int64_t sent_bcast, recved_bcast, total_pickup;
     int32_t prop_pid, prop_state, prop_vote;
     int32_t prop_votes_needed, prop_votes_recved;
+    /* round-generation counter: a restored engine must never reissue a
+     * pre-snapshot generation (stale in-flight votes could otherwise
+     * match a post-restore round) */
+    int32_t gen_counter;
 } rlo_engine_state;
 int rlo_engine_state_get(const rlo_engine *e, rlo_engine_state *out);
 int rlo_engine_state_set(rlo_engine *e, const rlo_engine_state *in);

@@ -20,6 +20,9 @@
  *   fail     net-new (no reference analogue): one rank crashes; the
  *              others detect it through shm heartbeat staleness
  *              (rlo_world_peer_alive) instead of hanging in a drain
+ *   efail    net-new: full engine-level elastic recovery across real
+ *              processes — heartbeat detection, FAILURE broadcast,
+ *              survivor overlay re-forming, and a working bcast after
  *
  * Usage: ./rlo_demo [-n ranks] [-c case|all] [-m msgs] [-v]
  * Exit status 0 iff every rank's oracle held.
@@ -378,6 +381,67 @@ static int case_fail(rlo_world *w, int rank, void *vcfg)
     return 0;
 }
 
+/* ---- efail: engine-level elastic recovery across real processes ----
+ * The full failure story on the multi-process transport: every rank
+ * runs a progress engine with heartbeat detection; the victim crashes
+ * after the start barrier; survivors detect it through missed ENGINE
+ * heartbeats (not just transport staleness), adopt the FAILURE
+ * broadcast, re-form the overlay, and complete a broadcast among
+ * themselves — all without a global drain, which a dead rank would
+ * stall forever. */
+static int case_efail(rlo_world *w, int rank, void *vcfg)
+{
+    const demo_cfg *cfg = (const demo_cfg *)vcfg;
+    int ws = rlo_world_size(w);
+    int victim = ws - 1;
+    int origin = 0;
+    rlo_shm_barrier(w);
+    if (rank == victim)
+        return 0; /* crash: no drain, no goodbye */
+    rlo_engine *e = rlo_engine_new(w, rank, 0, 0, 0, 0, 0, 0);
+    RCHECK(e);
+    RCHECK(rlo_engine_enable_failure_detection(e, 100 * 1000,
+                                               20 * 1000) == RLO_OK);
+    uint64_t t0 = rlo_now_usec();
+    while (!rlo_engine_rank_failed(e, victim)) {
+        rlo_progress_all(w);
+        RCHECK(rlo_now_usec() - t0 < 30ull * 1000 * 1000);
+    }
+    if (cfg->verbose)
+        fprintf(stderr, "rank %d: engine detected %d dead (%llu usec)\n",
+                rank, victim,
+                (unsigned long long)(rlo_now_usec() - t0));
+    /* give every survivor time to adopt before re-using the overlay
+     * (no pickup flush here: an early-arriving broadcast would be
+     * swallowed; the receive loop below skips FAILURE notices instead) */
+    t0 = rlo_now_usec();
+    while (rlo_now_usec() - t0 < 300ull * 1000)
+        rlo_progress_all(w);
+    uint8_t buf[256];
+    if (rank == origin)
+        RCHECK(rlo_bcast(e, (const uint8_t *)"elastic", 7) == RLO_OK);
+    if (rank != origin) {
+        /* straggler FAILURE notices (duplicated during the view
+         * transition) may still arrive — skip them, wait for the bcast */
+        for (;;) {
+            int tag = -1, org = -1, pid, vote;
+            int64_t n = pickup_spin(w, e, &tag, &org, &pid, &vote, buf,
+                                    sizeof buf);
+            RCHECK(n >= 0);
+            if (tag == RLO_TAG_FAILURE)
+                continue;
+            RCHECK(n == 7 && org == origin && tag == RLO_TAG_BCAST);
+            break;
+        }
+    }
+    /* settle outstanding forwards without a global drain */
+    t0 = rlo_now_usec();
+    while (rlo_now_usec() - t0 < 300ull * 1000)
+        rlo_progress_all(w);
+    rlo_engine_free(e);
+    return 0;
+}
+
 /* ------------------------------------------------------------------ */
 
 typedef struct demo_case {
@@ -389,7 +453,7 @@ static const demo_case CASES[] = {
     {"bcast", case_bcast},   {"wrapper", case_wrapper},
     {"hacky", case_hacky},   {"iar", case_iar},
     {"iar2", case_iar2},     {"multi", case_multi},
-    {"fail", case_fail},
+    {"fail", case_fail},     {"efail", case_efail},
 };
 #define N_CASES (int)(sizeof CASES / sizeof *CASES)
 

@@ -491,10 +491,12 @@ static int vote_gen(const rlo_msg *m)
                  ((uint32_t)m->payload[3] << 24));
 }
 
-static rlo_msg *find_proposal_msg(rlo_engine *e, int pid)
+/* Matched on (pid, generation) so rounds reusing a pid never shadow
+ * each other in the pending queue (~_find_proposal_msg :1036-1053). */
+static rlo_msg *find_proposal_msg(rlo_engine *e, int pid, int gen)
 {
     for (rlo_msg *m = e->q_iar_pending.head; m; m = m->next)
-        if (m->ps && m->ps->pid == pid)
+        if (m->ps && m->ps->pid == pid && m->ps->gen == gen)
             return m;
     return 0;
 }
@@ -559,7 +561,14 @@ static void decision_bcast(rlo_engine *e)
 {
     rlo_prop *p = &e->own;
     rlo_msg *m = 0;
-    int rc = bcast_init(e, RLO_TAG_IAR_DECISION, p->pid, p->vote, 0, 0, &m);
+    /* decision in the vote field, round generation in the payload */
+    uint8_t genb[4];
+    genb[0] = (uint8_t)(p->gen & 0xff);
+    genb[1] = (uint8_t)((p->gen >> 8) & 0xff);
+    genb[2] = (uint8_t)((p->gen >> 16) & 0xff);
+    genb[3] = (uint8_t)((p->gen >> 24) & 0xff);
+    int rc = bcast_init(e, RLO_TAG_IAR_DECISION, p->pid, p->vote, genb, 4,
+                        &m);
     if (rc != RLO_OK) {
         set_err(e, rc);
         return;
@@ -626,10 +635,10 @@ static void on_vote(rlo_engine *e, rlo_msg *m)
         msg_free(m);
         return;
     }
-    rlo_msg *pm = find_proposal_msg(e, pid);
-    if (!pm || pm->ps->gen != gen) {
+    rlo_msg *pm = find_proposal_msg(e, pid, gen);
+    if (!pm) {
         if ((pid == p->pid && p->state != RLO_INVALID) ||
-            e->fd_timeout || e->n_failed || pm)
+            e->fd_timeout || e->n_failed)
             ; /* stale round, settled own round, or a membership
                  change; drop */
         else
@@ -650,7 +659,7 @@ static void on_vote(rlo_engine *e, rlo_msg *m)
 
 static void on_decision(rlo_engine *e, rlo_msg *m)
 {
-    rlo_msg *pm = find_proposal_msg(e, m->pid);
+    rlo_msg *pm = find_proposal_msg(e, m->pid, vote_gen(m));
     int rc = bc_forward(e, m); /* forward first; delivery below */
     if (rc < 0)
         set_err(e, rc);
@@ -678,9 +687,10 @@ int rlo_submit_proposal(rlo_engine *e, const uint8_t *proposal, int64_t len,
     free(p->decision_handles);
     memset(p, 0, sizeof(*p));
     p->pid = pid;
-    /* rank-qualified so two proposers reusing one pid can never
-     * collide on generation either */
-    p->gen = (e->rank << 20) + (++e->gen_counter);
+    /* rank-qualified (counter * world_size + rank) so two proposers
+     * reusing one pid can never collide on generation either, with no
+     * overflow for any realistic rank or round count */
+    p->gen = (++e->gen_counter) * e->ws + e->rank;
     p->vote = 1;
     p->n_await = cur_init_targets(e, p->await_from, 64);
     if (p->n_await < 0)
@@ -1122,6 +1132,7 @@ int rlo_engine_state_get(const rlo_engine *e, rlo_engine_state *out)
     out->prop_vote = e->own.vote;
     out->prop_votes_needed = e->own.votes_needed;
     out->prop_votes_recved = e->own.votes_recved;
+    out->gen_counter = e->gen_counter;
     return RLO_OK;
 }
 
@@ -1144,6 +1155,7 @@ int rlo_engine_state_set(rlo_engine *e, const rlo_engine_state *in)
     e->own.vote = in->prop_vote;
     e->own.votes_needed = in->prop_votes_needed;
     e->own.votes_recved = in->prop_votes_recved;
+    e->gen_counter = in->gen_counter;
     return RLO_OK;
 }
 

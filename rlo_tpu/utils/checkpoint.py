@@ -267,6 +267,10 @@ def engine_state_dict(engine) -> dict:
         "proposal": {"pid": p.pid, "state": int(p.state), "vote": p.vote,
                      "votes_needed": p.votes_needed,
                      "votes_recved": p.votes_recved},
+        # generation counter: a restored engine must never reissue a
+        # pre-snapshot round generation (stale in-flight votes could
+        # otherwise match a post-restore round)
+        "gen_next": engine._gen_next,
         "pickup": pickup,
     }
 
@@ -296,6 +300,7 @@ def load_engine_state(engine, state: dict) -> None:
     p.pid, p.vote = snap["pid"], snap["vote"]
     p.state = type(p.state)(snap["state"])
     p.votes_needed, p.votes_recved = snap["votes_needed"], snap["votes_recved"]
+    engine._gen_next = state.get("gen_next", engine._gen_next)
     for m in state.get("pickup", []):
         frame = Frame(origin=m["origin"], pid=m["pid"], vote=m["vote"],
                       payload=base64.b64decode(m["data"]))

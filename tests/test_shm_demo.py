@@ -37,11 +37,17 @@ def test_all_cases(demo_bin, ws):
     out = run_demo(demo_bin, "-n", ws, "-m", 8)
     assert "FAIL" not in out
     # one PASS line per case (+1: iar runs agree and veto variants)
-    assert out.count("PASS") == 8
+    assert out.count("PASS") == 9
 
 
 def test_failure_detection(demo_bin):
     out = run_demo(demo_bin, "-n", 4, "-c", "fail")
+    assert out.count("PASS") == 1
+
+
+def test_engine_elastic_recovery_multiprocess(demo_bin):
+    """Full engine-level failure recovery across real OS processes."""
+    out = run_demo(demo_bin, "-n", 6, "-c", "efail")
     assert out.count("PASS") == 1
 
 
