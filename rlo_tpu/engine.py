@@ -540,13 +540,14 @@ class ProgressEngine:
                                    self.app_ctx)
                 pm.prop_state.state = ReqState.COMPLETED
             self.queue_iar_pending.remove(pm)
-        elif pid in self._orphaned_props:
+        elif (pid, gen) in self._orphaned_props:
             # relay aborted when my vote-tree parent died, but the
             # proposer survived and its decision reached me through the
             # re-formed overlay: still honor the action callback
             if vote and self.action_cb is not None:
-                self.action_cb(self._orphaned_props[pid], self.app_ctx)
-            del self._orphaned_props[pid]
+                self.action_cb(self._orphaned_props[(pid, gen)],
+                               self.app_ctx)
+            del self._orphaned_props[(pid, gen)]
         # deliver the decision to the user either way (:852-854)
         self.queue_pickup.append(msg)
 
@@ -704,8 +705,11 @@ class ProgressEngine:
                 if pm.frame.origin != rank:
                     # proposer may still be alive (only my parent died):
                     # keep the payload so a decision that reaches me via
-                    # the re-formed overlay can still run the action cb
-                    self._orphaned_props[ps.pid] = ps.proposal_payload
+                    # the re-formed overlay can still run the action cb.
+                    # Keyed on (pid, gen): a stale same-pid decision from
+                    # an earlier round must not fire this round's action
+                    self._orphaned_props[(ps.pid, ps.gen)] = \
+                        ps.proposal_payload
 
     def _on_other(self, msg: _Msg) -> None:
         """Unknown/aux tags go straight to pickup (reference prints and

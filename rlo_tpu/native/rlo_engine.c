@@ -469,13 +469,18 @@ static int eng_judge(rlo_engine *e, const uint8_t *payload, int64_t len,
  * _vote_back :728-741; nonblocking here). The payload echoes the round
  * generation so a stale vote from an earlier same-pid round can never
  * be counted into a later one. */
+static void put_le32(uint8_t *dst, int v)
+{
+    dst[0] = (uint8_t)(v & 0xff);
+    dst[1] = (uint8_t)((v >> 8) & 0xff);
+    dst[2] = (uint8_t)((v >> 16) & 0xff);
+    dst[3] = (uint8_t)((v >> 24) & 0xff);
+}
+
 static int vote_back(rlo_engine *e, const rlo_prop *ps, int vote)
 {
     uint8_t genb[4];
-    genb[0] = (uint8_t)(ps->gen & 0xff);
-    genb[1] = (uint8_t)((ps->gen >> 8) & 0xff);
-    genb[2] = (uint8_t)((ps->gen >> 16) & 0xff);
-    genb[3] = (uint8_t)((ps->gen >> 24) & 0xff);
+    put_le32(genb, ps->gen);
     rlo_trace_emit(e->rank, RLO_EV_VOTE, ps->pid, vote);
     return eng_isend(e, ps->recv_from, RLO_TAG_IAR_VOTE, e->rank, ps->pid,
                      vote, genb, 4, 0);
@@ -563,10 +568,7 @@ static void decision_bcast(rlo_engine *e)
     rlo_msg *m = 0;
     /* decision in the vote field, round generation in the payload */
     uint8_t genb[4];
-    genb[0] = (uint8_t)(p->gen & 0xff);
-    genb[1] = (uint8_t)((p->gen >> 8) & 0xff);
-    genb[2] = (uint8_t)((p->gen >> 16) & 0xff);
-    genb[3] = (uint8_t)((p->gen >> 24) & 0xff);
+    put_le32(genb, p->gen);
     int rc = bcast_init(e, RLO_TAG_IAR_DECISION, p->pid, p->vote, genb, 4,
                         &m);
     if (rc != RLO_OK) {

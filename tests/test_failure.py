@@ -93,6 +93,72 @@ class TestInjection:
 
 
 # ---------------------------------------------------------------------------
+# Chaos: kills injected MID-TRAFFIC under seeded latency/reordering.
+# The view-change window is documented best-effort (duplicates/drops of
+# in-flight messages are allowed) — what must ALWAYS hold is liveness:
+# no exception, every survivor converges to the same failed set, and the
+# overlay works for traffic initiated after the view settles.
+# ---------------------------------------------------------------------------
+
+class TestChaos:
+    @pytest.mark.parametrize("seed", [1, 2, 3, 4, 5])
+    def test_kill_mid_broadcast_storm(self, seed):
+        import random
+        ws = 8
+        clock = FakeClock()
+        world = LoopbackWorld(ws, latency=3, seed=seed)
+        mgr = EngineManager()
+        engines = [ProgressEngine(world.transport(r), manager=mgr,
+                                  failure_timeout=8.0,
+                                  heartbeat_interval=1.0, clock=clock)
+                   for r in range(ws)]
+        rng = random.Random(seed)
+        victims = rng.sample(range(ws), 2)
+        alive = [r for r in range(ws) if r not in victims]
+        # storm: every rank broadcasts repeatedly while the victims die
+        # at staggered points mid-traffic
+        for step in range(30):
+            for r in range(ws):
+                if r not in world.dead:
+                    engines[r].bcast(f"s{step}r{r}".encode())
+            if step == 7:
+                world.kill_rank(victims[0])
+                engines[victims[0]].cleanup()
+            if step == 15:
+                world.kill_rank(victims[1])
+                engines[victims[1]].cleanup()
+            clock.advance(0.7)
+            mgr.progress_all()
+        spin(mgr, clock, 80)  # let detection + notices settle
+        survivors = [engines[r] for r in alive]
+        assert all(e.failed == set(victims) for e in survivors), \
+            [(e.rank, e.failed) for e in survivors]
+        # engines remain responsive: drain the storm debris, then one
+        # clean broadcast delivers exactly once everywhere
+        drain([world], survivors)
+        for e in survivors:
+            while e.pickup_next() is not None:
+                pass
+        origin = alive[0]
+        engines[origin].bcast(b"post-chaos")
+        drain([world], survivors)
+        for e in survivors:
+            if e.rank == origin:
+                continue
+            msgs = []
+            while (m := e.pickup_next()) is not None:
+                msgs.append(m.data)
+            assert msgs == [b"post-chaos"], (e.rank, msgs)
+        # and consensus still completes among the survivors
+        engines[origin].submit_proposal(b"post", pid=origin)
+        for _ in range(50_000):
+            mgr.progress_all()
+            if engines[origin].vote_my_proposal() != -1:
+                break
+        assert engines[origin].vote_my_proposal() == 1
+
+
+# ---------------------------------------------------------------------------
 # Native (C) engine parity: same detect / re-form / recover behavior
 # ---------------------------------------------------------------------------
 
