@@ -113,6 +113,12 @@ class Backend:
     def all_gather(self, xs: Sequence[np.ndarray]) -> List[np.ndarray]:
         raise NotImplementedError
 
+    def all_to_all(self, xss: Sequence[Sequence[np.ndarray]]
+                   ) -> List[List[np.ndarray]]:
+        """Personalized exchange: ``xss[r][d]`` is rank r's chunk for
+        rank d; returns per-rank lists indexed by source."""
+        raise NotImplementedError
+
     def barrier(self) -> None:
         raise NotImplementedError
 
@@ -241,6 +247,18 @@ class TpuBackend(Backend):
         return self._run(("all_gather", shape, dt),
                          lambda v: tc.all_gather(v[0], "x")[None], xs)
 
+    def all_to_all(self, xss) -> List[List[np.ndarray]]:
+        tc = self._tc
+        ws = self.world_size
+        if len(xss) != ws or any(len(row) != ws for row in xss):
+            raise ValueError(f"need a {ws}x{ws} grid of chunks")
+        rows = [np.stack([np.asarray(c) for c in row]) for row in xss]
+        shape = rows[0].shape
+        dt = str(rows[0].dtype)
+        out = self._run(("all_to_all", shape, dt),
+                        lambda v: tc.all_to_all(v[0], "x")[None], rows)
+        return [[o[s] for s in range(ws)] for o in out]
+
     def barrier(self) -> None:
         tc = self._tc
         self._run(("barrier",),
@@ -323,6 +341,17 @@ class LoopbackBackend(Backend):
 
     def reduce_scatter(self, xs, op: str = "sum") -> List[np.ndarray]:
         return self._collective("reduce_scatter", xs, op=op)
+
+    def all_to_all(self, xss) -> List[List[np.ndarray]]:
+        ws = self.world_size
+        # validate the FULL grid before creating any coroutine: a bad
+        # inner row failing mid-exchange would desync opid counters and
+        # strand frames in the shared collective world
+        if len(xss) != ws or any(len(row) != ws for row in xss):
+            raise ValueError(f"need a {ws}x{ws} grid of chunks")
+        coros = [c.all_to_all([np.asarray(x) for x in row])
+                 for c, row in zip(self._comms, xss)]
+        return self._run(coros)
 
     def all_gather(self, xs) -> List[np.ndarray]:
         shape = np.asarray(xs[0]).shape
@@ -415,6 +444,15 @@ class NativeBackend(Backend):
     def all_gather(self, xs) -> List[np.ndarray]:
         gathered = self._bcast_gather(xs)
         return [np.stack(got) for got in gathered]
+
+    def all_to_all(self, xss) -> List[List[np.ndarray]]:
+        ws = self.world_size
+        if len(xss) != ws or any(len(row) != ws for row in xss):
+            raise ValueError(f"need a {ws}x{ws} grid of chunks")
+        rows = [np.stack([np.asarray(c) for c in row]) for row in xss]
+        gathered = self._bcast_gather(rows)
+        return [[gathered[r][src][r] for src in range(ws)]
+                for r in range(ws)]
 
     def barrier(self) -> None:
         self.world.drain()

@@ -75,6 +75,9 @@ class HybridBackend(Backend):
     def all_gather(self, xs) -> List[np.ndarray]:
         return self._tpu.all_gather(xs)
 
+    def all_to_all(self, xss):
+        return self._tpu.all_to_all(xss)
+
     def barrier(self) -> None:
         self._tpu.barrier()
 

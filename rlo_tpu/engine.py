@@ -211,7 +211,12 @@ class ProgressEngine:
         self.clock = clock
         self.failed: Set[int] = set()
         self.suspected_self = False
-        self._orphaned_props: dict = {}  # pid -> payload (aborted relays)
+        # aborted relays whose decision may still arrive:
+        # (pid, gen) -> (proposer, payload). Bounded: entries are
+        # consumed by their decision, pruned when their proposer dies,
+        # and capped (oldest-first) against decisions lost in a
+        # view-change window.
+        self._orphaned_props: dict = {}
         self._alive: List[int] = list(range(ws))
         self._v = {r: r for r in range(ws)}  # real rank -> virtual rank
         self._hb_last_sent = float("-inf")
@@ -545,7 +550,7 @@ class ProgressEngine:
             # proposer survived and its decision reached me through the
             # re-formed overlay: still honor the action callback
             if vote and self.action_cb is not None:
-                self.action_cb(self._orphaned_props[(pid, gen)],
+                self.action_cb(self._orphaned_props[(pid, gen)][1],
                                self.app_ctx)
             del self._orphaned_props[(pid, gen)]
         # deliver the decision to the user either way (:852-854)
@@ -708,8 +713,15 @@ class ProgressEngine:
                     # the re-formed overlay can still run the action cb.
                     # Keyed on (pid, gen): a stale same-pid decision from
                     # an earlier round must not fire this round's action
-                    self._orphaned_props[(ps.pid, ps.gen)] = \
-                        ps.proposal_payload
+                    self._orphaned_props[(ps.pid, ps.gen)] = (
+                        pm.frame.origin, ps.proposal_payload)
+                    while len(self._orphaned_props) > 64:
+                        self._orphaned_props.pop(
+                            next(iter(self._orphaned_props)))
+        # a dead proposer's decision will never come: drop its orphans
+        self._orphaned_props = {
+            k: v for k, v in self._orphaned_props.items()
+            if v[0] != rank}
 
     def _on_other(self, msg: _Msg) -> None:
         """Unknown/aux tags go straight to pickup (reference prints and

@@ -75,6 +75,15 @@ class TestFacadeOps:
             for o in b.all_gather(xs):
                 np.testing.assert_allclose(o, want, rtol=1e-6)
 
+    def test_all_to_all_transposes(self, backend):
+        with make(backend) as b:
+            xss = [[np.full((2,), 10 * r + d, np.float32)
+                    for d in range(WS)] for r in range(WS)]
+            out = b.all_to_all(xss)
+            for d in range(WS):
+                for r in range(WS):
+                    np.testing.assert_array_equal(out[d][r], xss[r][d])
+
     def test_barrier_completes(self, backend):
         with make(backend) as b:
             b.barrier()
