@@ -139,6 +139,14 @@ class Backend:
                 f"{len(xs)}")
         return xs
 
+    def _check_xss(self, xss) -> List[List[np.ndarray]]:
+        """Validate the FULL ws x ws all_to_all grid before any work
+        starts (a mid-exchange failure could corrupt transport state)."""
+        ws = self.world_size
+        if len(xss) != ws or any(len(row) != ws for row in xss):
+            raise ValueError(f"need a {ws}x{ws} grid of chunks")
+        return [[np.asarray(c) for c in row] for row in xss]
+
     def _engine_bcast(self, engines, drain, origin: int,
                       x: np.ndarray) -> List[np.ndarray]:
         """Shared bcast path for single-controller engine backends:
@@ -250,9 +258,7 @@ class TpuBackend(Backend):
     def all_to_all(self, xss) -> List[List[np.ndarray]]:
         tc = self._tc
         ws = self.world_size
-        if len(xss) != ws or any(len(row) != ws for row in xss):
-            raise ValueError(f"need a {ws}x{ws} grid of chunks")
-        rows = [np.stack([np.asarray(c) for c in row]) for row in xss]
+        rows = [np.stack(row) for row in self._check_xss(xss)]
         shape = rows[0].shape
         dt = str(rows[0].dtype)
         out = self._run(("all_to_all", shape, dt),
@@ -343,14 +349,8 @@ class LoopbackBackend(Backend):
         return self._collective("reduce_scatter", xs, op=op)
 
     def all_to_all(self, xss) -> List[List[np.ndarray]]:
-        ws = self.world_size
-        # validate the FULL grid before creating any coroutine: a bad
-        # inner row failing mid-exchange would desync opid counters and
-        # strand frames in the shared collective world
-        if len(xss) != ws or any(len(row) != ws for row in xss):
-            raise ValueError(f"need a {ws}x{ws} grid of chunks")
-        coros = [c.all_to_all([np.asarray(x) for x in row])
-                 for c, row in zip(self._comms, xss)]
+        coros = [c.all_to_all(row)
+                 for c, row in zip(self._comms, self._check_xss(xss))]
         return self._run(coros)
 
     def all_gather(self, xs) -> List[np.ndarray]:
@@ -447,9 +447,7 @@ class NativeBackend(Backend):
 
     def all_to_all(self, xss) -> List[List[np.ndarray]]:
         ws = self.world_size
-        if len(xss) != ws or any(len(row) != ws for row in xss):
-            raise ValueError(f"need a {ws}x{ws} grid of chunks")
-        rows = [np.stack([np.asarray(c) for c in row]) for row in xss]
+        rows = [np.stack(row) for row in self._check_xss(xss)]
         gathered = self._bcast_gather(rows)
         return [[gathered[r][src][r] for src in range(ws)]
                 for r in range(ws)]
@@ -588,6 +586,25 @@ class MpiBackend(Backend):
     def reduce_scatter(self, x: np.ndarray, op: str = "sum") -> np.ndarray:
         full = self.allreduce(x, op=op)
         return _rank_chunk(full, self.world_size, self.rank)
+
+    def all_to_all(self, xs: Sequence[np.ndarray]) -> List[np.ndarray]:
+        """Per-rank form: ``xs[d]`` is THIS rank's chunk for rank d;
+        returns the chunks received, indexed by source. Runs as
+        bcast-gather over the overlay like the other mpi collectives."""
+        from rlo_tpu.ops.collectives import _pack_array, _unpack_array
+        ws = self.world_size
+        if len(xs) != ws:
+            raise ValueError(f"need one chunk per rank ({ws}), got "
+                             f"{len(xs)}")
+        row = np.stack([np.asarray(x) for x in xs])
+        self.engine.bcast(_pack_array(row))
+        msgs = self._spin_pickup(ws - 1)
+        self.world.drain()
+        out: List[Optional[np.ndarray]] = [None] * ws
+        out[self.rank] = row[self.rank]
+        for m in msgs:
+            out[m.origin] = _unpack_array(m.data)[self.rank]
+        return out
 
     def barrier(self) -> None:
         self.world.drain()

@@ -84,6 +84,35 @@ class TestTransformerIntegration:
         got = float(f(params, tokens))
         assert abs(got - want) < 2e-4, (got, want)
 
+    def test_composes_with_tensor_parallel(self):
+        """ulysses + tp + dp on one mesh: tp splits heads first, then
+        ulysses scatters the LOCAL heads over sp — the step must match
+        single-device exactly."""
+        from rlo_tpu.models.transformer import param_pspecs
+        cfg = TransformerConfig(vocab=32, d_model=64, n_heads=8,
+                                n_layers=1, d_ff=64, dtype="float32",
+                                sp_attention="ulysses")
+        params = init_params(jax.random.PRNGKey(2), cfg)
+        rng = np.random.default_rng(2)
+        tokens = jnp.asarray(rng.integers(0, 32, (4, 32)), jnp.int32)
+        ref_p, ref_loss = jax.jit(
+            lambda p, t: train_step(p, t, cfg, lr=0.05))(params, tokens)
+        mesh = make_mesh((2, 2, 2), ("dp", "sp", "tp"))
+        specs = param_pspecs(cfg, "tp")
+        step = shard_jit(
+            lambda p, t: train_step(p, t, cfg, lr=0.05, sp_axis="sp",
+                                    dp_axis="dp", tp_axis="tp"),
+            mesh, (specs, P("dp", "sp")), (specs, P()))
+        new_p, loss = step(params, tokens)
+        np.testing.assert_allclose(float(loss), float(ref_loss),
+                                   rtol=1e-5)
+        for (k, a), (_, b) in zip(
+                jax.tree_util.tree_flatten_with_path(new_p)[0],
+                jax.tree_util.tree_flatten_with_path(ref_p)[0]):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=5e-3, atol=5e-4,
+                err_msg=jax.tree_util.keystr(k))
+
     def test_train_step_parity(self):
         params = init_params(jax.random.PRNGKey(1), self.CFG)
         rng = np.random.default_rng(1)
