@@ -589,22 +589,12 @@ class MpiBackend(Backend):
 
     def all_to_all(self, xs: Sequence[np.ndarray]) -> List[np.ndarray]:
         """Per-rank form: ``xs[d]`` is THIS rank's chunk for rank d;
-        returns the chunks received, indexed by source. Runs as
-        bcast-gather over the overlay like the other mpi collectives."""
-        from rlo_tpu.ops.collectives import _pack_array, _unpack_array
-        ws = self.world_size
-        if len(xs) != ws:
-            raise ValueError(f"need one chunk per rank ({ws}), got "
-                             f"{len(xs)}")
-        row = np.stack([np.asarray(x) for x in xs])
-        self.engine.bcast(_pack_array(row))
-        msgs = self._spin_pickup(ws - 1)
-        self.world.drain()
-        out: List[Optional[np.ndarray]] = [None] * ws
-        out[self.rank] = row[self.rank]
-        for m in msgs:
-            out[m.origin] = _unpack_array(m.data)[self.rank]
-        return out
+        returns the chunks received, indexed by source — an all_gather
+        of the chunk rows, keeping each source's chunk for me."""
+        row = np.stack(self._check_xs(xs))
+        gathered = self.all_gather(row)  # (src, dst, ...)
+        return [gathered[src][self.rank]
+                for src in range(self.world_size)]
 
     def barrier(self) -> None:
         self.world.drain()

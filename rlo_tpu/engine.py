@@ -352,6 +352,13 @@ class ProgressEngine:
             if item is None:
                 break
             src, tag, raw = item
+            if self.failure_timeout is not None and 0 <= src < \
+                    self.world_size:
+                # ANY frame proves the sender alive — under heavy
+                # traffic this prevents heartbeat starvation when
+                # membership views transiently diverge (each view picks
+                # different ring successors)
+                self._hb_seen[src] = self.clock()
             msg = _Msg(frame=Frame.decode(raw), tag=tag, src=src)
             if tag == Tag.BCAST:
                 self.recved_bcast_cnt += 1
@@ -364,7 +371,7 @@ class ProgressEngine:
                 self.recved_bcast_cnt += 1
                 self._on_decision(msg)
             elif tag == Tag.HEARTBEAT:
-                self._hb_seen[src] = self.clock()
+                pass  # liveness already refreshed above for any frame
             elif tag == Tag.FAILURE:
                 self._on_failure(msg)
             else:
@@ -619,32 +626,43 @@ class ProgressEngine:
 
     def _declare_failed(self, rank: int) -> None:
         """Local detection: mark, then tell the world — the failure notice
-        itself rides the rootless broadcast overlay (any rank can detect
-        and announce; no coordinator)."""
+        rides the rootless broadcast overlay AND goes point-to-point to
+        every alive rank (belt and braces: overlay forwarding can have
+        holes while membership views are still converging; duplicate
+        notices are suppressed at the receiver)."""
         if not self._mark_failed(rank):
             return
         TRACER.emit(self.rank, Ev.FAILURE, rank, 1)
         self.bcast(b"", tag=Tag.FAILURE, pid=rank)
+        frame = Frame(origin=self.rank, pid=rank)
+        raw = frame.encode()
+        for dst in self._alive:
+            if dst != self.rank:
+                self.transport.isend(dst, int(Tag.FAILURE), raw)
         if self.failure_cb is not None:
             self.failure_cb(rank, True)
 
     def _on_failure(self, msg: _Msg) -> None:
         """A FAILURE notification arrived: adopt the new membership BEFORE
         forwarding so the whole propagation runs on the survivor overlay,
-        then deliver the notice to the user (pid = failed rank)."""
+        then deliver the notice to the user (pid = failed rank).
+        Duplicates (the notice floods: overlay + direct sends) are
+        dropped entirely — each failure is delivered exactly once."""
         rank = msg.frame.pid
         if rank == self.rank:
             # somebody suspects me — a false positive from delays; there
             # is no un-fail protocol (matching the reference's absence of
             # recovery), so just record it for the application
-            self.suspected_self = True
-            self._bc_forward(msg)
+            if not self.suspected_self:
+                self.suspected_self = True
+                self._bc_forward(msg)
             return
         fresh = self._mark_failed(rank)
-        if fresh:
-            TRACER.emit(self.rank, Ev.FAILURE, rank, 0)
+        if not fresh:
+            return  # already known: suppress the duplicate
+        TRACER.emit(self.rank, Ev.FAILURE, rank, 0)
         self._bc_forward(msg)
-        if fresh and self.failure_cb is not None:
+        if self.failure_cb is not None:
             self.failure_cb(rank, False)
 
     def _mark_failed(self, rank: int) -> bool:

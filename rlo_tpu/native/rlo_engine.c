@@ -842,10 +842,20 @@ static void declare_failed(rlo_engine *e, int rank)
     if (!mark_failed(e, rank))
         return;
     rlo_trace_emit(e->rank, RLO_EV_FAILURE, rank, 1);
-    /* tell the world: the failure notice rides the overlay itself */
+    /* tell the world: overlay broadcast AND point-to-point to every
+     * alive rank (overlay forwarding can have holes while views are
+     * converging; receivers suppress duplicates) */
     int rc = bcast_init(e, RLO_TAG_FAILURE, rank, -1, 0, 0, 0);
     if (rc != RLO_OK)
         set_err(e, rc);
+    for (int dst = 0; dst < e->ws; dst++) {
+        if (dst == e->rank || e->failed[dst])
+            continue;
+        rc = eng_isend(e, dst, RLO_TAG_FAILURE, e->rank, rank, -1, 0, 0,
+                       0);
+        if (rc != RLO_OK)
+            set_err(e, rc);
+    }
 }
 
 static void on_failure(rlo_engine *e, rlo_msg *m)
@@ -854,8 +864,16 @@ static void on_failure(rlo_engine *e, rlo_msg *m)
     if (rank == e->rank) {
         /* somebody suspects me — record it; there is no un-fail
          * protocol (matching the reference's absence of recovery) */
+        if (e->suspected_self) {
+            msg_free(m); /* duplicate */
+            return;
+        }
         e->suspected_self = 1;
-    } else if (mark_failed(e, rank)) {
+    } else {
+        if (!mark_failed(e, rank)) {
+            msg_free(m); /* already known: suppress the duplicate */
+            return;
+        }
         rlo_trace_emit(e->rank, RLO_EV_FAILURE, rank, 0);
     }
     int rc = bc_forward(e, m); /* adopt-before-forward ordering */
@@ -1052,6 +1070,10 @@ void rlo_engine_progress_once(rlo_engine *e)
             set_err(e, err);
             continue;
         }
+        /* ANY frame proves the sender alive — prevents heartbeat
+         * starvation when membership views transiently diverge */
+        if (e->fd_timeout && m->src >= 0 && m->src < e->ws)
+            e->hb_seen[m->src] = rlo_now_usec();
         switch (m->tag) {
         case RLO_TAG_BCAST: {
             e->recved_bcast++;
@@ -1074,8 +1096,7 @@ void rlo_engine_progress_once(rlo_engine *e)
             on_decision(e, m);
             break;
         case RLO_TAG_HEARTBEAT:
-            if (m->src >= 0 && m->src < e->ws)
-                e->hb_seen[m->src] = rlo_now_usec();
+            /* liveness already refreshed above for any frame */
             msg_free(m);
             break;
         case RLO_TAG_FAILURE:

@@ -101,7 +101,11 @@ class TestInjection:
 # ---------------------------------------------------------------------------
 
 class TestChaos:
-    @pytest.mark.parametrize("seed", [1, 2, 3, 4, 5])
+    # a 60-seed sweep of this scenario drove the anti-starvation
+    # hardening (any frame counts as liveness; FAILURE notices flood
+    # with duplicate suppression) — before it, 21/60 seeds cascaded
+    # into false-positive meshes of mutual death declarations
+    @pytest.mark.parametrize("seed", list(range(1, 13)))
     def test_kill_mid_broadcast_storm(self, seed):
         import random
         ws = 8
