@@ -13,6 +13,7 @@ surface:
     allreduce(xs, op=...)    data collectives        (net-new, BASELINE)
     reduce_scatter(xs, op=...)
     all_gather(xs)
+    all_to_all(xss)          personalized exchange (expert dispatch)
     barrier()
 
 Per-rank data is passed/returned as a list with one numpy array per rank
