@@ -202,6 +202,20 @@ class ProgressEngine:
         # a restored engine never reissues a pre-snapshot generation.
         self._gen_next = 1
 
+        # exactly-once broadcast bookkeeping: every Tag.BCAST frame this
+        # rank initiates is stamped with a monotone sequence number (in
+        # the frame's otherwise-unused vote field); receivers dedup on
+        # (origin, seq) so a broadcast whose forwarding crosses a
+        # membership change can never deliver twice, and survivors
+        # re-flood their recent-broadcast log on every view change so it
+        # cannot be lost either (see _mark_failed)
+        self._bcast_seq = 0
+        # origin -> [contig, set(seqs > contig)]: all seqs <= contig seen
+        self._seen_bcast: dict = {}
+        # ring log of recently initiated/forwarded BCAST frames (raw
+        # bytes), flooded point-to-point on view changes
+        self._recent_bcasts: deque = deque(maxlen=64)
+
         # failure detection (net-new; SURVEY.md §5 "failure detection:
         # none" in the reference)
         self.failure_timeout = failure_timeout
@@ -239,8 +253,22 @@ class ProgressEngine:
             raise ValueError(
                 f"payload {len(payload)}B exceeds msg_size_max "
                 f"{self.msg_size_max}B")
+        if Tag(tag) == Tag.BCAST:
+            # the vote field of plain broadcasts belongs to the
+            # exactly-once sequence stamp now; a caller-supplied value
+            # would be misread by receivers as a (likely already-seen)
+            # seq and silently dropped cluster-wide
+            if vote != -1:
+                raise ValueError(
+                    "Tag.BCAST frames carry the exactly-once sequence "
+                    "number in the vote field; pass payload data in the "
+                    "payload, not vote")
+            vote = self._bcast_seq
+            self._bcast_seq += 1
         frame = Frame(origin=self.rank, pid=pid, vote=vote, payload=payload)
         raw = frame.encode()
+        if Tag(tag) == Tag.BCAST:
+            self._recent_bcasts.append(raw)
         msg = _Msg(frame=frame, tag=int(tag))
         for dst in self._cur_initiator_targets():  # furthest-first
             msg.send_handles.append(self.transport.isend(dst, int(tag), raw))
@@ -362,6 +390,9 @@ class ProgressEngine:
             msg = _Msg(frame=Frame.decode(raw), tag=tag, src=src)
             if tag == Tag.BCAST:
                 self.recved_bcast_cnt += 1
+                if self._bcast_is_dup(msg):
+                    continue  # exactly-once: drop, don't re-forward
+                self._recent_bcasts.append(raw)
                 self._bc_forward(msg)
             elif tag == Tag.IAR_PROPOSAL:
                 self._on_proposal(msg)
@@ -395,6 +426,28 @@ class ProgressEngine:
             if msg.sends_done():
                 msg.fwd_done = True
                 self.queue_wait.remove(msg)
+
+    def _bcast_is_dup(self, msg: _Msg) -> bool:
+        """Exactly-once receipt check for Tag.BCAST frames, keyed on
+        (origin, seq). The initiator never delivers its own broadcast,
+        so a re-flooded copy of my own frame is also a duplicate."""
+        origin, seq = msg.frame.origin, msg.frame.vote
+        if origin == self.rank:
+            return True
+        if seq < 0:
+            return False  # unstamped (foreign/legacy frame): best-effort
+        ent = self._seen_bcast.setdefault(origin, [-1, set()])
+        if seq <= ent[0] or seq in ent[1]:
+            return True
+        ent[1].add(seq)
+        while ent[0] + 1 in ent[1]:  # advance the contiguous watermark
+            ent[0] += 1
+            ent[1].remove(ent[0])
+        if len(ent[1]) > 4096:  # bound out-of-order state: assume the
+            # oldest half's gaps are lost, not late, and absorb them
+            ent[0] = sorted(ent[1])[len(ent[1]) // 2]
+            ent[1] = {s for s in ent[1] if s > ent[0]}
+        return False
 
     # -- broadcast forwarding (~_bc_forward, rootless_ops.c:1104-1225) ----
     def _bc_forward(self, msg: _Msg) -> int:
@@ -568,15 +621,29 @@ class ProgressEngine:
     # defines RLO_FAILED, rootless_ops.h:66, but never assigns it and has
     # no timeouts/retry/rank-failure handling — SURVEY.md §5)
     #
-    # Consistency contract: membership changes are NOT view-synchronous.
-    # Broadcasts initiated after every survivor has adopted the failure
-    # (and consensus rounds, via vote discounting) are exactly-once; a
-    # broadcast *in flight across* the view change can be forwarded by a
-    # mix of old- and new-topology trees and may reach a survivor twice
-    # or not at all. Applications needing stronger guarantees should
-    # quiesce (drain) after a failure notice before initiating new
-    # traffic — the same quiesce-first discipline the reference requires
-    # for teardown (rootless_ops.c:1606-1647).
+    # Consistency contract: membership changes are NOT view-synchronous,
+    # but Tag.BCAST delivery is **exactly-once** across them for any
+    # broadcast whose initiator survives:
+    #   - at-most-once by construction: every initiated frame carries a
+    #     per-origin sequence number and receivers dedup on (origin,
+    #     seq) before forwarding or delivering (_bcast_is_dup), so a
+    #     broadcast forwarded by a mix of old- and new-topology trees
+    #     can never deliver twice;
+    #   - at-least-once by re-flooding: on every adopted view change,
+    #     each survivor re-sends its recent-broadcast log point-to-point
+    #     to every alive rank (_reflood_recent_bcasts), plugging the
+    #     forwarding holes a dead relay left; the dedup layer absorbs
+    #     the duplication this creates.
+    # Bounds on the at-least-once leg (at-most-once is unconditional):
+    #   - the re-flood log keeps the most recent 64 frames per rank
+    #     (_recent_bcasts maxlen); a broadcast older than that at every
+    #     survivor when the view change lands cannot be re-flooded —
+    #     with >64 broadcasts outstanding per rank across a failure,
+    #     delivery degrades to at-most-once for the evicted ones;
+    #   - broadcasts whose *initiator* died mid-send are at-most-once
+    #     (a frame the origin never handed any survivor is gone).
+    # Consensus rounds stay exactly-once via vote discounting +
+    # (pid, generation) matching.
     # ------------------------------------------------------------------
     def _cur_initiator_targets(self):
         """Initiator send list over the current alive set. Identity to the
@@ -689,7 +756,20 @@ class ProgressEngine:
                 self._hb_seen[pred] = self.clock()
         self._discount_failed_voter(rank)
         self._abort_orphaned_proposals(rank)
+        self._reflood_recent_bcasts()
         return True
+
+    def _reflood_recent_bcasts(self) -> None:
+        """Plug forwarding holes a dead relay left: re-send every recent
+        BCAST frame this rank initiated or forwarded, point-to-point to
+        every alive rank. Receivers drop the (origin, seq) duplicates
+        (_bcast_is_dup) — together the flood + dedup upgrade broadcast
+        delivery across view changes to exactly-once for any initiator
+        that survived."""
+        for raw in list(self._recent_bcasts):
+            for dst in self._alive:
+                if dst != self.rank:
+                    self.transport.isend(dst, int(Tag.BCAST), raw)
 
     def _discount_failed_voter(self, rank: int) -> None:
         """A consensus participant died mid-round: its subtree's merged

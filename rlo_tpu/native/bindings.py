@@ -45,7 +45,8 @@ class _EngineState(C.Structure):
                 ("prop_vote", C.c_int32),
                 ("prop_votes_needed", C.c_int32),
                 ("prop_votes_recved", C.c_int32),
-                ("gen_counter", C.c_int32)]
+                ("gen_counter", C.c_int32),
+                ("bcast_seq", C.c_int32)]
 
 
 class _TraceEvent(C.Structure):

@@ -274,6 +274,14 @@ typedef struct rlo_engine_state {
      * pre-snapshot generation (stale in-flight votes could otherwise
      * match a post-restore round) */
     int32_t gen_counter;
+    /* exactly-once broadcast sequence counter: a restored engine must
+     * never reissue a pre-snapshot seq (peers remembering it as seen
+     * would drop the fresh broadcast). The per-origin dedup window and
+     * recent-frame log are NOT captured (this struct is a flat POD):
+     * the C snapshot assumes whole-world restart, where peers restart
+     * with fresh logs and nothing pre-snapshot is ever re-flooded.
+     * The Python engine snapshot captures both (checkpoint.py). */
+    int32_t bcast_seq;
 } rlo_engine_state;
 int rlo_engine_state_get(const rlo_engine *e, rlo_engine_state *out);
 int rlo_engine_state_set(rlo_engine *e, const rlo_engine_state *in);

@@ -8,6 +8,13 @@
  */
 #include "rlo_internal.h"
 
+/* depth of the recent-broadcast ring log re-flooded on view changes */
+#define RLO_RECENT_LOG 64
+/* per-origin out-of-order dedup window (bits above the contiguous
+ * watermark); reordering beyond this collapses to at-most-once */
+#define RLO_SEEN_BITS 256
+#define RLO_SEEN_WORDS (RLO_SEEN_BITS / 64)
+
 /* ---------------- intrusive message queue (reference queue_append/
  * queue_remove, rootless_ops.c:345-404) ---------------- */
 
@@ -81,6 +88,18 @@ struct rlo_engine {
     uint8_t *failed;    /* per rank */
     int n_failed;
     int suspected_self;
+    /* exactly-once broadcast (mirror of engine.py's _bcast_seq /
+     * _seen_bcast / _recent_bcasts): every initiated BCAST frame is
+     * stamped with a per-origin sequence number in the vote field;
+     * receivers dedup on (origin, seq) before forwarding or
+     * delivering, and on every adopted view change survivors re-flood
+     * their recent-frame log point-to-point so a dead relay's
+     * forwarding holes are plugged (dedup absorbs the duplication) */
+    int32_t bcast_seq;
+    int64_t *seen_contig;   /* per origin: all seqs <= contig seen */
+    uint64_t *seen_mask;    /* per origin: 256-bit window above contig */
+    rlo_blob *recent[RLO_RECENT_LOG];
+    int recent_pos;
 };
 
 /* ---------------- queue ops ---------------- */
@@ -269,10 +288,18 @@ rlo_engine *rlo_engine_new(rlo_world *w, int rank, int comm,
      * adopted even when this engine's own detector is off */
     e->failed = (uint8_t *)calloc((size_t)e->ws, 1);
     e->hb_seen = (uint64_t *)calloc((size_t)e->ws, sizeof(uint64_t));
-    if (e->n_init < 0 || !e->failed || !e->hb_seen ||
-        rlo_world_register(w, e) != RLO_OK) {
+    e->seen_contig = (int64_t *)malloc((size_t)e->ws * sizeof(int64_t));
+    e->seen_mask = (uint64_t *)calloc((size_t)e->ws * RLO_SEEN_WORDS,
+                                      sizeof(uint64_t));
+    if (e->seen_contig)
+        for (int r = 0; r < e->ws; r++)
+            e->seen_contig[r] = -1;
+    if (e->n_init < 0 || !e->failed || !e->hb_seen || !e->seen_contig ||
+        !e->seen_mask || rlo_world_register(w, e) != RLO_OK) {
         free(e->failed);
         free(e->hb_seen);
+        free(e->seen_contig);
+        free(e->seen_mask);
         free(e);
         return 0;
     }
@@ -305,6 +332,10 @@ void rlo_engine_free(rlo_engine *e)
     free(e->own.payload);
     free(e->failed);
     free(e->hb_seen);
+    free(e->seen_contig);
+    free(e->seen_mask);
+    for (int i = 0; i < RLO_RECENT_LOG; i++)
+        rlo_blob_unref(e->recent[i]);
     free(e);
 }
 
@@ -377,6 +408,86 @@ static int cur_fwd_targets(rlo_engine *e, int origin, int src, int *out,
     return n;
 }
 
+/* ---------------- exactly-once broadcast dedup -------------------- */
+
+/* Shift the 256-bit window right by k bits (toward bit 0). */
+static void seen_shift(uint64_t *m, int64_t k)
+{
+    while (k >= 64) {
+        for (int i = 0; i < RLO_SEEN_WORDS - 1; i++)
+            m[i] = m[i + 1];
+        m[RLO_SEEN_WORDS - 1] = 0;
+        k -= 64;
+    }
+    if (k > 0) {
+        for (int i = 0; i < RLO_SEEN_WORDS; i++) {
+            m[i] >>= k;
+            if (i + 1 < RLO_SEEN_WORDS)
+                m[i] |= m[i + 1] << (64 - k);
+        }
+    }
+}
+
+/* (origin, seq) receipt check for BCAST frames. Bit i of the window is
+ * seq contig+1+i. The initiator never delivers its own broadcast, so a
+ * re-flooded copy of my own frame is also a duplicate. */
+static int bcast_is_dup(rlo_engine *e, const rlo_msg *m)
+{
+    if (m->origin == e->rank)
+        return 1;
+    if (m->vote < 0 || m->origin < 0 || m->origin >= e->ws)
+        return 0; /* unstamped (foreign/legacy frame): best-effort */
+    int64_t *contig = &e->seen_contig[m->origin];
+    uint64_t *mask = &e->seen_mask[(size_t)m->origin * RLO_SEEN_WORDS];
+    int64_t seq = m->vote;
+    if (seq <= *contig)
+        return 1;
+    int64_t off = seq - *contig - 1;
+    if (off >= RLO_SEEN_BITS) {
+        /* reorder beyond the window: absorb the oldest gaps as seen
+         * (collapses to at-most-once for seqs that stale) */
+        int64_t shift = off - (RLO_SEEN_BITS - 1);
+        if (shift >= RLO_SEEN_BITS) /* clamp: a huge gap clears all */
+            memset(mask, 0, RLO_SEEN_WORDS * sizeof(uint64_t));
+        else
+            seen_shift(mask, shift);
+        *contig += shift;
+        off = RLO_SEEN_BITS - 1;
+    }
+    if (mask[off >> 6] & (1ull << (off & 63)))
+        return 1;
+    mask[off >> 6] |= 1ull << (off & 63);
+    while (mask[0] & 1) { /* advance the contiguous watermark */
+        seen_shift(mask, 1);
+        (*contig)++;
+    }
+    return 0;
+}
+
+/* Remember a BCAST frame for view-change re-flooding. */
+static void recent_log_push(rlo_engine *e, rlo_blob *frame)
+{
+    rlo_blob_unref(e->recent[e->recent_pos]);
+    e->recent[e->recent_pos] = rlo_blob_ref(frame);
+    e->recent_pos = (e->recent_pos + 1) % RLO_RECENT_LOG;
+}
+
+/* Plug forwarding holes a dead relay left: re-send every logged frame
+ * point-to-point to every alive rank; receivers drop the (origin, seq)
+ * duplicates. Together flood + dedup make BCAST delivery exactly-once
+ * across view changes for any initiator that survived. */
+static void reflood_recent(rlo_engine *e)
+{
+    for (int i = 0; i < RLO_RECENT_LOG; i++) {
+        rlo_blob *b = e->recent[i];
+        if (!b)
+            continue;
+        for (int dst = 0; dst < e->ws; dst++)
+            if (dst != e->rank && !e->failed[dst])
+                eng_isend_frame(e, dst, RLO_TAG_BCAST, b, 0);
+    }
+}
+
 /* ---------------- rootless broadcast ---------------- */
 
 /* Initiate without progressing (handlers use this; the public entry
@@ -417,9 +528,16 @@ static int bcast_init(rlo_engine *e, int tag, int32_t pid, int32_t vote,
 
 int rlo_bcast(rlo_engine *e, const uint8_t *payload, int64_t len)
 {
-    int rc = bcast_init(e, RLO_TAG_BCAST, -1, -1, payload, len, 0);
-    if (rc == RLO_OK)
+    /* stamp the exactly-once sequence number in the (otherwise unused)
+     * vote field; log the frame for view-change re-flooding */
+    rlo_msg *m = 0;
+    int rc = bcast_init(e, RLO_TAG_BCAST, -1, e->bcast_seq, payload, len,
+                        &m);
+    if (rc == RLO_OK) {
+        e->bcast_seq++;
+        recent_log_push(e, m->frame);
         rlo_progress_all(e->w);
+    }
     return rc;
 }
 
@@ -753,9 +871,13 @@ void rlo_proposal_reset(rlo_engine *e)
 
 /* ---------------- failure detection + elastic recovery --------------
  * Mirror of rlo_tpu/engine.py's failure machinery (see rlo_core.h for
- * the contract). The same non-view-synchronous caveat applies: traffic
- * initiated after every survivor adopted the failure is exactly-once;
- * traffic in flight across the change may duplicate or drop. */
+ * the contract). Membership changes are not view-synchronous, but
+ * BCAST delivery is exactly-once across them for any initiator that
+ * survived: (origin, seq) dedup makes twice impossible and the
+ * view-change re-flood (reflood_recent) makes zero impossible — for
+ * broadcasts within the RLO_RECENT_LOG most recent frames a survivor
+ * holds (older evicted frames degrade to at-most-once, as does
+ * traffic whose initiator died before handing any survivor a copy). */
 
 static void ring_neighbors(const rlo_engine *e, int *succ, int *pred)
 {
@@ -834,6 +956,7 @@ static int mark_failed(rlo_engine *e, int rank)
     }
     discount_failed_voter(e, rank);
     abort_orphaned_proposals(e, rank);
+    reflood_recent(e);
     return 1;
 }
 
@@ -1077,6 +1200,12 @@ void rlo_engine_progress_once(rlo_engine *e)
         switch (m->tag) {
         case RLO_TAG_BCAST: {
             e->recved_bcast++;
+            if (bcast_is_dup(e, m)) {
+                /* exactly-once: drop, don't re-forward or deliver */
+                msg_free(m);
+                break;
+            }
+            recent_log_push(e, m->frame);
             int rc = bc_forward(e, m);
             if (rc < 0) {
                 /* bc_forward only fails before queueing — reclaim */
@@ -1156,6 +1285,7 @@ int rlo_engine_state_get(const rlo_engine *e, rlo_engine_state *out)
     out->prop_votes_needed = e->own.votes_needed;
     out->prop_votes_recved = e->own.votes_recved;
     out->gen_counter = e->gen_counter;
+    out->bcast_seq = e->bcast_seq;
     return RLO_OK;
 }
 
@@ -1179,6 +1309,7 @@ int rlo_engine_state_set(rlo_engine *e, const rlo_engine_state *in)
     e->own.votes_needed = in->prop_votes_needed;
     e->own.votes_recved = in->prop_votes_recved;
     e->gen_counter = in->gen_counter;
+    e->bcast_seq = in->bcast_seq;
     return RLO_OK;
 }
 

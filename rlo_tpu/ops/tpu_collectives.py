@@ -104,7 +104,14 @@ def allreduce(x, axis: str, *, op: str = "sum", algorithm: str = "auto",
 
     algorithm: 'psum' lowers to one XLA AllReduce (the baseline to beat);
     'ring' is reduce-scatter + all-gather over explicit ppermute steps with
-    the Pallas fused combine (bandwidth-optimal, overlappable); 'recursive
+    the Pallas fused combine (bandwidth-optimal, overlappable);
+    'bidir_ring' is the chunked double-buffered bidirectional ring
+    (SURVEY.md §7 hard part 3): both ICI link directions carry half the
+    payload each, the schedule is fully unrolled with static chunk
+    indices, and each step's sub-chunk sends are independent of the same
+    step's combines so XLA's latency-hiding scheduler overlaps the
+    CollectivePermute DMA of sub-chunk q+1 with the (Pallas) combine of
+    sub-chunk q; 'recursive
     doubling' is log2(n) full-vector exchanges (small payloads, pow2 only);
     'halving_doubling' is recursive-halving reduce-scatter + recursive-
     doubling all-gather (Rabenseifner — bandwidth-optimal in log2(n) rounds,
@@ -126,6 +133,8 @@ def allreduce(x, axis: str, *, op: str = "sum", algorithm: str = "auto",
             raise ValueError(f"unknown op {op!r}")
         if algorithm == "recursive_doubling":
             return _allreduce_rd(x, axis, op, use_pallas)
+        if algorithm == "bidir_ring":
+            return _bidir_ring_allreduce(x, axis, op, use_pallas)
         if algorithm == "ring":
             chunks, meta = _chunk_shard(x, lax.axis_size(axis))
             _, reduced = _ring_reduce_scatter(chunks, axis, op, use_pallas)
@@ -148,6 +157,90 @@ def _allreduce_rd(x, axis: str, op: str, use_pallas: bool):
         other = lax.ppermute(x, axis, list(rnd))
         x = combine(x, other)
     return x
+
+
+def _bidir_ring_allreduce(x, axis: str, op: str, use_pallas: bool,
+                          pipeline_chunks: int = 2):
+    """Bidirectional chunked-pipelined ring allreduce.
+
+    The manual schedule the north star asks to win with (BASELINE.json;
+    SURVEY.md §7 hard part 3 — "chunked double-buffered overlap of DMA and
+    reduction"), built to overlap *by construction* instead of hoping XLA
+    reassociates a fori_loop:
+
+      - **Bidirectional**: the flat payload is split in half; the forward
+        half rings rank->rank+1 while the backward half rings
+        rank->rank-1. On a TPU torus the two directions are distinct ICI
+        links, so each of the 2*(ws-1) logical steps moves only 1/(2*ws)
+        of the buffer per link — halving the serialized bytes per link vs
+        a unidirectional ring.
+      - **Rank-relative static layout**: each half is chunked into ws
+        rows and rolled so local row j holds global chunk (j + rank); the
+        entire 2*(ws-1)-step schedule then uses *static* row indices (the
+        same program on every shard), no dynamic slicing in the loop. The
+        two rolls (in, out) are local HBM traffic, negligible next to ICI.
+      - **Sub-chunk software pipeline**: every row is further split into
+        ``pipeline_chunks`` sub-chunks. Within a step, the ppermute of
+        sub-chunk q+1 has no data dependence on the combine of sub-chunk
+        q (sends depend only on the *previous* step's combine of the same
+        q), so the unrolled program exposes DMA/compute overlap directly
+        to XLA's latency-hiding scheduler: there is always a
+        CollectivePermute in flight while the (Pallas) combine runs.
+
+    Reduces in ring association order; result replicated across the axis.
+    Works for any axis size (ws=1 is the identity) and any payload shape
+    (zero-padded to 2*ws*pipeline_chunks elements internally).
+    """
+    ws = lax.axis_size(axis)
+    if ws == 1:
+        return x
+    combine = _combiner(op, use_pallas)
+    idx = lax.axis_index(axis)
+    nq = pipeline_chunks
+    shape, n = x.shape, x.size
+    flat = x.reshape(-1)
+    pad = (-n) % (2 * ws * nq)
+    if pad:
+        flat = jnp.concatenate(
+            [flat, _vary_like(jnp.zeros(pad, flat.dtype), flat)])
+    halves = flat.reshape(2, ws, nq, -1)
+    # rank-relative layout: local row j holds global chunk (j + rank) % ws
+    halves = jnp.roll(halves, -idx, axis=1)
+    # materialize as [ws][nq] python grids of sub-chunk arrays so the whole
+    # schedule below is static indexing — no dynamic_slice inside the jit
+    fwd = [[halves[0, i, q] for q in range(nq)] for i in range(ws)]
+    bwd = [[halves[1, i, q] for q in range(nq)] for i in range(ws)]
+    fperm = list(topology.ring_perm(ws, 1))
+    bperm = list(topology.ring_perm(ws, -1))
+
+    # --- reduce-scatter: ws-1 steps, both directions concurrently -------
+    # fwd: step s sends row (ws-s)%ws, combines arrival into row ws-1-s
+    # bwd: step s sends row s,        combines arrival into row s+1
+    # (send of step s == combine target of step s-1: the inherent ring
+    # dependence; sub-chunks make the *cross*-q sends independent)
+    for s in range(ws - 1):
+        for q in range(nq):
+            f_in = lax.ppermute(fwd[(ws - s) % ws][q], axis, fperm)
+            b_in = lax.ppermute(bwd[s][q], axis, bperm)
+            fwd[ws - 1 - s][q] = combine(fwd[ws - 1 - s][q], f_in)
+            bwd[s + 1][q] = combine(bwd[s + 1][q], b_in)
+    # fully reduced: fwd row 1 (global chunk rank+1), bwd row ws-1 (rank-1)
+
+    # --- all-gather: ws-1 pure-forwarding steps -------------------------
+    # fwd: step t sends row (1-t)%ws, arrival lands in row (-t)%ws
+    # bwd: step t sends row (ws-1+t)%ws, arrival lands in row t
+    for t in range(ws - 1):
+        for q in range(nq):
+            f_in = lax.ppermute(fwd[(1 - t) % ws][q], axis, fperm)
+            b_in = lax.ppermute(bwd[(ws - 1 + t) % ws][q], axis, bperm)
+            fwd[(-t) % ws][q] = f_in
+            bwd[t][q] = b_in
+
+    out = jnp.stack([
+        jnp.stack([jnp.stack(row) for row in half])
+        for half in (fwd, bwd)])                    # (2, ws, nq, c)
+    out = jnp.roll(out, idx, axis=1)                # back to global order
+    return out.reshape(-1)[:n].reshape(shape)
 
 
 def _chunk_shard(x, ws: int):

@@ -271,6 +271,18 @@ def engine_state_dict(engine) -> dict:
         # pre-snapshot round generation (stale in-flight votes could
         # otherwise match a post-restore round)
         "gen_next": engine._gen_next,
+        # exactly-once broadcast state: the seq counter (a restored
+        # engine must never reissue a pre-snapshot seq — peers
+        # remembering it as seen would silently drop the fresh
+        # broadcast), the per-origin seen map (so a restored engine
+        # cannot re-deliver a pre-snapshot broadcast a survivor
+        # re-floods at it), and the recent-frame log (so it can still
+        # plug holes for traffic it forwarded pre-snapshot)
+        "bcast_seq": engine._bcast_seq,
+        "seen_bcast": {str(o): [ent[0], sorted(ent[1])]
+                       for o, ent in engine._seen_bcast.items()},
+        "recent_bcasts": [base64.b64encode(raw).decode()
+                          for raw in engine._recent_bcasts],
         "pickup": pickup,
     }
 
@@ -301,6 +313,12 @@ def load_engine_state(engine, state: dict) -> None:
     p.state = type(p.state)(snap["state"])
     p.votes_needed, p.votes_recved = snap["votes_needed"], snap["votes_recved"]
     engine._gen_next = state.get("gen_next", engine._gen_next)
+    engine._bcast_seq = state.get("bcast_seq", engine._bcast_seq)
+    engine._seen_bcast = {int(o): [ent[0], set(ent[1])]
+                          for o, ent in state.get("seen_bcast",
+                                                  {}).items()}
+    engine._recent_bcasts.extend(
+        base64.b64decode(s) for s in state.get("recent_bcasts", []))
     for m in state.get("pickup", []):
         frame = Frame(origin=m["origin"], pid=m["pid"], vote=m["vote"],
                       payload=base64.b64decode(m["data"]))

@@ -161,6 +161,57 @@ class TestChaos:
                 break
         assert engines[origin].vote_my_proposal() == 1
 
+    @pytest.mark.parametrize("seed", list(range(1, 13)))
+    def test_exactly_once_across_view_change(self, seed):
+        """Traffic initiated by SURVIVORS before the kill must deliver
+        exactly once at every other survivor, even when its forwarding
+        crosses the membership change: (origin, seq) dedup makes twice
+        impossible, the view-change re-flood makes zero impossible.
+        Victim-initiated traffic is at-most-once (a frame the dead
+        origin never handed a survivor has no copy left to re-flood)."""
+        import random
+        from collections import Counter
+        ws = 8
+        clock = FakeClock()
+        world = LoopbackWorld(ws, latency=4, seed=seed)
+        mgr = EngineManager()
+        engines = [ProgressEngine(world.transport(r), manager=mgr,
+                                  failure_timeout=8.0,
+                                  heartbeat_interval=1.0, clock=clock)
+                   for r in range(ws)]
+        rng = random.Random(seed)
+        victim = rng.randrange(ws)
+        alive = [r for r in range(ws) if r != victim]
+        sent_by_survivors = []
+        # burst of pre-kill traffic from every rank, then the kill lands
+        # while much of it is still in flight (latency=4)
+        for step in range(6):
+            for r in range(ws):
+                payload = f"pre{step}r{r}".encode()
+                engines[r].bcast(payload)
+                if r != victim:
+                    sent_by_survivors.append(payload)
+        world.kill_rank(victim)
+        engines[victim].cleanup()
+        spin(mgr, clock, 120)  # detection + re-flood + settle
+        survivors = [engines[r] for r in alive]
+        assert all(e.failed == {victim} for e in survivors)
+        drain([world], survivors)
+        got = {e.rank: Counter() for e in survivors}
+        for e in survivors:
+            while (m := e.pickup_next()) is not None:
+                if m.type == int(Tag.BCAST):
+                    got[e.rank][m.data] += 1
+        for e in survivors:
+            for payload in sent_by_survivors:
+                origin = int(payload.decode().rsplit("r", 1)[1])
+                want = 0 if e.rank == origin else 1
+                assert got[e.rank][payload] == want, (
+                    seed, e.rank, payload, got[e.rank][payload])
+            # victim-initiated: at most once
+            for payload, n in got[e.rank].items():
+                assert n == 1, (seed, e.rank, payload, n)
+
 
 # ---------------------------------------------------------------------------
 # Native (C) engine parity: same detect / re-form / recover behavior
@@ -211,6 +262,58 @@ class TestNativeParity:
                 rc = engines[0].vote_my_proposal()
             assert rc == 1
             world.drain()
+
+    @pytest.mark.parametrize("seed", [1, 2, 3, 4, 5])
+    def test_c_exactly_once_across_view_change(self, seed):
+        """C-engine mirror of test_exactly_once_across_view_change:
+        survivor-initiated broadcasts in flight across the kill must
+        deliver exactly once at every other survivor ((origin, seq)
+        dedup + view-change re-flood); victim-initiated at most once."""
+        import random
+        import time
+        from collections import Counter
+        from rlo_tpu.native.bindings import NativeEngine, NativeWorld
+
+        ws = 8
+        rng = random.Random(seed)
+        victim = rng.randrange(ws)
+        with NativeWorld(ws, latency=4, seed=seed) as world:
+            engines = [NativeEngine(world, r) for r in range(ws)]
+            for e in engines:
+                e.enable_failure_detection(timeout_usec=20_000,
+                                           interval_usec=5_000)
+            t0 = time.monotonic()
+            while time.monotonic() - t0 < 0.03:
+                world.progress_all()
+            sent_by_survivors = []
+            for step in range(6):
+                for r in range(ws):
+                    payload = f"pre{step}r{r}".encode()
+                    engines[r].bcast(payload)
+                    if r != victim:
+                        sent_by_survivors.append(payload)
+            world.kill_rank(victim)
+            engines[victim].close()
+            t0 = time.monotonic()
+            survivors = [e for r, e in enumerate(engines) if r != victim]
+            while time.monotonic() - t0 < 3.0:
+                world.progress_all()
+                if all(e.rank_failed(victim) for e in survivors):
+                    break
+            assert all(e.rank_failed(victim) for e in survivors)
+            world.drain()
+            for e in survivors:
+                got = Counter()
+                while (m := e.pickup_next()) is not None:
+                    if m.type == int(Tag.BCAST):
+                        got[m.data] += 1
+                for payload in sent_by_survivors:
+                    origin = int(payload.decode().rsplit("r", 1)[1])
+                    want = 0 if e.rank == origin else 1
+                    assert got[payload] == want, (
+                        seed, e.rank, payload, got[payload])
+                for payload, n in got.items():
+                    assert n == 1, (seed, e.rank, payload, n)
 
 
 # ---------------------------------------------------------------------------

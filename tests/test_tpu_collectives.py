@@ -30,7 +30,7 @@ def sharded_rand(shape, dtype=jnp.float32, seed=0):
 
 
 class TestAllreduce:
-    @pytest.mark.parametrize("algorithm", ["psum", "ring",
+    @pytest.mark.parametrize("algorithm", ["psum", "ring", "bidir_ring",
                                            "recursive_doubling",
                                            "halving_doubling"])
     @pytest.mark.parametrize("op", ["sum", "min", "max"])
@@ -46,6 +46,21 @@ class TestAllreduce:
         # ring/rd reduce in a different association order than one AllReduce
         np.testing.assert_allclose(np.asarray(f(x)), np.asarray(base(x)),
                                    rtol=1e-3, atol=1e-5)
+
+    @pytest.mark.parametrize("ws", [2, 3, 5, 8])
+    @pytest.mark.parametrize("use_pallas", [False, True])
+    def test_bidir_ring_any_world_size(self, ws, use_pallas):
+        """The pipelined bidirectional ring must hold for non-power-of-2
+        axis sizes and with the Pallas fused combine (interpret on CPU)."""
+        mesh = make_mesh((ws,), ("x",))
+        x = sharded_rand((ws, 4, 33), seed=ws)
+        f = shard_jit(
+            lambda v: tc.allreduce(v, "x", algorithm="bidir_ring",
+                                   use_pallas=use_pallas),
+            mesh, P("x"), P("x"))
+        want = np.broadcast_to(np.asarray(x).sum(0), x.shape)
+        np.testing.assert_allclose(np.asarray(f(x)), want,
+                                   rtol=1e-4, atol=1e-6)
 
     def test_ring_with_pallas_combine(self, mesh):
         """The Pallas fused combine (interpret mode on CPU) inside the ring
