@@ -17,6 +17,9 @@
  *              engines on one world, concurrent proposals, both verified
  *   multi    ~ test_iar_multi_proposal (:401-486): several simultaneous
  *              proposers; every rank counts the expected decisions
+ *   multi2   ~ test_concurrent_iar_multi_proposal (:488-594): engine
+ *              multiplexing x several simultaneous proposers per
+ *              engine, with pid reuse across two sequential rounds
  *   fail     net-new (no reference analogue): one rank crashes; the
  *              others detect it through shm heartbeat staleness
  *              (rlo_world_peer_alive) instead of hanging in a drain
@@ -337,6 +340,66 @@ static int case_multi(rlo_world *w, int rank, void *vcfg)
     return 0;
 }
 
+/* ---- concurrent multi-proposal on TWO engines ----
+ * Reference test_concurrent_iar_multi_proposal (testcases.c:488-594):
+ * the product of engine multiplexing (iar2) and several simultaneous
+ * proposers (multi), plus pid reuse across two sequential rounds (each
+ * proposer reuses pid=rank; the round generation disambiguates). */
+static int case_multi2(rlo_world *w, int rank, void *vcfg)
+{
+    (void)vcfg;
+    int ws = rlo_world_size(w);
+    rlo_engine *a = rlo_engine_new(w, rank, 0, 0, 0, 0, 0, 0);
+    rlo_engine *b = rlo_engine_new(w, rank, 1, 0, 0, 0, 0, 0);
+    RCHECK(a && b);
+    int am_proposer = rank == 1 % ws || rank % 4 == 0;
+    int n_prop = 0;
+    for (int r = 0; r < ws; r++)
+        if (r == 1 % ws || r % 4 == 0)
+            n_prop++;
+    for (int round = 0; round < 2; round++) {
+        if (am_proposer) {
+            RCHECK(rlo_submit_proposal(a, (const uint8_t *)"mA", 2,
+                                       rank) >= -1);
+            RCHECK(rlo_submit_proposal(b, (const uint8_t *)"mB", 2,
+                                       rank) >= -1);
+        }
+        /* decision-count oracle per engine: one decision per foreign
+         * proposal, each pid exactly once, all approved */
+        int want = n_prop - (am_proposer ? 1 : 0);
+        for (int ei = 0; ei < 2; ei++) {
+            rlo_engine *e = ei ? b : a;
+            int seen[256] = {0};
+            for (int i = 0; i < want; i++) {
+                uint8_t buf[64];
+                int tag, origin, pid, vote;
+                int64_t n = pickup_spin(w, e, &tag, &origin, &pid, &vote,
+                                        buf, sizeof buf);
+                RCHECK(n >= 0);
+                RCHECK(tag == RLO_TAG_IAR_DECISION && vote == 1);
+                RCHECK(pid >= 0 && pid < 256 && !seen[pid]);
+                seen[pid] = 1;
+            }
+        }
+        if (am_proposer) {
+            RCHECK(proposal_spin(w, a) == 0);
+            RCHECK(rlo_vote_my_proposal(a) == 1);
+            RCHECK(proposal_spin(w, b) == 0);
+            RCHECK(rlo_vote_my_proposal(b) == 1);
+        }
+        RCHECK(rlo_drain(w, DRAIN_SPINS) >= 0);
+        /* the drain is collective but its EXIT is not simultaneous: a
+         * fast rank submitting round r+1 immediately would regenerate
+         * traffic and keep a slow rank's drain from ever observing
+         * global idle. Barrier between rounds closes that race. */
+        rlo_shm_barrier(w);
+    }
+    RCHECK(rlo_engine_err(a) == RLO_OK && rlo_engine_err(b) == RLO_OK);
+    rlo_engine_free(a);
+    rlo_engine_free(b);
+    return 0;
+}
+
 /* ---- fail: a rank dies; survivors detect it via shm heartbeats ----
  * Net-new failure detection (the reference defines RLO_FAILED,
  * rootless_ops.h:66, but never assigns it; no timeouts or rank-failure
@@ -453,6 +516,7 @@ static const demo_case CASES[] = {
     {"bcast", case_bcast},   {"wrapper", case_wrapper},
     {"hacky", case_hacky},   {"iar", case_iar},
     {"iar2", case_iar2},     {"multi", case_multi},
+    {"multi2", case_multi2},
     {"fail", case_fail},     {"efail", case_efail},
 };
 #define N_CASES (int)(sizeof CASES / sizeof *CASES)

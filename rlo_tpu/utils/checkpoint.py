@@ -314,11 +314,13 @@ def load_engine_state(engine, state: dict) -> None:
     p.votes_needed, p.votes_recved = snap["votes_needed"], snap["votes_recved"]
     engine._gen_next = state.get("gen_next", engine._gen_next)
     engine._bcast_seq = state.get("bcast_seq", engine._bcast_seq)
-    engine._seen_bcast = {int(o): [ent[0], set(ent[1])]
-                          for o, ent in state.get("seen_bcast",
-                                                  {}).items()}
-    engine._recent_bcasts.extend(
-        base64.b64decode(s) for s in state.get("recent_bcasts", []))
+    if "seen_bcast" in state:  # pre-feature snapshots: preserve current
+        engine._seen_bcast = {int(o): [ent[0], set(ent[1])]
+                              for o, ent in state["seen_bcast"].items()}
+    if "recent_bcasts" in state:  # replace, not merge (rollback must not
+        engine._recent_bcasts.clear()  # leave post-snapshot frames behind)
+        engine._recent_bcasts.extend(
+            base64.b64decode(s) for s in state["recent_bcasts"])
     for m in state.get("pickup", []):
         frame = Frame(origin=m["origin"], pid=m["pid"], vote=m["vote"],
                       payload=base64.b64decode(m["data"]))

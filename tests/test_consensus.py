@@ -199,6 +199,87 @@ class TestMultiProposal:
         assert engines[0].vote_my_proposal() == 0
 
 
+class TestConcurrentMultiProposal:
+    """Reference test_concurrent_iar_multi_proposal (testcases.c:488-594):
+    the PRODUCT of engine multiplexing and multiple simultaneous
+    proposers — several proposers on each of two engines at once, with
+    pid reuse across sequential rounds. Decision-count oracles: every
+    rank sees exactly one decision per foreign proposal per engine, all
+    values agree."""
+
+    @staticmethod
+    def proposers_of(ws):
+        # reference active_1 + active_2_mod pattern (testcases.c:401-486)
+        return sorted({1 % ws} | {r for r in range(ws) if r % 4 == 0})
+
+    @pytest.mark.parametrize("ws", [4, 8, 13])
+    def test_multi_proposal_on_two_engines(self, ws):
+        manager = EngineManager()
+        world_a = make_world("loopback", ws)
+        world_b = make_world("loopback", ws)
+        eng_a = [ProgressEngine(world_a.transport(r), manager=manager)
+                 for r in range(ws)]
+        eng_b = [ProgressEngine(world_b.transport(r), manager=manager)
+                 for r in range(ws)]
+        proposers = self.proposers_of(ws)
+        for rnd in range(3):  # pid reuse: every round reuses pid=rank
+            for p in proposers:
+                eng_a[p].submit_proposal(f"A{rnd}p{p}".encode(), pid=p)
+                eng_b[p].submit_proposal(f"B{rnd}p{p}".encode(), pid=p)
+            drain([world_a, world_b], eng_a + eng_b)
+            for engines in (eng_a, eng_b):
+                for r in range(ws):
+                    ds = decisions_of(engines[r])
+                    want = len(proposers) - (1 if r in proposers else 0)
+                    assert len(ds) == want, (rnd, r, ds)
+                    assert sorted(d.pid for d in ds) == [
+                        p for p in proposers if p != r]
+                    assert all(d.vote == 1 for d in ds)
+            for p in proposers:
+                assert eng_a[p].vote_my_proposal() == 1
+                assert eng_b[p].vote_my_proposal() == 1
+
+    @pytest.mark.parametrize("ws", [4, 8, 13])
+    def test_native_multi_proposal_on_two_engines(self, ws):
+        """C-engine mirror over the in-process native world (the
+        multi-process version is demo scenario `multi2`)."""
+        from rlo_tpu.native.bindings import NativeEngine, NativeWorld
+
+        with NativeWorld(ws) as wa, NativeWorld(ws) as wb:
+            eng_a = [NativeEngine(wa, r) for r in range(ws)]
+            eng_b = [NativeEngine(wb, r) for r in range(ws)]
+            proposers = self.proposers_of(ws)
+
+            def spin_all():
+                for _ in range(100_000):
+                    wa.progress_all()
+                    wb.progress_all()
+                    if wa.quiescent() and wb.quiescent() and all(
+                            e.idle() for e in eng_a + eng_b):
+                        return
+                raise RuntimeError("no quiescence")
+
+            for rnd in range(3):
+                for p in proposers:
+                    assert eng_a[p].submit_proposal(
+                        f"A{rnd}".encode(), pid=p) >= -1
+                    assert eng_b[p].submit_proposal(
+                        f"B{rnd}".encode(), pid=p) >= -1
+                spin_all()
+                for engines in (eng_a, eng_b):
+                    for r in range(ws):
+                        pids = []
+                        while (m := engines[r].pickup_next()) is not None:
+                            if m.type == int(Tag.IAR_DECISION):
+                                assert m.vote == 1
+                                pids.append(m.pid)
+                        assert sorted(pids) == [
+                            p for p in proposers if p != r], (rnd, r)
+                for p in proposers:
+                    assert eng_a[p].vote_my_proposal() == 1
+                    assert eng_b[p].vote_my_proposal() == 1
+
+
 class TestEngineMultiplexing:
     @pytest.mark.parametrize("ws", [4, 8])
     def test_two_engines_concurrently(self, ws):
