@@ -492,9 +492,11 @@ class MpiBackend(Backend):
         if not lib.rlo_mpi_available():
             raise RuntimeError(
                 "this build has no MPI (mpi.h was absent at compile "
-                "time); rebuild the native core on a host with MPI and "
-                "launch under mpirun. The rlo_mpi.c transport is "
-                "compile-gated on RLO_HAVE_MPI.")
+                "time). Launch under the in-repo MPI subset —\n"
+                "    rlo_tpu/native/femtompirun -n N python your_prog.py\n"
+                "(the bindings auto-build the femtompi-linked core when "
+                "FEMTOMPI_RANK is set) — or rebuild on a host with a "
+                "real MPI and run under mpirun.")
         w = lib.rlo_mpi_world_new()
         if not w:
             raise RuntimeError(
@@ -542,6 +544,11 @@ class MpiBackend(Backend):
     def consensus(self, my_vote: int) -> int:
         from rlo_tpu.wire import Tag
         self._my_vote = int(my_vote)  # read by this rank's judge cb
+        # every rank's vote must be pinned BEFORE any proposal can
+        # arrive: without this barrier a slow rank still draining the
+        # previous collective could judge the proposal with its stale
+        # previous-round vote
+        self.world.barrier()
         if self.rank == 0:
             rc = self.engine.submit_proposal(b"facade", pid=0)
             for _ in range(200_000_000):

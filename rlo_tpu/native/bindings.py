@@ -132,6 +132,7 @@ def load() -> C.CDLL:
     sig("rlo_engine_sent_bcast", C.c_int64, [p])
     sig("rlo_engine_recved_bcast", C.c_int64, [p])
     sig("rlo_drain", C.c_int, [p, C.c_int])
+    sig("rlo_world_barrier", None, [p])
     sig("rlo_now_usec", C.c_uint64, [])
     sig("rlo_trace_set", None, [C.c_int])
     sig("rlo_trace_enabled", C.c_int, [])
@@ -194,6 +195,10 @@ class NativeWorld:
     @property
     def delivered_cnt(self) -> int:
         return self._lib.rlo_world_delivered_cnt(self._w)
+
+    def barrier(self) -> None:
+        """Collective barrier across ranks (shm/mpi; no-op loopback)."""
+        self._lib.rlo_world_barrier(self._w)
 
     def drain(self, max_spins: int = 100_000) -> int:
         rc = self._lib.rlo_drain(self._w, max_spins)

@@ -18,18 +18,28 @@ SOURCES = ["rlo_topology.c", "rlo_wire.c", "rlo_trace.c",
            "rlo_mpi.c", "rlo_engine.c", "rlo_bench.c"]
 HEADERS = ["rlo_core.h", "rlo_internal.h"]
 LIB_NAME = "librlo_core.so"
+#: femtompi-linked variant: the MPI transport is live, rendezvous via
+#: the femtompirun launcher (env FEMTOMPI_*). Built on demand when a
+#: process launched under femtompirun imports the bindings.
+MPI_LIB_NAME = "librlo_core_fmpi.so"
+
+
+def under_femtompi() -> bool:
+    return os.environ.get("FEMTOMPI_RANK") is not None
 
 
 def lib_path() -> Path:
-    return _DIR / LIB_NAME
+    return _DIR / (MPI_LIB_NAME if under_femtompi() else LIB_NAME)
 
 
 def _stale(lib: Path) -> bool:
     if not lib.exists():
         return True
     lib_mtime = lib.stat().st_mtime
-    return any((_DIR / f).stat().st_mtime > lib_mtime
-               for f in SOURCES + HEADERS)
+    deps = SOURCES + HEADERS
+    if under_femtompi():
+        deps = deps + ["femtompi/femtompi.c", "femtompi/mpi.h"]
+    return any((_DIR / f).stat().st_mtime > lib_mtime for f in deps)
 
 
 def _have_mpi(cc: str) -> bool:
@@ -43,17 +53,32 @@ def _have_mpi(cc: str) -> bool:
 
 
 def build(force: bool = False) -> Path:
-    """Build (if needed) and return the shared-library path."""
+    """Build (if needed) and return the shared-library path.
+
+    Under femtompirun the femtompi-linked variant is built instead: the
+    MPI transport compiles in against femtompi/mpi.h so MpiBackend runs
+    for real (one process per rank). Otherwise a real MPI install is
+    probed; absent both, rlo_mpi_available() reports 0.
+    """
     lib = lib_path()
     if not force and not _stale(lib):
         return lib
     cc = os.environ.get("CC", "cc")
-    extra = ["-DRLO_HAVE_MPI", "-lmpi"] if _have_mpi(cc) else []
+    srcs = [str(_DIR / s) for s in SOURCES]
+    if under_femtompi():
+        extra = ["-DRLO_HAVE_MPI", f"-I{_DIR / 'femtompi'}",
+                 str(_DIR / "femtompi" / "femtompi.c")]
+    else:
+        extra = ["-DRLO_HAVE_MPI", "-lmpi"] if _have_mpi(cc) else []
+    # build to a private temp then atomically rename: N ranks launched
+    # together may all find the library stale and rebuild concurrently
+    tmp = lib.with_suffix(f".so.tmp.{os.getpid()}")
     cmd = [cc, "-O2", "-g", "-std=c11", "-Wall", "-Wextra", "-fPIC",
-           "-shared", "-o", str(lib)] + \
-        [str(_DIR / s) for s in SOURCES] + extra
+           "-shared", "-o", str(tmp)] + srcs + extra
     proc = subprocess.run(cmd, capture_output=True, text=True)
     if proc.returncode != 0:
+        tmp.unlink(missing_ok=True)
         raise RuntimeError(
             f"native core build failed ({' '.join(cmd)}):\n{proc.stderr}")
+    os.replace(tmp, lib)
     return lib

@@ -47,5 +47,9 @@ int MPI_Request_free(MPI_Request *req);
 int MPI_Iallreduce(const void *sendbuf, void *recvbuf, int count,
                    MPI_Datatype dt, MPI_Op op, MPI_Comm comm,
                    MPI_Request *req);
+int MPI_Barrier(MPI_Comm comm);
+int MPI_Bcast(void *buf, int count, MPI_Datatype dt, int root,
+              MPI_Comm comm);
+double MPI_Wtime(void);
 
 #endif /* RLO_MOCK_MPI_H */

@@ -145,6 +145,9 @@ int rlo_world_peer_alive(const rlo_world *w, int rank,
 int rlo_world_kill_rank(rlo_world *w, int rank);
 int64_t rlo_world_sent_cnt(const rlo_world *w);
 int64_t rlo_world_delivered_cnt(const rlo_world *w);
+/* Collective barrier across all ranks (shm: sense-reversing spin;
+ * mpi: MPI_Barrier; no-op on single-process transports). */
+void rlo_world_barrier(rlo_world *w);
 
 /* ------------------------------------------------------------------ */
 /* SHM transport: N real OS processes as ranks over a shared-memory     */

@@ -529,12 +529,15 @@ static int bcast_init(rlo_engine *e, int tag, int32_t pid, int32_t vote,
 int rlo_bcast(rlo_engine *e, const uint8_t *payload, int64_t len)
 {
     /* stamp the exactly-once sequence number in the (otherwise unused)
-     * vote field; log the frame for view-change re-flooding */
+     * vote field; log the frame for view-change re-flooding. The seq is
+     * consumed BEFORE sending (matching engine.py): a partial-send
+     * failure may have leaked the seq to some peers, and reusing it
+     * would make them silently drop the next broadcast as a duplicate.
+     * A burnt seq just leaves a gap the dedup window absorbs. */
     rlo_msg *m = 0;
-    int rc = bcast_init(e, RLO_TAG_BCAST, -1, e->bcast_seq, payload, len,
-                        &m);
+    int rc = bcast_init(e, RLO_TAG_BCAST, -1, e->bcast_seq++, payload,
+                        len, &m);
     if (rc == RLO_OK) {
-        e->bcast_seq++;
         recent_log_push(e, m->frame);
         rlo_progress_all(e->w);
     }

@@ -110,6 +110,9 @@ typedef struct rlo_transport_ops {
     /* fault injection: simulate `rank`'s process dying (in-process
      * transports only); NULL = unsupported */
     int (*kill_rank)(rlo_world *w, int rank);
+    /* block until every rank reaches the barrier (multi-process
+     * transports); NULL = no-op (single-process worlds need none) */
+    void (*barrier)(rlo_world *w);
     void (*free_)(rlo_world *w);
 } rlo_transport_ops;
 

@@ -278,6 +278,11 @@ static void mpi_free(rlo_world *base)
     free(w);
 }
 
+static void mpi_barrier(rlo_world *base)
+{
+    MPI_Barrier(((rlo_mpi_world *)base)->comm);
+}
+
 static const rlo_transport_ops MPI_OPS = {
     .name = "mpi",
     .isend = mpi_isend,
@@ -286,6 +291,7 @@ static const rlo_transport_ops MPI_OPS = {
     .sent_cnt = mpi_sent,
     .delivered_cnt = mpi_delivered,
     .drain = mpi_drain,
+    .barrier = mpi_barrier,
     .free_ = mpi_free,
 };
 

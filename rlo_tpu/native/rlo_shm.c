@@ -441,8 +441,14 @@ static int shm_peer_alive(const rlo_world *base, int rank,
     return now < last || now - last <= timeout_usec;
 }
 
+static void shm_barrier_op(rlo_world *base)
+{
+    shm_barrier_w((rlo_shm_world *)base);
+}
+
 static const rlo_transport_ops SHM_OPS = {
     .name = "shm",
+    .barrier = shm_barrier_op,
     .isend = shm_isend,
     .poll = shm_poll,
     .quiescent = shm_quiescent,

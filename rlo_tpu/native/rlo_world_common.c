@@ -45,6 +45,12 @@ int rlo_world_failed(const rlo_world *w)
     return w->ops->failed ? w->ops->failed(w) : 0;
 }
 
+void rlo_world_barrier(rlo_world *w)
+{
+    if (w->ops->barrier)
+        w->ops->barrier(w);
+}
+
 int rlo_world_peer_alive(const rlo_world *w, int rank,
                          uint64_t timeout_usec)
 {

@@ -1,0 +1,114 @@
+"""MPI transport executed end-to-end under femtompirun.
+
+The reference's entire L0 is live MPI point-to-point
+(/root/reference/rootless_ops.c:656 irecv, :1123/:1152/:1588 isends,
+:1613 iallreduce drain) driven by `mpirun -n N ./demo`. The image has no
+MPI install, so femtompi (rlo_tpu/native/femtompi/) provides a
+functional single-host MPI subset over shared memory plus a launcher;
+these tests run the SAME demo scenarios over the real rlo_mpi.c
+transport code paths — nonblocking isends, ANY_SOURCE/ANY_TAG probing,
+and the MPI_Iallreduce-based termination-detection drain — with real
+multi-process traffic (BASELINE config 1's run shape).
+"""
+
+import subprocess
+from pathlib import Path
+
+import pytest
+
+NATIVE = Path(__file__).resolve().parent.parent / "rlo_tpu" / "native"
+
+
+@pytest.fixture(scope="module")
+def mpi_bins():
+    subprocess.run(["make", "mpidemo"], cwd=NATIVE, check=True,
+                   capture_output=True)
+    return NATIVE / "femtompirun", NATIVE / "rlo_demo_mpi"
+
+
+def mpirun(mpi_bins, n, *args, timeout=280):
+    launcher, demo = mpi_bins
+    proc = subprocess.run(
+        [str(launcher), "-n", str(n), "-t", str(timeout - 10), str(demo),
+         *map(str, args)],
+        capture_output=True, text=True, timeout=timeout)
+    assert proc.returncode == 0, (
+        f"femtompirun failed (rc={proc.returncode})\n"
+        f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr}")
+    return proc.stdout
+
+
+@pytest.mark.parametrize("ws", [2, 4, 8])
+def test_all_cases_over_mpi(mpi_bins, ws):
+    """Every transport-agnostic scenario passes over the MPI transport
+    (fail/efail are shm-only and reported as SKIP)."""
+    out = mpirun(mpi_bins, ws, "-m", 4, "-b", 65536)
+    assert "FAIL" not in out
+    assert out.count("PASS") == 9   # 9 runnable cases incl. benches
+    assert out.count("SKIP") == 2   # fail/efail
+
+
+def test_multi2_n13_over_mpi(mpi_bins):
+    """Concurrent multi-proposal on two engines, non-power-of-2 world,
+    real processes, MPI transport."""
+    out = mpirun(mpi_bins, 13, "-c", "multi2")
+    assert "PASS" in out and "FAIL" not in out
+
+
+def test_iallreduce_drain_under_traffic(mpi_bins):
+    """The hacky-sack stress ends in the nonblocking-iallreduce drain
+    with traffic still settling — the reference's cleanup-drain shape
+    (rootless_ops.c:1613-1625)."""
+    out = mpirun(mpi_bins, 8, "-c", "hacky", "-m", 16)
+    assert "PASS" in out and "FAIL" not in out
+
+
+MPI_BACKEND_PROG = r"""
+import sys
+sys.path.insert(0, {repo!r})
+import numpy as np
+from rlo_tpu.backend import MpiBackend
+
+b = MpiBackend()
+r, ws = b.rank, b.world_size
+x = np.full((8,), float(r + 1), np.float32)
+got = b.allreduce(x)
+assert np.allclose(got, ws * (ws + 1) / 2), (r, got)
+g = b.all_gather(np.int32([r]))
+assert list(g.reshape(-1)) == list(range(ws)), (r, g)
+rs = b.reduce_scatter(np.arange(ws * 2, dtype=np.float32))
+assert np.allclose(rs, ws * np.arange(r * 2, r * 2 + 2)), (r, rs)
+assert b.consensus(my_vote=1) == 1
+d = b.consensus(my_vote=0 if r == ws - 1 else 1)
+assert d == 0, (r, d)
+b.barrier()
+if r == 0:
+    print("MPI-BACKEND-OK", ws)
+b.close()
+"""
+
+
+def test_python_mpi_backend(mpi_bins, tmp_path):
+    """The Python MpiBackend facade end-to-end: one Python process per
+    rank over femtompirun, data collectives + veto/approve consensus
+    (the bindings auto-build the femtompi-linked native core)."""
+    import sys
+    launcher, _ = mpi_bins
+    repo = str(Path(__file__).resolve().parent.parent)
+    prog = tmp_path / "prog.py"
+    prog.write_text(MPI_BACKEND_PROG.format(repo=repo))
+    proc = subprocess.run(
+        [str(launcher), "-n", "4", "-t", "240", sys.executable,
+         str(prog)],
+        capture_output=True, text=True, timeout=280)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert "MPI-BACKEND-OK 4" in proc.stdout
+
+
+def test_config1_bench_shape(mpi_bins):
+    """BASELINE config 1: fp32 allreduce, 8 MPI ranks, 1 MB buffer —
+    the engine-substrate allreduce measured over real MPI processes
+    (numeric oracle inside the case)."""
+    out = mpirun(mpi_bins, 8, "-c", "bench", "-m", 3, "-b", 1 << 20)
+    assert "PASS" in out and "FAIL" not in out
+    assert "bench[mpi]" in out
