@@ -103,8 +103,12 @@ class Backend:
     def consensus(self, votes: Sequence[int]) -> int:
         raise NotImplementedError
 
-    def allreduce(self, xs: Sequence[np.ndarray],
-                  op: str = "sum") -> List[np.ndarray]:
+    def allreduce(self, xs: Sequence[np.ndarray], op: str = "sum",
+                  algorithm: str = "auto") -> List[np.ndarray]:
+        """``algorithm`` selects a backend-specific schedule ('auto'
+        always valid): tpu = tc.allreduce's {psum, ring, bidir_ring,
+        recursive_doubling, halving_doubling}; loopback = Comm's {ring,
+        recursive_doubling}; native/mpi = {ring, bcast_gather}."""
         raise NotImplementedError
 
     def reduce_scatter(self, xs: Sequence[np.ndarray],
@@ -179,6 +183,39 @@ def _rank_chunk(full: np.ndarray, ws: int, rank: int) -> np.ndarray:
     return flat.reshape(ws, -1)[rank]
 
 
+# -- shared C-ring dispatch policy (NativeBackend + MpiBackend) -----------
+
+#: ops the C ring reduction (rlo_coll.c) implements
+_RING_OPS = ("sum", "min", "max")
+
+
+def _ring_capable(xs, op: str) -> bool:
+    return op in _RING_OPS and all(
+        np.asarray(x).dtype == np.float32 for x in xs)
+
+
+def _resolve_ring_algorithm(algorithm: str, xs, op: str) -> str:
+    """'auto' -> 'ring' when the C ring can take it, else
+    'bcast_gather'; explicit 'ring' validates capability."""
+    if algorithm == "auto":
+        return "ring" if _ring_capable(xs, op) else "bcast_gather"
+    if algorithm == "ring" and not _ring_capable(xs, op):
+        raise ValueError(
+            "the C ring reduction is float32 sum/min/max only; use "
+            "algorithm='bcast_gather'")
+    if algorithm not in ("ring", "bcast_gather"):
+        raise ValueError(f"unknown algorithm {algorithm!r}")
+    return algorithm
+
+
+def _zero_pad_tail(out: np.ndarray, lo: int, count: int) -> np.ndarray:
+    """Rewrite a ring reduce-scatter chunk's identity-padded ragged
+    tail to zeros (the facade contract zero-pads, _rank_chunk)."""
+    if lo + out.size > count:
+        out[max(0, count - lo):] = 0.0
+    return out
+
+
 @_register("tpu")
 class TpuBackend(Backend):
     """Static-schedule XLA collectives over a jax device mesh."""
@@ -230,12 +267,14 @@ class TpuBackend(Backend):
         out = self._run(("consensus",), lambda v: tc.consensus(v, "x"), xs)
         return int(out[0][0])
 
-    def allreduce(self, xs, op: str = "sum") -> List[np.ndarray]:
+    def allreduce(self, xs, op: str = "sum",
+                  algorithm: str = "auto") -> List[np.ndarray]:
         tc = self._tc
         shape = np.asarray(xs[0]).shape
         dt = str(np.asarray(xs[0]).dtype)
-        return self._run(("allreduce", op, shape, dt),
-                         lambda v: tc.allreduce(v, "x", op=op), xs)
+        return self._run(("allreduce", op, algorithm, shape, dt),
+                         lambda v: tc.allreduce(v, "x", op=op,
+                                                algorithm=algorithm), xs)
 
     def reduce_scatter(self, xs, op: str = "sum") -> List[np.ndarray]:
         # v arrives as this shard's (1, ...) slice of the stacked input;
@@ -343,8 +382,10 @@ class LoopbackBackend(Backend):
                  for c, x in zip(self._comms, xs)]
         return self._run(coros)
 
-    def allreduce(self, xs, op: str = "sum") -> List[np.ndarray]:
-        return self._collective("allreduce", xs, op=op)
+    def allreduce(self, xs, op: str = "sum",
+                  algorithm: str = "auto") -> List[np.ndarray]:
+        return self._collective("allreduce", xs, op=op,
+                                algorithm=algorithm)
 
     def reduce_scatter(self, xs, op: str = "sum") -> List[np.ndarray]:
         return self._collective("reduce_scatter", xs, op=op)
@@ -371,23 +412,37 @@ class LoopbackBackend(Backend):
 
 @_register("native")
 class NativeBackend(Backend):
-    """The C core through ctypes. Data collectives run bcast-gather over
-    the rootless broadcast overlay: every rank broadcasts its tensor and
+    """The C core through ctypes. Data collectives default to the C
+    ring schedules (rlo_coll.c: ring reduce-scatter/all-gather
+    allreduce, rotation all-to-all — 2*(ws-1) rounds of 1/ws chunks,
+    the bandwidth-optimal shape) and fall back to bcast-gather over the
+    rootless broadcast overlay (every rank broadcasts its tensor and
     reduces what it picks up — the reference's any-rank-initiates
-    "IAllReduce" notion (rootless_ops.c:876) generalized from one vote
-    bit to tensors."""
+    "IAllReduce" notion, rootless_ops.c:876, generalized to tensors;
+    O(ws^2) bytes, kept for non-f32 reductions and as the comparison
+    baseline)."""
 
     name = "native"
 
+    #: transport comm id for the coll layer (engines use comm 0)
+    COLL_COMM = 64
+
     def __init__(self, world_size: Optional[int] = None, latency: int = 0,
                  seed: int = 1, msg_size_max: int = 1 << 22, **kwargs):
-        from rlo_tpu.native.bindings import NativeWorld, NativeEngine
+        from rlo_tpu.native.bindings import (NativeColl, NativeEngine,
+                                             NativeWorld)
 
         self.world_size = world_size or 4
         self.world = NativeWorld(self.world_size, latency, seed)
         self.engines = [NativeEngine(self.world, r,
                                      msg_size_max=msg_size_max)
                         for r in range(self.world_size)]
+        self.colls = [NativeColl(self.world, r, comm=self.COLL_COMM)
+                      for r in range(self.world_size)]
+
+    def _run_colls(self, starts):
+        from rlo_tpu.native.bindings import run_colls
+        return run_colls(self.colls, starts)
 
     def bcast(self, origin: int, x: np.ndarray) -> List[np.ndarray]:
         return self._engine_bcast(self.engines, self.world.drain,
@@ -425,7 +480,16 @@ class NativeBackend(Backend):
             out.append(got)
         return out
 
-    def allreduce(self, xs, op: str = "sum") -> List[np.ndarray]:
+    def allreduce(self, xs, op: str = "sum",
+                  algorithm: str = "auto") -> List[np.ndarray]:
+        xs = self._check_xs(xs)
+        algorithm = _resolve_ring_algorithm(algorithm, xs, op)
+        if algorithm == "ring":
+            shape = xs[0].shape
+            outs = self._run_colls(
+                [lambda r=r: self.colls[r].allreduce_start(xs[r], op)
+                 for r in range(self.world_size)])
+            return [np.asarray(o).reshape(shape) for o in outs]
         from rlo_tpu.ops.collectives import OPS
         fn = OPS[op]
         gathered = self._bcast_gather(xs)
@@ -438,25 +502,71 @@ class NativeBackend(Backend):
         return outs
 
     def reduce_scatter(self, xs, op: str = "sum") -> List[np.ndarray]:
-        full = self.allreduce(xs, op=op)
+        xs = self._check_xs(xs)
+        if _ring_capable(xs, op):
+            # C ring reduce-scatter; its ragged tail is identity-padded
+            # for reduction correctness — rewritten to zeros to match
+            # the facade contract (_rank_chunk zero-pads)
+            count = xs[0].size
+            outs = self._run_colls(
+                [lambda r=r: self.colls[r].reduce_scatter_start(
+                    xs[r].reshape(-1), op)
+                 for r in range(self.world_size)])
+            outs = [np.asarray(o) for o in outs]
+            chunk = outs[0].size
+            return [_zero_pad_tail(outs[r], r * chunk, count)
+                    for r in range(self.world_size)]
+        full = self.allreduce(xs, op=op, algorithm="bcast_gather")
         return [_rank_chunk(full[r], self.world_size, r)
                 for r in range(self.world_size)]
 
     def all_gather(self, xs) -> List[np.ndarray]:
+        from rlo_tpu.ops.collectives import _pack_array, _unpack_array
+        xs = self._check_xs(xs)
+        packed = [_pack_array(x) for x in xs]
+        if len({len(b) for b in packed}) == 1:
+            outs = self._run_colls(
+                [lambda r=r: self.colls[r].all_gather_start(packed[r])
+                 for r in range(self.world_size)])
+            n = len(packed[0])
+            out = []
+            for o in outs:
+                raw = np.asarray(o).tobytes()
+                out.append(np.stack([
+                    _unpack_array(raw[i * n:(i + 1) * n])
+                    for i in range(self.world_size)]))
+            return out
         gathered = self._bcast_gather(xs)
         return [np.stack(got) for got in gathered]
 
     def all_to_all(self, xss) -> List[List[np.ndarray]]:
+        from rlo_tpu.ops.collectives import _pack_array, _unpack_array
         ws = self.world_size
-        rows = [np.stack(row) for row in self._check_xss(xss)]
+        xss = self._check_xss(xss)
+        packed = [[_pack_array(np.asarray(x)) for x in row]
+                  for row in xss]
+        sizes = {len(b) for row in packed for b in row}
+        if len(sizes) == 1:
+            n = sizes.pop()
+            outs = self._run_colls(
+                [lambda r=r: self.colls[r].all_to_all_start(packed[r])
+                 for r in range(ws)])
+            return [[_unpack_array(np.asarray(o).tobytes()
+                                   [src * n:(src + 1) * n])
+                     for src in range(ws)] for o in outs]
+        rows = [np.stack(row) for row in xss]
         gathered = self._bcast_gather(rows)
         return [[gathered[r][src][r] for src in range(ws)]
                 for r in range(ws)]
 
     def barrier(self) -> None:
+        self._run_colls([self.colls[r].barrier_start
+                         for r in range(self.world_size)])
         self.world.drain()
 
     def close(self) -> None:
+        for c in self.colls:
+            c.close()
         self.world.close()
 
 
@@ -516,6 +626,9 @@ class MpiBackend(Backend):
         self.engine = NativeEngine(
             self.world, self.rank, msg_size_max=1 << 22,
             judge_cb=lambda payload, ctx: self._my_vote)
+        from rlo_tpu.native.bindings import NativeColl
+        self.coll = NativeColl(self.world, self.rank,
+                               comm=NativeBackend.COLL_COMM)
 
     def _spin_pickup(self, want: int, max_spins: int = 200_000_000):
         """Progress until `want` messages are picked up; returns them."""
@@ -567,10 +680,14 @@ class MpiBackend(Backend):
         self.world.drain()
         return int(msg.vote)
 
-    def allreduce(self, x: np.ndarray, op: str = "sum") -> np.ndarray:
+    def allreduce(self, x: np.ndarray, op: str = "sum",
+                  algorithm: str = "auto") -> np.ndarray:
+        x = np.asarray(x)
+        algorithm = _resolve_ring_algorithm(algorithm, [x], op)
+        if algorithm == "ring":
+            return self.coll.allreduce(x, op)
         from rlo_tpu.ops.collectives import (OPS, _pack_array,
                                              _unpack_array)
-        x = np.asarray(x)
         self.engine.bcast(_pack_array(x))
         msgs = self._spin_pickup(self.world_size - 1)
         self.world.drain()
@@ -582,30 +699,36 @@ class MpiBackend(Backend):
     def all_gather(self, x: np.ndarray) -> np.ndarray:
         from rlo_tpu.ops.collectives import _pack_array, _unpack_array
         x = np.asarray(x)
-        self.engine.bcast(_pack_array(x))
-        msgs = self._spin_pickup(self.world_size - 1)
-        self.world.drain()
-        parts = [None] * self.world_size
-        parts[self.rank] = x
-        for m in msgs:
-            parts[m.origin] = _unpack_array(m.data)
-        return np.stack(parts)
+        packed = _pack_array(x)
+        parts_raw = self.coll.all_gather(packed)
+        return np.stack([_unpack_array(raw) for raw in parts_raw])
 
     def reduce_scatter(self, x: np.ndarray, op: str = "sum") -> np.ndarray:
+        x = np.asarray(x)
+        if _ring_capable([x], op):
+            out = np.asarray(self.coll.reduce_scatter(x.reshape(-1), op))
+            return _zero_pad_tail(out, self.rank * out.size, x.size)
         full = self.allreduce(x, op=op)
         return _rank_chunk(full, self.world_size, self.rank)
 
     def all_to_all(self, xs: Sequence[np.ndarray]) -> List[np.ndarray]:
         """Per-rank form: ``xs[d]`` is THIS rank's chunk for rank d;
-        returns the chunks received, indexed by source — an all_gather
-        of the chunk rows, keeping each source's chunk for me."""
+        returns the chunks received, indexed by source (the C rotation
+        all-to-all, ws-1 rounds — not the old all_gather of full rows)."""
+        from rlo_tpu.ops.collectives import _pack_array, _unpack_array
+        packed = [_pack_array(np.asarray(x)) for x in
+                  self._check_xs(xs)]
+        if len({len(b) for b in packed}) == 1:
+            return [_unpack_array(raw)
+                    for raw in self.coll.all_to_all(packed)]
         row = np.stack(self._check_xs(xs))
-        gathered = self.all_gather(row)  # (src, dst, ...)
-        return [gathered[src][self.rank]
-                for src in range(self.world_size)]
+        gathered_raw = self.coll.all_gather(_pack_array(row))
+        return [_unpack_array(raw)[self.rank] for raw in gathered_raw]
 
     def barrier(self) -> None:
+        self.coll.barrier()
         self.world.drain()
 
     def close(self) -> None:
+        self.coll.close()
         self.world.close()

@@ -12,6 +12,8 @@ import ctypes as C
 from dataclasses import dataclass
 from typing import Callable, List, Optional
 
+import numpy as np
+
 from rlo_tpu.native.build import build
 
 # error codes (rlo_core.h enum rlo_err; -1 is the "nothing yet" sentinel)
@@ -126,6 +128,20 @@ def load() -> C.CDLL:
          C.POINTER(C.c_int), C.POINTER(C.POINTER(C.c_uint8))])
     sig("rlo_pickup_consume", C.c_int, [p])
     sig("rlo_bench_allreduce", C.c_double, [C.c_int, C.c_int64, C.c_int])
+    sig("rlo_bench_allreduce_ring", C.c_double,
+        [C.c_int, C.c_int64, C.c_int])
+    sig("rlo_coll_new", p, [p, C.c_int, C.c_int])
+    sig("rlo_coll_free", None, [p])
+    fp = C.POINTER(C.c_float)
+    sig("rlo_coll_allreduce_f32_start", C.c_int,
+        [p, fp, C.c_int64, C.c_int])
+    sig("rlo_coll_reduce_scatter_f32_start", C.c_int,
+        [p, fp, C.c_int64, fp, C.c_int])
+    sig("rlo_coll_all_gather_start", C.c_int, [p, u8p, C.c_int64, u8p])
+    sig("rlo_coll_all_to_all_start", C.c_int, [p, u8p, C.c_int64, u8p])
+    sig("rlo_coll_barrier_start", C.c_int, [p])
+    sig("rlo_coll_poll", C.c_int, [p])
+    sig("rlo_coll_wait", C.c_int, [p, C.c_long])
     sig("rlo_engine_idle", C.c_int, [p])
     sig("rlo_engine_err", C.c_int, [p])
     sig("rlo_engine_total_pickup", C.c_int64, [p])
@@ -218,6 +234,160 @@ class NativeWorld:
 
     def __exit__(self, *exc):
         self.close()
+
+
+_COLL_OPS = {"sum": 0, "min": 1, "max": 2}
+
+
+class NativeColl:
+    """Engine-substrate ring data collectives (rlo_coll.c) — the C
+    mirror of rlo_tpu/ops/collectives.py's coroutine Comm. Each op is a
+    start/poll state machine; `blocking=True` helpers spin to
+    completion (one-process-per-rank worlds), while in-process drivers
+    round-robin `poll()` across ranks like run_collectives()."""
+
+    MAX_SPINS = 200_000_000
+
+    def __init__(self, world: "NativeWorld", rank: int, comm: int = 64):
+        self._lib = world._lib
+        self.world = world
+        self.rank = rank
+        self.comm = comm  # must differ from every engine comm
+        self._c = self._lib.rlo_coll_new(world._w, rank, comm)
+        if not self._c:
+            raise ValueError(f"bad rank {rank} for this world")
+        self._keep = None  # buffers pinned while an op is in flight
+
+    def close(self) -> None:
+        if self._c:
+            self._lib.rlo_coll_free(self._c)
+            self._c = None
+
+    def __del__(self):  # pragma: no cover
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def poll(self) -> int:
+        """1 done, 0 in progress (<0 raises)."""
+        rc = self._lib.rlo_coll_poll(self._c)
+        if rc < 0 and rc != -1:  # -1 RLO_ERR_ARG = nothing armed
+            raise RuntimeError(f"coll poll failed ({rc})")
+        return rc
+
+    def _wait(self):
+        rc = self._lib.rlo_coll_wait(self._c, self.MAX_SPINS)
+        if rc != 0:
+            raise RuntimeError(f"collective did not complete ({rc})")
+
+    # -- fp32 ring ops -------------------------------------------------
+    def allreduce_start(self, x: "np.ndarray", op: str = "sum"):
+        """Arm an in-place ring allreduce; returns the output array
+        (filled when poll() reports done)."""
+        buf = np.ascontiguousarray(x, np.float32).reshape(-1).copy()
+        rc = self._lib.rlo_coll_allreduce_f32_start(
+            self._c, buf.ctypes.data_as(C.POINTER(C.c_float)), buf.size,
+            _COLL_OPS[op])
+        if rc != 0:
+            raise RuntimeError(f"allreduce start failed ({rc})")
+        self._keep = (buf,)
+        return buf
+
+    def allreduce(self, x: "np.ndarray", op: str = "sum"):
+        out = self.allreduce_start(x, op)
+        self._wait()
+        return out.reshape(np.asarray(x).shape)
+
+    def reduce_scatter_start(self, x: "np.ndarray", op: str = "sum"):
+        buf = np.ascontiguousarray(x, np.float32).reshape(-1).copy()
+        ws = self.world.world_size
+        chunk = -(-buf.size // ws)
+        out = np.empty(chunk, np.float32)
+        rc = self._lib.rlo_coll_reduce_scatter_f32_start(
+            self._c, buf.ctypes.data_as(C.POINTER(C.c_float)), buf.size,
+            out.ctypes.data_as(C.POINTER(C.c_float)), _COLL_OPS[op])
+        if rc != 0:
+            raise RuntimeError(f"reduce_scatter start failed ({rc})")
+        self._keep = (buf, out)
+        return out
+
+    def reduce_scatter(self, x, op: str = "sum"):
+        out = self.reduce_scatter_start(x, op)
+        self._wait()
+        return out
+
+    # -- byte ops ------------------------------------------------------
+    def all_gather_start(self, data: bytes):
+        ws = self.world.world_size
+        src = np.frombuffer(bytes(data), np.uint8).copy()
+        out = np.empty(ws * len(data), np.uint8)
+        rc = self._lib.rlo_coll_all_gather_start(
+            self._c, src.ctypes.data_as(C.POINTER(C.c_uint8)), len(data),
+            out.ctypes.data_as(C.POINTER(C.c_uint8)))
+        if rc != 0:
+            raise RuntimeError(f"all_gather start failed ({rc})")
+        self._keep = (src, out)
+        return out
+
+    def all_gather(self, data: bytes):
+        """Returns [bytes per rank]."""
+        out = self.all_gather_start(data)
+        self._wait()
+        n = len(out) // self.world.world_size
+        raw = out.tobytes()
+        return [raw[i * n:(i + 1) * n]
+                for i in range(self.world.world_size)]
+
+    def all_to_all_start(self, chunks):
+        ws = self.world.world_size
+        if len(chunks) != ws:
+            raise ValueError(f"need {ws} chunks, got {len(chunks)}")
+        n = len(chunks[0])
+        if any(len(ch) != n for ch in chunks):
+            raise ValueError("all chunks must be equal-sized")
+        src = np.frombuffer(b"".join(bytes(ch) for ch in chunks),
+                            np.uint8).copy()
+        out = np.empty(ws * n, np.uint8)
+        rc = self._lib.rlo_coll_all_to_all_start(
+            self._c, src.ctypes.data_as(C.POINTER(C.c_uint8)), n,
+            out.ctypes.data_as(C.POINTER(C.c_uint8)))
+        if rc != 0:
+            raise RuntimeError(f"all_to_all start failed ({rc})")
+        self._keep = (src, out)
+        return out
+
+    def all_to_all(self, chunks):
+        out = self.all_to_all_start(chunks)
+        self._wait()
+        ws = self.world.world_size
+        n = len(out) // ws
+        raw = out.tobytes()
+        return [raw[i * n:(i + 1) * n] for i in range(ws)]
+
+    def barrier_start(self):
+        rc = self._lib.rlo_coll_barrier_start(self._c)
+        if rc != 0:
+            raise RuntimeError(f"barrier start failed ({rc})")
+
+    def barrier(self):
+        self.barrier_start()
+        self._wait()
+
+
+def run_colls(colls, starts, max_spins: int = 10_000_000):
+    """Round-robin driver for in-process worlds: `starts[i]()` arms
+    rank i's op, then every coll is polled until all complete — the C
+    mirror of collectives.run_collectives()."""
+    outs = [start() for start in starts]
+    alive = set(range(len(colls)))
+    for _ in range(max_spins):
+        for i in list(alive):
+            if colls[i].poll() == 1:
+                alive.discard(i)
+        if not alive:
+            return outs
+    raise RuntimeError("collective did not complete (deadlock?)")
 
 
 class NativeEngine:
@@ -476,6 +646,17 @@ def bench_allreduce(world_size: int, count: int, reps: int = 5) -> float:
     rc = load().rlo_bench_allreduce(world_size, count, reps)
     if rc < 0:
         raise RuntimeError(f"native bench failed ({int(rc)})")
+    return float(rc)
+
+
+def bench_allreduce_ring(world_size: int, count: int,
+                         reps: int = 5) -> float:
+    """Median usec per wholly-native RING fp32 allreduce (rlo_coll.c
+    state machines round-robined in C) — the bandwidth-optimal
+    comparison line against bench_allreduce's bcast-gather."""
+    rc = load().rlo_bench_allreduce_ring(world_size, count, reps)
+    if rc < 0:
+        raise RuntimeError(f"native ring bench failed ({int(rc)})")
     return float(rc)
 
 

@@ -15,7 +15,7 @@ from pathlib import Path
 _DIR = Path(__file__).resolve().parent
 SOURCES = ["rlo_topology.c", "rlo_wire.c", "rlo_trace.c",
            "rlo_world_common.c", "rlo_loopback.c", "rlo_shm.c",
-           "rlo_mpi.c", "rlo_engine.c", "rlo_bench.c"]
+           "rlo_mpi.c", "rlo_engine.c", "rlo_coll.c", "rlo_bench.c"]
 HEADERS = ["rlo_core.h", "rlo_internal.h"]
 LIB_NAME = "librlo_core.so"
 #: femtompi-linked variant: the MPI transport is live, rendezvous via

@@ -304,6 +304,41 @@ int64_t rlo_engine_recved_bcast(const rlo_engine *e);
 int rlo_drain(rlo_world *w, int max_spins);
 
 /* ------------------------------------------------------------------ */
+/* Engine-substrate ring data collectives (rlo_coll.c) — the C mirror  */
+/* of rlo_tpu/ops/collectives.py: ring reduce-scatter/all-gather       */
+/* allreduce, rotation all-to-all, dissemination barrier, over the     */
+/* same transport vtable. Explicit state machines: `*_start` arms an   */
+/* op, rlo_coll_poll advances one slice (1 = done, 0 = in progress,    */
+/* <0 = error). One op may be armed per coll at a time; every rank     */
+/* must issue collectives in the same order. The coll's `comm` id      */
+/* must differ from every engine comm on the same world.               */
+/* ------------------------------------------------------------------ */
+typedef struct rlo_coll rlo_coll;
+
+enum rlo_coll_op { RLO_COLL_SUM = 0, RLO_COLL_MIN = 1, RLO_COLL_MAX = 2 };
+
+rlo_coll *rlo_coll_new(rlo_world *w, int rank, int comm);
+void rlo_coll_free(rlo_coll *c);
+/* in-place ring allreduce of count floats */
+int rlo_coll_allreduce_f32_start(rlo_coll *c, float *data, int64_t count,
+                                 int op);
+/* rank r receives the r-th of ws equal chunks (identity-padded);
+ * out must hold ceil(count/ws) floats */
+int rlo_coll_reduce_scatter_f32_start(rlo_coll *c, const float *data,
+                                      int64_t count, float *out, int op);
+/* out must hold ws*len bytes; slot r = rank r's data */
+int rlo_coll_all_gather_start(rlo_coll *c, const uint8_t *data,
+                              int64_t len, uint8_t *out);
+/* data/out are ws slots of len_per_rank bytes; out slot s = the chunk
+ * rank s addressed to this rank */
+int rlo_coll_all_to_all_start(rlo_coll *c, const uint8_t *data,
+                              int64_t len_per_rank, uint8_t *out);
+int rlo_coll_barrier_start(rlo_coll *c);
+int rlo_coll_poll(rlo_coll *c);
+/* spin poll to completion — one-process-per-rank transports only */
+int rlo_coll_wait(rlo_coll *c, long max_spins);
+
+/* ------------------------------------------------------------------ */
 /* Timing utils (reference RLO_get_time_usec, rootless_ops.c:128-132).  */
 /* ------------------------------------------------------------------ */
 uint64_t rlo_now_usec(void);

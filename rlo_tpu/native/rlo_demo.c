@@ -452,10 +452,40 @@ static int case_bench(rlo_world *w, int rank, void *vcfg)
                 times[j] = t;
             }
     if (rank == 0)
-        printf("bench[%s]: engine allreduce %lld B x %d ranks: median "
-               "%.0f usec\n",
+        printf("bench[%s]: engine allreduce (bcast-gather) %lld B x %d "
+               "ranks: median %.0f usec\n",
                rlo_world_transport(w), (long long)nbytes, ws,
                times[reps / 2]);
+
+    /* ring allreduce over the same transport (rlo_coll.c) — the
+     * bandwidth-optimal schedule, one real process per rank */
+    rlo_coll *coll = rlo_coll_new(w, rank, 64);
+    RCHECK(coll);
+    for (int rep = 0; rep < reps; rep++) {
+        for (int64_t i = 0; i < count; i++)
+            buf[i] = (float)((rank + 1) * ((i % 13) + 1));
+        rlo_world_barrier(w);
+        uint64_t t0 = rlo_now_usec();
+        RCHECK(rlo_coll_allreduce_f32_start(coll, buf, count,
+                                            RLO_COLL_SUM) == RLO_OK);
+        RCHECK(rlo_coll_wait(coll, 2000000000L) == RLO_OK);
+        times[rep] = (double)(rlo_now_usec() - t0);
+        RCHECK(buf[0] == (float)(ws * (ws + 1) / 2));
+        rlo_world_barrier(w);
+    }
+    for (int i = 0; i < reps; i++)
+        for (int j = i + 1; j < reps; j++)
+            if (times[j] < times[i]) {
+                double t = times[i];
+                times[i] = times[j];
+                times[j] = t;
+            }
+    if (rank == 0)
+        printf("bench[%s]: ring allreduce (rlo_coll) %lld B x %d ranks: "
+               "median %.0f usec\n",
+               rlo_world_transport(w), (long long)nbytes, ws,
+               times[reps / 2]);
+    rlo_coll_free(coll);
     free(buf);
     free(acc);
     free(times);

@@ -16,6 +16,11 @@ import pytest
 SUITE = Path(__file__).resolve().parent.parent / "benchmarks" / "suite.py"
 
 
+#: configs that emit several comparison lines (ring vs bcast-gather +
+#: the MPI_Bcast leg for 1; the TPU device leg for 5 when a chip is up)
+MULTI_LINE = {1: (2, 3), 5: (1, 2)}
+
+
 @pytest.mark.parametrize("config", [1, 2, 3, 4, 5])
 def test_config_emits_json_line(config):
     proc = subprocess.run(
@@ -23,11 +28,14 @@ def test_config_emits_json_line(config):
         capture_output=True, text=True, timeout=600)
     assert proc.returncode == 0, proc.stderr
     lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
-    assert len(lines) == 1, proc.stdout
-    rec = json.loads(lines[0])
-    assert rec["config"] == config
-    assert set(rec) >= {"config", "metric", "value", "unit", "vs_baseline"}
-    assert rec["value"] > 0 and rec["vs_baseline"] > 0
+    lo, hi = MULTI_LINE.get(config, (1, 1))
+    assert lo <= len(lines) <= hi, proc.stdout
+    for ln in lines:
+        rec = json.loads(ln)
+        assert rec["config"] == config
+        assert set(rec) >= {"config", "metric", "value", "unit",
+                            "vs_baseline"}
+        assert rec["value"] > 0 and rec["vs_baseline"] > 0
 
 
 def test_native_bench_allreduce_correctness_gate():

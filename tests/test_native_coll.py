@@ -1,0 +1,127 @@
+"""C engine-substrate ring collectives (rlo_coll.c) — numerics parity
+with the Python coroutine Comm (rlo_tpu/ops/collectives.py) and with
+numpy oracles, driven in-process round-robin exactly like
+run_collectives(). The ring replaces the O(ws^2) bcast-gather fallback
+in the Native/Mpi backend facades (the multi-process legs are covered
+by tests/test_mpi_transport.py and the demo bench case)."""
+
+import numpy as np
+import pytest
+
+from rlo_tpu.native.bindings import NativeColl, NativeWorld, run_colls
+
+WORLD_SIZES = [2, 3, 5, 8, 13]
+
+
+@pytest.fixture(params=WORLD_SIZES)
+def world_colls(request):
+    ws = request.param
+    w = NativeWorld(ws)
+    colls = [NativeColl(w, r) for r in range(ws)]
+    yield ws, colls
+    for c in colls:
+        c.close()
+    w.close()
+
+
+class TestRingAllreduce:
+    @pytest.mark.parametrize("op,npfn", [("sum", np.add),
+                                         ("min", np.minimum),
+                                         ("max", np.maximum)])
+    def test_matches_numpy(self, world_colls, op, npfn):
+        ws, colls = world_colls
+        rng = np.random.default_rng(ws)
+        xs = [rng.standard_normal(37).astype(np.float32)
+              for _ in range(ws)]
+        outs = run_colls(colls, [
+            lambda r=r: colls[r].allreduce_start(xs[r], op)
+            for r in range(ws)])
+        want = xs[0]
+        for x in xs[1:]:
+            want = npfn(want, x)
+        for o in outs:
+            np.testing.assert_allclose(np.asarray(o), want, rtol=1e-5)
+
+    def test_matches_python_comm(self, world_colls):
+        """Same payloads through the C ring and the Python coroutine
+        ring must agree to float32 association-order tolerance."""
+        from rlo_tpu.ops.collectives import Comm, run_collectives
+        from rlo_tpu.transport.loopback import LoopbackWorld
+
+        ws, colls = world_colls
+        rng = np.random.default_rng(ws + 100)
+        xs = [rng.standard_normal(64).astype(np.float32)
+              for _ in range(ws)]
+        c_outs = run_colls(colls, [
+            lambda r=r: colls[r].allreduce_start(xs[r], "sum")
+            for r in range(ws)])
+        world = LoopbackWorld(ws)
+        comms = [Comm(world.transport(r)) for r in range(ws)]
+        py_outs = run_collectives(
+            [c.allreduce(xs[r], algorithm="ring")
+             for r, c in enumerate(comms)])
+        for co, po in zip(c_outs, py_outs):
+            np.testing.assert_allclose(np.asarray(co), po, rtol=1e-5)
+
+
+class TestRingPieces:
+    def test_reduce_scatter_chunks_reassemble(self, world_colls):
+        ws, colls = world_colls
+        rng = np.random.default_rng(ws + 7)
+        xs = [rng.standard_normal(41).astype(np.float32)  # ragged
+              for _ in range(ws)]
+        outs = run_colls(colls, [
+            lambda r=r: colls[r].reduce_scatter_start(xs[r], "sum")
+            for r in range(ws)])
+        full = np.concatenate([np.asarray(o) for o in outs])[:41]
+        np.testing.assert_allclose(full, np.sum(xs, axis=0), rtol=1e-5)
+
+    def test_all_gather(self, world_colls):
+        ws, colls = world_colls
+        blobs = [bytes([r]) * 5 for r in range(ws)]
+        outs = run_colls(colls, [
+            lambda r=r: colls[r].all_gather_start(blobs[r])
+            for r in range(ws)])
+        want = b"".join(blobs)
+        for o in outs:
+            assert o.tobytes() == want
+
+    def test_all_to_all_transpose(self, world_colls):
+        ws, colls = world_colls
+        grid = [[bytes([16 * s + d, s ^ d]) for d in range(ws)]
+                for s in range(ws)]
+        outs = run_colls(colls, [
+            lambda r=r: colls[r].all_to_all_start(grid[r])
+            for r in range(ws)])
+        for d in range(ws):
+            want = b"".join(grid[s][d] for s in range(ws))
+            assert outs[d].tobytes() == want, d
+
+    def test_barrier_completes(self, world_colls):
+        ws, colls = world_colls
+        run_colls(colls, [colls[r].barrier_start for r in range(ws)])
+
+    def test_busy_coll_rejects_second_op(self, world_colls):
+        ws, colls = world_colls
+        x = np.ones(4, np.float32)
+        colls[0].allreduce_start(x)
+        with pytest.raises(RuntimeError):
+            colls[0].allreduce_start(x)
+        # complete the round: rank 0 is already armed, arm the rest
+        run_colls(colls, [lambda: None] + [
+            lambda r=r: colls[r].allreduce_start(x)
+            for r in range(1, ws)])
+
+    def test_sequential_ops_reuse_coll(self, world_colls):
+        """Back-to-back collectives on the same coll objects (fresh
+        opids per phase) must not cross-match."""
+        ws, colls = world_colls
+        for k in range(3):
+            xs = [np.full(8, float(r + 1 + k), np.float32)
+                  for r in range(ws)]
+            outs = run_colls(colls, [
+                lambda r=r: colls[r].allreduce_start(xs[r])
+                for r in range(ws)])
+            want = sum(range(1 + k, ws + 1 + k))
+            for o in outs:
+                np.testing.assert_allclose(np.asarray(o), want)
