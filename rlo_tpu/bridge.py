@@ -82,22 +82,45 @@ class HybridBackend(Backend):
         self._tpu.barrier()
 
     # ---- the bridge ----
+    def _device_votes(self, xs, device_judge) -> np.ndarray:
+        """Per-rank verdicts computed on DEVICE from each shard's own
+        slice of the stacked tensors (TpuConsensus.shard_votes): rank
+        r's vote comes from the device memory holding xs[r], not from
+        host copies — the device-side analogue of every rank judging
+        its local state (rootless_ops.c:698)."""
+        from rlo_tpu.parallel.consensus import TpuConsensus
+
+        if not hasattr(self, "_consensus"):
+            self._consensus = TpuConsensus(self._tpu.mesh, "x")
+        stacked = np.stack(xs)
+        return self._consensus.shard_votes(
+            stacked, lambda v: device_judge(v[0]),
+            key=id(device_judge)).reshape(-1)
+
     def propose_collective(self, op: str, xs: Sequence[np.ndarray],
-                           proposer: int = 0, reduce_op: str = "sum"):
+                           proposer: int = 0, reduce_op: str = "sum",
+                           device_judge=None):
         """Leaderless-consensus-gated collective.
 
         Rank ``proposer`` proposes running collective ``op`` on the
         per-rank tensors ``xs``; every rank's judgement callback
         validates the proposal descriptor against its own tensor (shape
         and dtype must agree — the collective would be malformed
-        otherwise). The AND-merged decision gates the device work:
+        otherwise). When ``device_judge`` is given (a jittable
+        per-shard predicate ``local_tensor -> {0,1}``), each rank's
+        vote additionally requires its own DEVICE shard to pass — the
+        verdicts are computed inside shard_map from device-resident
+        data and fed into the C vote tree, so a shard whose device
+        tensor disagrees vetoes (e.g. non-finite gradients on one
+        chip). The AND-merged decision gates the device work.
 
         Returns (decision, results): decision 1 and the per-rank outputs
         on approval; decision 0 and None when any rank vetoed.
 
         ~RLO_submit_proposal + prop_judgement_cb + proposal_action
         (rootless_ops.c:876, :698, :842), with the action generalized
-        from a host callback to the TPU data plane.
+        from a host callback to the TPU data plane and the judgement
+        generalized to per-device state.
         """
         from rlo_tpu.native.bindings import run_judged_proposal
 
@@ -108,13 +131,22 @@ class HybridBackend(Backend):
                              f"[0, {self.world_size})")
         xs = self._check_xs(xs)
         payload = _describe(op, reduce_op, [xs[proposer]])
+        # structural validation first, on the host: a shape/dtype
+        # mismatch vetoes before ANY device time is spent (and before
+        # np.stack below, which needs uniform shapes)
+        want = json.loads(payload.decode())
+        structural = [1 if (want["shape"] == list(x.shape)
+                            and want["dtype"] == str(x.dtype)) else 0
+                      for x in xs]
+        dev_votes = None
+        if device_judge is not None and all(structural):
+            dev_votes = self._device_votes(xs, device_judge)
 
         def judge_for(rank: int):
             def judge(prop: bytes, _ctx) -> int:
-                want = json.loads(prop.decode())
-                x = xs[rank]
-                ok = (want["shape"] == list(x.shape)
-                      and want["dtype"] == str(x.dtype))
+                ok = bool(structural[rank])
+                if ok and dev_votes is not None:
+                    ok = bool(dev_votes[rank])
                 return 1 if ok else 0
             return judge
 

@@ -45,6 +45,7 @@ class TpuConsensus:
         self.app_ctx = app_ctx
         self.action_cb = action_cb
         self.axis_size = mesh.shape[axis]
+        self._sharded_cache: dict = {}
         self._decide = jax.jit(jax.shard_map(
             lambda v: tpu_collectives.consensus(v, axis),
             mesh=mesh, in_specs=P(axis), out_specs=P(axis)))
@@ -57,7 +58,8 @@ class TpuConsensus:
 
     def submit(self, proposal: bytes) -> int:
         """Full propose/judge/decide/act round; returns 1 approved, 0
-        declined."""
+        declined. The single controller judges once and replicates its
+        vote — use submit_sharded for genuinely per-shard judgment."""
         my_vote = 1 if self.judge_cb is None else \
             int(self.judge_cb(proposal, self.app_ctx))
         votes = np.full((self.axis_size,), my_vote, np.int32)
@@ -65,3 +67,82 @@ class TpuConsensus:
         if decision and self.action_cb is not None:
             self.action_cb(proposal, self.app_ctx)
         return decision
+
+    # -- per-shard judgment (the reference's essence: EVERY rank judges
+    # its own local state, rootless_ops.c:698 — not one controller
+    # replicating its vote) ---------------------------------------------
+
+    def _sharded_decide(self, device_judge, key):
+        if key not in self._sharded_cache:
+            axis = self.axis
+
+            def step(v):
+                vote = jnp.asarray(device_judge(v), jnp.int32).reshape(1)
+                return tpu_collectives.consensus(vote, axis)
+            # pin the judge alongside the program: the key carries the
+            # judge's id(), and pinning prevents id reuse after GC
+            self._sharded_cache[key] = (device_judge, jax.jit(
+                jax.shard_map(step, mesh=self.mesh, in_specs=P(self.axis),
+                              out_specs=P(self.axis))))
+        return self._sharded_cache[key][1]
+
+    def shard_votes(self, x, device_judge, key=None):
+        """Every shard's OWN verdict on its slice of ``x``, computed on
+        device inside shard_map — no reduction. Returns an int32 array
+        of axis_size votes (feed these into an engine-substrate vote
+        tree, e.g. the hybrid bridge's C IAR round). ``key`` names a
+        stable cache identity for closures recreated per call; the
+        judge's id() is always part of the key, so a different judge
+        can never hit a stale compiled program."""
+        axis = self.axis
+        key = ("votes", key, id(device_judge),
+               np.asarray(x).shape, str(np.asarray(x).dtype))
+        if key not in self._sharded_cache:
+            def step(v):
+                return jnp.asarray(device_judge(v),
+                                   jnp.int32).reshape(1)
+            self._sharded_cache[key] = (device_judge, jax.jit(
+                jax.shard_map(step, mesh=self.mesh, in_specs=P(axis),
+                              out_specs=P(axis))))
+        return np.asarray(self._sharded_cache[key][1](x))
+
+    def submit_sharded(self, proposal: bytes, x, device_judge,
+                       key=None) -> int:
+        """Consensus where every shard judges ITS OWN device-resident
+        slice: ``device_judge(local_shard) -> {0,1}`` is traced per
+        shard inside shard_map, the votes pmin-merge on device (one
+        fused program: judge + vote tree), and the replicated decision
+        returns to the host. The host-side judge_cb (the controller's
+        own structural vote) ANDs in; action_cb fires on approval.
+
+        A shard whose device data fails the predicate vetoes the round
+        even though a single controller process drives the mesh — the
+        device-side analogue of rootless_ops.c:698."""
+        host_vote = 1 if self.judge_cb is None else \
+            int(self.judge_cb(proposal, self.app_ctx))
+        if not host_vote:
+            return 0
+        key = (key, id(device_judge), np.asarray(x).shape,
+               str(np.asarray(x).dtype))
+        out = np.asarray(self._sharded_decide(device_judge, key)(x))
+        decision = int(out.reshape(-1)[0])
+        if decision and self.action_cb is not None:
+            self.action_cb(proposal, self.app_ctx)
+        return decision
+
+    def submit_host_sharded(self, proposal: bytes, x, shard_judge) -> int:
+        """Like submit_sharded but the per-shard judge is a HOST
+        callback: each shard's slice round-trips through
+        jax.experimental.io_callback(shard_judge) — the escape hatch
+        for judgement logic that cannot be traced (arbitrary Python,
+        like the reference's arbitrary C callbacks, rootless_ops.h:77).
+        Slower (one host callback per shard per round); same veto
+        semantics."""
+        from jax.experimental import io_callback
+
+        def device_judge(v):
+            return io_callback(
+                lambda blk: np.int32(1 if shard_judge(blk) else 0),
+                jax.ShapeDtypeStruct((), jnp.int32), v)
+        return self.submit_sharded(proposal, x, device_judge,
+                                   key=("io", id(shard_judge)))
