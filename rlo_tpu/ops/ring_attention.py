@@ -57,7 +57,9 @@ def _block_update(q, k, v, m, l, o, q_pos, k_pos, causal, scale):
 
 
 def ring_attention(q, k, v, axis: str, *, causal: bool = False,
-                   scale: Optional[float] = None):
+                   scale: Optional[float] = None,
+                   use_pallas: Optional[bool] = None,
+                   block_q: int = 256):
     """Sequence-parallel attention; call inside shard_map over ``axis``.
 
     q, k, v: this shard's (block_len, n_heads, head_dim) slice of the
@@ -65,18 +67,62 @@ def ring_attention(q, k, v, axis: str, *, causal: bool = False,
     [r*block, (r+1)*block)). Returns the (block_len, n_heads, head_dim)
     attention output for the local Q block, numerically equal to full
     softmax attention over the whole sequence.
+
+    ``use_pallas`` selects the fused flash kernel
+    (rlo_tpu.pallas.flash) for the per-step online-softmax update: the
+    (BQ, Lk) score tile lives and dies in VMEM instead of the unfused
+    einsum path materializing (H, Lq, Lk) scores in HBM between ops.
+    Default: on TPU when ``min(block_q, block_len)`` divides the block
+    length (interpret mode exercises the same kernel in tests). The
+    pallas path carries everything in the kernel's head-leading layout
+    across the whole ring loop — one transpose in, one out.
     """
     ws = lax.axis_size(axis)
     idx = lax.axis_index(axis)
     blk, h, d = q.shape
     if scale is None:
         scale = 1.0 / (d ** 0.5)
+    if use_pallas is None:
+        use_pallas = jax.default_backend() == "tpu" and \
+            blk % min(block_q, blk) == 0
     # K/V travel rank -> rank+1, so the block held at step s originated
     # at shard (idx - s) mod ws — same schedule as the ring allreduce.
     perm = list(topology.ring_perm(ws))
+    q_pos = idx * blk + jnp.arange(blk)
+
+    if use_pallas:
+        from rlo_tpu.pallas.flash import flash_block_update_hld
+        q_hld = q.astype(jnp.float32).transpose(1, 0, 2)  # (H, Lq, D)
+        qp = q_pos.astype(jnp.int32).reshape(1, blk)
+
+        def update(s, kc, vc, m, l, o):
+            src = (idx - s) % ws
+            kp = (src * blk + jnp.arange(blk)).astype(
+                jnp.int32).reshape(1, blk)
+            return flash_block_update_hld(
+                q_hld, kc, vc, m, l, o, qp, kp, causal=causal,
+                scale=scale, block_q=block_q)
+
+        def step(s, carry):
+            kc, vc, m, l, o = carry
+            m, l, o = update(s, kc, vc, m, l, o)
+            kc = lax.ppermute(kc, axis, perm)
+            vc = lax.ppermute(vc, axis, perm)
+            return kc, vc, m, l, o
+
+        m0 = _vary_like(jnp.full((h, 1, blk), _NEG, jnp.float32), q)
+        l0 = _vary_like(jnp.zeros((h, 1, blk), jnp.float32), q)
+        o0 = _vary_like(jnp.zeros((h, blk, d), jnp.float32), q)
+        kc0 = k.transpose(1, 0, 2)
+        vc0 = v.transpose(1, 0, 2)
+        kc, vc, m, l, o = lax.fori_loop(0, ws - 1, step,
+                                        (kc0, vc0, m0, l0, o0))
+        m, l, o = update(ws - 1, kc, vc, m, l, o)
+        lt = l.transpose(0, 2, 1)                         # (H, Lq, 1)
+        denom = jnp.where(lt > 0, lt, 1.0)
+        return (o / denom).transpose(1, 0, 2).astype(q.dtype)
 
     q32 = q.astype(jnp.float32)
-    q_pos = idx * blk + jnp.arange(blk)
 
     def update(s, kc, vc, m, l, o):
         src = (idx - s) % ws

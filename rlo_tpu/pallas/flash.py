@@ -1,0 +1,137 @@
+"""Pallas flash-attention kernel for the ring-attention block update.
+
+Fuses the per-ring-step online-softmax update — q·kᵀ on the MXU, causal
+masking, the running-max rescale, and the (m, l, o) accumulation — into
+one VMEM-resident kernel (VERDICT round-1 item 5). The unfused XLA path
+(rlo_tpu/ops/ring_attention.py:_block_update) materializes the (H, Lq,
+Lk) score and probability tensors in HBM between ops; here each (BQ, Lk)
+score tile lives and dies in VMEM, so the only HBM traffic is the
+operands and the carried state. Measured on the v5e chip (causal, block
+2048, 8 heads, head_dim 128, bf16): 0.142 ms vs 0.610 ms unfused —
+4.3x (benchmarks/flash_bench.py).
+
+The kernel is the *step* of ring attention, not a whole attention: the
+K/V block rotating in from the ppermute ring is consumed against the
+resident Q block, updating the (m, l, o) accumulators in place
+(input_output_aliases). Same numerics as _block_update; parity-tested in
+interpret mode on CPU and compiled on TPU.
+
+Layouts are head-leading — q/k/v/o as (H, L, D), m/l as (H, 1, L) — so
+every block's trailing two dims are (sublane, lane) shaped (Mosaic's
+tiling constraint). `flash_block_update_hld` takes and returns that
+layout directly (ring_attention carries it across the whole ring loop —
+one transpose in, one out, instead of per step); `flash_block_update`
+is the convenience wrapper in ring_attention's caller layout.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from rlo_tpu.pallas.reduce import _on_tpu, out_struct
+
+try:  # pltpu only imports on TPU-enabled builds
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+_NEG = -1e30  # matches ring_attention._NEG (finite: exp/max NaN-free)
+
+
+def _kernel(q_ref, k_ref, v_ref, m_ref, l_ref, o_ref, qp_ref, kp_ref,
+            m_out, l_out, o_out, *, causal: bool, scale: float):
+    q = q_ref[0].astype(jnp.float32)                # (BQ, D)
+    k = k_ref[0].astype(jnp.float32)                # (Lk, D)
+    v = v_ref[0].astype(jnp.float32)                # (Lk, D)
+    m = m_ref[0, 0]                                 # (BQ,)
+    l = l_ref[0, 0]
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    if causal:
+        mask = kp_ref[0, :][None, :] <= qp_ref[0, :][:, None]
+        s = jnp.where(mask, s, _NEG)
+    m_new = jnp.maximum(m, s.max(axis=-1))          # (BQ,)
+    p = jnp.exp(s - m_new[:, None])                 # (BQ, Lk)
+    if causal:
+        p = jnp.where(mask, p, 0.0)
+    corr = jnp.exp(m - m_new)                       # (BQ,)
+    l_out[0, 0] = l * corr + p.sum(axis=-1)
+    m_out[0, 0] = m_new
+    o = o_ref[0]                                    # (BQ, D) f32
+    o_out[0] = o * corr[:, None] + jax.lax.dot_general(
+        p, v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+
+def flash_block_update_hld(q, k, v, m, l, o, q_pos, k_pos, *,
+                           causal: bool = False, scale: float = 1.0,
+                           block_q: int = 256,
+                           interpret: Optional[bool] = None):
+    """Head-leading-layout fused update: q (H, Lq, D) any float dtype;
+    k, v (H, Lk, D); m, l (H, 1, Lq) float32; o (H, Lq, D) float32;
+    q_pos (1, Lq), k_pos (1, Lk) int32. Returns (m', l', o') in the
+    same layouts. Grid = (H, Lq/block_q)."""
+    h, lq, d = q.shape
+    lk = k.shape[1]
+    if interpret is None:
+        interpret = not _on_tpu()
+    bq = min(block_q, lq)
+    if lq % bq:
+        raise ValueError(
+            f"block_q (clamped to {bq}) must divide Lq {lq}")
+    grid = (h, lq // bq)
+
+    q_spec = pl.BlockSpec((1, bq, d), lambda hh, iq: (hh, iq, 0))
+    kv_spec = pl.BlockSpec((1, lk, d), lambda hh, iq: (hh, 0, 0))
+    ml_spec = pl.BlockSpec((1, 1, bq), lambda hh, iq: (hh, 0, iq))
+    qp_spec = pl.BlockSpec((1, bq), lambda hh, iq: (0, iq))
+    kp_spec = pl.BlockSpec((1, lk), lambda hh, iq: (0, 0))
+
+    kwargs = {}
+    if not interpret and pltpu is not None:
+        kwargs["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel"))
+
+    def struct(shape):
+        return out_struct(shape, jnp.float32, q, k, v, m, l, o)
+
+    return pl.pallas_call(
+        functools.partial(_kernel, causal=causal, scale=float(scale)),
+        grid=grid,
+        in_specs=[q_spec, kv_spec, kv_spec, ml_spec, ml_spec, q_spec,
+                  qp_spec, kp_spec],
+        out_specs=[ml_spec, ml_spec, q_spec],
+        out_shape=[struct((h, 1, lq)), struct((h, 1, lq)),
+                   struct((h, lq, d))],
+        # accumulate in place: the (m, l, o) carries alias the outputs
+        input_output_aliases={3: 0, 4: 1, 5: 2},
+        interpret=interpret,
+        **kwargs,
+    )(q, k, v, m, l, o, q_pos, k_pos)
+
+
+def flash_block_update(q, k, v, m, l, o, q_pos, k_pos, *,
+                       causal: bool = False, scale: float = 1.0,
+                       block_q: int = 256,
+                       interpret: Optional[bool] = None):
+    """One fused online-softmax update in ring_attention's caller
+    layout: q, o (Lq, H, D); k, v (Lk, H, D); m, l (H, Lq); q_pos
+    (Lq,), k_pos (Lk,). Returns (m', l', o'). Convenience wrapper —
+    the ring loop itself uses flash_block_update_hld and transposes
+    once outside the loop instead of per step."""
+    lq, h, d = q.shape
+    lk = k.shape[0]
+    m2, l2, o2 = flash_block_update_hld(
+        q.transpose(1, 0, 2), k.transpose(1, 0, 2), v.transpose(1, 0, 2),
+        m.reshape(h, 1, lq), l.reshape(h, 1, lq),
+        o.astype(jnp.float32).transpose(1, 0, 2),
+        q_pos.astype(jnp.int32).reshape(1, lq),
+        k_pos.astype(jnp.int32).reshape(1, lk),
+        causal=causal, scale=scale, block_q=block_q,
+        interpret=interpret)
+    return (m2.reshape(h, lq), l2.reshape(h, lq), o2.transpose(1, 0, 2))

@@ -62,16 +62,23 @@ def _combine_kernel(op_name: str, out_dtype):
     return kernel
 
 
-def _out_struct(a):
-    """ShapeDtypeStruct matching ``a``, propagating the varying-mesh-axes
-    annotation so the kernel works inside shard_map (check_vma=True)."""
-    try:
-        vma = jax.typeof(a).vma
-    except (AttributeError, TypeError):
-        vma = None
+def out_struct(shape, dtype, *arrays):
+    """ShapeDtypeStruct carrying the union of ``arrays``' varying-mesh-
+    axes annotations, so kernels work inside shard_map (check_vma=True).
+    Shared by every pallas kernel in the package (reduce, flash)."""
+    vma: set = set()
+    for a in arrays:
+        try:
+            vma |= set(jax.typeof(a).vma)
+        except (AttributeError, TypeError):
+            pass
     if vma:
-        return jax.ShapeDtypeStruct(a.shape, a.dtype, vma=vma)
-    return jax.ShapeDtypeStruct(a.shape, a.dtype)
+        return jax.ShapeDtypeStruct(shape, dtype, vma=frozenset(vma))
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def _out_struct(a):
+    return out_struct(a.shape, a.dtype, a)
 
 
 def _fused_combine_2d(a, b, op: str, block_rows: int, interpret: bool,
