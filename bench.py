@@ -79,7 +79,14 @@ def _chain_time(loop_fn, x0, *rest, k=CHAIN):
 
 
 def bench_single_chip():
-    """Pallas fused combine vs XLA fused combine, 256 MB fp32 operands."""
+    """Pallas fused combine vs XLA fused combine, 256 MB fp32 operands.
+
+    Both sides are HBM-bandwidth-bound (3 passes over 256 MB), so the
+    honest ceiling is parity with XLA's own fusion; run-to-run drift on
+    the tunneled chip is a few percent. To keep the comparison fair
+    under that drift, the block size is auto-tuned at run time and the
+    XLA baseline is measured twice (before and after), taking each
+    side's best."""
     from rlo_tpu.pallas.reduce import fused_combine
 
     rows, lane = 512 * 1024, 128  # 512Ki x 128 x 4B = 256 MB per operand
@@ -88,20 +95,27 @@ def bench_single_chip():
     b = jnp.asarray(rng.standard_normal((rows, lane)), jnp.float32)
     nbytes = a.size * 4
 
-    @partial(jax.jit, static_argnames=("k",))
-    def pallas_loop(x, y, k):
-        return jax.lax.fori_loop(
-            0, k, lambda i, acc: fused_combine(acc, y, op="sum"), x)
+    def pallas_loop_for(block_rows):
+        @partial(jax.jit, static_argnames=("k",))
+        def loop(x, y, k):
+            return jax.lax.fori_loop(
+                0, k, lambda i, acc: fused_combine(
+                    acc, y, op="sum", block_rows=block_rows), x)
+        return loop
 
     @partial(jax.jit, static_argnames=("k",))
     def xla_loop(x, y, k):
         return jax.lax.fori_loop(0, k, lambda i, acc: acc + y, x)
 
-    t_pallas = _chain_time(pallas_loop, a, b)
-    t_xla = _chain_time(xla_loop, a, b)
+    t_xla_1 = _chain_time(xla_loop, a, b)
+    t_by_block = {br: _chain_time(pallas_loop_for(br), a, b)
+                  for br in (1024, 2048)}
+    t_xla_2 = _chain_time(xla_loop, a, b)
+    best_br, t_pallas = min(t_by_block.items(), key=lambda kv: kv[1])
+    t_xla = min(t_xla_1, t_xla_2)
     gbps = 3 * nbytes / t_pallas / 1e9      # read acc + read y + write acc
     base_gbps = 3 * nbytes / t_xla / 1e9
-    print(f"pallas: {t_pallas*1e3:.3f} ms ({gbps:.1f} GB/s)  "
+    print(f"pallas[{best_br}]: {t_pallas*1e3:.3f} ms ({gbps:.1f} GB/s)  "
           f"xla: {t_xla*1e3:.3f} ms ({base_gbps:.1f} GB/s)", file=sys.stderr)
     return {
         "metric": "pallas fused-combine HBM throughput, 256MB fp32 "

@@ -332,6 +332,124 @@ static void test_pid_reuse_across_rounds(int ws)
     rlo_world_free(w);
 }
 
+/* ring data collectives (rlo_coll.c) under the sanitizers: allreduce /
+ * reduce-scatter / all-gather / all-to-all / barrier, round-robin
+ * driven in-process, with numeric oracles and back-to-back reuse */
+static void test_coll(int ws)
+{
+    rlo_world *w = rlo_world_new(ws, 0, 0);
+    CHECK(w);
+    rlo_coll **c = (rlo_coll **)calloc((size_t)ws, sizeof(void *));
+    float **buf = (float **)calloc((size_t)ws, sizeof(void *));
+    const int64_t n = 37; /* ragged: forces identity padding */
+    for (int r = 0; r < ws; r++) {
+        c[r] = rlo_coll_new(w, r, 7);
+        buf[r] = (float *)malloc((size_t)n * sizeof(float));
+        CHECK(c[r] && buf[r]);
+    }
+
+#define DRIVE()                                                            \
+    do {                                                                   \
+        int done = 0;                                                      \
+        for (long spin = 0; done < ws && spin < 10000000L; spin++) {       \
+            done = 0;                                                      \
+            for (int r = 0; r < ws; r++) {                                 \
+                int pr = rlo_coll_poll(c[r]);                              \
+                if (pr == 1 || pr == RLO_ERR_ARG)                          \
+                    done++;                                                \
+                else                                                       \
+                    CHECK(pr >= 0);                                        \
+            }                                                              \
+        }                                                                  \
+        CHECK(done == ws);                                                 \
+    } while (0)
+
+    for (int round = 0; round < 2; round++) { /* opid reuse */
+        for (int r = 0; r < ws; r++) {
+            for (int64_t i = 0; i < n; i++)
+                buf[r][i] = (float)((r + 1) * (i + 1 + round));
+            CHECK(rlo_coll_allreduce_f32_start(c[r], buf[r], n,
+                                               RLO_COLL_SUM) == RLO_OK);
+        }
+        DRIVE();
+        float want = (float)(ws * (ws + 1) / 2 * (1 + round));
+        for (int r = 0; r < ws; r++)
+            CHECK(buf[r][0] == want);
+    }
+
+    /* reduce-scatter: chunks reassemble to the full reduction */
+    int64_t chunk = (n + ws - 1) / ws;
+    float **rs = (float **)calloc((size_t)ws, sizeof(void *));
+    for (int r = 0; r < ws; r++) {
+        rs[r] = (float *)malloc((size_t)chunk * sizeof(float));
+        for (int64_t i = 0; i < n; i++)
+            buf[r][i] = (float)(r + 1);
+        CHECK(rs[r] && rlo_coll_reduce_scatter_f32_start(
+                           c[r], buf[r], n, rs[r],
+                           RLO_COLL_SUM) == RLO_OK);
+    }
+    DRIVE();
+    for (int r = 0; r < ws; r++)
+        if ((int64_t)r * chunk < n)
+            CHECK(rs[r][0] == (float)(ws * (ws + 1) / 2));
+
+    /* all-gather + all-to-all on byte slots */
+    uint8_t *slot = (uint8_t *)malloc(4);
+    uint8_t **ag = (uint8_t **)calloc((size_t)ws, sizeof(void *));
+    uint8_t **a2a_in = (uint8_t **)calloc((size_t)ws, sizeof(void *));
+    uint8_t **a2a_out = (uint8_t **)calloc((size_t)ws, sizeof(void *));
+    for (int r = 0; r < ws; r++) {
+        memset(slot, r, 4);
+        ag[r] = (uint8_t *)malloc((size_t)(4 * ws));
+        CHECK(ag[r] && rlo_coll_all_gather_start(c[r], slot, 4,
+                                                 ag[r]) == RLO_OK);
+    }
+    DRIVE();
+    for (int r = 0; r < ws; r++)
+        for (int s = 0; s < ws; s++)
+            CHECK(ag[r][s * 4] == (uint8_t)s);
+    for (int r = 0; r < ws; r++) {
+        a2a_in[r] = (uint8_t *)malloc((size_t)(2 * ws));
+        a2a_out[r] = (uint8_t *)malloc((size_t)(2 * ws));
+        CHECK(a2a_in[r] && a2a_out[r]);
+        for (int d = 0; d < ws; d++) {
+            a2a_in[r][2 * d] = (uint8_t)(r * 8 + d);
+            a2a_in[r][2 * d + 1] = (uint8_t)(r ^ d);
+        }
+        CHECK(rlo_coll_all_to_all_start(c[r], a2a_in[r], 2,
+                                        a2a_out[r]) == RLO_OK);
+    }
+    DRIVE();
+    for (int d = 0; d < ws; d++)
+        for (int s = 0; s < ws; s++) {
+            CHECK(a2a_out[d][2 * s] == (uint8_t)(s * 8 + d));
+            CHECK(a2a_out[d][2 * s + 1] == (uint8_t)(s ^ d));
+        }
+
+    for (int r = 0; r < ws; r++)
+        CHECK(rlo_coll_barrier_start(c[r]) == RLO_OK);
+    DRIVE();
+#undef DRIVE
+
+    CHECK(rlo_world_quiescent(w));
+    for (int r = 0; r < ws; r++) {
+        rlo_coll_free(c[r]);
+        free(buf[r]);
+        free(rs[r]);
+        free(ag[r]);
+        free(a2a_in[r]);
+        free(a2a_out[r]);
+    }
+    free(c);
+    free(buf);
+    free(rs);
+    free(ag);
+    free(a2a_in);
+    free(a2a_out);
+    free(slot);
+    rlo_world_free(w);
+}
+
 int main(void)
 {
     static const int sizes[] = {2, 3, 5, 8, 16, 23, 32};
@@ -353,6 +471,10 @@ int main(void)
     test_sole_survivor_consensus();
     test_pid_reuse_across_rounds(4);
     test_pid_reuse_across_rounds(8);
+    test_coll(2);
+    test_coll(5);
+    test_coll(8);
+    test_coll(13);
     if (failures) {
         fprintf(stderr, "%d FAILURES\n", failures);
         return 1;
