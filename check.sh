@@ -25,6 +25,11 @@ echo "== MPI transport executed (femtompi mpirun) =="
 (cd rlo_tpu/native && make -s mpidemo && \
     ./femtompirun -n 8 -t 240 ./rlo_demo_mpi -m 4 -b 65536)
 
+echo "== manual-ring validation (8 virtual devices) =="
+PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python benchmarks/ring_validation.py --mb 1
+
 echo "== driver dryrun (8 virtual devices) =="
 PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
     XLA_FLAGS=--xla_force_host_platform_device_count=8 \

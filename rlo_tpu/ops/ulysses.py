@@ -63,7 +63,9 @@ def _heads_to_seq(x, axis: str, ws: int, algorithm: str):
 
 def ulysses_attention(q, k, v, axis: str, *, causal: bool = False,
                       scale: Optional[float] = None,
-                      algorithm: str = "xla"):
+                      algorithm: str = "xla",
+                      use_pallas: Optional[bool] = None,
+                      block_q: int = 256):
     """Sequence-parallel attention via head-scatter all_to_all; call
     inside shard_map over ``axis``.
 
@@ -72,12 +74,34 @@ def ulysses_attention(q, k, v, axis: str, *, causal: bool = False,
     ring_attention, so the two are drop-in interchangeable). Returns the
     (block_len, n_heads, head_dim) output slice, numerically equal to
     full attention over the whole sequence.
+
+    ``use_pallas`` runs the communication-free quadratic part as the
+    fused flash kernel (pallas/flash.py, one whole-sequence block
+    update). Default: on TPU when the full sequence tiles by
+    ``block_q`` and the kernel's per-grid-step VMEM working set —
+    the (block_q, seq) f32 score AND probability tiles plus the f32
+    K/V blocks and the q/o blocks — fits a conservative budget.
     """
+    from rlo_tpu.pallas.reduce import _on_tpu
+
     ws = lax.axis_size(axis)
     qh = _seq_to_heads(q, axis, ws, algorithm)
     kh = _seq_to_heads(k, axis, ws, algorithm)
     vh = _seq_to_heads(v, axis, ws, algorithm)
+    seq, _, d = qh.shape
+    if use_pallas is None:
+        bq = min(block_q, seq)
+        vmem_est = 4 * (2 * bq * seq     # s + p tiles
+                        + 2 * seq * d    # k + v blocks (f32)
+                        + 2 * bq * d)    # q + o blocks
+        use_pallas = (_on_tpu() and seq % bq == 0
+                      and vmem_est <= (10 << 20))
     # full sequence, local heads: the quadratic part is communication-
     # free and positions are globally consistent (causal masks included)
-    oh = full_attention(qh, kh, vh, causal=causal, scale=scale)
+    if use_pallas:
+        from rlo_tpu.pallas.flash import flash_attention
+        oh = flash_attention(qh, kh, vh, causal=causal, scale=scale,
+                             block_q=block_q)
+    else:
+        oh = full_attention(qh, kh, vh, causal=causal, scale=scale)
     return _heads_to_seq(oh, axis, ws, algorithm)

@@ -115,6 +115,35 @@ def flash_block_update_hld(q, k, v, m, l, o, q_pos, k_pos, *,
     )(q, k, v, m, l, o, q_pos, k_pos)
 
 
+def flash_attention(q, k, v, *, causal: bool = False,
+                    scale: Optional[float] = None, block_q: int = 256,
+                    interpret: Optional[bool] = None):
+    """Whole attention as ONE fused block update from the initial
+    (m, l, o) state — the communication-free quadratic part of Ulysses
+    sequence parallelism (each shard holds full sequences of its local
+    heads), or plain single-device attention. q: (Lq, H, D); k, v:
+    (Lk, H, D); positions are the global 0..L ranges. VMEM bound: the
+    (block_q, Lk) f32 score tile must fit (~block_q*Lk*4 bytes)."""
+    from rlo_tpu.parallel.mesh import vary_like
+
+    lq, h, d = q.shape
+    lk = k.shape[0]
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    m0 = vary_like(jnp.full((h, 1, lq), _NEG, jnp.float32), q)
+    l0 = vary_like(jnp.zeros((h, 1, lq), jnp.float32), q)
+    o0 = vary_like(jnp.zeros((h, lq, d), jnp.float32), q)
+    qp = vary_like(jnp.arange(lq, dtype=jnp.int32).reshape(1, lq), q)
+    kp = vary_like(jnp.arange(lk, dtype=jnp.int32).reshape(1, lk), q)
+    m, l, o = flash_block_update_hld(
+        q.transpose(1, 0, 2), k.transpose(1, 0, 2), v.transpose(1, 0, 2),
+        m0, l0, o0, qp, kp, causal=causal, scale=scale, block_q=block_q,
+        interpret=interpret)
+    lt = l.transpose(0, 2, 1)
+    denom = jnp.where(lt > 0, lt, 1.0)
+    return (o / denom).transpose(1, 0, 2).astype(q.dtype)
+
+
 def flash_block_update(q, k, v, m, l, o, q_pos, k_pos, *,
                        causal: bool = False, scale: float = 1.0,
                        block_q: int = 256,

@@ -55,6 +55,20 @@ def test_multi2_n13_over_mpi(mpi_bins):
     assert "PASS" in out and "FAIL" not in out
 
 
+def test_tiny_rings_exercise_pending_sends(mpi_bins):
+    """Shrink the shared-memory rings far below the traffic volume so
+    femtompi's lazy-flush path (sends parked when a ring is full,
+    re-pushed in per-destination FIFO order from the progress loop)
+    carries the load — the eager-path-only happy case can't see it."""
+    launcher, demo = mpi_bins
+    proc = subprocess.run(
+        [str(launcher), "-n", "4", "-r", "8192", "-t", "240", str(demo),
+         "-c", "hacky", "-m", "32"],
+        capture_output=True, text=True, timeout=280)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert "PASS" in proc.stdout
+
+
 def test_iallreduce_drain_under_traffic(mpi_bins):
     """The hacky-sack stress ends in the nonblocking-iallreduce drain
     with traffic still settling — the reference's cleanup-drain shape

@@ -31,10 +31,30 @@ def make_qkv(seed, seq, heads, dim, dtype=jnp.float32):
     return one(), one(), one()
 
 
-def run_sharded(fn, q, k, v, ws=WS):
+def run_sharded(fn, q, k, v, ws=WS, check_vma=True):
     mesh = make_mesh((ws,), ("sp",))
-    f = shard_jit(fn, mesh, (P("sp"), P("sp"), P("sp")), P("sp"))
+    f = shard_jit(fn, mesh, (P("sp"), P("sp"), P("sp")), P("sp"),
+                  check_vma=check_vma)
     return np.asarray(f(q, k, v))
+
+
+class TestFlashLocalAttention:
+    """The Ulysses quadratic part through the fused flash kernel
+    (interpret mode; check_vma off — the pallas interpreter does not
+    thread vma types, same caveat as the ring-attention tests)."""
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_flash_matches_full(self, causal):
+        from rlo_tpu.ops.ring_attention import full_attention
+        from rlo_tpu.ops.ulysses import ulysses_attention
+        q, k, v = make_qkv(11, 64, 8, 16)
+        want = np.asarray(full_attention(q, k, v, causal=causal))
+        got = run_sharded(
+            lambda a, b, c: ulysses_attention(
+                a, b, c, "sp", causal=causal, use_pallas=True,
+                block_q=8),
+            q, k, v, check_vma=False)
+        np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
 
 
 class TestParity:
