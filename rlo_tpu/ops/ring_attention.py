@@ -59,7 +59,7 @@ def _block_update(q, k, v, m, l, o, q_pos, k_pos, causal, scale):
 def ring_attention(q, k, v, axis: str, *, causal: bool = False,
                    scale: Optional[float] = None,
                    use_pallas: Optional[bool] = None,
-                   block_q: int = 256):
+                   block_q: int = 256, block_k: Optional[int] = None):
     """Sequence-parallel attention; call inside shard_map over ``axis``.
 
     q, k, v: this shard's (block_len, n_heads, head_dim) slice of the
@@ -83,8 +83,9 @@ def ring_attention(q, k, v, axis: str, *, causal: bool = False,
     if scale is None:
         scale = 1.0 / (d ** 0.5)
     if use_pallas is None:
+        from rlo_tpu.pallas.flash import can_flash
         use_pallas = jax.default_backend() == "tpu" and \
-            blk % min(block_q, blk) == 0
+            can_flash(blk, blk, d, block_q, block_k)
     # K/V travel rank -> rank+1, so the block held at step s originated
     # at shard (idx - s) mod ws — same schedule as the ring allreduce.
     perm = list(topology.ring_perm(ws))
@@ -101,7 +102,7 @@ def ring_attention(q, k, v, axis: str, *, causal: bool = False,
                 jnp.int32).reshape(1, blk)
             return flash_block_update_hld(
                 q_hld, kc, vc, m, l, o, qp, kp, causal=causal,
-                scale=scale, block_q=block_q)
+                scale=scale, block_q=block_q, block_k=block_k)
 
         def step(s, carry):
             kc, vc, m, l, o = carry

@@ -65,7 +65,8 @@ def ulysses_attention(q, k, v, axis: str, *, causal: bool = False,
                       scale: Optional[float] = None,
                       algorithm: str = "xla",
                       use_pallas: Optional[bool] = None,
-                      block_q: int = 256):
+                      block_q: int = 256,
+                      block_k: Optional[int] = None):
     """Sequence-parallel attention via head-scatter all_to_all; call
     inside shard_map over ``axis``.
 
@@ -77,10 +78,9 @@ def ulysses_attention(q, k, v, axis: str, *, causal: bool = False,
 
     ``use_pallas`` runs the communication-free quadratic part as the
     fused flash kernel (pallas/flash.py, one whole-sequence block
-    update). Default: on TPU when the full sequence tiles by
-    ``block_q`` and the kernel's per-grid-step VMEM working set —
-    the (block_q, seq) f32 score AND probability tiles plus the f32
-    K/V blocks and the q/o blocks — fits a conservative budget.
+    update; the K/V axis streams through VMEM in block_k tiles, so
+    sequence length is not VMEM-bound). Default: on TPU when the full
+    sequence tiles by both block sizes.
     """
     from rlo_tpu.pallas.reduce import _on_tpu
 
@@ -90,18 +90,15 @@ def ulysses_attention(q, k, v, axis: str, *, causal: bool = False,
     vh = _seq_to_heads(v, axis, ws, algorithm)
     seq, _, d = qh.shape
     if use_pallas is None:
-        bq = min(block_q, seq)
-        vmem_est = 4 * (2 * bq * seq     # s + p tiles
-                        + 2 * seq * d    # k + v blocks (f32)
-                        + 2 * bq * d)    # q + o blocks
-        use_pallas = (_on_tpu() and seq % bq == 0
-                      and vmem_est <= (10 << 20))
+        from rlo_tpu.pallas.flash import can_flash
+        use_pallas = _on_tpu() and can_flash(seq, seq, d, block_q,
+                                             block_k)
     # full sequence, local heads: the quadratic part is communication-
     # free and positions are globally consistent (causal masks included)
     if use_pallas:
         from rlo_tpu.pallas.flash import flash_attention
         oh = flash_attention(qh, kh, vh, causal=causal, scale=scale,
-                             block_q=block_q)
+                             block_q=block_q, block_k=block_k)
     else:
         oh = full_attention(qh, kh, vh, causal=causal, scale=scale)
     return _heads_to_seq(oh, axis, ws, algorithm)

@@ -25,7 +25,8 @@ def make_qkv(seed, seq, heads, dim, dtype=jnp.float32):
     return one(), one(), one()
 
 
-def run_ring(q, k, v, causal, use_pallas=None, block_q=256):
+def run_ring(q, k, v, causal, use_pallas=None, block_q=256,
+             block_k=None):
     mesh = make_mesh((WS,), ("sp",))
     # check_vma off when exercising the Pallas kernel in interpret mode:
     # the pallas interpreter's internal grid loop does not thread
@@ -34,7 +35,8 @@ def run_ring(q, k, v, causal, use_pallas=None, block_q=256):
     fn = shard_jit(
         lambda q_, k_, v_: ring_attention(q_, k_, v_, "sp", causal=causal,
                                           use_pallas=use_pallas,
-                                          block_q=block_q),
+                                          block_q=block_q,
+                                          block_k=block_k),
         mesh, (P("sp"), P("sp"), P("sp")), P("sp"),
         check_vma=not use_pallas)
     return np.asarray(fn(q, k, v))
@@ -106,3 +108,23 @@ class TestFlashKernel:
         q, k, v = make_qkv(7, 56, 1, 8)  # 7 tokens/shard
         with pytest.raises(ValueError, match="divide"):
             run_ring(q, k, v, False, use_pallas=True, block_q=4)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_forced_kv_tiling_parity(self, causal):
+        """Explicit block_k forces the multi-K-tile path (scratch init
+        at ik==0, cross-tile accumulation, flush at ik==n_k-1) that the
+        auto policy would run untiled at test sizes — the long-sequence
+        machinery must match the oracle exactly."""
+        from rlo_tpu.pallas.flash import flash_attention
+
+        q, k, v = make_qkv(8, 48, 2, 16)
+        want = np.asarray(full_attention(q, k, v, causal=causal))
+        # 48 keys in 6 tiles of 8 — n_k > 1 guaranteed
+        got = np.asarray(flash_attention(q, k, v, causal=causal,
+                                         block_q=16, block_k=8,
+                                         interpret=True))
+        np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+        # and inside the full ring (every per-step update tiled)
+        got_ring = run_ring(q, k, v, causal, use_pallas=True,
+                            block_q=6, block_k=2)
+        np.testing.assert_allclose(got_ring, want, rtol=2e-5, atol=2e-5)
