@@ -56,26 +56,69 @@ def _block_update(q, k, v, m, l, o, q_pos, k_pos, causal, scale):
     return m_new, l_new, o_new
 
 
+def stripe_sequence(x, ws: int):
+    """Reorder a full sequence (axis 0) into the STRIPED layout: shard r
+    of a striped ring holds tokens {r, r+ws, r+2ws, ...}. Apply before
+    sharding with layout='striped'; invert with unstripe_sequence.
+
+    Why: with contiguous sharding and causal masking, ring step s on
+    shard r is fully masked whenever the arriving K/V block comes from
+    a later shard — up to half the steps do no useful work and the
+    critical path is set by the last shard. Striding every shard's
+    tokens across the whole sequence makes every (q block, kv block)
+    pair ~half-unmasked, balancing useful work across all steps
+    (Striped Attention; the masking here is position-driven, so only
+    the position arrays change)."""
+    seq = x.shape[0]
+    if seq % ws:
+        raise ValueError(f"sequence {seq} must divide by ws {ws}")
+    blk = seq // ws
+    return jnp.moveaxis(x.reshape(blk, ws, *x.shape[1:]), 1, 0) \
+        .reshape(seq, *x.shape[1:])
+
+
+def unstripe_sequence(x, ws: int):
+    """Inverse of stripe_sequence (axis 0)."""
+    seq = x.shape[0]
+    if seq % ws:
+        raise ValueError(f"sequence {seq} must divide by ws {ws}")
+    blk = seq // ws
+    return jnp.moveaxis(x.reshape(ws, blk, *x.shape[1:]), 0, 1) \
+        .reshape(seq, *x.shape[1:])
+
+
 def ring_attention(q, k, v, axis: str, *, causal: bool = False,
                    scale: Optional[float] = None,
                    use_pallas: Optional[bool] = None,
-                   block_q: int = 256, block_k: Optional[int] = None):
+                   block_q: int = 256, block_k: Optional[int] = None,
+                   layout: str = "contiguous"):
     """Sequence-parallel attention; call inside shard_map over ``axis``.
 
     q, k, v: this shard's (block_len, n_heads, head_dim) slice of the
-    sequence (sharded contiguously: shard r holds tokens
-    [r*block, (r+1)*block)). Returns the (block_len, n_heads, head_dim)
-    attention output for the local Q block, numerically equal to full
-    softmax attention over the whole sequence.
+    sequence. Returns the (block_len, n_heads, head_dim) attention
+    output for the local Q block, numerically equal to full softmax
+    attention over the whole sequence.
+
+    ``layout`` declares how the sequence was sharded: 'contiguous'
+    (shard r holds tokens [r*block, (r+1)*block)) or 'striped' (shard
+    r holds tokens {r, r+ws, ...} — pre-permute the full sequence with
+    stripe_sequence). Striping balances CAUSAL work across ring steps:
+    contiguous causal sharding fully masks every step whose K/V block
+    comes from a later shard, so up to half the schedule is wasted;
+    striped blocks are ~half-unmasked everywhere. Only the position
+    arrays differ — the masking is position-driven.
 
     ``use_pallas`` selects the fused flash kernel
     (rlo_tpu.pallas.flash) for the per-step online-softmax update: the
-    (BQ, Lk) score tile lives and dies in VMEM instead of the unfused
+    (BQ, BK) score tile lives and dies in VMEM instead of the unfused
     einsum path materializing (H, Lq, Lk) scores in HBM between ops.
-    Default: on TPU when ``min(block_q, block_len)`` divides the block
-    length (interpret mode exercises the same kernel in tests). The
-    pallas path carries everything in the kernel's head-leading layout
-    across the whole ring loop — one transpose in, one out.
+    Default: on TPU when ``can_flash`` accepts the shape — the block
+    length must tile by block_q AND a VMEM-feasible K tile must exist
+    (single-tile when it fits, block_k-wide otherwise; see
+    pallas.flash._select_bk). Interpret mode exercises the same kernel
+    in tests. The pallas path carries everything in the kernel's
+    head-leading layout across the ring loop — one transpose in, one
+    out.
     """
     ws = lax.axis_size(axis)
     idx = lax.axis_index(axis)
@@ -89,7 +132,15 @@ def ring_attention(q, k, v, axis: str, *, causal: bool = False,
     # K/V travel rank -> rank+1, so the block held at step s originated
     # at shard (idx - s) mod ws — same schedule as the ring allreduce.
     perm = list(topology.ring_perm(ws))
-    q_pos = idx * blk + jnp.arange(blk)
+    if layout == "contiguous":
+        def positions(shard):
+            return shard * blk + jnp.arange(blk)
+    elif layout == "striped":
+        def positions(shard):
+            return shard + ws * jnp.arange(blk)
+    else:
+        raise ValueError(f"unknown layout {layout!r}")
+    q_pos = positions(idx)
 
     if use_pallas:
         from rlo_tpu.pallas.flash import flash_block_update_hld
@@ -98,8 +149,7 @@ def ring_attention(q, k, v, axis: str, *, causal: bool = False,
 
         def update(s, kc, vc, m, l, o):
             src = (idx - s) % ws
-            kp = (src * blk + jnp.arange(blk)).astype(
-                jnp.int32).reshape(1, blk)
+            kp = positions(src).astype(jnp.int32).reshape(1, blk)
             return flash_block_update_hld(
                 q_hld, kc, vc, m, l, o, qp, kp, causal=causal,
                 scale=scale, block_q=block_q, block_k=block_k)
@@ -127,7 +177,7 @@ def ring_attention(q, k, v, axis: str, *, causal: bool = False,
 
     def update(s, kc, vc, m, l, o):
         src = (idx - s) % ws
-        k_pos = src * blk + jnp.arange(blk)
+        k_pos = positions(src)
         return _block_update(q32, kc.astype(jnp.float32), vc, m, l, o,
                              q_pos, k_pos, causal, scale)
 

@@ -52,21 +52,38 @@ def _vmem_fits(bq: int, bk: int, d: int, budget: int = 12 << 20) -> bool:
     return 4 * (2 * bq * bk + 2 * bk * d + 2 * bq * d) <= budget
 
 
+def _select_bk(bq: int, lk: int, d: int,
+               block_k: Optional[int]) -> Optional[int]:
+    """THE K-tile policy, shared by the gate (can_flash) and the kernel
+    wrapper (flash_block_update_hld) so they can never disagree.
+    Returns the chosen tile width, or None when no valid choice exists
+    (Lk does not tile, or the per-step working set overflows VMEM).
+    block_k=None auto-selects: a single tile when it fits (biggest MXU
+    matmuls, no scratch round-trips — measured 3-4x vs the tiled shape
+    on the ring step), _AUTO_BLOCK_K otherwise; an explicit block_k is
+    honored exactly (tests force multi-tile with it)."""
+    if block_k is None:
+        if _vmem_fits(bq, lk, d):
+            return lk
+        bk = min(_AUTO_BLOCK_K, lk)
+    else:
+        bk = min(block_k, lk)
+    if lk % bk or not _vmem_fits(bq, bk, d):
+        return None
+    return bk
+
+
 def can_flash(lq: int, lk: int, d: int, block_q: int = 256,
               block_k: Optional[int] = None) -> bool:
-    """True when the kernel accepts these shapes: Lq tiles by block_q,
-    and Lk either runs as one VMEM-resident tile or tiles by the (auto
-    or explicit) block_k. The auto-enable gates in ring_attention and
-    ulysses_attention use this, so no shape the kernel accepts ever
-    silently drops to the unfused path."""
+    """True when the kernel accepts these shapes (Lq tiles by block_q
+    and _select_bk finds a VMEM-feasible K tile). The auto-enable gates
+    in ring_attention and ulysses_attention use this, so every shape
+    the kernel accepts takes the fused path and every shape it would
+    reject falls back to the unfused path instead of failing."""
     bq = min(block_q, lq)
     if lq % bq:
         return False
-    if block_k is None:
-        if _vmem_fits(bq, lk, d):
-            return True
-        return lk % min(_AUTO_BLOCK_K, lk) == 0
-    return lk % min(block_k, lk) == 0
+    return _select_bk(bq, lk, d, block_k) is not None
 
 
 def _kernel(q_ref, k_ref, v_ref, m_ref, l_ref, o_ref, qp_ref, kp_ref,
@@ -132,19 +149,12 @@ def flash_block_update_hld(q, k, v, m, l, o, q_pos, k_pos, *,
     if lq % bq:
         raise ValueError(
             f"block_q (clamped to {bq}) must divide Lq {lq}")
-    # block_k=None (the default) auto-selects: a SINGLE K tile whenever
-    # it fits VMEM — the untiled shape runs the biggest MXU matmuls and
-    # skips the scratch round-trips (measured 4.3x vs 1.5x on the
-    # ring-step shape) — and 512-wide tiles otherwise, which make
-    # arbitrarily long K/V streams feasible. An explicit block_k is
-    # honored exactly (tests force the multi-tile path with it).
-    if block_k is None:
-        bk = lk if _vmem_fits(bq, lk, d) else min(_AUTO_BLOCK_K, lk)
-    else:
-        bk = min(block_k, lk)
-    if lk % bk:
+    bk = _select_bk(bq, lk, d, block_k)
+    if bk is None:
         raise ValueError(
-            f"block_k (clamped to {bk}) must divide Lk {lk}")
+            f"no valid K tile for Lk={lk}, block_q={bq}, d={d}, "
+            f"block_k={block_k}: the tile must divide Lk and its "
+            f"working set must fit VMEM (see _select_bk)")
     n_k = lk // bk
     grid = (h, lq // bq, n_k)
 
