@@ -27,6 +27,7 @@
  */
 #include "rlo_internal.h"
 
+#include <sched.h>
 #include <string.h>
 
 typedef struct coll_pend {
@@ -563,7 +564,9 @@ int rlo_coll_poll(rlo_coll *c)
 }
 
 /* Blocking convenience: spin poll to completion (one-process-per-rank
- * transports; single-process drivers must round-robin poll instead). */
+ * transports; single-process drivers must round-robin poll instead).
+ * Yields the CPU periodically — ranks are commonly oversubscribed on
+ * few cores, where a hot spin starves the very peer being awaited. */
 int rlo_coll_wait(rlo_coll *c, long max_spins)
 {
     for (long i = 0; i < max_spins; i++) {
@@ -572,6 +575,8 @@ int rlo_coll_wait(rlo_coll *c, long max_spins)
             return rc < 0 ? rc : RLO_OK;
         if (rlo_world_failed(c->w))
             return RLO_ERR_STALL;
+        if ((i & 63) == 63)
+            sched_yield();
     }
     return RLO_ERR_STALL;
 }

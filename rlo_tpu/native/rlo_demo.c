@@ -32,6 +32,7 @@
  */
 #include "rlo_core.h"
 
+#include <sched.h>
 #include <stdio.h>
 #include <stdlib.h>
 #include <string.h>
@@ -67,6 +68,8 @@ static int64_t pickup_spin(rlo_world *w, rlo_engine *e, int *tag,
         if (rlo_world_failed(w))
             return -1;
         rlo_progress_all(w);
+        if ((i & 63) == 63) /* ranks are oversubscribed on few cores */
+            sched_yield();
     }
     return -1;
 }
@@ -79,6 +82,8 @@ static int proposal_spin(rlo_world *w, rlo_engine *e)
             return 0;
         if (rlo_world_failed(w))
             return -1;
+        if ((i & 63) == 63)
+            sched_yield();
     }
     return -1;
 }
