@@ -461,6 +461,7 @@ static int case_bench(rlo_world *w, int rank, void *vcfg)
                "ranks: median %.0f usec\n",
                rlo_world_transport(w), (long long)nbytes, ws,
                times[reps / 2]);
+    fflush(stdout);
 
     /* ring allreduce over the same transport (rlo_coll.c) — the
      * bandwidth-optimal schedule, one real process per rank */
@@ -490,6 +491,7 @@ static int case_bench(rlo_world *w, int rank, void *vcfg)
                "median %.0f usec\n",
                rlo_world_transport(w), (long long)nbytes, ws,
                times[reps / 2]);
+    fflush(stdout);
     rlo_coll_free(coll);
     free(buf);
     free(acc);
@@ -557,6 +559,7 @@ static int case_nbcast(rlo_world *w, int rank, void *vcfg)
                reps, (long long)nbytes, (double)t_overlay / reps,
                (double)t_native / reps,
                (double)t_overlay / (double)(t_native ? t_native : 1));
+    fflush(stdout);
     free(buf);
     RCHECK(rlo_engine_err(e) == RLO_OK);
     rlo_engine_free(e);
