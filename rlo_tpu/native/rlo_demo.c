@@ -788,6 +788,7 @@ int main(int argc, char **argv)
              * children without MPI_Init would abort */
             printf("%-8s n=%-3d SKIP (mpirun-only)\n", CASES[c].name,
                    ws);
+            fflush(stdout);
             continue;
         }
 #endif
@@ -811,6 +812,9 @@ int main(int argc, char **argv)
                    rc == 0 ? "PASS" : "FAIL",
                    (unsigned long long)(rlo_now_usec() - t0),
                    reps == 2 && rep == 1 ? " [veto]" : "");
+            /* flush BEFORE the next fork: children inherit the stdio
+             * buffer, and their own flushes would replay it */
+            fflush(stdout);
             if (rc != 0)
                 failures++;
         }
