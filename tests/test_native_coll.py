@@ -112,6 +112,53 @@ class TestRingPieces:
             lambda r=r: colls[r].allreduce_start(x)
             for r in range(1, ws)])
 
+    def test_interleaved_with_engine_traffic(self):
+        """Colls (comm 64) and progress engines (comm 0) share one
+        world: the inbox demultiplexes by comm, so a broadcast storm
+        running INTERLEAVED with a ring allreduce must disturb
+        neither — every bcast delivers exactly once and the reduction
+        is exact."""
+        from rlo_tpu.native.bindings import NativeEngine
+
+        ws = 6
+        with NativeWorld(ws) as w:
+            engines = [NativeEngine(w, r) for r in range(ws)]
+            colls = [NativeColl(w, r) for r in range(ws)]
+            try:
+                xs = [np.full(16, float(r + 1), np.float32)
+                      for r in range(ws)]
+                outs = [colls[r].allreduce_start(xs[r])
+                        for r in range(ws)]
+                alive = set(range(ws))
+                for burst in range(3):
+                    for r in range(ws):
+                        engines[r].bcast(f"b{burst}r{r}".encode())
+                    for r in list(alive):  # advance colls mid-storm
+                        if colls[r].poll() == 1:
+                            alive.discard(r)
+                for _ in range(100_000):
+                    for r in list(alive):
+                        if colls[r].poll() == 1:
+                            alive.discard(r)
+                    w.progress_all()
+                    if not alive:
+                        break
+                assert not alive, "collective starved by engine traffic"
+                w.drain()
+                want = sum(range(1, ws + 1))
+                for o in outs:
+                    np.testing.assert_allclose(np.asarray(o), want)
+                for r, e in enumerate(engines):
+                    got = sorted(m.data
+                                 for m in iter(e.pickup_next, None))
+                    expect = sorted(f"b{b}r{s}".encode()
+                                    for b in range(3)
+                                    for s in range(ws) if s != r)
+                    assert got == expect, (r, got)
+            finally:
+                for c in colls:
+                    c.close()
+
     def test_sequential_ops_reuse_coll(self, world_colls):
         """Back-to-back collectives on the same coll objects (fresh
         opids per phase) must not cross-match."""
