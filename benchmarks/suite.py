@@ -147,15 +147,38 @@ def bench_config1(tiny: bool) -> None:
              f"(baseline = C bcast-gather, same substrate)",
           t_c_ring * 1e6, "usec", t_c / t_c_ring)
 
+    import re
+    import subprocess
+    from pathlib import Path
+    native = Path(__file__).resolve().parent.parent / "rlo_tpu" / "native"
+
+    # ring vs bcast-gather across REAL OS processes (shm transport, one
+    # process per rank — the config's "via mpirun" run shape)
+    try:
+        subprocess.run(["make", "-s", "demo"], cwd=native, check=True,
+                       capture_output=True, timeout=120)
+        proc = subprocess.run(
+            [str(native / "rlo_demo"), "-n", str(ws), "-c", "bench",
+             "-m", "3" if tiny else "5", "-b", str(n * 4)],
+            capture_output=True, text=True, timeout=280, check=True)
+        mg = re.search(r"bcast-gather.*median (\d+) usec", proc.stdout)
+        mr = re.search(r"ring allreduce.*median (\d+) usec", proc.stdout)
+        if mg and mr:
+            t_bg, t_ring = float(mg.group(1)), float(mr.group(1))
+            print(f"config1 shm processes: ring {t_ring:.0f} usec  "
+                  f"bcast-gather {t_bg:.0f} usec", file=sys.stderr)
+            _emit(1, f"engine-substrate RING allreduce across {ws} real "
+                     f"OS processes (shm transport, {_fmt_bytes(n*4)} "
+                     f"fp32; baseline = bcast-gather, same processes)",
+                  t_ring, "usec", t_bg / t_ring)
+    except (subprocess.SubprocessError, OSError) as ex:
+        print(f"config1 shm-process leg skipped: {ex}", file=sys.stderr)
+
     # overlay bcast vs the native library broadcast over REAL MPI
     # processes — the reference's native_benchmark_single_point_bcast
     # (rootless_ops.c:1675-1709), run via femtompirun + the nbcast demo
     # case. The overlay loses (store-and-forward through a polled
     # engine vs a direct library collective); reported honestly.
-    import re
-    import subprocess
-    from pathlib import Path
-    native = Path(__file__).resolve().parent.parent / "rlo_tpu" / "native"
     try:
         subprocess.run(["make", "-s", "mpidemo"], cwd=native, check=True,
                        capture_output=True, timeout=120)

@@ -312,6 +312,12 @@ int rlo_drain(rlo_world *w, int max_spins);
 /* <0 = error). One op may be armed per coll at a time; every rank     */
 /* must issue collectives in the same order. The coll's `comm` id      */
 /* must differ from every engine comm on the same world.               */
+/* Collectives are NOT failure-elastic (MPI-collective semantics): a   */
+/* rank dying mid-op stalls the survivors' polls until their spin      */
+/* budget (rlo_coll_wait returns RLO_ERR_STALL; on transports with a   */
+/* failed() signal the wait aborts as soon as the world is dead). The  */
+/* elastic path is the engine substrate: bcast/IAR survive failures    */
+/* via the detector + re-formed overlay (rlo_engine.c).                */
 /* ------------------------------------------------------------------ */
 typedef struct rlo_coll rlo_coll;
 

@@ -18,7 +18,7 @@ SUITE = Path(__file__).resolve().parent.parent / "benchmarks" / "suite.py"
 
 #: configs that emit several comparison lines (ring vs bcast-gather +
 #: the MPI_Bcast leg for 1; the TPU device leg for 5 when a chip is up)
-MULTI_LINE = {1: (2, 3), 5: (1, 2)}
+MULTI_LINE = {1: (2, 4), 5: (1, 2)}
 
 
 @pytest.mark.parametrize("config", [1, 2, 3, 4, 5])
