@@ -215,6 +215,12 @@ class ProgressEngine:
         # ring log of recently initiated/forwarded BCAST frames (raw
         # bytes), flooded point-to-point on view changes
         self._recent_bcasts: deque = deque(maxlen=64)
+        # settled consensus rounds: decisions forwarded by a mix of
+        # old- and new-topology trees during a view change can reach a
+        # rank twice; a settled (pid, gen) is delivered exactly once
+        # (the IAR analogue of the (origin, seq) broadcast dedup)
+        self._settled_rounds: deque = deque(maxlen=256)
+        self._settled_set: Set = set()
 
         # failure detection (net-new; SURVEY.md §5 "failure detection:
         # none" in the reference)
@@ -595,6 +601,19 @@ class ProgressEngine:
         pid, vote = msg.frame.pid, msg.frame.vote
         gen = struct.unpack_from("<i", msg.frame.payload)[0] \
             if len(msg.frame.payload) >= 4 else -1
+        if gen >= 0:  # ungenerated (foreign/legacy) frames: best-effort
+            if (pid, gen) in self._settled_set:
+                # duplicate across a view change: deliver exactly once,
+                # but STILL forward — a descendant reachable only
+                # through this second tree (its old-view parent died)
+                # has no other way to learn the decision
+                self._bc_forward(msg)
+                self.queue_wait.append(msg)  # free when sends complete
+                return
+            if len(self._settled_rounds) == self._settled_rounds.maxlen:
+                self._settled_set.discard(self._settled_rounds[0])
+            self._settled_rounds.append((pid, gen))
+            self._settled_set.add((pid, gen))
         pm = self._find_proposal_msg(pid, gen)
         self._bc_forward(msg)  # forward first; delivery below
         if pm is not None:

@@ -149,6 +149,8 @@ def load() -> C.CDLL:
     sig("rlo_engine_recved_bcast", C.c_int64, [p])
     sig("rlo_drain", C.c_int, [p, C.c_int])
     sig("rlo_world_barrier", None, [p])
+    sig("rlo_world_inject", C.c_int,
+        [p, C.c_int, C.c_int, C.c_int, C.c_int, u8p, C.c_int64])
     sig("rlo_now_usec", C.c_uint64, [])
     sig("rlo_trace_set", None, [C.c_int])
     sig("rlo_trace_enabled", C.c_int, [])
@@ -215,6 +217,16 @@ class NativeWorld:
     def barrier(self) -> None:
         """Collective barrier across ranks (shm/mpi; no-op loopback)."""
         self._lib.rlo_world_barrier(self._w)
+
+    def inject(self, src: int, dst: int, tag: int, raw: bytes,
+               comm: int = 0) -> None:
+        """Test support: place one raw frame on the (src, dst) channel
+        as if src had sent it (duplicate/stale-frame scenarios)."""
+        buf = (C.c_uint8 * len(raw)).from_buffer_copy(raw)
+        rc = self._lib.rlo_world_inject(self._w, src, dst, comm, tag,
+                                        buf, len(raw))
+        if rc != 0:
+            raise RuntimeError(f"inject failed ({rc})")
 
     def drain(self, max_spins: int = 100_000) -> int:
         rc = self._lib.rlo_drain(self._w, max_spins)

@@ -148,6 +148,10 @@ int64_t rlo_world_delivered_cnt(const rlo_world *w);
 /* Collective barrier across all ranks (shm: sense-reversing spin;
  * mpi: MPI_Barrier; no-op on single-process transports). */
 void rlo_world_barrier(rlo_world *w);
+/* Test support (in-process worlds): inject one raw frame as if `src`
+ * sent it — for duplicate/stale-frame scenarios. */
+int rlo_world_inject(rlo_world *w, int src, int dst, int comm, int tag,
+                     const uint8_t *raw, int64_t len);
 
 /* ------------------------------------------------------------------ */
 /* SHM transport: N real OS processes as ranks over a shared-memory     */

@@ -10,6 +10,8 @@
 
 /* depth of the recent-broadcast ring log re-flooded on view changes */
 #define RLO_RECENT_LOG 64
+/* settled consensus rounds remembered for decision dedup */
+#define RLO_SETTLED_LOG 256
 /* per-origin out-of-order dedup window (bits above the contiguous
  * watermark); reordering beyond this collapses to at-most-once */
 #define RLO_SEEN_BITS 256
@@ -100,6 +102,9 @@ struct rlo_engine {
     uint64_t *seen_mask;    /* per origin: 256-bit window above contig */
     rlo_blob *recent[RLO_RECENT_LOG];
     int recent_pos;
+    /* settled consensus rounds (decision dedup across view changes) */
+    struct { int32_t pid, gen; int used; } settled[RLO_SETTLED_LOG];
+    int settled_pos;
 };
 
 /* ---------------- queue ops ---------------- */
@@ -780,8 +785,43 @@ static void on_vote(rlo_engine *e, rlo_msg *m)
     msg_free(m);
 }
 
+/* settled-round dedup: a decision forwarded by a mix of old- and new-
+ * topology trees during a view change can reach a rank twice; record
+ * (pid, gen) of delivered decisions in a ring and drop repeats — the
+ * IAR analogue of the (origin, seq) broadcast dedup. Returns 1 when
+ * the round was already settled. */
+static int round_settled(rlo_engine *e, int32_t pid, int32_t gen)
+{
+    if (gen < 0)
+        return 0; /* ungenerated (foreign/legacy) frame: best-effort */
+    for (int i = 0; i < RLO_SETTLED_LOG; i++)
+        if (e->settled[i].pid == pid && e->settled[i].gen == gen &&
+            e->settled[i].used)
+            return 1;
+    e->settled[e->settled_pos].pid = pid;
+    e->settled[e->settled_pos].gen = gen;
+    e->settled[e->settled_pos].used = 1;
+    e->settled_pos = (e->settled_pos + 1) % RLO_SETTLED_LOG;
+    return 0;
+}
+
 static void on_decision(rlo_engine *e, rlo_msg *m)
 {
+    if (round_settled(e, m->pid, vote_gen(m))) {
+        /* duplicate across a view change: deliver exactly once, but
+         * STILL forward — a descendant reachable only through this
+         * second tree (its old-view parent died) has no other way to
+         * learn the decision. Park in the wait-only queue so the
+         * sweep frees it once the forwards complete. */
+        int frc = bc_forward(e, m);
+        if (frc < 0) {
+            set_err(e, frc);
+            msg_free(m);
+            return;
+        }
+        q_append(&e->q_wait, m);
+        return;
+    }
     rlo_msg *pm = find_proposal_msg(e, m->pid, vote_gen(m));
     int rc = bc_forward(e, m); /* forward first; delivery below */
     if (rc < 0)

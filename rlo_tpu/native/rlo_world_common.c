@@ -10,6 +10,8 @@
  */
 #include "rlo_internal.h"
 
+#include <string.h>
+
 int rlo_world_size(const rlo_world *w)
 {
     return w->world_size;
@@ -43,6 +45,25 @@ int rlo_world_quiescent(const rlo_world *w)
 int rlo_world_failed(const rlo_world *w)
 {
     return w->ops->failed ? w->ops->failed(w) : 0;
+}
+
+/* Test support: inject one raw frame as if `src` had sent it —
+ * duplicate/stale-frame scenarios (e.g. a decision replayed by a
+ * mixed-overlay forward during a view change) need a way to place
+ * arbitrary wire bytes on a channel. In-process worlds only. */
+int rlo_world_inject(rlo_world *w, int src, int dst, int comm, int tag,
+                     const uint8_t *raw, int64_t len)
+{
+    if (!w || !raw || len < 0 || src < 0 || src >= w->world_size ||
+        dst < 0 || dst >= w->world_size)
+        return RLO_ERR_ARG;
+    rlo_blob *b = rlo_blob_new(len);
+    if (!b)
+        return RLO_ERR_NOMEM;
+    memcpy(b->data, raw, (size_t)len);
+    int rc = rlo_world_isend(w, src, dst, comm, tag, b, 0);
+    rlo_blob_unref(b);
+    return rc;
 }
 
 void rlo_world_barrier(rlo_world *w)

@@ -280,6 +280,68 @@ class TestConcurrentMultiProposal:
                     assert eng_b[p].vote_my_proposal() == 1
 
 
+class TestDecisionDedup:
+    """A decision forwarded by a mix of old- and new-topology trees
+    during a view change can arrive twice; the settled-round dedup
+    delivers each (pid, gen) exactly once and runs the action callback
+    exactly once — in both engines."""
+
+    def test_duplicate_decision_dropped_python(self):
+        import struct
+        from rlo_tpu.engine import EngineManager, ProgressEngine
+        from rlo_tpu.transport.loopback import LoopbackWorld
+        from rlo_tpu.wire import Frame
+
+        world = make_world("loopback", 4)
+        mgr = EngineManager()
+        acted = []
+        engines = [ProgressEngine(world.transport(r), manager=mgr,
+                                  action_cb=lambda p, c: acted.append(p))
+                   for r in range(4)]
+        engines[0].submit_proposal(b"p", pid=0)
+        drain([world], engines)
+        gen = engines[0].my_own_proposal.gen
+        # replay the decision frame at rank 2 (as a mixed-overlay
+        # duplicate would)
+        dup = Frame(origin=0, pid=0, vote=1,
+                    payload=struct.pack("<i", gen))
+        world.transport(0).isend(2, int(Tag.IAR_DECISION), dup.encode())
+        for _ in range(50):
+            mgr.progress_all()
+        ds = decisions_of(engines[2])
+        assert len(ds) == 1, ds  # replay suppressed
+        assert acted.count(b"p") == 3  # ranks 1-3, once each
+
+    def test_duplicate_decision_dropped_native(self):
+        import struct
+        from rlo_tpu.native.bindings import NativeEngine, NativeWorld
+        from rlo_tpu.wire import Frame
+
+        with NativeWorld(4) as world:
+            engines = [NativeEngine(world, r) for r in range(4)]
+            assert engines[0].submit_proposal(b"p", pid=0) >= -1
+            for _ in range(10_000):
+                world.progress_all()
+                if engines[0].vote_my_proposal() != -1:
+                    break
+            world.drain()
+            # count decisions at rank 2, then replay the decision frame
+            seen = [m for m in iter(engines[2].pickup_next, None)
+                    if m.type == int(Tag.IAR_DECISION)]
+            assert len(seen) == 1
+            # reconstruct the decision's generation from the payload
+            gen = struct.unpack_from("<i", seen[0].data)[0]
+            dup = Frame(origin=0, pid=0, vote=1,
+                        payload=struct.pack("<i", gen))
+            world.inject(src=0, dst=2, tag=int(Tag.IAR_DECISION),
+                         raw=dup.encode())
+            for _ in range(100):
+                world.progress_all()
+            world.drain()
+            assert all(m.type != int(Tag.IAR_DECISION)
+                       for m in iter(engines[2].pickup_next, None))
+
+
 class TestEngineMultiplexing:
     @pytest.mark.parametrize("ws", [4, 8])
     def test_two_engines_concurrently(self, ws):
