@@ -433,6 +433,19 @@ class ProgressEngine:
                 msg.fwd_done = True
                 self.queue_wait.remove(msg)
 
+    def _bc_forward_only(self, msg: _Msg) -> None:
+        """Forward a duplicate store-and-forward frame along the overlay
+        without any local processing/delivery; the wait-only queue frees
+        it once the sends complete."""
+        origin = msg.frame.origin
+        raw = None
+        for dst in self._fwd_targets(origin, msg.src):
+            if raw is None:
+                raw = msg.frame.encode()
+            msg.send_handles.append(
+                self.transport.isend(dst, msg.tag, raw))
+        self.queue_wait.append(msg)
+
     def _bcast_is_dup(self, msg: _Msg) -> bool:
         """Exactly-once receipt check for Tag.BCAST frames, keyed on
         (origin, seq). The initiator never delivers its own broadcast,
@@ -505,6 +518,30 @@ class ProgressEngine:
     def _on_proposal(self, msg: _Msg) -> None:
         """~_iar_proposal_handler (:668-726)."""
         origin = msg.frame.origin
+        # duplicate across a view change (mixed old/new overlay trees):
+        # never re-judge or re-park — a second ProposalState voting to a
+        # second parent would corrupt the vote accounting. Forward for
+        # coverage (a descendant may be reachable only via this tree).
+        # A PENDING duplicate's sender is a live relay awaiting my vote
+        # (its await_from was built from its own forward list), so
+        # staying silent would deadlock its round: vote the verdict
+        # accumulated so far back to it. Optimistic — my subtree's veto
+        # may still be in flight on the original path — but the
+        # proposer ANDs every path, so a veto that exists reaches it
+        # through the original parent. A SETTLED duplicate needs no
+        # vote (the decision already broadcast; on_decision frees the
+        # sender's pending state).
+        gen = msg.frame.vote
+        pending = self._find_proposal_msg(msg.frame.pid, gen)
+        if pending is not None or (msg.frame.pid, gen) in \
+                self._settled_set:
+            if pending is not None and msg.src != \
+                    pending.prop_state.recv_from:
+                dup_ps = ProposalState(pid=msg.frame.pid, gen=gen,
+                                       recv_from=msg.src)
+                self._vote_back(dup_ps, pending.prop_state.vote)
+            self._bc_forward_only(msg)
+            return
         if (self.my_own_proposal.state == ReqState.IN_PROGRESS
                 and msg.frame.pid == self.my_own_proposal.pid):
             # pid collision with my active proposal — the reference only
@@ -607,8 +644,7 @@ class ProgressEngine:
                 # but STILL forward — a descendant reachable only
                 # through this second tree (its old-view parent died)
                 # has no other way to learn the decision
-                self._bc_forward(msg)
-                self.queue_wait.append(msg)  # free when sends complete
+                self._bc_forward_only(msg)
                 return
             if len(self._settled_rounds) == self._settled_rounds.maxlen:
                 self._settled_set.discard(self._settled_rounds[0])

@@ -413,6 +413,9 @@ static int cur_fwd_targets(rlo_engine *e, int origin, int src, int *out,
     return n;
 }
 
+static int round_settled_peek(const rlo_engine *e, int32_t pid,
+                              int32_t gen);
+
 /* ---------------- exactly-once broadcast dedup -------------------- */
 
 /* Shift the 256-bit window right by k bits (toward bit 0). */
@@ -638,8 +641,53 @@ static void set_err(rlo_engine *e, int err)
         e->err = err;
 }
 
+/* Forward a duplicate store-and-forward frame along the overlay with
+ * no local processing; parked in the wait-only queue until the sends
+ * complete. */
+static void bc_forward_only(rlo_engine *e, rlo_msg *m)
+{
+    int targets[64];
+    int n = cur_fwd_targets(e, m->origin, m->src, targets, 64);
+    if (n < 0) {
+        set_err(e, n);
+        msg_free(m);
+        return;
+    }
+    for (int i = 0; i < n; i++) {
+        int rc = eng_isend_frame(e, targets[i], m->tag, m->frame, m);
+        if (rc != RLO_OK) {
+            set_err(e, rc);
+            msg_free(m);
+            return;
+        }
+    }
+    q_append(&e->q_wait, m);
+}
+
 static void on_proposal(rlo_engine *e, rlo_msg *m)
 {
+    /* duplicate across a view change (mixed old/new overlay trees):
+     * never re-judge or re-park — a second proposal state voting to a
+     * second parent would corrupt the vote accounting. Forward for
+     * coverage. A PENDING duplicate's sender is a live relay awaiting
+     * my vote (its await list mirrors its forward list), so staying
+     * silent would deadlock its round: vote the verdict accumulated so
+     * far back to it (optimistic; a veto still reaches the proposer
+     * through the original parent, and the proposer ANDs every path).
+     * A SETTLED duplicate needs no vote — the decision already
+     * broadcast, and on_decision frees the sender's pending state. */
+    rlo_msg *dup = find_proposal_msg(e, m->pid, m->vote);
+    if (dup || (m->vote >= 0 && round_settled_peek(e, m->pid, m->vote))) {
+        if (dup && m->src != dup->ps->recv_from) {
+            rlo_prop vb = {0};
+            vb.pid = m->pid;
+            vb.gen = m->vote;
+            vb.recv_from = m->src;
+            vote_back(e, &vb, dup->ps->vote);
+        }
+        bc_forward_only(e, m);
+        return;
+    }
     if (e->own.state == RLO_IN_PROGRESS && m->pid == e->own.pid) {
         /* pid collision with my active proposal — the reference only
          * printf-warns (:690-692) and corrupts vote accounting; fail
@@ -790,14 +838,23 @@ static void on_vote(rlo_engine *e, rlo_msg *m)
  * (pid, gen) of delivered decisions in a ring and drop repeats — the
  * IAR analogue of the (origin, seq) broadcast dedup. Returns 1 when
  * the round was already settled. */
-static int round_settled(rlo_engine *e, int32_t pid, int32_t gen)
+/* Non-recording membership test of the settled-round ring. */
+static int round_settled_peek(const rlo_engine *e, int32_t pid,
+                              int32_t gen)
 {
-    if (gen < 0)
-        return 0; /* ungenerated (foreign/legacy) frame: best-effort */
     for (int i = 0; i < RLO_SETTLED_LOG; i++)
         if (e->settled[i].pid == pid && e->settled[i].gen == gen &&
             e->settled[i].used)
             return 1;
+    return 0;
+}
+
+static int round_settled(rlo_engine *e, int32_t pid, int32_t gen)
+{
+    if (gen < 0)
+        return 0; /* ungenerated (foreign/legacy) frame: best-effort */
+    if (round_settled_peek(e, pid, gen))
+        return 1;
     e->settled[e->settled_pos].pid = pid;
     e->settled[e->settled_pos].gen = gen;
     e->settled[e->settled_pos].used = 1;
@@ -811,15 +868,8 @@ static void on_decision(rlo_engine *e, rlo_msg *m)
         /* duplicate across a view change: deliver exactly once, but
          * STILL forward — a descendant reachable only through this
          * second tree (its old-view parent died) has no other way to
-         * learn the decision. Park in the wait-only queue so the
-         * sweep frees it once the forwards complete. */
-        int frc = bc_forward(e, m);
-        if (frc < 0) {
-            set_err(e, frc);
-            msg_free(m);
-            return;
-        }
-        q_append(&e->q_wait, m);
+         * learn the decision. */
+        bc_forward_only(e, m);
         return;
     }
     rlo_msg *pm = find_proposal_msg(e, m->pid, vote_gen(m));

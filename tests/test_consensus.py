@@ -289,7 +289,6 @@ class TestDecisionDedup:
     def test_duplicate_decision_dropped_python(self):
         import struct
         from rlo_tpu.engine import EngineManager, ProgressEngine
-        from rlo_tpu.transport.loopback import LoopbackWorld
         from rlo_tpu.wire import Frame
 
         world = make_world("loopback", 4)
@@ -311,6 +310,107 @@ class TestDecisionDedup:
         ds = decisions_of(engines[2])
         assert len(ds) == 1, ds  # replay suppressed
         assert acted.count(b"p") == 3  # ranks 1-3, once each
+
+    def test_duplicate_proposal_not_rejudged_python(self):
+        """A proposal arriving twice (mixed-overlay trees) must be
+        judged and voted exactly once — a second judge/vote, possibly
+        to a different parent, would corrupt the vote accounting. The
+        duplicate is still forwarded for coverage."""
+        from rlo_tpu.engine import EngineManager, ProgressEngine
+        from rlo_tpu.wire import Frame
+
+        world = make_world("loopback", 4)
+        mgr = EngineManager()
+        judged = []
+        engines = [ProgressEngine(world.transport(r), manager=mgr,
+                                  judge_cb=lambda p, c, r=r: (
+                                      judged.append(r), 1)[1])
+                   for r in range(4)]
+        engines[0].submit_proposal(b"p", pid=0)
+        drain([world], engines)
+        assert engines[0].vote_my_proposal() == 1
+        base = sorted(judged)
+        gen = engines[0].my_own_proposal.gen
+        # replay the proposal at rank 1 as if re-sent by origin 0
+        dup = Frame(origin=0, pid=0, vote=gen, payload=b"p")
+        world.transport(0).isend(1, int(Tag.IAR_PROPOSAL), dup.encode())
+        for _ in range(100):
+            mgr.progress_all()
+        drain([world], engines)
+        assert sorted(judged) == base, (judged, base)  # no re-judging
+
+    def test_duplicate_proposal_not_rejudged_native(self):
+        from rlo_tpu.native.bindings import NativeEngine, NativeWorld
+        from rlo_tpu.wire import Frame
+
+        judged = []
+        with NativeWorld(4) as world:
+            engines = [NativeEngine(
+                world, r,
+                judge_cb=lambda p, c, r=r: (judged.append(r), 1)[1])
+                for r in range(4)]
+            assert engines[0].submit_proposal(b"p", pid=0) >= -1
+            for _ in range(10_000):
+                world.progress_all()
+                if engines[0].vote_my_proposal() != -1:
+                    break
+            world.drain()
+            base = sorted(judged)
+            # the decision payload at a relay carries the generation
+            seen = [m for m in iter(engines[2].pickup_next, None)
+                    if m.type == int(Tag.IAR_DECISION)]
+            import struct
+            gen = struct.unpack_from("<i", seen[0].data)[0]
+            dup = Frame(origin=0, pid=0, vote=gen, payload=b"p")
+            world.inject(src=0, dst=1, tag=int(Tag.IAR_PROPOSAL),
+                         raw=dup.encode())
+            for _ in range(100):
+                world.progress_all()
+            world.drain()
+            assert sorted(judged) == base, (judged, base)
+
+    def test_pending_duplicate_votes_back_to_new_parent(self):
+        """The deadlock case: a relay that receives a PENDING duplicate
+        from a different (new-view) parent must vote its accumulated
+        verdict back to that parent — the sender's await list includes
+        this rank and silence would hang its round forever."""
+        import struct
+        from rlo_tpu.engine import EngineManager, ProgressEngine
+        from rlo_tpu.transport.loopback import LoopbackWorld
+        from rlo_tpu.wire import Frame
+
+        world = LoopbackWorld(4)
+        mgr = EngineManager()
+        judged = []
+        eng1 = ProgressEngine(world.transport(1), manager=mgr,
+                              judge_cb=lambda p, c: (judged.append(1),
+                                                     1)[1])
+        gen = 12345
+        # original proposal from origin/parent 0: rank 1 judges, votes
+        # to 0, parks the pending state
+        orig = Frame(origin=0, pid=7, vote=gen, payload=b"p")
+        world.transport(0).isend(1, int(Tag.IAR_PROPOSAL), orig.encode())
+        mgr.progress_all()
+        assert judged == [1]
+        assert len(eng1.queue_iar_pending) == 1
+        # drain rank 0's inbox (the original vote)
+        while world.transport(0).poll() is not None:
+            pass
+        # duplicate arrives from rank 2 (a new-view parent)
+        dup = Frame(origin=0, pid=7, vote=gen, payload=b"p")
+        world.transport(2).isend(1, int(Tag.IAR_PROPOSAL), dup.encode())
+        mgr.progress_all()
+        assert judged == [1]  # not re-judged
+        assert len(eng1.queue_iar_pending) == 1  # not re-parked
+        got = []
+        while (item := world.transport(2).poll()) is not None:
+            got.append(item)
+        votes = [(s, t, Frame.decode(raw)) for (s, t, raw) in got
+                 if t == int(Tag.IAR_VOTE)]
+        assert len(votes) == 1, got
+        s, t, f = votes[0]
+        assert s == 1 and f.pid == 7 and f.vote == 1
+        assert struct.unpack_from("<i", f.payload)[0] == gen
 
     def test_duplicate_decision_dropped_native(self):
         import struct
