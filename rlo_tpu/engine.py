@@ -697,8 +697,12 @@ class ProgressEngine:
     #     delivery degrades to at-most-once for the evicted ones;
     #   - broadcasts whose *initiator* died mid-send are at-most-once
     #     (a frame the origin never handed any survivor is gone).
-    # Consensus rounds stay exactly-once via vote discounting +
-    # (pid, generation) matching.
+    # Consensus traffic is exactly-once too: duplicate proposals are
+    # never re-judged (a pending duplicate's new parent receives the
+    # accumulated verdict so its round stays live), duplicate
+    # decisions deliver/act once per (pid, gen) while still forwarding
+    # for coverage, and vote accounting uses (pid, generation)
+    # matching + failure discounting throughout.
     # ------------------------------------------------------------------
     def _cur_initiator_targets(self):
         """Initiator send list over the current alive set. Identity to the
