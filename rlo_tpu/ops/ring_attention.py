@@ -150,9 +150,13 @@ def ring_attention(q, k, v, axis: str, *, causal: bool = False,
         def update(s, kc, vc, m, l, o):
             src = (idx - s) % ws
             kp = positions(src).astype(jnp.int32).reshape(1, blk)
+            # pallas_fast backward: the l-normalization after the ring
+            # loop makes the dropped max-routing term analytically
+            # zero (see pallas.flash._pallas_bwd)
             return flash_block_update_hld(
                 q_hld, kc, vc, m, l, o, qp, kp, causal=causal,
-                scale=scale, block_q=block_q, block_k=block_k)
+                scale=scale, block_q=block_q, block_k=block_k,
+                bwd="pallas_fast")
 
         def step(s, carry):
             kc, vc, m, l, o = carry

@@ -1,0 +1,322 @@
+"""Differentiability of the flash kernel (round-2 VERDICT item 1).
+
+The confirmed round-2 crash: jax.grad through the pallas flash path
+raised "JVP with aliasing not supported", and ring/ulysses attention
+auto-enable that path on TPU — so sp-sharded *training* on the target
+hardware was broken. The fix is a custom_vjp whose backward recomputes
+score tiles in VMEM (pallas/flash.py:_pallas_bwd). These tests pin:
+
+- the VJP exists: jax.grad through flash_block_update_hld, ring
+  attention, and ulysses attention with use_pallas=True does not raise;
+- grad parity: both backward implementations ('pallas' hand-written,
+  'xla' autodiff-through-restatement) match autodiff through the
+  unfused reference math, for single updates and chained updates
+  (the ring-loop composition), causal and not, multi-tile K included;
+- dtype contract: cotangents come back in the primal dtypes (bf16
+  K/V get bf16 grads).
+
+All run in interpret mode on the CPU mesh — the identical kernel code
+path that compiles on TPU.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rlo_tpu.ops.ring_attention import full_attention, ring_attention
+from rlo_tpu.ops.ulysses import ulysses_attention
+from rlo_tpu.pallas.flash import (_NEG, _ref_block_update_hld,
+                                  flash_attention, flash_block_update_hld)
+from rlo_tpu.parallel.mesh import make_mesh, shard_jit
+from jax.sharding import PartitionSpec as P
+
+WS = 8
+
+
+def make_hld(seed, h, lq, lk, d, dtype=jnp.float32):
+    rng = np.random.default_rng(seed)
+    q = jnp.asarray(rng.standard_normal((h, lq, d)) * 0.5, dtype)
+    k = jnp.asarray(rng.standard_normal((h, lk, d)) * 0.5, dtype)
+    v = jnp.asarray(rng.standard_normal((h, lk, d)) * 0.5, dtype)
+    m = jnp.asarray(rng.standard_normal((h, 1, lq)), jnp.float32)
+    l = jnp.asarray(rng.uniform(0.5, 2.0, (h, 1, lq)), jnp.float32)
+    o = jnp.asarray(rng.standard_normal((h, lq, d)), jnp.float32)
+    qp = jnp.arange(lq, dtype=jnp.int32).reshape(1, lq)
+    kp = jnp.arange(lk, dtype=jnp.int32).reshape(1, lk)
+    return q, k, v, m, l, o, qp, kp
+
+
+def _loss_of(update):
+    """Scalar functional of a block update's (m', l', o') — weights
+    every output so every cotangent path is exercised."""
+    def loss(q, k, v, m, l, o, qp, kp):
+        m2, l2, o2 = update(q, k, v, m, l, o, qp, kp)
+        return (jnp.sum(o2 * jnp.cos(jnp.arange(o2.size)
+                                     .reshape(o2.shape) * 0.01))
+                + jnp.sum(jnp.sin(l2)) + jnp.sum(m2 * 0.3))
+    return loss
+
+
+@pytest.mark.parametrize("bwd", ["xla", "pallas"])
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("h,lq,lk,d,block_q,block_k", [
+    (2, 32, 32, 16, 16, None),      # multi-Q-tile, single K tile
+    (1, 16, 64, 8, 16, 16),         # forced multi-K-tile accumulation
+    (3, 24, 48, 16, 8, 24),         # odd-ish tiling both axes
+])
+def test_single_update_grads_match_reference(bwd, causal, h, lq, lk, d,
+                                             block_q, block_k):
+    args = make_hld(0, h, lq, lk, d)
+    flash = functools.partial(flash_block_update_hld, causal=causal,
+                              scale=0.3, block_q=block_q,
+                              block_k=block_k, interpret=True, bwd=bwd)
+    ref = functools.partial(_ref_block_update_hld, causal=causal,
+                            scale=0.3)
+
+    def ref_update(q, k, v, m, l, o, qp, kp):
+        return ref(q, k, v, m, l, o, qp, kp)
+
+    g_flash = jax.grad(_loss_of(flash), argnums=(0, 1, 2, 3, 4, 5))(*args)
+    g_ref = jax.grad(_loss_of(ref_update), argnums=(0, 1, 2, 3, 4, 5))(*args)
+    for gf, gr, name in zip(g_flash, g_ref,
+                            ["dq", "dk", "dv", "dm", "dl", "do"]):
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gr),
+                                   rtol=2e-4, atol=2e-4, err_msg=name)
+
+
+@pytest.mark.parametrize("bwd", ["xla", "pallas"])
+@pytest.mark.parametrize("causal", [False, True])
+def test_chained_updates_grads_match_reference(bwd, causal):
+    """Two chained block updates + normalization — the ring-attention
+    composition shape, where the (m, l, o) cotangents flowing between
+    steps are nontrivial and the m' cotangent identity must hold."""
+    h, lq, d = 2, 16, 8
+    rng = np.random.default_rng(7)
+    q = jnp.asarray(rng.standard_normal((h, lq, d)) * 0.5, jnp.float32)
+    k1 = jnp.asarray(rng.standard_normal((h, lq, d)) * 0.5, jnp.float32)
+    v1 = jnp.asarray(rng.standard_normal((h, lq, d)) * 0.5, jnp.float32)
+    k2 = jnp.asarray(rng.standard_normal((h, lq, d)) * 0.5, jnp.float32)
+    v2 = jnp.asarray(rng.standard_normal((h, lq, d)) * 0.5, jnp.float32)
+    qp = jnp.arange(lq, dtype=jnp.int32).reshape(1, lq)
+    kp1 = qp
+    kp2 = jnp.arange(lq, 2 * lq, dtype=jnp.int32).reshape(1, lq)
+
+    def chain(update):
+        def loss(q, k1, v1, k2, v2):
+            m = jnp.full((h, 1, lq), _NEG, jnp.float32)
+            l = jnp.zeros((h, 1, lq), jnp.float32)
+            o = jnp.zeros((h, lq, d), jnp.float32)
+            m, l, o = update(q, k1, v1, m, l, o, qp, kp1)
+            m, l, o = update(q, k2, v2, m, l, o, qp, kp2)
+            lt = l.transpose(0, 2, 1)
+            out = o / jnp.where(lt > 0, lt, 1.0)
+            return jnp.sum(out * jnp.tanh(
+                jnp.arange(out.size).reshape(out.shape) * 0.01))
+        return loss
+
+    flash = functools.partial(flash_block_update_hld, causal=causal,
+                              scale=0.35, block_q=8, interpret=True,
+                              bwd=bwd)
+    ref = functools.partial(_ref_block_update_hld, causal=causal,
+                            scale=0.35)
+    g_flash = jax.grad(chain(flash), argnums=(0, 1, 2, 3, 4))(
+        q, k1, v1, k2, v2)
+    g_ref = jax.grad(chain(ref), argnums=(0, 1, 2, 3, 4))(
+        q, k1, v1, k2, v2)
+    for gf, gr, name in zip(g_flash, g_ref,
+                            ["dq", "dk1", "dv1", "dk2", "dv2"]):
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gr),
+                                   rtol=3e-4, atol=3e-4, err_msg=name)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_tie_routing_matches_jax_semantics(causal):
+    """Degenerate inputs that force every tie branch of the exact
+    backward: duplicated K rows (reduce_max divides the cotangent among
+    cnt tied argmax slots), m preset to the exact row max (maximum's
+    0.5/0.5 split), and a zero q row (every score ties at 0). The
+    shipped random-data cases never leave cnt==1, so this is the only
+    coverage of _rowstats_kernel's count actually being used."""
+    h, lq, lk, d = 1, 8, 16, 8
+    rng = np.random.default_rng(21)
+    q = jnp.asarray(rng.standard_normal((h, lq, d)) * 0.5, jnp.float32)
+    q = q.at[0, 3].set(0.0)                   # all-tie row (scores 0)
+    k = jnp.asarray(rng.standard_normal((h, lk, d)) * 0.5, jnp.float32)
+    k = k.at[0, 9].set(k[0, 4])               # duplicated key: cnt=2
+    v = jnp.asarray(rng.standard_normal((h, lk, d)) * 0.5, jnp.float32)
+    l = jnp.asarray(rng.uniform(0.5, 2.0, (h, 1, lq)), jnp.float32)
+    o = jnp.asarray(rng.standard_normal((h, lq, d)), jnp.float32)
+    qp = jnp.arange(lq, dtype=jnp.int32).reshape(1, lq)
+    kp = jnp.arange(lk, dtype=jnp.int32).reshape(1, lk)
+    # m = the exact row max for rows 0-1 (maximum tie), -inf-ish for 2+
+    ref = functools.partial(_ref_block_update_hld, causal=causal,
+                            scale=0.3)
+    m = jnp.full((h, 1, lq), _NEG, jnp.float32)
+    m2_probe, _, _ = ref(q, k, v, m, l, o, qp, kp)
+    m = m.at[0, 0, 0:2].set(m2_probe[0, 0, 0:2])
+    args = (q, k, v, m, l, o, qp, kp)
+
+    flash = functools.partial(flash_block_update_hld, causal=causal,
+                              scale=0.3, block_q=8, block_k=8,
+                              interpret=True, bwd="pallas")
+    g_flash = jax.grad(_loss_of(flash), argnums=(0, 1, 2, 3, 4, 5))(*args)
+    g_ref = jax.grad(_loss_of(ref), argnums=(0, 1, 2, 3, 4, 5))(*args)
+    for gf, gr, name in zip(g_flash, g_ref,
+                            ["dq", "dk", "dv", "dm", "dl", "do"]):
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gr),
+                                   rtol=2e-4, atol=2e-4, err_msg=name)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_chained_pallas_fast_matches_reference(causal):
+    """The fast backward (no tie prepass) must still be exact through
+    the normalized composition — the production training path."""
+    h, lq, d = 2, 16, 8
+    rng = np.random.default_rng(9)
+    mk = lambda: jnp.asarray(
+        rng.standard_normal((h, lq, d)) * 0.5, jnp.float32)
+    q, k1, v1, k2, v2 = mk(), mk(), mk(), mk(), mk()
+    qp = jnp.arange(lq, dtype=jnp.int32).reshape(1, lq)
+    kp2 = jnp.arange(lq, 2 * lq, dtype=jnp.int32).reshape(1, lq)
+
+    def chain(update):
+        def loss(q, k1, v1, k2, v2):
+            m = jnp.full((h, 1, lq), _NEG, jnp.float32)
+            l = jnp.zeros((h, 1, lq), jnp.float32)
+            o = jnp.zeros((h, lq, d), jnp.float32)
+            m, l, o = update(q, k1, v1, m, l, o, qp, qp)
+            m, l, o = update(q, k2, v2, m, l, o, qp, kp2)
+            lt = l.transpose(0, 2, 1)
+            out = o / jnp.where(lt > 0, lt, 1.0)
+            return jnp.sum(out * jnp.tanh(
+                jnp.arange(out.size).reshape(out.shape) * 0.01))
+        return loss
+
+    fast = functools.partial(flash_block_update_hld, causal=causal,
+                             scale=0.35, block_q=8, interpret=True,
+                             bwd="pallas_fast")
+    ref = functools.partial(_ref_block_update_hld, causal=causal,
+                            scale=0.35)
+    g_fast = jax.grad(chain(fast), argnums=(0, 1, 2, 3, 4))(
+        q, k1, v1, k2, v2)
+    g_ref = jax.grad(chain(ref), argnums=(0, 1, 2, 3, 4))(
+        q, k1, v1, k2, v2)
+    for gf, gr, name in zip(g_fast, g_ref,
+                            ["dq", "dk1", "dv1", "dk2", "dv2"]):
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gr),
+                                   rtol=3e-4, atol=3e-4, err_msg=name)
+
+
+def test_bf16_kv_cotangent_dtypes():
+    h, lq, d = 1, 16, 8
+    q, k, v, m, l, o, qp, kp = make_hld(3, h, lq, lq, d)
+    kb, vb = k.astype(jnp.bfloat16), v.astype(jnp.bfloat16)
+    flash = functools.partial(flash_block_update_hld, causal=True,
+                              scale=0.3, block_q=8, interpret=True)
+    g = jax.grad(_loss_of(flash), argnums=(0, 1, 2))(
+        q, kb, vb, m, l, o, qp, kp)
+    assert g[0].dtype == jnp.float32
+    assert g[1].dtype == jnp.bfloat16
+    assert g[2].dtype == jnp.bfloat16
+
+
+def make_qkv(seed, seq, heads, dim, dtype=jnp.float32):
+    rng = np.random.default_rng(seed)
+
+    def one():
+        return jnp.asarray(
+            rng.standard_normal((seq, heads, dim)) * 0.5, dtype)
+
+    return one(), one(), one()
+
+
+def _sharded_grad(attn_fn, q, k, v, use_pallas, **kw):
+    """grad of a scalar loss of the sharded attention output, wrt the
+    full (replicated-gradient) q, k, v."""
+    mesh = make_mesh((WS,), ("sp",))
+
+    def loss(q_, k_, v_):
+        out = shard_jit(
+            lambda a, b, c: attn_fn(a, b, c, "sp",
+                                    use_pallas=use_pallas, **kw),
+            mesh, (P("sp"), P("sp"), P("sp")), P("sp"),
+            check_vma=False)(q_, k_, v_)
+        w = jnp.sin(jnp.arange(out.size).reshape(out.shape) * 0.01)
+        return jnp.sum(out.astype(jnp.float32) * w)
+
+    return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_grad_flash_matches_unfused(causal):
+    q, k, v = make_qkv(11, 64, 2, 16)
+    g_flash = _sharded_grad(ring_attention, q, k, v, True, causal=causal,
+                            block_q=8)
+    g_plain = _sharded_grad(ring_attention, q, k, v, False,
+                            causal=causal)
+    for gf, gp, name in zip(g_flash, g_plain, ["dq", "dk", "dv"]):
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gp),
+                                   rtol=3e-4, atol=3e-4, err_msg=name)
+
+
+def test_ring_attention_grad_striped_causal():
+    q, k, v = make_qkv(12, 64, 2, 16)
+    g_flash = _sharded_grad(ring_attention, q, k, v, True, causal=True,
+                            block_q=8, layout="striped")
+    g_plain = _sharded_grad(ring_attention, q, k, v, False, causal=True,
+                            layout="striped")
+    for gf, gp, name in zip(g_flash, g_plain, ["dq", "dk", "dv"]):
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gp),
+                                   rtol=3e-4, atol=3e-4, err_msg=name)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_grad_flash_matches_unfused(causal):
+    q, k, v = make_qkv(13, 64, 8, 16)
+    g_flash = _sharded_grad(ulysses_attention, q, k, v, True,
+                            causal=causal, block_q=8)
+    g_plain = _sharded_grad(ulysses_attention, q, k, v, False,
+                            causal=causal)
+    for gf, gp, name in zip(g_flash, g_plain, ["dq", "dk", "dv"]):
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gp),
+                                   rtol=3e-4, atol=3e-4, err_msg=name)
+
+
+def test_flash_attention_whole_grad_matches_full():
+    """Single-device whole attention: grads through flash_attention
+    equal grads through the unfused full_attention oracle."""
+    q, k, v = make_qkv(14, 32, 2, 16)
+
+    def loss(attn):
+        def f(q_, k_, v_):
+            out = attn(q_, k_, v_)
+            w = jnp.cos(jnp.arange(out.size).reshape(out.shape) * 0.02)
+            return jnp.sum(out.astype(jnp.float32) * w)
+        return f
+
+    g_flash = jax.grad(
+        loss(functools.partial(flash_attention, causal=True, block_q=8,
+                               interpret=True)),
+        argnums=(0, 1, 2))(q, k, v)
+    g_full = jax.grad(
+        loss(functools.partial(full_attention, causal=True)),
+        argnums=(0, 1, 2))(q, k, v)
+    for gf, gp, name in zip(g_flash, g_full, ["dq", "dk", "dv"]):
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gp),
+                                   rtol=3e-4, atol=3e-4, err_msg=name)
+
+
+def test_value_unchanged_by_vjp_wrapper():
+    """The custom_vjp wrapper must not perturb the primal: forward
+    values equal the round-2 kernel output (parity vs the reference
+    restatement)."""
+    args = make_hld(5, 2, 32, 32, 16)
+    got = flash_block_update_hld(*args, causal=True, scale=0.3,
+                                 block_q=16, interpret=True)
+    want = _ref_block_update_hld(*args, causal=True, scale=0.3)
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                   rtol=1e-5, atol=1e-5)
