@@ -88,14 +88,23 @@ class HybridBackend(Backend):
         r's vote comes from the device memory holding xs[r], not from
         host copies — the device-side analogue of every rank judging
         its local state (rootless_ops.c:698)."""
-        from rlo_tpu.parallel.consensus import TpuConsensus
+        from rlo_tpu.parallel.consensus import (JudgeWrapperCache,
+                                                TpuConsensus)
 
         if not hasattr(self, "_consensus"):
             self._consensus = TpuConsensus(self._tpu.mesh, "x")
+            self._judge_wrappers = JudgeWrapperCache()
+        # stable wrapper per user judge: shard_votes keys its compiled
+        # program on the wrapper's id(), so a per-call lambda would
+        # recompile and leak a cache entry every round (round-2 advisor
+        # finding)
+        wrapper = self._judge_wrappers.get(
+            device_judge, lambda get_judge: lambda v: get_judge()(v[0]))
         stacked = np.stack(xs)
-        return self._consensus.shard_votes(
-            stacked, lambda v: device_judge(v[0]),
-            key=id(device_judge)).reshape(-1)
+        # identity rides on the pinned wrapper's id() inside
+        # shard_votes' key — never the raw judge's id(), which is
+        # ephemeral for bound methods
+        return self._consensus.shard_votes(stacked, wrapper).reshape(-1)
 
     def propose_collective(self, op: str, xs: Sequence[np.ndarray],
                            proposer: int = 0, reduce_op: str = "sum",

@@ -99,7 +99,8 @@ def _named(name: str):
 
 
 def allreduce(x, axis: str, *, op: str = "sum", algorithm: str = "auto",
-              use_pallas: Optional[bool] = None):
+              use_pallas: Optional[bool] = None,
+              pipeline_chunks: int = 2):
     """Reduction of per-shard ``x`` across ``axis``; result replicated.
 
     algorithm: 'psum' lowers to one XLA AllReduce (the baseline to beat);
@@ -134,7 +135,8 @@ def allreduce(x, axis: str, *, op: str = "sum", algorithm: str = "auto",
         if algorithm == "recursive_doubling":
             return _allreduce_rd(x, axis, op, use_pallas)
         if algorithm == "bidir_ring":
-            return _bidir_ring_allreduce(x, axis, op, use_pallas)
+            return _bidir_ring_allreduce(x, axis, op, use_pallas,
+                                         pipeline_chunks)
         if algorithm == "ring":
             chunks, meta = _chunk_shard(x, lax.axis_size(axis))
             _, reduced = _ring_reduce_scatter(chunks, axis, op, use_pallas)

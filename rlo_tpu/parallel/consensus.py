@@ -16,6 +16,7 @@ decision -> action, rootless_ops.c:876-932):
 
 from __future__ import annotations
 
+import weakref
 from typing import Callable, Optional
 
 import jax
@@ -24,6 +25,61 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from rlo_tpu.ops import tpu_collectives
+
+
+class JudgeWrapperCache:
+    """One stable wrapper per user judge function.
+
+    The sharded-decision caches key compiled programs on the wrapper's
+    id(); a wrapper recreated per call therefore recompiles the
+    shard_map program and permanently pins a fresh cache entry every
+    round (round-2 advisor finding). Identity rules:
+
+    - bound methods are keyed on (id(__self__), __func__): accessing
+      ``obj.judge`` mints a NEW ephemeral method object per round, so
+      keying on the object itself would evaporate between rounds and
+      reintroduce the per-round recompile. The entry dies with
+      __self__ (weakref callback), so a recycled id can never hit a
+      stale wrapper.
+    - other callables are keyed weakly so user judges are not pinned;
+      the wrapper closes over a weakref for the same reason (a strong
+      closure would keep the WeakKeyDictionary entry alive forever).
+    - judges that don't support weakrefs fall back to a strong
+      id-keyed map — they recompile once, never per call."""
+
+    def __init__(self):
+        self._weak = weakref.WeakKeyDictionary()
+        self._methods: dict = {}
+        self._strong: dict = {}
+
+    def get(self, judge, make):
+        """Return the cached wrapper for ``judge``, building it with
+        ``make(get_judge)`` on first use (``get_judge`` is a zero-arg
+        callable resolving to the live judge)."""
+        import types
+
+        if isinstance(judge, types.MethodType):
+            k = (id(judge.__self__), judge.__func__)
+            if k not in self._methods:
+                func = judge.__func__
+                ref_self = weakref.ref(
+                    judge.__self__,
+                    lambda _ref: self._methods.pop(k, None))
+                self._methods[k] = make(
+                    lambda: types.MethodType(func, ref_self()))
+            return self._methods[k]
+        try:
+            return self._weak[judge]
+        except KeyError:
+            ref = weakref.ref(judge)
+            wrapper = make(ref)
+            self._weak[judge] = wrapper
+            return wrapper
+        except TypeError:  # judge not weakref-able: pin it
+            k = id(judge)
+            if k not in self._strong:
+                self._strong[k] = (judge, make(lambda: judge))
+            return self._strong[k][1]
 
 
 class TpuConsensus:
@@ -46,6 +102,7 @@ class TpuConsensus:
         self.action_cb = action_cb
         self.axis_size = mesh.shape[axis]
         self._sharded_cache: dict = {}
+        self._io_wrappers = JudgeWrapperCache()
         self._decide = jax.jit(jax.shard_map(
             lambda v: tpu_collectives.consensus(v, axis),
             mesh=mesh, in_specs=P(axis), out_specs=P(axis)))
@@ -140,9 +197,19 @@ class TpuConsensus:
         semantics."""
         from jax.experimental import io_callback
 
-        def device_judge(v):
-            return io_callback(
-                lambda blk: np.int32(1 if shard_judge(blk) else 0),
-                jax.ShapeDtypeStruct((), jnp.int32), v)
+        def make(get_judge):
+            def device_judge(v):
+                return io_callback(
+                    lambda blk: np.int32(1 if get_judge()(blk) else 0),
+                    jax.ShapeDtypeStruct((), jnp.int32), v)
+            return device_judge
+
+        # stable wrapper per shard_judge: repeated rounds with the same
+        # judge reuse one compiled program instead of recompiling and
+        # leaking a cache entry per call (round-2 advisor finding). The
+        # wrapper's id() carries the judge identity in the program
+        # cache key — never the raw judge's id(), which is ephemeral
+        # for bound methods (obj.judge mints a new object per access)
+        device_judge = self._io_wrappers.get(shard_judge, make)
         return self.submit_sharded(proposal, x, device_judge,
-                                   key=("io", id(shard_judge)))
+                                   key="io")

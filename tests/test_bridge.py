@@ -104,3 +104,45 @@ class TestProposedCollective:
         decision, out = backend.propose_collective(
             "allreduce", xs, proposer=0, device_judge=finite)
         assert decision == 0 and out is None
+
+    def test_device_judge_reuse_hits_compile_cache(self, backend):
+        """Repeated rounds with the SAME judge must reuse one compiled
+        shard_map program: the round-2 advisor found each call minting
+        a fresh wrapper lambda, recompiling, and permanently leaking a
+        cache entry per round."""
+        import jax.numpy as jnp
+        finite = lambda v: jnp.all(jnp.isfinite(v)).astype(jnp.int32)
+        xs = _xs(seed=7)
+        backend.propose_collective("allreduce", xs, device_judge=finite)
+        cache = backend._consensus._sharded_cache
+        n_before = len(cache)
+        for seed in (8, 9, 10):
+            decision, _ = backend.propose_collective(
+                "allreduce", _xs(seed=seed), device_judge=finite)
+            assert decision == 1
+        assert len(cache) == n_before, (
+            "repeat rounds with one judge grew the compiled-program "
+            f"cache from {n_before} to {len(cache)}")
+
+    def test_bound_method_judge_reuse_hits_compile_cache(self, backend):
+        """obj.judge mints a fresh bound-method object per attribute
+        access, so id()-keyed caching silently degrades to a recompile
+        per round — the wrapper cache must key methods on
+        (id(__self__), __func__) instead."""
+        import jax.numpy as jnp
+
+        class Judge:
+            def judge(self, v):
+                return jnp.all(jnp.isfinite(v)).astype(jnp.int32)
+
+        j = Judge()
+        xs = _xs(seed=11)
+        backend.propose_collective("allreduce", xs,
+                                   device_judge=j.judge)
+        cache = backend._consensus._sharded_cache
+        n_before = len(cache)
+        for seed in (12, 13, 14):
+            decision, _ = backend.propose_collective(
+                "allreduce", _xs(seed=seed), device_judge=j.judge)
+            assert decision == 1
+        assert len(cache) == n_before

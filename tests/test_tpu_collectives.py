@@ -290,6 +290,41 @@ class TestBarrierConsensus:
         y[4] = 100.0  # shard 4's sum violates the bound
         assert c.submit_host_sharded(b"p", y, shard_judge) == 0
 
+    def test_host_sharded_reuse_hits_compile_cache(self, mesh):
+        """Same judge across rounds must reuse one compiled program
+        (round-2 advisor: a per-call io_callback closure recompiled and
+        leaked a cache entry per round)."""
+        def shard_judge(blk):
+            return bool(np.asarray(blk).sum() < 100.0)
+
+        c = TpuConsensus(mesh, "x")
+        x = np.ones((WS, 4), np.float32)
+        assert c.submit_host_sharded(b"p", x, shard_judge) == 1
+        n_before = len(c._sharded_cache)
+        for _ in range(3):
+            assert c.submit_host_sharded(b"p", x, shard_judge) == 1
+        assert len(c._sharded_cache) == n_before
+
+    def test_host_sharded_bound_method_judge_reuse(self, mesh):
+        """Bound-method judges (obj.judge is a fresh object per
+        access) must also hit the compiled-program cache."""
+        class Judge:
+            def ok(self, blk):
+                return bool(np.asarray(blk).sum() < 100.0)
+
+        j = Judge()
+        c = TpuConsensus(mesh, "x")
+        x = np.ones((WS, 4), np.float32)
+        assert c.submit_host_sharded(b"p", x, j.ok) == 1
+        n_before = len(c._sharded_cache)
+        for _ in range(3):
+            assert c.submit_host_sharded(b"p", x, j.ok) == 1
+        assert len(c._sharded_cache) == n_before
+        # a DIFFERENT instance is a different judge: new program
+        j2 = Judge()
+        assert c.submit_host_sharded(b"p", x, j2.ok) == 1
+        assert len(c._sharded_cache) == n_before + 1
+
 
 class TestMultiAxisMesh:
     def test_allreduce_over_one_axis_of_2d_mesh(self):
