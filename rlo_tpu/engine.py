@@ -29,7 +29,7 @@ import struct
 import time
 from collections import deque
 from dataclasses import dataclass, field
-from typing import Callable, List, Optional, Set
+from typing import Callable, List, Optional, Sequence, Set
 
 from rlo_tpu import topology
 from rlo_tpu.transport.base import SendHandle, Transport
@@ -80,6 +80,15 @@ class ProposalState:
     # lets the failure detector discount a dead child so consensus
     # completes instead of waiting forever (net-new vs the reference)
     await_from: List[int] = field(default_factory=list)
+    # additional vote-tree parents acquired from duplicate proposals
+    # (re-formed overlay trees during view changes); they receive the
+    # SAME merged vote as recv_from when the round resolves — voting an
+    # interim verdict to them could lose a subtree veto still in flight
+    # (round-2 advisor finding)
+    dup_parents: List[int] = field(default_factory=list)
+    # the merged vote has been determined and sent up — a later
+    # duplicate's parent can safely receive it immediately
+    resolved: bool = False
 
 
 @dataclass
@@ -154,7 +163,8 @@ class ProgressEngine:
                  failure_timeout: Optional[float] = None,
                  heartbeat_interval: Optional[float] = None,
                  failure_cb: Optional[Callable[[int, bool], None]] = None,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 members: Optional[Sequence[int]] = None):
         """``failure_timeout`` (seconds) enables the net-new failure
         detector (the reference defines RLO_FAILED but never assigns it,
         SURVEY.md §5): ranks heartbeat their ring successor every
@@ -164,7 +174,17 @@ class ProgressEngine:
         elastically re-form the overlay (topology recomputed over the
         alive set) so broadcasts and consensus keep working.
         ``failure_cb(rank, detected_locally)`` fires once per learned
-        failure. ``clock`` is injectable for deterministic tests."""
+        failure. ``clock`` is injectable for deterministic tests.
+
+        ``members`` restricts the engine to a RANK SUBSET — the
+        reference's engines-over-sub-communicators capability
+        (RLO_progress_engine_new on any MPI_Comm,
+        rootless_ops.c:467, 1461). The overlay topology is computed
+        over virtual ranks 0..len(members)-1 (the same translation the
+        elastic re-forming uses), so bcast/IAR span exactly the member
+        set; non-members never see this engine's traffic. This rank
+        must be a member; create the subset engine only on member
+        ranks."""
         ws = transport.world_size
         if ws < 2:  # bcomm_init rejects this (rootless_ops.c:1464)
             raise ValueError(f"world_size must be >= 2, got {ws}")
@@ -231,16 +251,31 @@ class ProgressEngine:
         self.clock = clock
         self.failed: Set[int] = set()
         self.suspected_self = False
-        # aborted relays whose decision may still arrive:
-        # (pid, gen) -> (proposer, payload). Bounded: entries are
-        # consumed by their decision, pruned when their proposer dies,
-        # and capped (oldest-first) against decisions lost in a
-        # view-change window.
-        self._orphaned_props: dict = {}
         self._alive: List[int] = list(range(ws))
         self._v = {r: r for r in range(ws)}  # real rank -> virtual rank
         self._hb_last_sent = float("-inf")
         self._hb_seen: dict = {}  # sender rank -> last heartbeat clock
+
+        if members is not None:
+            group = sorted(set(int(r) for r in members))
+            if len(group) < 2:
+                raise ValueError(
+                    f"a sub-communicator needs >= 2 members, got "
+                    f"{group}")
+            if any(r < 0 or r >= ws for r in group):
+                raise ValueError(
+                    f"members {group} out of range [0, {ws})")
+            if self.rank not in group:
+                raise ValueError(
+                    f"rank {self.rank} is not in members {group}")
+            # subset = the translated-topology machinery with the
+            # non-members permanently excluded: every routed path
+            # (_cur_initiator_targets, _fwd_targets, _ring_neighbors,
+            # re-flood, discounting) already consults the alive view
+            self.failed = set(range(ws)) - set(group)
+            self._alive = group
+            self._v = {r: i for i, r in enumerate(group)}
+        self.group = list(self._alive)
 
         self.manager = manager
         self.engine_id = manager.append(self)
@@ -273,8 +308,13 @@ class ProgressEngine:
             self._bcast_seq += 1
         frame = Frame(origin=self.rank, pid=pid, vote=vote, payload=payload)
         raw = frame.encode()
-        if Tag(tag) == Tag.BCAST:
-            self._recent_bcasts.append(raw)
+        if Tag(tag) in (Tag.BCAST, Tag.IAR_DECISION):
+            # decisions join the re-flood log: a decision lost in a
+            # view-change window would otherwise leave relayed rounds
+            # parked forever (blocking checkpoint) — the settled-set
+            # dedup absorbs the flood exactly like (origin, seq) does
+            # for broadcasts
+            self._recent_bcasts.append((int(tag), raw))
         msg = _Msg(frame=frame, tag=int(tag))
         for dst in self._cur_initiator_targets():  # furthest-first
             msg.send_handles.append(self.transport.isend(dst, int(tag), raw))
@@ -398,7 +438,7 @@ class ProgressEngine:
                 self.recved_bcast_cnt += 1
                 if self._bcast_is_dup(msg):
                     continue  # exactly-once: drop, don't re-forward
-                self._recent_bcasts.append(raw)
+                self._recent_bcasts.append((int(tag), raw))
                 self._bc_forward(msg)
             elif tag == Tag.IAR_PROPOSAL:
                 self._on_proposal(msg)
@@ -515,6 +555,22 @@ class ProgressEngine:
         self.transport.isend(ps.recv_from, int(Tag.IAR_VOTE), frame.encode())
         TRACER.emit(self.rank, Ev.VOTE, ps.pid, int(vote))
 
+    def _resolve_relay(self, ps: ProposalState) -> None:
+        """The relay's merged vote is final: send it to the vote-tree
+        parent AND to every duplicate parent acquired from re-formed
+        overlay trees. Sending one merged verdict everywhere (instead
+        of an interim verdict at duplicate-arrival time) is what
+        guarantees a subtree veto can never be lost when the original
+        parent is the dead rank that triggered the view change
+        (round-2 advisor finding: the optimistic interim vote approved
+        a round whose veto went to a blackhole)."""
+        ps.resolved = True
+        self._vote_back(ps, ps.vote)
+        for dp in ps.dup_parents:
+            self._vote_back(ProposalState(pid=ps.pid, gen=ps.gen,
+                                          recv_from=dp), ps.vote)
+        ps.dup_parents.clear()
+
     def _on_proposal(self, msg: _Msg) -> None:
         """~_iar_proposal_handler (:668-726)."""
         origin = msg.frame.origin
@@ -523,23 +579,28 @@ class ProgressEngine:
         # second parent would corrupt the vote accounting. Forward for
         # coverage (a descendant may be reachable only via this tree).
         # A PENDING duplicate's sender is a live relay awaiting my vote
-        # (its await_from was built from its own forward list), so
-        # staying silent would deadlock its round: vote the verdict
-        # accumulated so far back to it. Optimistic — my subtree's veto
-        # may still be in flight on the original path — but the
-        # proposer ANDs every path, so a veto that exists reaches it
-        # through the original parent. A SETTLED duplicate needs no
-        # vote (the decision already broadcast; on_decision frees the
-        # sender's pending state).
+        # (its await_from was built from its own forward list), so it
+        # must eventually hear from me — but my subtree's veto may
+        # still be in flight, so an interim verdict could approve a
+        # round a live rank vetoed. Resolved round: the merged vote is
+        # final, send it now. Unresolved: record the sender as a
+        # duplicate parent; _resolve_relay sends it the merged vote.
+        # A SETTLED duplicate needs no vote (the decision already
+        # broadcast; on_decision frees the sender's pending state).
         gen = msg.frame.vote
         pending = self._find_proposal_msg(msg.frame.pid, gen)
         if pending is not None or (msg.frame.pid, gen) in \
                 self._settled_set:
-            if pending is not None and msg.src != \
-                    pending.prop_state.recv_from:
-                dup_ps = ProposalState(pid=msg.frame.pid, gen=gen,
-                                       recv_from=msg.src)
-                self._vote_back(dup_ps, pending.prop_state.vote)
+            if pending is not None:
+                ps = pending.prop_state
+                if msg.src != ps.recv_from and \
+                        msg.src not in ps.dup_parents:
+                    if ps.resolved:
+                        self._vote_back(
+                            ProposalState(pid=ps.pid, gen=gen,
+                                          recv_from=msg.src), ps.vote)
+                    else:
+                        ps.dup_parents.append(msg.src)
             self._bc_forward_only(msg)
             return
         if (self.my_own_proposal.state == ReqState.IN_PROGRESS
@@ -566,13 +627,25 @@ class ProgressEngine:
         msg.prop_state = ps
         judgment = self._judge(msg.frame.payload, ps.pid)
         if judgment == 0:
-            # decline: vote NO to parent immediately, do not forward — the
-            # subtree below never sees the proposal, only the decision
-            self._vote_back(ps, 0)
+            # decline: vote NO to parent immediately, do not forward —
+            # the subtree below never sees the proposal, only the
+            # decision. Parked anyway (resolved, vote 0) so duplicates
+            # from re-formed trees find the verdict instead of
+            # re-judging, and an approved decision (possible when this
+            # veto was discounted with a dead subtree) still fires the
+            # action callback here like everywhere else. The children
+            # never saw the proposal: clear the await list so a later
+            # child failure cannot re-trigger resolution (C mirror
+            # zeroes n_await the same way)
+            ps.vote = 0
+            ps.votes_needed = 0
+            ps.await_from = []
+            self._resolve_relay(ps)
+            self.queue_iar_pending.append(msg)
         else:
             sent = self._bc_forward(msg)  # parks msg in queue_iar_pending
             if sent == 0:
-                self._vote_back(ps, 1)  # leaf: nothing to wait for
+                self._resolve_relay(ps)  # leaf: merged vote == my own
 
     def _on_vote(self, msg: _Msg) -> None:
         """~_iar_vote_handler (:743-812). Votes AND-merge upward."""
@@ -614,7 +687,7 @@ class ProgressEngine:
         ps.vote &= vote
         ps.votes_recved += 1
         if ps.votes_recved == ps.votes_needed:
-            self._vote_back(ps, ps.vote)
+            self._resolve_relay(ps)
 
     def _complete_own_proposal(self, p: ProposalState) -> None:
         if p.vote:
@@ -636,6 +709,10 @@ class ProgressEngine:
     def _on_decision(self, msg: _Msg) -> None:
         """~_iar_decision_handler (:814-859) + forward along the overlay."""
         pid, vote = msg.frame.pid, msg.frame.vote
+        if msg.frame.origin == self.rank:
+            # a re-flooded copy of my own decision (the proposer learns
+            # its decision from the vote merge, never from the wire)
+            return
         gen = struct.unpack_from("<i", msg.frame.payload)[0] \
             if len(msg.frame.payload) >= 4 else -1
         if gen >= 0:  # ungenerated (foreign/legacy) frames: best-effort
@@ -650,24 +727,23 @@ class ProgressEngine:
                 self._settled_set.discard(self._settled_rounds[0])
             self._settled_rounds.append((pid, gen))
             self._settled_set.add((pid, gen))
+            # log for view-change re-flooding (decisions must survive
+            # the loss of any one relay — parked rounds depend on it)
+            self._recent_bcasts.append((int(Tag.IAR_DECISION),
+                                        msg.frame.encode()))
         pm = self._find_proposal_msg(pid, gen)
         self._bc_forward(msg)  # forward first; delivery below
         if pm is not None:
             if vote:
-                # approved: execute the user action (:842)
+                # approved: execute the user action (:842) — on every
+                # rank, including one that voted no (its veto may have
+                # been discounted along with a dead subtree; agreement
+                # means everyone follows the decision)
                 if self.action_cb is not None:
                     self.action_cb(pm.prop_state.proposal_payload,
                                    self.app_ctx)
                 pm.prop_state.state = ReqState.COMPLETED
             self.queue_iar_pending.remove(pm)
-        elif (pid, gen) in self._orphaned_props:
-            # relay aborted when my vote-tree parent died, but the
-            # proposer survived and its decision reached me through the
-            # re-formed overlay: still honor the action callback
-            if vote and self.action_cb is not None:
-                self.action_cb(self._orphaned_props[(pid, gen)][1],
-                               self.app_ctx)
-            del self._orphaned_props[(pid, gen)]
         # deliver the decision to the user either way (:852-854)
         self.queue_pickup.append(msg)
 
@@ -820,15 +896,18 @@ class ProgressEngine:
 
     def _reflood_recent_bcasts(self) -> None:
         """Plug forwarding holes a dead relay left: re-send every recent
-        BCAST frame this rank initiated or forwarded, point-to-point to
-        every alive rank. Receivers drop the (origin, seq) duplicates
-        (_bcast_is_dup) — together the flood + dedup upgrade broadcast
+        BCAST and IAR_DECISION frame this rank initiated or forwarded,
+        point-to-point to every alive rank. Receivers drop the
+        duplicates ((origin, seq) for broadcasts, the settled (pid,
+        gen) ring for decisions) — together the flood + dedup upgrade
         delivery across view changes to exactly-once for any initiator
-        that survived."""
-        for raw in list(self._recent_bcasts):
+        that survived. Covering decisions is what lets parent-died
+        relayed rounds stay parked (see _abort_orphaned_proposals): the
+        decision that clears them survives the loss of any one relay."""
+        for tag, raw in list(self._recent_bcasts):
             for dst in self._alive:
                 if dst != self.rank:
-                    self.transport.isend(dst, int(Tag.BCAST), raw)
+                    self.transport.isend(dst, tag, raw)
 
     def _discount_failed_voter(self, rank: int) -> None:
         """A consensus participant died mid-round: its subtree's merged
@@ -848,37 +927,30 @@ class ProgressEngine:
                 ps.await_from.remove(rank)
                 ps.votes_needed -= 1
                 if ps.votes_recved == ps.votes_needed:
-                    self._vote_back(ps, ps.vote)
+                    self._resolve_relay(ps)
 
     def _abort_orphaned_proposals(self, rank: int) -> None:
-        """Relayed proposals whose proposer or vote-tree parent is the
-        dead rank can never resolve (the decision will never be broadcast
-        / the vote-back would blackhole): mark them FAILED and unpark
-        them, so the engine is checkpointable again and the pid is freed.
-        This is the one place the rebuild assigns the reference's
-        otherwise-dead RLO_FAILED state (rootless_ops.h:66)."""
+        """Relayed proposals whose PROPOSER is the dead rank can never
+        resolve (the decision will never be broadcast): mark them FAILED
+        and unpark them, so the engine is checkpointable again and the
+        pid is freed. This is the one place the rebuild assigns the
+        reference's otherwise-dead RLO_FAILED state (rootless_ops.h:66).
+
+        Rounds whose vote-tree PARENT died stay parked: the surviving
+        proposer discounts the dead subtree and still broadcasts a
+        decision, which reaches this rank through the re-formed overlay
+        and clears the round (with the action callback) exactly like a
+        healthy one. Keeping the round alive also preserves the child
+        votes already merged into it, so a duplicate proposal from the
+        new tree collects the true subtree verdict instead of a vote
+        reconstructed from partial state (round-2 advisor finding)."""
         for pm in list(self.queue_iar_pending):
             ps = pm.prop_state
             if ps is None:
                 continue
-            if pm.frame.origin == rank or ps.recv_from == rank:
+            if pm.frame.origin == rank:
                 ps.state = ReqState.FAILED
                 self.queue_iar_pending.remove(pm)
-                if pm.frame.origin != rank:
-                    # proposer may still be alive (only my parent died):
-                    # keep the payload so a decision that reaches me via
-                    # the re-formed overlay can still run the action cb.
-                    # Keyed on (pid, gen): a stale same-pid decision from
-                    # an earlier round must not fire this round's action
-                    self._orphaned_props[(ps.pid, ps.gen)] = (
-                        pm.frame.origin, ps.proposal_payload)
-                    while len(self._orphaned_props) > 64:
-                        self._orphaned_props.pop(
-                            next(iter(self._orphaned_props)))
-        # a dead proposer's decision will never come: drop its orphans
-        self._orphaned_props = {
-            k: v for k, v in self._orphaned_props.items()
-            if v[0] != rank}
 
     def _on_other(self, msg: _Msg) -> None:
         """Unknown/aux tags go straight to pickup (reference prints and

@@ -113,6 +113,9 @@ def load() -> C.CDLL:
     sig("rlo_world_delivered_cnt", C.c_int64, [p])
     sig("rlo_engine_new", p,
         [p, C.c_int, C.c_int, _JUDGE_CB, p, _ACTION_CB, p, C.c_int64])
+    sig("rlo_engine_new_sub", p,
+        [p, C.c_int, C.c_int, C.POINTER(C.c_int), C.c_int, _JUDGE_CB, p,
+         _ACTION_CB, p, C.c_int64])
     sig("rlo_engine_free", None, [p])
     sig("rlo_progress_all", None, [p])
     sig("rlo_bcast", C.c_int, [p, u8p, C.c_int64])
@@ -409,13 +412,19 @@ class NativeEngine:
                  judge_cb: Optional[Callable[[bytes, object], int]] = None,
                  app_ctx: object = None,
                  action_cb: Optional[Callable[[bytes, object], None]] = None,
-                 msg_size_max: int = MSG_SIZE_MAX):
+                 msg_size_max: int = MSG_SIZE_MAX,
+                 members: Optional[List[int]] = None):
+        """``members`` builds the engine over a rank subset (a
+        sub-communicator: rlo_engine_new_sub; give it a distinct
+        ``comm`` from any full-world engine on the same world)."""
         self._lib = load()
         self.world = world
         self.rank = rank
         self.world_size = world.world_size
         self.msg_size_max = msg_size_max
         self.app_ctx = app_ctx
+        self.members = sorted(set(members)) if members is not None \
+            else None
 
         # keep CFUNCTYPE wrappers alive for the engine's lifetime
         if judge_cb is not None:
@@ -435,9 +444,15 @@ class NativeEngine:
         else:
             self._action = C.cast(None, _ACTION_CB)
 
-        self._e = self._lib.rlo_engine_new(
-            world._w, rank, comm, self._judge, None, self._action, None,
-            msg_size_max)
+        if self.members is None:
+            self._e = self._lib.rlo_engine_new(
+                world._w, rank, comm, self._judge, None, self._action,
+                None, msg_size_max)
+        else:
+            arr = (C.c_int * len(self.members))(*self.members)
+            self._e = self._lib.rlo_engine_new_sub(
+                world._w, rank, comm, arr, len(self.members),
+                self._judge, None, self._action, None, msg_size_max)
         if not self._e:
             raise RuntimeError(f"engine creation failed (rank {rank})")
         world.engines.append(self)

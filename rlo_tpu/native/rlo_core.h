@@ -196,6 +196,18 @@ rlo_engine *rlo_engine_new(rlo_world *w, int rank, int comm,
                            rlo_judge_cb judge, void *judge_ctx,
                            rlo_action_cb action, void *action_ctx,
                            int64_t msg_size_max);
+/* Engine over a RANK SUBSET — the reference's engines-over-sub-
+ * communicators capability (RLO_progress_engine_new on any MPI_Comm,
+ * rootless_ops.c:467, 1461). bcast/IAR span exactly `members` (overlay
+ * topology over virtual ranks 0..n_members-1); non-members never see
+ * this engine's traffic. `rank` must be a member; create the engine on
+ * member ranks only, with a `comm` distinct from any full-world
+ * engine's on the same world. */
+rlo_engine *rlo_engine_new_sub(rlo_world *w, int rank, int comm,
+                               const int *members, int n_members,
+                               rlo_judge_cb judge, void *judge_ctx,
+                               rlo_action_cb action, void *action_ctx,
+                               int64_t msg_size_max);
 void rlo_engine_free(rlo_engine *e);
 
 /* Step every engine in the world once (reference RLO_make_progress_all,

@@ -47,6 +47,14 @@ typedef struct rlo_prop {
      * in rlo_tpu/engine.py) */
     int await_from[64];
     int n_await;
+    /* additional vote-tree parents acquired from duplicate proposals
+     * (re-formed overlay trees); they receive the SAME merged vote as
+     * recv_from when the round resolves — an interim verdict could
+     * lose a subtree veto still in flight (round-2 advisor finding).
+     * Mirror of ProposalState.dup_parents/resolved in engine.py. */
+    int dup_parents[8];
+    int n_dup;
+    int resolved; /* merged vote determined and sent up */
 } rlo_prop;
 
 /* ---------------- in-flight message (reference RLO_msg_t,
@@ -101,6 +109,7 @@ struct rlo_engine {
     int64_t *seen_contig;   /* per origin: all seqs <= contig seen */
     uint64_t *seen_mask;    /* per origin: 256-bit window above contig */
     rlo_blob *recent[RLO_RECENT_LOG];
+    int recent_tag[RLO_RECENT_LOG]; /* BCAST or IAR_DECISION per entry */
     int recent_pos;
     /* settled consensus rounds (decision dedup across view changes) */
     struct { int32_t pid, gen; int used; } settled[RLO_SETTLED_LOG];
@@ -311,6 +320,41 @@ rlo_engine *rlo_engine_new(rlo_world *w, int rank, int comm,
     return e;
 }
 
+rlo_engine *rlo_engine_new_sub(rlo_world *w, int rank, int comm,
+                               const int *members, int n_members,
+                               rlo_judge_cb judge, void *judge_ctx,
+                               rlo_action_cb action, void *action_ctx,
+                               int64_t msg_size_max)
+{
+    if (!members || n_members < 2 || n_members > rlo_world_size(w))
+        return 0;
+    int in_group = 0;
+    for (int i = 0; i < n_members; i++) {
+        if (members[i] < 0 || members[i] >= rlo_world_size(w))
+            return 0;
+        if (members[i] == rank)
+            in_group = 1;
+    }
+    if (!in_group)
+        return 0;
+    rlo_engine *e = rlo_engine_new(w, rank, comm, judge, judge_ctx,
+                                   action, action_ctx, msg_size_max);
+    if (!e)
+        return 0;
+    /* subset = the elastic-reforming translation with the non-members
+     * permanently excluded: every routed path (cur_init_targets,
+     * cur_fwd_targets, ring_neighbors, reflood, discounting) already
+     * consults the alive view (mirror of ProgressEngine(members=...)) */
+    for (int r = 0; r < e->ws; r++)
+        e->failed[r] = 1;
+    for (int i = 0; i < n_members; i++)
+        e->failed[members[i]] = 0;
+    e->n_failed = 0;
+    for (int r = 0; r < e->ws; r++)
+        e->n_failed += e->failed[r];
+    return e;
+}
+
 static void q_free_all(rlo_queue *q)
 {
     for (rlo_msg *m = q->head; m;) {
@@ -472,11 +516,16 @@ static int bcast_is_dup(rlo_engine *e, const rlo_msg *m)
     return 0;
 }
 
-/* Remember a BCAST frame for view-change re-flooding. */
-static void recent_log_push(rlo_engine *e, rlo_blob *frame)
+/* Remember a BCAST or IAR_DECISION frame for view-change re-flooding.
+ * Decisions ride the same log: one lost in a view-change window would
+ * otherwise leave parent-died relayed rounds parked forever (the
+ * settled (pid, gen) ring absorbs the flood like (origin, seq) does
+ * for broadcasts). */
+static void recent_log_push(rlo_engine *e, rlo_blob *frame, int tag)
 {
     rlo_blob_unref(e->recent[e->recent_pos]);
     e->recent[e->recent_pos] = rlo_blob_ref(frame);
+    e->recent_tag[e->recent_pos] = tag;
     e->recent_pos = (e->recent_pos + 1) % RLO_RECENT_LOG;
 }
 
@@ -492,7 +541,7 @@ static void reflood_recent(rlo_engine *e)
             continue;
         for (int dst = 0; dst < e->ws; dst++)
             if (dst != e->rank && !e->failed[dst])
-                eng_isend_frame(e, dst, RLO_TAG_BCAST, b, 0);
+                eng_isend_frame(e, dst, e->recent_tag[i], b, 0);
     }
 }
 
@@ -546,7 +595,7 @@ int rlo_bcast(rlo_engine *e, const uint8_t *payload, int64_t len)
     int rc = bcast_init(e, RLO_TAG_BCAST, -1, e->bcast_seq++, payload,
                         len, &m);
     if (rc == RLO_OK) {
-        recent_log_push(e, m->frame);
+        recent_log_push(e, m->frame, RLO_TAG_BCAST);
         rlo_progress_all(e->w);
     }
     return rc;
@@ -615,6 +664,26 @@ static int vote_back(rlo_engine *e, const rlo_prop *ps, int vote)
                      vote, genb, 4, 0);
 }
 
+/* The relay's merged vote is final: send it to the vote-tree parent
+ * AND to every duplicate parent from re-formed overlay trees — one
+ * merged verdict everywhere, so a subtree veto survives even when the
+ * original parent is the dead rank that triggered the view change
+ * (mirror of ProgressEngine._resolve_relay). */
+static int resolve_relay(rlo_engine *e, rlo_prop *ps)
+{
+    ps->resolved = 1;
+    int rc = vote_back(e, ps, ps->vote);
+    for (int i = 0; i < ps->n_dup && rc == RLO_OK; i++) {
+        rlo_prop vb = {0};
+        vb.pid = ps->pid;
+        vb.gen = ps->gen;
+        vb.recv_from = ps->dup_parents[i];
+        rc = vote_back(e, &vb, ps->vote);
+    }
+    ps->n_dup = 0;
+    return rc;
+}
+
 static int vote_gen(const rlo_msg *m)
 {
     if (m->len < 4)
@@ -670,20 +739,42 @@ static void on_proposal(rlo_engine *e, rlo_msg *m)
      * never re-judge or re-park — a second proposal state voting to a
      * second parent would corrupt the vote accounting. Forward for
      * coverage. A PENDING duplicate's sender is a live relay awaiting
-     * my vote (its await list mirrors its forward list), so staying
-     * silent would deadlock its round: vote the verdict accumulated so
-     * far back to it (optimistic; a veto still reaches the proposer
-     * through the original parent, and the proposer ANDs every path).
+     * my vote, but my subtree's veto may still be in flight, so an
+     * interim verdict could approve a round a live rank vetoed:
+     * resolved rounds send the final merged vote now, unresolved ones
+     * record the sender as a duplicate parent for resolve_relay.
      * A SETTLED duplicate needs no vote — the decision already
      * broadcast, and on_decision frees the sender's pending state. */
     rlo_msg *dup = find_proposal_msg(e, m->pid, m->vote);
     if (dup || (m->vote >= 0 && round_settled_peek(e, m->pid, m->vote))) {
         if (dup && m->src != dup->ps->recv_from) {
-            rlo_prop vb = {0};
-            vb.pid = m->pid;
-            vb.gen = m->vote;
-            vb.recv_from = m->src;
-            vote_back(e, &vb, dup->ps->vote);
+            rlo_prop *dps = dup->ps;
+            int known = 0;
+            for (int i = 0; i < dps->n_dup; i++)
+                if (dps->dup_parents[i] == m->src)
+                    known = 1;
+            if (!known) {
+                if (dps->resolved) {
+                    rlo_prop vb = {0};
+                    vb.pid = m->pid;
+                    vb.gen = m->vote;
+                    vb.recv_from = m->src;
+                    vote_back(e, &vb, dps->vote);
+                } else if (dps->n_dup <
+                           (int)(sizeof(dps->dup_parents) /
+                                 sizeof(dps->dup_parents[0]))) {
+                    dps->dup_parents[dps->n_dup++] = m->src;
+                } else {
+                    /* 8 concurrent re-formed trees mid-round: out of
+                     * slots — vote the interim verdict rather than
+                     * deadlock the sender (degraded, bounded) */
+                    rlo_prop vb = {0};
+                    vb.pid = m->pid;
+                    vb.gen = m->vote;
+                    vb.recv_from = m->src;
+                    vote_back(e, &vb, dps->vote);
+                }
+            }
         }
         bc_forward_only(e, m);
         return;
@@ -720,10 +811,17 @@ static void on_proposal(rlo_engine *e, rlo_msg *m)
     ps->votes_needed = ps->n_await;
     m->ps = ps;
     if (!eng_judge(e, m->payload, m->len, ps->pid)) {
-        /* decline: NO to parent immediately, don't forward — the subtree
-         * below only ever sees the decision */
-        vote_back(e, ps, 0);
-        msg_free(m); /* frees ps too */
+        /* decline: NO to parent immediately, don't forward — the
+         * subtree below only ever sees the decision. Parked anyway
+         * (resolved, vote 0) so duplicates from re-formed trees find
+         * the verdict instead of re-judging, and an approved decision
+         * (possible when this veto was discounted with a dead subtree)
+         * still fires the action callback here like everywhere else */
+        ps->vote = 0;
+        ps->votes_needed = 0;
+        ps->n_await = 0;
+        resolve_relay(e, ps);
+        q_append(&e->q_iar_pending, m);
         return;
     }
     int sent = bc_forward(e, m); /* parks m in q_iar_pending */
@@ -732,7 +830,7 @@ static void on_proposal(rlo_engine *e, rlo_msg *m)
         set_err(e, sent);
         msg_free(m);
     } else if (sent == 0) {
-        vote_back(e, ps, 1); /* leaf: nothing to wait for */
+        resolve_relay(e, ps); /* leaf: merged vote == my own */
     }
 }
 
@@ -749,6 +847,7 @@ static void decision_bcast(rlo_engine *e)
         set_err(e, rc);
         return;
     }
+    recent_log_push(e, m->frame, RLO_TAG_IAR_DECISION);
     /* retain the decision sends: the proposal completes only once the
      * decision has fanned out (reference :554-566) */
     p->decision_handles = (rlo_handle **)malloc(
@@ -829,7 +928,7 @@ static void on_vote(rlo_engine *e, rlo_msg *m)
     pm->ps->vote &= vote;
     pm->ps->votes_recved++;
     if (pm->ps->votes_recved == pm->ps->votes_needed)
-        vote_back(e, pm->ps, pm->ps->vote);
+        resolve_relay(e, pm->ps);
     msg_free(m);
 }
 
@@ -864,6 +963,12 @@ static int round_settled(rlo_engine *e, int32_t pid, int32_t gen)
 
 static void on_decision(rlo_engine *e, rlo_msg *m)
 {
+    if (m->origin == e->rank) {
+        /* a re-flooded copy of my own decision (the proposer learns
+         * its decision from the vote merge, never from the wire) */
+        msg_free(m);
+        return;
+    }
     if (round_settled(e, m->pid, vote_gen(m))) {
         /* duplicate across a view change: deliver exactly once, but
          * STILL forward — a descendant reachable only through this
@@ -872,6 +977,9 @@ static void on_decision(rlo_engine *e, rlo_msg *m)
         bc_forward_only(e, m);
         return;
     }
+    /* first sight: log for view-change re-flooding (parked parent-died
+     * rounds depend on the decision surviving any one relay's death) */
+    recent_log_push(e, m->frame, RLO_TAG_IAR_DECISION);
     rlo_msg *pm = find_proposal_msg(e, m->pid, vote_gen(m));
     int rc = bc_forward(e, m); /* forward first; delivery below */
     if (rc < 0)
@@ -1007,20 +1115,24 @@ static void discount_failed_voter(rlo_engine *e, int rank)
         if (pm->ps && await_remove(pm->ps, rank)) {
             pm->ps->votes_needed--;
             if (pm->ps->votes_recved == pm->ps->votes_needed)
-                vote_back(e, pm->ps, pm->ps->vote);
+                resolve_relay(e, pm->ps);
         }
     }
 }
 
 static void abort_orphaned_proposals(rlo_engine *e, int rank)
 {
-    /* relays whose proposer or vote-tree parent died can never resolve:
-     * unpark and drop them (unlike the Python engine we do not keep the
-     * payload for a late decision's action callback) */
+    /* relays whose PROPOSER died can never resolve (no decision will
+     * ever broadcast): unpark and drop them. Rounds whose vote-tree
+     * PARENT died stay parked: the surviving proposer discounts the
+     * dead subtree and its decision still reaches this rank through
+     * the re-formed overlay, clearing the round (and firing the
+     * action) like a healthy one — and the child votes already merged
+     * stay live for duplicate parents (mirror of the Python engine's
+     * _abort_orphaned_proposals; round-2 advisor finding). */
     for (rlo_msg *pm = e->q_iar_pending.head; pm;) {
         rlo_msg *nm = pm->next;
-        if (pm->ps &&
-            (pm->origin == rank || pm->ps->recv_from == rank)) {
+        if (pm->ps && pm->origin == rank) {
             pm->ps->state = RLO_FAILED;
             q_remove(&e->q_iar_pending, pm);
             msg_free(pm);
@@ -1298,7 +1410,7 @@ void rlo_engine_progress_once(rlo_engine *e)
                 msg_free(m);
                 break;
             }
-            recent_log_push(e, m->frame);
+            recent_log_push(e, m->frame, RLO_TAG_BCAST);
             int rc = bc_forward(e, m);
             if (rc < 0) {
                 /* bc_forward only fails before queueing — reclaim */

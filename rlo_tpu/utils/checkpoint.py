@@ -31,6 +31,8 @@ from typing import Any, List, Optional
 import jax
 import numpy as np
 
+from rlo_tpu.wire import Tag
+
 try:  # gated: the subsystem still works without orbax via the npz backend
     import orbax.checkpoint as ocp
     _HAVE_ORBAX = True
@@ -281,8 +283,8 @@ def engine_state_dict(engine) -> dict:
         "bcast_seq": engine._bcast_seq,
         "seen_bcast": {str(o): [ent[0], sorted(ent[1])]
                        for o, ent in engine._seen_bcast.items()},
-        "recent_bcasts": [base64.b64encode(raw).decode()
-                          for raw in engine._recent_bcasts],
+        "recent_bcasts": [[tag, base64.b64encode(raw).decode()]
+                          for tag, raw in engine._recent_bcasts],
         "pickup": pickup,
     }
 
@@ -319,8 +321,14 @@ def load_engine_state(engine, state: dict) -> None:
                               for o, ent in state["seen_bcast"].items()}
     if "recent_bcasts" in state:  # replace, not merge (rollback must not
         engine._recent_bcasts.clear()  # leave post-snapshot frames behind)
-        engine._recent_bcasts.extend(
-            base64.b64decode(s) for s in state["recent_bcasts"])
+        for ent in state["recent_bcasts"]:
+            if isinstance(ent, str):  # pre-round-3 snapshot: BCAST-only
+                engine._recent_bcasts.append(
+                    (int(Tag.BCAST), base64.b64decode(ent)))
+            else:
+                tag, s = ent
+                engine._recent_bcasts.append((int(tag),
+                                              base64.b64decode(s)))
     for m in state.get("pickup", []):
         frame = Frame(origin=m["origin"], pid=m["pid"], vote=m["vote"],
                       payload=base64.b64decode(m["data"]))

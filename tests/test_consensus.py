@@ -412,6 +412,225 @@ class TestDecisionDedup:
         assert s == 1 and f.pid == 7 and f.vote == 1
         assert struct.unpack_from("<i", f.payload)[0] == gen
 
+    def test_unresolved_duplicate_defers_vote_until_merge(self):
+        """Round-2 advisor finding: a relay with subtree votes still
+        outstanding must NOT vote an interim verdict to a duplicate's
+        (new-view) parent — if a descendant's veto later completes the
+        round, that veto would go only to the original parent, which in
+        the view-change scenario is exactly the dead rank. The dup
+        parent must instead receive the FINAL merged vote when the
+        round resolves, so the veto survives on the new path."""
+        import struct
+        from rlo_tpu.engine import EngineManager, ProgressEngine
+        from rlo_tpu.transport.loopback import LoopbackWorld
+        from rlo_tpu.wire import Frame
+
+        world = LoopbackWorld(8)
+        mgr = EngineManager()
+        eng2 = ProgressEngine(world.transport(2), manager=mgr)
+        gen = 777
+        orig = Frame(origin=0, pid=5, vote=gen, payload=b"p")
+        world.transport(0).isend(2, int(Tag.IAR_PROPOSAL), orig.encode())
+        mgr.progress_all()
+        ps = eng2.queue_iar_pending[0].prop_state
+        children = list(ps.await_from)
+        assert children, "need a relay with children for this scenario"
+        assert not ps.resolved
+        # duplicate arrives from rank 6 (a re-formed-tree parent)
+        dup = Frame(origin=0, pid=5, vote=gen, payload=b"p")
+        world.transport(6).isend(2, int(Tag.IAR_PROPOSAL), dup.encode())
+        mgr.progress_all()
+        # deferred: no vote sent to rank 6 yet
+        got6 = []
+        while (item := world.transport(6).poll()) is not None:
+            got6.append(item)
+        assert not [1 for (_, t, _) in got6 if t == int(Tag.IAR_VOTE)]
+        assert 6 in ps.dup_parents
+        # children's merged votes arrive; the LAST one is a veto
+        for i, c in enumerate(children):
+            v = 0 if i == len(children) - 1 else 1
+            vf = Frame(origin=c, pid=5, vote=v,
+                       payload=struct.pack("<i", gen))
+            world.transport(c).isend(2, int(Tag.IAR_VOTE), vf.encode())
+        for _ in range(10):
+            mgr.progress_all()
+        assert ps.resolved and ps.vote == 0
+
+        def votes_at(rank):
+            out = []
+            while (item := world.transport(rank).poll()) is not None:
+                if item[1] == int(Tag.IAR_VOTE):
+                    out.append(Frame.decode(item[2]))
+            return out
+
+        # BOTH parents got the merged veto
+        v0 = votes_at(0)
+        v6 = votes_at(6)
+        assert [f.vote for f in v0] == [0], v0
+        assert [f.vote for f in v6] == [0], v6
+        assert struct.unpack_from("<i", v6[0].payload)[0] == gen
+
+    def test_declined_relay_parked_never_rejudged(self):
+        """A relay that voted NO must remember the round: a duplicate
+        from a re-formed tree gets the final 0 immediately and the
+        judge callback must not fire a second time."""
+        import struct
+        from rlo_tpu.engine import EngineManager, ProgressEngine
+        from rlo_tpu.transport.loopback import LoopbackWorld
+        from rlo_tpu.wire import Frame
+
+        world = LoopbackWorld(8)
+        mgr = EngineManager()
+        judged = []
+        eng2 = ProgressEngine(world.transport(2), manager=mgr,
+                              judge_cb=lambda p, c: (judged.append(1),
+                                                     0)[1])
+        gen = 778
+        orig = Frame(origin=0, pid=5, vote=gen, payload=b"p")
+        world.transport(0).isend(2, int(Tag.IAR_PROPOSAL), orig.encode())
+        mgr.progress_all()
+        assert judged == [1]
+        ps = eng2.queue_iar_pending[0].prop_state
+        assert ps.resolved and ps.vote == 0
+        dup = Frame(origin=0, pid=5, vote=gen, payload=b"p")
+        world.transport(6).isend(2, int(Tag.IAR_PROPOSAL), dup.encode())
+        mgr.progress_all()
+        assert judged == [1]  # never re-judged
+        got = []
+        while (item := world.transport(6).poll()) is not None:
+            got.append(item)
+        votes = [Frame.decode(raw) for (_, t, raw) in got
+                 if t == int(Tag.IAR_VOTE)]
+        assert [f.vote for f in votes] == [0]
+        assert struct.unpack_from("<i", votes[0].payload)[0] == gen
+
+    def test_decision_in_reflood_log_and_clears_parked_round(self):
+        """Decisions ride the view-change re-flood log (code-review
+        finding on the round-3 consensus rework): with parent-died
+        rounds now staying parked, a decision lost with a dead relay
+        would block checkpointing forever unless survivors re-flood it.
+        Pins: (a) after a round, the decision frame sits in every
+        participant's re-flood log with its own tag; (b) a re-flooded
+        decision arriving point-to-point (not via the tree) clears a
+        parked round and fires the action; (c) the proposer drops a
+        re-flooded copy of its own decision."""
+        import struct
+        from rlo_tpu.engine import EngineManager, ProgressEngine
+        from rlo_tpu.transport.loopback import LoopbackWorld
+        from rlo_tpu.wire import Frame
+
+        world = make_world("loopback", 4)
+        mgr = EngineManager()
+        engines = [ProgressEngine(world.transport(r), manager=mgr)
+                   for r in range(4)]
+        engines[0].submit_proposal(b"p", pid=0)
+        drain([world], engines)
+        gen = engines[0].my_own_proposal.gen
+        for r, eng in enumerate(engines):
+            tags = [t for t, _ in eng._recent_bcasts]
+            assert int(Tag.IAR_DECISION) in tags, (r, tags)
+
+        # (b) a fresh relay with a parked round, decision arriving as a
+        # point-to-point re-flood from a NON-parent rank
+        world2 = LoopbackWorld(8)
+        mgr2 = EngineManager()
+        acted = []
+        eng2 = ProgressEngine(world2.transport(2), manager=mgr2,
+                              action_cb=lambda p, c: acted.append(p))
+        orig = Frame(origin=0, pid=5, vote=777, payload=b"q")
+        world2.transport(0).isend(2, int(Tag.IAR_PROPOSAL), orig.encode())
+        mgr2.progress_all()
+        assert len(eng2.queue_iar_pending) == 1
+        dec = Frame(origin=0, pid=5, vote=1,
+                    payload=struct.pack("<i", 777))
+        world2.transport(5).isend(2, int(Tag.IAR_DECISION), dec.encode())
+        for _ in range(10):
+            mgr2.progress_all()
+        assert not eng2.queue_iar_pending  # round cleared
+        assert acted == [b"q"]             # action fired once
+
+        # (c) proposer ignores a re-flooded copy of its own decision
+        own_before = len(
+            [m for m in iter(engines[0].pickup_next, None)])
+        own_dec = Frame(origin=0, pid=0, vote=1,
+                        payload=struct.pack("<i", gen))
+        world.transport(3).isend(0, int(Tag.IAR_DECISION),
+                                 own_dec.encode())
+        for _ in range(10):
+            mgr.progress_all()
+        extra = [m for m in iter(engines[0].pickup_next, None)]
+        assert not extra, extra
+
+    def test_declined_relay_not_rejudged_native(self):
+        """C mirror of test_declined_relay_parked_never_rejudged: a
+        relay that voted NO keeps the round parked, so a duplicate from
+        a re-formed tree must not fire the judge a second time (the old
+        code freed the declined round, making every dup look new)."""
+        from rlo_tpu.native.bindings import NativeEngine, NativeWorld
+        from rlo_tpu.wire import Frame
+
+        judged = []
+        with NativeWorld(8) as world:
+            # engine only at the relay under test; other ranks' inboxes
+            # are passive sinks for its forwards/votes
+            NativeEngine(world, 2,
+                         judge_cb=lambda p, c: (judged.append(2), 0)[1])
+            gen = 779
+            orig = Frame(origin=0, pid=5, vote=gen, payload=b"p")
+            world.inject(src=0, dst=2, tag=int(Tag.IAR_PROPOSAL),
+                         raw=orig.encode())
+            for _ in range(100):
+                world.progress_all()
+            assert judged == [2]
+            dup = Frame(origin=0, pid=5, vote=gen, payload=b"p")
+            world.inject(src=6, dst=2, tag=int(Tag.IAR_PROPOSAL),
+                         raw=dup.encode())
+            for _ in range(100):
+                world.progress_all()
+            assert judged == [2]  # never re-judged
+
+    def test_unresolved_duplicate_defers_vote_native(self):
+        """C mirror of the deferred-dup scenario: an approving relay
+        with child votes outstanding records the dup parent instead of
+        voting an interim verdict; the round resolves when the (vetoing)
+        child votes arrive. Observable natively as: exactly one judge
+        call, no engine error, and the world going quiescent (the dup
+        parent DID eventually receive a vote — a deadlocked round would
+        leave the relay's pending send unforwarded forever)."""
+        import struct
+        from rlo_tpu.native.bindings import NativeEngine, NativeWorld
+        from rlo_tpu.wire import Frame
+
+        judged = []
+        with NativeWorld(8) as world:
+            eng = NativeEngine(world, 2,
+                               judge_cb=lambda p, c: (judged.append(2),
+                                                      1)[1])
+            gen = 780
+            orig = Frame(origin=0, pid=5, vote=gen, payload=b"p")
+            world.inject(src=0, dst=2, tag=int(Tag.IAR_PROPOSAL),
+                         raw=orig.encode())
+            for _ in range(100):
+                world.progress_all()
+            dup = Frame(origin=0, pid=5, vote=gen, payload=b"p")
+            world.inject(src=6, dst=2, tag=int(Tag.IAR_PROPOSAL),
+                         raw=dup.encode())
+            for _ in range(100):
+                world.progress_all()
+            assert judged == [2]
+            # children 3 and 4 (skip-ring fwd targets of rank 2 for an
+            # origin-0 proposal) vote; 4 vetoes
+            for child, v in ((3, 1), (4, 0)):
+                vf = Frame(origin=child, pid=5, vote=v,
+                           payload=struct.pack("<i", gen))
+                world.inject(src=child, dst=2,
+                             tag=int(Tag.IAR_VOTE), raw=vf.encode())
+            for _ in range(200):
+                world.progress_all()
+            assert judged == [2]
+            # the engine reached a resolved state without protocol error
+            assert eng.err == 0
+
     def test_duplicate_decision_dropped_native(self):
         import struct
         from rlo_tpu.native.bindings import NativeEngine, NativeWorld

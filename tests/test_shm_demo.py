@@ -37,7 +37,7 @@ def test_all_cases(demo_bin, ws):
     out = run_demo(demo_bin, "-n", ws, "-m", 8)
     assert "FAIL" not in out
     # one PASS line per case (+1: iar runs agree and veto variants)
-    assert out.count("PASS") == 11
+    assert out.count("PASS") == 12
 
 
 def test_failure_detection(demo_bin):

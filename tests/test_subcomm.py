@@ -1,0 +1,152 @@
+"""Engines over rank subsets — sub-communicators (round-2 VERDICT
+missing #2).
+
+The reference creates engines on ANY MPI communicator
+(RLO_progress_engine_new dup's it, rootless_ops.c:467, 1461), so an
+engine can span ranks {0,2,5} of an 8-rank world. Oracles: bcast and
+IAR span exactly the member set (delivery counts, decision agreement);
+non-members see none of the subset's traffic; a concurrently active
+full-world engine set (the "bystanders") is undisturbed — on both the
+Python and C engines.
+"""
+
+import pytest
+
+from rlo_tpu.engine import EngineManager, ProgressEngine, drain
+from rlo_tpu.transport import make_world
+from rlo_tpu.wire import Tag
+
+MEMBERS = [0, 2, 5]
+WS = 8
+
+
+def collect(eng):
+    out = []
+    while (m := eng.pickup_next()) is not None:
+        out.append(m)
+    return out
+
+
+class TestPythonSubset:
+    def build(self, **kw):
+        # the subset engine lives on its own world (= its own dup'ed
+        # communicator, exactly the reference model); bystander ranks
+        # simply have no engine on it
+        world = make_world("loopback", WS)
+        mgr = EngineManager()
+        engines = {r: ProgressEngine(world.transport(r), manager=mgr,
+                                     members=MEMBERS, **kw)
+                   for r in MEMBERS}
+        return world, mgr, engines
+
+    def test_validation(self):
+        world = make_world("loopback", WS)
+        mgr = EngineManager()
+        with pytest.raises(ValueError, match="not in members"):
+            ProgressEngine(world.transport(1), manager=mgr,
+                           members=MEMBERS)
+        with pytest.raises(ValueError, match=">= 2 members"):
+            ProgressEngine(world.transport(0), manager=mgr, members=[0])
+
+    @pytest.mark.parametrize("origin", MEMBERS)
+    def test_bcast_spans_exactly_the_subset(self, origin):
+        world, mgr, engines = self.build()
+        engines[origin].bcast(b"sub")
+        drain([world], list(engines.values()))
+        for r, eng in engines.items():
+            msgs = collect(eng)
+            if r == origin:
+                assert msgs == []
+            else:
+                assert [m.data for m in msgs] == [b"sub"], (r, msgs)
+        # nothing ever addressed a non-member endpoint
+        for r in range(WS):
+            if r not in MEMBERS:
+                assert world.transport(r).poll() is None
+
+    @pytest.mark.parametrize("proposer", MEMBERS)
+    @pytest.mark.parametrize("veto_rank", [None, 0, 5])
+    def test_iar_on_subset(self, proposer, veto_rank):
+        votes = {r: 0 if r == veto_rank else 1 for r in MEMBERS}
+        world, mgr, engines = self.build()
+        for r, eng in engines.items():
+            eng.judge_cb = lambda p, c, r=r: votes[r]
+        decision = engines[proposer].submit_proposal(b"prop",
+                                                     pid=proposer)
+        for _ in range(10_000):
+            if decision != -1:
+                break
+            mgr.progress_all()
+            decision = engines[proposer].vote_my_proposal()
+        drain([world], list(engines.values()))
+        want = 0 if veto_rank is not None else 1
+        assert decision == want
+        for r, eng in engines.items():
+            if r == proposer:
+                continue
+            ds = [m for m in collect(eng)
+                  if m.type == int(Tag.IAR_DECISION)]
+            assert len(ds) == 1 and ds[0].vote == want, (r, ds)
+
+    def test_bystanders_active_on_their_own_comm(self):
+        """A full-world engine set runs interleaved traffic while the
+        subset round proceeds; both see exactly their own."""
+        world, mgr, engines = self.build()
+        world_full = make_world("loopback", WS)
+        full = [ProgressEngine(world_full.transport(r), manager=mgr)
+                for r in range(WS)]
+        engines[2].bcast(b"sub")
+        full[3].bcast(b"full")      # a bystander initiates concurrently
+        engines[5].bcast(b"sub2")
+        drain([world, world_full], list(engines.values()) + full)
+        for r, eng in engines.items():
+            want = {b"sub", b"sub2"} - ({b"sub"} if r == 2 else set()) \
+                - ({b"sub2"} if r == 5 else set())
+            assert {m.data for m in collect(eng)} == want, r
+        for r, eng in enumerate(full):
+            want = set() if r == 3 else {b"full"}
+            assert {m.data for m in collect(eng)} == want, r
+
+
+class TestNativeSubset:
+    def test_bcast_and_iar_with_bystanders(self):
+        """C mirror over one NativeWorld: the subset engine rides
+        comm=1 on member ranks while a full-world comm=0 engine set
+        runs interleaved traffic. Delivery counts and the vetoed
+        decision pin the subset scope; the comm demux keeps both
+        engine sets' traffic apart."""
+        from rlo_tpu.native.bindings import NativeEngine, NativeWorld
+
+        with NativeWorld(WS) as world:
+            full = [NativeEngine(world, r) for r in range(WS)]
+            sub = {r: NativeEngine(
+                world, r, comm=1, members=MEMBERS,
+                judge_cb=lambda p, c, r=r: 0 if r == 5 else 1)
+                for r in MEMBERS}
+            sub[2].bcast(b"sub")
+            full[3].bcast(b"full")
+            rc = sub[0].submit_proposal(b"prop", pid=0)
+            for _ in range(100_000):
+                world.progress_all()
+                if rc == -1:
+                    rc = sub[0].vote_my_proposal()
+                if rc != -1:
+                    break
+            world.drain()
+            assert rc == 0  # rank 5's veto reached the subset proposer
+            for r in MEMBERS:
+                msgs = [m for m in iter(sub[r].pickup_next, None)]
+                datas = [m.data for m in msgs
+                         if m.type == int(Tag.BCAST)]
+                assert datas == ([] if r == 2 else [b"sub"]), (r, datas)
+            for r in range(WS):
+                datas = [m.data for m in iter(full[r].pickup_next, None)
+                         if m.type == int(Tag.BCAST)]
+                assert datas == ([] if r == 3 else [b"full"]), (r, datas)
+
+    def test_non_member_rejected(self):
+        from rlo_tpu.native.bindings import NativeEngine, NativeWorld
+
+        with NativeWorld(WS) as world:
+            with pytest.raises(RuntimeError):
+                NativeEngine(world, 1, comm=1, members=MEMBERS)
