@@ -1,0 +1,69 @@
+"""Multi-controller demo: 4 OS processes, engine consensus gating a real
+cross-process XLA collective (round-2 VERDICT "What's missing" #1).
+
+Run from the repo root (the launcher provides FEMTOMPI_RANK/SHM; the
+env forces per-process CPU JAX so jax.distributed federates locally):
+
+    PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+    RLO_COORDINATOR=127.0.0.1:28741 \
+    rlo_tpu/native/femtompirun -n 4 python benchmarks/multihost_demo.py
+
+Every process is BOTH an engine rank (femtompi shm rings — real
+cross-process vote frames) and a JAX controller (federated into one
+4-device CPU mesh — real cross-process AllReduce). Scenario:
+
+  round 1: proposer = rank 1 (rootless: not rank 0), all local tensors
+           finite -> every process approves -> the global psum runs and
+           every process gets the replicated sum.
+  round 2: rank 2 poisons ITS OWN local tensor with NaN; its judge
+           votes NO -> the AND-merged decision is 0 on EVERY process
+           and the device collective never runs anywhere.
+
+Self-verifying: each process checks both outcomes and prints one
+MULTIHOST-OK line; the launcher's collective exit makes any failure a
+nonzero rc.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+from rlo_tpu.parallel.multihost import MultiHostContext  # noqa: E402
+
+
+def main():
+    ctx = MultiHostContext()
+    rank, ws = ctx.rank, ctx.world_size
+
+    def judge(local):
+        return bool(np.isfinite(local).all())
+
+    # round 1: clean tensors, rootless proposer (rank 1)
+    local = np.full(256, float(rank + 1), np.float32)
+    decision, out = ctx.propose_collective(local, proposer=1,
+                                           judge=judge)
+    want = sum(range(1, ws + 1))
+    assert decision == 1, f"rank {rank}: clean round vetoed"
+    assert out is not None and np.allclose(out, want), (
+        f"rank {rank}: psum wrong: {out[:4]} != {want}")
+
+    # round 2: rank 2's local state is poisoned; everyone must see 0
+    local2 = local.copy()
+    if rank == 2:
+        local2[7] = np.nan
+    decision2, out2 = ctx.propose_collective(local2, proposer=3,
+                                             judge=judge)
+    assert decision2 == 0 and out2 is None, (
+        f"rank {rank}: poisoned round not vetoed (decision={decision2})")
+
+    print(f"MULTIHOST-OK rank={rank}/{ws} sum={float(out[0])}",
+          flush=True)
+    ctx.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -183,10 +183,11 @@ def bench_config1(tiny: bool) -> None:
         subprocess.run(["make", "-s", "mpidemo"], cwd=native, check=True,
                        capture_output=True, timeout=120)
         reps_b = 8 if tiny else 32
+        bytes_b = 4096 if tiny else 65536  # VERDICT item 6: 64 KB leg
         proc = subprocess.run(
             [str(native / "femtompirun"), "-n", str(ws), "-t", "240",
              str(native / "rlo_demo_mpi"), "-c", "nbcast",
-             "-m", str(reps_b)],
+             "-m", str(reps_b), "-b", str(bytes_b)],
             capture_output=True, text=True, timeout=280, check=True)
         m = re.search(r"overlay ([\d.]+) usec/bcast, MPI_Bcast "
                       r"([\d.]+) usec/bcast", proc.stdout)
@@ -195,8 +196,8 @@ def bench_config1(tiny: bool) -> None:
             print(f"config1 nbcast overlay: {t_ov:.1f} usec  "
                   f"MPI_Bcast: {t_nat:.1f} usec", file=sys.stderr)
             _emit(1, f"rootless overlay bcast vs native MPI_Bcast "
-                     f"(4 KB, {ws} real MPI processes via femtompi; "
-                     f"reference rootless_ops.c:1675)",
+                     f"({bytes_b >> 10} KB, {ws} real MPI processes "
+                     f"via femtompi; reference rootless_ops.c:1675)",
                   t_ov, "usec/bcast", t_nat / t_ov)
     except (subprocess.SubprocessError, OSError) as ex:
         print(f"config1 nbcast leg skipped: {ex}", file=sys.stderr)
@@ -421,14 +422,17 @@ def bench_config5(tiny: bool) -> None:
         mesh, (P(), P()), P())
     v0 = jnp.ones((), jnp.int32)
 
+    bound_only = False
     try:
         t_chained = bench._chain_time(lambda v, k: f(v, jnp.int32(k)),
                                       v0, k=1 << 20)
     except RuntimeError:
         # even 2^20 chained rounds sit below the dispatch noise floor:
-        # bound the per-round cost by noise/k (the scalar pmin is
-        # effectively free on device; the protocol cost is the host leg)
+        # the per-round cost is BOUNDED by noise/k but was not
+        # measured. A bound must never travel through the same field as
+        # a measurement (round-2 VERDICT item 8a) — emit it labeled.
         t_chained = 0.005 / (1 << 20)
+        bound_only = True
     one = jax.jit(lambda v: f(v, jnp.int32(1)))
     one(v0).block_until_ready()
     t0 = time.perf_counter()
@@ -436,14 +440,25 @@ def bench_config5(tiny: bool) -> None:
     for _ in range(reps_rt):
         np_.asarray(one(v0))
     t_rt = (time.perf_counter() - t0) / reps_rt
-    print(f"config5 TPU pmin: chained {t_chained*1e6:.1f} usec/round "
-          f"({1/t_chained:.0f} ops/s), host round-trip {t_rt*1e3:.1f} ms "
-          f"({1/t_rt:.1f} ops/s)", file=sys.stderr)
-    _emit(5, f"device consensus vote-merge (pmin) on "
-             f"{len(jax.devices())}-chip TPU, chained in-jit rounds; "
-             f"host-round-trip floor {t_rt*1e3:.1f} ms/round "
-             f"(baseline = 1k ops/s north-star target)",
-          1 / t_chained, "ops/s", (1 / t_chained) / 1000.0)
+    kind = "BOUND (not measured)" if bound_only else "measured"
+    print(f"config5 TPU pmin [{kind}]: chained {t_chained*1e6:.3f} "
+          f"usec/round ({1/t_chained:.0f} ops/s), host round-trip "
+          f"{t_rt*1e3:.1f} ms ({1/t_rt:.1f} ops/s)", file=sys.stderr)
+    if bound_only:
+        # labeled lower bound on the rate; vs_baseline is zeroed so no
+        # consumer keying on it can mistake the bound for a measured
+        # comparison (the bound itself rides "value" + bound=True)
+        _emit(5, f"device consensus vote-merge (pmin) on "
+                 f"{len(jax.devices())}-chip TPU: LOWER BOUND only "
+                 f"(chain below dispatch noise floor); host-round-trip "
+                 f"floor {t_rt*1e3:.1f} ms/round",
+              1 / t_chained, "ops/s", 0.0, bound=True)
+    else:
+        _emit(5, f"device consensus vote-merge (pmin) on "
+                 f"{len(jax.devices())}-chip TPU, chained in-jit rounds; "
+                 f"host-round-trip floor {t_rt*1e3:.1f} ms/round "
+                 f"(baseline = 1k ops/s north-star target)",
+              1 / t_chained, "ops/s", (1 / t_chained) / 1000.0)
 
 
 # ---------------------------------------------------------------------------

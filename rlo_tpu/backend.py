@@ -654,7 +654,11 @@ class MpiBackend(Backend):
         self.world.drain()
         return _unpack_array(msg.data)
 
-    def consensus(self, my_vote: int) -> int:
+    def consensus(self, my_vote: int, proposer: int = 0) -> int:
+        """One leaderless round over the real process ranks: ANY rank
+        may initiate (``proposer`` — the reference's rootless pitch,
+        RLO_submit_proposal from any rank), every process judges with
+        its own pinned vote, and the AND-merged decision broadcasts."""
         from rlo_tpu.wire import Tag
         self._my_vote = int(my_vote)  # read by this rank's judge cb
         # every rank's vote must be pinned BEFORE any proposal can
@@ -662,8 +666,8 @@ class MpiBackend(Backend):
         # previous collective could judge the proposal with its stale
         # previous-round vote
         self.world.barrier()
-        if self.rank == 0:
-            rc = self.engine.submit_proposal(b"facade", pid=0)
+        if self.rank == proposer:
+            rc = self.engine.submit_proposal(b"facade", pid=proposer)
             for _ in range(200_000_000):
                 if rc != -1:
                     break

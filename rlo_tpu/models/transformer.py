@@ -158,6 +158,34 @@ def _sincos(pos, d_model, dtype):
                            axis=-1).astype(dtype)
 
 
+def _local_attention(q, k, v, interpret=None):
+    """Unsharded causal attention on (b, L, H, D) tensors.
+
+    On TPU this is the fused flash kernel (pallas/flash.py — trainable
+    since the custom_vjp landed): the batch folds into the head axis
+    (attention is per-head independent; the causal mask is purely
+    position-driven, identical for every batch row), so the whole batch
+    is ONE kernel launch instead of a vmapped per-row program. Falls
+    back to the unfused oracle off-TPU or for shapes the kernel
+    rejects."""
+    b, L, nh, hd = q.shape
+    from rlo_tpu.pallas.flash import can_flash
+    use_flash = (interpret if interpret is not None
+                 else jax.default_backend() == "tpu") and \
+        can_flash(L, L, hd)
+    if not use_flash:
+        return jax.vmap(lambda q_, k_, v_: full_attention(
+            q_, k_, v_, causal=True))(q, k, v)
+    from rlo_tpu.pallas.flash import flash_attention
+
+    def fold(t):
+        return t.transpose(1, 0, 2, 3).reshape(L, b * nh, hd)
+
+    out = flash_attention(fold(q), fold(k), fold(v), causal=True,
+                          interpret=interpret)
+    return out.reshape(L, b, nh, hd).transpose(1, 0, 2, 3)
+
+
 def apply_layer(x, layer: dict, cfg: TransformerConfig, *,
                 sp_axis: Optional[str] = None,
                 tp_axis: Optional[str] = None,
@@ -191,8 +219,7 @@ def apply_layer(x, layer: dict, cfg: TransformerConfig, *,
 
     q, k, v = heads(q), heads(k), heads(v)
     if sp_axis is None:
-        att = jax.vmap(lambda q_, k_, v_: full_attention(
-            q_, k_, v_, causal=True))(q, k, v)
+        att = _local_attention(q, k, v)
     elif cfg.sp_attention == "ulysses":
         from rlo_tpu.ops.ulysses import ulysses_attention
         att = jax.vmap(lambda q_, k_, v_: ulysses_attention(

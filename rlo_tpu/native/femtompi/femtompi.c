@@ -82,7 +82,9 @@ struct fmpi_req {
     int count, ctag, got, stage;
     void *arbuf;
     uint8_t *acc;
-    struct fmpi_req **fan; /* rank 0: result sends, ws entries */
+    struct fmpi_req **fan; /* rank 0: result sends, ws entries;
+                              non-root: the 1 contribution send */
+    int n_fan;             /* entries in fan (for req_free reclaim) */
 };
 
 static struct {
@@ -285,8 +287,21 @@ static void reduce_in(MPI_Datatype dt, MPI_Op op, void *acc,
 static void req_free(struct fmpi_req *q);
 
 static struct fmpi_req *send_req_new(int dst, int tag, int comm,
-                                     const void *buf, uint32_t len)
+                                     const void *buf, uint64_t len)
 {
+    /* capacity check for EVERY send path (Isend, Bcast, Reduce,
+     * Iallreduce fans): an oversized frame can never leave the queue —
+     * ring_push would fail forever and the rank would spin until the
+     * launcher timeout instead of returning an error (round-2 advisor
+     * finding; the check used to live only in MPI_Isend) */
+    if (align8(FMPI_REC_HDR + len) > G.hdr->ring_bytes) {
+        fprintf(stderr,
+                "femtompi: message of %llu bytes exceeds ring capacity "
+                "%llu (raise femtompirun -r)\n",
+                (unsigned long long)len,
+                (unsigned long long)G.hdr->ring_bytes);
+        return 0;
+    }
     struct fmpi_req *q = (struct fmpi_req *)calloc(1, sizeof(*q));
     if (!q)
         return 0;
@@ -294,7 +309,7 @@ static struct fmpi_req *send_req_new(int dst, int tag, int comm,
     q->dst = dst;
     q->tag = tag;
     q->comm = comm;
-    q->len = len;
+    q->len = (uint32_t)len;
     q->sbuf = (uint8_t *)malloc(len ? len : 1);
     if (!q->sbuf) {
         free(q);
@@ -343,7 +358,7 @@ static void fmpi_progress(void)
             free(n);
             q->done = 1;
         } else if (q->kind == 3) {
-            int bytes = q->count * dt_size(q->dt);
+            int64_t bytes = (int64_t)q->count * dt_size(q->dt);
             if (G.rank != 0) {
                 /* stage 0: contribution queued at post time; wait for
                  * the result from rank 0 */
@@ -376,9 +391,10 @@ static void fmpi_progress(void)
                         (size_t)G.ws, sizeof(*q->fan));
                     if (!q->fan)
                         continue;
+                    q->n_fan = G.ws;
                     for (int r = 1; r < G.ws; r++)
                         q->fan[r] = send_req_new(r, q->ctag, q->comm,
-                                                 q->acc, (uint32_t)bytes);
+                                                 q->acc, (uint64_t)bytes);
                     memcpy(q->arbuf, q->acc, bytes);
                     q->stage = 1;
                 }
@@ -408,7 +424,15 @@ static void req_free(struct fmpi_req *q)
     act_remove(q);
     free(q->sbuf);
     free(q->acc);
-    free(q->fan); /* fan sends free themselves via MPI semantics below */
+    if (q->fan) {
+        /* freeing an in-flight collective: release any still-active
+         * fan sub-requests too, or they stay on the active list
+         * forever (round-2 advisor finding) */
+        for (int i = 0; i < q->n_fan; i++)
+            if (q->fan[i])
+                req_free(q->fan[i]);
+        free(q->fan);
+    }
     free(q);
 }
 
@@ -523,17 +547,8 @@ int MPI_Isend(const void *buf, int count, MPI_Datatype dt, int dest,
         dest == G.rank)
         return MPI_ERR_OTHER;
     uint64_t len = (uint64_t)count * (uint64_t)sz;
-    if (align8(FMPI_REC_HDR + len) > G.hdr->ring_bytes) {
-        fprintf(stderr,
-                "femtompi: message of %llu bytes exceeds ring capacity "
-                "%llu (raise femtompirun -r)\n",
-                (unsigned long long)len,
-                (unsigned long long)G.hdr->ring_bytes);
-        return MPI_ERR_OTHER;
-    }
-    struct fmpi_req *q =
-        send_req_new(dest, tag, comm, buf, (uint32_t)len);
-    if (!q)
+    struct fmpi_req *q = send_req_new(dest, tag, comm, buf, len);
+    if (!q) /* includes the ring-capacity check (reported to stderr) */
         return MPI_ERR_OTHER;
     fmpi_progress(); /* often completes the push immediately */
     *req = q;
@@ -674,7 +689,8 @@ int MPI_Iallreduce(const void *sendbuf, void *recvbuf, int count,
     int sz = dt_size(dt);
     if (!G.inited || sz <= 0 || count < 0)
         return MPI_ERR_OTHER;
-    int bytes = count * sz;
+    /* int64: count * sz overflows int for large counts (advisor) */
+    int64_t bytes = (int64_t)count * sz;
     struct fmpi_req *q = (struct fmpi_req *)calloc(1, sizeof(*q));
     if (!q)
         return MPI_ERR_OTHER;
@@ -695,10 +711,11 @@ int MPI_Iallreduce(const void *sendbuf, void *recvbuf, int count,
         act_append(q);
     } else {
         q->fan = (struct fmpi_req **)calloc(1, sizeof(*q->fan));
+        q->n_fan = 1;
         act_append(q);
         if (!q->fan ||
             !(q->fan[0] = send_req_new(0, q->ctag, comm, sendbuf,
-                                       (uint32_t)bytes))) {
+                                       (uint64_t)bytes))) {
             act_remove(q);
             free(q->fan);
             free(q);
@@ -733,13 +750,13 @@ int MPI_Bcast(void *buf, int count, MPI_Datatype dt, int root,
     if (!G.inited || sz <= 0 || count < 0 || root < 0 || root >= G.ws)
         return MPI_ERR_OTHER;
     int tag = coll_tag(comm);
-    int bytes = count * sz;
+    int64_t bytes = (int64_t)count * sz;
     if (G.rank == root) {
         for (int r = 0; r < G.ws; r++) {
             if (r == root)
                 continue;
             struct fmpi_req *s =
-                send_req_new(r, tag, comm, buf, (uint32_t)bytes);
+                send_req_new(r, tag, comm, buf, (uint64_t)bytes);
             if (!s)
                 return MPI_ERR_OTHER;
             while (!s->done) { /* block until in the ring */
@@ -761,10 +778,10 @@ int MPI_Reduce(const void *sendbuf, void *recvbuf, int count,
     if (!G.inited || sz <= 0 || count < 0 || root < 0 || root >= G.ws)
         return MPI_ERR_OTHER;
     int tag = coll_tag(comm);
-    int bytes = count * sz;
+    int64_t bytes = (int64_t)count * sz;
     if (G.rank != root) {
         struct fmpi_req *s =
-            send_req_new(root, tag, comm, sendbuf, (uint32_t)bytes);
+            send_req_new(root, tag, comm, sendbuf, (uint64_t)bytes);
         if (!s)
             return MPI_ERR_OTHER;
         while (!s->done) {

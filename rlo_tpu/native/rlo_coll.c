@@ -136,14 +136,21 @@ static int coll_pump(rlo_coll *c)
     int32_t origin = -1;
     p->len = rlo_frame_decode(n->frame->data, n->frame->len, &origin,
                               &p->pid, &p->vote, &p->payload);
+    rlo_handle_unref(n->handle);
+    if (p->len < 0) {
+        /* drop the undecodable frame BEFORE linking: a parked node
+         * with garbage (src, pid, vote) and negative len could later
+         * match a coll_take and memcpy from junk (advisor finding) */
+        rlo_blob_unref(n->frame);
+        free(n);
+        free(p);
+        return RLO_ERR_PROTO;
+    }
     p->src = n->src >= 0 ? n->src : origin;
     p->frame = n->frame; /* steal the ref */
     p->next = c->pend;
     c->pend = p;
-    rlo_handle_unref(n->handle);
     free(n);
-    if (p->len < 0)
-        return RLO_ERR_PROTO;
     return 1;
 }
 

@@ -23,6 +23,7 @@
  */
 #include "rlo_internal.h"
 
+#include <sched.h>
 #include <stdio.h>
 
 int rlo_mpi_available(void)
@@ -216,8 +217,11 @@ static int mpi_drain(rlo_world *base, int max_spins)
         for (int j = 0; j < base->n_engines; j++)
             if (!rlo_engine_idle(base->engines[j]))
                 local_idle = 0;
-        if (!local_idle || !mpi_quiescent(base))
+        if (!local_idle || !mpi_quiescent(base)) {
+            if ((i & 7) == 7) /* oversubscribed cores: let peers run */
+                sched_yield();
             continue;
+        }
         int64_t local[2] = {w->sent_cnt, w->recv_cnt};
         int64_t sum[2] = {0, 0};
         MPI_Request req;
@@ -234,6 +238,8 @@ static int mpi_drain(rlo_world *base, int max_spins)
             }
             MPI_Test(&req, &done, MPI_STATUS_IGNORE);
             rlo_progress_all(base); /* keep draining while reducing */
+            if (!done && (t & 7) == 7)
+                sched_yield(); /* peers must reach their Iallreduce */
         }
         if (sum[0] == sum[1] && sum[0] == prev_sum[0] &&
             prev_sum[0] == prev_sum[1])
