@@ -85,6 +85,10 @@ struct fmpi_req {
     struct fmpi_req **fan; /* rank 0: result sends, ws entries;
                               non-root: the 1 contribution send */
     int n_fan;             /* entries in fan (for req_free reclaim) */
+    uint64_t fan_made;     /* bit r: fan[r] was successfully created —
+                              distinguishes 'reclaimed' (NULL, made)
+                              from 'creation failed, retry' (NULL, not
+                              made); ws <= 64 enforced at init */
 };
 
 static struct {
@@ -286,6 +290,15 @@ static void reduce_in(MPI_Datatype dt, MPI_Op op, void *acc,
 
 static void req_free(struct fmpi_req *q);
 
+/* Does a frame of `len` payload bytes fit the per-pair ring at all?
+ * Collectives check this symmetrically on EVERY rank before any
+ * traffic: the sender-side failure alone would leave the peers parked
+ * in blocking waits with no timeout (review finding). */
+static int frame_fits(uint64_t len)
+{
+    return align8(FMPI_REC_HDR + len) <= G.hdr->ring_bytes;
+}
+
 static struct fmpi_req *send_req_new(int dst, int tag, int comm,
                                      const void *buf, uint64_t len)
 {
@@ -294,7 +307,7 @@ static struct fmpi_req *send_req_new(int dst, int tag, int comm,
      * ring_push would fail forever and the rank would spin until the
      * launcher timeout instead of returning an error (round-2 advisor
      * finding; the check used to live only in MPI_Isend) */
-    if (align8(FMPI_REC_HDR + len) > G.hdr->ring_bytes) {
+    if (!frame_fits(len)) {
         fprintf(stderr,
                 "femtompi: message of %llu bytes exceeds ring capacity "
                 "%llu (raise femtompirun -r)\n",
@@ -387,14 +400,31 @@ static void fmpi_progress(void)
                 if (q->got < G.ws - 1)
                     continue;
                 if (q->stage == 0) { /* fan the result out once */
-                    q->fan = (struct fmpi_req **)calloc(
-                        (size_t)G.ws, sizeof(*q->fan));
-                    if (!q->fan)
-                        continue;
-                    q->n_fan = G.ws;
-                    for (int r = 1; r < G.ws; r++)
+                    if (!q->fan) {
+                        q->fan = (struct fmpi_req **)calloc(
+                            (size_t)G.ws, sizeof(*q->fan));
+                        if (!q->fan)
+                            continue;
+                        q->n_fan = G.ws;
+                    }
+                    /* retry creation until every result send exists:
+                     * treating a failed creation like a reclaimed
+                     * (delivered) send would report success while the
+                     * peer waits forever (review finding) */
+                    int missing = 0;
+                    for (int r = 1; r < G.ws; r++) {
+                        if (q->fan_made & (1ull << r))
+                            continue;
                         q->fan[r] = send_req_new(r, q->ctag, q->comm,
-                                                 q->acc, (uint64_t)bytes);
+                                                 q->acc,
+                                                 (uint64_t)bytes);
+                        if (q->fan[r])
+                            q->fan_made |= 1ull << r;
+                        else
+                            missing = 1;
+                    }
+                    if (missing)
+                        continue;
                     memcpy(q->arbuf, q->acc, bytes);
                     q->stage = 1;
                 }
@@ -691,6 +721,8 @@ int MPI_Iallreduce(const void *sendbuf, void *recvbuf, int count,
         return MPI_ERR_OTHER;
     /* int64: count * sz overflows int for large counts (advisor) */
     int64_t bytes = (int64_t)count * sz;
+    if (!frame_fits((uint64_t)bytes))
+        return MPI_ERR_OTHER; /* symmetric: every rank rejects */
     struct fmpi_req *q = (struct fmpi_req *)calloc(1, sizeof(*q));
     if (!q)
         return MPI_ERR_OTHER;
@@ -751,6 +783,8 @@ int MPI_Bcast(void *buf, int count, MPI_Datatype dt, int root,
         return MPI_ERR_OTHER;
     int tag = coll_tag(comm);
     int64_t bytes = (int64_t)count * sz;
+    if (!frame_fits((uint64_t)bytes))
+        return MPI_ERR_OTHER; /* symmetric: every rank rejects */
     if (G.rank == root) {
         for (int r = 0; r < G.ws; r++) {
             if (r == root)
@@ -779,6 +813,8 @@ int MPI_Reduce(const void *sendbuf, void *recvbuf, int count,
         return MPI_ERR_OTHER;
     int tag = coll_tag(comm);
     int64_t bytes = (int64_t)count * sz;
+    if (!frame_fits((uint64_t)bytes))
+        return MPI_ERR_OTHER; /* symmetric: every rank rejects */
     if (G.rank != root) {
         struct fmpi_req *s =
             send_req_new(root, tag, comm, sendbuf, (uint64_t)bytes);

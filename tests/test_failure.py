@@ -162,6 +162,61 @@ class TestChaos:
         assert engines[origin].vote_my_proposal() == 1
 
     @pytest.mark.parametrize("seed", list(range(1, 13)))
+    def test_consensus_relay_killed_mid_round(self, seed):
+        """A consensus relay dies somewhere in the middle of the round
+        (between proposal fan-out and decision settlement) under
+        latency fuzz. The round-3 contract under this chaos: every
+        SURVIVOR eventually clears its pending queue (the proposer
+        discounts the dead subtree; parked parent-died rounds are
+        cleared by the decision, which survives the relay's death via
+        the decision re-flood) and survivors that saw the decision
+        agree on it. A stuck pending round would also wedge engine
+        snapshots — the regression the review feared."""
+        import random
+        ws = 8
+        clock = FakeClock()
+        world = LoopbackWorld(ws, latency=3, seed=seed)
+        mgr = EngineManager()
+        engines = [ProgressEngine(world.transport(r), manager=mgr,
+                                  failure_timeout=8.0,
+                                  heartbeat_interval=1.0, clock=clock)
+                   for r in range(ws)]
+        rng = random.Random(seed)
+        victim = rng.choice(range(1, ws))  # never the proposer
+        kill_at = rng.randint(1, 6)
+        engines[0].submit_proposal(b"chaos-round", pid=0)
+        for step in range(40):
+            if step == kill_at:
+                world.kill_rank(victim)
+                engines[victim].cleanup()
+            clock.advance(0.7)
+            mgr.progress_all()
+        spin(mgr, clock, 120)
+        survivors = [e for e in engines if e.rank != victim]
+        drain([world], survivors)
+        # proposer's round resolved (either verdict is legitimate
+        # depending on where the kill landed; it must not hang)
+        assert engines[0].vote_my_proposal() in (0, 1)
+        decision = engines[0].vote_my_proposal()
+        # no survivor left with a parked round: consensus state fully
+        # settled (this is what keeps checkpointing possible)
+        for e in survivors:
+            assert not e.queue_iar_pending, (
+                f"rank {e.rank} stuck with parked rounds "
+                f"{[(m.frame.pid, m.prop_state and m.prop_state.gen) for m in e.queue_iar_pending]}")
+        # survivors that delivered the decision agree with the proposer
+        for e in survivors:
+            if e.rank == 0:
+                continue
+            ds = []
+            while (m := e.pickup_next()) is not None:
+                if m.type == int(Tag.IAR_DECISION):
+                    ds.append(m.vote)
+            assert len(ds) <= 1, (e.rank, ds)
+            if ds:
+                assert ds[0] == decision, (e.rank, ds, decision)
+
+    @pytest.mark.parametrize("seed", list(range(1, 13)))
     def test_exactly_once_across_view_change(self, seed):
         """Traffic initiated by SURVIVORS before the kill must deliver
         exactly once at every other survivor, even when its forwarding

@@ -255,6 +255,38 @@ def test_grad_parity_ring_vs_psum():
                                    rtol=1e-4, atol=1e-5)
 
 
+def test_chunked_loss_matches_unfused():
+    """nll_sum_chunked (online-logsumexp LM head, never materializes
+    the (b, blk, vocab) logits) must match the plain loss in value AND
+    parameter grads — including a vocab that does not divide the chunk
+    (padding-row masking) and the chunk path wired through loss_fn via
+    cfg.loss_vocab_chunk."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from rlo_tpu.models.transformer import (TransformerConfig,
+                                            init_params, loss_fn)
+
+    cfg0 = TransformerConfig(vocab=1000, d_model=64, n_heads=4,
+                             n_layers=2, d_ff=128, dtype="float32",
+                             loss_vocab_chunk=0)
+    cfg1 = dataclasses.replace(cfg0, loss_vocab_chunk=256)  # 1000 % 256 != 0
+    params = init_params(jax.random.PRNGKey(0), cfg0)
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(0, cfg0.vocab, (2, 32)), jnp.int32)
+
+    l0, g0 = jax.value_and_grad(
+        lambda p: loss_fn(p, tokens, cfg0))(params)
+    l1, g1 = jax.value_and_grad(
+        lambda p: loss_fn(p, tokens, cfg1))(params)
+    np.testing.assert_allclose(float(l0), float(l1), rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(g0), jax.tree.leaves(g1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5)
+
+
 def test_local_attention_flash_fold_matches_unfused():
     """The batch→head fold feeding the flash kernel must match the
     vmapped unfused attention in values AND grads (the single-chip
