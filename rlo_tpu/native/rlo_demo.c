@@ -576,6 +576,35 @@ static int case_nbcast(rlo_world *w, int rank, void *vcfg)
 }
 #endif /* RLO_HAVE_MPI */
 
+#ifdef RLO_HAVE_MPI
+/* ---- toobig: oversized collectives fail symmetrically ----
+ * A frame larger than the femtompi per-pair ring can never be
+ * delivered; every rank must get MPI_ERR_OTHER promptly instead of
+ * the sender erroring alone while peers park in blocking waits until
+ * the launcher timeout (the round-3 review finding). */
+static int case_toobig(rlo_world *w, int rank, void *vcfg)
+{
+    (void)vcfg;
+    (void)w;
+    /* far above any configured ring (femtompirun default 4 MB) */
+    int count = 256 << 20;
+    static uint8_t tiny[1]; /* never touched: the size check fires
+                               before any buffer access */
+    uint64_t t0 = rlo_now_usec();
+    RCHECK(MPI_Bcast(tiny, count, MPI_BYTE, 0, MPI_COMM_WORLD) ==
+           MPI_ERR_OTHER);
+    RCHECK(MPI_Reduce(tiny, tiny, count, MPI_BYTE, MPI_SUM, 0,
+                      MPI_COMM_WORLD) == MPI_ERR_OTHER);
+    MPI_Request req;
+    RCHECK(MPI_Iallreduce(tiny, tiny, count / 4, MPI_INT, MPI_SUM,
+                          MPI_COMM_WORLD, &req) == MPI_ERR_OTHER);
+    /* symmetric + prompt: nobody blocked on a peer */
+    RCHECK(rlo_now_usec() - t0 < 5 * 1000 * 1000ull);
+    MPI_Barrier(MPI_COMM_WORLD); /* everyone got here: no hang */
+    return 0;
+}
+#endif /* RLO_HAVE_MPI */
+
 /* ---- subcomm: engine over a rank subset (sub-communicator) ----
  * Reference parity: RLO_progress_engine_new on any MPI_Comm — an
  * engine spanning ranks {0,2,ws-1} (rootless_ops.c:467, 1461) — while
@@ -790,6 +819,7 @@ static const demo_case CASES[] = {
     {"subcomm", case_subcomm},
 #ifdef RLO_HAVE_MPI
     {"nbcast", case_nbcast},
+    {"toobig", case_toobig},
 #endif
     {"fail", case_fail},     {"efail", case_efail},
 };
@@ -886,7 +916,8 @@ int main(int argc, char **argv)
             continue;
         matched++;
 #ifdef RLO_HAVE_MPI
-        if (!strcmp(CASES[c].name, "nbcast")) {
+        if (!strcmp(CASES[c].name, "nbcast") ||
+            !strcmp(CASES[c].name, "toobig")) {
             /* needs a live MPI runtime: only valid under an mpirun
              * launcher (mpi_main); calling MPI_Bcast from the shm
              * children without MPI_Init would abort */

@@ -450,6 +450,125 @@ static void test_coll(int ws)
     rlo_world_free(w);
 }
 
+static int judge_count(const uint8_t *p, int64_t n, void *ctx)
+{
+    (void)p;
+    (void)n;
+    (*(int *)ctx)++;
+    return 1;
+}
+
+/* Round-3: engine over a rank subset (sub-communicator, comm 1) with a
+ * full-world engine set running interleaved traffic on comm 0 — the
+ * ASan leg of rlo_engine_new_sub (pytest covers semantics; this proves
+ * the subset paths are leak/UAF-free). */
+static void test_subcomm(void)
+{
+    int ws = 8;
+    rlo_world *w = rlo_world_new(ws, 2, 21);
+    int members[3] = {0, 2, 7};
+    rlo_engine *ef[8];
+    rlo_engine *es[8] = {0};
+    int veto[8] = {0}, actions[8] = {0};
+    veto[7] = 1;
+    for (int r = 0; r < ws; r++)
+        ef[r] = rlo_engine_new(w, r, 0, 0, 0, 0, 0, 0);
+    for (int i = 0; i < 3; i++) {
+        int r = members[i];
+        es[r] = rlo_engine_new_sub(w, r, 1, members, 3, judge_veto,
+                                   &veto[r], action_count, &actions[r],
+                                   0);
+        CHECK(es[r] != 0);
+    }
+    CHECK(!rlo_engine_new_sub(w, 1, 1, members, 3, 0, 0, 0, 0, 0));
+    CHECK(rlo_bcast(ef[3], (const uint8_t *)"full", 4) == RLO_OK);
+    CHECK(rlo_bcast(es[2], (const uint8_t *)"sub", 3) == RLO_OK);
+    int rc = rlo_submit_proposal(es[0], (const uint8_t *)"p", 1, 0);
+    CHECK(rc == -1 || rc == 0);
+    CHECK(rlo_drain(w, 100000) >= 0);
+    CHECK(rlo_vote_my_proposal(es[0]) == 0); /* rank 7's veto won */
+    for (int r = 0; r < ws; r++) {
+        uint8_t buf[64];
+        int tag, origin, pid, vote, got = 0;
+        while (rlo_pickup_next(ef[r], &tag, &origin, &pid, &vote, buf,
+                               sizeof buf) >= 0)
+            got++;
+        CHECK(got == (r == 3 ? 0 : 1)); /* full bcast scope */
+        CHECK(rlo_engine_err(ef[r]) == RLO_OK);
+    }
+    for (int i = 0; i < 3; i++) {
+        int r = members[i];
+        uint8_t buf[64];
+        int tag, origin, pid, vote, got_b = 0, got_d = 0;
+        while (rlo_pickup_next(es[r], &tag, &origin, &pid, &vote, buf,
+                               sizeof buf) >= 0) {
+            if (tag == RLO_TAG_BCAST)
+                got_b++;
+            else if (tag == RLO_TAG_IAR_DECISION)
+                got_d++;
+        }
+        CHECK(got_b == (r == 2 ? 0 : 1)); /* subset bcast scope */
+        CHECK(got_d == (r == 0 ? 0 : 1)); /* declined decision */
+        CHECK(rlo_engine_err(es[r]) == RLO_OK);
+    }
+    for (int r = 0; r < ws; r++) {
+        rlo_engine_free(ef[r]);
+        if (es[r])
+            rlo_engine_free(es[r]);
+    }
+    rlo_world_free(w);
+}
+
+/* Round-3: deferred duplicate-parent vote — a relay with child votes
+ * outstanding records a duplicate's sender instead of voting an
+ * interim verdict; the round resolves when the (vetoing) child votes
+ * arrive, and teardown with a still-parked neighbor round leaks
+ * nothing (ASan leg of dup_parents/resolve_relay + the parked decline
+ * path). */
+static void test_deferred_dup_vote(void)
+{
+    int ws = 8;
+    rlo_world *w = rlo_world_new(ws, 0, 3);
+    int judged = 0;
+    rlo_engine *e = rlo_engine_new(w, 2, 0, judge_count, &judged, 0, 0,
+                                   0);
+    CHECK(e != 0);
+    int kids[8];
+    int n_kids = rlo_fwd_targets(ws, 2, 0, 0, kids, 8);
+    CHECK(n_kids >= 1); /* scenario needs an outstanding child */
+    uint8_t frame[64];
+    int64_t n = rlo_frame_encode(frame, sizeof frame, 0, 5, 777,
+                                 (const uint8_t *)"p", 1);
+    CHECK(n > 0);
+    CHECK(rlo_world_inject(w, 0, 2, 0, RLO_TAG_IAR_PROPOSAL, frame,
+                           n) == RLO_OK);
+    for (int i = 0; i < 200; i++)
+        rlo_progress_all(w);
+    CHECK(judged == 1);
+    /* duplicate from a re-formed-tree parent: recorded, not answered */
+    CHECK(rlo_world_inject(w, 6, 2, 0, RLO_TAG_IAR_PROPOSAL, frame,
+                           n) == RLO_OK);
+    for (int i = 0; i < 200; i++)
+        rlo_progress_all(w);
+    CHECK(judged == 1); /* never re-judged */
+    /* child votes (gen 777 echoed LE32); the last one vetoes */
+    uint8_t genb[4] = {(uint8_t)(777 & 0xff), (uint8_t)(777 >> 8), 0, 0};
+    for (int i = 0; i < n_kids; i++) {
+        uint8_t vf[64];
+        int64_t vn = rlo_frame_encode(vf, sizeof vf, kids[i], 5,
+                                      i == n_kids - 1 ? 0 : 1, genb, 4);
+        CHECK(vn > 0);
+        CHECK(rlo_world_inject(w, kids[i], 2, 0, RLO_TAG_IAR_VOTE, vf,
+                               vn) == RLO_OK);
+    }
+    for (int i = 0; i < 400; i++)
+        rlo_progress_all(w);
+    CHECK(judged == 1);
+    CHECK(rlo_engine_err(e) == RLO_OK);
+    rlo_engine_free(e); /* still parked (no decision): must not leak */
+    rlo_world_free(w);
+}
+
 int main(void)
 {
     static const int sizes[] = {2, 3, 5, 8, 16, 23, 32};
@@ -475,6 +594,8 @@ int main(void)
     test_coll(5);
     test_coll(8);
     test_coll(13);
+    test_subcomm();
+    test_deferred_dup_vote();
     if (failures) {
         fprintf(stderr, "%d FAILURES\n", failures);
         return 1;

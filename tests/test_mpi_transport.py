@@ -44,7 +44,7 @@ def test_all_cases_over_mpi(mpi_bins, ws):
     (fail/efail are shm-only and reported as SKIP)."""
     out = mpirun(mpi_bins, ws, "-m", 4, "-b", 65536)
     assert "FAIL" not in out
-    assert out.count("PASS") == 10  # runnable cases incl. benches
+    assert out.count("PASS") == 11  # runnable cases incl. benches
     assert out.count("SKIP") == 2   # fail/efail
 
 

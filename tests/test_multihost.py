@@ -37,7 +37,8 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_four_process_consensus_gated_psum(launcher):
+@pytest.mark.parametrize("nproc", [4, 8])
+def test_consensus_gated_psum_across_processes(launcher, nproc):
     env = {
         "PATH": "/usr/bin:/bin:/usr/local/bin",
         "HOME": "/tmp",
@@ -48,13 +49,14 @@ def test_four_process_consensus_gated_psum(launcher):
         "RLO_COORDINATOR": f"127.0.0.1:{_free_port()}",
     }
     proc = subprocess.run(
-        [str(launcher), "-n", "4", "-t", "280", sys.executable,
+        [str(launcher), "-n", str(nproc), "-t", "280", sys.executable,
          str(DEMO)],
         capture_output=True, text=True, timeout=300, cwd=str(REPO),
         env=env)
     assert proc.returncode == 0, (proc.stdout, proc.stderr)
     ok = [ln for ln in proc.stdout.splitlines()
           if ln.startswith("MULTIHOST-OK")]
-    assert len(ok) == 4, proc.stdout
+    assert len(ok) == nproc, proc.stdout
+    want = float(sum(range(1, nproc + 1)))
     for ln in ok:
-        assert "sum=10.0" in ln, ln
+        assert f"sum={want}" in ln, ln
