@@ -134,6 +134,8 @@ def load() -> C.CDLL:
     sig("rlo_bench_allreduce_ring", C.c_double,
         [C.c_int, C.c_int64, C.c_int])
     sig("rlo_coll_new", p, [p, C.c_int, C.c_int])
+    sig("rlo_coll_new_sub", p,
+        [p, C.c_int, C.c_int, C.POINTER(C.c_int), C.c_int])
     sig("rlo_coll_free", None, [p])
     fp = C.POINTER(C.c_float)
     sig("rlo_coll_allreduce_f32_start", C.c_int,
@@ -263,14 +265,30 @@ class NativeColl:
 
     MAX_SPINS = 200_000_000
 
-    def __init__(self, world: "NativeWorld", rank: int, comm: int = 64):
+    def __init__(self, world: "NativeWorld", rank: int, comm: int = 64,
+                 members: Optional[List[int]] = None):
+        """``members`` scopes the collectives to a rank subset (the
+        data-collective face of sub-communicators); slot layouts are
+        indexed by subset position."""
         self._lib = world._lib
         self.world = world
         self.rank = rank
         self.comm = comm  # must differ from every engine comm
-        self._c = self._lib.rlo_coll_new(world._w, rank, comm)
-        if not self._c:
-            raise ValueError(f"bad rank {rank} for this world")
+        if members is None:
+            self._c = self._lib.rlo_coll_new(world._w, rank, comm)
+            self.group_size = world.world_size
+            if not self._c:
+                raise ValueError(f"bad rank {rank} for this world")
+        else:
+            ms = sorted(set(members))
+            arr = (C.c_int * len(ms))(*ms)
+            self._c = self._lib.rlo_coll_new_sub(world._w, rank, comm,
+                                                 arr, len(ms))
+            self.group_size = len(ms)
+            if not self._c:
+                raise ValueError(
+                    f"bad subset for rank {rank}: members={ms} (need "
+                    f"2..64 in-range members including this rank)")
         self._keep = None  # buffers pinned while an op is in flight
 
     def close(self) -> None:
@@ -316,7 +334,7 @@ class NativeColl:
 
     def reduce_scatter_start(self, x: "np.ndarray", op: str = "sum"):
         buf = np.ascontiguousarray(x, np.float32).reshape(-1).copy()
-        ws = self.world.world_size
+        ws = self.group_size
         chunk = -(-buf.size // ws)
         out = np.empty(chunk, np.float32)
         rc = self._lib.rlo_coll_reduce_scatter_f32_start(
@@ -334,7 +352,7 @@ class NativeColl:
 
     # -- byte ops ------------------------------------------------------
     def all_gather_start(self, data: bytes):
-        ws = self.world.world_size
+        ws = self.group_size
         src = np.frombuffer(bytes(data), np.uint8).copy()
         out = np.empty(ws * len(data), np.uint8)
         rc = self._lib.rlo_coll_all_gather_start(
@@ -349,13 +367,13 @@ class NativeColl:
         """Returns [bytes per rank]."""
         out = self.all_gather_start(data)
         self._wait()
-        n = len(out) // self.world.world_size
+        n = len(out) // self.group_size
         raw = out.tobytes()
         return [raw[i * n:(i + 1) * n]
-                for i in range(self.world.world_size)]
+                for i in range(self.group_size)]
 
     def all_to_all_start(self, chunks):
-        ws = self.world.world_size
+        ws = self.group_size
         if len(chunks) != ws:
             raise ValueError(f"need {ws} chunks, got {len(chunks)}")
         n = len(chunks[0])
@@ -375,7 +393,7 @@ class NativeColl:
     def all_to_all(self, chunks):
         out = self.all_to_all_start(chunks)
         self._wait()
-        ws = self.world.world_size
+        ws = self.group_size
         n = len(out) // ws
         raw = out.tobytes()
         return [raw[i * n:(i + 1) * n] for i in range(ws)]

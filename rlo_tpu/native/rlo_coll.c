@@ -55,6 +55,13 @@ enum { PH_RS = 0, PH_AG, PH_ROT, PH_DONE };
 struct rlo_coll {
     rlo_world *w;
     int rank, ws, comm;
+    /* sub-communicator support: ring/slot math runs on VIRTUAL ranks
+     * 0..ws-1 (vrank = this rank's ring position); for subsets
+     * (sub=1, <= 64 members) transport endpoints map through real[],
+     * full-world contexts use identity arithmetic at ANY world size */
+    int vrank;
+    int sub;
+    int real[64];
     int next_opid;
     coll_pend *pend;
 
@@ -83,6 +90,43 @@ rlo_coll *rlo_coll_new(rlo_world *w, int rank, int comm)
     c->rank = rank;
     c->ws = rlo_world_size(w);
     c->comm = comm;
+    c->vrank = rank; /* full-world: endpoints are identity (endp) */
+    return c;
+}
+
+/* virtual ring position -> real transport endpoint */
+static int endp(const rlo_coll *c, int v)
+{
+    return c->sub ? c->real[v] : v;
+}
+
+rlo_coll *rlo_coll_new_sub(rlo_world *w, int rank, int comm,
+                           const int *members, int n_members)
+{
+    if (!members || n_members < 2 || n_members > 64 ||
+        n_members > rlo_world_size(w))
+        return 0;
+    int vr = -1;
+    for (int i = 0; i < n_members; i++) {
+        if (members[i] < 0 || members[i] >= rlo_world_size(w))
+            return 0;
+        for (int j = 0; j < i; j++)
+            if (members[j] == members[i])
+                return 0; /* duplicate member: the ring could never
+                             complete (two positions, one rank) */
+        if (members[i] == rank)
+            vr = i;
+    }
+    if (vr < 0)
+        return 0;
+    rlo_coll *c = rlo_coll_new(w, rank, comm);
+    if (!c)
+        return 0;
+    c->ws = n_members;
+    c->vrank = vr;
+    c->sub = 1;
+    for (int i = 0; i < n_members; i++)
+        c->real[i] = members[i];
     return c;
 }
 
@@ -270,7 +314,7 @@ int rlo_coll_all_gather_start(rlo_coll *c, const uint8_t *data,
     c->bbuf = (uint8_t *)malloc((size_t)(c->ws * len));
     if (!c->bbuf)
         return RLO_ERR_NOMEM;
-    memcpy(c->bbuf + (size_t)c->rank * len, data, (size_t)len);
+    memcpy(c->bbuf + (size_t)c->vrank * len, data, (size_t)len);
     c->kind = COLL_ALL_GATHER;
     c->bout = out;
     c->phase = c->ws > 1 ? PH_AG : PH_DONE;
@@ -291,8 +335,8 @@ int rlo_coll_all_to_all_start(rlo_coll *c, const uint8_t *data,
     if (!c->bbuf)
         return RLO_ERR_NOMEM;
     memcpy(c->bbuf, data, (size_t)(c->ws * len_per_rank));
-    memcpy(out + (size_t)c->rank * len_per_rank,
-           data + (size_t)c->rank * len_per_rank, (size_t)len_per_rank);
+    memcpy(out + (size_t)c->vrank * len_per_rank,
+           data + (size_t)c->vrank * len_per_rank, (size_t)len_per_rank);
     c->kind = COLL_ALL_TO_ALL;
     c->bout = out;
     c->phase = c->ws > 1 ? PH_AG : PH_DONE;
@@ -321,7 +365,7 @@ static void coll_finish(rlo_coll *c)
     if (c->kind == COLL_ALLREDUCE)
         memcpy(c->fout, c->fbuf, (size_t)c->count * sizeof(float));
     else if (c->kind == COLL_REDUCE_SCATTER)
-        memcpy(c->fout, c->fbuf + (size_t)c->rank * c->chunk,
+        memcpy(c->fout, c->fbuf + (size_t)c->vrank * c->chunk,
                (size_t)c->chunk * sizeof(float));
     else if (c->kind == COLL_ALL_GATHER)
         memcpy(c->bout, c->bbuf, (size_t)(c->ws * c->blen));
@@ -340,8 +384,9 @@ int rlo_coll_poll(rlo_coll *c)
         coll_finish(c);
         return 1;
     }
-    int ws = c->ws, rank = c->rank;
-    int nxt = (rank + 1) % ws, prv = (rank - 1 + ws) % ws;
+    int ws = c->ws, rank = c->vrank; /* ring position */
+    int nxt = endp(c, (rank + 1) % ws);       /* transport endpoints */
+    int prv = endp(c, (rank - 1 + ws) % ws);
     int rc;
 
     switch (c->kind) {
@@ -442,6 +487,11 @@ int rlo_coll_poll(rlo_coll *c)
                 if (!p)
                     return 0;
             }
+            if (p->len != c->chunk * (int64_t)sizeof(float)) {
+                rlo_blob_unref(p->frame);
+                free(p);
+                return RLO_ERR_PROTO;
+            }
             int64_t idx = ((own - c->step - 1) % ws + ws) % ws;
             memcpy(c->fbuf + idx * c->chunk, p->payload,
                    (size_t)c->chunk * sizeof(float));
@@ -495,22 +545,23 @@ int rlo_coll_poll(rlo_coll *c)
 
     case COLL_ALL_TO_ALL: {
         /* rotation: round d sends slot (rank+d) to rank+d, receives
-         * slot for me from rank-d (collectives.py:241-259) */
+         * slot for me from rank-d (collectives.py:241-259); slots are
+         * virtual positions, send/take endpoints are real ranks */
         int dst = (rank + c->step) % ws;
         int src = ((rank - c->step) % ws + ws) % ws;
         if (!c->sent) {
-            rc = coll_send(c, dst, c->opid, c->step,
+            rc = coll_send(c, endp(c, dst), c->opid, c->step,
                            c->bbuf + (size_t)dst * c->blen, c->blen);
             if (rc != RLO_OK)
                 return rc;
             c->sent = 1;
         }
-        coll_pend *p = coll_take(c, src, c->opid, c->step);
+        coll_pend *p = coll_take(c, endp(c, src), c->opid, c->step);
         if (!p) {
             rc = coll_pump(c);
             if (rc < 0)
                 return rc;
-            p = coll_take(c, src, c->opid, c->step);
+            p = coll_take(c, endp(c, src), c->opid, c->step);
             if (!p)
                 return 0;
         }
@@ -538,20 +589,19 @@ int rlo_coll_poll(rlo_coll *c)
         int dist = 1 << c->step;
         if (!c->sent) {
             uint8_t token = 1;
-            rc = coll_send(c, (rank + dist) % ws, c->opid, c->step,
-                           &token, 1);
+            rc = coll_send(c, endp(c, (rank + dist) % ws), c->opid,
+                           c->step, &token, 1);
             if (rc != RLO_OK)
                 return rc;
             c->sent = 1;
         }
-        coll_pend *p = coll_take(c, ((rank - dist) % ws + ws) % ws,
-                                 c->opid, c->step);
+        int from = endp(c, ((rank - dist) % ws + ws) % ws);
+        coll_pend *p = coll_take(c, from, c->opid, c->step);
         if (!p) {
             rc = coll_pump(c);
             if (rc < 0)
                 return rc;
-            p = coll_take(c, ((rank - dist) % ws + ws) % ws, c->opid,
-                          c->step);
+            p = coll_take(c, from, c->opid, c->step);
             if (!p)
                 return 0;
         }

@@ -340,6 +340,13 @@ typedef struct rlo_coll rlo_coll;
 enum rlo_coll_op { RLO_COLL_SUM = 0, RLO_COLL_MIN = 1, RLO_COLL_MAX = 2 };
 
 rlo_coll *rlo_coll_new(rlo_world *w, int rank, int comm);
+/* Data collectives over a RANK SUBSET (the collective face of
+ * rlo_engine_new_sub): ring/rotation schedules run over virtual ranks
+ * 0..n_members-1; slot layouts (all_gather / reduce_scatter /
+ * all_to_all) are indexed by subset position. `rank` must be a member;
+ * use a comm distinct from any full-world context on the same world. */
+rlo_coll *rlo_coll_new_sub(rlo_world *w, int rank, int comm,
+                           const int *members, int n_members);
 void rlo_coll_free(rlo_coll *c);
 /* in-place ring allreduce of count floats */
 int rlo_coll_allreduce_f32_start(rlo_coll *c, float *data, int64_t count,

@@ -450,6 +450,68 @@ static void test_coll(int ws)
     rlo_world_free(w);
 }
 
+/* Round-3: ring data collectives over a rank subset, interleaved with
+ * a full-world context on another comm (ASan leg of rlo_coll_new_sub:
+ * virtual-ring endpoints, subset slot layouts). */
+static void test_coll_sub(void)
+{
+    int ws = 8;
+    static const int members[3] = {1, 4, 6};
+    int n_m = 3;
+    rlo_world *w = rlo_world_new(ws, 0, 0);
+    CHECK(w != 0);
+    rlo_coll *cs[3];
+    rlo_coll *cf[8];
+    float bufs[3][10], buff[8][10];
+    const int64_t n = 10;
+    for (int i = 0; i < n_m; i++) {
+        cs[i] = rlo_coll_new_sub(w, members[i], 70, members, n_m);
+        CHECK(cs[i] != 0);
+    }
+    CHECK(!rlo_coll_new_sub(w, 0, 70, members, n_m)); /* non-member */
+    for (int r = 0; r < ws; r++) {
+        cf[r] = rlo_coll_new(w, r, 71);
+        CHECK(cf[r] != 0);
+    }
+    for (int i = 0; i < n_m; i++) {
+        for (int64_t j = 0; j < n; j++)
+            bufs[i][j] = (float)(members[i] + 1);
+        CHECK(rlo_coll_allreduce_f32_start(cs[i], bufs[i], n,
+                                           RLO_COLL_SUM) == RLO_OK);
+    }
+    for (int r = 0; r < ws; r++) {
+        for (int64_t j = 0; j < n; j++)
+            buff[r][j] = 1.0f;
+        CHECK(rlo_coll_allreduce_f32_start(cf[r], buff[r], n,
+                                           RLO_COLL_SUM) == RLO_OK);
+    }
+    int done = 0;
+    for (long spin = 0; done < n_m + ws && spin < 10000000L; spin++) {
+        done = 0;
+        for (int i = 0; i < n_m; i++)
+            if (rlo_coll_poll(cs[i]) == 1 ||
+                rlo_coll_poll(cs[i]) == RLO_ERR_ARG)
+                done++;
+        for (int r = 0; r < ws; r++)
+            if (rlo_coll_poll(cf[r]) == 1 ||
+                rlo_coll_poll(cf[r]) == RLO_ERR_ARG)
+                done++;
+    }
+    CHECK(done == n_m + ws);
+    float want = 0;
+    for (int i = 0; i < n_m; i++)
+        want += (float)(members[i] + 1);
+    for (int i = 0; i < n_m; i++)
+        CHECK(bufs[i][0] == want && bufs[i][n - 1] == want);
+    for (int r = 0; r < ws; r++)
+        CHECK(buff[r][0] == (float)ws);
+    for (int i = 0; i < n_m; i++)
+        rlo_coll_free(cs[i]);
+    for (int r = 0; r < ws; r++)
+        rlo_coll_free(cf[r]);
+    rlo_world_free(w);
+}
+
 static int judge_count(const uint8_t *p, int64_t n, void *ctx)
 {
     (void)p;
@@ -596,6 +658,7 @@ int main(void)
     test_coll(13);
     test_subcomm();
     test_deferred_dup_vote();
+    test_coll_sub();
     if (failures) {
         fprintf(stderr, "%d FAILURES\n", failures);
         return 1;

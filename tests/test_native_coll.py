@@ -172,3 +172,25 @@ class TestRingPieces:
             want = sum(range(1 + k, ws + 1 + k))
             for o in outs:
                 np.testing.assert_allclose(np.asarray(o), want)
+
+
+def test_full_world_ring_beyond_64_ranks():
+    """Full-world contexts must work at ANY world size — the subset
+    member map is a fixed 64-entry table, so the full-world endpoint
+    path must stay pure arithmetic (round-3 review regression: a
+    100-rank ring read past the table and hung)."""
+    from rlo_tpu.native.bindings import NativeColl, NativeWorld, run_colls
+
+    ws = 100
+    with NativeWorld(ws) as world:
+        colls = [NativeColl(world, r, comm=70) for r in range(ws)]
+        try:
+            xs = [np.full(4, 1.0, np.float32) for _ in range(ws)]
+            outs = run_colls(colls, [
+                lambda r=r: colls[r].allreduce_start(xs[r])
+                for r in range(ws)])
+            for o in outs:
+                np.testing.assert_allclose(np.asarray(o), float(ws))
+        finally:
+            for c in colls:
+                c.close()

@@ -108,6 +108,64 @@ class TestPythonSubset:
             assert {m.data for m in collect(eng)} == want, r
 
 
+class TestSubsetFailureInteraction:
+    def test_member_death_reforms_the_subset(self):
+        """Failure detection INSIDE a sub-communicator: the subset ring
+        heartbeats among members only; a dead member is detected, the
+        subset overlay re-forms over the survivors, and bcast +
+        consensus keep working within the (shrunken) subset. Pins the
+        interaction between the two users of the exclusion machinery
+        (static non-members + dynamic failures)."""
+        from tests.test_failure import FakeClock, spin
+
+        members = [0, 2, 5, 7]
+        world = make_world("loopback", WS)
+        mgr = EngineManager()
+        clock = FakeClock()
+        engines = {r: ProgressEngine(world.transport(r), manager=mgr,
+                                     members=members,
+                                     failure_timeout=8.0,
+                                     heartbeat_interval=1.0,
+                                     clock=clock)
+                   for r in members}
+        # healthy subset round first
+        engines[2].bcast(b"pre")
+        drain([world], list(engines.values()))
+        for r, eng in engines.items():
+            want = [] if r == 2 else [b"pre"]
+            assert [m.data for m in collect(eng)] == want, r
+        # member 5 dies; survivors must detect and re-form
+        world.kill_rank(5)
+        engines[5].cleanup()
+        survivors = {r: engines[r] for r in members if r != 5}
+        spin(mgr, clock, 80)
+        for r, eng in survivors.items():
+            assert 5 in eng.failed, (r, eng.failed)
+            # non-members remain excluded too
+            assert set(range(WS)) - set(members) <= eng.failed
+        drain([world], list(survivors.values()))
+        for eng in survivors.values():
+            while eng.pickup_next() is not None:
+                pass
+        # bcast among the surviving subset
+        engines[7].bcast(b"post")
+        drain([world], list(survivors.values()))
+        for r, eng in survivors.items():
+            want = [] if r == 7 else [b"post"]
+            assert [m.data for m in collect(eng)] == want, r
+        # consensus among the surviving subset (veto by 2)
+        for r, eng in survivors.items():
+            eng.judge_cb = lambda p, c, r=r: 0 if r == 2 else 1
+        decision = engines[0].submit_proposal(b"post-prop", pid=0)
+        for _ in range(10_000):
+            if decision != -1:
+                break
+            mgr.progress_all()
+            decision = engines[0].vote_my_proposal()
+        assert decision == 0
+        drain([world], list(survivors.values()))
+
+
 class TestNativeSubset:
     def test_bcast_and_iar_with_bystanders(self):
         """C mirror over one NativeWorld: the subset engine rides
@@ -150,3 +208,68 @@ class TestNativeSubset:
         with NativeWorld(WS) as world:
             with pytest.raises(RuntimeError):
                 NativeEngine(world, 1, comm=1, members=MEMBERS)
+
+    def test_data_collectives_over_subset(self):
+        """The ring data collectives (rlo_coll.c) scoped to a subset:
+        allreduce / reduce_scatter / all_gather / all_to_all / barrier
+        run over members {0,2,5} with slot layouts indexed by subset
+        position, while a FULL-WORLD allreduce runs interleaved on a
+        different comm — both must produce their own scopes' results."""
+        import numpy as np
+
+        from rlo_tpu.native.bindings import (NativeColl, NativeWorld,
+                                             run_colls)
+
+        with NativeWorld(WS) as world:
+            sub = [NativeColl(world, r, comm=70, members=MEMBERS)
+                   for r in MEMBERS]
+            full = [NativeColl(world, r, comm=71) for r in range(WS)]
+            xs = {r: np.full(40, float(r + 1), np.float32)
+                  for r in MEMBERS}
+            outs = run_colls(
+                sub + full,
+                [lambda r=r, c=c: c.allreduce_start(xs[r])
+                 for r, c in zip(MEMBERS, sub)] +
+                [lambda r=r, c=c: c.allreduce_start(
+                    np.full(8, float(r), np.float32))
+                 for r, c in enumerate(full)])
+            want_sub = sum(r + 1 for r in MEMBERS)
+            for o in outs[:len(MEMBERS)]:
+                np.testing.assert_allclose(o, want_sub)
+            want_full = sum(range(WS))
+            for o in outs[len(MEMBERS):]:
+                np.testing.assert_allclose(o, want_full)
+            # all_gather: slots indexed by subset position
+            parts = run_colls(
+                sub, [lambda r=r, c=c: c.all_gather_start(
+                    f"m{r}".encode()) for r, c in zip(MEMBERS, sub)])
+            for out in parts:
+                raw = out.tobytes()
+                n = len(raw) // len(MEMBERS)
+                got = [raw[i * n:(i + 1) * n] for i in
+                       range(len(MEMBERS))]
+                assert got == [f"m{r}".encode() for r in MEMBERS]
+            # all_to_all: member at position i sends chunk j to the
+            # member at position j
+            chunks = {r: [bytes([10 * r + j]) for j in
+                          range(len(MEMBERS))] for r in MEMBERS}
+            outs = run_colls(
+                sub, [lambda r=r, c=c: c.all_to_all_start(chunks[r])
+                      for r, c in zip(MEMBERS, sub)])
+            for i, out in enumerate(outs):
+                got = list(out.tobytes())
+                want = [10 * src + i for src in MEMBERS]
+                assert got == want, (i, got, want)
+            # reduce_scatter: each member gets its position's chunk
+            ys = {r: np.arange(6, dtype=np.float32) + (r + 1)
+                  for r in MEMBERS}
+            outs = run_colls(
+                sub, [lambda r=r, c=c: c.reduce_scatter_start(ys[r])
+                      for r, c in zip(MEMBERS, sub)])
+            total = np.sum([ys[r] for r in MEMBERS], axis=0)
+            for i, out in enumerate(outs):
+                np.testing.assert_allclose(out, total[i * 2:(i + 1) * 2])
+            run_colls(sub, [lambda c=c: c.barrier_start() or 1
+                            for c in sub])
+            for c in sub + full:
+                c.close()
