@@ -91,25 +91,52 @@ def _unpack_array(raw: bytes) -> np.ndarray:
 
 
 class Comm:
-    """One rank's collective communicator over a transport endpoint."""
+    """One rank's collective communicator over a transport endpoint.
 
-    def __init__(self, transport: Transport):
+    ``members`` scopes the collectives to a RANK SUBSET (the Python
+    mirror of rlo_coll_new_sub): every op's ring/rotation math and
+    slot layout runs on virtual positions 0..len(members)-1; the
+    _send/_recv boundary translates positions to real transport
+    endpoints. ``self.rank``/``self.world_size`` are therefore the
+    VIRTUAL position and group size inside the op code."""
+
+    def __init__(self, transport: Transport,
+                 members: Optional[Sequence[int]] = None):
         self.tp = transport
-        self.rank = transport.rank
-        self.world_size = transport.world_size
+        self.real_rank = transport.rank
+        if members is None:
+            self.group = list(range(transport.world_size))
+            self.rank = transport.rank
+        else:
+            self.group = sorted(set(int(r) for r in members))
+            if len(self.group) < 2:
+                raise ValueError(
+                    f"a sub-communicator needs >= 2 members, got "
+                    f"{self.group}")
+            if any(r < 0 or r >= transport.world_size
+                   for r in self.group):
+                raise ValueError(f"members {self.group} out of range "
+                                 f"[0, {transport.world_size})")
+            if transport.rank not in self.group:
+                raise ValueError(f"rank {transport.rank} is not in "
+                                 f"members {self.group}")
+            self.rank = self.group.index(transport.rank)
+        self.world_size = len(self.group)
         self._opid = itertools.count()
         # parked out-of-order arrivals: (src, opid, round) -> payload
         self._pending: Dict[Tuple[int, int, int], bytes] = {}
 
     # -- plumbing ----------------------------------------------------------
     def _send(self, dst: int, opid: int, rnd: int, x: np.ndarray) -> None:
-        frame = Frame(origin=self.rank, pid=opid, vote=rnd,
+        frame = Frame(origin=self.real_rank, pid=opid, vote=rnd,
                       payload=_pack_array(x))
-        self.tp.isend(dst, int(Tag.DATA), frame.encode())
+        self.tp.isend(self.group[dst], int(Tag.DATA), frame.encode())
 
     def _recv(self, src: int, opid: int, rnd: int):
-        """Coroutine: yield until the (src, opid, round) message arrives."""
-        key = (src, opid, rnd)
+        """Coroutine: yield until the (src, opid, round) message arrives.
+        ``src`` is a virtual position; arrivals are keyed by the real
+        sender rank the transport reports."""
+        key = (self.group[src], opid, rnd)
         while key not in self._pending:
             m = self.tp.poll()
             if m is None:

@@ -166,6 +166,67 @@ class TestSubsetFailureInteraction:
         drain([world], list(survivors.values()))
 
 
+class TestPythonCollectivesSubset:
+    def test_coroutine_collectives_over_subset(self):
+        """The Python coroutine collectives (ops/collectives.py::Comm)
+        scoped to members {0,2,5}, interleaved with a full-world Comm
+        set on a second world — mirror of the C rlo_coll_new_sub
+        semantics: virtual ring math, subset slot layouts."""
+        import numpy as np
+
+        from rlo_tpu.ops.collectives import Comm, run_collectives
+
+        world = make_world("loopback", WS)
+        world2 = make_world("loopback", WS)
+        sub = {r: Comm(world.transport(r), members=MEMBERS)
+               for r in MEMBERS}
+        full = [Comm(world2.transport(r)) for r in range(WS)]
+        outs = run_collectives(
+            [sub[r].allreduce(np.full(5, float(r + 1), np.float32))
+             for r in MEMBERS] +
+            [c.allreduce(np.full(5, 1.0, np.float32)) for c in full])
+        want_sub = sum(r + 1 for r in MEMBERS)
+        for o in outs[:len(MEMBERS)]:
+            np.testing.assert_allclose(o, want_sub)
+        for o in outs[len(MEMBERS):]:
+            np.testing.assert_allclose(o, float(WS))
+        # all_gather: slots by subset position
+        outs = run_collectives(
+            [sub[r].all_gather(np.array([r], np.int32))
+             for r in MEMBERS])
+        for o in outs:
+            np.testing.assert_array_equal(np.asarray(o).reshape(-1),
+                                          MEMBERS)
+        # all_to_all: position i's chunk j goes to position j
+        outs = run_collectives(
+            [sub[r].all_to_all([np.array([10 * r + j], np.int32)
+                                for j in range(len(MEMBERS))])
+             for r in MEMBERS])
+        for i, o in enumerate(outs):
+            got = [int(np.asarray(ch)[0]) for ch in o]
+            assert got == [10 * src + i for src in MEMBERS], (i, got)
+        # reduce_scatter + barrier complete over the subset
+        outs = run_collectives(
+            [sub[r].reduce_scatter(
+                np.arange(6, dtype=np.float32) + (r + 1))
+             for r in MEMBERS])
+        total = np.sum([np.arange(6, dtype=np.float32) + (r + 1)
+                        for r in MEMBERS], axis=0)
+        for i, o in enumerate(outs):
+            np.testing.assert_allclose(np.asarray(o).reshape(-1),
+                                       total[i * 2:(i + 1) * 2])
+        run_collectives([sub[r].barrier() for r in MEMBERS])
+
+    def test_validation(self):
+        from rlo_tpu.ops.collectives import Comm
+
+        world = make_world("loopback", WS)
+        with pytest.raises(ValueError, match="not in members"):
+            Comm(world.transport(1), members=MEMBERS)
+        with pytest.raises(ValueError, match=">= 2 members"):
+            Comm(world.transport(0), members=[0])
+
+
 class TestNativeSubset:
     def test_bcast_and_iar_with_bystanders(self):
         """C mirror over one NativeWorld: the subset engine rides
