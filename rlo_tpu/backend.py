@@ -127,6 +127,13 @@ class Backend:
     def barrier(self) -> None:
         raise NotImplementedError
 
+    def sub_group(self, members: Sequence[int]) -> "Backend":
+        """Facade over a rank subset (a sub-communicator). Implemented
+        by the loopback and native backends; on the TPU data plane
+        subsetting is expressed with jax.sharding sub-meshes instead."""
+        raise NotImplementedError(
+            f"backend {self.name!r} has no sub-communicator facade")
+
     def close(self) -> None:
         pass
 
@@ -405,9 +412,49 @@ class LoopbackBackend(Backend):
     def barrier(self) -> None:
         self._run([c.barrier() for c in self._comms])
 
+    def sub_group(self, members: Sequence[int]) -> "LoopbackBackend":
+        """Facade over a rank subset. The Python loopback transport has
+        no comm demux, so a sub-communicator IS its own dup'ed world —
+        exactly the reference model (MPI_Comm_dup per engine,
+        rootless_ops.c:1461): fresh worlds carry subset engines
+        (ProgressEngine members=...) and subset Comm objects at the
+        member endpoints. Ops are indexed by subset position."""
+        return _LoopbackSubGroup(self, members)
+
     def close(self) -> None:
         for e in self._engines:
             e.cleanup()
+
+
+class _LoopbackSubGroup(LoopbackBackend):
+    """Scoped facade returned by LoopbackBackend.sub_group; all
+    inherited ops work positionally (world_size = group size)."""
+
+    name = "loopback-sub"
+
+    def __init__(self, parent: "LoopbackBackend", members: Sequence[int]):
+        from rlo_tpu.engine import EngineManager, ProgressEngine, drain
+        from rlo_tpu.ops.collectives import Comm, run_collectives
+        from rlo_tpu.transport.loopback import LoopbackWorld
+
+        ms = sorted(set(int(r) for r in members))
+        full_ws = parent._eng_world.world_size
+        self.members = ms
+        self.world_size = len(ms)
+        self._eng_world = LoopbackWorld(full_ws)
+        self._coll_world = LoopbackWorld(full_ws)
+        self._manager = EngineManager()
+        self._engines = [
+            ProgressEngine(self._eng_world.transport(r),
+                           manager=self._manager, members=ms)
+            for r in ms]
+        self._comms = [Comm(self._coll_world.transport(r), members=ms)
+                       for r in ms]
+        self._run = run_collectives
+        self._drain = drain
+
+    def sub_group(self, members):
+        raise NotImplementedError("nested sub-groups are not supported")
 
 
 @_register("native")
@@ -439,6 +486,19 @@ class NativeBackend(Backend):
                         for r in range(self.world_size)]
         self.colls = [NativeColl(self.world, r, comm=self.COLL_COMM)
                       for r in range(self.world_size)]
+        self._pos = {r: r for r in range(self.world_size)}
+        self._msg_size_max = msg_size_max
+        self._sub_comm_next = 128  # engine comm 0 / coll comm 64 taken
+
+    def sub_group(self, members: Sequence[int]) -> "NativeBackend":
+        """Facade over a rank subset — the reference's engine-on-any-
+        communicator (rootless_ops.c:467, 1461) surfaced at the facade
+        level. The returned backend shares this world (comm-demuxed
+        subset engines + subset C collectives); its ops take/return
+        lists indexed by SUBSET POSITION, and its world_size is the
+        group size. Close the subgroup before (or let it die with)
+        the parent."""
+        return _NativeSubGroup(self, members)
 
     def _run_colls(self, starts):
         from rlo_tpu.native.bindings import run_colls
@@ -474,7 +534,7 @@ class NativeBackend(Backend):
                 msg = e.pickup_next()
                 if msg is None:
                     break
-                got[msg.origin] = _unpack_array(msg.data)
+                got[self._pos[msg.origin]] = _unpack_array(msg.data)
             assert all(g is not None for g in got), \
                 f"rank {r} missed a broadcast"
             out.append(got)
@@ -568,6 +628,46 @@ class NativeBackend(Backend):
         for c in self.colls:
             c.close()
         self.world.close()
+
+
+class _NativeSubGroup(NativeBackend):
+    """Scoped facade returned by NativeBackend.sub_group: the same op
+    surface over subset engines (rlo_engine_new_sub) and subset C
+    collectives (rlo_coll_new_sub) on the PARENT's world, isolated by
+    fresh comm ids. Every inherited op works positionally: world_size
+    is the group size, engines/colls are indexed by subset position,
+    and _pos maps real origin ranks back to positions."""
+
+    name = "native-sub"
+
+    def __init__(self, parent: NativeBackend, members: Sequence[int]):
+        from rlo_tpu.native.bindings import NativeColl, NativeEngine
+
+        ms = sorted(set(int(r) for r in members))
+        self.world = parent.world
+        self.world_size = len(ms)
+        self.members = ms
+        self._pos = {r: i for i, r in enumerate(ms)}
+        self._msg_size_max = parent._msg_size_max
+        self._sub_comm_next = None  # subgroups don't nest (yet)
+        ec = parent._sub_comm_next
+        parent._sub_comm_next += 2
+        self.engines = [NativeEngine(self.world, r, comm=ec,
+                                     members=ms,
+                                     msg_size_max=self._msg_size_max)
+                        for r in ms]
+        self.colls = [NativeColl(self.world, r, comm=ec + 1,
+                                 members=ms) for r in ms]
+
+    def sub_group(self, members):
+        raise NotImplementedError("nested sub-groups are not supported")
+
+    def close(self) -> None:
+        for c in self.colls:
+            c.close()
+        for e in list(self.engines):
+            e.close()
+        # the world belongs to the parent
 
 
 @_register("shm")

@@ -166,6 +166,59 @@ class TestSubsetFailureInteraction:
         drain([world], list(survivors.values()))
 
 
+class TestFacadeSubGroup:
+    """backend.sub_group(members): the facade-level sub-communicator —
+    same op surface, lists indexed by subset position."""
+
+    @pytest.mark.parametrize("name", ["loopback", "native"])
+    def test_ops_over_subgroup(self, name):
+        import numpy as np
+
+        import rlo_tpu
+
+        with rlo_tpu.init(backend=name, world_size=WS) as b:
+            g = b.sub_group(MEMBERS)
+            assert g.world_size == len(MEMBERS)
+            # bcast from subset position 1 (real rank 2)
+            out = g.bcast(1, np.arange(6, dtype=np.float32))
+            assert len(out) == len(MEMBERS)
+            for o in out:
+                np.testing.assert_allclose(o, np.arange(6))
+            # allreduce over the subset only
+            xs = [np.full(5, float(r + 1), np.float32)
+                  for r in MEMBERS]
+            outs = g.allreduce(xs)
+            want = sum(r + 1 for r in MEMBERS)
+            for o in outs:
+                np.testing.assert_allclose(o, want)
+            # consensus among group-size participants (position 0 veto)
+            assert g.consensus([0] + [1] * (len(MEMBERS) - 1)) == 0
+            assert g.consensus([1] * len(MEMBERS)) == 1
+            # the PARENT facade still works at full scope alongside
+            outs = b.allreduce([np.full(4, 1.0, np.float32)
+                                for _ in range(WS)])
+            for o in outs:
+                np.testing.assert_allclose(o, float(WS))
+            # all_gather stacks subset-position slots
+            ag = g.all_gather([np.array([r], np.int32)
+                               for r in MEMBERS])
+            for o in ag:
+                np.testing.assert_array_equal(
+                    np.asarray(o).reshape(-1), MEMBERS)
+            g.barrier()
+            g.close()
+
+    @pytest.mark.parametrize("name", ["loopback", "native"])
+    def test_nested_subgroup_rejected(self, name):
+        import rlo_tpu
+
+        with rlo_tpu.init(backend=name, world_size=WS) as b:
+            g = b.sub_group(MEMBERS)
+            with pytest.raises(NotImplementedError):
+                g.sub_group(MEMBERS[:2])
+            g.close()
+
+
 class TestPythonCollectivesSubset:
     def test_coroutine_collectives_over_subset(self):
         """The Python coroutine collectives (ops/collectives.py::Comm)
