@@ -1,0 +1,135 @@
+"""KV-cache autoregressive generation for the flagship transformer.
+
+The training side (models.transformer) recomputes full attention every
+step; generation wants O(1) work per new token: each layer's keys and
+values are cached at (batch, max_len, heads, head_dim) and a decode
+step attends the single new query against the cache prefix. Shapes stay
+STATIC (the cache is allocated at max_len up front and masked by the
+traced position) so the whole generate loop is one `lax.scan` inside
+one jit — XLA-friendly control flow, no per-token retrace.
+
+Scope: dense single-device decode (the inference story of the flagship
+model; sampling is greedy or temperature-softmax). The math mirrors
+apply_layer exactly — rmsnorm/qkv/attention/wo/ffn with the same
+weights — pinned by a logits-parity test against the training `forward`
+at every generated position (tests/test_generate.py).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from rlo_tpu.models.transformer import (TransformerConfig, apply_layer,
+                                        _rmsnorm, _sincos)
+from rlo_tpu.ops.ring_attention import _NEG
+
+
+def init_kv_cache(cfg: TransformerConfig, batch: int, max_len: int):
+    """Zeroed per-layer K/V cache: a list of {"k","v"} arrays shaped
+    (batch, max_len, n_heads, head_dim) in the activation dtype."""
+    if cfg.n_experts > 0:
+        raise NotImplementedError("decode supports dense configs only")
+    shape = (batch, max_len, cfg.n_heads, cfg.head_dim)
+    z = jnp.zeros(shape, cfg.act_dtype)
+    return [{"k": z, "v": z} for _ in range(cfg.n_layers)]
+
+
+def _attend_cache(q, k_cache, v_cache, pos, scale):
+    """q (b, 1, H, hd) against the cache prefix [0, pos]: full-length
+    matmul over the static cache, masked beyond the position."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k_cache.astype(jnp.float32),
+                   preferred_element_type=jnp.float32) * scale
+    mask = jnp.arange(k_cache.shape[1]) <= pos           # (max_len,)
+    s = jnp.where(mask[None, None, None, :], s, _NEG)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p,
+                      v_cache.astype(jnp.float32))
+
+
+def decode_step(params: dict, token, pos, cache, cfg: TransformerConfig
+                ) -> Tuple[jax.Array, list]:
+    """One token (b,) int32 at position ``pos`` through all layers
+    using the K/V cache. Returns (logits (b, vocab) f32, new cache).
+    The layer math IS apply_layer (single source); only the attention
+    is swapped for the cache-attend via its ``attention`` hook."""
+    dt = cfg.act_dtype
+    x = params["embed"][token].astype(dt)[:, None, :] \
+        + _sincos(jnp.asarray(pos)[None], cfg.d_model, dt)
+    scale = 1.0 / (cfg.head_dim ** 0.5)
+    new_cache = []
+    for layer, lc in zip(params["layers"], cache):
+        def attend(q, k, v, lc=lc):
+            kc = lax.dynamic_update_slice(lc["k"], k.astype(dt),
+                                          (0, pos, 0, 0))
+            vc = lax.dynamic_update_slice(lc["v"], v.astype(dt),
+                                          (0, pos, 0, 0))
+            new_cache.append({"k": kc, "v": vc})
+            return _attend_cache(q, kc, vc, pos, scale).astype(dt)
+
+        x, _ = apply_layer(x, layer, cfg, attention=attend)
+    x = _rmsnorm(x, params["ln_f"]["g"])
+    logits = (x[:, 0, :] @ params["embed"].T.astype(dt)) \
+        .astype(jnp.float32)
+    return logits, new_cache
+
+
+def prefill(params: dict, tokens, cache, cfg: TransformerConfig):
+    """Run the prompt through the cache one position at a time (scan).
+    Returns (logits of the last prompt position, filled cache).
+
+    A blockwise prefill would batch this; the scan keeps the code one
+    path (decode_step) and the cost is one prompt-length pass."""
+    b, plen = tokens.shape
+
+    def step(carry, t):
+        cache, pos, _ = carry
+        logits, cache = decode_step(params, t, pos, cache, cfg)
+        return (cache, pos + 1, logits), None
+
+    z = jnp.zeros((b, cfg.vocab), jnp.float32)
+    (cache, _, logits), _ = lax.scan(step, (cache, 0, z),
+                                     jnp.transpose(tokens))
+    return logits, cache
+
+
+def generate(params: dict, prompt, cfg: TransformerConfig, *,
+             max_new: int, max_len: Optional[int] = None,
+             temperature: float = 0.0,
+             rng: Optional[jax.Array] = None):
+    """Autoregressive continuation of ``prompt`` (b, plen) int32:
+    returns (b, max_new) int32 new tokens. temperature 0 = greedy;
+    > 0 samples from softmax(logits/T) (needs ``rng``). Jittable as a
+    whole (static shapes; one lax.scan over the new positions)."""
+    b, plen = prompt.shape
+    max_len = max_len or (plen + max_new)
+    if plen + max_new > max_len:
+        raise ValueError(f"prompt {plen} + max_new {max_new} exceeds "
+                         f"max_len {max_len}")
+    if temperature > 0 and rng is None:
+        # argument error: raise before any cache/prefill work is spent
+        raise ValueError("sampling (temperature > 0) needs rng")
+    cache = init_kv_cache(cfg, b, max_len)
+    logits, cache = prefill(params, prompt, cache, cfg)
+
+    def pick(logits, key):
+        if temperature == 0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return jax.random.categorical(
+            key, logits / temperature, axis=-1).astype(jnp.int32)
+
+    keys = (jax.random.split(rng, max_new) if rng is not None
+            else jnp.zeros((max_new, 2), jnp.uint32))
+
+    def step(carry, key):
+        logits, cache, pos = carry
+        tok = pick(logits, key)
+        logits, cache = decode_step(params, tok, pos, cache, cfg)
+        return (logits, cache, pos + 1), tok
+
+    (_, _, _), toks = lax.scan(step, (logits, cache, plen), keys)
+    return jnp.transpose(toks)  # (b, max_new)

@@ -201,12 +201,16 @@ def apply_layer(x, layer: dict, cfg: TransformerConfig, *,
                 sp_axis: Optional[str] = None,
                 tp_axis: Optional[str] = None,
                 tp_algorithm: str = "psum",
-                ep_axis: Optional[str] = None):
+                ep_axis: Optional[str] = None,
+                attention=None):
     """One transformer layer (attention + FFN sublayers) on activation
     ``x`` (b, blk, d). Returns (x, aux). The single source of the layer
-    math — `forward` iterates it and the pipeline stage
-    (models.pipeline) scans it, so the block cannot silently diverge
-    between the two."""
+    math — `forward` iterates it, the pipeline stage (models.pipeline)
+    scans it, and the KV-cache decode (models.generate) calls it with a
+    custom ``attention`` callable — so the block cannot silently
+    diverge between them. ``attention(q, k, v)`` receives and returns
+    (b, blk, heads, head_dim); None selects the training dispatch
+    (local flash / ring / ulysses)."""
     b, blk, _ = x.shape
     dt = x.dtype
     ntp = lax.axis_size(tp_axis) if tp_axis is not None else 1
@@ -229,7 +233,9 @@ def apply_layer(x, layer: dict, cfg: TransformerConfig, *,
         return t.reshape(b, blk, nh_local, cfg.head_dim)
 
     q, k, v = heads(q), heads(k), heads(v)
-    if sp_axis is None:
+    if attention is not None:
+        att = attention(q, k, v)
+    elif sp_axis is None:
         att = _local_attention(q, k, v)
     elif cfg.sp_attention == "ulysses":
         from rlo_tpu.ops.ulysses import ulysses_attention

@@ -17,6 +17,24 @@ SUITE = Path(__file__).resolve().parent.parent / "benchmarks" / "suite.py"
 TRAIN = Path(__file__).resolve().parent.parent / "benchmarks" / "train_bench.py"
 
 
+def test_decode_bench_emits_json_line():
+    """The KV-cache decode benchmark must run end-to-end at --tiny
+    sizes and emit one valid JSON line."""
+    import os
+    env = dict(os.environ,
+               PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu")
+    bench = Path(__file__).resolve().parent.parent / "benchmarks" / \
+        "decode_bench.py"
+    proc = subprocess.run(
+        [sys.executable, str(bench), "--tiny"],
+        capture_output=True, text=True, timeout=600, env=env)
+    assert proc.returncode == 0, proc.stderr
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1, proc.stdout
+    rec = json.loads(lines[0])
+    assert rec["unit"] == "tokens/s" and rec["value"] > 0
+
+
 def test_train_bench_emits_json_line():
     """The train-step MFU benchmark (round-2 VERDICT item 5) must run
     end-to-end at --tiny sizes and emit one valid JSON line."""
