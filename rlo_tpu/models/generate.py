@@ -2,8 +2,9 @@
 
 The training side (models.transformer) recomputes full attention every
 step; generation wants O(1) work per new token: each layer's keys and
-values are cached at (batch, max_len, heads, head_dim) and a decode
-step attends the single new query against the cache prefix. Shapes stay
+values are cached at (batch, max_len, kv_heads, head_dim) — kv_heads <
+n_heads for GQA configs — and a decode step attends the single new
+query against the cache prefix (grouped, never repeated). Shapes stay
 STATIC (the cache is allocated at max_len up front and masked by the
 traced position) so the whole generate loop is one `lax.scan` inside
 one jit — XLA-friendly control flow, no per-token retrace.
@@ -30,25 +31,35 @@ from rlo_tpu.ops.ring_attention import _NEG
 
 def init_kv_cache(cfg: TransformerConfig, batch: int, max_len: int):
     """Zeroed per-layer K/V cache: a list of {"k","v"} arrays shaped
-    (batch, max_len, n_heads, head_dim) in the activation dtype."""
+    (batch, max_len, kv_heads, head_dim) in the activation dtype —
+    GQA configs (n_kv_heads < n_heads) store only the K/V heads, the
+    n_heads/kv_heads memory win that motivates GQA."""
     if cfg.n_experts > 0:
         raise NotImplementedError("decode supports dense configs only")
-    shape = (batch, max_len, cfg.n_heads, cfg.head_dim)
+    shape = (batch, max_len, cfg.kv_heads, cfg.head_dim)
     z = jnp.zeros(shape, cfg.act_dtype)
     return [{"k": z, "v": z} for _ in range(cfg.n_layers)]
 
 
 def _attend_cache(q, k_cache, v_cache, pos, scale):
     """q (b, 1, H, hd) against the cache prefix [0, pos]: full-length
-    matmul over the static cache, masked beyond the position."""
-    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+    matmul over the static cache, masked beyond the position. The
+    cache may hold fewer (grouped) K/V heads: each group of
+    H/kv_heads query heads attends its shared K/V head directly —
+    no repeat is ever materialized."""
+    b, one, nh, hd = q.shape
+    nkv = k_cache.shape[2]
+    rep = nh // nkv
+    qg = q.reshape(b, one, nkv, rep, hd)
+    s = jnp.einsum("bqgrd,bkgd->bgrqk", qg.astype(jnp.float32),
                    k_cache.astype(jnp.float32),
                    preferred_element_type=jnp.float32) * scale
     mask = jnp.arange(k_cache.shape[1]) <= pos           # (max_len,)
-    s = jnp.where(mask[None, None, None, :], s, _NEG)
+    s = jnp.where(mask[None, None, None, None, :], s, _NEG)
     p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bhqk,bkhd->bqhd", p,
-                      v_cache.astype(jnp.float32))
+    out = jnp.einsum("bgrqk,bkgd->bqgrd", p,
+                     v_cache.astype(jnp.float32))
+    return out.reshape(b, one, nh, hd)
 
 
 def decode_step(params: dict, token, pos, cache, cfg: TransformerConfig

@@ -58,18 +58,26 @@ def unstack_layers(pparams: dict, n_layers: int) -> dict:
             "layers": layers}
 
 
-def pipeline_pspecs(pp_axis: Optional[str] = None):
+def pipeline_pspecs(pp_axis: Optional[str] = None,
+                    cfg: Optional[TransformerConfig] = None):
     """PartitionSpec tree for `stack_layers` output: stacked layer
-    leaves sharded over `pp` on the layer axis, embed/ln_f replicated."""
+    leaves sharded over `pp` on the layer axis, embed/ln_f replicated.
+    Pass ``cfg`` so the attention-projection leaves match it (GQA
+    configs carry wq/wkv instead of the fused wqkv) — omitting it
+    assumes MHA, like param_pspecs' default tree."""
     from jax.sharding import PartitionSpec as P
     layer = {
         "ln1": {"g": P(pp_axis, None)},
-        "wqkv": P(pp_axis, None, None, None),
         "wo": P(pp_axis, None, None),
         "ln2": {"g": P(pp_axis, None)},
         "w1": P(pp_axis, None, None),
         "w2": P(pp_axis, None, None),
     }
+    if cfg is not None and cfg.kv_heads != cfg.n_heads:
+        layer["wq"] = P(pp_axis, None, None)
+        layer["wkv"] = P(pp_axis, None, None, None)
+    else:
+        layer["wqkv"] = P(pp_axis, None, None, None)
     return {"embed": P(), "ln_f": {"g": P()}, "stacked": layer}
 
 

@@ -60,6 +60,12 @@ class TransformerConfig:
     # local heads divisible by the sp size) — rlo_tpu.ops.{ring_attention,
     # ulysses}
     sp_attention: str = "ring"
+    # grouped-query attention: number of K/V heads (must divide
+    # n_heads); None = n_heads (MHA). Each group of
+    # n_heads/n_kv_heads query heads shares one K/V head — smaller
+    # projections and an n_heads/n_kv_heads-times smaller decode
+    # KV cache (models.generate stores only the K/V heads).
+    n_kv_heads: Optional[int] = None
     # rematerialize each layer in the backward pass (jax.checkpoint):
     # trades ~one extra forward of FLOPs for O(layers) less activation
     # HBM — the standard long-context memory lever
@@ -80,6 +86,15 @@ class TransformerConfig:
     def head_dim(self) -> int:
         assert self.d_model % self.n_heads == 0
         return self.d_model // self.n_heads
+
+    @property
+    def kv_heads(self) -> int:
+        if self.n_kv_heads is None:
+            return self.n_heads
+        assert self.n_heads % self.n_kv_heads == 0, \
+            f"n_kv_heads {self.n_kv_heads} must divide n_heads " \
+            f"{self.n_heads}"
+        return self.n_kv_heads
 
     @property
     def act_dtype(self):
@@ -108,10 +123,16 @@ def init_params(rng: jax.Array, cfg: TransformerConfig) -> dict:
     for _ in range(cfg.n_layers):
         layer = {
             "ln1": {"g": jnp.ones((d,), jnp.float32)},
-            "wqkv": norm(keys[k], (d, 3, d), d ** -0.5),
             "wo": norm(keys[k + 1], (d, d), (2 * d * cfg.n_layers) ** -0.5),
             "ln2": {"g": jnp.ones((d,), jnp.float32)},
         }
+        if cfg.kv_heads == cfg.n_heads:
+            layer["wqkv"] = norm(keys[k], (d, 3, d), d ** -0.5)
+        else:  # GQA: smaller K/V projections, separate q
+            dkv = cfg.kv_heads * cfg.head_dim
+            kq, kkv = jax.random.split(keys[k])
+            layer["wq"] = norm(kq, (d, d), d ** -0.5)
+            layer["wkv"] = norm(kkv, (d, 2, dkv), d ** -0.5)
         if cfg.n_experts > 0:
             layer["moe"] = moe.init_moe_params(keys[k + 2], d, f,
                                                cfg.n_experts)
@@ -138,10 +159,14 @@ def param_pspecs(cfg: TransformerConfig, tp_axis: Optional[str] = None,
     t = tp_axis
     layer = {
         "ln1": {"g": P()},
-        "wqkv": P(None, None, t),
         "wo": P(t, None),
         "ln2": {"g": P()},
     }
+    if cfg.kv_heads == cfg.n_heads:
+        layer["wqkv"] = P(None, None, t)
+    else:  # GQA: q and kv column-parallel by (kv-)head
+        layer["wq"] = P(None, t)
+        layer["wkv"] = P(None, None, t)
     if cfg.n_experts > 0:
         layer["moe"] = {"wr": P(), "w1": P(ep_axis, None, None),
                         "w2": P(ep_axis, None, None)}
@@ -208,9 +233,12 @@ def apply_layer(x, layer: dict, cfg: TransformerConfig, *,
     math — `forward` iterates it, the pipeline stage (models.pipeline)
     scans it, and the KV-cache decode (models.generate) calls it with a
     custom ``attention`` callable — so the block cannot silently
-    diverge between them. ``attention(q, k, v)`` receives and returns
-    (b, blk, heads, head_dim); None selects the training dispatch
-    (local flash / ring / ulysses)."""
+    diverge between them. ``attention(q, k, v)`` receives q as
+    (b, blk, heads, head_dim) and k/v as (b, blk, KV_heads, head_dim)
+    — fewer heads than q on GQA configs (the hook owns the grouping,
+    so e.g. the decode cache stays compact) — and returns the q shape;
+    None selects the training dispatch (local flash / ring / ulysses),
+    which attends explicitly-repeated K/V heads."""
     b, blk, _ = x.shape
     dt = x.dtype
     ntp = lax.axis_size(tp_axis) if tp_axis is not None else 1
@@ -225,25 +253,48 @@ def apply_layer(x, layer: dict, cfg: TransformerConfig, *,
             t.dtype)
 
     h = _rmsnorm(x, layer["ln1"]["g"])
-    w = layer["wqkv"].astype(dt)       # (d, 3, local heads x hd)
-    qkv = h @ w.reshape(w.shape[0], -1)
-    q, k, v = jnp.split(qkv, 3, axis=-1)
+    if cfg.kv_heads == cfg.n_heads:
+        w = layer["wqkv"].astype(dt)   # (d, 3, local heads x hd)
+        qkv = h @ w.reshape(w.shape[0], -1)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        nkv_local = nh_local
+    else:  # GQA
+        assert cfg.kv_heads % ntp == 0, \
+            f"n_kv_heads {cfg.kv_heads} must divide tp={ntp}"
+        nkv_local = cfg.kv_heads // ntp
+        q = h @ layer["wq"].astype(dt)
+        wkv = layer["wkv"].astype(dt)
+        kv = h @ wkv.reshape(wkv.shape[0], -1)
+        k, v = jnp.split(kv, 2, axis=-1)
 
-    def heads(t):
-        return t.reshape(b, blk, nh_local, cfg.head_dim)
+    def heads(t, n):
+        return t.reshape(b, blk, n, cfg.head_dim)
 
-    q, k, v = heads(q), heads(k), heads(v)
+    q = heads(q, nh_local)
+    k, v = heads(k, nkv_local), heads(v, nkv_local)
+
+    def expand_kv(t):
+        # each group of nh/nkv query heads shares one K/V head; the
+        # training paths attend with explicitly repeated heads (exact
+        # GQA semantics); a custom ``attention`` hook receives the
+        # COMPACT heads so the decode cache stores only kv_heads
+        if nkv_local == nh_local:
+            return t
+        return jnp.repeat(t, nh_local // nkv_local, axis=2)
+
     if attention is not None:
         att = attention(q, k, v)
     elif sp_axis is None:
-        att = _local_attention(q, k, v)
+        att = _local_attention(q, expand_kv(k), expand_kv(v))
     elif cfg.sp_attention == "ulysses":
+        k, v = expand_kv(k), expand_kv(v)
         from rlo_tpu.ops.ulysses import ulysses_attention
         att = jax.vmap(lambda q_, k_, v_: ulysses_attention(
             q_, k_, v_, sp_axis, causal=True), in_axes=0)(q, k, v)
     elif cfg.sp_attention == "ring":
         att = jax.vmap(lambda q_, k_, v_: ring_attention(
-            q_, k_, v_, sp_axis, causal=True), in_axes=0)(q, k, v)
+            q_, k_, v_, sp_axis, causal=True), in_axes=0)(
+                q, expand_kv(k), expand_kv(v))
     else:
         raise ValueError(
             f"unknown sp_attention {cfg.sp_attention!r}; "
