@@ -25,7 +25,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from rlo_tpu.models.transformer import (TransformerConfig, apply_layer,
-                                        _rmsnorm, _sincos)
+                                        embed_tokens, _rmsnorm)
 from rlo_tpu.ops.ring_attention import _NEG
 
 
@@ -69,12 +69,14 @@ def decode_step(params: dict, token, pos, cache, cfg: TransformerConfig
     The layer math IS apply_layer (single source); only the attention
     is swapped for the cache-attend via its ``attention`` hook."""
     dt = cfg.act_dtype
-    x = params["embed"][token].astype(dt)[:, None, :] \
-        + _sincos(jnp.asarray(pos)[None], cfg.d_model, dt)
+    pos_arr = jnp.asarray(pos)[None]                  # (1,)
+    x = embed_tokens(params["embed"], token[:, None], pos_arr, cfg)
     scale = 1.0 / (cfg.head_dim ** 0.5)
     new_cache = []
     for layer, lc in zip(params["layers"], cache):
         def attend(q, k, v, lc=lc):
+            # rope configs: q/k arrive rotated from apply_layer; keys
+            # are cached rotated (standard RoPE decode)
             kc = lax.dynamic_update_slice(lc["k"], k.astype(dt),
                                           (0, pos, 0, 0))
             vc = lax.dynamic_update_slice(lc["v"], v.astype(dt),
@@ -82,7 +84,8 @@ def decode_step(params: dict, token, pos, cache, cfg: TransformerConfig
             new_cache.append({"k": kc, "v": vc})
             return _attend_cache(q, kc, vc, pos, scale).astype(dt)
 
-        x, _ = apply_layer(x, layer, cfg, attention=attend)
+        x, _ = apply_layer(x, layer, cfg, attention=attend,
+                           pos=pos_arr)
     x = _rmsnorm(x, params["ln_f"]["g"])
     logits = (x[:, 0, :] @ params["embed"].T.astype(dt)) \
         .astype(jnp.float32)

@@ -37,7 +37,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from rlo_tpu.models.transformer import (TransformerConfig, _rmsnorm,
-                                        _sincos, _vma_active, apply_layer,
+                                        embed_tokens, _vma_active, apply_layer,
                                         next_token_targets, nll_sum)
 
 
@@ -86,7 +86,8 @@ def _make_stage_fn(cfg: TransformerConfig):
     a lax.scan over transformer.apply_layer, THE layer math (shared with
     forward, so the block cannot diverge between the two)."""
     def one_layer(x, lp):
-        x, _aux = apply_layer(x, lp, cfg)
+        pos = jnp.arange(x.shape[1])  # full sequence per microbatch
+        x, _aux = apply_layer(x, lp, cfg, pos=pos)
         return x, None
 
     def stage(stacked_local, x):
@@ -121,8 +122,7 @@ def pipeline_loss(pparams: dict, tokens, cfg: TransformerConfig,
     chain = [(i, i + 1) for i in range(pp - 1)]  # no wraparound
 
     def embed_mb(tok):
-        return (pparams["embed"][tok].astype(dt)
-                + _sincos(pos, cfg.d_model, dt))
+        return embed_tokens(pparams["embed"], tok, pos, cfg)
 
     state0 = jnp.zeros((mb, blk, cfg.d_model), dt)
     try:

@@ -66,6 +66,12 @@ class TransformerConfig:
     # projections and an n_heads/n_kv_heads-times smaller decode
     # KV cache (models.generate stores only the K/V heads).
     n_kv_heads: Optional[int] = None
+    # position encoding: 'sincos' (additive at the embedding) or
+    # 'rope' (rotary: q/k rotated per position inside every layer —
+    # relative-position attention; composes with sp sharding because
+    # the rotation uses GLOBAL positions, and with the KV cache
+    # because keys are cached rotated)
+    pos_encoding: str = "sincos"
     # rematerialize each layer in the backward pass (jax.checkpoint):
     # trades ~one extra forward of FLOPs for O(layers) less activation
     # HBM — the standard long-context memory lever
@@ -194,6 +200,41 @@ def _sincos(pos, d_model, dtype):
                            axis=-1).astype(dtype)
 
 
+def embed_tokens(embed, tokens, pos, cfg: TransformerConfig):
+    """THE token-embedding path — training (_features), pipeline
+    microbatches, and decode all call it, so the position-encoding
+    guard lives exactly once. 'sincos' adds the absolute encoding
+    here; 'rope' embeds plain (the rotation happens on q/k inside
+    every apply_layer)."""
+    if cfg.pos_encoding not in ("sincos", "rope"):
+        raise ValueError(
+            f"unknown pos_encoding {cfg.pos_encoding!r}; "
+            f"known: 'sincos', 'rope'")
+    x = embed[tokens].astype(cfg.act_dtype)
+    if cfg.pos_encoding == "sincos":
+        x = x + _sincos(pos, cfg.d_model, cfg.act_dtype)
+    return x
+
+
+def _rope(t, pos):
+    """Rotary position embedding: rotate dim pairs (i, i+hd/2) of
+    ``t`` (b, blk, heads, head_dim) by position-dependent angles
+    (pos (blk,) GLOBAL token positions — sp shards pass their own
+    slice, decode passes the single position). Attention scores then
+    depend only on RELATIVE positions (the rotation of q·kᵀ composes
+    to pos_q − pos_k)."""
+    hd = t.shape[-1]
+    half = hd // 2
+    freqs = jnp.exp(-np.log(10000.0) * jnp.arange(half) / half)
+    ang = pos[:, None].astype(jnp.float32) * freqs[None, :]
+    cos = jnp.cos(ang)[None, :, None, :]
+    sin = jnp.sin(ang)[None, :, None, :]
+    t32 = t.astype(jnp.float32)
+    t1, t2 = t32[..., :half], t32[..., half:]
+    return jnp.concatenate([t1 * cos - t2 * sin,
+                            t1 * sin + t2 * cos], -1).astype(t.dtype)
+
+
 def _local_attention(q, k, v, interpret=None):
     """Unsharded causal attention on (b, L, H, D) tensors.
 
@@ -227,7 +268,8 @@ def apply_layer(x, layer: dict, cfg: TransformerConfig, *,
                 tp_axis: Optional[str] = None,
                 tp_algorithm: str = "psum",
                 ep_axis: Optional[str] = None,
-                attention=None):
+                attention=None,
+                pos: Optional[jax.Array] = None):
     """One transformer layer (attention + FFN sublayers) on activation
     ``x`` (b, blk, d). Returns (x, aux). The single source of the layer
     math — `forward` iterates it, the pipeline stage (models.pipeline)
@@ -272,6 +314,9 @@ def apply_layer(x, layer: dict, cfg: TransformerConfig, *,
 
     q = heads(q, nh_local)
     k, v = heads(k, nkv_local), heads(v, nkv_local)
+    if cfg.pos_encoding == "rope":
+        assert pos is not None, "rope needs per-layer positions"
+        q, k = _rope(q, pos), _rope(k, pos)  # compact k: pre-grouping
 
     def expand_kv(t):
         # each group of nh/nkv query heads shares one K/V head; the
@@ -445,13 +490,13 @@ def _features(params: dict, tokens: jax.Array, cfg: TransformerConfig,
         pos0 = 0
     pos = pos0 + jnp.arange(blk)
 
-    x = params["embed"][tokens].astype(dt) + _sincos(pos, cfg.d_model, dt)
+    x = embed_tokens(params["embed"], tokens, pos, cfg)
     aux_total = jnp.zeros((), jnp.float32)
 
     def block(x, layer):
         return apply_layer(x, layer, cfg, sp_axis=sp_axis,
                            tp_axis=tp_axis, tp_algorithm=tp_algorithm,
-                           ep_axis=ep_axis)
+                           ep_axis=ep_axis, pos=pos)
 
     if cfg.remat:
         # recompute each layer's activations in the backward pass
