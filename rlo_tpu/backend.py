@@ -489,6 +489,7 @@ class NativeBackend(Backend):
         self._pos = {r: r for r in range(self.world_size)}
         self._msg_size_max = msg_size_max
         self._sub_comm_next = 128  # engine comm 0 / coll comm 64 taken
+        self._sub_comm_free: List[int] = []  # recycled sub_group pairs
 
     def sub_group(self, members: Sequence[int]) -> "NativeBackend":
         """Facade over a rank subset — the reference's engine-on-any-
@@ -650,8 +651,15 @@ class _NativeSubGroup(NativeBackend):
         self._pos = {r: i for i, r in enumerate(ms)}
         self._msg_size_max = parent._msg_size_max
         self._sub_comm_next = None  # subgroups don't nest (yet)
-        ec = parent._sub_comm_next
-        parent._sub_comm_next += 2
+        # comm ids recycle through the parent's free list, so long-lived
+        # processes creating/closing subgroups don't grow ids unboundedly
+        self._parent = parent
+        if parent._sub_comm_free:
+            ec = parent._sub_comm_free.pop()
+        else:
+            ec = parent._sub_comm_next
+            parent._sub_comm_next += 2
+        self._comm_pair = ec
         self.engines = [NativeEngine(self.world, r, comm=ec,
                                      members=ms,
                                      msg_size_max=self._msg_size_max)
@@ -667,7 +675,10 @@ class _NativeSubGroup(NativeBackend):
             c.close()
         for e in list(self.engines):
             e.close()
-        # the world belongs to the parent
+        # the world belongs to the parent; the comm-id pair recycles
+        if self._comm_pair is not None:
+            self._parent._sub_comm_free.append(self._comm_pair)
+            self._comm_pair = None
 
 
 @_register("shm")

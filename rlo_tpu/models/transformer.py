@@ -210,6 +210,11 @@ def embed_tokens(embed, tokens, pos, cfg: TransformerConfig):
         raise ValueError(
             f"unknown pos_encoding {cfg.pos_encoding!r}; "
             f"known: 'sincos', 'rope'")
+    if cfg.pos_encoding == "rope" and cfg.head_dim % 2:
+        raise ValueError(
+            f"rope rotates (i, i+head_dim/2) dim pairs and needs an "
+            f"even head_dim; got head_dim={cfg.head_dim} "
+            f"(d_model={cfg.d_model}, n_heads={cfg.n_heads})")
     x = embed[tokens].astype(cfg.act_dtype)
     if cfg.pos_encoding == "sincos":
         x = x + _sincos(pos, cfg.d_model, cfg.act_dtype)
@@ -235,28 +240,41 @@ def _rope(t, pos):
                             t1 * sin + t2 * cos], -1).astype(t.dtype)
 
 
-def _local_attention(q, k, v, interpret=None):
-    """Unsharded causal attention on (b, L, H, D) tensors.
+def _local_attention(q, k, v, use_flash=None, interpret=None):
+    """Unsharded causal attention: q (b, L, H, D); k/v (b, L, Hkv, D)
+    with Hkv ≤ H (grouped-query attention — query head h attends K/V
+    head h // (H/Hkv)).
 
     On TPU this is the fused flash kernel (pallas/flash.py — trainable
     since the custom_vjp landed): the batch folds into the head axis
     (attention is per-head independent; the causal mask is purely
     position-driven, identical for every batch row), so the whole batch
-    is ONE kernel launch instead of a vmapped per-row program. Falls
+    is ONE kernel launch instead of a vmapped per-row program — and the
+    batch-folded head indices keep the GQA group mapping intact
+    (b·H + h ↦ b·Hkv + h//G), so compact K/V streams from HBM. Falls
     back to the unfused oracle off-TPU or for shapes the kernel
-    rejects."""
+    rejects. ``use_flash`` overrides the gate; ``interpret`` passes
+    through to the kernel unchanged (interpret=True also enables flash
+    off-TPU, where the compiled kernel cannot run)."""
     b, L, nh, hd = q.shape
+    nkv = k.shape[2]
+    g = nh // nkv
     from rlo_tpu.pallas.flash import can_flash
-    use_flash = (interpret if interpret is not None
-                 else jax.default_backend() == "tpu") and \
-        can_flash(L, L, hd)
+    if use_flash is None:
+        use_flash = (jax.default_backend() == "tpu"
+                     or bool(interpret)) and can_flash(L, L, hd,
+                                                       groups=g)
     if not use_flash:
+        if g > 1:
+            k = jnp.repeat(k, g, axis=2)
+            v = jnp.repeat(v, g, axis=2)
         return jax.vmap(lambda q_, k_, v_: full_attention(
             q_, k_, v_, causal=True))(q, k, v)
     from rlo_tpu.pallas.flash import flash_attention
 
     def fold(t):
-        return t.transpose(1, 0, 2, 3).reshape(L, b * nh, hd)
+        n = t.shape[2]
+        return t.transpose(1, 0, 2, 3).reshape(L, b * n, hd)
 
     out = flash_attention(fold(q), fold(k), fold(v), causal=True,
                           interpret=interpret)
@@ -280,12 +298,13 @@ def apply_layer(x, layer: dict, cfg: TransformerConfig, *,
     — fewer heads than q on GQA configs (the hook owns the grouping,
     so e.g. the decode cache stays compact) — and returns the q shape;
     None selects the training dispatch (local flash / ring / ulysses),
-    which attends explicitly-repeated K/V heads."""
+    which also attends the compact grouped K/V directly — no repeat
+    is materialized anywhere on the training path."""
     b, blk, _ = x.shape
     dt = x.dtype
     ntp = lax.axis_size(tp_axis) if tp_axis is not None else 1
     assert cfg.n_heads % ntp == 0 and cfg.d_ff % ntp == 0, \
-        f"n_heads {cfg.n_heads} and d_ff {cfg.d_ff} must divide tp={ntp}"
+        f"tp={ntp} must divide n_heads {cfg.n_heads} and d_ff {cfg.d_ff}"
     nh_local = cfg.n_heads // ntp
 
     def tp_sum(t):
@@ -302,7 +321,7 @@ def apply_layer(x, layer: dict, cfg: TransformerConfig, *,
         nkv_local = nh_local
     else:  # GQA
         assert cfg.kv_heads % ntp == 0, \
-            f"n_kv_heads {cfg.kv_heads} must divide tp={ntp}"
+            f"tp={ntp} must divide n_kv_heads {cfg.kv_heads}"
         nkv_local = cfg.kv_heads // ntp
         q = h @ layer["wq"].astype(dt)
         wkv = layer["wkv"].astype(dt)
@@ -318,28 +337,23 @@ def apply_layer(x, layer: dict, cfg: TransformerConfig, *,
         assert pos is not None, "rope needs per-layer positions"
         q, k = _rope(q, pos), _rope(k, pos)  # compact k: pre-grouping
 
-    def expand_kv(t):
-        # each group of nh/nkv query heads shares one K/V head; the
-        # training paths attend with explicitly repeated heads (exact
-        # GQA semantics); a custom ``attention`` hook receives the
-        # COMPACT heads so the decode cache stores only kv_heads
-        if nkv_local == nh_local:
-            return t
-        return jnp.repeat(t, nh_local // nkv_local, axis=2)
-
+    # GQA K/V stay COMPACT on every dispatch path: the attention ops
+    # attend grouped heads natively (the flash kernel folds the group
+    # dim into its Q axis; ring rotates and ulysses all_to_alls only
+    # kv_heads worth of bytes — the ICI/HBM reduction GQA exists for),
+    # and a custom ``attention`` hook receives the compact heads so
+    # the decode cache stores only kv_heads
     if attention is not None:
         att = attention(q, k, v)
     elif sp_axis is None:
-        att = _local_attention(q, expand_kv(k), expand_kv(v))
+        att = _local_attention(q, k, v)
     elif cfg.sp_attention == "ulysses":
-        k, v = expand_kv(k), expand_kv(v)
         from rlo_tpu.ops.ulysses import ulysses_attention
         att = jax.vmap(lambda q_, k_, v_: ulysses_attention(
             q_, k_, v_, sp_axis, causal=True), in_axes=0)(q, k, v)
     elif cfg.sp_attention == "ring":
         att = jax.vmap(lambda q_, k_, v_: ring_attention(
-            q_, k_, v_, sp_axis, causal=True), in_axes=0)(
-                q, expand_kv(k), expand_kv(v))
+            q_, k_, v_, sp_axis, causal=True), in_axes=0)(q, k, v)
     else:
         raise ValueError(
             f"unknown sp_attention {cfg.sp_attention!r}; "

@@ -95,9 +95,16 @@ def ring_attention(q, k, v, axis: str, *, causal: bool = False,
     """Sequence-parallel attention; call inside shard_map over ``axis``.
 
     q, k, v: this shard's (block_len, n_heads, head_dim) slice of the
-    sequence. Returns the (block_len, n_heads, head_dim) attention
-    output for the local Q block, numerically equal to full softmax
-    attention over the whole sequence.
+    sequence; k/v may carry FEWER heads (block_len, n_kv_heads,
+    head_dim) for grouped-query attention — query head h attends K/V
+    head h // (n_heads/n_kv_heads). Only the COMPACT K/V rotates
+    around the ring, so GQA's n_heads/n_kv_heads reduction in ICI
+    bytes is realized per step (the fused path also streams compact
+    K/V from HBM — the group dim folds into the kernel's Q axis, see
+    pallas.flash.flash_block_update_hld). Returns the (block_len,
+    n_heads, head_dim) attention output for the local Q block,
+    numerically equal to full softmax attention over the whole
+    sequence.
 
     ``layout`` declares how the sequence was sharded: 'contiguous'
     (shard r holds tokens [r*block, (r+1)*block)) or 'striped' (shard
@@ -123,12 +130,17 @@ def ring_attention(q, k, v, axis: str, *, causal: bool = False,
     ws = lax.axis_size(axis)
     idx = lax.axis_index(axis)
     blk, h, d = q.shape
+    hk = k.shape[1]
+    if h % hk:
+        raise ValueError(
+            f"query heads {h} must be a multiple of K/V heads {hk}")
+    g = h // hk
     if scale is None:
         scale = 1.0 / (d ** 0.5)
     if use_pallas is None:
         from rlo_tpu.pallas.flash import can_flash
         use_pallas = jax.default_backend() == "tpu" and \
-            can_flash(blk, blk, d, block_q, block_k)
+            can_flash(blk, blk, d, block_q, block_k, groups=g)
     # K/V travel rank -> rank+1, so the block held at step s originated
     # at shard (idx - s) mod ws — same schedule as the ring allreduce.
     perm = list(topology.ring_perm(ws))
@@ -144,8 +156,13 @@ def ring_attention(q, k, v, axis: str, *, causal: bool = False,
 
     if use_pallas:
         from rlo_tpu.pallas.flash import flash_block_update_hld
-        q_hld = q.astype(jnp.float32).transpose(1, 0, 2)  # (H, Lq, D)
-        qp = q_pos.astype(jnp.int32).reshape(1, blk)
+        # GQA fold applied ONCE outside the ring loop: q (H, Lq, D) ->
+        # (Hkv, G*Lq, D) with group-tiled positions; the loop then
+        # carries everything in the kernel's folded head-leading layout
+        # and only the COMPACT (Hkv, Lq, D) K/V rotates over ICI
+        q_hld = q.astype(jnp.float32).transpose(1, 0, 2) \
+            .reshape(hk, g * blk, d)
+        qp = jnp.tile(q_pos.astype(jnp.int32).reshape(1, blk), (1, g))
 
         def update(s, kc, vc, m, l, o):
             src = (idx - s) % ws
@@ -165,24 +182,29 @@ def ring_attention(q, k, v, axis: str, *, causal: bool = False,
             vc = lax.ppermute(vc, axis, perm)
             return kc, vc, m, l, o
 
-        m0 = _vary_like(jnp.full((h, 1, blk), _NEG, jnp.float32), q)
-        l0 = _vary_like(jnp.zeros((h, 1, blk), jnp.float32), q)
-        o0 = _vary_like(jnp.zeros((h, blk, d), jnp.float32), q)
-        kc0 = k.transpose(1, 0, 2)
+        m0 = _vary_like(jnp.full((hk, 1, g * blk), _NEG, jnp.float32), q)
+        l0 = _vary_like(jnp.zeros((hk, 1, g * blk), jnp.float32), q)
+        o0 = _vary_like(jnp.zeros((hk, g * blk, d), jnp.float32), q)
+        kc0 = k.transpose(1, 0, 2)                        # (Hkv, Lk, D)
         vc0 = v.transpose(1, 0, 2)
         kc, vc, m, l, o = lax.fori_loop(0, ws - 1, step,
                                         (kc0, vc0, m0, l0, o0))
         m, l, o = update(ws - 1, kc, vc, m, l, o)
-        lt = l.transpose(0, 2, 1)                         # (H, Lq, 1)
+        lt = l.transpose(0, 2, 1)                         # (Hkv, G*Lq, 1)
         denom = jnp.where(lt > 0, lt, 1.0)
-        return (o / denom).transpose(1, 0, 2).astype(q.dtype)
+        return (o / denom).reshape(h, blk, d) \
+            .transpose(1, 0, 2).astype(q.dtype)
 
     q32 = q.astype(jnp.float32)
 
     def update(s, kc, vc, m, l, o):
         src = (idx - s) % ws
         k_pos = positions(src)
-        return _block_update(q32, kc.astype(jnp.float32), vc, m, l, o,
+        # compact K/V rotated; the grouped expand happens locally, so
+        # ICI still carries only Hkv heads per step
+        ke = jnp.repeat(kc, g, axis=1) if g > 1 else kc
+        ve = jnp.repeat(vc, g, axis=1) if g > 1 else vc
+        return _block_update(q32, ke.astype(jnp.float32), ve, m, l, o,
                              q_pos, k_pos, causal, scale)
 
     def step(s, carry):

@@ -72,9 +72,15 @@ def ulysses_attention(q, k, v, axis: str, *, causal: bool = False,
 
     q, k, v: this shard's (block_len, n_heads, head_dim) sequence slice
     (shard r holds tokens [r*block, (r+1)*block) — the same contract as
-    ring_attention, so the two are drop-in interchangeable). Returns the
-    (block_len, n_heads, head_dim) output slice, numerically equal to
-    full attention over the whole sequence.
+    ring_attention, so the two are drop-in interchangeable). k/v may
+    carry FEWER heads (block_len, n_kv_heads, head_dim) for
+    grouped-query attention: when n_kv_heads divides the axis size,
+    only the COMPACT K/V crosses the all_to_alls (shard s's query-head
+    chunk lines up with its K/V-head chunk because h/ws = g * hkv/ws);
+    otherwise K/V is repeated by the smallest factor restoring
+    divisibility first. Returns the (block_len, n_heads, head_dim)
+    output slice, numerically equal to full attention over the whole
+    sequence.
 
     ``use_pallas`` runs the communication-free quadratic part as the
     fused flash kernel (pallas/flash.py, one whole-sequence block
@@ -85,6 +91,24 @@ def ulysses_attention(q, k, v, axis: str, *, causal: bool = False,
     from rlo_tpu.pallas.reduce import _on_tpu
 
     ws = lax.axis_size(axis)
+    hq, hk = q.shape[1], k.shape[1]
+    if hq % hk:
+        raise ValueError(
+            f"query heads {hq} must be a multiple of K/V heads {hk}")
+    g = hq // hk
+    if hk % ws and hq % ws == 0:
+        # the head-scatter needs ws | heads: repeat K/V by the SMALLEST
+        # factor restoring divisibility (repeat composes exactly with
+        # grouping — expanded head hq//g' copies original hq//g), so
+        # e.g. hkv=2 on a 4-wide axis ships 4 heads, not n_heads.
+        # r=g always qualifies (hk*g = hq, divisible by ws); when hq
+        # itself does not divide, _seq_to_heads raises the clear error
+        r = next(r for r in range(1, g + 1)
+                 if g % r == 0 and (hk * r) % ws == 0)
+        k = jnp.repeat(k, r, axis=1)
+        v = jnp.repeat(v, r, axis=1)
+        hk *= r
+        g //= r
     qh = _seq_to_heads(q, axis, ws, algorithm)
     kh = _seq_to_heads(k, axis, ws, algorithm)
     vh = _seq_to_heads(v, axis, ws, algorithm)
@@ -92,13 +116,18 @@ def ulysses_attention(q, k, v, axis: str, *, causal: bool = False,
     if use_pallas is None:
         from rlo_tpu.pallas.flash import can_flash
         use_pallas = _on_tpu() and can_flash(seq, seq, d, block_q,
-                                             block_k)
+                                             block_k, groups=g)
     # full sequence, local heads: the quadratic part is communication-
     # free and positions are globally consistent (causal masks included)
     if use_pallas:
+        # grouped K/V attends natively (the kernel folds the group dim
+        # into its Q axis) — compact K/V streams from HBM too
         from rlo_tpu.pallas.flash import flash_attention
         oh = flash_attention(qh, kh, vh, causal=causal, scale=scale,
                              block_q=block_q, block_k=block_k)
     else:
+        if g > 1:  # local expand AFTER the a2a: ICI carried compact K/V
+            kh = jnp.repeat(kh, g, axis=1)
+            vh = jnp.repeat(vh, g, axis=1)
         oh = full_attention(qh, kh, vh, causal=causal, scale=scale)
     return _heads_to_seq(oh, axis, ws, algorithm)

@@ -75,12 +75,17 @@ def _select_bk(bq: int, lk: int, d: int,
 
 
 def can_flash(lq: int, lk: int, d: int, block_q: int = 256,
-              block_k: Optional[int] = None) -> bool:
+              block_k: Optional[int] = None, groups: int = 1) -> bool:
     """True when the kernel accepts these shapes (Lq tiles by block_q
     and _select_bk finds a VMEM-feasible K tile). The auto-enable gates
     in ring_attention and ulysses_attention use this, so every shape
     the kernel accepts takes the fused path and every shape it would
-    reject falls back to the unfused path instead of failing."""
+    reject falls back to the unfused path instead of failing.
+
+    ``groups`` is the GQA query-group count (n_heads / n_kv_heads):
+    grouped calls fold the group dim into the Q axis (see
+    flash_block_update_hld), so the effective Q length is groups*lq."""
+    lq = groups * lq
     bq = min(block_q, lq)
     if lq % bq:
         return False
@@ -521,11 +526,20 @@ def flash_block_update_hld(q, k, v, m, l, o, q_pos, k_pos, *,
                            interpret: Optional[bool] = None,
                            bwd: str = "pallas"):
     """Head-leading-layout fused update: q (H, Lq, D) any float dtype;
-    k, v (H, Lk, D); m, l (H, 1, Lq) float32; o (H, Lq, D) float32;
+    k, v (Hkv, Lk, D); m, l (H, 1, Lq) float32; o (H, Lq, D) float32;
     q_pos (1, Lq), k_pos (1, Lk) int32. Returns (m', l', o') in the
     same layouts. Grid = (H, Lq/block_q, Lk/block_k) — the K/V axis is
     tiled, so arbitrarily long K/V blocks stream through VMEM instead
     of having to fit in it.
+
+    Grouped-query attention is native: Hkv may be smaller than H (H %
+    Hkv == 0), in which case query head h attends K/V head h //
+    (H/Hkv) — jnp.repeat semantics, but the compact K/V is what
+    streams from HBM (the n_heads/n_kv_heads bandwidth reduction GQA
+    exists for). Implementation: the group dim folds into the Q-length
+    axis — q (H, Lq, D) reshapes to (Hkv, G*Lq, D) with positions
+    tiled per group — so the kernel itself never changes; masking is
+    per-row position-driven and rows are independent.
 
     Differentiable: jax.grad works through this (custom_vjp; the
     backward recomputes score tiles in VMEM — _pallas_bwd). ``bwd``
@@ -535,7 +549,22 @@ def flash_block_update_hld(q, k, v, m, l, o, q_pos, k_pos, *,
     the final m, as all the attention ops do), or 'xla' (autodiff
     through the unfused restatement, the test oracle)."""
     h, lq, d = q.shape
-    lk = k.shape[1]
+    hk, lk = k.shape[0], k.shape[1]
+    if hk != h:
+        # GQA fold: group dim -> Q-length axis, then the plain kernel
+        if h % hk:
+            raise ValueError(
+                f"query heads {h} must be a multiple of K/V heads {hk}")
+        g = h // hk
+        m2, l2, o2 = flash_block_update_hld(
+            q.reshape(hk, g * lq, d), k, v,
+            m.reshape(hk, 1, g * lq), l.reshape(hk, 1, g * lq),
+            o.reshape(hk, g * lq, d),
+            jnp.tile(q_pos, (1, g)), k_pos, causal=causal, scale=scale,
+            block_q=block_q, block_k=block_k, interpret=interpret,
+            bwd=bwd)
+        return (m2.reshape(h, 1, lq), l2.reshape(h, 1, lq),
+                o2.reshape(h, lq, d))
     if interpret is None:
         interpret = not _on_tpu()
     bq = min(block_q, lq)
@@ -563,7 +592,9 @@ def flash_attention(q, k, v, *, causal: bool = False,
     (m, l, o) state — the communication-free quadratic part of Ulysses
     sequence parallelism (each shard holds full sequences of its local
     heads), or plain single-device attention. q: (Lq, H, D); k, v:
-    (Lk, H, D); positions are the global 0..L ranges. The K/V axis is
+    (Lk, Hkv, D) — Hkv < H is grouped-query attention (query head h
+    attends K/V head h // (H/Hkv); the compact K/V is what streams
+    from HBM); positions are the global 0..L ranges. The K/V axis is
     tiled by ``block_k``, so arbitrarily long sequences stream through
     VMEM (per-step working set ~ block_q x block_k)."""
     from rlo_tpu.parallel.mesh import vary_like

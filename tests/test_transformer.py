@@ -310,8 +310,9 @@ def test_local_attention_flash_fold_matches_unfused():
             return jnp.sum(out.astype(jnp.float32) * w)
         return f
 
-    flash = lambda a, b_, c: _local_attention(a, b_, c, interpret=True)
-    plain = lambda a, b_, c: _local_attention(a, b_, c, interpret=False)
+    flash = lambda a, b_, c: _local_attention(a, b_, c, use_flash=True,
+                                              interpret=True)
+    plain = lambda a, b_, c: _local_attention(a, b_, c, use_flash=False)
     np.testing.assert_allclose(
         np.asarray(flash(q, k, v)), np.asarray(plain(q, k, v)),
         rtol=2e-5, atol=2e-5)
