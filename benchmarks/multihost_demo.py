@@ -51,14 +51,40 @@ def main():
     assert out is not None and np.allclose(out, want), (
         f"rank {rank}: psum wrong: {out[:4]} != {want}")
 
-    # round 2: rank 2's local state is poisoned; everyone must see 0
+    # round 2: one rank's local state is poisoned; everyone must see 0
+    # (ranks chosen to exercise a non-proposing poisoner when ws allows)
+    poisoner = 2 if ws > 2 else ws - 1
+    proposer2 = 3 if ws > 3 else 0
     local2 = local.copy()
-    if rank == 2:
+    if rank == poisoner:
         local2[7] = np.nan
-    decision2, out2 = ctx.propose_collective(local2, proposer=3,
+    decision2, out2 = ctx.propose_collective(local2, proposer=proposer2,
                                              judge=judge)
     assert decision2 == 0 and out2 is None, (
         f"rank {rank}: poisoned round not vetoed (decision={decision2})")
+
+    # rounds 3-4 (round-4 VERDICT): a SUBSET of the hosts ({0, 2,
+    # ws-1}) runs its own consensus-gated collective — subset engine
+    # frames on their own comm, subset device sub-mesh — while rank 1
+    # stands by on the parent world
+    members = [0, 2, ws - 1] if ws >= 4 else [0, ws - 1]
+    sctx = ctx.sub_context(members)
+    assert (sctx is None) == (rank not in members)
+    if sctx is not None:
+        pos, n = sctx.rank, sctx.world_size
+        loc = np.full(64, float(pos + 1), np.float32)
+        bad = loc.copy()
+        if pos == n - 1:  # the highest member poisons: subset veto
+            bad[3] = np.nan
+        d3, out3 = sctx.propose_collective(bad, proposer=1, judge=judge)
+        assert d3 == 0 and out3 is None, (
+            f"rank {rank}: subset veto failed (decision={d3})")
+        d4, out4 = sctx.propose_collective(loc, proposer=0, judge=judge)
+        want4 = n * (n + 1) / 2
+        assert d4 == 1 and out4 is not None and np.allclose(out4, want4), (
+            f"rank {rank}: subset psum wrong")
+        sctx.close()
+    ctx.backend.barrier()  # the bystander re-joins the full world here
 
     print(f"MULTIHOST-OK rank={rank}/{ws} sum={float(out[0])}",
           flush=True)

@@ -100,7 +100,13 @@ class Backend:
     def bcast(self, origin: int, x: np.ndarray) -> List[np.ndarray]:
         raise NotImplementedError
 
-    def consensus(self, votes: Sequence[int]) -> int:
+    def consensus(self, votes: Sequence[int], proposer: int = 0) -> int:
+        """One leaderless IAR round over THIS facade's engines (the
+        reference runs full consensus on any communicator,
+        rootless_ops.c:467, 1461 — including a sub_group's): ``votes``
+        is each member's judgement (by position), ``proposer`` the
+        initiating position (rootless: any member may initiate).
+        Returns the AND-merged decision."""
         raise NotImplementedError
 
     def allreduce(self, xs: Sequence[np.ndarray], op: str = "sum",
@@ -268,7 +274,9 @@ class TpuBackend(Backend):
                          lambda v: tc.rootless_bcast(
                              v, origin=int(origin), axis="x"), xs)
 
-    def consensus(self, votes: Sequence[int]) -> int:
+    def consensus(self, votes: Sequence[int], proposer: int = 0) -> int:
+        # the TPU lowering is a symmetric min-reduce over {0,1} votes:
+        # every device holds the decision, so the proposer is moot
         tc = self._tc
         xs = [np.asarray([int(v)], np.int32) for v in votes]
         out = self._run(("consensus",), lambda v: tc.consensus(v, "x"), xs)
@@ -337,8 +345,15 @@ class LoopbackBackend(Backend):
         self._eng_world = LoopbackWorld(self.world_size, latency, seed)
         self._coll_world = LoopbackWorld(self.world_size, latency, seed)
         self._manager = EngineManager()
+        # every facade engine judges with its slot of the CURRENT
+        # round's votes (set by consensus() before proposing) — so
+        # consensus runs on these persistent engines, interleaved with
+        # their bcast traffic, not on a fabricated per-round world
+        self._votes = [1] * self.world_size
         self._engines = [
             ProgressEngine(self._eng_world.transport(r),
+                           judge_cb=lambda payload, ctx, i=r:
+                               self._votes[i],
                            manager=self._manager)
             for r in range(self.world_size)]
         self._comms = [Comm(self._coll_world.transport(r))
@@ -352,36 +367,41 @@ class LoopbackBackend(Backend):
             lambda: self._drain([self._eng_world], self._engines),
             origin, x)
 
-    def consensus(self, votes: Sequence[int]) -> int:
+    def consensus(self, votes: Sequence[int], proposer: int = 0) -> int:
+        """IAR round on the FACADE'S OWN engines (each judges with its
+        slot of ``votes`` — reference judgement cb, rootless_ops.h:77;
+        any position may propose). Runs interleaved with the engines'
+        bcast traffic — no per-round world is fabricated — and works
+        identically on sub_group facades (subset engines on their
+        comm, bystanders active), matching the reference's consensus-
+        on-any-communicator (rootless_ops.c:467, 1461)."""
+        from rlo_tpu.wire import Tag
+
         votes = list(votes)
         if len(votes) != self.world_size:
             raise ValueError("need one vote per rank")
-        # judge callback: each rank votes its slot (reference judgement
-        # cb, rootless_ops.h:77); proposer = rank 0. A fresh world so
-        # the consensus engines never steal the facade engines' traffic.
-        from rlo_tpu.engine import ProgressEngine, EngineManager
-        from rlo_tpu.transport.loopback import LoopbackWorld
-
-        world = LoopbackWorld(self.world_size)
-        mgr = EngineManager()
-        engines = [ProgressEngine(
-            world.transport(r),
-            judge_cb=lambda payload, ctx, r=r: votes[r],
-            manager=mgr) for r in range(self.world_size)]
-        try:
-            engines[0].submit_proposal(b"facade", pid=0)
-            for _ in range(1_000_000):
-                mgr.progress_all()
-                if engines[0].vote_my_proposal() != -1:
-                    break
-            decision = engines[0].vote_my_proposal()
-            if decision == -1:
-                raise RuntimeError("consensus did not complete")
-            self._drain([world], engines)
-            return int(decision)
-        finally:
-            for e in engines:
-                e.cleanup()
+        self._votes[:] = [int(v) for v in votes]
+        eng = self._engines[proposer]
+        eng.submit_proposal(b"facade", pid=proposer)
+        for _ in range(1_000_000):
+            self._manager.progress_all()
+            if eng.vote_my_proposal() != -1:
+                break
+        decision = eng.vote_my_proposal()
+        if decision == -1:
+            raise RuntimeError("consensus did not complete")
+        self._drain([self._eng_world], self._engines)
+        # consume the decision deliveries so the next facade op's
+        # pickups start clean (the proposer learns via vote_my_proposal)
+        for i, e in enumerate(self._engines):
+            if i == proposer:
+                continue
+            msg = e.pickup_next()
+            if msg is None or msg.type != int(Tag.IAR_DECISION):
+                raise RuntimeError(
+                    f"member {i} expected the decision pickup, got "
+                    f"{msg!r}")
+        return int(decision)
 
     def _collective(self, method: str, xs, **kw) -> List[np.ndarray]:
         xs = self._check_xs(xs)
@@ -444,10 +464,13 @@ class _LoopbackSubGroup(LoopbackBackend):
         self._eng_world = LoopbackWorld(full_ws)
         self._coll_world = LoopbackWorld(full_ws)
         self._manager = EngineManager()
+        self._votes = [1] * len(ms)  # judged by subset position
         self._engines = [
             ProgressEngine(self._eng_world.transport(r),
+                           judge_cb=lambda payload, ctx, i=i:
+                               self._votes[i],
                            manager=self._manager, members=ms)
-            for r in ms]
+            for i, r in enumerate(ms)]
         self._comms = [Comm(self._coll_world.transport(r), members=ms)
                        for r in ms]
         self._run = run_collectives
@@ -481,7 +504,12 @@ class NativeBackend(Backend):
 
         self.world_size = world_size or 4
         self.world = NativeWorld(self.world_size, latency, seed)
+        # judge callbacks read the current round's votes (consensus()
+        # pins them before proposing) so IAR runs on THESE engines
+        self._votes = [1] * self.world_size
         self.engines = [NativeEngine(self.world, r,
+                                     judge_cb=lambda payload, ctx, i=r:
+                                         self._votes[i],
                                      msg_size_max=msg_size_max)
                         for r in range(self.world_size)]
         self.colls = [NativeColl(self.world, r, comm=self.COLL_COMM)
@@ -509,15 +537,39 @@ class NativeBackend(Backend):
         return self._engine_bcast(self.engines, self.world.drain,
                                   origin, x)
 
-    def consensus(self, votes: Sequence[int]) -> int:
-        from rlo_tpu.native.bindings import run_judged_proposal
+    def consensus(self, votes: Sequence[int], proposer: int = 0) -> int:
+        """IAR round on the FACADE'S OWN C engines (no per-round world;
+        each member judges with its slot of ``votes``, any position may
+        propose). Identical on sub_group facades — subset engines on
+        their own comm, bystander engines live on the same world —
+        matching rootless_ops.c:467, 1461."""
+        from rlo_tpu.wire import Tag
 
         votes = list(votes)
         if len(votes) != self.world_size:
             raise ValueError("need one vote per rank")
-        return run_judged_proposal(
-            self.world_size, b"facade", proposer=0,
-            judge_for=lambda r: (lambda payload, ctx: votes[r]))
+        self._votes[:] = [int(v) for v in votes]
+        eng = self.engines[proposer]
+        rc = eng.submit_proposal(b"facade", pid=proposer)
+        for _ in range(2_000_000):
+            if rc != -1:
+                break
+            self.world.progress_all()
+            rc = eng.vote_my_proposal()
+        else:
+            raise RuntimeError("consensus did not complete")
+        self.world.drain()
+        eng.proposal_reset()
+        # consume the decision deliveries so the next op starts clean
+        for i, e in enumerate(self.engines):
+            if i == proposer:
+                continue
+            msg = e.pickup_next()
+            if msg is None or msg.type != int(Tag.IAR_DECISION):
+                raise RuntimeError(
+                    f"member {i} expected the decision pickup, got "
+                    f"{msg!r}")
+        return int(rc)
 
     def _bcast_gather(self, xs) -> List[List[np.ndarray]]:
         """Every rank broadcasts its tensor; returns per-rank lists of
@@ -650,6 +702,7 @@ class _NativeSubGroup(NativeBackend):
         self.members = ms
         self._pos = {r: i for i, r in enumerate(ms)}
         self._msg_size_max = parent._msg_size_max
+        self._votes = [1] * len(ms)  # judged by subset position
         self._sub_comm_next = None  # subgroups don't nest (yet)
         # comm ids recycle through the parent's free list, so long-lived
         # processes creating/closing subgroups don't grow ids unboundedly
@@ -662,8 +715,10 @@ class _NativeSubGroup(NativeBackend):
         self._comm_pair = ec
         self.engines = [NativeEngine(self.world, r, comm=ec,
                                      members=ms,
+                                     judge_cb=lambda payload, ctx, i=i:
+                                         self._votes[i],
                                      msg_size_max=self._msg_size_max)
-                        for r in ms]
+                        for i, r in enumerate(ms)]
         self.colls = [NativeColl(self.world, r, comm=ec + 1,
                                  members=ms) for r in ms]
 
@@ -708,7 +763,7 @@ class MpiBackend(Backend):
     name = "mpi"
 
     def __init__(self, world_size: Optional[int] = None, **kwargs):
-        from rlo_tpu.native.bindings import load, NativeWorld, NativeEngine
+        from rlo_tpu.native.bindings import load
         lib = load()
         if not lib.rlo_mpi_available():
             raise RuntimeError(
@@ -722,6 +777,13 @@ class MpiBackend(Backend):
         if not w:
             raise RuntimeError(
                 "MPI world creation failed (need mpirun with >= 2 ranks)")
+        self._adopt_world(lib, w)
+
+    def _adopt_world(self, lib, w) -> None:
+        """Wrap a per-rank C world (MPI or TCP) into the NativeWorld
+        shell and build this rank's engine + collectives on it."""
+        from rlo_tpu.native.bindings import NativeEngine, NativeWorld
+
         # adopt the C world into the NativeWorld wrapper so NativeEngine
         # and drain work unchanged
         self.world = NativeWorld.__new__(NativeWorld)
@@ -731,6 +793,9 @@ class MpiBackend(Backend):
         self.world.engines = []
         self.world_size = self.world.world_size
         self.rank = lib.rlo_world_my_rank(w)
+        # position within this communicator (== rank for the full
+        # world; sub_group facades remap it to the subset position)
+        self.pos = self.rank
         # the judge callback reads this rank's current vote (set by
         # consensus() before each round)
         self._my_vote = 1
@@ -740,6 +805,17 @@ class MpiBackend(Backend):
         from rlo_tpu.native.bindings import NativeColl
         self.coll = NativeColl(self.world, self.rank,
                                comm=NativeBackend.COLL_COMM)
+        self._sub_comm_next = 128  # 0 (engine) and 64 (coll) taken
+        self._sub_comm_free: List[int] = []  # via release_sub_comm
+        self._sub_comm_alloc: List[int] = []  # live pairs, LIFO
+
+    def _drain(self) -> None:
+        """Quiesce this communicator. Full world: the transport's
+        collective termination-detection drain (every rank enters).
+        Overridden by _MpiSubGroup — the full drain is collective over
+        ALL ranks (MPI_Iallreduce, rlo_mpi.c), which a member-only op
+        must never enter."""
+        self.world.drain()
 
     def _spin_pickup(self, want: int, max_spins: int = 200_000_000):
         """Progress until `want` messages are picked up; returns them."""
@@ -756,13 +832,15 @@ class MpiBackend(Backend):
                            f"got {len(got)}")
 
     def bcast(self, origin: int, x: Optional[np.ndarray] = None):
+        """``origin`` is a communicator POSITION (== rank on the full
+        world; subset position on sub_group facades)."""
         from rlo_tpu.ops.collectives import _pack_array, _unpack_array
-        if self.rank == origin:
+        if self.pos == origin:
             self.engine.bcast(_pack_array(np.asarray(x)))
-            self.world.drain()
+            self._drain()
             return np.asarray(x)
         (msg,) = self._spin_pickup(1)
-        self.world.drain()
+        self._drain()
         return _unpack_array(msg.data)
 
     def consensus(self, my_vote: int, proposer: int = 0) -> int:
@@ -772,12 +850,13 @@ class MpiBackend(Backend):
         its own pinned vote, and the AND-merged decision broadcasts."""
         from rlo_tpu.wire import Tag
         self._my_vote = int(my_vote)  # read by this rank's judge cb
-        # every rank's vote must be pinned BEFORE any proposal can
+        # every member's vote must be pinned BEFORE any proposal can
         # arrive: without this barrier a slow rank still draining the
         # previous collective could judge the proposal with its stale
-        # previous-round vote
-        self.world.barrier()
-        if self.rank == proposer:
+        # previous-round vote (subset barrier on sub_groups — the C
+        # coll barrier spans exactly this communicator's members)
+        self.barrier()
+        if self.pos == proposer:
             rc = self.engine.submit_proposal(b"facade", pid=proposer)
             for _ in range(200_000_000):
                 if rc != -1:
@@ -787,12 +866,12 @@ class MpiBackend(Backend):
             else:
                 raise RuntimeError(
                     "consensus did not complete (a peer rank stalled?)")
-            self.world.drain()
+            self._drain()
             self.engine.proposal_reset()
             return int(rc)
         (msg,) = self._spin_pickup(1)
         assert msg.type == int(Tag.IAR_DECISION)
-        self.world.drain()
+        self._drain()
         return int(msg.vote)
 
     def allreduce(self, x: np.ndarray, op: str = "sum",
@@ -805,7 +884,7 @@ class MpiBackend(Backend):
                                              _unpack_array)
         self.engine.bcast(_pack_array(x))
         msgs = self._spin_pickup(self.world_size - 1)
-        self.world.drain()
+        self._drain()
         acc = x.copy()
         for m in msgs:
             acc = OPS[op](acc, _unpack_array(m.data))
@@ -822,9 +901,9 @@ class MpiBackend(Backend):
         x = np.asarray(x)
         if _ring_capable([x], op):
             out = np.asarray(self.coll.reduce_scatter(x.reshape(-1), op))
-            return _zero_pad_tail(out, self.rank * out.size, x.size)
+            return _zero_pad_tail(out, self.pos * out.size, x.size)
         full = self.allreduce(x, op=op)
-        return _rank_chunk(full, self.world_size, self.rank)
+        return _rank_chunk(full, self.world_size, self.pos)
 
     def all_to_all(self, xs: Sequence[np.ndarray]) -> List[np.ndarray]:
         """Per-rank form: ``xs[d]`` is THIS rank's chunk for rank d;
@@ -838,12 +917,131 @@ class MpiBackend(Backend):
                     for raw in self.coll.all_to_all(packed)]
         row = np.stack(self._check_xs(xs))
         gathered_raw = self.coll.all_gather(_pack_array(row))
-        return [_unpack_array(raw)[self.rank] for raw in gathered_raw]
+        return [_unpack_array(raw)[self.pos] for raw in gathered_raw]
 
     def barrier(self) -> None:
         self.coll.barrier()
-        self.world.drain()
+        self._drain()
+
+    def sub_group(self, members: Sequence[int]):
+        """Collective: EVERY process must call this with the same
+        ``members`` (like MPI_Comm_split), in the same order relative
+        to other sub_group calls so the comm ids agree. Member ranks
+        get a positional facade over the subset — a set of real
+        processes can then run consensus/bcast/collectives among
+        themselves while the others keep using the parent facade;
+        non-members get None (the MPI_COMM_NULL convention). Matches
+        the reference's engine-on-any-communicator
+        (rootless_ops.c:467, 1461)."""
+        ms = sorted(set(int(r) for r in members))
+        bad = [r for r in ms if not 0 <= r < self.world_size]
+        if bad:
+            raise ValueError(f"members {bad} outside the world")
+        # comm ids must agree across ALL ranks, so recycling is also
+        # collective: release_sub_comm (below) is the MPI_Comm_free
+        # analogue. rlo_mpi.c multiplexes comm into the MPI tag
+        # (stride 16) and MPI only guarantees tags up to 32767, so an
+        # un-recycled long-liver would eventually overflow — cap it.
+        if self._sub_comm_free:
+            ec = self._sub_comm_free.pop()
+        else:
+            ec = self._sub_comm_next
+            self._sub_comm_next += 2
+            if ec + 1 >= 2047:  # (2047*16 + 15) == MPI_TAG_UB floor
+                raise RuntimeError(
+                    "sub-communicator ids exhausted; release closed "
+                    "sub_groups with release_sub_comm() (collective)")
+        self._sub_comm_alloc.append(ec)
+        if self.rank not in ms:
+            return None
+        return _MpiSubGroup(self, ms, ec)
+
+    def release_sub_comm(self) -> None:
+        """COLLECTIVE (every rank, like MPI_Comm_free): recycle the
+        comm-id pair of the most recently created, not-yet-released
+        sub_group (LIFO). Member ranks must close() the facade first;
+        non-members (who got None) just call this. Keeps the comm-id
+        allocator in lockstep across ranks, which unilateral recycling
+        at close() could not."""
+        if not self._sub_comm_alloc:
+            raise RuntimeError("no live sub_group comm pair to release")
+        self._sub_comm_free.append(self._sub_comm_alloc.pop())
 
     def close(self) -> None:
         self.coll.close()
         self.world.close()
+
+
+class _MpiSubGroup(MpiBackend):
+    """Positional per-rank facade over a subset of the real MPI
+    processes: subset engine + subset C collectives on fresh comm ids
+    of the PARENT's world (frames demux by comm — rlo_mpi.c
+    multiplexes comm into the MPI tag). All inherited ops work with
+    ``pos`` = this rank's position in the member list."""
+
+    name = "mpi-sub"
+
+    def __init__(self, parent: MpiBackend, ms: List[int], ec: int):
+        from rlo_tpu.native.bindings import NativeColl, NativeEngine
+
+        self.world = parent.world
+        self.world_size = len(ms)
+        self.members = ms
+        self.rank = parent.rank
+        self.pos = ms.index(parent.rank)
+        self._my_vote = 1
+        self.engine = NativeEngine(
+            self.world, self.rank, comm=ec, members=ms,
+            msg_size_max=1 << 22,
+            judge_cb=lambda payload, ctx: self._my_vote)
+        self.coll = NativeColl(self.world, self.rank, comm=ec + 1,
+                               members=ms)
+        self._sub_comm_next = None  # subgroups don't nest
+
+    def _drain(self) -> None:
+        # subset quiescence WITHOUT the full-world collective drain:
+        # progress until the local engine is idle (sends flushed,
+        # queues empty), then the subset C barrier — every member has
+        # reached the same point, so the op's frames are all consumed
+        for _ in range(200_000_000):
+            if self.engine.idle():
+                break
+            self.world.progress_all()
+        else:
+            raise RuntimeError("subset drain: engine never went idle")
+        self.coll.barrier()
+
+    def barrier(self) -> None:
+        self.coll.barrier()
+
+    def sub_group(self, members):
+        raise NotImplementedError("nested sub-groups are not supported")
+
+    def close(self) -> None:
+        self.coll.close()
+        self.engine.close()
+        # the world belongs to the parent
+
+
+@_register("tcp")
+class TcpBackend(MpiBackend):
+    """Per-rank SPMD facade over the TCP socket transport (rlo_tcp.c):
+    the same op surface as MpiBackend, but the frames cross a real
+    socket mesh that can span machines — launch one process per rank
+    with RLO_TCP_RANK/RLO_TCP_WORLD (+ RLO_TCP_HOSTS for multi-host,
+    or rlo_tpu/native/tcprun locally). The control plane of
+    docs/DEPLOY.md's multi-host mapping runs on exactly this."""
+
+    name = "tcp"
+
+    def __init__(self, world_size: Optional[int] = None, **kwargs):
+        from rlo_tpu.native.bindings import load
+        lib = load()
+        w = lib.rlo_tcp_world_new()
+        if not w:
+            raise RuntimeError(
+                "TCP world creation failed: launch one process per rank "
+                "with RLO_TCP_RANK/RLO_TCP_WORLD set (locally via "
+                "rlo_tpu/native/tcprun -n N python your_prog.py; across "
+                "hosts set RLO_TCP_HOSTS='host:port,...' per rank)")
+        self._adopt_world(lib, w)

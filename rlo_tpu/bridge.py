@@ -62,8 +62,8 @@ class HybridBackend(Backend):
     def bcast(self, origin: int, x: np.ndarray) -> List[np.ndarray]:
         return self._native.bcast(origin, x)
 
-    def consensus(self, votes: Sequence[int]) -> int:
-        return self._native.consensus(votes)
+    def consensus(self, votes: Sequence[int], proposer: int = 0) -> int:
+        return self._native.consensus(votes, proposer=proposer)
 
     # ---- data plane (device mesh) ----
     def allreduce(self, xs, op: str = "sum") -> List[np.ndarray]:

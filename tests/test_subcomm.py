@@ -209,6 +209,67 @@ class TestFacadeSubGroup:
             g.close()
 
     @pytest.mark.parametrize("name", ["loopback", "native"])
+    def test_subgroup_consensus_with_interleaved_bystanders(self, name):
+        """Round-4 VERDICT item: facade consensus runs on the FACADE'S
+        OWN engines (subset engines for sub_groups, on the parent
+        world for native), not a fabricated per-round world — so
+        subset consensus must interleave with live bystander traffic.
+        Pattern: parent bcast in flight semantics -> subgroup veto
+        round -> parent collective -> subgroup unanimous round ->
+        subgroup bcast -> parent consensus, with every decision and
+        delivery checked. Any state leakage between the parent and
+        subset engines (stolen pickups, stale votes, comm cross-talk)
+        breaks an oracle."""
+        import numpy as np
+
+        import rlo_tpu
+
+        with rlo_tpu.init(backend=name, world_size=WS) as b:
+            g = b.sub_group(MEMBERS)
+            # bystander traffic before and between consensus rounds
+            out = b.bcast(1, np.arange(4, dtype=np.float32))
+            assert len(out) == WS
+            # subset veto round, any-position proposer (rootless)
+            votes = [1] * len(MEMBERS)
+            votes[-1] = 0
+            assert g.consensus(votes, proposer=1) == 0
+            # parent collective while the subgroup engines stay live
+            outs = b.allreduce([np.full(3, 2.0, np.float32)
+                                for _ in range(WS)])
+            for o in outs:
+                np.testing.assert_allclose(o, 2.0 * WS)
+            # unanimous subset round from another proposer
+            assert g.consensus([1] * len(MEMBERS),
+                               proposer=len(MEMBERS) - 1) == 1
+            # subgroup bcast still clean after two consensus rounds
+            sub_out = g.bcast(0, np.array([7.0], np.float32))
+            assert len(sub_out) == len(MEMBERS)
+            for o in sub_out:
+                np.testing.assert_allclose(o, 7.0)
+            # the PARENT's consensus also rides persistent engines now
+            assert b.consensus([1] * WS) == 1
+            assert b.consensus([1] * (WS - 1) + [0], proposer=2) == 0
+            # parent bcast after everything: pickups uncorrupted
+            out = b.bcast(0, np.array([9.0], np.float32))
+            for o in out:
+                np.testing.assert_allclose(o, 9.0)
+            g.close()
+
+    @pytest.mark.parametrize("name", ["loopback", "native"])
+    def test_repeated_consensus_rounds_reuse_engines(self, name):
+        """Back-to-back rounds on the persistent engines: generations
+        disambiguate pid reuse, decisions never leak across rounds."""
+        import rlo_tpu
+
+        with rlo_tpu.init(backend=name, world_size=4) as b:
+            for i in range(6):
+                votes = [1] * 4
+                if i % 2:
+                    votes[i % 4] = 0
+                want = 0 if i % 2 else 1
+                assert b.consensus(votes, proposer=i % 4) == want
+
+    @pytest.mark.parametrize("name", ["loopback", "native"])
     def test_nested_subgroup_rejected(self, name):
         import rlo_tpu
 
