@@ -108,6 +108,8 @@ def load() -> C.CDLL:
     sig("rlo_engine_state_set", C.c_int, [p, p])
     sig("rlo_mpi_available", C.c_int, [])
     sig("rlo_mpi_world_new", p, [])
+    sig("rlo_tcp_available", C.c_int, [])
+    sig("rlo_tcp_world_new", p, [])
     sig("rlo_world_quiescent", C.c_int, [p])
     sig("rlo_world_sent_cnt", C.c_int64, [p])
     sig("rlo_world_delivered_cnt", C.c_int64, [p])

@@ -15,7 +15,8 @@ from pathlib import Path
 _DIR = Path(__file__).resolve().parent
 SOURCES = ["rlo_topology.c", "rlo_wire.c", "rlo_trace.c",
            "rlo_world_common.c", "rlo_loopback.c", "rlo_shm.c",
-           "rlo_mpi.c", "rlo_engine.c", "rlo_coll.c", "rlo_bench.c"]
+           "rlo_mpi.c", "rlo_tcp.c", "rlo_engine.c", "rlo_coll.c",
+           "rlo_bench.c"]
 HEADERS = ["rlo_core.h", "rlo_internal.h"]
 LIB_NAME = "librlo_core.so"
 #: femtompi-linked variant: the MPI transport is live, rendezvous via
@@ -36,7 +37,9 @@ def _stale(lib: Path) -> bool:
     if not lib.exists():
         return True
     lib_mtime = lib.stat().st_mtime
-    deps = SOURCES + HEADERS
+    # build.py itself is a dep: changing the source list must trigger
+    # a rebuild (a stale lib otherwise masks missing symbols)
+    deps = SOURCES + HEADERS + ["build.py"]
     if under_femtompi():
         deps = deps + ["femtompi/femtompi.c", "femtompi/mpi.h"]
     return any((_DIR / f).stat().st_mtime > lib_mtime for f in deps)

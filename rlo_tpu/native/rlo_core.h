@@ -180,6 +180,16 @@ int rlo_mpi_available(void);
 rlo_world *rlo_mpi_world_new(void);
 
 /* ------------------------------------------------------------------ */
+/* TCP transport: one process per rank over a full mesh of stream      */
+/* sockets — the control plane crossing real host boundaries (the      */
+/* reference's any-MPI-cluster deployment, rootless_ops.c:1123).       */
+/* Endpoints from RLO_TCP_RANK/RLO_TCP_WORLD plus RLO_TCP_HOSTS        */
+/* ("host:port,...", one per rank) or RLO_TCP_PORT_BASE on localhost.  */
+/* ------------------------------------------------------------------ */
+int rlo_tcp_available(void);
+rlo_world *rlo_tcp_world_new(void);
+
+/* ------------------------------------------------------------------ */
 /* Progress engine (reference struct progress_engine + EngineManager).  */
 /* ------------------------------------------------------------------ */
 /* judgement callback: 1 approve / 0 decline (reference iar_cb_func_t,
