@@ -9,15 +9,18 @@ STATIC (the cache is allocated at max_len up front and masked by the
 traced position) so the whole generate loop is one `lax.scan` inside
 one jit — XLA-friendly control flow, no per-token retrace.
 
-Scope: dense single-device decode (the inference story of the flagship
-model; sampling is greedy or temperature-softmax). The math mirrors
-apply_layer exactly — rmsnorm/qkv/attention/wo/ffn with the same
-weights — pinned by a logits-parity test against the training `forward`
-at every generated position (tests/test_generate.py).
+Scope: dense and MoE decode, single-device or tensor-parallel
+(decode_step/generate take tp_axis inside shard_map: sharded params
+per param_pspecs, sharded cache per kv_cache_pspecs; MoE experts can
+shard over ep_axis). Sampling is greedy or temperature-softmax. The
+math mirrors apply_layer exactly — rmsnorm/qkv/attention/wo/ffn with
+the same weights — pinned by a logits-parity test against the training
+`forward` at every generated position (tests/test_generate.py).
 """
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Optional, Tuple
 
 import jax
@@ -29,16 +32,45 @@ from rlo_tpu.models.transformer import (TransformerConfig, apply_layer,
 from rlo_tpu.ops.ring_attention import _NEG
 
 
-def init_kv_cache(cfg: TransformerConfig, batch: int, max_len: int):
+def init_kv_cache(cfg: TransformerConfig, batch: int, max_len: int,
+                  tp_axis: Optional[str] = None):
     """Zeroed per-layer K/V cache: a list of {"k","v"} arrays shaped
     (batch, max_len, kv_heads, head_dim) in the activation dtype —
     GQA configs (n_kv_heads < n_heads) store only the K/V heads, the
-    n_heads/kv_heads memory win that motivates GQA."""
-    if cfg.n_experts > 0:
-        raise NotImplementedError("decode supports dense configs only")
-    shape = (batch, max_len, cfg.kv_heads, cfg.head_dim)
+    n_heads/kv_heads memory win that motivates GQA. Inside shard_map
+    with ``tp_axis``, each shard allocates only its kv_heads/tp local
+    heads (matching apply_layer's column-parallel K/V projections)."""
+    ntp = lax.axis_size(tp_axis) if tp_axis is not None else 1
+    assert cfg.kv_heads % ntp == 0
+    shape = (batch, max_len, cfg.kv_heads // ntp, cfg.head_dim)
     z = jnp.zeros(shape, cfg.act_dtype)
     return [{"k": z, "v": z} for _ in range(cfg.n_layers)]
+
+
+def kv_cache_pspecs(cfg: TransformerConfig,
+                    tp_axis: Optional[str] = None):
+    """PartitionSpec tree matching init_kv_cache output: the K/V head
+    axis shards over ``tp_axis`` (like the wkv projections in
+    param_pspecs); batch/positions replicated. Pass as the cache
+    in/out spec for shard_jit'd decode."""
+    from jax.sharding import PartitionSpec as P
+    spec = P(None, None, tp_axis, None)
+    return [{"k": spec, "v": spec} for _ in range(cfg.n_layers)]
+
+
+def _decode_cfg(cfg: TransformerConfig) -> TransformerConfig:
+    """Decode-time config: MoE routing is DROP-FREE (capacity >= the
+    tokens in one step). Training-time capacity dropping is inherently
+    order-dependent across the flattened token axis (moe.moe_ffn's
+    cumsum queue), i.e. not causal — so decode routes every token to
+    its argmax expert and parity with the training forward holds
+    exactly when the forward drops nothing (capacity_factor >=
+    n_experts guarantees that)."""
+    if cfg.n_experts == 0:
+        return cfg
+    return dataclasses.replace(
+        cfg, capacity_factor=max(cfg.capacity_factor,
+                                 float(cfg.n_experts)))
 
 
 def _attend_cache(q, k_cache, v_cache, pos, scale):
@@ -62,12 +94,21 @@ def _attend_cache(q, k_cache, v_cache, pos, scale):
     return out.reshape(b, one, nh, hd)
 
 
-def decode_step(params: dict, token, pos, cache, cfg: TransformerConfig
-                ) -> Tuple[jax.Array, list]:
+def decode_step(params: dict, token, pos, cache, cfg: TransformerConfig,
+                tp_axis: Optional[str] = None,
+                ep_axis: Optional[str] = None) -> Tuple[jax.Array, list]:
     """One token (b,) int32 at position ``pos`` through all layers
     using the K/V cache. Returns (logits (b, vocab) f32, new cache).
     The layer math IS apply_layer (single source); only the attention
-    is swapped for the cache-attend via its ``attention`` hook."""
+    is swapped for the cache-attend via its ``attention`` hook.
+
+    ``tp_axis`` (inside shard_map): tensor-parallel decode — params
+    arrive sharded per param_pspecs, the cache per kv_cache_pspecs;
+    each shard attends its local (kv-)heads and the row-parallel
+    output projections combine with the framework allreduce, exactly
+    like training. MoE configs route drop-free (see _decode_cfg);
+    ``ep_axis`` shards the experts with all_to_all dispatch."""
+    cfg = _decode_cfg(cfg)
     dt = cfg.act_dtype
     pos_arr = jnp.asarray(pos)[None]                  # (1,)
     x = embed_tokens(params["embed"], token[:, None], pos_arr, cfg)
@@ -85,6 +126,7 @@ def decode_step(params: dict, token, pos, cache, cfg: TransformerConfig
             return _attend_cache(q, kc, vc, pos, scale).astype(dt)
 
         x, _ = apply_layer(x, layer, cfg, attention=attend,
+                           tp_axis=tp_axis, ep_axis=ep_axis,
                            pos=pos_arr)
     x = _rmsnorm(x, params["ln_f"]["g"])
     logits = (x[:, 0, :] @ params["embed"].T.astype(dt)) \
@@ -92,17 +134,60 @@ def decode_step(params: dict, token, pos, cache, cfg: TransformerConfig
     return logits, new_cache
 
 
-def prefill(params: dict, tokens, cache, cfg: TransformerConfig):
-    """Run the prompt through the cache one position at a time (scan).
+def prefill(params: dict, tokens, cache, cfg: TransformerConfig,
+            tp_axis: Optional[str] = None,
+            ep_axis: Optional[str] = None):
+    """Fill the cache with the whole prompt in ONE forward pass.
     Returns (logits of the last prompt position, filled cache).
+    MoE prompts route with the TRAINING capacity semantics (the whole
+    prompt is one token set — exact forward parity); decode steps then
+    route drop-free (_decode_cfg).
 
-    A blockwise prefill would batch this; the scan keeps the code one
-    path (decode_step) and the cost is one prompt-length pass."""
+    The prompt is a causal prefix, so causal attention over the prompt
+    block IS attention against the (empty-beyond-it) cache — one
+    batched forward through the flash kernel (apply_layer's training
+    dispatch) replaces plen serial decode steps. The attention hook
+    stashes each layer's COMPACT K/V block into the cache on the way
+    through (rope keys are cached rotated, exactly like decode_step).
+    Logits-parity with the one-token-at-a-time scan is pinned in
+    tests/test_generate.py; measured ~two orders of magnitude faster
+    at plen 1024 on the v5e chip (benchmarks/decode_bench.py --ttft).
+    """
+    b, plen = tokens.shape
+    dt = cfg.act_dtype
+    pos = jnp.arange(plen)
+    x = embed_tokens(params["embed"], tokens, pos, cfg)
+    new_cache = []
+    for layer, lc in zip(params["layers"], cache):
+        def attend(q, k, v, lc=lc):
+            new_cache.append({
+                "k": lax.dynamic_update_slice(
+                    lc["k"], k.astype(dt), (0, 0, 0, 0)),
+                "v": lax.dynamic_update_slice(
+                    lc["v"], v.astype(dt), (0, 0, 0, 0))})
+            from rlo_tpu.models.transformer import _local_attention
+            return _local_attention(q, k, v).astype(dt)
+
+        x, _ = apply_layer(x, layer, cfg, attention=attend,
+                           tp_axis=tp_axis, ep_axis=ep_axis, pos=pos)
+    x = _rmsnorm(x, params["ln_f"]["g"])
+    logits = (x[:, -1, :] @ params["embed"].T.astype(dt)) \
+        .astype(jnp.float32)
+    return logits, new_cache
+
+
+def prefill_scan(params: dict, tokens, cache, cfg: TransformerConfig,
+                 tp_axis: Optional[str] = None,
+                 ep_axis: Optional[str] = None):
+    """One-token-at-a-time prefill (scan over decode_step) — the
+    parity oracle for `prefill` and a fallback exercising exactly the
+    decode path."""
     b, plen = tokens.shape
 
     def step(carry, t):
         cache, pos, _ = carry
-        logits, cache = decode_step(params, t, pos, cache, cfg)
+        logits, cache = decode_step(params, t, pos, cache, cfg,
+                                    tp_axis=tp_axis, ep_axis=ep_axis)
         return (cache, pos + 1, logits), None
 
     z = jnp.zeros((b, cfg.vocab), jnp.float32)
@@ -114,11 +199,15 @@ def prefill(params: dict, tokens, cache, cfg: TransformerConfig):
 def generate(params: dict, prompt, cfg: TransformerConfig, *,
              max_new: int, max_len: Optional[int] = None,
              temperature: float = 0.0,
-             rng: Optional[jax.Array] = None):
+             rng: Optional[jax.Array] = None,
+             tp_axis: Optional[str] = None,
+             ep_axis: Optional[str] = None):
     """Autoregressive continuation of ``prompt`` (b, plen) int32:
     returns (b, max_new) int32 new tokens. temperature 0 = greedy;
     > 0 samples from softmax(logits/T) (needs ``rng``). Jittable as a
-    whole (static shapes; one lax.scan over the new positions)."""
+    whole (static shapes; one lax.scan over the new positions).
+    With ``tp_axis`` (inside shard_map): tensor-parallel decode over
+    sharded params + cache (see decode_step)."""
     b, plen = prompt.shape
     max_len = max_len or (plen + max_new)
     if plen + max_new > max_len:
@@ -127,8 +216,9 @@ def generate(params: dict, prompt, cfg: TransformerConfig, *,
     if temperature > 0 and rng is None:
         # argument error: raise before any cache/prefill work is spent
         raise ValueError("sampling (temperature > 0) needs rng")
-    cache = init_kv_cache(cfg, b, max_len)
-    logits, cache = prefill(params, prompt, cache, cfg)
+    cache = init_kv_cache(cfg, b, max_len, tp_axis=tp_axis)
+    logits, cache = prefill(params, prompt, cache, cfg,
+                            tp_axis=tp_axis, ep_axis=ep_axis)
 
     def pick(logits, key):
         if temperature == 0:
@@ -142,7 +232,8 @@ def generate(params: dict, prompt, cfg: TransformerConfig, *,
     def step(carry, key):
         logits, cache, pos = carry
         tok = pick(logits, key)
-        logits, cache = decode_step(params, tok, pos, cache, cfg)
+        logits, cache = decode_step(params, tok, pos, cache, cfg,
+                                    tp_axis=tp_axis, ep_axis=ep_axis)
         return (logits, cache, pos + 1), tok
 
     (_, _, _), toks = lax.scan(step, (logits, cache, plen), keys)

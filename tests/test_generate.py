@@ -90,8 +90,147 @@ def test_sampling_contract(setup):
     np.testing.assert_array_equal(np.asarray(cold), np.asarray(greedy))
 
 
-def test_moe_rejected(setup):
+def test_moe_greedy_decode_matches_oracle():
+    """MoE decode (round-4 VERDICT item 7): greedy cache generation ==
+    the O(n^2) recompute oracle. capacity_factor >= n_experts makes
+    BOTH paths drop-free, where decode's drop-free routing and the
+    training forward's capacity routing coincide exactly (capacity
+    dropping is order-dependent across the token axis, hence not
+    causal — see generate._decode_cfg)."""
     import dataclasses
-    cfg = dataclasses.replace(CFG, n_experts=2)
-    with pytest.raises(NotImplementedError):
-        init_kv_cache(cfg, 1, 8)
+
+    cfg = dataclasses.replace(CFG, n_experts=2, capacity_factor=2.0)
+    params = init_params(jax.random.PRNGKey(7), cfg)
+    rng = np.random.default_rng(8)
+    prompt = jnp.asarray(rng.integers(0, cfg.vocab, (2, 6)), jnp.int32)
+    max_new = 8
+    got = np.asarray(generate(params, prompt, cfg, max_new=max_new))
+    seq = np.asarray(prompt)
+    for _ in range(max_new):
+        logits = np.asarray(forward(params, jnp.asarray(seq), cfg)
+                            )[:, -1, :]
+        nxt = logits.argmax(-1).astype(np.int32)
+        seq = np.concatenate([seq, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(got, seq[:, prompt.shape[1]:])
+
+
+def test_tp_sharded_generate_matches_single_device():
+    """Tensor-parallel decode (round-4 VERDICT item 7): the whole
+    generate loop under shard_map on a tp mesh — sharded params
+    (param_pspecs), per-shard compact KV cache (kv_heads/tp local
+    heads) — produces the same greedy tokens as single-device."""
+    import dataclasses
+
+    from jax.sharding import PartitionSpec as P
+
+    from rlo_tpu.models.transformer import param_pspecs
+    from rlo_tpu.parallel.mesh import make_mesh, shard_jit
+
+    cfg = dataclasses.replace(CFG, n_kv_heads=2)  # GQA + tp
+    mesh = make_mesh((2,), ("tp",))
+    params = init_params(jax.random.PRNGKey(9), cfg)
+    rng = np.random.default_rng(10)
+    prompt = jnp.asarray(rng.integers(0, cfg.vocab, (2, 5)), jnp.int32)
+    specs = param_pspecs(cfg, "tp")
+    f = shard_jit(
+        lambda p, t: generate(p, t, cfg, max_new=7, tp_axis="tp"),
+        mesh, (specs, P()), P())
+    got = np.asarray(f(params, prompt))
+    want = np.asarray(generate(params, prompt, cfg, max_new=7))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_tp_decode_step_logits_parity():
+    """One tp-sharded decode_step with an explicitly sharded cache
+    (kv_cache_pspecs) matches the single-device logits."""
+    import dataclasses
+
+    from jax.sharding import PartitionSpec as P
+
+    from rlo_tpu.models.generate import kv_cache_pspecs
+    from rlo_tpu.models.transformer import param_pspecs
+    from rlo_tpu.parallel.mesh import make_mesh, shard_jit
+
+    cfg = dataclasses.replace(CFG, n_kv_heads=2)
+    mesh = make_mesh((2,), ("tp",))
+    params = init_params(jax.random.PRNGKey(11), cfg)
+    rng = np.random.default_rng(12)
+    prompt = jnp.asarray(rng.integers(0, cfg.vocab, (2, 4)), jnp.int32)
+    cspecs = kv_cache_pspecs(cfg, "tp")
+    pspecs = param_pspecs(cfg, "tp")
+    f = shard_jit(
+        lambda p, t: prefill(p, t, init_kv_cache(cfg, 2, 6,
+                                                 tp_axis="tp"),
+                             cfg, tp_axis="tp"),
+        mesh, (pspecs, P()), (P(), cspecs))
+    logits_tp, cache_tp = f(params, prompt)
+    cache0 = init_kv_cache(cfg, 2, 6)
+    logits_one, cache_one = prefill(params, prompt, cache0, cfg)
+    np.testing.assert_allclose(np.asarray(logits_tp),
+                               np.asarray(logits_one),
+                               rtol=2e-4, atol=2e-4)
+    # the reassembled sharded cache equals the single-device cache
+    for la, lb in zip(cache_tp, cache_one):
+        for key in ("k", "v"):
+            np.testing.assert_allclose(np.asarray(la[key]),
+                                       np.asarray(lb[key]),
+                                       rtol=2e-4, atol=2e-4)
+
+    step = shard_jit(
+        lambda p, t, c: decode_step(p, t, 4, c, cfg, tp_axis="tp"),
+        mesh, (pspecs, P(), cspecs), (P(), cspecs))
+    tok = jnp.asarray(np.argmax(np.asarray(logits_one), -1), jnp.int32)
+    logits2_tp, _ = step(params, tok, cache_tp)
+    logits2_one, _ = decode_step(params, tok, 4, cache_one, cfg)
+    np.testing.assert_allclose(np.asarray(logits2_tp),
+                               np.asarray(logits2_one),
+                               rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("variant", ["dense", "gqa", "rope", "gqa_rope"])
+def test_prefill_matches_scan(variant):
+    """The one-forward-pass prefill must equal the token-at-a-time
+    scan oracle exactly: last-position logits AND every cache entry
+    (the subsequent decode reads the cache, so cache parity is the
+    stronger contract). Covers GQA (compact cached K/V) and rope
+    (keys cached rotated)."""
+    import dataclasses
+
+    from rlo_tpu.models.generate import prefill_scan
+
+    cfg = CFG
+    if "gqa" in variant:
+        cfg = dataclasses.replace(cfg, n_kv_heads=2)
+    if "rope" in variant:
+        cfg = dataclasses.replace(cfg, pos_encoding="rope")
+    params = init_params(jax.random.PRNGKey(3), cfg)
+    rng = np.random.default_rng(4)
+    prompt = jnp.asarray(rng.integers(0, cfg.vocab, (2, 8)), jnp.int32)
+    max_len = 12  # cache longer than the prompt: the tail must stay 0
+    cache0 = init_kv_cache(cfg, 2, max_len)
+    logits_a, cache_a = prefill(params, prompt, cache0, cfg)
+    logits_b, cache_b = prefill_scan(params, prompt, cache0, cfg)
+    np.testing.assert_allclose(np.asarray(logits_a),
+                               np.asarray(logits_b),
+                               rtol=2e-4, atol=2e-4)
+    for la, lb in zip(cache_a, cache_b):
+        for key in ("k", "v"):
+            np.testing.assert_allclose(np.asarray(la[key]),
+                                       np.asarray(lb[key]),
+                                       rtol=2e-4, atol=2e-4)
+
+
+def test_generate_with_long_cache_uses_blockwise_prefill(setup):
+    """generate() end-to-end with max_len > plen + max_new still
+    matches the O(n^2) oracle (the blockwise prefill writes only the
+    prompt positions; decode masks beyond pos)."""
+    params, prompt = setup
+    got = np.asarray(generate(params, prompt, CFG, max_new=5,
+                              max_len=32))
+    seq = np.asarray(prompt)
+    for _ in range(5):
+        logits = np.asarray(forward(params, jnp.asarray(seq), CFG)
+                            )[:, -1, :]
+        nxt = logits.argmax(-1).astype(np.int32)
+        seq = np.concatenate([seq, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(got, seq[:, prompt.shape[1]:])
