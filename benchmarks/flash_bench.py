@@ -20,7 +20,25 @@ state in the kernel's head-leading layout (one transpose in, one out),
 and the round-3 custom_vjp backward (pallas dq / dkv kernels)
 recomputes score tiles in VMEM instead of saving them.
 
+The --gqa leg measures grouped-query attention through the SAME kernel
+two ways: compact K/V (n_kv_heads streamed from HBM, the group dim
+folded into the kernel's Q axis) vs K/V explicitly repeated to n_heads
+first (what the training path did before round 4). Measured 2026-07-31
+(seq 4096, 8q/2kv heads, dim 128, bf16, paired-ratio protocol):
+fwd 0.993x, fwd+bwd 0.976x — PARITY, and that is the expected result:
+per-step K/V tile traffic is grid-identical (the fold trades the head
+grid dim for Q tiles; total K reads = (total q rows / block_q) * Lk
+either way) and these shapes are MXU-bound. The compact path's real
+wins are structural, not kernel-time: n_heads/n_kv_heads fewer ICI
+bytes per ring-attention step (pinned by the ppermute-shape tests in
+tests/test_gqa_flash.py — only measurable on real multi-chip ICI), an
+n_heads/n_kv_heads smaller K/V footprint (no repeated HBM copies
+materialized), and the decode cache (where the K/V-HBM-bound regime
+actually lives — see decode_bench.py). The leg exists so regressions
+from kernel changes show up, not to claim a single-chip speedup.
+
 Usage: python benchmarks/flash_bench.py [--seq N] [--heads H] [--dim D]
+       [--gqa KV_HEADS]
 """
 
 from __future__ import annotations
@@ -50,6 +68,9 @@ def main() -> int:
     ap.add_argument("--heads", type=int, default=8)
     ap.add_argument("--dim", type=int, default=128)
     ap.add_argument("--block-q", type=int, default=512)
+    ap.add_argument("--gqa", type=int, default=0, metavar="KV_HEADS",
+                    help="also run the grouped-vs-repeated K/V leg "
+                         "with this many K/V heads")
     args = ap.parse_args()
 
     mesh = make_mesh((1,), ("sp",))
@@ -128,7 +149,67 @@ def main() -> int:
     print(f"fwd+bwd einsum: {t_gu*1e3:.3f} ms  "
           f"fwd+bwd flash (pallas vjp): {t_gp*1e3:.3f} ms  "
           f"speedup {t_gu/t_gp:.2f}x")
+
+    if args.gqa:
+        gqa_leg(args.seq, args.heads, args.gqa, args.dim, args.block_q)
     return 0
+
+
+def gqa_leg(seq, h, hkv, d, block_q):
+    """Compact vs repeated K/V through the flash kernel (fwd and
+    fwd+bwd): the single-chip-measurable HBM-bytes reduction of GQA."""
+    from rlo_tpu.pallas.flash import flash_attention
+
+    g = h // hkv
+    rng = np.random.default_rng(1)
+    q = jnp.asarray(rng.standard_normal((seq, h, d)) * 0.3, jnp.bfloat16)
+    k = jnp.asarray(rng.standard_normal((seq, hkv, d)) * 0.3,
+                    jnp.bfloat16)
+    v = jnp.asarray(rng.standard_normal((seq, hkv, d)) * 0.3,
+                    jnp.bfloat16)
+
+    def att(q_, k_, v_, compact):
+        if not compact:
+            k_, v_ = (jnp.repeat(t, g, axis=1) for t in (k_, v_))
+        return flash_attention(q_, k_, v_, causal=True, block_q=block_q)
+
+    # parity first
+    a = np.asarray(jax.jit(partial(att, compact=True))(q, k, v),
+                   np.float32)
+    b = np.asarray(jax.jit(partial(att, compact=False))(q, k, v),
+                   np.float32)
+    np.testing.assert_allclose(a, b, rtol=5e-2, atol=5e-2)
+    print("gqa numerics ok", file=sys.stderr)
+
+    def make(compact, with_grad):
+        def fwd_it(i, acc):
+            return att(acc, k, v, compact).astype(jnp.bfloat16)
+
+        def grad_it(i, acc):
+            gq, gk, gv = jax.grad(
+                lambda q_, k_, v_: jnp.sum(
+                    att(q_, k_, v_, compact).astype(jnp.float32) ** 2),
+                argnums=(0, 1, 2))(acc, k, v)
+            return (acc + 1e-6 * gq).astype(jnp.bfloat16)
+
+        it = grad_it if with_grad else fwd_it
+
+        @partial(jax.jit, static_argnames=("kk",))
+        def loop(q_, kk):
+            return jax.lax.fori_loop(0, kk, it, q_)
+        return lambda x, kk: loop(x, kk)
+
+    # drift-immune paired protocol (bench.py): each rep times
+    # [empty, repeated, compact] back-to-back; median per-pair ratio
+    for label, with_grad in (("fwd", False), ("fwd+bwd", True)):
+        base = make(False, with_grad)
+        chain = bench._calibrate_chain(base, q, k=16)
+        results, _ = bench._paired_race(
+            base, [("compact", make(True, with_grad))], q, k=chain)
+        r = results["compact"]
+        print(f"gqa {label} ({h}q/{hkv}kv heads): compact "
+              f"{r['t_med']*1e3:.3f} ms/op, median paired ratio "
+              f"repeated/compact = {r['ratio']:.3f}x")
 
 
 if __name__ == "__main__":
