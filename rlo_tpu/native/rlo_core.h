@@ -315,6 +315,16 @@ typedef struct rlo_engine_state {
 int rlo_engine_state_get(const rlo_engine *e, rlo_engine_state *out);
 int rlo_engine_state_set(rlo_engine *e, const rlo_engine_state *in);
 
+/* Spanning-tree shape for bcast/IAR (runtime-selectable; the skip-ring
+ * is the reference's overlay, rootless_ops.c:1489; FLAT is depth-1 —
+ * origin sends to every live member directly, receivers are leaves.
+ * Env default RLO_FANOUT=flat; per-engine override below, only while
+ * the engine is idle between rounds). Rootlessness, dedup, and vote
+ * accounting are schedule-independent. */
+#define RLO_FANOUT_SKIP_RING 0
+#define RLO_FANOUT_FLAT 1
+int rlo_engine_set_fanout(rlo_engine *e, int mode);
+
 /* 1 when this engine has no outstanding forwards or pending decision */
 int rlo_engine_idle(const rlo_engine *e);
 int rlo_engine_err(const rlo_engine *e);         /* sticky first error */

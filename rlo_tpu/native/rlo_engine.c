@@ -84,6 +84,7 @@ struct rlo_engine {
     int my_level;
     int init_targets[64];
     int n_init;
+    int fanout; /* RLO_FANOUT_* — bcast/IAR spanning-tree shape */
     rlo_queue q_wait, q_wait_pickup, q_pickup, q_iar_pending;
     int64_t sent_bcast, recved_bcast, total_pickup;
     rlo_prop own; /* my_own_proposal; own.payload = my proposal bytes */
@@ -296,6 +297,14 @@ rlo_engine *rlo_engine_new(rlo_world *w, int rank, int comm,
     e->msg_size_max = msg_size_max > 0 ? msg_size_max : RLO_MSG_SIZE_MAX;
     e->my_level = rlo_level(e->ws, rank);
     e->n_init = rlo_initiator_targets(e->ws, rank, e->init_targets, 64);
+    /* runtime schedule switch (net-new config surface, SURVEY.md §5):
+     * RLO_FANOUT=flat makes every engine depth-1; the per-engine
+     * setter overrides */
+    {
+        const char *fo = getenv("RLO_FANOUT");
+        e->fanout = (fo && !strcmp(fo, "flat")) ? RLO_FANOUT_FLAT
+                                                : RLO_FANOUT_SKIP_RING;
+    }
     e->own.state = RLO_INVALID;
     e->own.pid = -1;
     /* always present so a FAILURE notice from a detecting peer is
@@ -419,6 +428,24 @@ static int real_of(const rlo_engine *e, int v)
 
 static int cur_init_targets(rlo_engine *e, int *out, int cap)
 {
+    if (e->fanout == RLO_FANOUT_FLAT) {
+        /* flat spanning tree: the origin sends to every live member
+         * directly; receivers are leaves. Depth-1 scheduling (the
+         * right shape for oversubscribed single-host worlds and
+         * latency-dominated small payloads); the skip-ring stays the
+         * default for bandwidth-balanced fan-out. Rootlessness, the
+         * (origin, seq) dedup, and IAR vote accounting are schedule-
+         * independent — the proposer simply awaits ws-1 leaf votes. */
+        int n = 0;
+        for (int r = 0; r < e->ws; r++) {
+            if (r == e->rank || e->failed[r])
+                continue;
+            if (n >= cap)
+                return RLO_ERR_ARG;
+            out[n++] = r;
+        }
+        return n;
+    }
     if (!e->n_failed) {
         int n = e->n_init < cap ? e->n_init : cap;
         memcpy(out, e->init_targets, (size_t)n * sizeof(int));
@@ -439,6 +466,8 @@ static int cur_init_targets(rlo_engine *e, int *out, int cap)
 static int cur_fwd_targets(rlo_engine *e, int origin, int src, int *out,
                            int cap)
 {
+    if (e->fanout == RLO_FANOUT_FLAT)
+        return 0; /* flat: the origin reached everyone; deliver only */
     if (!e->n_failed)
         return rlo_fwd_targets(e->ws, e->rank, origin, src, out, cap);
     if (origin < 0 || origin >= e->ws || src < 0 || src >= e->ws ||
@@ -1529,6 +1558,19 @@ int rlo_engine_idle(const rlo_engine *e)
 int rlo_engine_err(const rlo_engine *e)
 {
     return e->err;
+}
+
+int rlo_engine_set_fanout(rlo_engine *e, int mode)
+{
+    if (!e || (mode != RLO_FANOUT_SKIP_RING && mode != RLO_FANOUT_FLAT))
+        return RLO_ERR_ARG;
+    /* schedule switches only between settled rounds: frames already in
+     * flight were routed (and their votes counted) under the old shape */
+    if (!rlo_engine_idle(e) || e->own.state == RLO_IN_PROGRESS ||
+        e->q_iar_pending.len)
+        return RLO_ERR_BUSY;
+    e->fanout = mode;
+    return RLO_OK;
 }
 
 int64_t rlo_engine_total_pickup(const rlo_engine *e)

@@ -48,6 +48,22 @@ def test_all_cases_over_mpi(mpi_bins, ws):
     assert out.count("SKIP") == 2   # fail/efail
 
 
+def test_all_cases_flat_fanout(mpi_bins):
+    """RLO_FANOUT=flat (depth-1 spanning tree — the round-4 adaptive
+    fanout) must pass every scenario: rootlessness, dedup, and IAR
+    vote accounting are schedule-independent, and this pins it."""
+    import os
+    launcher, demo = mpi_bins
+    env = dict(os.environ, RLO_FANOUT="flat")
+    proc = subprocess.run(
+        [str(launcher), "-n", "8", "-t", "270", str(demo), "-m", "4",
+         "-b", "65536"],
+        capture_output=True, text=True, timeout=280, env=env)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert "FAIL" not in proc.stdout
+    assert proc.stdout.count("PASS") == 11
+
+
 def test_multi2_n13_over_mpi(mpi_bins):
     """Concurrent multi-proposal on two engines, non-power-of-2 world,
     real processes, MPI transport."""
