@@ -1,0 +1,100 @@
+"""Record the auxiliary perf numbers as an in-repo artifact.
+
+Round-4 VERDICT item 3: the MFU / decode / TTFT / GQA numbers lived in
+code comments and stderr — nothing a reviewer could regression-track.
+This runs each auxiliary bench as a subprocess (sequentially: the
+tunneled chip is contention-sensitive) and writes BENCH_extra.json at
+the repo root — one entry per leg with the bench's own JSON line (or
+its diagnostic tail, for text-only legs like flash_bench) plus the
+exit status, so a failed leg is recorded as failed instead of
+silently absent.
+
+Usage: python benchmarks/record_extra.py [--skip NAME ...] [--out PATH]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+#: (name, argv, timeout_sec) — argv relative to the repo root
+LEGS = [
+    ("train_mfu_batch4",
+     [sys.executable, "benchmarks/train_bench.py"], 2400),
+    ("train_mfu_batch8",
+     [sys.executable, "benchmarks/train_bench.py", "--batch", "8"], 2400),
+    ("decode_tok_s",
+     [sys.executable, "benchmarks/decode_bench.py"], 2400),
+    ("ttft_blockwise_prefill_b1",
+     [sys.executable, "benchmarks/decode_bench.py", "--ttft",
+      "--plen", "1024", "--batch", "1"], 2400),
+    ("ttft_blockwise_prefill_b4",
+     [sys.executable, "benchmarks/decode_bench.py", "--ttft",
+      "--plen", "1024", "--batch", "4"], 2400),
+    ("flash_gqa_compact_vs_repeated",
+     [sys.executable, "benchmarks/flash_bench.py", "--seq", "4096",
+      "--heads", "8", "--dim", "128", "--gqa", "2"], 2400),
+]
+
+
+def run_leg(name, argv, timeout):
+    t0 = time.time()
+    try:
+        proc = subprocess.run(argv, cwd=str(REPO), capture_output=True,
+                              text=True, timeout=timeout)
+        rc = proc.returncode
+        out, err = proc.stdout, proc.stderr
+    except subprocess.TimeoutExpired as e:
+        rc, out, err = -1, e.stdout or "", f"timeout after {timeout}s"
+        out = out if isinstance(out, str) else out.decode()
+    rec = {"name": name, "argv": argv[1:], "rc": rc,
+           "wall_s": round(time.time() - t0, 1)}
+    # the benches print exactly one JSON line on stdout; text-only
+    # legs (flash_bench) get their informative stdout tail instead
+    for line in reversed(out.strip().splitlines()):
+        try:
+            rec["result"] = json.loads(line)
+            break
+        except ValueError:
+            continue
+    if "result" not in rec:
+        rec["stdout_tail"] = out.strip().splitlines()[-8:]
+    if rc != 0:
+        rec["stderr_tail"] = (err or "").strip().splitlines()[-8:]
+    print(f"  {name}: rc={rc} ({rec['wall_s']}s)", file=sys.stderr)
+    return rec
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--skip", action="append", default=[])
+    ap.add_argument("--only", action="append", default=[])
+    ap.add_argument("--out", default=str(REPO / "BENCH_extra.json"))
+    args = ap.parse_args()
+
+    import jax
+
+    meta = {
+        "backend": jax.default_backend(),
+        "n_devices": len(jax.devices()),
+        "recorded_unix": int(time.time()),
+    }
+    legs = []
+    for name, argv, timeout in LEGS:
+        if name in args.skip or (args.only and name not in args.only):
+            continue
+        legs.append(run_leg(name, argv, timeout))
+    out = {"meta": meta, "legs": legs}
+    Path(args.out).write_text(json.dumps(out, indent=1) + "\n")
+    print(f"wrote {args.out} ({len(legs)} legs)", file=sys.stderr)
+    return 0 if all(r["rc"] == 0 for r in legs) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
