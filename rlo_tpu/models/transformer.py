@@ -259,10 +259,16 @@ def _local_attention(q, k, v, use_flash=None, interpret=None):
     b, L, nh, hd = q.shape
     nkv = k.shape[2]
     g = nh // nkv
-    from rlo_tpu.pallas.flash import can_flash
+    from rlo_tpu.pallas.flash import auto_block_q, can_flash
+    # adaptive Q tile: the batch folds into the kernel's head grid, so
+    # large batches mean many programs — bigger tiles claw back the
+    # per-program overhead (the round-4 MFU-cliff mechanism; measured
+    # bq 1024 = 1.14x bq 256 at 128 folded heads)
+    bq = auto_block_q(g * L, L, hd)
     if use_flash is None:
         use_flash = (jax.default_backend() == "tpu"
                      or bool(interpret)) and can_flash(L, L, hd,
+                                                       block_q=bq,
                                                        groups=g)
     if not use_flash:
         if g > 1:
@@ -277,7 +283,7 @@ def _local_attention(q, k, v, use_flash=None, interpret=None):
         return t.transpose(1, 0, 2, 3).reshape(L, b * n, hd)
 
     out = flash_attention(fold(q), fold(k), fold(v), causal=True,
-                          interpret=interpret)
+                          block_q=bq, interpret=interpret)
     return out.reshape(L, b, nh, hd).transpose(1, 0, 2, 3)
 
 
