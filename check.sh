@@ -25,6 +25,9 @@ echo "== MPI transport executed (femtompi mpirun) =="
 (cd rlo_tpu/native && make -s mpidemo && \
     ./femtompirun -n 8 -t 240 ./rlo_demo_mpi -m 4 -b 65536)
 
+echo "== TCP transport executed (socket mesh) =="
+(cd rlo_tpu/native && ./tcprun -n 8 -t 240 ./rlo_demo -m 4 -b 65536)
+
 echo "== manual-ring validation (8 virtual devices) =="
 PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
     XLA_FLAGS=--xla_force_host_platform_device_count=8 \

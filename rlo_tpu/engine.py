@@ -164,7 +164,8 @@ class ProgressEngine:
                  heartbeat_interval: Optional[float] = None,
                  failure_cb: Optional[Callable[[int, bool], None]] = None,
                  clock: Callable[[], float] = time.monotonic,
-                 members: Optional[Sequence[int]] = None):
+                 members: Optional[Sequence[int]] = None,
+                 fanout: Optional[str] = None):
         """``failure_timeout`` (seconds) enables the net-new failure
         detector (the reference defines RLO_FAILED but never assigns it,
         SURVEY.md §5): ranks heartbeat their ring successor every
@@ -184,10 +185,26 @@ class ProgressEngine:
         elastic re-forming uses), so bcast/IAR span exactly the member
         set; non-members never see this engine's traffic. This rank
         must be a member; create the subset engine only on member
-        ranks."""
+        ranks.
+
+        ``fanout`` selects the spanning-tree shape (mirror of the C
+        engine's rlo_engine_set_fanout / RLO_FANOUT): 'skip_ring'
+        (default — the reference overlay) or 'flat' (depth-1: the
+        origin sends to every live member, receivers are leaves — the
+        right shape when scheduling latency dominates). Rootlessness,
+        dedup, and IAR vote accounting are schedule-independent.
+        Default from $RLO_FANOUT, else 'skip_ring'."""
         ws = transport.world_size
         if ws < 2:  # bcomm_init rejects this (rootless_ops.c:1464)
             raise ValueError(f"world_size must be >= 2, got {ws}")
+        if fanout is None:
+            import os
+            fanout = ("flat" if os.environ.get("RLO_FANOUT") == "flat"
+                      else "skip_ring")
+        if fanout not in ("skip_ring", "flat"):
+            raise ValueError(
+                f"unknown fanout {fanout!r}; known: 'skip_ring', 'flat'")
+        self.fanout = fanout
         self.transport = transport
         self.rank = transport.rank
         self.world_size = ws
@@ -783,6 +800,9 @@ class ProgressEngine:
     def _cur_initiator_targets(self):
         """Initiator send list over the current alive set. Identity to the
         static topology while nothing has failed."""
+        if self.fanout == "flat":
+            # depth-1 tree: everyone alive, directly (see __init__)
+            return tuple(r for r in self._alive if r != self.rank)
         if not self.failed:
             return self.initiator_targets
         alive = self._alive
@@ -795,6 +815,8 @@ class ProgressEngine:
         """Forward targets over the current alive set. Messages routed by
         a pre-failure view (dead origin/sender) are delivered locally but
         not re-forwarded — survivors re-broadcast if they need fan-out."""
+        if self.fanout == "flat":
+            return ()  # the origin reached everyone; deliver only
         if not self.failed:
             return topology.fwd_targets(self.world_size, self.rank,
                                         origin, src)

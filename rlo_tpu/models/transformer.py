@@ -72,6 +72,18 @@ class TransformerConfig:
     # the rotation uses GLOBAL positions, and with the KV cache
     # because keys are cached rotated)
     pos_encoding: str = "sincos"
+    # RoPE context extension (rope configs only). rope_scaling:
+    #   None      — plain rotary at base 10000
+    #   'linear'  — position interpolation: positions divided by
+    #               rope_scale, squeezing a rope_scale-times longer
+    #               context into the trained rotation range
+    #   'ntk'     — NTK-aware base rescale: base *= scale^(d/(d-2)),
+    #               extending low-frequency dims' range while keeping
+    #               high-frequency (local-order) resolution
+    # Both are inference-time levers for running a model past its
+    # training length; rope_scale is the extension factor.
+    rope_scaling: Optional[str] = None
+    rope_scale: float = 1.0
     # rematerialize each layer in the backward pass (jax.checkpoint):
     # trades ~one extra forward of FLOPs for O(layers) less activation
     # HBM — the standard long-context memory lever
@@ -215,23 +227,51 @@ def embed_tokens(embed, tokens, pos, cfg: TransformerConfig):
             f"rope rotates (i, i+head_dim/2) dim pairs and needs an "
             f"even head_dim; got head_dim={cfg.head_dim} "
             f"(d_model={cfg.d_model}, n_heads={cfg.n_heads})")
+    if cfg.rope_scaling is not None:
+        if cfg.pos_encoding != "rope":
+            raise ValueError(
+                f"rope_scaling={cfg.rope_scaling!r} requires "
+                f"pos_encoding='rope' (got {cfg.pos_encoding!r})")
+        if cfg.rope_scaling not in ("linear", "ntk"):
+            raise ValueError(
+                f"unknown rope_scaling {cfg.rope_scaling!r}; "
+                f"known: 'linear', 'ntk'")
+        if cfg.rope_scale < 1.0:
+            raise ValueError(
+                f"rope_scale must be >= 1 (an extension factor); got "
+                f"{cfg.rope_scale}")
     x = embed[tokens].astype(cfg.act_dtype)
     if cfg.pos_encoding == "sincos":
         x = x + _sincos(pos, cfg.d_model, cfg.act_dtype)
     return x
 
 
-def _rope(t, pos):
+def _rope(t, pos, scaling: Optional[str] = None, scale: float = 1.0):
     """Rotary position embedding: rotate dim pairs (i, i+hd/2) of
     ``t`` (b, blk, heads, head_dim) by position-dependent angles
     (pos (blk,) GLOBAL token positions — sp shards pass their own
     slice, decode passes the single position). Attention scores then
     depend only on RELATIVE positions (the rotation of q·kᵀ composes
-    to pos_q − pos_k)."""
+    to pos_q − pos_k).
+
+    ``scaling``/``scale`` extend the context window (cfg.rope_scaling):
+    'linear' divides positions by ``scale`` (position interpolation —
+    identical to evaluating the unscaled rotation at pos/scale); 'ntk'
+    rescales the base by scale^(hd/(hd-2)) so the lowest frequency's
+    period grows ~scale-fold while the highest stays ~unchanged."""
     hd = t.shape[-1]
     half = hd // 2
-    freqs = jnp.exp(-np.log(10000.0) * jnp.arange(half) / half)
-    ang = pos[:, None].astype(jnp.float32) * freqs[None, :]
+    base = 10000.0
+    posf = pos.astype(jnp.float32)
+    if scaling == "linear":
+        posf = posf / scale
+    elif scaling == "ntk":
+        base = base * float(scale) ** (hd / (hd - 2))
+    elif scaling is not None:
+        raise ValueError(
+            f"unknown rope_scaling {scaling!r}; known: 'linear', 'ntk'")
+    freqs = jnp.exp(-np.log(base) * jnp.arange(half) / half)
+    ang = posf[:, None] * freqs[None, :]
     cos = jnp.cos(ang)[None, :, None, :]
     sin = jnp.sin(ang)[None, :, None, :]
     t32 = t.astype(jnp.float32)
@@ -341,7 +381,9 @@ def apply_layer(x, layer: dict, cfg: TransformerConfig, *,
     k, v = heads(k, nkv_local), heads(v, nkv_local)
     if cfg.pos_encoding == "rope":
         assert pos is not None, "rope needs per-layer positions"
-        q, k = _rope(q, pos), _rope(k, pos)  # compact k: pre-grouping
+        q = _rope(q, pos, cfg.rope_scaling, cfg.rope_scale)
+        k = _rope(k, pos, cfg.rope_scaling, cfg.rope_scale)  # compact
+        # k: pre-grouping (the hook/caches see rotated compact keys)
 
     # GQA K/V stay COMPACT on every dispatch path: the attention ops
     # attend grouped heads natively (the flash kernel folds the group

@@ -662,7 +662,11 @@ rlo_world *rlo_tcp_world_new(void)
         }
         w->peers[r].fd = fd;
     }
-    /* accept UP (peers rank+1..ws-1, in whatever order they arrive) */
+    /* accept UP (peers rank+1..ws-1, in whatever order they arrive).
+     * Bounded: a peer that failed to boot (port clash, crash) must
+     * fail this rank's setup, not hang it in accept() forever */
+    struct timeval atv = {TCP_CONNECT_TIMEOUT_SEC, 0};
+    setsockopt(lfd, SOL_SOCKET, SO_RCVTIMEO, &atv, sizeof atv);
     for (int need = ws - 1 - rank; need > 0; need--) {
         int fd = accept(lfd, 0, 0);
         int32_t hello = -1;

@@ -427,7 +427,11 @@ class TestDecisionDedup:
 
         world = LoopbackWorld(8)
         mgr = EngineManager()
-        eng2 = ProgressEngine(world.transport(2), manager=mgr)
+        # relay-with-children is a skip-ring-only shape (under 'flat'
+        # every receiver is a leaf) — pin the schedule explicitly so
+        # the suite also passes under RLO_FANOUT=flat
+        eng2 = ProgressEngine(world.transport(2), manager=mgr,
+                              fanout="skip_ring")
         gen = 777
         orig = Frame(origin=0, pid=5, vote=gen, payload=b"p")
         world.transport(0).isend(2, int(Tag.IAR_PROPOSAL), orig.encode())

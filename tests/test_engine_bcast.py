@@ -202,3 +202,72 @@ class TestBcast:
                 break
         assert all(seen[1:])
         drain([world], engines)
+
+
+class TestFlatFanout:
+    """fanout='flat' (round 4, mirror of the C engine's
+    rlo_engine_set_fanout): depth-1 spanning tree — origin sends to
+    every live member, receivers are leaves. Rootlessness, dedup, and
+    IAR vote accounting are schedule-independent; these pin it at the
+    Python engine level (the C side is pinned by the demo suite under
+    RLO_FANOUT=flat)."""
+
+    def test_bcast_delivers_exactly_once_everywhere(self):
+        for ws in (2, 5, 8):
+            world, engines = build_world(ws, fanout="flat")
+            # the static skip-ring list stays untouched (flat bypasses
+            # it in _cur_initiator_targets rather than mutating it)
+            from rlo_tpu import topology
+            assert engines[0].initiator_targets == \
+                topology.initiator_targets(ws, 0)
+            assert engines[0]._cur_initiator_targets() == tuple(
+                range(1, ws))
+            assert engines[1]._fwd_targets(0, 0) == ()
+            engines[0].bcast(b"flat")
+            engines[ws - 1].bcast(b"rootless")  # any origin
+            drain([world], engines)
+            for r, eng in enumerate(engines):
+                got = sorted(m.data for m in collect_all(eng))
+                want = sorted(b for o, b in ((0, b"flat"),
+                                             (ws - 1, b"rootless"))
+                              if o != r)
+                assert got == want, (ws, r)
+
+    def test_iar_veto_and_approval(self):
+        from rlo_tpu.engine import EngineManager, ProgressEngine
+        from rlo_tpu.transport.loopback import LoopbackWorld
+
+        world = LoopbackWorld(6)
+        mgr = EngineManager()
+        votes = [1] * 6
+        engines = [ProgressEngine(world.transport(r),
+                                  judge_cb=lambda p, c, r=r: votes[r],
+                                  manager=mgr, fanout="flat")
+                   for r in range(6)]
+        # proposer hears every member directly (await_from prunes as
+        # leaf votes arrive, possibly within this very call's progress
+        # turn, so the assertable invariant is votes_needed)
+        engines[2].submit_proposal(b"p", pid=2)
+        assert engines[2].my_own_proposal.votes_needed == 5
+        for _ in range(10_000):
+            mgr.progress_all()
+            if engines[2].vote_my_proposal() != -1:
+                break
+        assert engines[2].vote_my_proposal() == 1
+        drain([world], engines)
+        for r, eng in enumerate(engines):
+            collect_all(eng)  # consume decisions
+        # veto round from another proposer
+        votes[4] = 0
+        engines[5].submit_proposal(b"q", pid=5)
+        for _ in range(10_000):
+            mgr.progress_all()
+            if engines[5].vote_my_proposal() != -1:
+                break
+        assert engines[5].vote_my_proposal() == 0
+        drain([world], engines)
+
+    def test_invalid_fanout_rejected(self):
+        import pytest as _pytest
+        with _pytest.raises(ValueError, match="unknown fanout"):
+            build_world(4, fanout="butterfly")

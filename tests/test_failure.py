@@ -549,10 +549,14 @@ class TestElasticRecovery:
         from rlo_tpu.wire import Frame, Tag
         world = LoopbackWorld(4)
         mgr_p, mgr_o = EngineManager(), EngineManager()
+        # the [1, 2] await set below is the skip-ring schedule's — pin
+        # it so the suite also passes under RLO_FANOUT=flat
         proposer = ProgressEngine(world.transport(0), manager=mgr_p,
                                   failure_timeout=1e9,  # no auto detection
-                                  clock=lambda: 0.0)
-        _others = [ProgressEngine(world.transport(r), manager=mgr_o)
+                                  clock=lambda: 0.0,
+                                  fanout="skip_ring")
+        _others = [ProgressEngine(world.transport(r), manager=mgr_o,
+                                  fanout="skip_ring")
                    for r in range(1, 4)]
         assert proposer.submit_proposal(b"p", pid=0) == -1
         assert sorted(proposer.my_own_proposal.await_from) == [1, 2]

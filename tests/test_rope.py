@@ -54,6 +54,108 @@ def test_scores_depend_on_relative_positions_only():
         atol=1e-3)
 
 
+class TestRopeScaling:
+    """Context-extension levers (cfg.rope_scaling, round 4)."""
+
+    def test_linear_is_position_interpolation(self):
+        """'linear' at scale s == the unscaled rotation evaluated at
+        pos/s — the defining identity of position interpolation."""
+        rng = np.random.default_rng(3)
+        t = jnp.asarray(rng.standard_normal((2, 8, 3, 16)), jnp.float32)
+        pos = jnp.arange(0, 64, 8)  # positions beyond a 'trained' range
+        got = _rope(t, pos, scaling="linear", scale=4.0)
+        want = _rope(t, pos.astype(jnp.float32) / 4.0)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_ntk_rescales_base(self):
+        """'ntk' at scale s == plain rotary with base
+        10000 * s^(hd/(hd-2)) — computed directly."""
+        rng = np.random.default_rng(4)
+        hd = 16
+        t = jnp.asarray(rng.standard_normal((2, 8, 3, hd)), jnp.float32)
+        pos = jnp.arange(8)
+        got = np.asarray(_rope(t, pos, scaling="ntk", scale=8.0))
+        base = 10000.0 * 8.0 ** (hd / (hd - 2))
+        half = hd // 2
+        freqs = np.exp(-np.log(base) * np.arange(half) / half)
+        ang = np.arange(8)[:, None] * freqs[None, :]
+        cos = np.cos(ang)[None, :, None, :]
+        sin = np.sin(ang)[None, :, None, :]
+        tn = np.asarray(t)
+        t1, t2 = tn[..., :half], tn[..., half:]
+        want = np.concatenate([t1 * cos - t2 * sin,
+                               t1 * sin + t2 * cos], -1)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    def test_ntk_preserves_high_freq_extends_low(self):
+        """The NTK property itself: the highest-frequency pair's angle
+        moves <10% while the lowest-frequency pair's period grows by
+        ~the scale factor."""
+        hd, s = 64, 16.0
+        half = hd // 2
+        base0, base1 = 10000.0, 10000.0 * s ** (hd / (hd - 2))
+        f0 = np.exp(-np.log(base0) * np.arange(half) / half)
+        f1 = np.exp(-np.log(base1) * np.arange(half) / half)
+        assert f1[0] == f0[0]                      # highest: untouched
+        assert abs(f1[1] / f0[1] - 1) < 0.1        # near-highest: <10%
+        # lowest-frequency period grows ~s (up to the (d-2)/d exponent)
+        growth = f0[-1] / f1[-1]
+        assert s * 0.5 < growth <= s * 1.01
+
+    @pytest.mark.parametrize("scaling", ["linear", "ntk"])
+    def test_scaled_model_trains_and_decodes(self, scaling):
+        """End-to-end: a scaled-rope config trains (finite loss,
+        params move) and KV-cache decode still matches the O(n^2)
+        recompute oracle (keys cached rotated with the SAME scaled
+        rotation)."""
+        cfg = dataclasses.replace(ROPE, rope_scaling=scaling,
+                                  rope_scale=4.0)
+        params = init_params(jax.random.PRNGKey(5), cfg)
+        new_params, loss = train_step(params, tokens_for(cfg), cfg,
+                                      lr=1e-2)
+        assert np.isfinite(float(loss))
+        prompt = tokens_for(cfg, seq=6, seed=7)
+        got = np.asarray(generate(params, prompt, cfg, max_new=6))
+        seq = np.asarray(prompt)
+        for _ in range(6):
+            logits = np.asarray(forward(params, jnp.asarray(seq), cfg)
+                                )[:, -1, :]
+            nxt = logits.argmax(-1).astype(np.int32)
+            seq = np.concatenate([seq, nxt[:, None]], axis=1)
+        np.testing.assert_array_equal(got, seq[:, prompt.shape[1]:])
+
+    def test_scaled_sp_sharded_matches_single_device(self):
+        """Scaling composes with sp sharding (global positions scale
+        uniformly across shards)."""
+        cfg = dataclasses.replace(ROPE, rope_scaling="ntk",
+                                  rope_scale=2.0)
+        mesh = make_mesh((2,), ("sp",))
+        params = init_params(jax.random.PRNGKey(6), cfg)
+        toks = tokens_for(cfg, seq=32, seed=8)
+        step = shard_jit(
+            lambda p, t: train_step(p, t, cfg, lr=1e-2, sp_axis="sp"),
+            mesh, (P(), P(None, "sp")), (P(), P()))
+        _, loss_sp = step(params, toks)
+        _, loss_one = train_step(params, toks, cfg, lr=1e-2)
+        assert abs(float(loss_sp) - float(loss_one)) < 1e-4
+
+    def test_invalid_configs_rejected(self):
+        toks = tokens_for(ROPE, seq=4)
+        bad1 = dataclasses.replace(ROPE, rope_scaling="yarn")
+        params = init_params(jax.random.PRNGKey(0), bad1)
+        with pytest.raises(ValueError, match="unknown rope_scaling"):
+            forward(params, toks, bad1)
+        bad2 = dataclasses.replace(ROPE, pos_encoding="sincos",
+                                   rope_scaling="ntk")
+        with pytest.raises(ValueError, match="requires"):
+            forward(params, toks, bad2)
+        bad3 = dataclasses.replace(ROPE, rope_scaling="linear",
+                                   rope_scale=0.5)
+        with pytest.raises(ValueError, match=">= 1"):
+            forward(params, toks, bad3)
+
+
 def test_rope_differs_from_sincos():
     params_shape_cfg = dataclasses.replace(ROPE, pos_encoding="sincos")
     params = init_params(jax.random.PRNGKey(0), ROPE)
