@@ -177,8 +177,10 @@ def ttft(args):
     # scan-prefill baseline: one token of scan prefill IS one decode
     # step (same decode_step, same cache attend), so its cost is the
     # decode steps/s from the same length-differencing as the main
-    # mode — no plen-long scan program needs to compile
-    n1, n2 = 8, 64
+    # mode — no plen-long scan program needs to compile. Wide gap: at
+    # batch 1 a step is ~0.15 ms and a narrow pair sits inside the
+    # dispatch noise (the differencing guard tripped on it)
+    n1, n2 = 8, 192
     td1 = time_generate(params, prompt_of(p0), cfg, n1, p0 + n2)
     td2 = time_generate(params, prompt_of(p0), cfg, n2, p0 + n2)
     if td2 <= td1:

@@ -199,6 +199,12 @@ class NativeWorld:
     def quiescent(self) -> bool:
         return bool(self._lib.rlo_world_quiescent(self._w))
 
+    def failed(self) -> bool:
+        """True when the world is dead — a peer process crashed (shm
+        abort flag / tcp reset or mid-frame EOF). A graceful peer
+        departure does NOT set it."""
+        return bool(self._lib.rlo_world_failed(self._w))
+
     def peer_alive(self, rank: int, timeout_usec: int = 1_000_000) -> bool:
         """Net-new failure detection (SURVEY.md §5): False when `rank`
         showed no transport activity for timeout_usec. Always True on

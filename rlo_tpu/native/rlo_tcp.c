@@ -350,6 +350,24 @@ static int tcp_failed(const rlo_world *base)
     return ((const rlo_tcp_world *)base)->failed;
 }
 
+/* Socket-level liveness: a peer is alive while its connection is
+ * open. A graceful exit closes the fd (clean EOF in tcp_pump); a
+ * crash is a reset/mid-frame EOF (world failed AND the fd closes).
+ * A peer that is hung-but-connected stays "alive" here — that is
+ * what the engine-level heartbeat detector is for; this signal is
+ * the transport's crash-fast path (shm's heartbeat-slot analogue). */
+static int tcp_peer_alive(const rlo_world *base, int rank,
+                          uint64_t timeout_usec)
+{
+    (void)timeout_usec;
+    const rlo_tcp_world *w = (const rlo_tcp_world *)base;
+    if (rank == base->my_rank)
+        return 1;
+    if (rank < 0 || rank >= base->world_size)
+        return 0;
+    return w->peers[rank].fd >= 0;
+}
+
 /* send a control token; bounded-blocking (flush until accepted) */
 static int ctrl_send(rlo_tcp_world *w, int dst, int tag,
                      const int64_t *payload, int n64)
@@ -524,7 +542,7 @@ static const rlo_transport_ops TCP_OPS = {
     .delivered_cnt = tcp_delivered,
     .drain = tcp_drain,
     .failed = tcp_failed,
-    .peer_alive = 0,
+    .peer_alive = tcp_peer_alive,
     .kill_rank = 0,
     .barrier = tcp_barrier,
     .free_ = tcp_free,

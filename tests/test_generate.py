@@ -140,6 +140,32 @@ def test_tp_sharded_generate_matches_single_device():
     np.testing.assert_array_equal(got, want)
 
 
+def test_ep_sharded_moe_decode_matches_single_device():
+    """Expert-parallel decode (round-4 VERDICT item 7): generate with
+    ep_axis on an expert-sharded mesh — per-shard batch rows, expert
+    weights sharded per param_pspecs, tokens crossing shards through
+    the all_to_all dispatch — equals single-device greedy decode."""
+    import dataclasses
+
+    from jax.sharding import PartitionSpec as P
+
+    from rlo_tpu.models.transformer import param_pspecs
+    from rlo_tpu.parallel.mesh import make_mesh, shard_jit
+
+    cfg = dataclasses.replace(CFG, n_experts=2, capacity_factor=2.0)
+    params = init_params(jax.random.PRNGKey(13), cfg)
+    rng = np.random.default_rng(14)
+    prompt = jnp.asarray(rng.integers(0, cfg.vocab, (4, 6)), jnp.int32)
+    mesh = make_mesh((2,), ("ep",))
+    specs = param_pspecs(cfg, ep_axis="ep")
+    gen = shard_jit(
+        lambda p, t: generate(p, t, cfg, max_new=6, ep_axis="ep"),
+        mesh, (specs, P("ep")), P("ep"))
+    got = np.asarray(gen(params, prompt))
+    want = np.asarray(generate(params, prompt, cfg, max_new=6))
+    np.testing.assert_array_equal(got, want)
+
+
 def test_tp_decode_step_logits_parity():
     """One tp-sharded decode_step with an explicitly sharded cache
     (kv_cache_pspecs) matches the single-device logits."""

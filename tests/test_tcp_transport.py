@@ -124,6 +124,52 @@ def test_python_tcp_backend(tcp_bins, tmp_path):
     assert "TCP-BACKEND-OK 4" in proc.stdout
 
 
+TCP_LIVENESS_PROG = r"""
+import sys, time
+sys.path.insert(0, {repo!r})
+from rlo_tpu.backend import TcpBackend
+
+b = TcpBackend()
+r, ws = b.rank, b.world_size
+assert all(b.world.peer_alive(p, 0) for p in range(ws)), r
+b.barrier()
+if r == ws - 1:
+    # rank ws-1 departs gracefully; the others see its socket close
+    b.close()
+    sys.exit(0)
+deadline = time.time() + 30
+while b.world.peer_alive(ws - 1, 0):
+    b.world.progress_all()
+    if time.time() > deadline:
+        raise RuntimeError(f"rank {{r}}: never saw the peer depart")
+    time.sleep(0.001)
+# a clean departure is NOT a world failure (graceful-EOF contract).
+# (No cross-survivor aliveness check here: survivors exit at their
+# own pace, so peer_alive on another survivor races its departure.)
+assert not b.world.failed(), r
+if r == 0:
+    print("TCP-LIVENESS-OK")
+b.close()
+"""
+
+
+def test_peer_alive_sees_graceful_departure(tcp_bins, tmp_path):
+    """The TCP transport's socket-level liveness (round 4): a
+    gracefully departed peer reads as not-alive on every survivor
+    without marking the world failed (crash = mid-frame EOF, which
+    does)."""
+    launcher, _ = tcp_bins
+    repo = str(Path(__file__).resolve().parent.parent)
+    prog = tmp_path / "prog.py"
+    prog.write_text(TCP_LIVENESS_PROG.format(repo=repo))
+    proc = subprocess.run(
+        [sys.executable, str(launcher), "-n", "3", "-t", "120",
+         sys.executable, str(prog)],
+        capture_output=True, text=True, timeout=150)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert "TCP-LIVENESS-OK" in proc.stdout
+
+
 def test_multihost_demo_over_tcp_two_hosts(tcp_bins, tmp_path):
     """The multihost demo (engine consensus gating a federated-JAX
     device collective) with 2 'hosts' = 2 processes whose CONTROL
