@@ -75,10 +75,12 @@ def _decode_cfg(cfg: TransformerConfig) -> TransformerConfig:
 
 def _attend_cache(q, k_cache, v_cache, pos, scale):
     """q (b, 1, H, hd) against the cache prefix [0, pos]: full-length
-    matmul over the static cache, masked beyond the position. The
-    cache may hold fewer (grouped) K/V heads: each group of
-    H/kv_heads query heads attends its shared K/V head directly —
-    no repeat is ever materialized."""
+    matmul over the static cache, masked beyond the position. ``pos``
+    is a scalar (all rows at the same position) or a (b,) vector
+    (ragged decode: each row masks at its own position). The cache
+    may hold fewer (grouped) K/V heads: each group of H/kv_heads
+    query heads attends its shared K/V head directly — no repeat is
+    ever materialized."""
     b, one, nh, hd = q.shape
     nkv = k_cache.shape[2]
     rep = nh // nkv
@@ -86,8 +88,13 @@ def _attend_cache(q, k_cache, v_cache, pos, scale):
     s = jnp.einsum("bqgrd,bkgd->bgrqk", qg.astype(jnp.float32),
                    k_cache.astype(jnp.float32),
                    preferred_element_type=jnp.float32) * scale
-    mask = jnp.arange(k_cache.shape[1]) <= pos           # (max_len,)
-    s = jnp.where(mask[None, None, None, None, :], s, _NEG)
+    posv = jnp.asarray(pos)
+    if posv.ndim == 0:
+        mask = jnp.arange(k_cache.shape[1]) <= posv      # (max_len,)
+        s = jnp.where(mask[None, None, None, None, :], s, _NEG)
+    else:  # per-row positions
+        mask = jnp.arange(k_cache.shape[1]) <= posv[:, None]
+        s = jnp.where(mask[:, None, None, None, :], s, _NEG)
     p = jax.nn.softmax(s, axis=-1)
     out = jnp.einsum("bgrqk,bkgd->bqgrd", p,
                      v_cache.astype(jnp.float32))
@@ -102,6 +109,11 @@ def decode_step(params: dict, token, pos, cache, cfg: TransformerConfig,
     The layer math IS apply_layer (single source); only the attention
     is swapped for the cache-attend via its ``attention`` hook.
 
+    ``pos`` is a scalar (every row at the same position) or a (b,)
+    int32 vector — RAGGED decode: each row writes its cache slot and
+    masks its attention at its own position (per-row rotary/sincos
+    positions included).
+
     ``tp_axis`` (inside shard_map): tensor-parallel decode — params
     arrive sharded per param_pspecs, the cache per kv_cache_pspecs;
     each shard attends its local (kv-)heads and the row-parallel
@@ -110,7 +122,11 @@ def decode_step(params: dict, token, pos, cache, cfg: TransformerConfig,
     ``ep_axis`` shards the experts with all_to_all dispatch."""
     cfg = _decode_cfg(cfg)
     dt = cfg.act_dtype
-    pos_arr = jnp.asarray(pos)[None]                  # (1,)
+    posv = jnp.asarray(pos)
+    ragged = posv.ndim == 1
+    b = token.shape[0]
+    # (1,) shared positions, or (b, 1) per-row, for embed/rope
+    pos_arr = posv[:, None] if ragged else posv[None]
     x = embed_tokens(params["embed"], token[:, None], pos_arr, cfg)
     scale = 1.0 / (cfg.head_dim ** 0.5)
     new_cache = []
@@ -118,12 +134,17 @@ def decode_step(params: dict, token, pos, cache, cfg: TransformerConfig,
         def attend(q, k, v, lc=lc):
             # rope configs: q/k arrive rotated from apply_layer; keys
             # are cached rotated (standard RoPE decode)
-            kc = lax.dynamic_update_slice(lc["k"], k.astype(dt),
-                                          (0, pos, 0, 0))
-            vc = lax.dynamic_update_slice(lc["v"], v.astype(dt),
-                                          (0, pos, 0, 0))
+            if ragged:
+                rows = jnp.arange(b)
+                kc = lc["k"].at[rows, posv].set(k[:, 0].astype(dt))
+                vc = lc["v"].at[rows, posv].set(v[:, 0].astype(dt))
+            else:
+                kc = lax.dynamic_update_slice(lc["k"], k.astype(dt),
+                                              (0, pos, 0, 0))
+                vc = lax.dynamic_update_slice(lc["v"], v.astype(dt),
+                                              (0, pos, 0, 0))
             new_cache.append({"k": kc, "v": vc})
-            return _attend_cache(q, kc, vc, pos, scale).astype(dt)
+            return _attend_cache(q, kc, vc, posv, scale).astype(dt)
 
         x, _ = apply_layer(x, layer, cfg, attention=attend,
                            tp_axis=tp_axis, ep_axis=ep_axis,
@@ -136,12 +157,24 @@ def decode_step(params: dict, token, pos, cache, cfg: TransformerConfig,
 
 def prefill(params: dict, tokens, cache, cfg: TransformerConfig,
             tp_axis: Optional[str] = None,
-            ep_axis: Optional[str] = None):
+            ep_axis: Optional[str] = None,
+            last_index=None):
     """Fill the cache with the whole prompt in ONE forward pass.
     Returns (logits of the last prompt position, filled cache).
+    ``last_index`` (b,) selects a PER-ROW logits position instead of
+    the final one (ragged prompts: row i's prompt ends at
+    last_index[i]; positions beyond it hold padding whose cache
+    entries are never attended — decode masks at the row's own
+    position and overwrites them in order).
     MoE prompts route with the TRAINING capacity semantics (the whole
     prompt is one token set — exact forward parity); decode steps then
-    route drop-free (_decode_cfg).
+    route drop-free (_decode_cfg). RAGGED MoE prompts instead route
+    DROP-FREE too: the training-capacity cumsum queue runs over the
+    whole flattened padded token set, so padding would consume expert
+    capacity and displace real tokens — drop-free routing makes
+    padding inert, and per-row parity with the dense generate then
+    holds exactly when the dense forward drops nothing (the same
+    capacity_factor >= n_experts condition as decode).
 
     The prompt is a causal prefix, so causal attention over the prompt
     block IS attention against the (empty-beyond-it) cache — one
@@ -154,6 +187,8 @@ def prefill(params: dict, tokens, cache, cfg: TransformerConfig,
     at plen 1024 on the v5e chip (benchmarks/decode_bench.py --ttft).
     """
     b, plen = tokens.shape
+    if last_index is not None:
+        cfg = _decode_cfg(cfg)  # ragged MoE: padding must be inert
     dt = cfg.act_dtype
     pos = jnp.arange(plen)
     x = embed_tokens(params["embed"], tokens, pos, cfg)
@@ -171,8 +206,13 @@ def prefill(params: dict, tokens, cache, cfg: TransformerConfig,
         x, _ = apply_layer(x, layer, cfg, attention=attend,
                            tp_axis=tp_axis, ep_axis=ep_axis, pos=pos)
     x = _rmsnorm(x, params["ln_f"]["g"])
-    logits = (x[:, -1, :] @ params["embed"].T.astype(dt)) \
-        .astype(jnp.float32)
+    if last_index is None:
+        xl = x[:, -1, :]
+    else:
+        idx = jnp.asarray(last_index, jnp.int32)[:, None, None]
+        xl = jnp.take_along_axis(
+            x, jnp.broadcast_to(idx, (b, 1, x.shape[-1])), axis=1)[:, 0]
+    logits = (xl @ params["embed"].T.astype(dt)).astype(jnp.float32)
     return logits, new_cache
 
 
@@ -201,13 +241,27 @@ def generate(params: dict, prompt, cfg: TransformerConfig, *,
              temperature: float = 0.0,
              rng: Optional[jax.Array] = None,
              tp_axis: Optional[str] = None,
-             ep_axis: Optional[str] = None):
+             ep_axis: Optional[str] = None,
+             prompt_lengths=None):
     """Autoregressive continuation of ``prompt`` (b, plen) int32:
     returns (b, max_new) int32 new tokens. temperature 0 = greedy;
     > 0 samples from softmax(logits/T) (needs ``rng``). Jittable as a
     whole (static shapes; one lax.scan over the new positions).
     With ``tp_axis`` (inside shard_map): tensor-parallel decode over
-    sharded params + cache (see decode_step)."""
+    sharded params + cache (see decode_step).
+
+    ``prompt_lengths`` (b,) int32 enables RAGGED prompts (the serving
+    shape: one batch, different prompt lengths): row i's prompt is
+    prompt[i, :prompt_lengths[i]], the rest is padding (any valid
+    token id). Row i's continuation starts right after its own last
+    prompt token — per-row positions, cache slots, and attention
+    masks throughout — and equals the dense generate of the truncated
+    row exactly (the padded positions' cache entries are never
+    attended: decode masks at the row's position and overwrites them
+    in order). MoE configs: the ragged prefill routes drop-free so
+    padding cannot consume expert capacity (see prefill); per-row
+    parity then holds under the same capacity_factor >= n_experts
+    condition as MoE decode."""
     b, plen = prompt.shape
     max_len = max_len or (plen + max_new)
     if plen + max_new > max_len:
@@ -217,8 +271,16 @@ def generate(params: dict, prompt, cfg: TransformerConfig, *,
         # argument error: raise before any cache/prefill work is spent
         raise ValueError("sampling (temperature > 0) needs rng")
     cache = init_kv_cache(cfg, b, max_len, tp_axis=tp_axis)
-    logits, cache = prefill(params, prompt, cache, cfg,
-                            tp_axis=tp_axis, ep_axis=ep_axis)
+    if prompt_lengths is None:
+        pos0 = plen
+        logits, cache = prefill(params, prompt, cache, cfg,
+                                tp_axis=tp_axis, ep_axis=ep_axis)
+    else:
+        lengths = jnp.asarray(prompt_lengths, jnp.int32)
+        pos0 = lengths                                   # (b,) ragged
+        logits, cache = prefill(params, prompt, cache, cfg,
+                                tp_axis=tp_axis, ep_axis=ep_axis,
+                                last_index=lengths - 1)
 
     def pick(logits, key):
         if temperature == 0:
@@ -236,5 +298,5 @@ def generate(params: dict, prompt, cfg: TransformerConfig, *,
                                     tp_axis=tp_axis, ep_axis=ep_axis)
         return (logits, cache, pos + 1), tok
 
-    (_, _, _), toks = lax.scan(step, (logits, cache, plen), keys)
+    (_, _, _), toks = lax.scan(step, (logits, cache, pos0), keys)
     return jnp.transpose(toks)  # (b, max_new)

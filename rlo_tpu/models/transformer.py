@@ -204,10 +204,12 @@ def _rmsnorm(x, g):
 
 
 def _sincos(pos, d_model, dtype):
-    """Sinusoidal positions for GLOBAL token positions (works sharded)."""
+    """Sinusoidal positions for GLOBAL token positions (works sharded).
+    ``pos`` is (blk,) shared across the batch, or (b, blk) per-row
+    (ragged decode); returns (blk, d) or (b, blk, d) accordingly."""
     half = d_model // 2
     freqs = jnp.exp(-np.log(10000.0) * jnp.arange(half) / half)
-    ang = pos[:, None].astype(jnp.float32) * freqs[None, :]
+    ang = pos[..., None].astype(jnp.float32) * freqs
     return jnp.concatenate([jnp.sin(ang), jnp.cos(ang)],
                            axis=-1).astype(dtype)
 
@@ -254,6 +256,9 @@ def _rope(t, pos, scaling: Optional[str] = None, scale: float = 1.0):
     depend only on RELATIVE positions (the rotation of q·kᵀ composes
     to pos_q − pos_k).
 
+    ``pos`` is (blk,) shared across the batch, or (b, blk) PER-ROW
+    (ragged decode: each row sits at its own global position).
+
     ``scaling``/``scale`` extend the context window (cfg.rope_scaling):
     'linear' divides positions by ``scale`` (position interpolation —
     identical to evaluating the unscaled rotation at pos/scale); 'ntk'
@@ -271,9 +276,13 @@ def _rope(t, pos, scaling: Optional[str] = None, scale: float = 1.0):
         raise ValueError(
             f"unknown rope_scaling {scaling!r}; known: 'linear', 'ntk'")
     freqs = jnp.exp(-np.log(base) * jnp.arange(half) / half)
-    ang = posf[:, None] * freqs[None, :]
-    cos = jnp.cos(ang)[None, :, None, :]
-    sin = jnp.sin(ang)[None, :, None, :]
+    ang = posf[..., None] * freqs          # (blk, half) | (b, blk, half)
+    if ang.ndim == 2:
+        cos = jnp.cos(ang)[None, :, None, :]
+        sin = jnp.sin(ang)[None, :, None, :]
+    else:                                  # per-row positions
+        cos = jnp.cos(ang)[:, :, None, :]
+        sin = jnp.sin(ang)[:, :, None, :]
     t32 = t.astype(jnp.float32)
     t1, t2 = t32[..., :half], t32[..., half:]
     return jnp.concatenate([t1 * cos - t2 * sin,

@@ -206,9 +206,13 @@ class NativeWorld:
         return bool(self._lib.rlo_world_failed(self._w))
 
     def peer_alive(self, rank: int, timeout_usec: int = 1_000_000) -> bool:
-        """Net-new failure detection (SURVEY.md §5): False when `rank`
-        showed no transport activity for timeout_usec. Always True on
-        transports without a liveness signal (in-process loopback)."""
+        """Net-new failure detection (SURVEY.md §5), transport-
+        specific: shm = False when `rank` stamped no heartbeat slot
+        for timeout_usec; tcp = False when `rank`'s connection is
+        closed (graceful exit or crash — timeout_usec is ignored, and
+        a hung-but-connected peer stays True: that is the engine-level
+        heartbeat detector's job). Always True on transports without
+        a liveness signal (in-process loopback)."""
         return bool(self._lib.rlo_world_peer_alive(self._w, rank,
                                                    timeout_usec))
 

@@ -108,6 +108,8 @@ static void set_nodelay(int fd)
     setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
 }
 
+static void tcp_peer_crashed(rlo_tcp_world *w, tcp_peer *p);
+
 /* flush as much of dst's queue as the kernel accepts right now */
 static int tcp_flush_peer(rlo_tcp_world *w, int dst)
 {
@@ -133,7 +135,7 @@ static int tcp_flush_peer(rlo_tcp_world *w, int dst)
             }
             if (k < 0 && (errno == EAGAIN || errno == EWOULDBLOCK))
                 return RLO_OK; /* kernel buffer full: try later */
-            w->failed = 1;
+            tcp_peer_crashed(w, p); /* EPIPE/reset: the peer died */
             return RLO_ERR_STALL;
         }
         /* fully written */
@@ -238,6 +240,19 @@ static void tcp_deliver(rlo_tcp_world *w, int src)
     w->inbox_tail = n;
 }
 
+
+/* a peer-attributable failure: remember the dead world AND close the
+ * peer's socket so tcp_peer_alive reports it dead (the crash-fast
+ * signal; without the close, fd >= 0 would read "alive" forever) */
+static void tcp_peer_crashed(rlo_tcp_world *w, tcp_peer *p)
+{
+    w->failed = 1;
+    if (p->fd >= 0) {
+        close(p->fd);
+        p->fd = -1;
+    }
+}
+
 /* read whatever each socket has; assemble frames into the inboxes.
  * A clean EOF at a record boundary is a GRACEFUL peer exit (it
  * finished its drain and freed its world — the shutdown ring is
@@ -263,7 +278,7 @@ static void tcp_pump(rlo_tcp_world *w)
                 }
                 if (k == 0 || (k < 0 && errno != EAGAIN &&
                                errno != EWOULDBLOCK)) {
-                    w->failed = 1;
+                    tcp_peer_crashed(w, p);
                     return;
                 }
                 if (k < 0)
@@ -272,7 +287,7 @@ static void tcp_pump(rlo_tcp_world *w)
                 if (p->rhdr_got < sizeof p->rhdr)
                     break;
                 if (p->rhdr.len < 0 || p->rhdr.len > TCP_MAX_FRAME) {
-                    w->failed = 1;
+                    tcp_peer_crashed(w, p);
                     return;
                 }
                 p->rframe = rlo_blob_new(p->rhdr.len);
@@ -290,7 +305,7 @@ static void tcp_pump(rlo_tcp_world *w)
                              (size_t)p->rhdr.len - p->rframe_got, 0);
             if (k == 0 ||
                 (k < 0 && errno != EAGAIN && errno != EWOULDBLOCK)) {
-                w->failed = 1;
+                tcp_peer_crashed(w, p);
                 return;
             }
             if (k < 0)
