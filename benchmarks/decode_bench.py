@@ -6,6 +6,13 @@ prefill cost cancel by differencing two generation lengths:
 
     tokens/s = (N2 - N1) / (t(N2) - t(N1))
 
+where t(N2) - t(N1) is the MEDIAN OF INTERLEAVED PAIRS (paired_diff):
+the two programs are timed back-to-back within each pair so the
+chip's between-window throughput drift — the source of the round-3
+numbers' ±30% run-to-run scatter — cancels, the same cure bench.py's
+paired-ratio protocol applies to the headline number. Each recorded
+value now also prints its own MAD/median spread.
+
 Decode is matvec-bound (one (1, d) activation against every weight
 matrix per token), so the interesting ceiling is HBM bandwidth over
 the ~param bytes read per token, reported as achieved/ceiling.
@@ -47,16 +54,53 @@ from rlo_tpu.models.transformer import (TransformerConfig,  # noqa: E402
 V5E_HBM_GBPS = 819.0
 
 
-def time_generate(params, prompt, cfg, max_new, max_len, reps=7):
-    f = jax.jit(lambda p, t: generate(p, t, cfg, max_new=max_new,
-                                      max_len=max_len))
-    np.asarray(f(params, prompt))  # compile + warm
-    ts = []
-    for _ in range(reps):
+def paired_diff(params, hi_args, lo_args, cfg, pairs=9, label="decode"):
+    """Median of interleaved per-pair differences t(hi) - t(lo).
+
+    The round-3 decode numbers carried ~±30% run-to-run drift because
+    the two legs of the differencing were timed in separate blocks:
+    the tunneled chip's throughput drifts between measurement windows
+    (docs/DESIGN.md, "the chip drifts ~1.6x between windows"), so any
+    window shift between block t(N1) and block t(N2) lands directly in
+    the difference. Same cure as bench.py's paired-ratio protocol
+    (round-2 VERDICT item 2): compile and warm BOTH programs, then
+    alternate hi/lo timings back-to-back and take the median of the
+    per-pair differences — drift slow relative to one pair cancels.
+    The median runs over ALL pairs including non-positive ones —
+    dropping negative pairs before the median would censor the noise
+    distribution one-sidedly and bias the estimate up (and made the
+    tiny smoke test flaky); only a non-positive MEDIAN means the gap
+    is genuinely inside dispatch noise, and that raises.
+    Returns (median_diff_seconds, relative_spread) where the spread is
+    MAD/median over all pairs — the number carries its own
+    uncertainty instead of hiding it.
+    """
+    def build(args):
+        prompt, max_new, max_len = args
+        f = jax.jit(lambda p, t: generate(p, t, cfg, max_new=max_new,
+                                          max_len=max_len))
+        np.asarray(f(params, prompt))  # compile + warm
+        return lambda: np.asarray(f(params, prompt))
+
+    run_hi, run_lo = build(hi_args), build(lo_args)
+    run_hi(), run_lo()  # second warm pass after both are compiled
+    diffs = []
+    for _ in range(pairs):
         t0 = time.perf_counter()
-        np.asarray(f(params, prompt))
-        ts.append(time.perf_counter() - t0)
-    return float(min(ts))
+        run_hi()
+        t1 = time.perf_counter()
+        run_lo()
+        t2 = time.perf_counter()
+        diffs.append((t1 - t0) - (t2 - t1))
+    med = float(np.median(diffs))
+    if med <= 0:
+        raise RuntimeError(
+            f"{label} paired differencing failed: median pair "
+            f"difference {med*1e3:.3f} ms <= 0 over {pairs} pairs "
+            f"(hi={hi_args[1:]}, lo={lo_args[1:]}) — the timing gap "
+            f"is inside dispatch noise; widen the length gap")
+    mad = float(np.median(np.abs(np.asarray(diffs) - med)))
+    return med, mad / med
 
 
 def main():
@@ -110,14 +154,12 @@ def main():
     prompt = jnp.asarray(rng.integers(0, cfg.vocab, (batch, 16)),
                          jnp.int32)
     max_len = prompt.shape[1] + n2
-    t1 = time_generate(params, prompt, cfg, n1, max_len)
-    t2 = time_generate(params, prompt, cfg, n2, max_len)
-    if t2 <= t1:
-        raise RuntimeError(
-            f"differencing failed (t({n2})={t2:.3f} <= t({n1})={t1:.3f})"
-            f" — dispatch noise swamped the decode cost")
-    steps_s = (n2 - n1) / (t2 - t1)
+    diff, spread = paired_diff(params, (prompt, n2, max_len),
+                               (prompt, n1, max_len), cfg)
+    steps_s = (n2 - n1) / diff
     tok_s = steps_s * batch
+    print(f"paired differencing spread (MAD/median): {spread:.1%}",
+          file=sys.stderr)
     on_tpu = jax.default_backend() == "tpu"
     # HBM ceiling: every decode step reads at least the param bytes
     # (bf16 weights; embeddings gather + cache traffic excluded)
@@ -164,15 +206,11 @@ def ttft(args):
 
     # blockwise prefill cost by PROMPT-LENGTH differencing of whole
     # generate programs: decode tail (fixed n_dec) and dispatch floor
-    # cancel in the difference
-    t_hi = time_generate(params, prompt_of(plen), cfg, n_dec,
-                         plen + n_dec)
-    t_lo = time_generate(params, prompt_of(p0), cfg, n_dec, p0 + n_dec)
-    t_block = t_hi - t_lo
-    if t_block <= 0:
-        raise RuntimeError(
-            f"prefill differencing failed (t({plen})={t_hi:.4f} <= "
-            f"t({p0})={t_lo:.4f})")
+    # cancel in the difference; interleaved pairs cancel window drift
+    t_block, spread_b = paired_diff(
+        params, (prompt_of(plen), n_dec, plen + n_dec),
+        (prompt_of(p0), n_dec, p0 + n_dec), cfg,
+        label="prefill (gap = --plen)")
 
     # scan-prefill baseline: one token of scan prefill IS one decode
     # step (same decode_step, same cache attend), so its cost is the
@@ -181,14 +219,14 @@ def ttft(args):
     # batch 1 a step is ~0.15 ms and a narrow pair sits inside the
     # dispatch noise (the differencing guard tripped on it)
     n1, n2 = 8, 192
-    td1 = time_generate(params, prompt_of(p0), cfg, n1, p0 + n2)
-    td2 = time_generate(params, prompt_of(p0), cfg, n2, p0 + n2)
-    if td2 <= td1:
-        raise RuntimeError(
-            f"decode differencing failed (t({n2})={td2:.4f} <= "
-            f"t({n1})={td1:.4f})")
-    t_step = (td2 - td1) / (n2 - n1)
+    d_dec, spread_d = paired_diff(
+        params, (prompt_of(p0), n2, p0 + n2),
+        (prompt_of(p0), n1, p0 + n2), cfg,
+        label=f"ttft decode baseline (gap = n1,n2={n1},{n2})")
+    t_step = d_dec / (n2 - n1)
     t_scan = t_step * (plen - p0)
+    print(f"ttft paired spreads: prefill {spread_b:.1%}  decode "
+          f"{spread_d:.1%}", file=sys.stderr)
 
     on_tpu = jax.default_backend() == "tpu"
     print(f"ttft plen={plen} batch={batch}: blockwise prefill of "
