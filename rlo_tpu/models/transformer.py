@@ -645,7 +645,8 @@ def train_step(params: dict, tokens: jax.Array, cfg: TransformerConfig,
                dp_axis: Optional[str] = None,
                tp_axis: Optional[str] = None,
                ep_axis: Optional[str] = None,
-               grad_algorithm: str = "psum"):
+               grad_algorithm: str = "psum",
+               dcn_axis: Optional[str] = None):
     """One SGD step; returns (new_params, loss). Run under shard_jit
     (check_vma=True by default).
 
@@ -666,7 +667,8 @@ def train_step(params: dict, tokens: jax.Array, cfg: TransformerConfig,
     loss, grads = grads_and_loss(params, tokens, cfg, sp_axis=sp_axis,
                                  dp_axis=dp_axis, tp_axis=tp_axis,
                                  ep_axis=ep_axis,
-                                 grad_algorithm=grad_algorithm)
+                                 grad_algorithm=grad_algorithm,
+                                 dcn_axis=dcn_axis)
     new_params = jax.tree.map(lambda p, g: p - lr * g, params, grads)
     return new_params, loss
 
@@ -677,9 +679,19 @@ def grads_and_loss(params: dict, tokens: jax.Array,
                    dp_axis: Optional[str] = None,
                    tp_axis: Optional[str] = None,
                    ep_axis: Optional[str] = None,
-                   grad_algorithm: str = "psum"):
+                   grad_algorithm: str = "psum",
+                   dcn_axis: Optional[str] = None):
     """(loss, fully-synchronized grads) — the shared gradient pipeline
-    behind train_step (plain SGD) and train_step_optax."""
+    behind train_step (plain SGD) and train_step_optax.
+
+    ``dcn_axis``: second, slower data-parallel tier (multi-slice DP,
+    one mesh axis per make_multislice_mesh). On the explicit combine
+    path the dp gradient sync becomes
+    tpu_collectives.hierarchical_allreduce — reduce-scatter in-slice,
+    cross-slice allreduce on only the scattered shard, all-gather
+    in-slice — so per-chip DCN bytes shrink by the in-slice dp size.
+    Under vma typing, AD inserts the (already hierarchical-aware) XLA
+    AllReduce over both axes and only the rescale differs."""
     if sp_axis is not None or tp_axis is not None or ep_axis is not None:
         # without vma typing the sp/tp/ep cotangent reductions never
         # happen and every shard would silently take a different step
@@ -689,11 +701,23 @@ def grads_and_loss(params: dict, tokens: jax.Array,
             "run with check_vma=False")
     loss, grads = jax.value_and_grad(loss_fn)(params, tokens, cfg, sp_axis,
                                               tp_axis, ep_axis)
+    if dcn_axis is not None and dp_axis is None:
+        raise ValueError("dcn_axis requires dp_axis (it is the second, "
+                         "cross-slice tier of data parallelism)")
     if dp_axis is not None:
         n = lax.axis_size(dp_axis)
+        if dcn_axis is not None:
+            n *= lax.axis_size(dcn_axis)
         if _vma_active(dp_axis):
-            # vma AD already summed grads over dp; just rescale
+            # vma AD already summed grads over dp (and dcn); rescale
             grads = jax.tree.map(lambda g: g / n, grads)
+        elif dcn_axis is not None:
+            # two-tier explicit combine: in-slice RS, DCN allreduce of
+            # the scattered shard only, in-slice AG
+            grads = jax.tree.map(
+                lambda g: tc.hierarchical_allreduce(g, dp_axis,
+                                                    dcn_axis) / n,
+                grads)
         else:
             # explicit framework combine of per-shard grads
             grads = jax.tree.map(
@@ -701,6 +725,8 @@ def grads_and_loss(params: dict, tokens: jax.Array,
                                        algorithm=grad_algorithm) / n,
                 grads)
         loss = lax.pmean(loss, dp_axis)
+        if dcn_axis is not None:
+            loss = lax.pmean(loss, dcn_axis)
     if ep_axis is not None:
         # ep is a second data axis: tokens are sharded over it, so the
         # (vma-inserted) cross-shard grad sums — psum for replicated
@@ -718,7 +744,8 @@ def train_step_optax(params: dict, opt_state, tokens: jax.Array,
                      dp_axis: Optional[str] = None,
                      tp_axis: Optional[str] = None,
                      ep_axis: Optional[str] = None,
-                     grad_algorithm: str = "psum"):
+                     grad_algorithm: str = "psum",
+                     dcn_axis: Optional[str] = None):
     """One optimizer step with any optax GradientTransformation
     (`optimizer.init(params)` builds opt_state); returns
     (new_params, new_opt_state, loss). Optimizer state mirrors the
@@ -730,6 +757,7 @@ def train_step_optax(params: dict, opt_state, tokens: jax.Array,
     loss, grads = grads_and_loss(params, tokens, cfg, sp_axis=sp_axis,
                                  dp_axis=dp_axis, tp_axis=tp_axis,
                                  ep_axis=ep_axis,
-                                 grad_algorithm=grad_algorithm)
+                                 grad_algorithm=grad_algorithm,
+                                 dcn_axis=dcn_axis)
     updates, opt_state = optimizer.update(grads, opt_state, params)
     return optax.apply_updates(params, updates), opt_state, loss

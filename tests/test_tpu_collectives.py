@@ -117,6 +117,81 @@ class TestAllreduce:
         np.testing.assert_allclose(np.asarray(ok(x)), 4.0)
 
 
+class TestHierarchicalAllreduce:
+    """Multi-slice two-tier allreduce: in-slice reduce-scatter, DCN
+    allreduce of only the scattered shard, in-slice all-gather."""
+
+    @pytest.mark.parametrize("ici_alg,dcn_alg",
+                             [("auto", "psum"), ("ring", "ring"),
+                              ("auto", "bidir_ring")])
+    def test_matches_two_axis_psum(self, ici_alg, dcn_alg):
+        mesh = make_mesh((2, 4), ("dcn", "ici"))
+        x = jnp.asarray(np.random.default_rng(0).standard_normal(
+            (2, 4, 33)), jnp.float32)  # ragged: padding path
+        f = shard_jit(
+            lambda v: tc.hierarchical_allreduce(
+                v, "ici", "dcn", ici_algorithm=ici_alg,
+                dcn_algorithm=dcn_alg, use_pallas=False),
+            mesh, P("dcn", "ici"), P("dcn", "ici"))
+        want = np.broadcast_to(np.asarray(x).sum((0, 1)), x.shape)
+        np.testing.assert_allclose(np.asarray(f(x)), want,
+                                   rtol=1e-4, atol=1e-5)
+
+    @pytest.mark.parametrize("op", ["min", "max"])
+    def test_min_max(self, op):
+        mesh = make_mesh((2, 4), ("dcn", "ici"))
+        x = jnp.asarray(np.random.default_rng(1).standard_normal(
+            (2, 4, 16)), jnp.float32)
+        f = shard_jit(
+            lambda v: tc.hierarchical_allreduce(v, "ici", "dcn", op=op,
+                                                use_pallas=False),
+            mesh, P("dcn", "ici"), P("dcn", "ici"))
+        red = getattr(np.asarray(x), op)(axis=(0, 1))
+        np.testing.assert_allclose(np.asarray(f(x)),
+                                   np.broadcast_to(red, x.shape),
+                                   rtol=1e-6)
+
+    @pytest.mark.parametrize("shape", [(1, 8), (2, 3)])
+    def test_degenerate_and_non_pow2(self, shape):
+        """ws_dcn=1 must degrade to a pure in-slice schedule; non-pow2
+        in-slice sizes take the ring RS/AG branch."""
+        mesh = make_mesh(shape, ("dcn", "ici"))
+        x = jnp.asarray(np.random.default_rng(2).standard_normal(
+            shape + (17,)), jnp.float32)
+        f = shard_jit(
+            lambda v: tc.hierarchical_allreduce(v, "ici", "dcn",
+                                                use_pallas=False),
+            mesh, P("dcn", "ici"), P("dcn", "ici"))
+        want = np.broadcast_to(np.asarray(x).sum((0, 1)), x.shape)
+        np.testing.assert_allclose(np.asarray(f(x)), want,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_dcn_traffic_is_scattered_shard_only(self):
+        """THE point of the hierarchy: the only collective on the dcn
+        axis carries 1/ws_ici of the buffer, never the full payload."""
+        import re
+        wd, wi = 2, 4
+        mesh = make_mesh((wd, wi), ("dcn", "ici"))
+        per_shard = wi * 128
+        x = jnp.zeros((wd, wi, per_shard), jnp.float32)
+        f = shard_jit(
+            lambda v: tc.hierarchical_allreduce(v, "ici", "dcn",
+                                                use_pallas=False),
+            mesh, P("dcn", "ici"), P("dcn", "ici"))
+        txt = f.lower(x).as_text()
+        # the dcn psum is the only stablehlo.all_reduce in the program;
+        # its replica groups pair shards ACROSS slices (stride wi). The
+        # op carries a multi-line reduction region, so match through it
+        # to the trailing `}) : (tensor<...>)` operand type.
+        groups = re.findall(
+            r'all_reduce.*?replica_groups\s*=\s*dense<\[\[(\d+),\s*(\d+)\]'
+            r'.*?\}\)\s*:\s*\(tensor<(\d+)xf32>\)', txt, re.DOTALL)
+        assert groups, "no all_reduce found on the dcn axis"
+        for a, b, elems in groups:
+            assert abs(int(b) - int(a)) == wi  # cross-slice pairing
+            assert int(elems) == per_shard // wi  # scattered shard only
+
+
 def _permute_bytes_by_direction(lowered_text: str, ws: int):
     """Sum collective_permute operand bytes in StableHLO text, grouped
     by ring direction (first source->target pair: +1 hop = fwd, -1 =

@@ -134,6 +134,62 @@ def test_train_step_explicit_ring_pure_dp_matches_single_device():
                                    rtol=1e-4, atol=1e-5)
 
 
+def test_train_step_two_tier_dp_matches_single_device():
+    """Multi-slice data parallelism (dcn_axis): the explicit two-tier
+    combine — in-slice reduce-scatter, DCN allreduce of the scattered
+    shard, in-slice all-gather — must reproduce the single-device step
+    on a (dcn=2, dp=4) mesh under check_vma=False."""
+    cfg = TransformerConfig(vocab=16, d_model=32, n_heads=2, n_layers=1,
+                            d_ff=64, dtype="float32")
+    p0 = init_params(jax.random.PRNGKey(7), cfg)
+    rng = np.random.default_rng(7)
+    tokens = jnp.asarray(rng.integers(0, cfg.vocab, (8, SEQ)), jnp.int32)
+    ref_p, ref_loss = jax.jit(
+        lambda p, t: train_step(p, t, cfg, lr=0.1))(p0, tokens)
+    mesh = make_mesh((2, 4), ("dcn", "dp"))
+    step = shard_jit(
+        lambda p, t: train_step(p, t, cfg, lr=0.1, dp_axis="dp",
+                                dcn_axis="dcn"),
+        mesh, (P(), P(("dcn", "dp"))), (P(), P()), check_vma=False)
+    new_p, loss = step(p0, tokens)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(new_p), jax.tree.leaves(ref_p)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_train_step_two_tier_dp_vma_path():
+    """Same mesh under vma typing: AD inserts the psums over both data
+    axes and grads_and_loss only rescales by the PRODUCT of the two
+    axis sizes — a wrong n here silently scales the step."""
+    cfg = TransformerConfig(vocab=16, d_model=32, n_heads=2, n_layers=1,
+                            d_ff=64, dtype="float32")
+    p0 = init_params(jax.random.PRNGKey(8), cfg)
+    rng = np.random.default_rng(8)
+    tokens = jnp.asarray(rng.integers(0, cfg.vocab, (8, SEQ)), jnp.int32)
+    ref_p, ref_loss = jax.jit(
+        lambda p, t: train_step(p, t, cfg, lr=0.1))(p0, tokens)
+    mesh = make_mesh((2, 4), ("dcn", "dp"))
+    step = shard_jit(
+        lambda p, t: train_step(p, t, cfg, lr=0.1, dp_axis="dp",
+                                dcn_axis="dcn"),
+        mesh, (P(), P(("dcn", "dp"))), (P(), P()))
+    new_p, loss = step(p0, tokens)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(new_p), jax.tree.leaves(ref_p)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_dcn_axis_requires_dp_axis():
+    cfg = TransformerConfig(vocab=16, d_model=32, n_heads=2, n_layers=1,
+                            d_ff=64, dtype="float32")
+    p0 = init_params(jax.random.PRNGKey(9), cfg)
+    tokens = jnp.zeros((2, SEQ), jnp.int32)
+    with pytest.raises(ValueError, match="dcn_axis requires dp_axis"):
+        train_step(p0, tokens, cfg, dcn_axis="dcn")
+
+
 def test_remat_matches_non_remat_exactly():
     """jax.checkpoint per layer must not change forward numerics or the
     training step — it only changes what the backward rematerializes."""
