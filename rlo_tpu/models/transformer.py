@@ -646,7 +646,8 @@ def train_step(params: dict, tokens: jax.Array, cfg: TransformerConfig,
                tp_axis: Optional[str] = None,
                ep_axis: Optional[str] = None,
                grad_algorithm: str = "psum",
-               dcn_axis: Optional[str] = None):
+               dcn_axis: Optional[str] = None,
+               dcn_algorithm: str = "psum"):
     """One SGD step; returns (new_params, loss). Run under shard_jit
     (check_vma=True by default).
 
@@ -668,7 +669,8 @@ def train_step(params: dict, tokens: jax.Array, cfg: TransformerConfig,
                                  dp_axis=dp_axis, tp_axis=tp_axis,
                                  ep_axis=ep_axis,
                                  grad_algorithm=grad_algorithm,
-                                 dcn_axis=dcn_axis)
+                                 dcn_axis=dcn_axis,
+                                 dcn_algorithm=dcn_algorithm)
     new_params = jax.tree.map(lambda p, g: p - lr * g, params, grads)
     return new_params, loss
 
@@ -680,7 +682,8 @@ def grads_and_loss(params: dict, tokens: jax.Array,
                    tp_axis: Optional[str] = None,
                    ep_axis: Optional[str] = None,
                    grad_algorithm: str = "psum",
-                   dcn_axis: Optional[str] = None):
+                   dcn_axis: Optional[str] = None,
+                   dcn_algorithm: str = "psum"):
     """(loss, fully-synchronized grads) — the shared gradient pipeline
     behind train_step (plain SGD) and train_step_optax.
 
@@ -709,14 +712,25 @@ def grads_and_loss(params: dict, tokens: jax.Array,
         if dcn_axis is not None:
             n *= lax.axis_size(dcn_axis)
         if _vma_active(dp_axis):
+            if dcn_axis is not None and dcn_algorithm != "psum":
+                # unlike grad_algorithm (whose vma fallback is also
+                # psum-shaped, just XLA's own), a silently-dropped
+                # int8 request means the user believes DCN traffic is
+                # compressed when it is not — refuse instead
+                raise ValueError(
+                    f"dcn_algorithm={dcn_algorithm!r} requires the "
+                    f"explicit combine path: run under "
+                    f"shard_jit(..., check_vma=False); the vma path's "
+                    f"AD-inserted AllReduce cannot be compressed")
             # vma AD already summed grads over dp (and dcn); rescale
             grads = jax.tree.map(lambda g: g / n, grads)
         elif dcn_axis is not None:
             # two-tier explicit combine: in-slice RS, DCN allreduce of
             # the scattered shard only, in-slice AG
             grads = jax.tree.map(
-                lambda g: tc.hierarchical_allreduce(g, dp_axis,
-                                                    dcn_axis) / n,
+                lambda g: tc.hierarchical_allreduce(
+                    g, dp_axis, dcn_axis,
+                    dcn_algorithm=dcn_algorithm) / n,
                 grads)
         else:
             # explicit framework combine of per-shard grads
@@ -745,7 +759,8 @@ def train_step_optax(params: dict, opt_state, tokens: jax.Array,
                      tp_axis: Optional[str] = None,
                      ep_axis: Optional[str] = None,
                      grad_algorithm: str = "psum",
-                     dcn_axis: Optional[str] = None):
+                     dcn_axis: Optional[str] = None,
+                     dcn_algorithm: str = "psum"):
     """One optimizer step with any optax GradientTransformation
     (`optimizer.init(params)` builds opt_state); returns
     (new_params, new_opt_state, loss). Optimizer state mirrors the
@@ -758,6 +773,7 @@ def train_step_optax(params: dict, opt_state, tokens: jax.Array,
                                  dp_axis=dp_axis, tp_axis=tp_axis,
                                  ep_axis=ep_axis,
                                  grad_algorithm=grad_algorithm,
-                                 dcn_axis=dcn_axis)
+                                 dcn_axis=dcn_axis,
+                                 dcn_algorithm=dcn_algorithm)
     updates, opt_state = optimizer.update(grads, opt_state, params)
     return optax.apply_updates(params, updates), opt_state, loss

@@ -458,6 +458,36 @@ def _doubling_all_gather(chunk, axis: str):
     return out
 
 
+def _int8_gather_allreduce(x, axis: str):
+    """Sum-allreduce over a slow (DCN) axis with int8 compression.
+
+    Each shard quantizes symmetrically (per-shard f32 scale =
+    amax/127, a 4-byte sidecar), all-gathers the int8 payload +
+    scales, and dequant-accumulates in f32 locally — the standard
+    8-bit gradient-compression trade: per-element error is bounded by
+    ws * scale_max / 2 (one half-step per contributing shard), which
+    for gradient averaging is noise-level. Only valid for op='sum'
+    (quantized min/max would be exact anyway and gain nothing).
+
+    Traffic honesty: an all-gather moves (ws-1)*n int8 bytes per
+    shard vs ~2*n*4*(ws-1)/ws for an f32 ring allreduce, i.e. a
+    ~8/(ws-1) * ws/(ws-1) ~ 8x win at ws=2 shrinking to parity around
+    ws~9 and a LOSS beyond — this schedule is for the few-slice
+    regime multi-slice deployments actually use; past that, keep
+    psum (or add a quantized reduce-scatter). hierarchical_allreduce
+    documents the same bound.
+    """
+    orig_dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf))
+    scale = jnp.maximum(amax, jnp.float32(1e-30)) / 127.0
+    q = jnp.round(xf / scale).astype(jnp.int8)
+    qs = lax.all_gather(q, axis)                      # (ws, ...) int8
+    ss = lax.all_gather(scale, axis)                  # (ws,) f32
+    ss = ss.reshape((-1,) + (1,) * xf.ndim)
+    return (qs.astype(jnp.float32) * ss).sum(0).astype(orig_dtype)
+
+
 def hierarchical_allreduce(x, ici_axis: str, dcn_axis: str, *,
                            op: str = "sum", ici_algorithm: str = "auto",
                            dcn_algorithm: str = "psum",
@@ -488,7 +518,13 @@ def hierarchical_allreduce(x, ici_axis: str, dcn_axis: str, *,
     ``dcn_algorithm='psum'`` is the right default: XLA routes that
     AllReduce over DCN itself; the manual schedules remain selectable
     for parity studies and to host fused per-step compute.
+    ``dcn_algorithm='int8'`` compresses the DCN hop ~8x at 2 slices
+    (_int8_gather_allreduce; sum only, lossy within one quantization
+    half-step per slice; all-gather-based, so the win shrinks with
+    slice count and inverts past ~8 slices — see its docstring).
     """
+    if dcn_algorithm == "int8" and op != "sum":
+        raise ValueError("dcn_algorithm='int8' supports op='sum' only")
     if use_pallas is None:
         use_pallas = jax.default_backend() == "tpu"
     wi = lax.axis_size(ici_axis)
@@ -503,8 +539,15 @@ def hierarchical_allreduce(x, ici_axis: str, dcn_axis: str, *,
                                                     use_pallas)
             mine = lax.ppermute(reduced, ici_axis,
                                 list(topology.ring_perm(wi, 1)))
-        mine = allreduce(mine, dcn_axis, op=op, algorithm=dcn_algorithm,
-                         use_pallas=use_pallas)
+        if lax.axis_size(dcn_axis) > 1:  # ws_dcn=1: nothing to cross
+            # (the guard also keeps int8 from injecting quantization
+            # error into single-slice runs that left it configured)
+            if dcn_algorithm == "int8":
+                mine = _int8_gather_allreduce(mine, dcn_axis)
+            else:
+                mine = allreduce(mine, dcn_axis, op=op,
+                                 algorithm=dcn_algorithm,
+                                 use_pallas=use_pallas)
         gathered = _doubling_all_gather(mine, ici_axis) \
             if topology.is_power_of_2(wi) \
             else all_gather(mine, ici_axis, algorithm="ring")

@@ -166,6 +166,108 @@ class TestHierarchicalAllreduce:
         np.testing.assert_allclose(np.asarray(f(x)), want,
                                    rtol=1e-4, atol=1e-5)
 
+    def test_int8_dcn_exact_on_representable_values(self):
+        """A payload whose in-slice-reduced chunks are all +-127 has
+        scale exactly 1 at the quantization point (which sits AFTER
+        the in-slice reduce-scatter), so the compressed DCN hop must
+        reproduce the exact sum."""
+        mesh = make_mesh((2, 4), ("dcn", "ici"))
+        rng = np.random.default_rng(3)
+        # only ici-shard 0 of each slice contributes, values +-127:
+        # every reduced chunk is +-127 everywhere -> amax 127, scale 1
+        x = np.zeros((2, 4, 64), np.float32)
+        x[:, 0, :] = 127.0 * rng.choice([-1.0, 1.0], (2, 64))
+        xj = jnp.asarray(x)
+        f = shard_jit(
+            lambda v: tc.hierarchical_allreduce(v, "ici", "dcn",
+                                                dcn_algorithm="int8",
+                                                use_pallas=False),
+            mesh, P("dcn", "ici"), P("dcn", "ici"))
+        want = np.broadcast_to(x.sum((0, 1)), x.shape)
+        np.testing.assert_allclose(np.asarray(f(xj)), want,
+                                   rtol=1e-6, atol=1e-4)
+
+    def test_int8_dcn_error_bound(self):
+        """Random data: per-element error of the compressed hop is
+        bounded by ws_dcn half-steps of the largest per-slice scale."""
+        mesh = make_mesh((2, 4), ("dcn", "ici"))
+        rng = np.random.default_rng(4)
+        x = rng.standard_normal((2, 4, 128)).astype(np.float32)
+        xj = jnp.asarray(x)
+        f = shard_jit(
+            lambda v: tc.hierarchical_allreduce(v, "ici", "dcn",
+                                                dcn_algorithm="int8",
+                                                use_pallas=False),
+            mesh, P("dcn", "ici"), P("dcn", "ici"))
+        got = np.asarray(f(xj))
+        want = np.broadcast_to(x.sum((0, 1)), x.shape)
+        # after the in-slice RS each dcn shard holds a slice-summed
+        # chunk; its scale is amax/127 over that chunk
+        bound = 2 * (np.abs(x.sum(1)).max() / 127.0) * 0.51 + 1e-5
+        assert np.abs(got - want).max() <= bound
+
+    def test_int8_dcn_wire_is_int8(self):
+        """The compression must reach the wire: the only dcn-axis
+        collectives are the i8 payload gather and the f32 scale
+        gather — no f32 tensor of the chunk size crosses DCN."""
+        import re
+        wd, wi = 2, 4
+        mesh = make_mesh((wd, wi), ("dcn", "ici"))
+        per_shard = wi * 128
+        x = jnp.zeros((wd, wi, per_shard), jnp.float32)
+        f = shard_jit(
+            lambda v: tc.hierarchical_allreduce(v, "ici", "dcn",
+                                                dcn_algorithm="int8",
+                                                use_pallas=False),
+            mesh, P("dcn", "ici"), P("dcn", "ici"))
+        txt = f.lower(x).as_text()
+        gathers = re.findall(
+            r'all_gather.*?replica_groups\s*=\s*dense<\[\[(\d+),\s*(\d+)\]'
+            r'[^\n]*?:\s*\(tensor<([0-9x]+)x(i8|f32)>\)', txt)
+        cross = [(int(a), int(b), dims, dt) for a, b, dims, dt in gathers
+                 if abs(int(b) - int(a)) == wi]  # dcn-axis groups
+        assert cross, f"no dcn-axis all_gather found: {gathers}"
+        payload = [g for g in cross if g[3] == "i8"]
+        assert payload and all(
+            int(g[2].split("x")[-1]) == per_shard // wi or
+            g[2] == str(per_shard // wi) for g in payload)
+        # any f32 crossing dcn must be the scalar scale, not the chunk
+        for _, _, dims, dt in cross:
+            if dt == "f32":
+                elems = 1
+                for d in dims.split("x"):
+                    elems *= int(d)
+                assert elems == 1, f"f32 chunk crossed DCN: {dims}"
+        assert "all_reduce" not in txt  # psum path fully replaced
+
+    def test_int8_single_slice_is_lossless_noop(self):
+        """ws_dcn=1 with int8 configured: the dcn hop is skipped
+        entirely — no quantization error may leak into single-slice
+        runs that keep the config flag set."""
+        mesh = make_mesh((1, 8), ("dcn", "ici"))
+        x = jnp.asarray(np.random.default_rng(5).standard_normal(
+            (1, 8, 33)), jnp.float32)
+        f = shard_jit(
+            lambda v: tc.hierarchical_allreduce(v, "ici", "dcn",
+                                                dcn_algorithm="int8",
+                                                use_pallas=False),
+            mesh, P("dcn", "ici"), P("dcn", "ici"))
+        want = np.broadcast_to(np.asarray(x).sum((0, 1)), x.shape)
+        np.testing.assert_allclose(np.asarray(f(x)), want,
+                                   rtol=1e-5, atol=1e-6)
+        assert "i8" not in f.lower(x).as_text()
+
+    def test_int8_rejects_non_sum(self):
+        mesh = make_mesh((2, 4), ("dcn", "ici"))
+        x = jnp.zeros((2, 4, 8), jnp.float32)
+        f = shard_jit(
+            lambda v: tc.hierarchical_allreduce(v, "ici", "dcn", op="min",
+                                                dcn_algorithm="int8",
+                                                use_pallas=False),
+            mesh, P("dcn", "ici"), P("dcn", "ici"))
+        with pytest.raises(ValueError, match="op='sum' only"):
+            f(x)
+
     def test_dcn_traffic_is_scattered_shard_only(self):
         """THE point of the hierarchy: the only collective on the dcn
         axis carries 1/ws_ici of the buffer, never the full payload."""

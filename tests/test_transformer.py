@@ -181,6 +181,52 @@ def test_train_step_two_tier_dp_vma_path():
                                    rtol=1e-4, atol=1e-5)
 
 
+def test_train_step_two_tier_int8_dcn_close_to_exact():
+    """Compressed (int8) DCN gradient sync: the step must stay within
+    quantization distance of the exact two-tier step — bounded, not
+    bit-identical (8-bit mantissas on the slow hop)."""
+    cfg = TransformerConfig(vocab=16, d_model=32, n_heads=2, n_layers=1,
+                            d_ff=64, dtype="float32")
+    p0 = init_params(jax.random.PRNGKey(11), cfg)
+    rng = np.random.default_rng(11)
+    tokens = jnp.asarray(rng.integers(0, cfg.vocab, (8, SEQ)), jnp.int32)
+    mesh = make_mesh((2, 4), ("dcn", "dp"))
+
+    def run(dcn_algorithm):
+        step = shard_jit(
+            lambda p, t: train_step(p, t, cfg, lr=0.1, dp_axis="dp",
+                                    dcn_axis="dcn",
+                                    dcn_algorithm=dcn_algorithm),
+            mesh, (P(), P(("dcn", "dp"))), (P(), P()), check_vma=False)
+        return step(p0, tokens)
+
+    exact_p, exact_loss = run("psum")
+    q_p, q_loss = run("int8")
+    np.testing.assert_allclose(float(q_loss), float(exact_loss),
+                               rtol=1e-5)  # loss precedes the sync
+    for a, b in zip(jax.tree.leaves(q_p), jax.tree.leaves(exact_p)):
+        a, b = np.asarray(a), np.asarray(b)
+        # params moved by lr*grad; quantization perturbs each grad by
+        # at most a half-step of its slice's amax/127 scale (~0.4%)
+        np.testing.assert_allclose(a, b, rtol=5e-3, atol=5e-4)
+
+
+def test_int8_dcn_rejected_on_vma_path():
+    """Under vma typing the AD-inserted AllReduce cannot be compressed;
+    a silently-ignored int8 request must refuse loudly instead."""
+    cfg = TransformerConfig(vocab=16, d_model=32, n_heads=2, n_layers=1,
+                            d_ff=64, dtype="float32")
+    p0 = init_params(jax.random.PRNGKey(12), cfg)
+    tokens = jnp.zeros((8, SEQ), jnp.int32)
+    mesh = make_mesh((2, 4), ("dcn", "dp"))
+    step = shard_jit(
+        lambda p, t: train_step(p, t, cfg, lr=0.1, dp_axis="dp",
+                                dcn_axis="dcn", dcn_algorithm="int8"),
+        mesh, (P(), P(("dcn", "dp"))), (P(), P()))  # check_vma=True
+    with pytest.raises(ValueError, match="check_vma=False"):
+        step(p0, tokens)
+
+
 def test_dcn_axis_requires_dp_axis():
     cfg = TransformerConfig(vocab=16, d_model=32, n_heads=2, n_layers=1,
                             d_ff=64, dtype="float32")
