@@ -155,11 +155,19 @@ def _paired_race(base, candidates, x0, *rest, k, iters=ITERS,
     return results, t_base_best
 
 
-def _chain_time(loop_fn, x0, *rest, k=CHAIN, iters=ITERS):
+def _chain_time(loop_fn, x0, *rest, k=CHAIN, iters=ITERS, stat="min"):
     """Single-contender measurement (suite.py / flash_bench.py /
-    pallas_sweep.py callers): calibrated chain length, best-of-reps
-    per-op seconds. Cross-contender comparisons should use
-    _paired_race so drift cancels in the ratio."""
+    pallas_sweep.py callers): calibrated chain length, per-op seconds.
+    Cross-contender comparisons should use _paired_race so drift
+    cancels in the ratio.
+
+    stat: 'min' (best-achievable; fine when the chain dwarfs the
+    dispatch floor) or 'median' — use median whenever the floor is a
+    sizable fraction of the chain: min() SELECTS the rep whose floor
+    estimate was most inflated (each rep subtracts its own t_empty, so
+    an overestimated floor yields an underestimated per-op time), which
+    is how a recorded MFU once exceeded the chip's physical peak
+    (train_bench batch-8, BENCH_extra round 4)."""
     k = _calibrate_chain(loop_fn, x0, *rest, k=k)
 
     def run(kk):
@@ -173,8 +181,19 @@ def _chain_time(loop_fn, x0, *rest, k=CHAIN, iters=ITERS):
         t0 = time.perf_counter()
         run(k)
         per_op = (time.perf_counter() - t0 - t_empty) / k
-        if per_op > 0:  # an empty-chain spike swallowed the rep
+        # min: drop floor-swallowed reps (a non-positive can't be the
+        # best-achievable). median: KEEP them — one-sided censoring
+        # before a median biases it, the same mistake paired_diff's
+        # docstring documents (benchmarks/decode_bench.py)
+        if stat == "median" or per_op > 0:
             ts.append(per_op)
+    if stat == "median":
+        med = float(np.median(ts))
+        if med <= 0:
+            raise RuntimeError(
+                "median repetition swallowed by dispatch noise — "
+                "lengthen the chain (k)")
+        return med
     if not ts:
         raise RuntimeError(
             "every repetition was swallowed by dispatch noise")

@@ -40,6 +40,18 @@ LEGS = [
     ("flash_gqa_compact_vs_repeated",
      [sys.executable, "benchmarks/flash_bench.py", "--seq", "4096",
       "--heads", "8", "--dim", "128", "--gqa", "2"], 2400),
+    # long-context decode: the cache (not the weights) is the HBM
+    # bound; the int8 cache halves its bytes (round 4). Batch 16 is
+    # the measured-win regime; the batch-32 case measured SLOWER with
+    # int8 (XLA materializes the dequant at that shape) — the capacity
+    # story (half the cache memory) holds regardless.
+    ("decode_longctx_b16_act",
+     [sys.executable, "benchmarks/decode_bench.py",
+      "--prompt-len", "1024", "--batch", "16"], 2400),
+    ("decode_longctx_b16_int8",
+     [sys.executable, "benchmarks/decode_bench.py",
+      "--prompt-len", "1024", "--batch", "16",
+      "--kv-dtype", "int8"], 2400),
 ]
 
 

@@ -39,10 +39,22 @@ def init_kv_cache(cfg: TransformerConfig, batch: int, max_len: int,
     GQA configs (n_kv_heads < n_heads) store only the K/V heads, the
     n_heads/kv_heads memory win that motivates GQA. Inside shard_map
     with ``tp_axis``, each shard allocates only its kv_heads/tp local
-    heads (matching apply_layer's column-parallel K/V projections)."""
+    heads (matching apply_layer's column-parallel K/V projections).
+
+    ``cfg.kv_cache_dtype='int8'``: entries are int8 with per-(batch,
+    position, head) f32 scale sidecars ``ks``/``vs`` — half the bf16
+    cache's bytes in HBM; the dequant folds into the attend's score /
+    probability tensors so the cache reads stay int8 on the wire."""
     ntp = lax.axis_size(tp_axis) if tp_axis is not None else 1
     assert cfg.kv_heads % ntp == 0
-    shape = (batch, max_len, cfg.kv_heads // ntp, cfg.head_dim)
+    kvh = cfg.kv_heads // ntp
+    shape = (batch, max_len, kvh, cfg.head_dim)
+    if cfg.kv_cache_dtype == "int8":
+        z = jnp.zeros(shape, jnp.int8)
+        s = jnp.zeros((batch, max_len, kvh), jnp.float32)
+        return [{"k": z, "v": z, "ks": s, "vs": s}
+                for _ in range(cfg.n_layers)]
+    assert cfg.kv_cache_dtype is None, cfg.kv_cache_dtype
     z = jnp.zeros(shape, cfg.act_dtype)
     return [{"k": z, "v": z} for _ in range(cfg.n_layers)]
 
@@ -55,7 +67,22 @@ def kv_cache_pspecs(cfg: TransformerConfig,
     in/out spec for shard_jit'd decode."""
     from jax.sharding import PartitionSpec as P
     spec = P(None, None, tp_axis, None)
+    if cfg.kv_cache_dtype == "int8":
+        sspec = P(None, None, tp_axis)
+        return [{"k": spec, "v": spec, "ks": sspec, "vs": sspec}
+                for _ in range(cfg.n_layers)]
     return [{"k": spec, "v": spec} for _ in range(cfg.n_layers)]
+
+
+def _quantize_kv(x):
+    """(..., head_dim) -> (int8 values, f32 scale over the last axis).
+    Symmetric per-(batch, position, head) quantization: scale =
+    amax/127, so dequant error is at most scale/2 per element."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1)
+    scale = jnp.maximum(amax, jnp.float32(1e-30)) / 127.0
+    q = jnp.round(xf / scale[..., None]).astype(jnp.int8)
+    return q, scale
 
 
 def _decode_cfg(cfg: TransformerConfig) -> TransformerConfig:
@@ -73,21 +100,38 @@ def _decode_cfg(cfg: TransformerConfig) -> TransformerConfig:
                                  float(cfg.n_experts)))
 
 
-def _attend_cache(q, k_cache, v_cache, pos, scale):
+def _attend_cache(q, k_cache, v_cache, pos, scale,
+                  k_scale=None, v_scale=None):
     """q (b, 1, H, hd) against the cache prefix [0, pos]: full-length
     matmul over the static cache, masked beyond the position. ``pos``
     is a scalar (all rows at the same position) or a (b,) vector
     (ragged decode: each row masks at its own position). The cache
     may hold fewer (grouped) K/V heads: each group of H/kv_heads
     query heads attends its shared K/V head directly — no repeat is
-    ever materialized."""
+    ever materialized.
+
+    Quantized caches (cfg.kv_cache_dtype='int8') pass per-(batch,
+    position, head) ``k_scale``/``v_scale`` (b, max_len, kv_heads):
+    the dequant is FOLDED into the score and probability tensors —
+    scores scale per key position, probabilities pre-multiply the
+    value scale — so the (b, max_len, kv, hd) cache operands enter
+    their matmuls as stored int8 and the big HBM reads stay 1
+    byte/element."""
     b, one, nh, hd = q.shape
     nkv = k_cache.shape[2]
     rep = nh // nkv
     qg = q.reshape(b, one, nkv, rep, hd)
-    s = jnp.einsum("bqgrd,bkgd->bgrqk", qg.astype(jnp.float32),
-                   k_cache.astype(jnp.float32),
+    # quantized caches matmul in bf16: int8 -> bf16 is LOSSLESS (every
+    # value in [-127, 127] is exactly representable) and keeps the
+    # cache-sized operand on the MXU's native bf16 path — the int8 ->
+    # f32 convert measured convert-bound at batch 32 on v5e.
+    cache_dt = jnp.float32 if k_scale is None else jnp.bfloat16
+    s = jnp.einsum("bqgrd,bkgd->bgrqk", qg.astype(cache_dt),
+                   k_cache.astype(cache_dt),
                    preferred_element_type=jnp.float32) * scale
+    s = s.astype(jnp.float32)
+    if k_scale is not None:  # fold dequant: per (b, k-position, g)
+        s = s * jnp.transpose(k_scale, (0, 2, 1))[:, :, None, None, :]
     posv = jnp.asarray(pos)
     if posv.ndim == 0:
         mask = jnp.arange(k_cache.shape[1]) <= posv      # (max_len,)
@@ -96,9 +140,12 @@ def _attend_cache(q, k_cache, v_cache, pos, scale):
         mask = jnp.arange(k_cache.shape[1]) <= posv[:, None]
         s = jnp.where(mask[:, None, None, None, :], s, _NEG)
     p = jax.nn.softmax(s, axis=-1)
-    out = jnp.einsum("bgrqk,bkgd->bqgrd", p,
-                     v_cache.astype(jnp.float32))
-    return out.reshape(b, one, nh, hd)
+    if v_scale is not None:  # fold dequant into the probabilities
+        p = p * jnp.transpose(v_scale, (0, 2, 1))[:, :, None, None, :]
+    out = jnp.einsum("bgrqk,bkgd->bqgrd", p.astype(cache_dt),
+                     v_cache.astype(cache_dt),
+                     preferred_element_type=jnp.float32)
+    return out.astype(jnp.float32).reshape(b, one, nh, hd)
 
 
 def decode_step(params: dict, token, pos, cache, cfg: TransformerConfig,
@@ -134,17 +181,37 @@ def decode_step(params: dict, token, pos, cache, cfg: TransformerConfig,
         def attend(q, k, v, lc=lc):
             # rope configs: q/k arrive rotated from apply_layer; keys
             # are cached rotated (standard RoPE decode)
+            quant = "ks" in lc
+            if quant:  # int8 cache: quantize the new entry at append
+                k, ks_new = _quantize_kv(k)
+                v, vs_new = _quantize_kv(v)
+                store_dt = jnp.int8
+            else:
+                store_dt = dt
             if ragged:
                 rows = jnp.arange(b)
-                kc = lc["k"].at[rows, posv].set(k[:, 0].astype(dt))
-                vc = lc["v"].at[rows, posv].set(v[:, 0].astype(dt))
+                kc = lc["k"].at[rows, posv].set(k[:, 0].astype(store_dt))
+                vc = lc["v"].at[rows, posv].set(v[:, 0].astype(store_dt))
             else:
-                kc = lax.dynamic_update_slice(lc["k"], k.astype(dt),
+                kc = lax.dynamic_update_slice(lc["k"], k.astype(store_dt),
                                               (0, pos, 0, 0))
-                vc = lax.dynamic_update_slice(lc["v"], v.astype(dt),
+                vc = lax.dynamic_update_slice(lc["v"], v.astype(store_dt),
                                               (0, pos, 0, 0))
-            new_cache.append({"k": kc, "v": vc})
-            return _attend_cache(q, kc, vc, posv, scale).astype(dt)
+            entry = {"k": kc, "v": vc}
+            ks = vs = None
+            if quant:
+                if ragged:
+                    ks = lc["ks"].at[rows, posv].set(ks_new[:, 0])
+                    vs = lc["vs"].at[rows, posv].set(vs_new[:, 0])
+                else:
+                    ks = lax.dynamic_update_slice(lc["ks"], ks_new,
+                                                  (0, pos, 0))
+                    vs = lax.dynamic_update_slice(lc["vs"], vs_new,
+                                                  (0, pos, 0))
+                entry.update(ks=ks, vs=vs)
+            new_cache.append(entry)
+            return _attend_cache(q, kc, vc, posv, scale,
+                                 k_scale=ks, v_scale=vs).astype(dt)
 
         x, _ = apply_layer(x, layer, cfg, attention=attend,
                            tp_axis=tp_axis, ep_axis=ep_axis,
@@ -183,8 +250,11 @@ def prefill(params: dict, tokens, cache, cfg: TransformerConfig,
     stashes each layer's COMPACT K/V block into the cache on the way
     through (rope keys are cached rotated, exactly like decode_step).
     Logits-parity with the one-token-at-a-time scan is pinned in
-    tests/test_generate.py; measured ~two orders of magnitude faster
-    at plen 1024 on the v5e chip (benchmarks/decode_bench.py --ttft).
+    tests/test_generate.py (exactly for plain caches; quantized
+    caches attend the DEQUANTIZED block — the same values decode
+    reads back — so the parity is within matmul association error,
+    not the quantization envelope); measured ~two orders of magnitude
+    faster at plen 1024 on the v5e chip (decode_bench.py --ttft).
     """
     b, plen = tokens.shape
     if last_index is not None:
@@ -195,11 +265,31 @@ def prefill(params: dict, tokens, cache, cfg: TransformerConfig,
     new_cache = []
     for layer, lc in zip(params["layers"], cache):
         def attend(q, k, v, lc=lc):
-            new_cache.append({
-                "k": lax.dynamic_update_slice(
-                    lc["k"], k.astype(dt), (0, 0, 0, 0)),
-                "v": lax.dynamic_update_slice(
-                    lc["v"], v.astype(dt), (0, 0, 0, 0))})
+            if "ks" in lc:  # int8 cache: quantize the whole block
+                qk, ks = _quantize_kv(k)
+                qv, vs = _quantize_kv(v)
+                new_cache.append({
+                    "k": lax.dynamic_update_slice(lc["k"], qk,
+                                                  (0, 0, 0, 0)),
+                    "v": lax.dynamic_update_slice(lc["v"], qv,
+                                                  (0, 0, 0, 0)),
+                    "ks": lax.dynamic_update_slice(lc["ks"], ks,
+                                                   (0, 0, 0)),
+                    "vs": lax.dynamic_update_slice(lc["vs"], vs,
+                                                   (0, 0, 0))})
+                # attend the DEQUANTIZED block: the prompt K/V the
+                # prefill logits see must be the values decode will
+                # read back from the cache, or the blockwise prefill
+                # and the decode-step scan diverge by the quantization
+                # envelope on quantized configs
+                k = (qk.astype(jnp.float32) * ks[..., None]).astype(dt)
+                v = (qv.astype(jnp.float32) * vs[..., None]).astype(dt)
+            else:
+                new_cache.append({
+                    "k": lax.dynamic_update_slice(
+                        lc["k"], k.astype(dt), (0, 0, 0, 0)),
+                    "v": lax.dynamic_update_slice(
+                        lc["v"], v.astype(dt), (0, 0, 0, 0))})
             from rlo_tpu.models.transformer import _local_attention
             return _local_attention(q, k, v).astype(dt)
 

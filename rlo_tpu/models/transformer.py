@@ -84,6 +84,16 @@ class TransformerConfig:
     # training length; rope_scale is the extension factor.
     rope_scaling: Optional[str] = None
     rope_scale: float = 1.0
+    # KV-cache storage dtype for generation (models.generate):
+    #   None   — cache in the activation dtype (exact decode)
+    #   'int8' — per-(position, head) symmetric quantization: HALF the
+    #            cache memory and HBM bytes of bf16, error one
+    #            quantization half-step per read. Primarily a CAPACITY
+    #            lever (2x the batch x context that fits); measured
+    #            +10% tok/s at batch 16 / plen 1024 on v5e and SLOWER
+    #            at batch 32 (XLA materializes the dequant at that
+    #            shape) — benchmarks/decode_bench.py --kv-dtype int8.
+    kv_cache_dtype: Optional[str] = None
     # rematerialize each layer in the backward pass (jax.checkpoint):
     # trades ~one extra forward of FLOPs for O(layers) less activation
     # HBM — the standard long-context memory lever

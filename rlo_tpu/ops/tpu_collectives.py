@@ -469,13 +469,13 @@ def _int8_gather_allreduce(x, axis: str):
     for gradient averaging is noise-level. Only valid for op='sum'
     (quantized min/max would be exact anyway and gain nothing).
 
-    Traffic honesty: an all-gather moves (ws-1)*n int8 bytes per
-    shard vs ~2*n*4*(ws-1)/ws for an f32 ring allreduce, i.e. a
-    ~8/(ws-1) * ws/(ws-1) ~ 8x win at ws=2 shrinking to parity around
-    ws~9 and a LOSS beyond — this schedule is for the few-slice
-    regime multi-slice deployments actually use; past that, keep
-    psum (or add a quantized reduce-scatter). hierarchical_allreduce
-    documents the same bound.
+    Traffic honesty: the all-gather moves (ws-1)*n int8 bytes per
+    shard vs 2*n*4*(ws-1)/ws for an f32 ring allreduce — ratio 8/ws:
+    a 4x win at ws=2 slices, shrinking to exact parity at ws=8 and a
+    LOSS beyond — this schedule is for the few-slice regime
+    multi-slice deployments actually use; past that, keep psum (or
+    add a quantized reduce-scatter). hierarchical_allreduce documents
+    the same bound.
     """
     orig_dtype = x.dtype
     xf = x.astype(jnp.float32)
@@ -518,10 +518,10 @@ def hierarchical_allreduce(x, ici_axis: str, dcn_axis: str, *,
     ``dcn_algorithm='psum'`` is the right default: XLA routes that
     AllReduce over DCN itself; the manual schedules remain selectable
     for parity studies and to host fused per-step compute.
-    ``dcn_algorithm='int8'`` compresses the DCN hop ~8x at 2 slices
-    (_int8_gather_allreduce; sum only, lossy within one quantization
-    half-step per slice; all-gather-based, so the win shrinks with
-    slice count and inverts past ~8 slices — see its docstring).
+    ``dcn_algorithm='int8'`` compresses the DCN hop 8/ws_dcn-fold
+    (4x at 2 slices, parity at 8, loss beyond — all-gather-based;
+    see _int8_gather_allreduce; sum only, lossy within one
+    quantization half-step per slice).
     """
     if dcn_algorithm == "int8" and op != "sum":
         raise ValueError("dcn_algorithm='int8' supports op='sum' only")

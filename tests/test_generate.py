@@ -310,3 +310,149 @@ def test_generate_with_long_cache_uses_blockwise_prefill(setup):
         nxt = logits.argmax(-1).astype(np.int32)
         seq = np.concatenate([seq, nxt[:, None]], axis=1)
     np.testing.assert_array_equal(got, seq[:, prompt.shape[1]:])
+
+
+class TestQuantizedKVCache:
+    """cfg.kv_cache_dtype='int8': half the cache bytes; per-(position,
+    head) symmetric quantization with the dequant folded into the
+    attend (models.generate._attend_cache)."""
+
+    def _cfg(self, **kw):
+        import dataclasses
+        return dataclasses.replace(CFG, kv_cache_dtype="int8", **kw)
+
+    def test_cache_layout_and_bytes(self):
+        cfg = self._cfg()
+        cache = init_kv_cache(cfg, 2, 16)
+        exact = init_kv_cache(CFG, 2, 16)
+        for lc in cache:
+            assert lc["k"].dtype == jnp.int8 and lc["v"].dtype == jnp.int8
+            assert lc["ks"].shape == (2, 16, CFG.n_heads)
+        q_bytes = sum(sum(a.nbytes for a in lc.values()) for lc in cache)
+        e_bytes = sum(sum(a.nbytes for a in lc.values()) for lc in exact)
+        # vs the f32 exact cache: (hd + 4)/(4*hd) — 0.375 at this toy
+        # head_dim of 8, ~0.27 at a real head_dim of 64+
+        hd = CFG.head_dim
+        assert q_bytes <= ((hd + 4) / (4 * hd) + 0.01) * e_bytes
+
+    def test_roundtrip_error_bound(self):
+        from rlo_tpu.models.generate import _quantize_kv
+        x = jnp.asarray(np.random.default_rng(0).standard_normal(
+            (2, 8, 4, 16)), jnp.float32)
+        q, s = _quantize_kv(x)
+        err = np.abs(np.asarray(q, np.float32) * np.asarray(s)[..., None]
+                     - np.asarray(x))
+        assert err.max() <= float(np.asarray(s).max()) * 0.5 + 1e-7
+
+    def test_decode_logits_close_to_exact(self, setup):
+        """Quantized decode vs exact decode: logits within the
+        quantization error envelope at every position."""
+        params, prompt = setup
+        cfg = self._cfg()
+        b, plen = prompt.shape
+        cache_q = init_kv_cache(cfg, b, plen)
+        cache_e = init_kv_cache(CFG, b, plen)
+        for pos in range(plen):
+            lq, cache_q = decode_step(params, prompt[:, pos], pos,
+                                      cache_q, cfg)
+            le, cache_e = decode_step(params, prompt[:, pos], pos,
+                                      cache_e, CFG)
+            scale = np.abs(np.asarray(le)).max() + 1.0
+            np.testing.assert_allclose(np.asarray(lq), np.asarray(le),
+                                       atol=0.05 * scale)
+
+    def test_generate_runs_and_is_jittable(self, setup):
+        params, prompt = setup
+        cfg = self._cfg()
+        f = jax.jit(lambda p, t: generate(p, t, cfg, max_new=6))
+        toks = np.asarray(f(params, prompt))
+        assert toks.shape == (2, 6)
+        assert (toks >= 0).all() and (toks < cfg.vocab).all()
+        # greedy tokens usually survive 8-bit cache error at this size
+        exact = np.asarray(generate(params, prompt, CFG, max_new=6))
+        assert (toks == exact).mean() >= 0.5
+
+    @pytest.mark.parametrize("variant", ["dense", "gqa_rope"])
+    def test_ragged_matches_per_row_dense_exactly(self, variant):
+        """Ragged and dense generate quantize the same K/V values at
+        the same points, so per-row parity is EXACT inside the
+        quantized world — the same oracle as the unquantized path."""
+        import dataclasses
+        cfg = self._cfg()
+        if variant == "gqa_rope":
+            cfg = dataclasses.replace(cfg, n_kv_heads=2,
+                                      pos_encoding="rope")
+        params = init_params(jax.random.PRNGKey(31), cfg)
+        rng = np.random.default_rng(32)
+        lengths = [3, 6, 2]
+        plen = max(lengths)
+        prompt = np.zeros((len(lengths), plen), np.int32)
+        for i, L in enumerate(lengths):
+            prompt[i, :L] = rng.integers(0, cfg.vocab, L)
+        max_new = 5
+        got = np.asarray(generate(
+            params, jnp.asarray(prompt), cfg, max_new=max_new,
+            max_len=plen + max_new,
+            prompt_lengths=jnp.asarray(lengths, jnp.int32)))
+        for i, L in enumerate(lengths):
+            want = np.asarray(generate(
+                params, jnp.asarray(prompt[i:i + 1, :L]), cfg,
+                max_new=max_new))
+            np.testing.assert_array_equal(got[i], want[0],
+                                          err_msg=f"row {i}")
+
+    def test_prefill_matches_scan_within_association_error(self):
+        """Blockwise prefill attends the DEQUANTIZED block (the values
+        decode reads back), so prefill and the decode-step scan agree
+        to matmul-association error — NOT the (much larger)
+        quantization envelope that an unquantized-attend prefill
+        would diverge by."""
+        from rlo_tpu.models.generate import prefill_scan
+        cfg = self._cfg()
+        params = init_params(jax.random.PRNGKey(35), cfg)
+        rng = np.random.default_rng(36)
+        prompt = jnp.asarray(rng.integers(0, cfg.vocab, (2, 8)),
+                             jnp.int32)
+        cache0 = init_kv_cache(cfg, 2, 12)
+        la, ca = prefill(params, prompt, cache0, cfg)
+        lb, cb = prefill_scan(params, prompt, cache0, cfg)
+        np.testing.assert_allclose(np.asarray(la), np.asarray(lb),
+                                   rtol=2e-3, atol=2e-3)
+        # layer 0 sees identical inputs -> identical quantized
+        # entries; deeper layers' inputs differ by the association
+        # error of the layer below, which can flip a near-tie
+        # round() by one step — allow exactly that
+        np.testing.assert_array_equal(np.asarray(ca[0]["k"]),
+                                      np.asarray(cb[0]["k"]))
+        np.testing.assert_allclose(np.asarray(ca[0]["ks"]),
+                                   np.asarray(cb[0]["ks"]), rtol=1e-6)
+        for xa, xb in zip(ca[1:], cb[1:]):
+            diff = np.abs(np.asarray(xa["k"], np.int32)
+                          - np.asarray(xb["k"], np.int32))
+            assert diff.max() <= 1
+
+    def test_tp_sharded_matches_single_device_exactly(self):
+        """tp shards whole K/V heads and quantization is per-head, so
+        sharded quantized decode equals single-device quantized decode
+        bit for bit."""
+        import dataclasses
+
+        from jax.sharding import PartitionSpec as P
+
+        from rlo_tpu.models.transformer import param_pspecs
+        from rlo_tpu.parallel.mesh import make_mesh, shard_jit
+
+        cfg = dataclasses.replace(CFG, kv_cache_dtype="int8",
+                                  n_kv_heads=2)
+        mesh = make_mesh((2,), ("tp",))
+        params = init_params(jax.random.PRNGKey(33), cfg)
+        rng = np.random.default_rng(34)
+        prompt = jnp.asarray(rng.integers(0, cfg.vocab, (2, 5)),
+                             jnp.int32)
+        specs = param_pspecs(cfg, "tp")
+        f = shard_jit(
+            lambda p, t: generate(p, t, cfg, max_new=6, tp_axis="tp"),
+            mesh, (specs, P()), P())
+        got = np.asarray(f(params, prompt))
+        want = np.asarray(generate(params, prompt, cfg, max_new=6))
+        np.testing.assert_array_equal(got, want)
