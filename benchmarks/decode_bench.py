@@ -128,7 +128,16 @@ def main():
                     help="KV-cache storage: activation dtype (exact) "
                          "or int8 (cfg.kv_cache_dtype='int8' — half "
                          "the cache HBM traffic)")
+    ap.add_argument("--compare-kv", action="store_true",
+                    help="measure act vs int8 cache decode in "
+                         "INTERLEAVED pairs (drift-immune ratio; two "
+                         "separate runs of this bench sit in "
+                         "different chip-throughput windows and their "
+                         "ratio is not trustworthy)")
     args = ap.parse_args()
+
+    if args.compare_kv:
+        return compare_kv(args)
 
     if args.ttft:
         return ttft(args)
@@ -211,6 +220,81 @@ def main():
     }))
 
 
+def compare_kv(args):
+    """act-vs-int8 cache decode ratio, drift-immune: each iteration
+    times all four programs (act/int8 x n1/n2) back-to-back, diffs
+    out the prefill+floor per variant, and takes the median of the
+    per-iteration RATIOS — chip-throughput window drift cancels
+    inside an iteration instead of landing between two separate
+    bench invocations."""
+    import dataclasses
+    if args.tiny:
+        cfg = TransformerConfig(vocab=128, d_model=64, n_heads=4,
+                                n_layers=2, d_ff=256, dtype="float32")
+        batch, n1, n2, plen = args.batch or 2, 4, 48, 16
+    else:
+        cfg = TransformerConfig(vocab=32768, d_model=1024, n_heads=16,
+                                n_layers=8, d_ff=4096,
+                                dtype="bfloat16")
+        batch, n1, n2 = args.batch or 32, 64, 192
+        plen = args.prompt_len if args.prompt_len > 16 else 1024
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    n_params = sum(int(np.prod(p.shape))
+                   for p in jax.tree.leaves(params))
+    rng = np.random.default_rng(0)
+    prompt = jnp.asarray(rng.integers(0, cfg.vocab, (batch, plen)),
+                         jnp.int32)
+    max_len = plen + n2
+
+    def build(kv_dtype, max_new):
+        c = (dataclasses.replace(cfg, kv_cache_dtype="int8")
+             if kv_dtype == "int8" else cfg)
+        f = jax.jit(lambda p, t: generate(p, t, c, max_new=max_new,
+                                          max_len=max_len))
+        np.asarray(f(params, prompt))  # compile + warm
+        return lambda: np.asarray(f(params, prompt))
+
+    runs = {(kv, n): build(kv, n) for kv in ("act", "int8")
+            for n in (n1, n2)}
+    for f in runs.values():
+        f()  # second warm pass after all four are compiled
+    ratios, d_acts, d_ints = [], [], []
+    for _ in range(9):
+        t = {}
+        for key, f in runs.items():
+            t0 = time.perf_counter()
+            f()
+            t[key] = time.perf_counter() - t0
+        d_act = t[("act", n2)] - t[("act", n1)]
+        d_int = t[("int8", n2)] - t[("int8", n1)]
+        if d_act > 0 and d_int > 0:
+            ratios.append(d_act / d_int)
+            d_acts.append(d_act)
+            d_ints.append(d_int)
+    if len(ratios) < 5:
+        raise RuntimeError("compare-kv: too few valid iterations")
+    ratio = float(np.median(ratios))
+    tok_act = (n2 - n1) * batch / float(np.median(d_acts))
+    tok_int = (n2 - n1) * batch / float(np.median(d_ints))
+    on_tpu = jax.default_backend() == "tpu"
+    print(f"compare-kv batch={batch} plen={plen}: act "
+          f"{tok_act:,.0f} tok/s  int8 {tok_int:,.0f} tok/s  "
+          f"interleaved speedup {ratio:.3f}x "
+          f"({len(ratios)}/9 valid iterations)", file=sys.stderr)
+    print(json.dumps({
+        "metric": f"int8-vs-act KV cache decode speedup, "
+                  f"{n_params/1e6:.0f}M params, "
+                  f"batch {batch}, prompt {plen}, "
+                  f"{'bf16 v5e chip' if on_tpu else jax.default_backend()}"
+                  f" (interleaved paired ratio)",
+        "value": round(ratio, 4),
+        "unit": "x",
+        "vs_baseline": round(ratio, 4),
+        "vs_baseline_meaning": "decode-step time ratio act/int8; "
+                               ">1 means the int8 cache is faster",
+    }))
+
+
 def ttft(args):
     if args.tiny:
         cfg = TransformerConfig(vocab=128, d_model=64, n_heads=4,
@@ -263,7 +347,6 @@ def ttft(args):
     t_gen_op = bench._chain_time(
         lambda pr, kk: gen_chain(params, pr, kk), prompt_hi, k=4,
         stat="median")
-    spread_b = float("nan")  # chained: spread is bench.py's concern
 
     # scan-prefill baseline: one token of scan prefill IS one decode
     # step (same decode_step, same cache attend), so the baseline is

@@ -2,12 +2,15 @@
 
 The training side (models.transformer) recomputes full attention every
 step; generation wants O(1) work per new token: each layer's keys and
-values are cached at (batch, max_len, kv_heads, head_dim) — kv_heads <
-n_heads for GQA configs — and a decode step attends the single new
-query against the cache prefix (grouped, never repeated). Shapes stay
-STATIC (the cache is allocated at max_len up front and masked by the
-traced position) so the whole generate loop is one `lax.scan` inside
-one jit — XLA-friendly control flow, no per-token retrace.
+values are cached HEAD-LEADING at (batch, kv_heads, max_len, head_dim)
+— kv_heads < n_heads for GQA configs, and the (max_len, head_dim)
+trailing dims are the Mosaic-native tiling the flash-decode kernel
+(rlo_tpu.pallas.decode) requires — and a decode step attends the
+single new query against the cache prefix (grouped, never repeated).
+Shapes stay STATIC (the cache is allocated at max_len up front and
+masked by the traced position) so the whole generate loop is one
+`lax.scan` inside one jit — XLA-friendly control flow, no per-token
+retrace.
 
 Scope: dense and MoE decode, single-device or tensor-parallel
 (decode_step/generate take tp_axis inside shard_map: sharded params
@@ -35,23 +38,27 @@ from rlo_tpu.ops.ring_attention import _NEG
 def init_kv_cache(cfg: TransformerConfig, batch: int, max_len: int,
                   tp_axis: Optional[str] = None):
     """Zeroed per-layer K/V cache: a list of {"k","v"} arrays shaped
-    (batch, max_len, kv_heads, head_dim) in the activation dtype —
-    GQA configs (n_kv_heads < n_heads) store only the K/V heads, the
+    (batch, kv_heads, max_len, head_dim) in the activation dtype —
+    HEAD-LEADING, the same (…, sublane, lane)-friendly convention as
+    the flash kernels: the (max_len, head_dim) trailing dims tile
+    natively in Mosaic, which the flash-decode kernel
+    (rlo_tpu.pallas.decode) requires for its cache blocks. GQA
+    configs (n_kv_heads < n_heads) store only the K/V heads, the
     n_heads/kv_heads memory win that motivates GQA. Inside shard_map
     with ``tp_axis``, each shard allocates only its kv_heads/tp local
     heads (matching apply_layer's column-parallel K/V projections).
 
     ``cfg.kv_cache_dtype='int8'``: entries are int8 with per-(batch,
-    position, head) f32 scale sidecars ``ks``/``vs`` — half the bf16
+    head, position) f32 scale sidecars ``ks``/``vs`` — half the bf16
     cache's bytes in HBM; the dequant folds into the attend's score /
     probability tensors so the cache reads stay int8 on the wire."""
     ntp = lax.axis_size(tp_axis) if tp_axis is not None else 1
     assert cfg.kv_heads % ntp == 0
     kvh = cfg.kv_heads // ntp
-    shape = (batch, max_len, kvh, cfg.head_dim)
+    shape = (batch, kvh, max_len, cfg.head_dim)
     if cfg.kv_cache_dtype == "int8":
         z = jnp.zeros(shape, jnp.int8)
-        s = jnp.zeros((batch, max_len, kvh), jnp.float32)
+        s = jnp.zeros((batch, kvh, max_len), jnp.float32)
         return [{"k": z, "v": z, "ks": s, "vs": s}
                 for _ in range(cfg.n_layers)]
     assert cfg.kv_cache_dtype is None, cfg.kv_cache_dtype
@@ -66,9 +73,9 @@ def kv_cache_pspecs(cfg: TransformerConfig,
     param_pspecs); batch/positions replicated. Pass as the cache
     in/out spec for shard_jit'd decode."""
     from jax.sharding import PartitionSpec as P
-    spec = P(None, None, tp_axis, None)
+    spec = P(None, tp_axis, None, None)
     if cfg.kv_cache_dtype == "int8":
-        sspec = P(None, None, tp_axis)
+        sspec = P(None, tp_axis, None)
         return [{"k": spec, "v": spec, "ks": sspec, "vs": sspec}
                 for _ in range(cfg.n_layers)]
     return [{"k": spec, "v": spec} for _ in range(cfg.n_layers)]
@@ -101,7 +108,7 @@ def _decode_cfg(cfg: TransformerConfig) -> TransformerConfig:
 
 
 def _attend_cache(q, k_cache, v_cache, pos, scale,
-                  k_scale=None, v_scale=None):
+                  k_scale=None, v_scale=None, use_flash=None):
     """q (b, 1, H, hd) against the cache prefix [0, pos]: full-length
     matmul over the static cache, masked beyond the position. ``pos``
     is a scalar (all rows at the same position) or a (b,) vector
@@ -111,38 +118,54 @@ def _attend_cache(q, k_cache, v_cache, pos, scale,
     ever materialized.
 
     Quantized caches (cfg.kv_cache_dtype='int8') pass per-(batch,
-    position, head) ``k_scale``/``v_scale`` (b, max_len, kv_heads):
+    head, position) ``k_scale``/``v_scale`` (b, kv_heads, max_len):
     the dequant is FOLDED into the score and probability tensors —
     scores scale per key position, probabilities pre-multiply the
-    value scale — so the (b, max_len, kv, hd) cache operands enter
+    value scale — so the (b, kv, max_len, hd) cache operands enter
     their matmuls as stored int8 and the big HBM reads stay 1
     byte/element."""
     b, one, nh, hd = q.shape
-    nkv = k_cache.shape[2]
+    nkv, max_len = k_cache.shape[1], k_cache.shape[2]
+    if use_flash is None:
+        from rlo_tpu.pallas.decode import can_flash_decode
+        use_flash = (jax.default_backend() == "tpu"
+                     and can_flash_decode(max_len, hd))
+    if use_flash:
+        # fused decode attention: cache tiles stream through VMEM
+        # (int8 tiles dequantize there — the einsum path measured XLA
+        # materializing the dequant at batch 32), online softmax, one
+        # pass — rlo_tpu.pallas.decode
+        from rlo_tpu.pallas.decode import flash_decode
+        return flash_decode(q, k_cache, v_cache, pos, scale,
+                            k_scale, v_scale)
     rep = nh // nkv
     qg = q.reshape(b, one, nkv, rep, hd)
-    # quantized caches matmul in bf16: int8 -> bf16 is LOSSLESS (every
-    # value in [-127, 127] is exactly representable) and keeps the
-    # cache-sized operand on the MXU's native bf16 path — the int8 ->
-    # f32 convert measured convert-bound at batch 32 on v5e.
-    cache_dt = jnp.float32 if k_scale is None else jnp.bfloat16
-    s = jnp.einsum("bqgrd,bkgd->bgrqk", qg.astype(cache_dt),
+    # quantized caches matmul in bf16 ON TPU: int8 -> bf16 is LOSSLESS
+    # (every value in [-127, 127] is exactly representable) and keeps
+    # the cache-sized operand on the MXU's native bf16 path — the
+    # int8 -> f32 convert measured convert-bound at batch 32 on v5e.
+    # (CPU keeps f32: its runtime has no bf16 dot, and exactness of
+    # the sharded-vs-single parities wants the widest dtype anyway.)
+    cache_dt = jnp.bfloat16 if (k_scale is not None and
+                                jax.default_backend() == "tpu") \
+        else jnp.float32
+    s = jnp.einsum("bqgrd,bgkd->bgrqk", qg.astype(cache_dt),
                    k_cache.astype(cache_dt),
                    preferred_element_type=jnp.float32) * scale
     s = s.astype(jnp.float32)
-    if k_scale is not None:  # fold dequant: per (b, k-position, g)
-        s = s * jnp.transpose(k_scale, (0, 2, 1))[:, :, None, None, :]
+    if k_scale is not None:  # fold dequant: per (b, g, k-position)
+        s = s * k_scale[:, :, None, None, :]
     posv = jnp.asarray(pos)
     if posv.ndim == 0:
-        mask = jnp.arange(k_cache.shape[1]) <= posv      # (max_len,)
+        mask = jnp.arange(max_len) <= posv               # (max_len,)
         s = jnp.where(mask[None, None, None, None, :], s, _NEG)
     else:  # per-row positions
-        mask = jnp.arange(k_cache.shape[1]) <= posv[:, None]
+        mask = jnp.arange(max_len) <= posv[:, None]
         s = jnp.where(mask[:, None, None, None, :], s, _NEG)
     p = jax.nn.softmax(s, axis=-1)
     if v_scale is not None:  # fold dequant into the probabilities
-        p = p * jnp.transpose(v_scale, (0, 2, 1))[:, :, None, None, :]
-    out = jnp.einsum("bgrqk,bkgd->bqgrd", p.astype(cache_dt),
+        p = p * v_scale[:, :, None, None, :]
+    out = jnp.einsum("bgrqk,bgkd->bqgrd", p.astype(cache_dt),
                      v_cache.astype(cache_dt),
                      preferred_element_type=jnp.float32)
     return out.astype(jnp.float32).reshape(b, one, nh, hd)
@@ -180,34 +203,43 @@ def decode_step(params: dict, token, pos, cache, cfg: TransformerConfig,
     for layer, lc in zip(params["layers"], cache):
         def attend(q, k, v, lc=lc):
             # rope configs: q/k arrive rotated from apply_layer; keys
-            # are cached rotated (standard RoPE decode)
+            # are cached rotated (standard RoPE decode). k/v arrive
+            # (b, 1, kvh, hd); the cache is head-leading — transpose
+            # the new entry to (b, kvh, hd) rows
             quant = "ks" in lc
+            k_row, v_row = k[:, 0], v[:, 0]          # (b, kvh, hd)
             if quant:  # int8 cache: quantize the new entry at append
-                k, ks_new = _quantize_kv(k)
-                v, vs_new = _quantize_kv(v)
+                k_row, ks_new = _quantize_kv(k_row)
+                v_row, vs_new = _quantize_kv(v_row)
                 store_dt = jnp.int8
             else:
                 store_dt = dt
+            rows = jnp.arange(b)
+            heads = jnp.arange(lc["k"].shape[1])
             if ragged:
-                rows = jnp.arange(b)
-                kc = lc["k"].at[rows, posv].set(k[:, 0].astype(store_dt))
-                vc = lc["v"].at[rows, posv].set(v[:, 0].astype(store_dt))
+                idx = (rows[:, None], heads[None, :], posv[:, None])
+                kc = lc["k"].at[idx].set(k_row.astype(store_dt))
+                vc = lc["v"].at[idx].set(v_row.astype(store_dt))
             else:
-                kc = lax.dynamic_update_slice(lc["k"], k.astype(store_dt),
-                                              (0, pos, 0, 0))
-                vc = lax.dynamic_update_slice(lc["v"], v.astype(store_dt),
-                                              (0, pos, 0, 0))
+                kc = lax.dynamic_update_slice(
+                    lc["k"], k_row[:, :, None].astype(store_dt),
+                    (0, 0, pos, 0))
+                vc = lax.dynamic_update_slice(
+                    lc["v"], v_row[:, :, None].astype(store_dt),
+                    (0, 0, pos, 0))
             entry = {"k": kc, "v": vc}
             ks = vs = None
             if quant:
                 if ragged:
-                    ks = lc["ks"].at[rows, posv].set(ks_new[:, 0])
-                    vs = lc["vs"].at[rows, posv].set(vs_new[:, 0])
+                    sidx = (rows[:, None], heads[None, :],
+                            posv[:, None])
+                    ks = lc["ks"].at[sidx].set(ks_new)
+                    vs = lc["vs"].at[sidx].set(vs_new)
                 else:
-                    ks = lax.dynamic_update_slice(lc["ks"], ks_new,
-                                                  (0, pos, 0))
-                    vs = lax.dynamic_update_slice(lc["vs"], vs_new,
-                                                  (0, pos, 0))
+                    ks = lax.dynamic_update_slice(
+                        lc["ks"], ks_new[:, :, None], (0, 0, pos))
+                    vs = lax.dynamic_update_slice(
+                        lc["vs"], vs_new[:, :, None], (0, 0, pos))
                 entry.update(ks=ks, vs=vs)
             new_cache.append(entry)
             return _attend_cache(q, kc, vc, posv, scale,
@@ -265,9 +297,12 @@ def prefill(params: dict, tokens, cache, cfg: TransformerConfig,
     new_cache = []
     for layer, lc in zip(params["layers"], cache):
         def attend(q, k, v, lc=lc):
+            # k/v arrive (b, plen, kvh, hd); the cache is head-leading
+            kt = k.transpose(0, 2, 1, 3)             # (b, kvh, plen, hd)
+            vt = v.transpose(0, 2, 1, 3)
             if "ks" in lc:  # int8 cache: quantize the whole block
-                qk, ks = _quantize_kv(k)
-                qv, vs = _quantize_kv(v)
+                qk, ks = _quantize_kv(kt)
+                qv, vs = _quantize_kv(vt)
                 new_cache.append({
                     "k": lax.dynamic_update_slice(lc["k"], qk,
                                                   (0, 0, 0, 0)),
@@ -282,14 +317,16 @@ def prefill(params: dict, tokens, cache, cfg: TransformerConfig,
                 # read back from the cache, or the blockwise prefill
                 # and the decode-step scan diverge by the quantization
                 # envelope on quantized configs
-                k = (qk.astype(jnp.float32) * ks[..., None]).astype(dt)
-                v = (qv.astype(jnp.float32) * vs[..., None]).astype(dt)
+                k = (qk.astype(jnp.float32) * ks[..., None]) \
+                    .transpose(0, 2, 1, 3).astype(dt)
+                v = (qv.astype(jnp.float32) * vs[..., None]) \
+                    .transpose(0, 2, 1, 3).astype(dt)
             else:
                 new_cache.append({
                     "k": lax.dynamic_update_slice(
-                        lc["k"], k.astype(dt), (0, 0, 0, 0)),
+                        lc["k"], kt.astype(dt), (0, 0, 0, 0)),
                     "v": lax.dynamic_update_slice(
-                        lc["v"], v.astype(dt), (0, 0, 0, 0))})
+                        lc["v"], vt.astype(dt), (0, 0, 0, 0))})
             from rlo_tpu.models.transformer import _local_attention
             return _local_attention(q, k, v).astype(dt)
 

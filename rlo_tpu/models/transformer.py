@@ -88,11 +88,12 @@ class TransformerConfig:
     #   None   — cache in the activation dtype (exact decode)
     #   'int8' — per-(position, head) symmetric quantization: HALF the
     #            cache memory and HBM bytes of bf16, error one
-    #            quantization half-step per read. Primarily a CAPACITY
-    #            lever (2x the batch x context that fits); measured
-    #            +10% tok/s at batch 16 / plen 1024 on v5e and SLOWER
-    #            at batch 32 (XLA materializes the dequant at that
-    #            shape) — benchmarks/decode_bench.py --kv-dtype int8.
+    #            quantization half-step per read. With the flash-decode
+    #            kernel (pallas/decode.py) dequantizing tiles in VMEM,
+    #            measured 1.43x decode tok/s at batch 32 / plen 1024
+    #            on v5e (interleaved paired ratio,
+    #            benchmarks/decode_bench.py --compare-kv); also 2x the
+    #            servable batch x context per chip.
     kv_cache_dtype: Optional[str] = None
     # rematerialize each layer in the backward pass (jax.checkpoint):
     # trades ~one extra forward of FLOPs for O(layers) less activation

@@ -1,0 +1,212 @@
+"""Pallas flash-decode kernel: one query against the KV cache.
+
+Decode attention is the other half of the serving HBM story: each step
+reads the whole live cache prefix, and the XLA einsum path
+(models.generate._attend_cache) was measured 2-4x off the
+weight+cache streaming bound at batch 32 / plen 1024 on v5e — and,
+worse, de-optimized the int8 cache (XLA materializes the dequantized
+cache as an f32/bf16 scratch buffer at that shape, paying MORE HBM
+traffic than it saves; benchmarks/decode_bench.py, BENCH_extra
+`decode_longctx_*`). This kernel streams cache tiles through VMEM
+with the online-softmax accumulator — the flash pattern of
+rlo_tpu.pallas.flash specialized to a single query row — and
+dequantizes int8 tiles in VMEM, so the cache's HBM traffic is its
+stored bytes, exactly.
+
+The work per position is tiny (a (r, d) x (d, BK) dot), so the grid
+must be coarse or per-program launch overhead dominates — the first
+cut ran one program per (batch, kv-head, tile) and measured 2x SLOWER
+than the einsum at batch 32 (12k programs/step of ~100 ns of useful
+bandwidth each). The shipped grid is (batch, cache-tiles) with ALL kv
+heads resident per program (a batched dot over the head axis), two
+orders of magnitude fewer launches, each streaming kvh*BK*d cache
+bytes.
+
+Shapes (GQA-grouped, head-leading like the rest of the pallas
+package — models.generate stores the cache this way so the kernel's
+(max_len, head_dim) trailing dims tile natively in Mosaic):
+  q        (b, kv_heads, r, head_dim)   r = n_heads / kv_heads
+  k/v      (b, kv_heads, max_len, head_dim)  act dtype or int8
+  ks/vs    (b, kv_heads, max_len) f32 scales (int8 caches only)
+  pos      (b, 1) int32 — every row masks its own prefix [0, pos_b]
+  out      (b, kv_heads, r, head_dim) f32
+
+Dots run in bf16 with f32 accumulation (int8 -> bf16 is lossless;
+f32 caches keep f32 dots — their tiles are smaller than VMEM allows
+anyway). The cache axis is innermost and sequential ('arbitrary'),
+accumulating (m, l, o) in VMEM scratch; the padded tail block past
+max_len is masked (and V zeroed under the mask, so out-of-range
+garbage can never ride a 0*NaN into the accumulator).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from rlo_tpu.pallas.reduce import out_struct
+
+try:  # pltpu only imports on TPU-enabled builds
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+_NEG = -1e30
+
+#: cache-axis tile width; ceil-divides max_len (padded tail is masked)
+_BLOCK_K = 512
+
+
+def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, *rest, scale: float,
+                   n_k: int, bk: int, max_len: int, quant: bool):
+    if quant:
+        ks_ref, vs_ref, o_ref, m_s, l_s, o_s = rest
+    else:
+        o_ref, m_s, l_s, o_s = rest
+    ib = pl.program_id(0)
+    ik = pl.program_id(1)
+
+    @pl.when(ik == 0)
+    def _init():
+        m_s[...] = jnp.full_like(m_s[...], _NEG)
+        l_s[...] = jnp.zeros_like(l_s[...])
+        o_s[...] = jnp.zeros_like(o_s[...])
+
+    # dots in bf16 (f32 accumulate): int8 -> bf16 is lossless, bf16 is
+    # the MXU-native width, and an f32 cast would materialize 4x the
+    # tile bytes in VMEM. f32 caches keep f32 (exactness; their tiles
+    # fit). g = kvh heads batched per program.
+    dot_dt = jnp.float32 if k_ref.dtype == jnp.float32 else jnp.bfloat16
+    q = q_ref[0].astype(dot_dt)                      # (g, r, d)
+    k = k_ref[0].astype(dot_dt)                      # (g, BK, d)
+    v = v_ref[0].astype(dot_dt)                      # (g, BK, d)
+    pos = pos_ref[ib, 0]
+    # masks built >=2-D from iota: Mosaic cannot insert a minor dim on
+    # sub-32-bit (bool) values, so never reshape a 1-D mask
+    base = ik * bk
+    row = base + jax.lax.broadcasted_iota(jnp.int32, (1, 1, bk), 2)
+    col = base + jax.lax.broadcasted_iota(jnp.int32, (1, bk, 1), 1)
+    mask_row = (row <= pos) & (row < max_len)        # (1, 1, BK)
+    mask_col = (col <= pos) & (col < max_len)        # (1, BK, 1)
+
+    # batched over the head axis: ((contract d), (batch g))
+    s = jax.lax.dot_general(q, k, (((2,), (2,)), ((0,), (0,))),
+                            preferred_element_type=jnp.float32) * scale
+    if quant:
+        s = s * ks_ref[0]                            # (g, 1, BK)
+    s = jnp.where(mask_row, s, _NEG)                 # (g, r, BK)
+    # zero V under the mask: a padded tail tile may hold uninitialized
+    # VMEM, and 0 * NaN would poison the accumulator
+    v = jnp.where(mask_col, v, jnp.zeros((), dot_dt))
+
+    m = m_s[...]                                     # (g, r)
+    m_new = jnp.maximum(m, s.max(axis=-1))
+    p = jnp.where(mask_row, jnp.exp(s - m_new[..., None]), 0.0)
+    corr = jnp.exp(m - m_new)
+    m_s[...] = m_new
+    l_s[...] = l_s[...] * corr + p.sum(axis=-1)
+    # fold the v dequant into the probabilities (f32, no relayout of
+    # v) — AFTER the l accumulation (the softmax denominator must sum
+    # the unscaled probabilities) and re-masked: the padded tail's vs
+    # tile is uninitialized VMEM and p's zeros would ride 0*NaN into
+    # the accumulator, the same hazard v is zeroed for above
+    pv = jnp.where(mask_row, p * vs_ref[0], 0.0) if quant else p
+    o_s[...] = o_s[...] * corr[..., None] + jax.lax.dot_general(
+        pv.astype(dot_dt), v, (((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32)
+
+    @pl.when(ik == n_k - 1)
+    def _flush():
+        o_ref[0] = o_s[...] / l_s[...][..., None]
+
+
+def can_flash_decode(max_len: int, head_dim: int,
+                     block_k: int = _BLOCK_K) -> bool:
+    """Shape gate: a lane-friendly head_dim, and a cache tile Mosaic
+    accepts — bk a multiple of 128 (bk ceil-divides max_len; the
+    padded tail is masked) or the whole axis in one tile."""
+    if max_len < 1 or not (head_dim % 128 == 0 or head_dim == 64):
+        return False
+    bk = min(block_k, max_len)
+    return bk == max_len or bk % 128 == 0
+
+
+def flash_decode(q, k_cache, v_cache, pos, scale, k_scale=None,
+                 v_scale=None, *, block_k: int = _BLOCK_K,
+                 interpret: Optional[bool] = None):
+    """Fused decode attention. ``q`` is (b, 1, n_heads, head_dim) (the
+    _attend_cache caller layout); caches head-leading as in
+    models.generate. ``pos`` scalar or (b,). Returns
+    (b, 1, n_heads, head_dim) f32."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    b, one, nh, d = q.shape
+    assert one == 1
+    nkv, L = k_cache.shape[1], k_cache.shape[2]
+    r = nh // nkv
+    quant = k_scale is not None
+    bk = min(block_k, max(L, 1))
+    # VMEM guard: two (kvh, bk, d) tiles in the dot dtype + the f32
+    # probability/score tensors must fit the ~16 MB budget
+    itemsize = 4 if k_cache.dtype == jnp.float32 else 2
+    while bk > 128 and (2 * nkv * bk * d * itemsize
+                        + 2 * nkv * r * bk * 4) > (10 << 20):
+        bk //= 2
+    n_k = -(-L // bk)
+
+    qg = q.reshape(b, nkv, r, d)
+    posv = jnp.asarray(pos, jnp.int32)
+    posv = (jnp.full((b, 1), posv) if posv.ndim == 0
+            else posv.reshape(b, 1))
+    # inside shard_map (vma typing) every kernel operand must carry
+    # the same varying-axes set: a replicated pos rides along with the
+    # tp-sharded q/cache
+    from rlo_tpu.parallel.mesh import vary_like
+    posv = vary_like(posv, q)
+    posv = vary_like(posv, k_cache)
+
+    # pos: whole-array block (block dims == array dims is always legal)
+    pos_spec = pl.BlockSpec((b, 1), lambda ib, ik: (0, 0))
+    q_spec = pl.BlockSpec((1, nkv, r, d), lambda ib, ik: (ib, 0, 0, 0))
+    kv_spec = pl.BlockSpec((1, nkv, bk, d),
+                           lambda ib, ik: (ib, 0, ik, 0))
+    o_spec = q_spec
+    in_specs = [pos_spec, q_spec, kv_spec, kv_spec]
+    args = [posv, qg, k_cache, v_cache]
+    if quant:
+        # scales reshaped (b, kvh, 1, L): the (1, bk) trailing block
+        # dims satisfy Mosaic's tiling rule for any bk multiple of 128
+        s_spec = pl.BlockSpec((1, nkv, 1, bk),
+                              lambda ib, ik: (ib, 0, 0, ik))
+        in_specs += [s_spec, s_spec]
+        args += [k_scale[:, :, None, :], v_scale[:, :, None, :]]
+
+    kwargs = {}
+    if not interpret and pltpu is not None:
+        kwargs["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"))
+    if pltpu is not None:
+        scratch = [pltpu.VMEM((nkv, r), jnp.float32),
+                   pltpu.VMEM((nkv, r), jnp.float32),
+                   pltpu.VMEM((nkv, r, d), jnp.float32)]
+    else:  # pragma: no cover — interpret-only builds without pltpu
+        scratch = [jax.ShapeDtypeStruct((nkv, r), jnp.float32),
+                   jax.ShapeDtypeStruct((nkv, r), jnp.float32),
+                   jax.ShapeDtypeStruct((nkv, r, d), jnp.float32)]
+
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel, scale=float(scale), n_k=n_k,
+                          bk=bk, max_len=L, quant=quant),
+        grid=(b, n_k),
+        in_specs=in_specs,
+        out_specs=o_spec,
+        out_shape=out_struct((b, nkv, r, d), jnp.float32, q, k_cache),
+        scratch_shapes=scratch,
+        interpret=interpret,
+        **kwargs,
+    )(*args)
+    return out.reshape(b, 1, nh, d)

@@ -1,0 +1,158 @@
+"""Pallas flash-decode kernel vs the einsum cache-attend oracle
+(rlo_tpu.pallas.decode vs models.generate._attend_cache).
+
+The kernel is exact-class against the f32 oracle (same masking, online
+softmax changes only association order); for int8 caches it is MORE
+precise than the einsum path (f32 accumulation vs the bf16 matmul), so
+the quantized comparison targets the dequantized-f32 reference.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rlo_tpu.models.generate import _attend_cache, _quantize_kv
+from rlo_tpu.pallas.decode import can_flash_decode, flash_decode
+
+B, NH, NKV, D, L = 3, 8, 4, 64, 48
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((B, 1, NH, D)), jnp.float32)
+    kc = jnp.asarray(rng.standard_normal((B, NKV, L, D)), jnp.float32)
+    vc = jnp.asarray(rng.standard_normal((B, NKV, L, D)), jnp.float32)
+    return q, kc, vc, 1.0 / np.sqrt(D)
+
+
+def _oracle(q, kc, vc, pos, scale, ks=None, vs=None):
+    return np.asarray(_attend_cache(q, kc, vc, pos, scale, k_scale=ks,
+                                    v_scale=vs, use_flash=False))
+
+
+@pytest.mark.parametrize("pos", [0, 7, L - 1])
+def test_scalar_pos_matches_oracle(data, pos):
+    q, kc, vc, scale = data
+    got = np.asarray(flash_decode(q, kc, vc, pos, scale,
+                                  interpret=True, block_k=16))
+    np.testing.assert_allclose(got, _oracle(q, kc, vc, pos, scale),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_padded_tail_block(data):
+    """block_k that does not divide max_len: the tail tile is padded
+    and masked; garbage beyond max_len must not reach the output."""
+    q, kc, vc, scale = data
+    got = np.asarray(flash_decode(q, kc, vc, 40, scale,
+                                  interpret=True, block_k=32))
+    np.testing.assert_allclose(got, _oracle(q, kc, vc, 40, scale),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ragged_per_row_positions(data):
+    q, kc, vc, scale = data
+    posv = jnp.asarray([3, L - 1, 11], jnp.int32)
+    got = np.asarray(flash_decode(q, kc, vc, posv, scale,
+                                  interpret=True, block_k=16))
+    np.testing.assert_allclose(got, _oracle(q, kc, vc, posv, scale),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_mha_no_grouping(data):
+    """nkv == nh (r = 1): the degenerate group size."""
+    q, _, _, scale = data
+    rng = np.random.default_rng(1)
+    kc = jnp.asarray(rng.standard_normal((B, NH, L, D)), jnp.float32)
+    vc = jnp.asarray(rng.standard_normal((B, NH, L, D)), jnp.float32)
+    got = np.asarray(flash_decode(q, kc, vc, 20, scale,
+                                  interpret=True, block_k=16))
+    np.testing.assert_allclose(got, _oracle(q, kc, vc, 20, scale),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_int8_matches_f32_dequant_reference(data):
+    """The kernel dequantizes in VMEM — compare against the f32
+    dequantized einsum. int8 tiles matmul in bf16 (int8 -> bf16 is
+    lossless; the rounding is in the f32 q cast and the products), so
+    the tolerance is bf16-matmul class, the same class as the einsum
+    path's own bf16 trick — the point of the kernel is bandwidth, and
+    correctness is pinned exactly by the f32 legs above."""
+    q, kc, vc, scale = data
+    qk, ks = _quantize_kv(kc)
+    qv, vs = _quantize_kv(vc)
+    kd = jnp.asarray(np.asarray(qk, np.float32)
+                     * np.asarray(ks)[..., None])
+    vd = jnp.asarray(np.asarray(qv, np.float32)
+                     * np.asarray(vs)[..., None])
+    want = _oracle(q, kd, vd, 30, scale)
+    got = np.asarray(flash_decode(q, qk, qv, 30, scale, ks, vs,
+                                  interpret=True, block_k=16))
+    np.testing.assert_allclose(got, want, rtol=1e-2, atol=1e-2)
+
+
+def test_int8_padded_tail(data):
+    """Quantized + non-dividing block_k: the tail tile's SCALE block
+    is uninitialized too — pv must be re-masked or 0*NaN rides into
+    the accumulator (the v-zeroing alone does not cover vs)."""
+    q, kc, vc, scale = data
+    qk, ks = _quantize_kv(kc)
+    qv, vs = _quantize_kv(vc)
+    got = np.asarray(flash_decode(q, qk, qv, 40, scale, ks, vs,
+                                  interpret=True, block_k=32))
+    kd = jnp.asarray(np.asarray(qk, np.float32)
+                     * np.asarray(ks)[..., None])
+    vd = jnp.asarray(np.asarray(qv, np.float32)
+                     * np.asarray(vs)[..., None])
+    assert np.isfinite(got).all()
+    np.testing.assert_allclose(got, _oracle(q, kd, vd, 40, scale),
+                               rtol=1e-2, atol=1e-2)
+
+
+def test_gate():
+    assert can_flash_decode(1024, 64)
+    assert can_flash_decode(16, 128)
+    assert not can_flash_decode(16, 80)  # lane-hostile head_dim
+    assert can_flash_decode(1216, 64)  # non-dividing L: padded tail
+    assert not can_flash_decode(0, 64)
+
+
+def test_attend_cache_flash_flag_parity(data):
+    """_attend_cache(use_flash=True) in interpret mode must agree with
+    its own einsum path — the production gate swaps implementations,
+    not semantics. (On CPU the gate defaults to einsum; force the
+    kernel through the interpret default.)"""
+    q, kc, vc, scale = data
+    a = np.asarray(_attend_cache(q, kc, vc, 25, scale, use_flash=True))
+    b = np.asarray(_attend_cache(q, kc, vc, 25, scale, use_flash=False))
+    np.testing.assert_allclose(a, b, rtol=2e-5, atol=2e-5)
+
+
+def test_jittable_and_sharded(data):
+    """jit + tp-sharded shard_map. The shard_map leg runs
+    check_vma=False: pallas's HLO interpreter slices blocks with
+    unvarying grid indices, which the vma checker rejects for varying
+    operands (JAX's error message itself prescribes check_vma=False);
+    the Mosaic path on real TPU does not go through that interpreter,
+    and flash_decode pre-varies its pos operand so the kernel's
+    operands stay vma-uniform there."""
+    from jax.sharding import PartitionSpec as P
+
+    from rlo_tpu.parallel.mesh import make_mesh, shard_jit
+    q, kc, vc, scale = data
+    f = jax.jit(lambda q, k, v: flash_decode(q, k, v, 12, scale,
+                                             interpret=True,
+                                             block_k=16))
+    np.testing.assert_allclose(np.asarray(f(q, kc, vc)),
+                               _oracle(q, kc, vc, 12, scale),
+                               rtol=2e-5, atol=2e-5)
+    mesh = make_mesh((2,), ("tp",))
+    g = shard_jit(
+        lambda q, k, v: flash_decode(q, k, v, 12, scale,
+                                     interpret=True, block_k=16),
+        mesh, (P(None, None, "tp"), P(None, "tp"), P(None, "tp")),
+        P(None, None, "tp"), check_vma=False)
+    np.testing.assert_allclose(np.asarray(g(q, kc, vc)),
+                               _oracle(q, kc, vc, 12, scale),
+                               rtol=2e-5, atol=2e-5)

@@ -327,7 +327,7 @@ class TestQuantizedKVCache:
         exact = init_kv_cache(CFG, 2, 16)
         for lc in cache:
             assert lc["k"].dtype == jnp.int8 and lc["v"].dtype == jnp.int8
-            assert lc["ks"].shape == (2, 16, CFG.n_heads)
+            assert lc["ks"].shape == (2, CFG.n_heads, 16)
         q_bytes = sum(sum(a.nbytes for a in lc.values()) for lc in cache)
         e_bytes = sum(sum(a.nbytes for a in lc.values()) for lc in exact)
         # vs the f32 exact cache: (hd + 4)/(4*hd) — 0.375 at this toy
