@@ -52,6 +52,14 @@ LEGS = [
     ("decode_kv_compare",
      [sys.executable, "benchmarks/decode_bench.py",
       "--compare-kv"], 2400),
+    # speculative-decoding infra costs at batch 1 (the latency-bound
+    # serving case, where decode is weight-streaming-bound and the
+    # verify amortizes): measured 2026-07-31 verify of gamma=4 tokens
+    # = 1.32 decode steps, draft step 0.04-0.08 of a target step ->
+    # ~2x implied speedup at 80% acceptance
+    ("spec_verify_b1",
+     [sys.executable, "benchmarks/spec_bench.py", "--batch", "1"],
+     2400),
 ]
 
 

@@ -138,14 +138,31 @@ def _attend_cache(q, k_cache, v_cache, pos, scale,
         from rlo_tpu.pallas.decode import flash_decode
         return flash_decode(q, k_cache, v_cache, pos, scale,
                             k_scale, v_scale)
+    # the einsum path IS the T=1 case of the block attend — one
+    # implementation, so a dequant/mask/dtype fix can never diverge
+    # decode_step from block_decode (speculative decoding's
+    # losslessness rides on their agreement)
+    posv = jnp.asarray(pos, jnp.int32)
+    pos_q = (jnp.full((b, 1), posv) if posv.ndim == 0
+             else posv.reshape(b, 1))
+    return _attend_cache_block(q, k_cache, v_cache, pos_q, scale,
+                               k_scale=k_scale, v_scale=v_scale)
+
+
+def _attend_cache_block(q, k_cache, v_cache, pos_q, scale,
+                        k_scale=None, v_scale=None):
+    """Block variant of the cache attend: q (b, T, nh, hd) where query
+    i of row b sits at position pos_q[b, i] and attends cache
+    positions <= pos_q[b, i]. Because the block's own K/V rows are
+    written into the cache BEFORE attending (write-then-attend, as in
+    decode_step), that single mask covers in-block causality too.
+    Used by the speculative-decoding verify step (T = gamma tokens
+    through the target in ONE forward); T=1 recovers decode_step's
+    attend shape."""
+    b, T, nh, hd = q.shape
+    nkv, max_len = k_cache.shape[1], k_cache.shape[2]
     rep = nh // nkv
-    qg = q.reshape(b, one, nkv, rep, hd)
-    # quantized caches matmul in bf16 ON TPU: int8 -> bf16 is LOSSLESS
-    # (every value in [-127, 127] is exactly representable) and keeps
-    # the cache-sized operand on the MXU's native bf16 path — the
-    # int8 -> f32 convert measured convert-bound at batch 32 on v5e.
-    # (CPU keeps f32: its runtime has no bf16 dot, and exactness of
-    # the sharded-vs-single parities wants the widest dtype anyway.)
+    qg = q.reshape(b, T, nkv, rep, hd)
     cache_dt = jnp.bfloat16 if (k_scale is not None and
                                 jax.default_backend() == "tpu") \
         else jnp.float32
@@ -153,22 +170,17 @@ def _attend_cache(q, k_cache, v_cache, pos, scale,
                    k_cache.astype(cache_dt),
                    preferred_element_type=jnp.float32) * scale
     s = s.astype(jnp.float32)
-    if k_scale is not None:  # fold dequant: per (b, g, k-position)
+    if k_scale is not None:
         s = s * k_scale[:, :, None, None, :]
-    posv = jnp.asarray(pos)
-    if posv.ndim == 0:
-        mask = jnp.arange(max_len) <= posv               # (max_len,)
-        s = jnp.where(mask[None, None, None, None, :], s, _NEG)
-    else:  # per-row positions
-        mask = jnp.arange(max_len) <= posv[:, None]
-        s = jnp.where(mask[:, None, None, None, :], s, _NEG)
+    mask = jnp.arange(max_len)[None, None, :] <= pos_q[:, :, None]
+    s = jnp.where(mask[:, None, None, :, :], s, _NEG)
     p = jax.nn.softmax(s, axis=-1)
-    if v_scale is not None:  # fold dequant into the probabilities
+    if v_scale is not None:
         p = p * v_scale[:, :, None, None, :]
     out = jnp.einsum("bgrqk,bgkd->bqgrd", p.astype(cache_dt),
                      v_cache.astype(cache_dt),
                      preferred_element_type=jnp.float32)
-    return out.astype(jnp.float32).reshape(b, one, nh, hd)
+    return out.astype(jnp.float32).reshape(b, T, nh, hd)
 
 
 def decode_step(params: dict, token, pos, cache, cfg: TransformerConfig,
@@ -251,6 +263,64 @@ def decode_step(params: dict, token, pos, cache, cfg: TransformerConfig,
     x = _rmsnorm(x, params["ln_f"]["g"])
     logits = (x[:, 0, :] @ params["embed"].T.astype(dt)) \
         .astype(jnp.float32)
+    return logits, new_cache
+
+
+def block_decode(params: dict, tokens, pos0, cache,
+                 cfg: TransformerConfig,
+                 tp_axis: Optional[str] = None,
+                 ep_axis: Optional[str] = None):
+    """Process T tokens (b, T) through the cache in ONE forward: row
+    b's token i sits at position pos0[b] + i. Returns
+    (logits (b, T, vocab) f32, cache). The verify step of speculative
+    decoding (the target judges all gamma draft tokens at once); also
+    a building block for chunked cache extension. Write-then-attend
+    with per-(row, i) masks, so rejected drafts' cache entries are
+    simply garbage beyond the accepted position — masked out and
+    overwritten by later writes, exactly like ragged decode."""
+    cfg = _decode_cfg(cfg)
+    dt = cfg.act_dtype
+    b, T = tokens.shape
+    pos0 = jnp.asarray(pos0, jnp.int32).reshape(b)
+    pos_arr = pos0[:, None] + jnp.arange(T, dtype=jnp.int32)  # (b, T)
+    x = embed_tokens(params["embed"], tokens, pos_arr, cfg)
+    scale = 1.0 / (cfg.head_dim ** 0.5)
+    new_cache = []
+    for layer, lc in zip(params["layers"], cache):
+        def attend(q, k, v, lc=lc):
+            quant = "ks" in lc
+            kt = k.transpose(0, 2, 1, 3)           # (b, kvh, T, hd)
+            vt = v.transpose(0, 2, 1, 3)
+            if quant:
+                kt, ks_new = _quantize_kv(kt)
+                vt, vs_new = _quantize_kv(vt)
+                store_dt = jnp.int8
+            else:
+                store_dt = dt
+            kvh = lc["k"].shape[1]
+            rows = jnp.arange(b)[:, None, None]
+            heads = jnp.arange(kvh)[None, :, None]
+            posw = pos_arr[:, None, :]             # (b, 1, T)
+            kc = lc["k"].at[rows, heads, posw].set(kt.astype(store_dt))
+            vc = lc["v"].at[rows, heads, posw].set(vt.astype(store_dt))
+            entry = {"k": kc, "v": vc}
+            ks = vs = None
+            if quant:
+                ks = lc["ks"].at[rows, heads, posw].set(ks_new)
+                vs = lc["vs"].at[rows, heads, posw].set(vs_new)
+                entry.update(ks=ks, vs=vs)
+            new_cache.append(entry)
+            return _attend_cache_block(q, kc, vc, pos_arr, scale,
+                                       k_scale=ks,
+                                       v_scale=vs).astype(dt)
+
+        x, _ = apply_layer(x, layer, cfg, attention=attend,
+                           tp_axis=tp_axis, ep_axis=ep_axis,
+                           pos=pos_arr)
+    x = _rmsnorm(x, params["ln_f"]["g"])
+    logits = jnp.einsum("btd,vd->btv", x,
+                        params["embed"].astype(dt)
+                        ).astype(jnp.float32)
     return logits, new_cache
 
 

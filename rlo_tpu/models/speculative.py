@@ -1,0 +1,137 @@
+"""Speculative decoding: a cheap draft proposes, the target verifies.
+
+Serving-side latency lever (net-new; the reference has no model stack
+at all, SURVEY.md §5): decode is HBM-bound — every step reads the full
+weights for ONE token per row — so a small draft model proposes
+``gamma`` tokens autoregressively and the big target model judges all
+of them in ONE forward (models.generate.block_decode), reading its
+weights once per round instead of once per token. Greedy speculative
+decoding is LOSSLESS: the emitted tokens each round are the target's
+own argmax predictions ``t_pred[0..j]`` (a draft token is accepted
+exactly when it equals the target's prediction, so the accepted prefix
+and the bonus token are all target predictions), hence the output
+equals plain greedy decode token for token — the parity oracle
+tests/test_speculative.py pins.
+
+Cache bookkeeping rides the same masking trick as ragged decode:
+rejected drafts leave garbage cache entries BEYOND each row's valid
+position, which are never attended (every attend masks at the row's
+own position) and are overwritten by later rounds. Per-row acceptance
+lengths make the whole loop ragged; positions, cache writes, and
+output writes are all per-row. One `lax.while_loop` over rounds (the
+trip count is data-dependent — rows finish at different speeds), each
+round = gamma draft decode_steps + 1 target block_decode.
+
+Speedup economics: a round emits j+1 in [1, gamma] tokens for the cost
+of gamma draft steps + one gamma-wide target forward. With draft cost
+c_d (fraction of a target step) and acceptance-driven yield E[j+1],
+speedup = E[j+1] / (gamma * c_d + c_verify). benchmarks/spec_bench.py
+measures the two cost terms on the chip and the realized yield.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import lax
+
+from rlo_tpu.models.generate import (block_decode, decode_step,
+                                     init_kv_cache, prefill)
+from rlo_tpu.models.transformer import TransformerConfig
+
+
+def speculative_generate(params: dict, draft_params: dict, prompt,
+                         cfg: TransformerConfig,
+                         draft_cfg: TransformerConfig, *,
+                         max_new: int, gamma: int = 4,
+                         max_len: Optional[int] = None):
+    """Greedy speculative continuation of ``prompt`` (b, plen) int32:
+    returns (b, max_new) int32 — IDENTICAL to
+    ``generate(params, prompt, cfg, max_new=max_new)`` by the
+    lossless-acceptance construction; the draft only changes how fast
+    the tokens arrive. ``gamma`` = draft tokens proposed per round.
+    Both configs must share the vocabulary; the draft is typically a
+    much smaller model (fewer layers / narrower).
+    """
+    if cfg.vocab != draft_cfg.vocab:
+        raise ValueError(
+            f"draft vocab {draft_cfg.vocab} != target vocab {cfg.vocab}")
+    if gamma < 1:
+        raise ValueError("gamma >= 1 required")
+    b, plen = prompt.shape
+    # + gamma slack: the last round's block writes reach at most
+    # position plen + max_new - 1 + gamma (garbage tail, never read)
+    max_len = max_len or (plen + max_new + gamma)
+    if plen + max_new + gamma > max_len:
+        raise ValueError(f"max_len {max_len} < plen {plen} + max_new "
+                         f"{max_new} + gamma {gamma}")
+
+    t_cache = init_kv_cache(cfg, b, max_len)
+    d_cache = init_kv_cache(draft_cfg, b, max_len)
+    t_logits, t_cache = prefill(params, prompt, t_cache, cfg)
+    _, d_cache = prefill(draft_params, prompt, d_cache, draft_cfg)
+
+    # first token: the target's own prefill prediction. Invariant from
+    # here on (per row): out[:n_out] emitted; last_tok = out[n_out-1]
+    # sits at sequence position pos-? — precisely, both caches are
+    # validly filled through position pos-1 and last_tok has NOT been
+    # processed by either model yet; last_tok's position is pos.
+    first = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)     # (b,)
+    out = jnp.zeros((b, max_new), jnp.int32)
+    out = out.at[:, 0].set(first)
+    n_out = jnp.ones((b,), jnp.int32)
+    pos = jnp.full((b,), plen, jnp.int32)
+    last_tok = first
+    rows = jnp.arange(b)
+
+    def round_body(state):
+        out, n_out, pos, last_tok, t_cache, d_cache, rounds = state
+        done = n_out >= max_new
+
+        # --- draft rollout: gamma ragged decode steps ---------------
+        cur = last_tok
+        dc = d_cache
+        d_toks = []
+        for i in range(gamma):
+            logits, dc = decode_step(draft_params, cur, pos + i, dc,
+                                     draft_cfg)
+            cur = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            d_toks.append(cur)
+        d_mat = jnp.stack(d_toks, axis=1)                  # (b, gamma)
+
+        # --- verify: ONE target forward over [last_tok, d_1..d_{g-1}]
+        block = jnp.concatenate([last_tok[:, None],
+                                 d_mat[:, :gamma - 1]], axis=1)
+        v_logits, tc = block_decode(params, block, pos, t_cache, cfg)
+        t_pred = jnp.argmax(v_logits, axis=-1).astype(jnp.int32)
+
+        # --- lossless acceptance ------------------------------------
+        acc = (d_mat == t_pred)                            # (b, gamma)
+        n_acc = jnp.cumprod(acc, axis=1).sum(axis=1)       # in [0, g]
+        j = jnp.minimum(n_acc, gamma - 1)                  # (b,)
+        # emitted tokens this round are t_pred[:, :j+1] — the target's
+        # own predictions (accepted drafts EQUAL them; the bonus IS
+        # one), which is the whole losslessness argument
+        n_emit = jnp.where(done, 0, j + 1)
+        for i in range(gamma):
+            idx = jnp.minimum(n_out + i, max_new - 1)
+            ok = (i <= j) & (n_out + i < max_new) & ~done
+            old = out[rows, idx]
+            out = out.at[rows, idx].set(
+                jnp.where(ok, t_pred[:, i], old))
+        new_last = jnp.where(done, last_tok, t_pred[rows, j])
+        n_out = jnp.minimum(n_out + n_emit, max_new)
+        pos = jnp.where(done, pos, pos + n_emit)
+        return (out, n_out, pos, new_last, tc, dc, rounds + 1)
+
+    def cond(state):
+        _, n_out, _, _, _, _, rounds = state
+        # every round emits >= 1 token per unfinished row, so max_new
+        # rounds always suffice — the bound makes divergence impossible
+        return jnp.any(n_out < max_new) & (rounds < max_new)
+
+    state = (out, n_out, pos, last_tok, t_cache, d_cache,
+             jnp.int32(0))
+    out = lax.while_loop(cond, round_body, state)[0]
+    return out

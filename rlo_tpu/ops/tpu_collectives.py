@@ -620,24 +620,56 @@ def all_to_all(x, axis: str, *, algorithm: str = "xla"):
     (net-new; the reference has no tensor traffic at all, SURVEY.md §5).
 
     x: (ws, ...) per shard. 'xla' lowers to one XLA AllToAll (the perf
-    path); 'ring' runs ws-1 ppermute steps rotating the FULL buffer and
-    keeping the chunk addressed to this shard each step — simple and
-    schedule-compatible with the other manual collectives, but ~2x the
-    bytes of an optimal ring all-to-all (ws(ws-1) chunk-hops per shard
-    vs ws(ws-1)/2 shipping only undelivered chunks). Use it for parity
-    studies, not bandwidth.
+    path); 'direct' runs ws-1 ppermutes, offset o shipping ONLY the
+    chunk addressed o hops away — the byte-optimal manual schedule:
+    sum_o o = ws(ws-1)/2 chunk-hops of ring-link traffic per shard
+    (XLA routes a shift-o CollectivePermute over o ICI hops), the same
+    total an optimal rotating ring pays; 'ring' rotates the FULL
+    buffer ws-1 steps keeping the addressed chunk each step — simple,
+    schedule-compatible with the other manual collectives, but 2x the
+    link bytes of 'direct' (ws(ws-1) chunk-hops). Keep 'ring' for
+    parity studies; bench with 'direct'.
     """
     ws = lax.axis_size(axis)
     if x.shape[0] != ws:
         raise ValueError(
             f"leading axis {x.shape[0]} != axis size {ws}")
-    if algorithm not in ("xla", "ring"):
+    if algorithm not in ("xla", "ring", "direct"):
         raise ValueError(f"unknown algorithm {algorithm!r}")
     with _named(f"all_to_all.{algorithm}"):
         if algorithm == "xla":
             return lax.all_to_all(x, axis, split_axis=0, concat_axis=0,
                                   tiled=True)
+        if algorithm == "direct":
+            return _all_to_all_direct(x, axis)
         return _all_to_all_ring(x, axis)
+
+
+def _all_to_all_direct(x, axis: str):
+    """ws-1 shift-o ppermutes, each carrying one chunk. After the
+    offset-o exchange, the arriving chunk came from shard (i-o) and is
+    that shard's chunk addressed to me — it lands at out[i-o]."""
+    ws = lax.axis_size(axis)
+    idx = lax.axis_index(axis)
+    # the ppermutes make the result varying over `axis` even when the
+    # input is replicated — pre-vary (same guard as the ring variant)
+    try:
+        if axis not in jax.typeof(x).vma:
+            x = lax.pcast(x, (axis,), to="varying")
+    except (AttributeError, TypeError):
+        pass
+    out = jnp.zeros_like(x)
+    own = lax.dynamic_index_in_dim(x, idx, 0, keepdims=False)
+    out = lax.dynamic_update_index_in_dim(out, own, idx, 0)
+    for o in range(1, ws):
+        perm = list(topology.ring_perm(ws, o))
+        # my chunk addressed to (idx + o): x[(idx + o) % ws]
+        send = lax.dynamic_index_in_dim(x, (idx + o) % ws, 0,
+                                        keepdims=False)
+        recv = lax.ppermute(send, axis, perm)
+        out = lax.dynamic_update_index_in_dim(out, recv,
+                                              (idx - o) % ws, 0)
+    return out
 
 
 def _all_to_all_ring(x, axis: str):

@@ -83,3 +83,21 @@ def test_native_bench_allreduce_correctness_gate():
     from rlo_tpu.native.bindings import bench_allreduce
     t = bench_allreduce(4, 1024, reps=3)
     assert t > 0
+
+
+def test_spec_bench_emits_json_line():
+    """The speculative-decoding infra bench must run end-to-end at
+    --tiny sizes and emit one valid JSON line."""
+    import os
+    env = dict(os.environ,
+               PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu")
+    bench = Path(__file__).resolve().parent.parent / "benchmarks" / \
+        "spec_bench.py"
+    proc = subprocess.run(
+        [sys.executable, str(bench), "--tiny"],
+        capture_output=True, text=True, timeout=600, env=env)
+    assert proc.returncode == 0, proc.stderr
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1, proc.stdout
+    rec = json.loads(lines[0])
+    assert rec["unit"] == "x" and rec["value"] > 0

@@ -25,7 +25,7 @@ from rlo_tpu.parallel.mesh import make_mesh, shard_jit
 
 
 class TestAllToAll:
-    @pytest.mark.parametrize("algorithm", ["xla", "ring"])
+    @pytest.mark.parametrize("algorithm", ["xla", "ring", "direct"])
     @pytest.mark.parametrize("ws", [4, 8])
     def test_matches_numpy_transpose(self, algorithm, ws):
         rng = np.random.default_rng(0)
@@ -108,7 +108,9 @@ class TestMoEFFN:
                                      all_to_all_algorithm=alg)[0][None],
                 mesh, (specs, P()), P("ep"))
             return np.asarray(fn(params, h))
-        np.testing.assert_allclose(run("ring"), run("xla"), rtol=1e-6)
+        base = run("xla")
+        np.testing.assert_allclose(run("ring"), base, rtol=1e-6)
+        np.testing.assert_allclose(run("direct"), base, rtol=1e-6)
 
 
 class TestMoETransformer:

@@ -1,0 +1,150 @@
+"""Speculative decoding (models.speculative) — the lossless oracle.
+
+Greedy speculative decode must equal plain greedy decode token for
+token, for ANY draft: a worthless draft only slows it down (every
+round still emits the target's own next prediction), a perfect draft
+only speeds it up. The tests drive the rejection-heavy path (random
+draft), the full-acceptance path (draft == target), and a partial
+path (perturbed target), across dense / GQA+rope / int8-cache
+configs and gamma in {1, 3, 8}.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rlo_tpu.models.generate import (block_decode, decode_step,
+                                     generate, init_kv_cache)
+from rlo_tpu.models.speculative import speculative_generate
+from rlo_tpu.models.transformer import TransformerConfig, init_params
+
+CFG = TransformerConfig(vocab=61, d_model=32, n_heads=4, n_layers=2,
+                        d_ff=64, dtype="float32")
+DRAFT = TransformerConfig(vocab=61, d_model=16, n_heads=2, n_layers=1,
+                          d_ff=32, dtype="float32")
+
+
+@pytest.fixture(scope="module")
+def setup():
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    dparams = init_params(jax.random.PRNGKey(5), DRAFT)
+    rng = np.random.default_rng(1)
+    prompt = jnp.asarray(rng.integers(0, CFG.vocab, (3, 6)), jnp.int32)
+    return params, dparams, prompt
+
+
+@pytest.mark.parametrize("gamma", [1, 3, 8])
+def test_lossless_random_draft(setup, gamma):
+    """Rejection-heavy: an untrained draft agrees ~1/vocab of the
+    time; output must still be exactly the target's greedy tokens."""
+    params, dparams, prompt = setup
+    want = np.asarray(generate(params, prompt, CFG, max_new=10))
+    got = np.asarray(speculative_generate(
+        params, dparams, prompt, CFG, DRAFT, max_new=10, gamma=gamma))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_lossless_self_draft(setup):
+    """Full-acceptance: draft == target accepts every proposal; the
+    all-gamma-accepted bookkeeping (bonus == d_gamma) must hold."""
+    params, _, prompt = setup
+    want = np.asarray(generate(params, prompt, CFG, max_new=12))
+    got = np.asarray(speculative_generate(
+        params, params, prompt, CFG, CFG, max_new=12, gamma=4))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_lossless_perturbed_draft(setup):
+    """Partial acceptance: target + noise agrees on easy tokens and
+    diverges on hard ones — the mixed accept/reject path."""
+    params, _, prompt = setup
+    noisy = jax.tree.map(
+        lambda p: p + 0.03 * jax.random.normal(
+            jax.random.PRNGKey(9), p.shape, p.dtype), params)
+    want = np.asarray(generate(params, prompt, CFG, max_new=10))
+    got = np.asarray(speculative_generate(
+        params, noisy, prompt, CFG, CFG, max_new=10, gamma=4))
+    np.testing.assert_array_equal(got, want)
+
+
+@pytest.mark.parametrize("variant", ["gqa_rope", "int8"])
+def test_lossless_variants(setup, variant):
+    _, _, prompt = setup
+    cfg = CFG
+    if variant == "gqa_rope":
+        cfg = dataclasses.replace(CFG, n_kv_heads=2,
+                                  pos_encoding="rope")
+    else:
+        cfg = dataclasses.replace(CFG, kv_cache_dtype="int8")
+    dcfg = dataclasses.replace(DRAFT,
+                               kv_cache_dtype=cfg.kv_cache_dtype)
+    params = init_params(jax.random.PRNGKey(11), cfg)
+    dparams = init_params(jax.random.PRNGKey(12), dcfg)
+    want = np.asarray(generate(params, prompt, cfg, max_new=9))
+    got = np.asarray(speculative_generate(
+        params, dparams, prompt, cfg, dcfg, max_new=9, gamma=3))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_jittable(setup):
+    params, dparams, prompt = setup
+    f = jax.jit(lambda p, d, t: speculative_generate(
+        p, d, t, CFG, DRAFT, max_new=8, gamma=3))
+    want = np.asarray(generate(params, prompt, CFG, max_new=8))
+    np.testing.assert_array_equal(np.asarray(f(params, dparams,
+                                               prompt)), want)
+
+
+def test_argument_errors(setup):
+    params, dparams, prompt = setup
+    bad = dataclasses.replace(DRAFT, vocab=17)
+    with pytest.raises(ValueError, match="vocab"):
+        speculative_generate(params, dparams, prompt, CFG, bad,
+                             max_new=4)
+    with pytest.raises(ValueError, match="gamma"):
+        speculative_generate(params, dparams, prompt, CFG, DRAFT,
+                             max_new=4, gamma=0)
+    with pytest.raises(ValueError, match="max_len"):
+        speculative_generate(params, dparams, prompt, CFG, DRAFT,
+                             max_new=4, gamma=2, max_len=8)
+
+
+@pytest.mark.parametrize("variant", ["dense", "gqa_rope", "int8"])
+def test_block_decode_matches_sequential(variant):
+    """block_decode (the verify primitive) == T sequential
+    decode_steps: logits at every position and the final cache."""
+    cfg = CFG
+    if variant == "gqa_rope":
+        cfg = dataclasses.replace(cfg, n_kv_heads=2,
+                                  pos_encoding="rope")
+    elif variant == "int8":
+        cfg = dataclasses.replace(cfg, kv_cache_dtype="int8")
+    params = init_params(jax.random.PRNGKey(2), cfg)
+    rng = np.random.default_rng(3)
+    b, T, L = 2, 4, 12
+    toks = jnp.asarray(rng.integers(0, cfg.vocab, (b, 3 + T)),
+                       jnp.int32)
+    cache_a = init_kv_cache(cfg, b, L)
+    cache_b = init_kv_cache(cfg, b, L)
+    for pos in range(3):
+        _, cache_a = decode_step(params, toks[:, pos], pos, cache_a,
+                                 cfg)
+        _, cache_b = decode_step(params, toks[:, pos], pos, cache_b,
+                                 cfg)
+    blk, cache_a = block_decode(params, toks[:, 3:], jnp.asarray([3, 3]),
+                                cache_a, cfg)
+    seq = []
+    for i in range(T):
+        lb, cache_b = decode_step(params, toks[:, 3 + i], 3 + i,
+                                  cache_b, cfg)
+        seq.append(np.asarray(lb))
+    np.testing.assert_allclose(np.asarray(blk), np.stack(seq, 1),
+                               rtol=2e-4, atol=2e-4)
+    for ca, cb in zip(cache_a, cache_b):
+        for key in ca:
+            np.testing.assert_allclose(
+                np.asarray(ca[key], np.float32),
+                np.asarray(cb[key], np.float32), rtol=1e-5, atol=1e-5)
