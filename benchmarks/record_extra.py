@@ -45,7 +45,7 @@ LEGS = [
     # flash-decode kernel; decode_kv_compare measures the int8-cache
     # speedup with INTERLEAVED pairs (separate runs sit in different
     # chip-throughput windows; their ratio is meaningless) — measured
-    # 1.43x at batch 32 / plen 1024 on 2026-07-31.
+    # 1.17-1.43x across windows at batch 32 / plen 1024 (2026-07-31).
     ("decode_longctx",
      [sys.executable, "benchmarks/decode_bench.py",
       "--prompt-len", "1024"], 2400),

@@ -95,6 +95,34 @@ def main():
     achieved = tok_s * fl_tok
     on_tpu = jax.default_backend() == "tpu"
     mfu = achieved / V5E_BF16_PEAK if on_tpu else float("nan")
+
+    # window-relative MFU: the tunneled chip's DELIVERED throughput
+    # drifts ~1.6x between windows (identical code recorded 0.52 and
+    # 0.86 nominal MFU), so also time a roofline probe — a big bf16
+    # matmul chain — in the SAME window and report the step's flops as
+    # a fraction of the probe's achieved flops. This ratio is the
+    # drift-immune number: how close the train step is to what the
+    # chip will actually give you right now.
+    mfu_rel = float("nan")
+    if on_tpu:
+        mm = 2048
+        a = jnp.asarray(np.random.default_rng(1).standard_normal(
+            (mm, mm)), jnp.bfloat16)
+
+        @partial(jax.jit, static_argnames=("kk",))
+        def mm_chain(a, kk):
+            def it(i, x):
+                return jnp.tanh(x @ a)  # tanh blocks trivial fusion
+            return jax.lax.fori_loop(0, kk, it, a)
+
+        t_mm = bench._chain_time(lambda x, kk: mm_chain(x, kk), a,
+                                 k=256, stat="median")
+        probe_flops = 2.0 * mm ** 3 / t_mm
+        mfu_rel = achieved / probe_flops
+        print(f"roofline probe: {probe_flops/1e12:.1f} TFLOP/s "
+              f"({probe_flops/V5E_BF16_PEAK:.1%} of nominal peak this "
+              f"window); window-relative MFU {mfu_rel:.1%}",
+              file=sys.stderr)
     print(f"params={n_params/1e6:.1f}M batch={batch} seq={seq} "
           f"step={t_step*1e3:.2f} ms  {tok_s:,.0f} tok/s  "
           f"{achieved/1e12:.1f} TFLOP/s"
@@ -121,6 +149,12 @@ def main():
         "vs_baseline": round(mfu, 4) if on_tpu else 0.0,
         "vs_baseline_meaning": "MFU fraction of 197 TFLOP/s v5e bf16 peak",
     }
+    if on_tpu and mfu_rel == mfu_rel:
+        rec["mfu_window_relative"] = round(mfu_rel, 4)
+        rec["mfu_window_relative_meaning"] = (
+            "step flops / same-window roofline-matmul flops — "
+            "drift-immune (the chip's delivered peak moves ~1.6x "
+            "between windows)")
     print(json.dumps(rec))
 
 

@@ -90,7 +90,8 @@ class TransformerConfig:
     #            cache memory and HBM bytes of bf16, error one
     #            quantization half-step per read. With the flash-decode
     #            kernel (pallas/decode.py) dequantizing tiles in VMEM,
-    #            measured 1.43x decode tok/s at batch 32 / plen 1024
+    #            measured 1.17-1.43x decode tok/s (across windows)
+    #            at batch 32 / plen 1024
     #            on v5e (interleaved paired ratio,
     #            benchmarks/decode_bench.py --compare-kv); also 2x the
     #            servable batch x context per chip.
