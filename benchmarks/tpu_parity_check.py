@@ -1,0 +1,96 @@
+"""On-chip numerics parity checks (run on the real TPU, outside the
+CPU-forced pytest conftest):
+
+1. flash_block_decode vs the einsum block oracle on TPU (Mosaic path,
+   not the interpreter).
+2. Greedy speculative_generate == plain greedy generate token-for-token
+   on TPU — the losslessness claim under the production kernels
+   (decode_step takes flash T=1, the verify takes flash T=gamma).
+
+Exit 0 on full parity; prints per-check status.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from rlo_tpu.models.generate import (_attend_cache_block,  # noqa: E402
+                                     generate)
+from rlo_tpu.models.speculative import speculative_generate  # noqa: E402
+from rlo_tpu.models.transformer import (TransformerConfig,  # noqa: E402
+                                        init_params)
+from rlo_tpu.pallas.decode import flash_block_decode  # noqa: E402
+
+
+def check_kernel():
+    rng = np.random.default_rng(0)
+    b, T, nh, nkv, d, L = 2, 4, 8, 2, 64, 512
+    q = jnp.asarray(rng.standard_normal((b, T, nh, d)), jnp.bfloat16)
+    kc = jnp.asarray(rng.standard_normal((b, nkv, L, d)), jnp.bfloat16)
+    vc = jnp.asarray(rng.standard_normal((b, nkv, L, d)), jnp.bfloat16)
+    pos0 = jnp.asarray([100, L - T], jnp.int32)
+    scale = 1.0 / np.sqrt(d)
+    got = np.asarray(jax.jit(
+        lambda q, k, v: flash_block_decode(q, k, v, pos0, scale))(
+            q, kc, vc))
+    pos_q = pos0[:, None] + jnp.arange(T, dtype=jnp.int32)
+    want = np.asarray(jax.jit(
+        lambda q, k, v: _attend_cache_block(q, k, v, pos_q, scale,
+                                            use_flash=False))(
+            q, kc, vc))
+    err = np.max(np.abs(got - want))
+    ok = err < 2e-2  # bf16-dot class
+    print(f"flash_block_decode vs einsum (TPU): max|diff| {err:.2e} "
+          f"{'OK' if ok else 'FAIL'}")
+    return ok
+
+
+def check_speculative(kv_heads=None, kv_cache_dtype=None):
+    import dataclasses
+    cfg = TransformerConfig(vocab=4096, d_model=256, n_heads=8,
+                            n_layers=4, d_ff=1024, dtype="bfloat16")
+    if kv_heads:
+        cfg = dataclasses.replace(cfg, n_kv_heads=kv_heads,
+                                  pos_encoding="rope")
+    if kv_cache_dtype:
+        cfg = dataclasses.replace(cfg, kv_cache_dtype=kv_cache_dtype)
+    dcfg = dataclasses.replace(cfg, n_layers=1, d_model=128,
+                               n_heads=4, d_ff=256,
+                               n_kv_heads=None)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    dparams = init_params(jax.random.PRNGKey(1), dcfg)
+    rng = np.random.default_rng(2)
+    prompt = jnp.asarray(rng.integers(0, cfg.vocab, (2, 32)), jnp.int32)
+    max_new = 48
+    want = np.asarray(jax.jit(lambda p, t: generate(
+        p, t, cfg, max_new=max_new))(params, prompt))
+    got = np.asarray(jax.jit(lambda p, d, t: speculative_generate(
+        p, d, t, cfg, dcfg, max_new=max_new, gamma=4))(
+            params, dparams, prompt))
+    n_mismatch = int((got != want).sum())
+    tag = (f"kv_heads={kv_heads} cache={kv_cache_dtype}"
+           if (kv_heads or kv_cache_dtype) else "dense")
+    print(f"speculative greedy parity (TPU, {tag}): "
+          f"{n_mismatch} mismatched tokens of {want.size} "
+          f"{'OK' if n_mismatch == 0 else 'FAIL'}")
+    return n_mismatch == 0
+
+
+def main():
+    print(f"backend: {jax.default_backend()}, {jax.devices()}")
+    ok = check_kernel()
+    ok &= check_speculative()
+    ok &= check_speculative(kv_heads=2)
+    ok &= check_speculative(kv_cache_dtype="int8")
+    print("ALL PARITY CHECKS PASSED" if ok else "PARITY FAILURES")
+    sys.exit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    main()

@@ -61,7 +61,9 @@ def init_kv_cache(cfg: TransformerConfig, batch: int, max_len: int,
         s = jnp.zeros((batch, kvh, max_len), jnp.float32)
         return [{"k": z, "v": z, "ks": s, "vs": s}
                 for _ in range(cfg.n_layers)]
-    assert cfg.kv_cache_dtype is None, cfg.kv_cache_dtype
+    if cfg.kv_cache_dtype is not None:
+        raise ValueError(
+            f"unknown kv_cache_dtype {cfg.kv_cache_dtype!r}")
     z = jnp.zeros(shape, cfg.act_dtype)
     return [{"k": z, "v": z} for _ in range(cfg.n_layers)]
 
@@ -150,7 +152,8 @@ def _attend_cache(q, k_cache, v_cache, pos, scale,
 
 
 def _attend_cache_block(q, k_cache, v_cache, pos_q, scale,
-                        k_scale=None, v_scale=None):
+                        k_scale=None, v_scale=None, pos0=None,
+                        use_flash=None):
     """Block variant of the cache attend: q (b, T, nh, hd) where query
     i of row b sits at position pos_q[b, i] and attends cache
     positions <= pos_q[b, i]. Because the block's own K/V rows are
@@ -158,9 +161,30 @@ def _attend_cache_block(q, k_cache, v_cache, pos_q, scale,
     decode_step), that single mask covers in-block causality too.
     Used by the speculative-decoding verify step (T = gamma tokens
     through the target in ONE forward); T=1 recovers decode_step's
-    attend shape."""
+    attend shape.
+
+    ``pos0`` (b,) asserts the positions are CONTIGUOUS per row
+    (pos_q[b, i] == pos0[b] + i) — a static property of the caller,
+    not checkable on traced values — which enables the fused
+    flash-block path on TPU: the SAME kernel family decode_step's
+    attend uses (T=1), so speculative verify logits and plain decode
+    logits share numerics (losslessness of greedy speculative decoding
+    needs their argmaxes to agree)."""
     b, T, nh, hd = q.shape
     nkv, max_len = k_cache.shape[1], k_cache.shape[2]
+    if use_flash is None:
+        from rlo_tpu.pallas.decode import (_block_fits_vmem,
+                                           can_flash_decode)
+        itemsize = 4 if k_cache.dtype == jnp.float32 else 2
+        use_flash = (pos0 is not None
+                     and jax.default_backend() == "tpu"
+                     and can_flash_decode(max_len, hd)
+                     and _block_fits_vmem(max_len, hd, nkv, nh // nkv,
+                                          T, itemsize))
+    if use_flash:
+        from rlo_tpu.pallas.decode import flash_block_decode
+        return flash_block_decode(q, k_cache, v_cache, pos0, scale,
+                                  k_scale, v_scale)
     rep = nh // nkv
     qg = q.reshape(b, T, nkv, rep, hd)
     cache_dt = jnp.bfloat16 if (k_scale is not None and
@@ -311,8 +335,8 @@ def block_decode(params: dict, tokens, pos0, cache,
                 entry.update(ks=ks, vs=vs)
             new_cache.append(entry)
             return _attend_cache_block(q, kc, vc, pos_arr, scale,
-                                       k_scale=ks,
-                                       v_scale=vs).astype(dt)
+                                       k_scale=ks, v_scale=vs,
+                                       pos0=pos0).astype(dt)
 
         x, _ = apply_layer(x, layer, cfg, attention=attend,
                            tp_axis=tp_axis, ep_axis=ep_axis,

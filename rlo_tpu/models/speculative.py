@@ -13,6 +13,18 @@ and the bonus token are all target predictions), hence the output
 equals plain greedy decode token for token — the parity oracle
 tests/test_speculative.py pins.
 
+Numerics caveat on that claim: "the target's prediction" must mean
+the SAME floating-point logits plain decode would compute, or a
+near-tie argmax can flip between the two paths. On TPU both paths now
+route through one kernel family — plain decode_step uses the Pallas
+flash-decode kernel at T=1 and the verify block_decode uses the same
+kernel at T=gamma (pallas.decode.flash_block_decode), with identical
+tile shapes, accumulation order, and dot dtypes per query row — and on
+CPU both take the einsum path, so the parity holds by shared numerics
+on both backends (pinned on-chip by benchmarks/tpu_parity_check.py —
+run on the real TPU, outside the CPU-forced pytest conftest — and by
+the CPU oracles in tests/test_speculative.py always).
+
 Cache bookkeeping rides the same masking trick as ragged decode:
 rejected drafts leave garbage cache entries BEYOND each row's valid
 position, which are never attended (every attend masks at the row's

@@ -62,7 +62,8 @@ _BLOCK_K = 512
 
 
 def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, *rest, scale: float,
-                   n_k: int, bk: int, max_len: int, quant: bool):
+                   n_k: int, bk: int, max_len: int, quant: bool,
+                   r: int, T: int):
     if quant:
         ks_ref, vs_ref, o_ref, m_s, l_s, o_s = rest
     else:
@@ -79,9 +80,11 @@ def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, *rest, scale: float,
     # dots in bf16 (f32 accumulate): int8 -> bf16 is lossless, bf16 is
     # the MXU-native width, and an f32 cast would materialize 4x the
     # tile bytes in VMEM. f32 caches keep f32 (exactness; their tiles
-    # fit). g = kvh heads batched per program.
+    # fit). g = kvh heads batched per program. The query axis holds
+    # T*r rows, t-major: row t*r+rr is block token t, group-member rr,
+    # at sequence position pos + t (T=1 recovers single-token decode).
     dot_dt = jnp.float32 if k_ref.dtype == jnp.float32 else jnp.bfloat16
-    q = q_ref[0].astype(dot_dt)                      # (g, r, d)
+    q = q_ref[0].astype(dot_dt)                      # (g, T*r, d)
     k = k_ref[0].astype(dot_dt)                      # (g, BK, d)
     v = v_ref[0].astype(dot_dt)                      # (g, BK, d)
     pos = pos_ref[ib, 0]
@@ -90,15 +93,18 @@ def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, *rest, scale: float,
     base = ik * bk
     row = base + jax.lax.broadcasted_iota(jnp.int32, (1, 1, bk), 2)
     col = base + jax.lax.broadcasted_iota(jnp.int32, (1, bk, 1), 1)
-    mask_row = (row <= pos) & (row < max_len)        # (1, 1, BK)
-    mask_col = (col <= pos) & (col < max_len)        # (1, BK, 1)
+    # per-query causal position: query row t*r+rr masks at pos + t
+    qoff = jax.lax.broadcasted_iota(jnp.int32, (1, T * r, 1), 1) // r
+    mask_row = (row <= pos + qoff) & (row < max_len)  # (1, T*r, BK)
+    # V zeroing: any key a query of this block may attend (<= pos+T-1)
+    mask_col = (col <= pos + (T - 1)) & (col < max_len)  # (1, BK, 1)
 
     # batched over the head axis: ((contract d), (batch g))
     s = jax.lax.dot_general(q, k, (((2,), (2,)), ((0,), (0,))),
                             preferred_element_type=jnp.float32) * scale
     if quant:
         s = s * ks_ref[0]                            # (g, 1, BK)
-    s = jnp.where(mask_row, s, _NEG)                 # (g, r, BK)
+    s = jnp.where(mask_row, s, _NEG)                 # (g, T*r, BK)
     # zero V under the mask: a padded tail tile may hold uninitialized
     # VMEM, and 0 * NaN would poison the accumulator
     v = jnp.where(mask_col, v, jnp.zeros((), dot_dt))
@@ -135,6 +141,30 @@ def can_flash_decode(max_len: int, head_dim: int,
     return bk == max_len or bk % 128 == 0
 
 
+def _pick_bk(L: int, d: int, nkv: int, r: int, itemsize: int,
+             block_k: int) -> int:
+    """Cache-tile width from the T=1 VMEM budget (two (kvh, bk, d)
+    tiles in the dot dtype + the f32 score/probability tensors within
+    ~10 MB). Deliberately independent of T: every block size must
+    tile the cache identically or verify/decode numerics diverge."""
+    bk = min(block_k, max(L, 1))
+    while bk > 128 and (2 * nkv * bk * d * itemsize
+                        + 2 * nkv * r * bk * 4) > (10 << 20):
+        # halve, but stay on the multiple-of-128 grid can_flash_decode
+        # gated on (e.g. 384 -> 192 would fail Mosaic tiling; use 128)
+        bk = max(128, (bk // 2) // 128 * 128)
+    return bk
+
+
+def _block_fits_vmem(L: int, d: int, nkv: int, r: int, T: int,
+                     itemsize: int, block_k: int = _BLOCK_K) -> bool:
+    """Whether a T-query block fits VMEM at the T=1 tile size (the
+    only tile size that preserves shared numerics with plain decode)."""
+    bk = _pick_bk(L, d, nkv, r, itemsize, block_k)
+    return (2 * nkv * bk * d * itemsize + 2 * nkv * T * r * bk * 4
+            + nkv * T * r * d * 4) <= (14 << 20)
+
+
 def flash_decode(q, k_cache, v_cache, pos, scale, k_scale=None,
                  v_scale=None, *, block_k: int = _BLOCK_K,
                  interpret: Optional[bool] = None):
@@ -142,24 +172,52 @@ def flash_decode(q, k_cache, v_cache, pos, scale, k_scale=None,
     _attend_cache caller layout); caches head-leading as in
     models.generate. ``pos`` scalar or (b,). Returns
     (b, 1, n_heads, head_dim) f32."""
+    assert q.shape[1] == 1, q.shape  # single query; flash_block_decode for T>1
+    return flash_block_decode(q, k_cache, v_cache, pos, scale,
+                              k_scale=k_scale, v_scale=v_scale,
+                              block_k=block_k, interpret=interpret)
+
+
+def flash_block_decode(q, k_cache, v_cache, pos0, scale, k_scale=None,
+                       v_scale=None, *, block_k: int = _BLOCK_K,
+                       interpret: Optional[bool] = None):
+    """Fused T-query block decode attention (the speculative-decoding
+    verify shape): ``q`` is (b, T, n_heads, head_dim) where row b's
+    query t sits at sequence position ``pos0[b] + t`` and attends
+    cache positions <= it (write-then-attend covers in-block
+    causality, as in models.generate.block_decode). ``pos0`` scalar or
+    (b,). T=1 IS single-token flash decode — one kernel, so the
+    speculative verify and the plain decode step share numerics (the
+    losslessness of greedy speculative decoding rides on their
+    argmaxes agreeing; tests/test_speculative.py pins parity).
+    Returns (b, T, n_heads, head_dim) f32."""
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    b, one, nh, d = q.shape
-    assert one == 1
+    b, T, nh, d = q.shape
     nkv, L = k_cache.shape[1], k_cache.shape[2]
     r = nh // nkv
+    R = T * r
     quant = k_scale is not None
-    bk = min(block_k, max(L, 1))
-    # VMEM guard: two (kvh, bk, d) tiles in the dot dtype + the f32
-    # probability/score tensors must fit the ~16 MB budget
+    # bk comes from the T=1 budget — identical for every T, or the
+    # verify kernel's tile partition (and so its accumulation order)
+    # would differ from plain decode's, breaking the shared-numerics
+    # guarantee speculative losslessness rests on.
     itemsize = 4 if k_cache.dtype == jnp.float32 else 2
-    while bk > 128 and (2 * nkv * bk * d * itemsize
-                        + 2 * nkv * r * bk * 4) > (10 << 20):
-        bk //= 2
+    bk = _pick_bk(L, d, nkv, r, itemsize, block_k)
+    # the T-scaled tensors at that same bk must still fit VMEM; a
+    # block too big to share the T=1 tiling cannot share numerics, so
+    # refuse rather than silently retile (caller falls back to einsum)
+    if not _block_fits_vmem(L, d, nkv, r, T, itemsize, block_k):
+        raise ValueError(
+            f"flash_block_decode: T={T} block exceeds the VMEM budget "
+            f"at the T=1 tile size bk={bk} (nkv={nkv}, r={r}, d={d}) "
+            f"— use the einsum block attend for this shape")
     n_k = -(-L // bk)
 
-    qg = q.reshape(b, nkv, r, d)
-    posv = jnp.asarray(pos, jnp.int32)
+    # t-major query rows: row t*r + rr = block token t, group member rr
+    qg = (q.reshape(b, T, nkv, r, d).transpose(0, 2, 1, 3, 4)
+          .reshape(b, nkv, R, d))
+    posv = jnp.asarray(pos0, jnp.int32)
     posv = (jnp.full((b, 1), posv) if posv.ndim == 0
             else posv.reshape(b, 1))
     # inside shard_map (vma typing) every kernel operand must carry
@@ -171,7 +229,7 @@ def flash_decode(q, k_cache, v_cache, pos, scale, k_scale=None,
 
     # pos: whole-array block (block dims == array dims is always legal)
     pos_spec = pl.BlockSpec((b, 1), lambda ib, ik: (0, 0))
-    q_spec = pl.BlockSpec((1, nkv, r, d), lambda ib, ik: (ib, 0, 0, 0))
+    q_spec = pl.BlockSpec((1, nkv, R, d), lambda ib, ik: (ib, 0, 0, 0))
     kv_spec = pl.BlockSpec((1, nkv, bk, d),
                            lambda ib, ik: (ib, 0, ik, 0))
     o_spec = q_spec
@@ -190,23 +248,24 @@ def flash_decode(q, k_cache, v_cache, pos, scale, k_scale=None,
         kwargs["compiler_params"] = pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary"))
     if pltpu is not None:
-        scratch = [pltpu.VMEM((nkv, r), jnp.float32),
-                   pltpu.VMEM((nkv, r), jnp.float32),
-                   pltpu.VMEM((nkv, r, d), jnp.float32)]
+        scratch = [pltpu.VMEM((nkv, R), jnp.float32),
+                   pltpu.VMEM((nkv, R), jnp.float32),
+                   pltpu.VMEM((nkv, R, d), jnp.float32)]
     else:  # pragma: no cover — interpret-only builds without pltpu
-        scratch = [jax.ShapeDtypeStruct((nkv, r), jnp.float32),
-                   jax.ShapeDtypeStruct((nkv, r), jnp.float32),
-                   jax.ShapeDtypeStruct((nkv, r, d), jnp.float32)]
+        scratch = [jax.ShapeDtypeStruct((nkv, R), jnp.float32),
+                   jax.ShapeDtypeStruct((nkv, R), jnp.float32),
+                   jax.ShapeDtypeStruct((nkv, R, d), jnp.float32)]
 
     out = pl.pallas_call(
         functools.partial(_decode_kernel, scale=float(scale), n_k=n_k,
-                          bk=bk, max_len=L, quant=quant),
+                          bk=bk, max_len=L, quant=quant, r=r, T=T),
         grid=(b, n_k),
         in_specs=in_specs,
         out_specs=o_spec,
-        out_shape=out_struct((b, nkv, r, d), jnp.float32, q, k_cache),
+        out_shape=out_struct((b, nkv, R, d), jnp.float32, q, k_cache),
         scratch_shapes=scratch,
         interpret=interpret,
         **kwargs,
     )(*args)
-    return out.reshape(b, 1, nh, d)
+    return (out.reshape(b, nkv, T, r, d).transpose(0, 2, 1, 3, 4)
+            .reshape(b, T, nh, d))

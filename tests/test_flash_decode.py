@@ -12,8 +12,10 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from rlo_tpu.models.generate import _attend_cache, _quantize_kv
-from rlo_tpu.pallas.decode import can_flash_decode, flash_decode
+from rlo_tpu.models.generate import (_attend_cache, _attend_cache_block,
+                                     _quantize_kv)
+from rlo_tpu.pallas.decode import (can_flash_decode, flash_block_decode,
+                                   flash_decode)
 
 B, NH, NKV, D, L = 3, 8, 4, 64, 48
 
@@ -127,6 +129,72 @@ def test_attend_cache_flash_flag_parity(data):
     a = np.asarray(_attend_cache(q, kc, vc, 25, scale, use_flash=True))
     b = np.asarray(_attend_cache(q, kc, vc, 25, scale, use_flash=False))
     np.testing.assert_allclose(a, b, rtol=2e-5, atol=2e-5)
+
+
+def _block_oracle(q, kc, vc, pos0, scale, ks=None, vs=None):
+    b, T = q.shape[0], q.shape[1]
+    p0 = jnp.asarray(pos0, jnp.int32)
+    p0 = jnp.full((b,), p0) if p0.ndim == 0 else p0
+    pos_q = p0[:, None] + jnp.arange(T, dtype=jnp.int32)
+    return np.asarray(_attend_cache_block(q, kc, vc, pos_q, scale,
+                                          k_scale=ks, v_scale=vs,
+                                          use_flash=False))
+
+
+@pytest.mark.parametrize("T", [1, 4])
+def test_block_decode_matches_block_oracle(data, T):
+    """flash_block_decode (the speculative verify kernel) vs the
+    einsum block attend: per-query causal masks at pos0 + t."""
+    _, kc, vc, scale = data
+    rng = np.random.default_rng(7)
+    q = jnp.asarray(rng.standard_normal((B, T, NH, D)), jnp.float32)
+    got = np.asarray(flash_block_decode(q, kc, vc, 9, scale,
+                                        interpret=True, block_k=16))
+    np.testing.assert_allclose(got, _block_oracle(q, kc, vc, 9, scale),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_block_decode_ragged_pos0(data):
+    _, kc, vc, scale = data
+    rng = np.random.default_rng(8)
+    T = 3
+    q = jnp.asarray(rng.standard_normal((B, T, NH, D)), jnp.float32)
+    pos0 = jnp.asarray([0, L - T, 17], jnp.int32)
+    got = np.asarray(flash_block_decode(q, kc, vc, pos0, scale,
+                                        interpret=True, block_k=16))
+    np.testing.assert_allclose(got,
+                               _block_oracle(q, kc, vc, pos0, scale),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_block_decode_int8(data):
+    _, kc, vc, scale = data
+    rng = np.random.default_rng(9)
+    T = 4
+    q = jnp.asarray(rng.standard_normal((B, T, NH, D)), jnp.float32)
+    qk, ks = _quantize_kv(kc)
+    qv, vs = _quantize_kv(vc)
+    kd = jnp.asarray(np.asarray(qk, np.float32)
+                     * np.asarray(ks)[..., None])
+    vd = jnp.asarray(np.asarray(qv, np.float32)
+                     * np.asarray(vs)[..., None])
+    got = np.asarray(flash_block_decode(q, qk, qv, 21, scale, ks, vs,
+                                        interpret=True, block_k=32))
+    assert np.isfinite(got).all()
+    np.testing.assert_allclose(got, _block_oracle(q, kd, vd, 21, scale),
+                               rtol=1e-2, atol=1e-2)
+
+
+def test_block_T1_is_flash_decode(data):
+    """T=1 block == single-token flash decode BITWISE — the shared-
+    numerics argument speculative losslessness rests on requires the
+    degenerate case to be the same computation, not a near one."""
+    q, kc, vc, scale = data
+    a = np.asarray(flash_decode(q, kc, vc, 13, scale, interpret=True,
+                                block_k=16))
+    b = np.asarray(flash_block_decode(q, kc, vc, 13, scale,
+                                      interpret=True, block_k=16))
+    np.testing.assert_array_equal(a, b)
 
 
 def test_jittable_and_sharded(data):
