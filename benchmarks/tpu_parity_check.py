@@ -53,16 +53,22 @@ def check_kernel():
 
 def check_speculative(kv_heads=None, kv_cache_dtype=None):
     import dataclasses
-    cfg = TransformerConfig(vocab=4096, d_model=256, n_heads=8,
+    # head_dim must pass can_flash_decode (64 or %128==0) or both
+    # paths silently take the einsum fallback and the check pins
+    # nothing: 512/8 = 64
+    cfg = TransformerConfig(vocab=4096, d_model=512, n_heads=8,
                             n_layers=4, d_ff=1024, dtype="bfloat16")
     if kv_heads:
         cfg = dataclasses.replace(cfg, n_kv_heads=kv_heads,
                                   pos_encoding="rope")
     if kv_cache_dtype:
         cfg = dataclasses.replace(cfg, kv_cache_dtype=kv_cache_dtype)
-    dcfg = dataclasses.replace(cfg, n_layers=1, d_model=128,
+    dcfg = dataclasses.replace(cfg, n_layers=1, d_model=256,
                                n_heads=4, d_ff=256,
                                n_kv_heads=None)
+    from rlo_tpu.pallas.decode import can_flash_decode
+    assert can_flash_decode(32 + 48 + 4, cfg.head_dim), \
+        "config fails the flash gate; this check would pin nothing"
     params = init_params(jax.random.PRNGKey(0), cfg)
     dparams = init_params(jax.random.PRNGKey(1), dcfg)
     rng = np.random.default_rng(2)

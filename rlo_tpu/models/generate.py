@@ -176,11 +176,25 @@ def _attend_cache_block(q, k_cache, v_cache, pos_q, scale,
         from rlo_tpu.pallas.decode import (_block_fits_vmem,
                                            can_flash_decode)
         itemsize = 4 if k_cache.dtype == jnp.float32 else 2
-        use_flash = (pos0 is not None
-                     and jax.default_backend() == "tpu"
-                     and can_flash_decode(max_len, hd)
-                     and _block_fits_vmem(max_len, hd, nkv, nh // nkv,
-                                          T, itemsize))
+        gate = (pos0 is not None
+                and jax.default_backend() == "tpu"
+                and can_flash_decode(max_len, hd))
+        fits = gate and _block_fits_vmem(max_len, hd, nkv, nh // nkv,
+                                         T, itemsize)
+        if gate and not fits:
+            # T=1 would flash but this block cannot share its tiling:
+            # the einsum fallback DIVERGES numerically from the flash
+            # decode step, so speculative greedy parity degrades to
+            # near-tie class in this regime — warn, don't hide it
+            import warnings
+            warnings.warn(
+                f"block attend T={T} exceeds the VMEM budget at the "
+                f"T=1 flash tiling (nkv={nkv}, head_dim={hd}, "
+                f"max_len={max_len}); falling back to einsum — verify "
+                f"numerics will NOT match the flash decode step "
+                f"(use a smaller gamma for exact speculative parity)",
+                stacklevel=2)
+        use_flash = fits
     if use_flash:
         from rlo_tpu.pallas.decode import flash_block_decode
         return flash_block_decode(q, k_cache, v_cache, pos0, scale,

@@ -21,7 +21,11 @@ flash-decode kernel at T=1 and the verify block_decode uses the same
 kernel at T=gamma (pallas.decode.flash_block_decode), with identical
 tile shapes, accumulation order, and dot dtypes per query row — and on
 CPU both take the einsum path, so the parity holds by shared numerics
-on both backends (pinned on-chip by benchmarks/tpu_parity_check.py —
+on both backends. One carve-out: a gamma-wide block too large for
+VMEM at the T=1 tiling (pallas.decode._block_fits_vmem; needs extreme
+nkv*gamma*head_dim, far beyond any shipped config at gamma <= 8)
+falls back to einsum with a RuntimeWarning and the parity degrades to
+near-tie class there (pinned on-chip by benchmarks/tpu_parity_check.py —
 run on the real TPU, outside the CPU-forced pytest conftest — and by
 the CPU oracles in tests/test_speculative.py always).
 
@@ -45,6 +49,7 @@ from __future__ import annotations
 
 from typing import Optional
 
+import jax
 import jax.numpy as jnp
 from jax import lax
 
@@ -57,20 +62,46 @@ def speculative_generate(params: dict, draft_params: dict, prompt,
                          cfg: TransformerConfig,
                          draft_cfg: TransformerConfig, *,
                          max_new: int, gamma: int = 4,
-                         max_len: Optional[int] = None):
-    """Greedy speculative continuation of ``prompt`` (b, plen) int32:
-    returns (b, max_new) int32 — IDENTICAL to
-    ``generate(params, prompt, cfg, max_new=max_new)`` by the
-    lossless-acceptance construction; the draft only changes how fast
-    the tokens arrive. ``gamma`` = draft tokens proposed per round.
+                         max_len: Optional[int] = None,
+                         temperature: float = 0.0,
+                         rng=None, return_rounds: bool = False):
+    """Speculative continuation of ``prompt`` (b, plen) int32: returns
+    (b, max_new) int32. ``gamma`` = draft tokens proposed per round.
     Both configs must share the vocabulary; the draft is typically a
     much smaller model (fewer layers / narrower).
+
+    temperature == 0 (default): greedy — IDENTICAL to
+    ``generate(params, prompt, cfg, max_new=max_new)`` by the
+    lossless-acceptance construction; the draft only changes how fast
+    the tokens arrive.
+
+    temperature > 0 (needs ``rng``): LOSSLESS speculative SAMPLING —
+    the standard rejection scheme: the draft SAMPLES x_i ~ p_d, the
+    target accepts x_i with probability min(1, p_t(x_i)/p_d(x_i)), and
+    the first rejected position resamples from the residual
+    norm(max(p_t - p_d, 0)). Each emitted token is distributed exactly
+    as plain temperature sampling from the target — in DISTRIBUTION,
+    not trajectory (the rejection scheme spends randomness differently
+    than `generate`'s per-step categorical, so token-for-token equality
+    is not defined; tests/test_speculative.py pins the distributional
+    equality statistically and the all-accept behavior exactly).
+    A round emits n_acc + 1 tokens (the accepted prefix + the
+    adjustment sample), capped at gamma when every draft is accepted —
+    the same [1, gamma] per-round yield as the greedy path.
+
+    ``return_rounds``: also return the number of verify rounds taken
+    (b-invariant scalar) — rounds * (gamma draft steps + 1 verify) is
+    the realized cost, and max_new / rounds the realized per-round
+    yield, which benchmarks/spec_bench.py turns into the measured
+    acceptance-driven speedup.
     """
     if cfg.vocab != draft_cfg.vocab:
         raise ValueError(
             f"draft vocab {draft_cfg.vocab} != target vocab {cfg.vocab}")
     if gamma < 1:
         raise ValueError("gamma >= 1 required")
+    if temperature > 0 and rng is None:
+        raise ValueError("sampling (temperature > 0) needs rng")
     b, plen = prompt.shape
     # + gamma slack: the last round's block writes reach at most
     # position plen + max_new - 1 + gamma (garbage tail, never read)
@@ -84,12 +115,20 @@ def speculative_generate(params: dict, draft_params: dict, prompt,
     t_logits, t_cache = prefill(params, prompt, t_cache, cfg)
     _, d_cache = prefill(draft_params, prompt, d_cache, draft_cfg)
 
-    # first token: the target's own prefill prediction. Invariant from
-    # here on (per row): out[:n_out] emitted; last_tok = out[n_out-1]
-    # sits at sequence position pos-? — precisely, both caches are
-    # validly filled through position pos-1 and last_tok has NOT been
-    # processed by either model yet; last_tok's position is pos.
-    first = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)     # (b,)
+    sampling = temperature > 0
+    if sampling:
+        rng, k0 = jax.random.split(rng)
+        first = jax.random.categorical(
+            k0, t_logits / temperature, axis=-1).astype(jnp.int32)
+        key0 = rng
+    else:
+        first = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)  # (b,)
+        key0 = jnp.zeros((2,), jnp.uint32)  # unused carry slot
+
+    # first token: the target's own prefill prediction (or sample).
+    # Invariant from here on (per row): out[:n_out] emitted; both
+    # caches are validly filled through position pos-1 and last_tok
+    # has NOT been processed by either model yet; its position is pos.
     out = jnp.zeros((b, max_new), jnp.int32)
     out = out.at[:, 0].set(first)
     n_out = jnp.ones((b,), jnp.int32)
@@ -98,17 +137,28 @@ def speculative_generate(params: dict, draft_params: dict, prompt,
     rows = jnp.arange(b)
 
     def round_body(state):
-        out, n_out, pos, last_tok, t_cache, d_cache, rounds = state
+        out, n_out, pos, last_tok, t_cache, d_cache, rounds, key = state
         done = n_out >= max_new
+        if sampling:
+            key, kd, ka, kr = jax.random.split(key, 4)
+            dkeys = jax.random.split(kd, gamma)
 
         # --- draft rollout: gamma ragged decode steps ---------------
         cur = last_tok
         dc = d_cache
         d_toks = []
+        d_probs = []
         for i in range(gamma):
             logits, dc = decode_step(draft_params, cur, pos + i, dc,
                                      draft_cfg)
-            cur = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            if sampling:
+                d_probs.append(jax.nn.softmax(
+                    logits.astype(jnp.float32) / temperature, axis=-1))
+                cur = jax.random.categorical(
+                    dkeys[i], logits / temperature,
+                    axis=-1).astype(jnp.int32)
+            else:
+                cur = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             d_toks.append(cur)
         d_mat = jnp.stack(d_toks, axis=1)                  # (b, gamma)
 
@@ -116,34 +166,82 @@ def speculative_generate(params: dict, draft_params: dict, prompt,
         block = jnp.concatenate([last_tok[:, None],
                                  d_mat[:, :gamma - 1]], axis=1)
         v_logits, tc = block_decode(params, block, pos, t_cache, cfg)
-        t_pred = jnp.argmax(v_logits, axis=-1).astype(jnp.int32)
 
-        # --- lossless acceptance ------------------------------------
-        acc = (d_mat == t_pred)                            # (b, gamma)
-        n_acc = jnp.cumprod(acc, axis=1).sum(axis=1)       # in [0, g]
-        j = jnp.minimum(n_acc, gamma - 1)                  # (b,)
-        # emitted tokens this round are t_pred[:, :j+1] — the target's
-        # own predictions (accepted drafts EQUAL them; the bonus IS
-        # one), which is the whole losslessness argument
-        n_emit = jnp.where(done, 0, j + 1)
+        if not sampling:
+            t_pred = jnp.argmax(v_logits, axis=-1).astype(jnp.int32)
+            # --- lossless greedy acceptance -------------------------
+            acc = (d_mat == t_pred)                        # (b, gamma)
+            n_acc = jnp.cumprod(acc, axis=1).sum(axis=1)   # in [0, g]
+            j = jnp.minimum(n_acc, gamma - 1)              # (b,)
+            # emitted tokens this round are t_pred[:, :j+1] — the
+            # target's own predictions (accepted drafts EQUAL them;
+            # the bonus IS one): the whole losslessness argument
+            n_emit_raw = j + 1
+            emit_at = lambda i: t_pred[:, i]  # noqa: E731
+            emit_ok = lambda i: i <= j        # noqa: E731
+            new_last_live = t_pred[rows, j]
+        else:
+            # --- lossless rejection sampling ------------------------
+            # accept x_i with prob min(1, p_t(x_i) / p_d(x_i)); first
+            # rejection resamples from norm(max(p_t - p_d, 0)) — each
+            # emitted token is exactly target-temperature-distributed
+            p_t = jax.nn.softmax(
+                v_logits.astype(jnp.float32) / temperature, axis=-1)
+            p_d = jnp.stack(d_probs, axis=1)           # (b, g, V)
+            idx = d_mat[..., None]
+            pt_x = jnp.take_along_axis(p_t, idx, -1)[..., 0]  # (b, g)
+            pd_x = jnp.take_along_axis(p_d, idx, -1)[..., 0]
+            u = jax.random.uniform(ka, (b, gamma))
+            accept = u * pd_x < pt_x       # u < pt/pd, division-free
+            n_acc = jnp.cumprod(accept, axis=1).sum(axis=1)  # [0, g]
+            j = jnp.minimum(n_acc, gamma - 1)
+            # residual distribution at the first rejected position
+            p_t_j = jnp.take_along_axis(p_t, j[:, None, None],
+                                        1)[:, 0]          # (b, V)
+            p_d_j = jnp.take_along_axis(p_d, j[:, None, None],
+                                        1)[:, 0]
+            resid = jnp.maximum(p_t_j - p_d_j, 0.0)
+            s = resid.sum(-1, keepdims=True)
+            res_logits = jnp.where(resid > 0,
+                                   jnp.log(jnp.maximum(resid, 1e-38)),
+                                   -1e30)
+            # p_t == p_d exactly (s == 0): the residual is empty and
+            # any sample from p_t is already correct — fall back
+            fb_logits = jnp.log(jnp.maximum(p_t_j, 1e-38))
+            y = jax.random.categorical(
+                kr, jnp.where(s > 0, res_logits, fb_logits),
+                axis=-1).astype(jnp.int32)                # (b,)
+            # all gamma accepted -> emit them all (no bonus: the
+            # target never processed x_{gamma-1}, same as greedy);
+            # else the accepted prefix + the adjustment sample
+            n_emit_raw = jnp.where(n_acc == gamma, gamma, n_acc + 1)
+            emit_at = lambda i: jnp.where(  # noqa: E731
+                i < n_acc, d_mat[:, i], y)
+            emit_ok = lambda i: i < n_emit_raw  # noqa: E731
+            new_last_live = jnp.where(n_acc == gamma,
+                                      d_mat[:, gamma - 1], y)
+
+        n_emit = jnp.where(done, 0, n_emit_raw)
         for i in range(gamma):
-            idx = jnp.minimum(n_out + i, max_new - 1)
-            ok = (i <= j) & (n_out + i < max_new) & ~done
-            old = out[rows, idx]
-            out = out.at[rows, idx].set(
-                jnp.where(ok, t_pred[:, i], old))
-        new_last = jnp.where(done, last_tok, t_pred[rows, j])
+            idxw = jnp.minimum(n_out + i, max_new - 1)
+            ok = emit_ok(i) & (n_out + i < max_new) & ~done
+            old = out[rows, idxw]
+            out = out.at[rows, idxw].set(
+                jnp.where(ok, emit_at(i), old))
+        new_last = jnp.where(done, last_tok, new_last_live)
         n_out = jnp.minimum(n_out + n_emit, max_new)
         pos = jnp.where(done, pos, pos + n_emit)
-        return (out, n_out, pos, new_last, tc, dc, rounds + 1)
+        return (out, n_out, pos, new_last, tc, dc, rounds + 1, key)
 
     def cond(state):
-        _, n_out, _, _, _, _, rounds = state
+        _, n_out, _, _, _, _, rounds, _ = state
         # every round emits >= 1 token per unfinished row, so max_new
         # rounds always suffice — the bound makes divergence impossible
         return jnp.any(n_out < max_new) & (rounds < max_new)
 
     state = (out, n_out, pos, last_tok, t_cache, d_cache,
-             jnp.int32(0))
-    out = lax.while_loop(cond, round_body, state)[0]
-    return out
+             jnp.int32(0), key0)
+    final = lax.while_loop(cond, round_body, state)
+    if return_rounds:
+        return final[0], final[6]
+    return final[0]

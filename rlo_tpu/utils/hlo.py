@@ -1,0 +1,58 @@
+"""Lowered-StableHLO text accounting helpers.
+
+The byte-pinning discipline (allreduce_cost / hierarchical_allreduce_cost
+/ all_to_all_cost vs the program XLA actually builds) needs to read
+collective operand shapes out of `lowered.as_text()`. The regexes are
+brittle against JAX printing changes by nature, so they live in exactly
+one place — tests/test_tpu_collectives.py and __graft_entry__ both
+import from here.
+"""
+
+from __future__ import annotations
+
+import re
+
+_DTYPE_BYTES = {"f32": 4, "i32": 4, "f64": 8, "bf16": 2, "i8": 1}
+
+_PERMUTE_RE = re.compile(
+    r'collective_permute"?\(?[^\n]*?source_target_pairs\s*=\s*'
+    r'dense<\[\[(\d+),\s*(\d+)\][^\n]*?'
+    r'tensor<([0-9x]*)x?(f32|f64|i32|bf16|i8)>\)?\s*$',
+    re.MULTILINE)
+
+
+def _elems(dims: str) -> int:
+    n = 1
+    for d in dims.split("x"):
+        if d:
+            n *= int(d)
+    return n
+
+
+def permute_total_bytes(lowered_text: str):
+    """Total collective_permute operand bytes + launch count,
+    pattern-agnostic (ring, XOR halving/doubling, shift-o hops all
+    counted)."""
+    total = n = 0
+    for m in _PERMUTE_RE.finditer(lowered_text):
+        total += _elems(m.group(3)) * _DTYPE_BYTES[m.group(4)]
+        n += 1
+    return total, n
+
+
+def permute_entries(lowered_text: str):
+    """Per-launch (src, dst, nbytes) of the first source-target pair of
+    every collective_permute — enough to classify ring direction or
+    shift offset."""
+    out = []
+    for m in _PERMUTE_RE.finditer(lowered_text):
+        out.append((int(m.group(1)), int(m.group(2)),
+                    _elems(m.group(3)) * _DTYPE_BYTES[m.group(4)]))
+    return out
+
+
+def all_gather_operands(lowered_text: str):
+    """(elems, dtype) of every all_gather operand in the text."""
+    return [(_elems(dims), dt) for dims, dt in re.findall(
+        r'all_gather[^\n]*?:\s*\(tensor<([0-9x]+)x'
+        r'(f32|f64|i32|bf16|i8)>\)', lowered_text)]

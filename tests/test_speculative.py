@@ -112,6 +112,102 @@ def test_argument_errors(setup):
                              max_new=4, gamma=2, max_len=8)
 
 
+def test_sampling_needs_rng(setup):
+    params, dparams, prompt = setup
+    with pytest.raises(ValueError, match="rng"):
+        speculative_generate(params, dparams, prompt, CFG, DRAFT,
+                             max_new=4, temperature=0.7)
+
+
+def test_sampling_self_draft_accepts_everything(setup):
+    """draft == target at the same temperature: p_t == p_d up to the
+    T=1-vs-block einsum association, so acceptance u < pt/pd ~ 1 is
+    (near-)certain and the loop takes the minimum number of rounds."""
+    params, _, prompt = setup
+    max_new, gamma = 13, 4
+    out, rounds = speculative_generate(
+        params, params, prompt, CFG, CFG, max_new=max_new, gamma=gamma,
+        temperature=0.8, rng=jax.random.PRNGKey(3), return_rounds=True)
+    assert out.shape == (3, max_new)
+    assert (np.asarray(out) >= 0).all()
+    assert (np.asarray(out) < CFG.vocab).all()
+    # 1 prefill token + gamma/round: ceil(12 / 4) = 3 rounds (+1 slack
+    # for a last-bit fp rejection between the two einsum shapes)
+    assert int(rounds) <= -(-(max_new - 1) // gamma) + 1
+
+
+def test_sampling_matches_target_distribution():
+    """The rejection scheme's output must be distributed EXACTLY like
+    plain temperature sampling from the target. Position 0 samples
+    from the prefill logits directly; position 1's exact marginal is
+    enumerable on a tiny vocab: p1(w) = sum_t0 p0(t0) p(w | t0).
+    Compare the speculative empirical marginal (heavy rejection path:
+    an unrelated random draft) against that exact distribution."""
+    vocab = 23
+    cfg = TransformerConfig(vocab=vocab, d_model=16, n_heads=2,
+                            n_layers=2, d_ff=32, dtype="float32")
+    dcfg = TransformerConfig(vocab=vocab, d_model=8, n_heads=1,
+                             n_layers=1, d_ff=16, dtype="float32")
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    dparams = init_params(jax.random.PRNGKey(1), dcfg)
+    temp = 1.3
+    prompt = jnp.asarray([[5, 11, 2]], jnp.int32)
+
+    # exact marginals from the target alone
+    cache = init_kv_cache(cfg, 1, 8)
+    logits0, cache = __import__("rlo_tpu.models.generate",
+                                fromlist=["prefill"]).prefill(
+        params, prompt, cache, cfg)
+    p0 = jax.nn.softmax(logits0[0] / temp)                 # (V,)
+
+    def next_probs(t0):
+        lg, _ = decode_step(params, jnp.asarray([t0], jnp.int32), 3,
+                            cache, cfg)
+        return jax.nn.softmax(lg[0] / temp)
+
+    P1 = jax.vmap(next_probs)(jnp.arange(vocab))           # (V, V)
+    p1_exact = np.asarray(p0 @ P1)
+
+    n = 4096
+    f = jax.jit(jax.vmap(lambda key: speculative_generate(
+        params, dparams, prompt, cfg, dcfg, max_new=2, gamma=3,
+        temperature=temp, rng=key)[0]))
+    outs = np.asarray(f(jax.random.split(jax.random.PRNGKey(7), n)))
+    for posn, exact in ((0, np.asarray(p0)), (1, p1_exact)):
+        emp = np.bincount(outs[:, posn], minlength=vocab) / n
+        tv = 0.5 * np.abs(emp - exact).sum()
+        assert tv < 0.07, (posn, tv)
+
+
+def test_sampling_lossless_vs_plain_sampling_stats():
+    """Same check against plain generate's own empirical marginals —
+    the two samplers must be statistically indistinguishable."""
+    vocab = 23
+    cfg = TransformerConfig(vocab=vocab, d_model=16, n_heads=2,
+                            n_layers=2, d_ff=32, dtype="float32")
+    dcfg = TransformerConfig(vocab=vocab, d_model=8, n_heads=1,
+                             n_layers=1, d_ff=16, dtype="float32")
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    dparams = init_params(jax.random.PRNGKey(1), dcfg)
+    temp, n, max_new = 0.9, 4096, 3
+    prompt = jnp.asarray([[1, 7]], jnp.int32)
+    f_spec = jax.jit(jax.vmap(lambda key: speculative_generate(
+        params, dparams, prompt, cfg, dcfg, max_new=max_new, gamma=2,
+        temperature=temp, rng=key)[0]))
+    f_plain = jax.jit(jax.vmap(lambda key: generate(
+        params, prompt, cfg, max_new=max_new, temperature=temp,
+        rng=key)[0]))
+    keys_a = jax.random.split(jax.random.PRNGKey(21), n)
+    keys_b = jax.random.split(jax.random.PRNGKey(22), n)
+    a = np.asarray(f_spec(keys_a))
+    bb = np.asarray(f_plain(keys_b))
+    for posn in range(max_new):
+        ea = np.bincount(a[:, posn], minlength=vocab) / n
+        eb = np.bincount(bb[:, posn], minlength=vocab) / n
+        tv = 0.5 * np.abs(ea - eb).sum()
+        assert tv < 0.09, (posn, tv)
+
+
 @pytest.mark.parametrize("variant", ["dense", "gqa_rope", "int8"])
 def test_block_decode_matches_sequential(variant):
     """block_decode (the verify primitive) == T sequential

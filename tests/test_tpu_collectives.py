@@ -408,6 +408,170 @@ class TestAllreduceCostModel:
             tc.allreduce_cost("psum", 8, n)
 
 
+from rlo_tpu.utils.hlo import permute_total_bytes as _permute_total_bytes  # noqa: E402,E501
+
+
+class TestRound5CostModels:
+    """Round-5 VERDICT item 5: the round-4 schedules (hierarchical,
+    int8-DCN, all_to_all) get the same lowered-HLO byte pinning the
+    ring family got in round 3 — the claims hold by construction."""
+
+    def test_hierarchical_ici_hlo_bytes_match_model(self):
+        """pow-2 slice: halving RS + doubling AG are fully unrolled,
+        so every collective_permute in the program is ICI-tier and
+        their byte total must equal the model exactly (the DCN psum
+        lowers to all_reduce, not permutes)."""
+        wd, wi = 2, 4
+        mesh = make_mesh((wd, wi), ("dcn", "ici"))
+        per_shard = wi * 96
+        x = jnp.zeros((wd, wi, per_shard), jnp.float32)
+        f = shard_jit(
+            lambda v: tc.hierarchical_allreduce(v, "ici", "dcn",
+                                                use_pallas=False),
+            mesh, P("dcn", "ici"), P("dcn", "ici"))
+        txt = f.lower(x).as_text()
+        total, n = _permute_total_bytes(txt)
+        model = tc.hierarchical_allreduce_cost(wi, wd, per_shard * 4)
+        assert total == model["ici_bytes"] \
+            == 2 * (wi - 1) * (per_shard // wi) * 4
+        assert n == model["ici_permutes"]
+        # the dcn all_reduce operand is the scattered shard, and the
+        # model's element count states exactly that
+        assert model["dcn_elems"] == per_shard // wi
+        # the wi-fold DCN claim the hierarchy exists for
+        assert model["dcn_bytes"] * wi == model["dcn_bytes_flat"]
+
+    def test_hierarchical_int8_dcn_bytes_match_model(self):
+        """int8 DCN hop: the lowered all_gather carries exactly the
+        model's dcn_elems as i8 — the byte claim on the wire."""
+        import re
+        wd, wi = 2, 4
+        mesh = make_mesh((wd, wi), ("dcn", "ici"))
+        per_shard = wi * 64
+        x = jnp.zeros((wd, wi, per_shard), jnp.float32)
+        f = shard_jit(
+            lambda v: tc.hierarchical_allreduce(v, "ici", "dcn",
+                                                dcn_algorithm="int8",
+                                                use_pallas=False),
+            mesh, P("dcn", "ici"), P("dcn", "ici"))
+        txt = f.lower(x).as_text()
+        model = tc.hierarchical_allreduce_cost(
+            wi, wd, per_shard * 4, dcn_algorithm="int8")
+        payload = []
+        for dims, dt in re.findall(
+                r'all_gather.*?replica_groups\s*=\s*dense<\[\[\d+,\s*\d+\]'
+                r'[^\n]*?:\s*\(tensor<([0-9x]+)x(i8|f32)>\)', txt):
+            if dt == "i8":
+                elems = 1
+                for d in dims.split("x"):
+                    elems *= int(d)
+                payload.append(elems)
+        assert payload and all(p == model["dcn_elems"]
+                               for p in payload), payload
+        # per-rank dcn bytes: (wd-1) int8 chunks + (wd-1) 4-byte scales
+        assert model["dcn_bytes"] == (wd - 1) * (model["dcn_elems"] + 4)
+
+    def test_int8_crossover_pinned(self):
+        """The docstring's 8/ws_dcn crossover, pinned numerically:
+        gain below 8 slices, parity at 8, loss beyond (sidecar scale
+        bytes excluded by using a large chunk)."""
+        n = 1 << 20
+        for wd, expect in ((2, 4.0), (4, 2.0), (8, 1.0), (16, 0.5)):
+            c = tc.hierarchical_allreduce_cost(
+                4, wd, n, dcn_algorithm="int8")
+            assert abs(c["dcn_compression"] - expect) < 0.01, (wd, c)
+
+    def test_all_to_all_direct_hlo_bytes_match_model(self, mesh):
+        """'direct' is an unrolled python loop: ws-1 permutes, offset
+        o carrying one chunk over o ring hops — injected bytes AND
+        hop-weighted link bytes both pinned to the model."""
+        import re
+        chunk = 32
+        x = jnp.zeros((WS, WS, chunk), jnp.float32)
+        f = shard_jit(
+            lambda v: tc.all_to_all(v[0], "x", algorithm="direct")[None],
+            mesh, P("x"), P("x"))
+        txt = f.lower(x).as_text()
+        injected = hop_bytes = n = 0
+        for m in re.finditer(
+                r'collective_permute"?\(?[^\n]*?source_target_pairs\s*=\s*'
+                r'dense<\[\[(\d+),\s*(\d+)\][^\n]*?'
+                r'tensor<([0-9x]*)x?f32>\)?\s*$', txt, re.MULTILINE):
+            src, dst = int(m.group(1)), int(m.group(2))
+            elems = 1
+            for d in m.group(3).split("x"):
+                if d:
+                    elems *= int(d)
+            o = (dst - src) % WS
+            injected += elems * 4
+            hop_bytes += o * elems * 4
+            n += 1
+        model = tc.all_to_all_cost("direct", WS, WS * chunk * 4)
+        assert n == model["n_permutes"] == WS - 1
+        assert injected == model["injected_bytes"]
+        assert hop_bytes == model["link_hop_bytes"]
+
+    def test_all_to_all_cost_totals(self):
+        """ring pays exactly 2x direct's link bytes (the docstring
+        claim); xla is modeled at direct's optimum."""
+        n = 1 << 16
+        d = tc.all_to_all_cost("direct", 8, n)
+        r = tc.all_to_all_cost("ring", 8, n)
+        xl = tc.all_to_all_cost("xla", 8, n)
+        assert r["link_hop_bytes"] == 2 * d["link_hop_bytes"]
+        assert xl["link_hop_bytes"] == d["link_hop_bytes"]
+        assert d["injected_bytes"] == 7 * n // 8
+        assert r["injected_bytes"] == 7 * n
+        assert tc.all_to_all_cost("direct", 1, 0)["n_permutes"] == 0
+        with pytest.raises(ValueError, match="divide"):
+            tc.all_to_all_cost("direct", 8, n + 1)
+        with pytest.raises(ValueError, match="no cost model"):
+            tc.all_to_all_cost("nope", 8, n)
+
+    def test_hierarchical_forced_ring_on_pow2_pinned(self):
+        """ici_algorithm='ring' on a pow-2 slice: the RS honors the
+        forced ring but the AG is doubling (picked by pow2 alone) —
+        the model must describe THAT mixed program, launch count
+        included."""
+        wd, wi = 2, 4
+        mesh = make_mesh((wd, wi), ("dcn", "ici"))
+        per_shard = wi * 96
+        x = jnp.zeros((wd, wi, per_shard), jnp.float32)
+        f = shard_jit(
+            lambda v: tc.hierarchical_allreduce(v, "ici", "dcn",
+                                                ici_algorithm="ring",
+                                                use_pallas=False),
+            mesh, P("dcn", "ici"), P("dcn", "ici"))
+        txt = f.lower(x).as_text()
+        total, n = _permute_total_bytes(txt)
+        model = tc.hierarchical_allreduce_cost(wi, wd, per_shard * 4,
+                                               ici_algorithm="ring")
+        chunk = per_shard // wi * 4
+        # NOTE: the ring RS here is python-unrolled? No — it's a
+        # fori_loop; static text shows ONE loop-body permute + the
+        # ownership rotation + unrolled doubling AG. Pin the static
+        # text pieces and the model total separately.
+        k = wi.bit_length() - 1
+        assert model["ici_permutes"] == wi + k
+        assert model["ici_bytes"] == (2 * wi - 1) * chunk
+        # static text: 1 rolled RS permute + 1 rotation + k doubling
+        assert n == 2 + k, txt.count("collective_permute")
+        assert total == 2 * chunk + (wi - 1) * chunk
+
+    def test_hierarchical_cost_non_pow2_and_errors(self):
+        c = tc.hierarchical_allreduce_cost(3, 2, 3 * 40)
+        # ring RS (2 steps + rotation) + ring AG (2 steps), 40-byte
+        # chunks (30 elems pad to 10/shard)
+        assert c["ici_bytes"] == (2 * 3 - 1) * 40
+        assert c["ici_permutes"] == 2 * (3 - 1) + 1
+        one = tc.hierarchical_allreduce_cost(1, 4, 64)
+        assert one["ici_bytes"] == 0 and one["dcn_elems"] == 16
+        none = tc.hierarchical_allreduce_cost(4, 1, 64)
+        assert none["dcn_bytes"] == 0
+        with pytest.raises(ValueError, match="multiple"):
+            tc.hierarchical_allreduce_cost(4, 2, 63)
+
+
 class TestReduceScatterAllGather:
     @pytest.mark.parametrize("algorithm", ["ring", "halving", "auto"])
     def test_reduce_scatter_chunks(self, mesh, algorithm):
