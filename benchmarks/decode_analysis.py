@@ -259,10 +259,15 @@ def main():
     t_step = chain_time(step_chain, tok0, model_bytes, label="step")
 
     # ---- budget table ----------------------------------------------
+    # the logits probe streams the fold matrix too (d*vocab extra
+    # bytes the real step does not have) — charge the step's budget
+    # only the head's byte share of the probe time, or the residual
+    # is understated by the fold's stream time
+    head_share = (d * vocab * wbytes) / (d * vocab * wbytes
+                                         + logits_extra)
     comp_t = {"ffn": t_ffn1 * nl, "qkv_wo": t_qkv1 * nl,
-              "logits": t_logits, "attend": t_attend1 * nl}
+              "logits": t_logits * head_share, "attend": t_attend1 * nl}
     meas_bytes = dict(comp_bytes)
-    meas_bytes["logits"] += logits_extra
     resid = t_step - sum(comp_t.values())
     print(f"\nwindow streaming probe: {gbps_window:.0f} GB/s delivered "
           f"({gbps_window/V5E_HBM_GBPS:.1%} of 819 nominal)",

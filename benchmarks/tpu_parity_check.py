@@ -66,18 +66,19 @@ def check_speculative(kv_heads=None, kv_cache_dtype=None):
     dcfg = dataclasses.replace(cfg, n_layers=1, d_model=256,
                                n_heads=4, d_ff=256,
                                n_kv_heads=None)
+    plen, max_new, gamma = 32, 48, 4
     from rlo_tpu.pallas.decode import can_flash_decode
-    assert can_flash_decode(32 + 48 + 4, cfg.head_dim), \
+    assert can_flash_decode(plen + max_new + gamma, cfg.head_dim), \
         "config fails the flash gate; this check would pin nothing"
     params = init_params(jax.random.PRNGKey(0), cfg)
     dparams = init_params(jax.random.PRNGKey(1), dcfg)
     rng = np.random.default_rng(2)
-    prompt = jnp.asarray(rng.integers(0, cfg.vocab, (2, 32)), jnp.int32)
-    max_new = 48
+    prompt = jnp.asarray(rng.integers(0, cfg.vocab, (2, plen)),
+                         jnp.int32)
     want = np.asarray(jax.jit(lambda p, t: generate(
         p, t, cfg, max_new=max_new))(params, prompt))
     got = np.asarray(jax.jit(lambda p, d, t: speculative_generate(
-        p, d, t, cfg, dcfg, max_new=max_new, gamma=4))(
+        p, d, t, cfg, dcfg, max_new=max_new, gamma=gamma))(
             params, dparams, prompt))
     n_mismatch = int((got != want).sum())
     tag = (f"kv_heads={kv_heads} cache={kv_cache_dtype}"

@@ -457,15 +457,8 @@ class TestRound5CostModels:
         txt = f.lower(x).as_text()
         model = tc.hierarchical_allreduce_cost(
             wi, wd, per_shard * 4, dcn_algorithm="int8")
-        payload = []
-        for dims, dt in re.findall(
-                r'all_gather.*?replica_groups\s*=\s*dense<\[\[\d+,\s*\d+\]'
-                r'[^\n]*?:\s*\(tensor<([0-9x]+)x(i8|f32)>\)', txt):
-            if dt == "i8":
-                elems = 1
-                for d in dims.split("x"):
-                    elems *= int(d)
-                payload.append(elems)
+        from rlo_tpu.utils.hlo import all_gather_operands
+        payload = [e for e, dt in all_gather_operands(txt) if dt == "i8"]
         assert payload and all(p == model["dcn_elems"]
                                for p in payload), payload
         # per-rank dcn bytes: (wd-1) int8 chunks + (wd-1) 4-byte scales
@@ -492,19 +485,12 @@ class TestRound5CostModels:
             lambda v: tc.all_to_all(v[0], "x", algorithm="direct")[None],
             mesh, P("x"), P("x"))
         txt = f.lower(x).as_text()
+        from rlo_tpu.utils.hlo import permute_entries
         injected = hop_bytes = n = 0
-        for m in re.finditer(
-                r'collective_permute"?\(?[^\n]*?source_target_pairs\s*=\s*'
-                r'dense<\[\[(\d+),\s*(\d+)\][^\n]*?'
-                r'tensor<([0-9x]*)x?f32>\)?\s*$', txt, re.MULTILINE):
-            src, dst = int(m.group(1)), int(m.group(2))
-            elems = 1
-            for d in m.group(3).split("x"):
-                if d:
-                    elems *= int(d)
+        for src, dst, nbytes in permute_entries(txt):
             o = (dst - src) % WS
-            injected += elems * 4
-            hop_bytes += o * elems * 4
+            injected += nbytes
+            hop_bytes += o * nbytes
             n += 1
         model = tc.all_to_all_cost("direct", WS, WS * chunk * 4)
         assert n == model["n_permutes"] == WS - 1
