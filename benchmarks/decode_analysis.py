@@ -55,7 +55,7 @@ from rlo_tpu.models.transformer import (TransformerConfig,  # noqa: E402
 V5E_HBM_GBPS = 819.0
 
 
-def chain_time(run, x0, exp_bytes, *, pairs=7, label=""):
+def chain_time(run, x0, exp_bytes, *, pairs=7, label="", max_k=4096):
     """Per-op seconds for a chained loop ``run(x0, kk)``.
 
     Tunnel-budget-aware replacement for bench._chain_time: the
@@ -68,7 +68,7 @@ def chain_time(run, x0, exp_bytes, *, pairs=7, label=""):
     each pair (memory: tunnel-bench-protocols)."""
     import time
     t_exp = max(exp_bytes / (V5E_HBM_GBPS * 1e9), 2e-7)
-    k = int(min(4096, max(8, 0.25 / t_exp)))
+    k = int(min(max_k, max(8, 0.25 / t_exp)))
     np.asarray(run(x0, k))
     np.asarray(run(x0, 2 * k))  # compile + warm both
     np.asarray(run(x0, k))
@@ -143,8 +143,11 @@ def main():
         batch, plen, win = args.batch, args.plen, args.n_window
 
     max_len = plen + win
-    pos = plen + win // 2            # mid-window position, as the
-    params = init_params(jax.random.PRNGKey(0), cfg)  # bench differences
+    # mid-differencing-window position (decode_bench differences
+    # max_new = win/3 vs win): component probes use it; the flash
+    # attend streams the FULL allocated max_len regardless
+    pos = plen + (win // 3 + win) // 2
+    params = init_params(jax.random.PRNGKey(0), cfg)
     n_params = _count_params(params)
     on_tpu = jax.default_backend() == "tpu"
     dt = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
@@ -243,20 +246,40 @@ def main():
         attend_chain, q0, 2 * batch * kvh * max_len * hd * wbytes,
         label="attend")
 
-    # the full decode step, fixed mid-window position
-    cache = init_kv_cache(cfg, batch, max_len)
-    tok0 = jnp.zeros((batch,), jnp.int32)
+    # the full decode step: whole-`generate` length differencing, the
+    # ONE program shape the tunneled remote compiler reliably handles
+    # (fori chains of the raw decode step kill it with a broken pipe
+    # at any chain length — twice reproduced; decode_bench.py's
+    # methodology note). Same interleaved-pair protocol: per-step =
+    # median[(t(n2) - t(n1)) pair] / (n2 - n1).
+    import time as _time
+    from rlo_tpu.models.generate import generate
+    n1, n2 = win // 3, win
+    prompt = jnp.asarray(rng.integers(0, vocab, (batch, plen)),
+                         jnp.int32)
 
-    @partial(jax.jit, static_argnames=("kk",))
-    def step_chain(tok, kk):
-        def it(i, carry):
-            tok, c = carry
-            logits, c = decode_step(params, tok, pos, c, cfg)
-            return jnp.argmax(logits, -1).astype(jnp.int32), c
-        tok, _ = jax.lax.fori_loop(0, kk, it, (tok, cache))
-        return tok
+    def build(max_new):
+        f = jax.jit(lambda p, t: generate(p, t, cfg, max_new=max_new,
+                                          max_len=max_len))
+        np.asarray(f(params, prompt))
+        return lambda: np.asarray(f(params, prompt))
 
-    t_step = chain_time(step_chain, tok0, model_bytes, label="step")
+    run_hi, run_lo = build(n2), build(n1)
+    run_hi(), run_lo()
+    sdiffs = []
+    for _ in range(9):
+        t0 = _time.perf_counter()
+        run_hi()
+        t1 = _time.perf_counter()
+        run_lo()
+        t2 = _time.perf_counter()
+        sdiffs.append((t1 - t0) - (t2 - t1))
+    smed = float(np.median(sdiffs))
+    if smed <= 0:
+        raise RuntimeError("step differencing swallowed by noise")
+    t_step = smed / (n2 - n1)
+    print(f"  step: generate-differenced per-op {t_step*1e6:.1f} us",
+          file=sys.stderr)
 
     # ---- budget table ----------------------------------------------
     # the logits probe streams the fold matrix too (d*vocab extra

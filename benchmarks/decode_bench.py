@@ -134,10 +134,23 @@ def main():
                          "separate runs of this bench sit in "
                          "different chip-throughput windows and their "
                          "ratio is not trustworthy)")
+    ap.add_argument("--compare-gqa", action="store_true",
+                    help="MHA (16q/16kv) vs GQA (16q/4kv) decode in "
+                         "interleaved pairs at long prompt — the "
+                         "cache-bandwidth win GQA exists for")
+    ap.add_argument("--capacity", action="store_true",
+                    help="max servable batch at --prompt-len context "
+                         "before HBM exhaustion: kv=16/4/4+int8, "
+                         "each PROVEN by allocating the cache and "
+                         "running a decode step at the claimed size")
     args = ap.parse_args()
 
     if args.compare_kv:
         return compare_kv(args)
+    if args.compare_gqa:
+        return compare_gqa(args)
+    if args.capacity:
+        return capacity(args)
 
     if args.ttft:
         return ttft(args)
@@ -292,6 +305,153 @@ def compare_kv(args):
         "vs_baseline": round(ratio, 4),
         "vs_baseline_meaning": "decode-step time ratio act/int8; "
                                ">1 means the int8 cache is faster",
+    }))
+
+
+def compare_gqa(args):
+    """MHA vs GQA decode, drift-immune (round-5 VERDICT item 3a): the
+    kv-heads sweep through the flash-decode kernel at long prompt,
+    where each step's HBM traffic is weights + the live K/V cache and
+    GQA's 4x-smaller cache is a direct bandwidth win. Same interleaved
+    four-program protocol as compare_kv. The GQA config also has
+    smaller K/V projections (that is part of what GQA buys); the
+    metric line reports both models' parameter counts."""
+    import dataclasses
+    if args.tiny:
+        base = TransformerConfig(vocab=128, d_model=64, n_heads=4,
+                                 n_layers=2, d_ff=256, dtype="float32")
+        batch, n1, n2, plen, kvg = args.batch or 2, 4, 48, 16, 2
+    else:
+        base = TransformerConfig(vocab=32768, d_model=1024, n_heads=16,
+                                 n_layers=8, d_ff=4096,
+                                 dtype="bfloat16")
+        batch, n1, n2 = args.batch or 32, 64, 192
+        plen = args.prompt_len if args.prompt_len > 16 else 1024
+        kvg = 4
+    gqa = dataclasses.replace(base, n_kv_heads=kvg)
+    params = {"mha": init_params(jax.random.PRNGKey(0), base),
+              "gqa": init_params(jax.random.PRNGKey(0), gqa)}
+    cfgs = {"mha": base, "gqa": gqa}
+    n_par = {k: sum(int(np.prod(p.shape))
+                    for p in jax.tree.leaves(v))
+             for k, v in params.items()}
+    rng = np.random.default_rng(0)
+    prompt = jnp.asarray(rng.integers(0, base.vocab, (batch, plen)),
+                         jnp.int32)
+    max_len = plen + n2
+
+    def build(kind, max_new):
+        c = cfgs[kind]
+        f = jax.jit(lambda p, t: generate(p, t, c, max_new=max_new,
+                                          max_len=max_len))
+        np.asarray(f(params[kind], prompt))  # compile + warm
+        return lambda: np.asarray(f(params[kind], prompt))
+
+    runs = {(k, n): build(k, n) for k in ("mha", "gqa")
+            for n in (n1, n2)}
+    for f in runs.values():
+        f()
+    ratios, d_m, d_g = [], [], []
+    for _ in range(9):
+        t = {}
+        for key, f in runs.items():
+            t0 = time.perf_counter()
+            f()
+            t[key] = time.perf_counter() - t0
+        dm = t[("mha", n2)] - t[("mha", n1)]
+        dg = t[("gqa", n2)] - t[("gqa", n1)]
+        if dm > 0 and dg > 0:
+            ratios.append(dm / dg)
+            d_m.append(dm)
+            d_g.append(dg)
+    if len(ratios) < 5:
+        raise RuntimeError("compare-gqa: too few valid iterations")
+    ratio = float(np.median(ratios))
+    tok_m = (n2 - n1) * batch / float(np.median(d_m))
+    tok_g = (n2 - n1) * batch / float(np.median(d_g))
+    on_tpu = jax.default_backend() == "tpu"
+    print(f"compare-gqa batch={batch} plen={plen}: "
+          f"{base.n_heads}q/{base.kv_heads}kv {tok_m:,.0f} tok/s  "
+          f"{gqa.n_heads}q/{gqa.kv_heads}kv {tok_g:,.0f} tok/s  "
+          f"interleaved speedup {ratio:.3f}x "
+          f"({len(ratios)}/9 valid)", file=sys.stderr)
+    print(json.dumps({
+        "metric": f"GQA decode speedup {base.n_heads}q/"
+                  f"{gqa.kv_heads}kv vs MHA, batch {batch}, prompt "
+                  f"{plen} ({n_par['mha']/1e6:.0f}M vs "
+                  f"{n_par['gqa']/1e6:.0f}M params, "
+                  f"{'bf16 v5e chip' if on_tpu else jax.default_backend()}"
+                  f", interleaved paired ratio)",
+        "value": round(ratio, 4),
+        "unit": "x",
+        "vs_baseline": round(ratio, 4),
+        "vs_baseline_meaning": "decode-step time ratio MHA/GQA at the "
+                               "same q heads; >1 means the compact "
+                               "cache is faster",
+    }))
+
+
+def capacity(args):
+    """Servable capacity (round-5 VERDICT item 3b): the largest batch
+    of --plen-context rows whose KV cache fits HBM next to the
+    weights, for MHA / GQA / GQA+int8 — PROVEN by allocating the full
+    cache and running one decode step at that size (an analytic claim
+    would hide allocator overheads); the recorded ratio is capacity
+    vs the MHA baseline."""
+    import dataclasses
+    if args.tiny:
+        base = TransformerConfig(vocab=128, d_model=64, n_heads=4,
+                                 n_layers=2, d_ff=256, dtype="float32")
+        L, budget, kvh_g = 128, 64 << 20, 2
+    else:
+        base = TransformerConfig(vocab=32768, d_model=1024, n_heads=16,
+                                 n_layers=8, d_ff=4096,
+                                 dtype="bfloat16")
+        L = args.prompt_len if args.prompt_len > 16 else 4096
+        budget = int(12.5e9)  # leave headroom of the 16 GB for
+        # weights (0.8 GB f32+bf16), activations, and runtime slack
+        kvh_g = 4
+    variants = {
+        "mha": base,
+        "gqa4": dataclasses.replace(base, n_kv_heads=kvh_g),
+        "gqa4_int8": dataclasses.replace(base, n_kv_heads=kvh_g,
+                                         kv_cache_dtype="int8"),
+    }
+    rows = {}
+    for name, cfg in variants.items():
+        elem = (1 + 4 / cfg.head_dim) if cfg.kv_cache_dtype == "int8" \
+            else (2 if cfg.dtype == "bfloat16" else 4)
+        per_row = 2 * cfg.n_layers * cfg.kv_heads * L * cfg.head_dim \
+            * elem
+        b = max(1, int(budget / per_row))
+        from rlo_tpu.models.generate import decode_step, init_kv_cache
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        tok = jnp.zeros((b,), jnp.int32)
+        cache = init_kv_cache(cfg, b, L)
+        step = jax.jit(lambda p, t, c, cfg=cfg: decode_step(
+            p, t, L - 1, c, cfg))
+        logits, cache = step(params, tok, cache)
+        np.asarray(logits[0, :4])  # force execution
+        del cache, logits, params
+        rows[name] = b
+        print(f"capacity {name}: {per_row/2**20:.0f} MB/row at "
+              f"context {L} -> {b} rows allocated AND decoded "
+              f"({b * L / 1e6:.2f}M tokens of live context)",
+              file=sys.stderr)
+    on_tpu = jax.default_backend() == "tpu"
+    print(json.dumps({
+        "metric": f"servable capacity at context {L}: rows allocated+"
+                  f"decoded within a {budget/1e9:.1f} GB cache budget "
+                  f"(mha {rows['mha']}, gqa4 {rows['gqa4']}, "
+                  f"gqa4+int8 {rows['gqa4_int8']}), "
+                  f"{'bf16 v5e chip' if on_tpu else jax.default_backend()}",
+        "value": rows["gqa4_int8"] * L / 1e6,
+        "unit": "Mtokens live context",
+        "vs_baseline": round(rows["gqa4_int8"] / rows["mha"], 2),
+        "vs_baseline_meaning": "capacity ratio gqa4+int8 / MHA "
+                               "(gqa4 alone: "
+                               f"{round(rows['gqa4'] / rows['mha'], 2)}"
+                               "x)",
     }))
 
 

@@ -40,6 +40,15 @@ LEGS = [
     ("flash_gqa_compact_vs_repeated",
      [sys.executable, "benchmarks/flash_bench.py", "--seq", "4096",
       "--heads", "8", "--dim", "128", "--gqa", "2"], 2400),
+    # GQA where it is measurable on one chip (round-5 item 3): the
+    # decode cache-bandwidth win at long prompt, and the servable-
+    # capacity win proven by allocation + a real decode step
+    ("decode_gqa_compare",
+     [sys.executable, "benchmarks/decode_bench.py", "--compare-gqa"],
+     2400),
+    ("decode_capacity",
+     [sys.executable, "benchmarks/decode_bench.py", "--capacity"],
+     2400),
     # long-context decode: the cache (not the weights) is the HBM
     # bound. decode_longctx records the absolute number through the
     # flash-decode kernel; decode_kv_compare measures the int8-cache
@@ -53,13 +62,22 @@ LEGS = [
      [sys.executable, "benchmarks/decode_bench.py",
       "--compare-kv"], 2400),
     # speculative-decoding infra costs at batch 1 (the latency-bound
-    # serving case, where decode is weight-streaming-bound and the
-    # verify amortizes): measured 2026-07-31 verify of gamma=4 tokens
-    # = 1.32 decode steps, draft step 0.04-0.08 of a target step ->
-    # ~2x implied speedup at 80% acceptance
+    # serving case): round-4 recorded verify of gamma=4 = 1.12 decode
+    # steps (~90% of ideal), draft step 0.04-0.08 of a target step
     ("spec_verify_b1",
      [sys.executable, "benchmarks/spec_bench.py", "--batch", "1"],
      2400),
+    # round-5 item 2: the REALIZED speculative speedup — distill a
+    # draft on-chip, measure acceptance and end-to-end tokens/s
+    ("spec_e2e_b1",
+     [sys.executable, "benchmarks/spec_bench.py", "--e2e"], 3000),
+    # round-5 item 1: the decode HBM budget decomposition (per-
+    # component GB/s vs a same-window streaming probe)
+    ("decode_budget",
+     [sys.executable, "benchmarks/decode_analysis.py"], 3300),
+    # round-5 item 6: continuous batching vs naive batch-restart
+    ("serve_continuous",
+     [sys.executable, "benchmarks/serve_bench.py"], 2400),
 ]
 
 
@@ -84,7 +102,14 @@ def run_leg(name, argv, timeout):
         except ValueError:
             continue
     if "result" not in rec:
+        # every leg must emit a parseable JSON result line — a leg
+        # that does not is recorded as BROKEN, not silently tailed
+        # (round-4's flash_gqa leg regression-tracked nothing)
+        rec["unparsed"] = True
         rec["stdout_tail"] = out.strip().splitlines()[-8:]
+        if rc == 0:
+            rc = 1          # broken, not silently tailed
+            rec["rc"] = 1
     if rc != 0:
         rec["stderr_tail"] = (err or "").strip().splitlines()[-8:]
     print(f"  {name}: rc={rc} ({rec['wall_s']}s)", file=sys.stderr)

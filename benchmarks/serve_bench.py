@@ -1,0 +1,148 @@
+"""Continuous batching vs naive batch-restart serving throughput.
+
+Workload: N requests with mixed decode budgets. The naive server
+groups them into batches of n_slots and runs `generate` with
+max_new = the batch's LARGEST budget (finished rows burn steps until
+the batch restarts). The continuous server (models.serve.DecodeServer)
+refills finished slots from the queue every round.
+
+Two readings, both printed:
+  - slot-step efficiency: useful tokens / (decode steps x slots).
+    Deterministic, hardware-independent — the pure scheduling claim.
+    Continuous wastes only round-quantization + tail bubbles; naive
+    wastes (max - budget) per row per batch.
+  - wall tokens/s. Caveat on THIS environment: the tunneled chip's
+    ~110 ms dispatch floor taxes the continuous server once per round
+    (and once per admission prefill) but the naive server only once
+    per batch, so tunnel wall-clock UNDERSTATES continuous batching;
+    on a locally-attached TPU the per-dispatch cost is ~100 us and
+    the efficiency ratio is what wall-clock converges to. The
+    recorded vs_baseline is the efficiency ratio for that reason.
+
+Usage: python benchmarks/serve_bench.py [--tiny] [--n-req N]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from rlo_tpu.models.generate import generate  # noqa: E402
+from rlo_tpu.models.serve import DecodeServer  # noqa: E402
+from rlo_tpu.models.transformer import (TransformerConfig,  # noqa: E402
+                                        init_params)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--tiny", action="store_true")
+    ap.add_argument("--n-req", type=int, default=32)
+    ap.add_argument("--slots", type=int, default=8)
+    ap.add_argument("--round-len", type=int, default=32)
+    args = ap.parse_args()
+
+    if args.tiny:
+        cfg = TransformerConfig(vocab=128, d_model=64, n_heads=4,
+                                n_layers=2, d_ff=256, dtype="float32")
+        n_req, slots, round_len = 8, 2, 4
+        plen_rng, bud_rng, max_len, buckets = (4, 12), (4, 24), 64, (16,)
+    else:
+        cfg = TransformerConfig(vocab=32768, d_model=1024, n_heads=16,
+                                n_layers=8, d_ff=4096,
+                                dtype="bfloat16")
+        n_req, slots, round_len = args.n_req, args.slots, args.round_len
+        plen_rng, bud_rng, max_len, buckets = ((32, 64), (16, 160),
+                                               256, (64,))
+
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    rng = np.random.default_rng(7)
+    reqs = [(rng.integers(0, cfg.vocab, (int(rng.integers(*plen_rng)),)),
+             int(rng.integers(*bud_rng))) for _ in range(n_req)]
+    useful = sum(m for _, m in reqs)
+
+    # ---- continuous ------------------------------------------------
+    srv = DecodeServer(params, cfg, n_slots=slots, max_len=max_len,
+                       round_len=round_len, prompt_buckets=buckets)
+    for p, m in reqs:
+        srv.submit(p, m)
+    # warm round on the SAME server (the jit wrappers are per-
+    # instance), then exclude its already-emitted tokens from the
+    # timed numerator so compile cost and pre-timed work both stay
+    # out of the tokens/s
+    srv.step_round()
+    pre_emitted = sum(len(o) for o in srv._out if o is not None)
+    t0 = time.perf_counter()
+    outs = srv.run()
+    t_cont = time.perf_counter() - t0
+    cont_slot_steps = srv.steps_run * slots
+    timed_tokens = useful - pre_emitted
+    assert len(outs) == n_req
+
+    # ---- naive batch-restart ---------------------------------------
+    # equal-compile footing: pad prompts to the same bucket
+    bucket = buckets[0]
+    gen = {}
+    naive_slot_steps = 0
+    t_naive = 0.0
+    for i in range(0, n_req, slots):
+        chunk = reqs[i:i + slots]
+        mx = max(m for _, m in chunk)
+        prompts = np.zeros((slots, bucket), np.int32)
+        lengths = np.ones((slots,), np.int32)
+        for j, (p, _) in enumerate(chunk):
+            prompts[j, :len(p)] = p
+            lengths[j] = len(p)
+        key = mx
+        if key not in gen:
+            f = jax.jit(lambda pr, ln, m=mx: generate(
+                params, pr, cfg, max_new=m, max_len=bucket + m,
+                prompt_lengths=ln))
+            np.asarray(f(jnp.asarray(prompts),
+                         jnp.asarray(lengths)))  # compile+warm
+            gen[key] = f
+        t0 = time.perf_counter()
+        np.asarray(gen[key](jnp.asarray(prompts), jnp.asarray(lengths)))
+        t_naive += time.perf_counter() - t0
+        naive_slot_steps += mx * slots
+
+    eff_cont = useful / cont_slot_steps
+    eff_naive = useful / naive_slot_steps
+    on_tpu = jax.default_backend() == "tpu"
+    print(f"continuous: {useful} useful tokens ({timed_tokens} in the "
+          f"timed section), {srv.rounds_run} rounds x {round_len} "
+          f"steps x {slots} slots = {cont_slot_steps} slot-steps "
+          f"(efficiency {eff_cont:.1%}), wall {t_cont:.2f}s "
+          f"({timed_tokens/t_cont:,.0f} tok/s)", file=sys.stderr)
+    print(f"naive:      {naive_slot_steps} slot-steps "
+          f"(efficiency {eff_naive:.1%}), wall {t_naive:.2f}s "
+          f"({useful/t_naive:,.0f} tok/s)", file=sys.stderr)
+    print(f"scheduling efficiency ratio {eff_cont/eff_naive:.2f}x, "
+          f"wall speedup {t_naive/t_cont:.2f}x (tunnel wall "
+          f"under-credits continuous; see module docstring)",
+          file=sys.stderr)
+    print(json.dumps({
+        "metric": f"continuous batching, {n_req} mixed-budget requests "
+                  f"over {slots} slots, round {round_len}, "
+                  f"{'bf16 v5e chip' if on_tpu else jax.default_backend()}"
+                  f" (naive restart: {useful/t_naive:,.0f} tok/s wall, "
+                  f"{round(eff_naive, 4)} step-efficiency)",
+        "value": round(timed_tokens / t_cont, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(eff_cont / eff_naive, 4),
+        "vs_baseline_meaning": "slot-step efficiency ratio vs naive "
+                               "batch-restart (useful tokens per "
+                               "decode slot-step; dispatch-floor-"
+                               "independent scheduling win)",
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,222 @@
+"""Continuous batching: a persistent decode loop with slot admission.
+
+The serving shape after ragged prompts (round-5 VERDICT item 6): a
+fixed pool of ``n_slots`` batch rows decodes forever; when a row
+finishes its request, the slot is re-filled by the next pending request
+without restarting the batch — the reference-side analogue is the
+engine manager multiplexing independent engines over one progress loop
+(/root/reference/rootless_ops.c:33-47: many engines, one
+`RLO_make_progress_all`), here it is many REQUESTS multiplexing one
+jitted decode program.
+
+TPU-shaped design decisions:
+  - The decode program is ONE jit over the whole slot pool — static
+    shapes (n_slots, max_len), per-row positions/masks from the ragged
+    machinery (models.generate decode_step with a (b,) pos vector), so
+    admission never recompiles.
+  - Admission granularity is a ROUND of ``round_len`` decode steps
+    (one lax.scan inside one jit): the tunneled chip's ~110 ms
+    dispatch floor makes per-token host round-trips absurd; round_len
+    amortizes it. Iteration-level batching a la Orca.
+  - A fresh request prefills into its slot with the blockwise prefill
+    (one forward at a padded prompt bucket — a handful of distinct
+    bucket lengths keeps the compile cache small), then the row's
+    cache is scattered into the pool cache at the slot index.
+  - Finished rows keep decoding masked garbage until the round ends
+    (their budget exhausted); outputs are truncated to the request's
+    max_new, and slot reuse is safe because every attend masks at the
+    row's own position and cache writes overwrite in order.
+
+Oracle (tests/test_serve.py): any stream of requests produces, per
+request, EXACTLY the tokens of its dense `generate` — continuous
+batching is a scheduling change, not a numerics change.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from rlo_tpu.models.generate import (decode_step, init_kv_cache,
+                                     prefill, _decode_cfg)
+from rlo_tpu.models.transformer import TransformerConfig
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request: ``prompt`` (plen,) int32, ``max_new``
+    tokens to generate. ``eos_id`` optionally ends the row early (the
+    emitted tokens still include the eos)."""
+    prompt: np.ndarray
+    max_new: int
+    eos_id: Optional[int] = None
+
+
+def _bucket(plen: int, buckets: Tuple[int, ...]) -> int:
+    for b in buckets:
+        if plen <= b:
+            return b
+    raise ValueError(f"prompt length {plen} exceeds the largest "
+                     f"bucket {buckets[-1]}")
+
+
+class DecodeServer:
+    """Continuous-batching server over ``n_slots`` rows.
+
+    submit() queues requests; run() drives rounds until every request
+    completes and returns the per-request token arrays in submission
+    order. step_round() is the unit the throughput bench times.
+    """
+
+    def __init__(self, params, cfg: TransformerConfig, *,
+                 n_slots: int, max_len: int, round_len: int = 32,
+                 prompt_buckets: Tuple[int, ...] = (64, 256, 1024)):
+        self.params = params
+        self.cfg = cfg
+        self.n_slots = n_slots
+        self.max_len = max_len
+        self.round_len = round_len
+        self.buckets = tuple(b for b in sorted(prompt_buckets)
+                             if b <= max_len)
+        if not self.buckets:
+            raise ValueError(
+                f"no prompt bucket fits max_len {max_len} "
+                f"(buckets {tuple(sorted(prompt_buckets))})")
+        self.cache = init_kv_cache(cfg, n_slots, max_len)
+        self.pos = np.zeros((n_slots,), np.int32)
+        self.last_tok = np.zeros((n_slots,), np.int32)
+        self.budget = np.zeros((n_slots,), np.int64)  # tokens still due
+        self.req_of_slot: List[Optional[int]] = [None] * n_slots
+        self._queue: List[Tuple[int, Request]] = []
+        self._out: List[Optional[List[int]]] = []
+        self._eos: List[Optional[int]] = []
+        self.rounds_run = 0
+        self.steps_run = 0
+
+        cfg_d = _decode_cfg(cfg)
+
+        def round_fn(params, cache, last_tok, pos, kk):
+            def body(carry, _):
+                tok, pos, cache = carry
+                logits, cache = decode_step(params, tok, pos, cache,
+                                            cfg_d)
+                tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                return (tok, pos + 1, cache), tok
+
+            (tok, pos, cache), toks = lax.scan(
+                body, (last_tok, pos, cache), None, length=kk)
+            return tok, pos, cache, jnp.transpose(toks)  # (b, kk)
+
+        self._round = jax.jit(round_fn, static_argnames=("kk",))
+
+        def prefill_slot(params, prompt, length):
+            # one padded row through the blockwise prefill; returns the
+            # row cache + the first generated token
+            row = init_kv_cache(cfg, 1, max_len)
+            logits, row = prefill(params, prompt, row, cfg,
+                                  last_index=length - 1)
+            first = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return row, first
+
+        self._prefill = jax.jit(prefill_slot)
+
+        def scatter_slot(cache, row, slot):
+            def put(big, small):
+                return lax.dynamic_update_slice(
+                    big, small.astype(big.dtype),
+                    (slot,) + (0,) * (big.ndim - 1))
+            return jax.tree.map(put, cache, row)
+
+        self._scatter = jax.jit(scatter_slot)
+
+    # ---- request lifecycle ------------------------------------------
+    def submit(self, prompt, max_new: int,
+               eos_id: Optional[int] = None) -> int:
+        """Queue a request; returns its id (position in results)."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if len(prompt) + max_new > self.max_len:
+            raise ValueError(
+                f"prompt {len(prompt)} + max_new {max_new} exceeds "
+                f"max_len {self.max_len}")
+        rid = len(self._out)
+        self._queue.append((rid, Request(prompt, max_new, eos_id)))
+        self._out.append(None)
+        self._eos.append(eos_id)
+        return rid
+
+    def _admit(self):
+        for slot in range(self.n_slots):
+            if self.req_of_slot[slot] is not None or not self._queue:
+                continue
+            rid, req = self._queue.pop(0)
+            plen = len(req.prompt)
+            bucket = _bucket(plen, self.buckets)
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, :plen] = req.prompt
+            row, first = self._prefill(
+                self.params, jnp.asarray(padded),
+                jnp.asarray([plen], jnp.int32))
+            self.cache = self._scatter(self.cache, row,
+                                       jnp.int32(slot))
+            first = int(np.asarray(first)[0])
+            self.req_of_slot[slot] = rid
+            self._out[rid] = [first]
+            self.pos[slot] = plen
+            self.last_tok[slot] = first
+            self.budget[slot] = req.max_new - 1
+            if req.eos_id is not None and first == req.eos_id:
+                self.budget[slot] = 0
+            self._retire_if_done(slot)
+
+    def _retire_if_done(self, slot: int):
+        rid = self.req_of_slot[slot]
+        if rid is None:
+            return
+        if self.budget[slot] <= 0:
+            self.req_of_slot[slot] = None
+
+    # ---- the decode loop --------------------------------------------
+    def step_round(self):
+        """Admit pending requests, run one jitted round of
+        ``round_len`` ragged decode steps, distribute tokens."""
+        self._admit()
+        if all(r is None for r in self.req_of_slot):
+            return False
+        tok, pos, cache, toks = self._round(
+            self.params, self.cache, jnp.asarray(self.last_tok),
+            jnp.asarray(self.pos), self.round_len)
+        self.cache = cache
+        toks = np.asarray(toks)
+        self.last_tok = np.asarray(tok).copy()
+        self.pos = np.asarray(pos).copy()
+        self.rounds_run += 1
+        self.steps_run += self.round_len
+        for slot in range(self.n_slots):
+            rid = self.req_of_slot[slot]
+            if rid is None:
+                continue
+            take = int(min(self.budget[slot], self.round_len))
+            seq = toks[slot, :take].tolist()
+            eos = self._eos[rid]
+            if eos is not None and eos in seq:
+                seq = seq[:seq.index(eos) + 1]
+                self.budget[slot] = 0
+            else:
+                self.budget[slot] -= take
+            self._out[rid].extend(seq)
+            self._retire_if_done(slot)
+        return True
+
+    def run(self) -> List[np.ndarray]:
+        """Drive rounds until every submitted request completes."""
+        while self._queue or any(r is not None
+                                 for r in self.req_of_slot):
+            progressed = self.step_round()
+            if not progressed and self._queue:  # pragma: no cover
+                raise RuntimeError("queue stuck with no free slots")
+        return [np.asarray(o, np.int32) for o in self._out]

@@ -110,3 +110,76 @@ out:
     rlo_world_free(w);
     return rc;
 }
+
+/* Median usec per SINGLE-ROOT broadcast (rank 0 -> all) of `nbytes`
+ * over an in-process loopback world — the engine+wire machinery cost
+ * of one overlay bcast with no transport contention and no scheduler:
+ * every frame is a loopback queue hop (one memcpy) plus the engine's
+ * serialize/demux/dedup/forward/pickup work. case_nbcast's floor
+ * analysis divides this by (ws-1) frames to quantify the per-frame
+ * engine CPU that the native MPI_Bcast path never pays (round-5
+ * VERDICT item 7). Returns <0 (rlo_err) on failure. */
+double rlo_bench_bcast_usec(int world_size, int64_t nbytes, int reps)
+{
+    if (world_size < 2 || nbytes <= 0 || reps <= 0 || reps > 10000)
+        return RLO_ERR_ARG;
+    rlo_world *w = rlo_world_new(world_size, 0, 0);
+    if (!w)
+        return RLO_ERR_NOMEM;
+    double rc = RLO_ERR_NOMEM;
+    rlo_engine **engines =
+        (rlo_engine **)calloc((size_t)world_size, sizeof(void *));
+    uint8_t *buf = (uint8_t *)malloc((size_t)nbytes);
+    double *times = (double *)calloc((size_t)reps, sizeof(double));
+    if (!engines || !buf || !times)
+        goto out;
+    memset(buf, 0x5a, (size_t)nbytes);
+    for (int r = 0; r < world_size; r++) {
+        engines[r] = rlo_engine_new(w, r, 0, 0, 0, 0, 0, nbytes + 64);
+        if (!engines[r])
+            goto out;
+    }
+    for (int rep = -2; rep < reps; rep++) { /* 2 warmup reps */
+        uint64_t t0 = rlo_now_usec();
+        int src = rlo_bcast(engines[0], buf, nbytes);
+        if (src != RLO_OK) {
+            rc = src;
+            goto out;
+        }
+        int spun = rlo_drain(w, 1000000);
+        if (spun < 0) {
+            rc = spun;
+            goto out;
+        }
+        for (int r = 1; r < world_size; r++) {
+            const uint8_t *payload = 0;
+            int64_t n = rlo_pickup_peek(engines[r], 0, 0, 0, 0,
+                                        &payload);
+            if (n != nbytes || payload[0] != 0x5a) {
+                rc = RLO_ERR_PROTO;
+                goto out;
+            }
+            rlo_pickup_consume(engines[r]);
+        }
+        if (rep >= 0)
+            times[rep] = (double)(rlo_now_usec() - t0);
+    }
+    for (int i = 0; i < reps; i++)
+        for (int j = i + 1; j < reps; j++)
+            if (times[j] < times[i]) {
+                double t = times[i];
+                times[i] = times[j];
+                times[j] = t;
+            }
+    rc = times[reps / 2];
+
+out:
+    if (engines)
+        for (int r = 0; r < world_size; r++)
+            rlo_engine_free(engines[r]);
+    free(engines);
+    free(buf);
+    free(times);
+    rlo_world_free(w);
+    return rc;
+}
