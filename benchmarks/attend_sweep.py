@@ -2,17 +2,23 @@
 
 decode_analysis measured the cache attend at ~370 GB/s while every
 matmul component streams at ~700+ GB/s in the same window. Leading
-hypothesis: the cache layout (b, kvh, L, head_dim=64) has a 64-wide
+hypothesis: a head-minor cache layout (b, kvh, L, head_dim=64) has a 64-wide
 minor dimension — half a (8, 128) native lane tile — so HBM tiles are
 lane-padded and the DMA streams at half width. This sweep pins it by
 measuring the SAME cache bytes under different shapes/layouts in one
 window:
 
-  a. flash (32, 16, L, 64)    - production shape (hd 64)
-  b. flash (32, 8, L, 128)    - same bytes, lane-native head_dim
+  a. flash (32, 16, ., 64)    - production shape (hd 64)
+  b. flash (32, 8, ., 128)    - same bytes, wider head_dim
   c. flash block_k=128        - finer cache tiles (DMA pipelining)
   d. einsum same shape        - the XLA path for reference
-  e. L = 1216 (plen-1024 serving regime) variants of a/b
+  e. L = 1280 (plen-1024 serving regime) variants of a/b
+
+RESULT (2026-07-31, pre-fix head-minor layout): hd64 365 GB/s vs
+hd128 703 GB/s at identical bytes — confirmed the lane-padding
+hypothesis, and the cache layout was flipped to SEQ-MINOR
+(models.generate.init_kv_cache); this sweep now measures the new
+layout, where hd64 and hd128 should both stream at full width.
 
 Usage: python benchmarks/attend_sweep.py [--tiny]
 """
@@ -40,8 +46,8 @@ def attend_leg(batch, kvh, L, hd, *, block_k=None, use_flash=True,
                dt=jnp.bfloat16, label=""):
     rng = np.random.default_rng(0)
     nh = 16  # total query heads fixed: (kvh, hd) vary, bytes constant
-    kc = jnp.asarray(rng.standard_normal((batch, kvh, L, hd)), dt)
-    vc = jnp.asarray(rng.standard_normal((batch, kvh, L, hd)), dt)
+    kc = jnp.asarray(rng.standard_normal((batch, kvh, hd, L)), dt)
+    vc = jnp.asarray(rng.standard_normal((batch, kvh, hd, L)), dt)
     q0 = jnp.asarray(rng.standard_normal((batch, 1, nh, hd)), dt)
     scale = 1.0 / np.sqrt(hd)
     pos = L - 8
@@ -98,13 +104,13 @@ def main():
         legs["hd64_L208_einsum"] = attend_leg(32, 16, 208, 64,
                                               use_flash=False,
                                               label="hd64_L208_einsum")
-        legs["hd64_L1216"] = attend_leg(32, 16, 1216, 64,
-                                        label="hd64_L1216")
-        legs["hd128_L1216"] = attend_leg(32, 8, 1216, 128,
-                                         label="hd128_L1216")
-        legs["hd64_L1216_bk128"] = attend_leg(32, 16, 1216, 64,
+        legs["hd64_L1280"] = attend_leg(32, 16, 1280, 64,
+                                        label="hd64_L1280")
+        legs["hd128_L1280"] = attend_leg(32, 8, 1280, 128,
+                                         label="hd128_L1280")
+        legs["hd64_L1280_bk128"] = attend_leg(32, 16, 1280, 64,
                                               block_k=128,
-                                              label="hd64_L1216_bk128")
+                                              label="hd64_L1280_bk128")
     print(json.dumps({"attend_gbps": {k: round(v, 1)
                                       for k, v in legs.items()}}))
 

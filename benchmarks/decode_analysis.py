@@ -20,7 +20,7 @@ Two accounting surfaces:
      - ffn matmuls   (b, d) x (d, ff) x (ff, d)      - weights stream
      - qkv + wo      (b, d) x (d, 3d), (b, d) x (d, d)
      - logits head   (b, d) x (d, vocab)
-     - cache attend  flash_decode at (b, kvh, max_len, hd)
+     - cache attend  flash_decode at (b, kvh, hd, max_len)
      - full step     decode_step (fixed mid-window position)
    Component GB/s = known bytes / measured time; the residual
    (step - sum of parts) is elementwise + scan overhead.
@@ -142,7 +142,10 @@ def main():
                                 dtype="bfloat16")
         batch, plen, win = args.batch, args.plen, args.n_window
 
-    max_len = plen + win
+    # production caches round the seq axis to the 128-lane tile
+    # (init_kv_cache) — the probes must measure the same shape or the
+    # attend leg pays materialized pads production avoids
+    max_len = -(-(plen + win) // 128) * 128
     # mid-differencing-window position (decode_bench differences
     # max_new = win/3 vs win): component probes use it; the flash
     # attend streams the FULL allocated max_len regardless
@@ -229,8 +232,10 @@ def main():
     logits_extra = d * vocab * wbytes  # the fold matrix also streams
 
     # cache attend at decode shape (one layer; x8 in accounting)
-    kc = jnp.asarray(rng.standard_normal((batch, kvh, max_len, hd)), dt)
-    vc = jnp.asarray(rng.standard_normal((batch, kvh, max_len, hd)), dt)
+    kc = jnp.asarray(rng.standard_normal((batch, kvh, hd, max_len)),
+                     dt)
+    vc = jnp.asarray(rng.standard_normal((batch, kvh, hd, max_len)),
+                     dt)
     from rlo_tpu.models.generate import _attend_cache
     scale = 1.0 / np.sqrt(hd)
 

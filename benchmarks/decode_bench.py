@@ -428,8 +428,10 @@ def capacity(args):
         params = init_params(jax.random.PRNGKey(0), cfg)
         tok = jnp.zeros((b,), jnp.int32)
         cache = init_kv_cache(cfg, b, L)
+        # donate the cache: input+output copies would double the
+        # budget and OOM the 16 GB chip the leg sizes itself for
         step = jax.jit(lambda p, t, c, cfg=cfg: decode_step(
-            p, t, L - 1, c, cfg))
+            p, t, L - 1, c, cfg), donate_argnums=(2,))
         logits, cache = step(params, tok, cache)
         np.asarray(logits[0, :4])  # force execution
         del cache, logits, params

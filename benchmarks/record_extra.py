@@ -74,7 +74,8 @@ LEGS = [
     # round-5 item 1: the decode HBM budget decomposition (per-
     # component GB/s vs a same-window streaming probe)
     ("decode_budget",
-     [sys.executable, "benchmarks/decode_analysis.py"], 3300),
+     [sys.executable, "benchmarks/decode_analysis.py",
+      "--plen", "1024"], 3300),
     # round-5 item 6: continuous batching vs naive batch-restart
     ("serve_continuous",
      [sys.executable, "benchmarks/serve_bench.py"], 2400),

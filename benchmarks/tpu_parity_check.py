@@ -32,8 +32,8 @@ def check_kernel():
     rng = np.random.default_rng(0)
     b, T, nh, nkv, d, L = 2, 4, 8, 2, 64, 512
     q = jnp.asarray(rng.standard_normal((b, T, nh, d)), jnp.bfloat16)
-    kc = jnp.asarray(rng.standard_normal((b, nkv, L, d)), jnp.bfloat16)
-    vc = jnp.asarray(rng.standard_normal((b, nkv, L, d)), jnp.bfloat16)
+    kc = jnp.asarray(rng.standard_normal((b, nkv, d, L)), jnp.bfloat16)
+    vc = jnp.asarray(rng.standard_normal((b, nkv, d, L)), jnp.bfloat16)
     pos0 = jnp.asarray([100, L - T], jnp.int32)
     scale = 1.0 / np.sqrt(d)
     got = np.asarray(jax.jit(

@@ -2,10 +2,11 @@
 
 The training side (models.transformer) recomputes full attention every
 step; generation wants O(1) work per new token: each layer's keys and
-values are cached HEAD-LEADING at (batch, kv_heads, max_len, head_dim)
-— kv_heads < n_heads for GQA configs, and the (max_len, head_dim)
-trailing dims are the Mosaic-native tiling the flash-decode kernel
-(rlo_tpu.pallas.decode) requires — and a decode step attends the
+values are cached HEAD-LEADING, SEQ-MINOR at (batch, kv_heads,
+head_dim, max_len) — kv_heads < n_heads for GQA configs, and the
+sequence-minor trailing dim streams HBM tiles at full 128-lane width
+(see init_kv_cache; head_dim-minor measured half the bandwidth) — and
+a decode step attends the
 single new query against the cache prefix (grouped, never repeated).
 Shapes stay STATIC (the cache is allocated at max_len up front and
 masked by the traced position) so the whole generate loop is one
@@ -38,11 +39,16 @@ from rlo_tpu.ops.ring_attention import _NEG
 def init_kv_cache(cfg: TransformerConfig, batch: int, max_len: int,
                   tp_axis: Optional[str] = None):
     """Zeroed per-layer K/V cache: a list of {"k","v"} arrays shaped
-    (batch, kv_heads, max_len, head_dim) in the activation dtype —
-    HEAD-LEADING, the same (…, sublane, lane)-friendly convention as
-    the flash kernels: the (max_len, head_dim) trailing dims tile
-    natively in Mosaic, which the flash-decode kernel
-    (rlo_tpu.pallas.decode) requires for its cache blocks. GQA
+    (batch, kv_heads, head_dim, max_len) in the activation dtype —
+    SEQUENCE-MINOR. The minor dimension is what HBM tiles pad to the
+    128-lane width: the previous (…, max_len, head_dim) layout put
+    head_dim=64 in the lanes and measured HALF the deliverable cache
+    bandwidth (365 vs 703 GB/s at identical bytes,
+    benchmarks/attend_sweep.py, 2026-07-31) because every (16, 128)
+    bf16 tile was half padding. max_len is >= 128 in any real serving
+    config, so the seq-minor layout streams at full width; the
+    flash-decode kernel's dots contract head_dim as the sublane axis,
+    which is the MXU-native (d, L) matmul orientation anyway. GQA
     configs (n_kv_heads < n_heads) store only the K/V heads, the
     n_heads/kv_heads memory win that motivates GQA. Inside shard_map
     with ``tp_axis``, each shard allocates only its kv_heads/tp local
@@ -55,17 +61,30 @@ def init_kv_cache(cfg: TransformerConfig, batch: int, max_len: int,
     ntp = lax.axis_size(tp_axis) if tp_axis is not None else 1
     assert cfg.kv_heads % ntp == 0
     kvh = cfg.kv_heads // ntp
-    shape = (batch, kvh, max_len, cfg.head_dim)
+    if jax.default_backend() == "tpu":
+        # round the seq axis up to the 128-lane tile: a non-multiple
+        # max_len makes EVERY pallas call pad the whole cache (16
+        # materialized pad ops per step at plen 1024 — measured); the
+        # tail is position-masked everywhere, so +<=127 slots is
+        # semantics-free and removes the pads
+        max_len = -(-max_len // 128) * 128
+    shape = (batch, kvh, cfg.head_dim, max_len)
+    # DISTINCT buffers per entry: sharing one zeros array across k/v/
+    # layers breaks donation ("attempt to donate the same buffer
+    # twice") for any jit that takes the cache donated (serve.py's
+    # round, capacity probes)
     if cfg.kv_cache_dtype == "int8":
-        z = jnp.zeros(shape, jnp.int8)
-        s = jnp.zeros((batch, kvh, max_len), jnp.float32)
-        return [{"k": z, "v": z, "ks": s, "vs": s}
+        return [{"k": jnp.zeros(shape, jnp.int8),
+                 "v": jnp.zeros(shape, jnp.int8),
+                 "ks": jnp.zeros((batch, kvh, max_len), jnp.float32),
+                 "vs": jnp.zeros((batch, kvh, max_len), jnp.float32)}
                 for _ in range(cfg.n_layers)]
     if cfg.kv_cache_dtype is not None:
         raise ValueError(
             f"unknown kv_cache_dtype {cfg.kv_cache_dtype!r}")
-    z = jnp.zeros(shape, cfg.act_dtype)
-    return [{"k": z, "v": z} for _ in range(cfg.n_layers)]
+    return [{"k": jnp.zeros(shape, cfg.act_dtype),
+             "v": jnp.zeros(shape, cfg.act_dtype)}
+            for _ in range(cfg.n_layers)]
 
 
 def kv_cache_pspecs(cfg: TransformerConfig,
@@ -123,11 +142,11 @@ def _attend_cache(q, k_cache, v_cache, pos, scale,
     head, position) ``k_scale``/``v_scale`` (b, kv_heads, max_len):
     the dequant is FOLDED into the score and probability tensors —
     scores scale per key position, probabilities pre-multiply the
-    value scale — so the (b, kv, max_len, hd) cache operands enter
+    value scale — so the (b, kv, hd, max_len) cache operands enter
     their matmuls as stored int8 and the big HBM reads stay 1
     byte/element."""
     b, one, nh, hd = q.shape
-    nkv, max_len = k_cache.shape[1], k_cache.shape[2]
+    nkv, max_len = k_cache.shape[1], k_cache.shape[3]
     if use_flash is None:
         from rlo_tpu.pallas.decode import can_flash_decode
         use_flash = (jax.default_backend() == "tpu"
@@ -171,7 +190,7 @@ def _attend_cache_block(q, k_cache, v_cache, pos_q, scale,
     logits share numerics (losslessness of greedy speculative decoding
     needs their argmaxes to agree)."""
     b, T, nh, hd = q.shape
-    nkv, max_len = k_cache.shape[1], k_cache.shape[2]
+    nkv, max_len = k_cache.shape[1], k_cache.shape[3]
     if use_flash is None:
         from rlo_tpu.pallas.decode import (_block_fits_vmem,
                                            can_flash_decode)
@@ -204,7 +223,7 @@ def _attend_cache_block(q, k_cache, v_cache, pos_q, scale,
     cache_dt = jnp.bfloat16 if (k_scale is not None and
                                 jax.default_backend() == "tpu") \
         else jnp.float32
-    s = jnp.einsum("bqgrd,bgkd->bgrqk", qg.astype(cache_dt),
+    s = jnp.einsum("bqgrd,bgdk->bgrqk", qg.astype(cache_dt),
                    k_cache.astype(cache_dt),
                    preferred_element_type=jnp.float32) * scale
     s = s.astype(jnp.float32)
@@ -215,7 +234,7 @@ def _attend_cache_block(q, k_cache, v_cache, pos_q, scale,
     p = jax.nn.softmax(s, axis=-1)
     if v_scale is not None:
         p = p * v_scale[:, :, None, None, :]
-    out = jnp.einsum("bgrqk,bgkd->bqgrd", p.astype(cache_dt),
+    out = jnp.einsum("bgrqk,bgdk->bqgrd", p.astype(cache_dt),
                      v_cache.astype(cache_dt),
                      preferred_element_type=jnp.float32)
     return out.astype(jnp.float32).reshape(b, T, nh, hd)
@@ -264,23 +283,52 @@ def decode_step(params: dict, token, pos, cache, cfg: TransformerConfig,
                 store_dt = jnp.int8
             else:
                 store_dt = dt
-            rows = jnp.arange(b)
-            heads = jnp.arange(lc["k"].shape[1])
-            if ragged:
-                idx = (rows[:, None], heads[None, :], posv[:, None])
+            from rlo_tpu.pallas.decode import (can_write_row,
+                                               write_kv_row)
+            max_len_c = lc["k"].shape[3]
+            if (jax.default_backend() == "tpu"
+                    and can_write_row(max_len_c)):
+                # aliased pallas write: an XLA lane-offset DUS makes
+                # layout assignment transpose the cache and copy it
+                # back for the flash kernel every step (~2 ms/step at
+                # plen 1024 — see write_kv_row)
+                kc = write_kv_row(lc["k"], k_row, posv)
+                vc = write_kv_row(lc["v"], v_row, posv)
+            elif ragged:
+                rows = jnp.arange(b)
+                heads = jnp.arange(lc["k"].shape[1])
+                # seq-minor: the new row lands in ONE lane per
+                # (b, head, dim) — idx over the last axis
+                dims = jnp.arange(lc["k"].shape[2])
+                idx = (rows[:, None, None], heads[None, :, None],
+                       dims[None, None, :], posv[:, None, None])
                 kc = lc["k"].at[idx].set(k_row.astype(store_dt))
                 vc = lc["v"].at[idx].set(v_row.astype(store_dt))
             else:
                 kc = lax.dynamic_update_slice(
-                    lc["k"], k_row[:, :, None].astype(store_dt),
-                    (0, 0, pos, 0))
+                    lc["k"], k_row[..., None].astype(store_dt),
+                    (0, 0, 0, pos))
                 vc = lax.dynamic_update_slice(
-                    lc["v"], v_row[:, :, None].astype(store_dt),
-                    (0, 0, pos, 0))
+                    lc["v"], v_row[..., None].astype(store_dt),
+                    (0, 0, 0, pos))
             entry = {"k": kc, "v": vc}
             ks = vs = None
             if quant:
-                if ragged:
+                if (jax.default_backend() == "tpu"
+                        and can_write_row(max_len_c)):
+                    # the scale sidecars are seq-minor too — a lane-
+                    # offset DUS would reintroduce the layout-war
+                    # copies; view (b, kvh, L) as (b, kvh, 1, L) (a
+                    # free reshape) and ride the same aliased kernel
+                    ks = write_kv_row(lc["ks"][:, :, None, :],
+                                      ks_new[:, :, None],
+                                      posv)[:, :, 0, :]
+                    vs = write_kv_row(lc["vs"][:, :, None, :],
+                                      vs_new[:, :, None],
+                                      posv)[:, :, 0, :]
+                elif ragged:
+                    rows = jnp.arange(b)
+                    heads = jnp.arange(lc["k"].shape[1])
                     sidx = (rows[:, None], heads[None, :],
                             posv[:, None])
                     ks = lc["ks"].at[sidx].set(ks_new)
@@ -329,23 +377,32 @@ def block_decode(params: dict, tokens, pos0, cache,
             quant = "ks" in lc
             kt = k.transpose(0, 2, 1, 3)           # (b, kvh, T, hd)
             vt = v.transpose(0, 2, 1, 3)
-            if quant:
+            if quant:  # quantize over hd BEFORE the seq-minor flip
                 kt, ks_new = _quantize_kv(kt)
                 vt, vs_new = _quantize_kv(vt)
                 store_dt = jnp.int8
             else:
                 store_dt = dt
+            kt = kt.transpose(0, 1, 3, 2)          # (b, kvh, hd, T)
+            vt = vt.transpose(0, 1, 3, 2)
             kvh = lc["k"].shape[1]
-            rows = jnp.arange(b)[:, None, None]
-            heads = jnp.arange(kvh)[None, :, None]
-            posw = pos_arr[:, None, :]             # (b, 1, T)
-            kc = lc["k"].at[rows, heads, posw].set(kt.astype(store_dt))
-            vc = lc["v"].at[rows, heads, posw].set(vt.astype(store_dt))
+            rows = jnp.arange(b)[:, None, None, None]
+            heads = jnp.arange(kvh)[None, :, None, None]
+            dims = jnp.arange(lc["k"].shape[2])[None, None, :, None]
+            posw = pos_arr[:, None, None, :]       # (b, 1, 1, T)
+            kc = lc["k"].at[rows, heads, dims, posw].set(
+                kt.astype(store_dt))
+            vc = lc["v"].at[rows, heads, dims, posw].set(
+                vt.astype(store_dt))
             entry = {"k": kc, "v": vc}
             ks = vs = None
             if quant:
-                ks = lc["ks"].at[rows, heads, posw].set(ks_new)
-                vs = lc["vs"].at[rows, heads, posw].set(vs_new)
+                # scale sidecars stay (b, kvh, L): 3-D scatter indices
+                r3 = jnp.arange(b)[:, None, None]
+                h3 = jnp.arange(kvh)[None, :, None]
+                p3 = pos_arr[:, None, :]           # (b, 1, T)
+                ks = lc["ks"].at[r3, h3, p3].set(ks_new)
+                vs = lc["vs"].at[r3, h3, p3].set(vs_new)
                 entry.update(ks=ks, vs=vs)
             new_cache.append(entry)
             return _attend_cache_block(q, kc, vc, pos_arr, scale,
@@ -406,16 +463,19 @@ def prefill(params: dict, tokens, cache, cfg: TransformerConfig,
     for layer, lc in zip(params["layers"], cache):
         def attend(q, k, v, lc=lc):
             # k/v arrive (b, plen, kvh, hd); the cache is head-leading
+            # and SEQ-MINOR: (b, kvh, hd, plen)
             kt = k.transpose(0, 2, 1, 3)             # (b, kvh, plen, hd)
             vt = v.transpose(0, 2, 1, 3)
             if "ks" in lc:  # int8 cache: quantize the whole block
                 qk, ks = _quantize_kv(kt)
                 qv, vs = _quantize_kv(vt)
                 new_cache.append({
-                    "k": lax.dynamic_update_slice(lc["k"], qk,
-                                                  (0, 0, 0, 0)),
-                    "v": lax.dynamic_update_slice(lc["v"], qv,
-                                                  (0, 0, 0, 0)),
+                    "k": lax.dynamic_update_slice(
+                        lc["k"], qk.transpose(0, 1, 3, 2),
+                        (0, 0, 0, 0)),
+                    "v": lax.dynamic_update_slice(
+                        lc["v"], qv.transpose(0, 1, 3, 2),
+                        (0, 0, 0, 0)),
                     "ks": lax.dynamic_update_slice(lc["ks"], ks,
                                                    (0, 0, 0)),
                     "vs": lax.dynamic_update_slice(lc["vs"], vs,
@@ -432,9 +492,11 @@ def prefill(params: dict, tokens, cache, cfg: TransformerConfig,
             else:
                 new_cache.append({
                     "k": lax.dynamic_update_slice(
-                        lc["k"], kt.astype(dt), (0, 0, 0, 0)),
+                        lc["k"], kt.transpose(0, 1, 3, 2).astype(dt),
+                        (0, 0, 0, 0)),
                     "v": lax.dynamic_update_slice(
-                        lc["v"], vt.astype(dt), (0, 0, 0, 0))})
+                        lc["v"], vt.transpose(0, 1, 3, 2).astype(dt),
+                        (0, 0, 0, 0))})
             from rlo_tpu.models.transformer import _local_attention
             return _local_attention(q, k, v).astype(dt)
 

@@ -112,7 +112,10 @@ class DecodeServer:
                 body, (last_tok, pos, cache), None, length=kk)
             return tok, pos, cache, jnp.transpose(toks)  # (b, kk)
 
-        self._round = jax.jit(round_fn, static_argnames=("kk",))
+        # donate the pool cache: without aliasing, every round would
+        # double-buffer the full n_slots x max_len cache in HBM
+        self._round = jax.jit(round_fn, static_argnames=("kk",),
+                              donate_argnums=(1,))
 
         def prefill_slot(params, prompt, length):
             # one padded row through the blockwise prefill; returns the
@@ -132,7 +135,7 @@ class DecodeServer:
                     (slot,) + (0,) * (big.ndim - 1))
             return jax.tree.map(put, cache, row)
 
-        self._scatter = jax.jit(scatter_slot)
+        self._scatter = jax.jit(scatter_slot, donate_argnums=(0,))
 
     # ---- request lifecycle ------------------------------------------
     def submit(self, prompt, max_new: int,
@@ -143,15 +146,27 @@ class DecodeServer:
             raise ValueError(
                 f"prompt {len(prompt)} + max_new {max_new} exceeds "
                 f"max_len {self.max_len}")
+        if len(prompt) > self.buckets[-1]:
+            raise ValueError(
+                f"prompt length {len(prompt)} exceeds the largest "
+                f"prompt bucket {self.buckets[-1]}")
         rid = len(self._out)
         self._queue.append((rid, Request(prompt, max_new, eos_id)))
         self._out.append(None)
         self._eos.append(eos_id)
         return rid
 
-    def _admit(self):
-        for slot in range(self.n_slots):
+    def _admit(self) -> int:
+        """Fill every free slot from the queue; returns the number of
+        requests that COMPLETED during admission (max_new=1 or an
+        immediate eos retires the slot at once — the freed slot is
+        re-offered to the queue in the same pass, and the completion
+        count keeps step_round truthful about progress)."""
+        completed = 0
+        slot = 0
+        while slot < self.n_slots:
             if self.req_of_slot[slot] is not None or not self._queue:
+                slot += 1
                 continue
             rid, req = self._queue.pop(0)
             plen = len(req.prompt)
@@ -172,6 +187,11 @@ class DecodeServer:
             if req.eos_id is not None and first == req.eos_id:
                 self.budget[slot] = 0
             self._retire_if_done(slot)
+            if self.req_of_slot[slot] is None:
+                completed += 1  # retired at admission: re-offer slot
+            else:
+                slot += 1
+        return completed
 
     def _retire_if_done(self, slot: int):
         rid = self.req_of_slot[slot]
@@ -184,9 +204,9 @@ class DecodeServer:
     def step_round(self):
         """Admit pending requests, run one jitted round of
         ``round_len`` ragged decode steps, distribute tokens."""
-        self._admit()
+        completed = self._admit()
         if all(r is None for r in self.req_of_slot):
-            return False
+            return completed > 0
         tok, pos, cache, toks = self._round(
             self.params, self.cache, jnp.asarray(self.last_tok),
             jnp.asarray(self.pos), self.round_len)

@@ -22,11 +22,12 @@ heads resident per program (a batched dot over the head axis), two
 orders of magnitude fewer launches, each streaming kvh*BK*d cache
 bytes.
 
-Shapes (GQA-grouped, head-leading like the rest of the pallas
-package — models.generate stores the cache this way so the kernel's
-(max_len, head_dim) trailing dims tile natively in Mosaic):
+Shapes (GQA-grouped, head-leading, SEQ-MINOR — models.generate
+stores the cache with max_len as the minor dim so HBM tiles stream at
+full 128-lane width; head_dim=64-minor measured half the bandwidth,
+benchmarks/attend_sweep.py):
   q        (b, kv_heads, r, head_dim)   r = n_heads / kv_heads
-  k/v      (b, kv_heads, max_len, head_dim)  act dtype or int8
+  k/v      (b, kv_heads, head_dim, max_len)  act dtype or int8
   ks/vs    (b, kv_heads, max_len) f32 scales (int8 caches only)
   pos      (b, 1) int32 — every row masks its own prefix [0, pos_b]
   out      (b, kv_heads, r, head_dim) f32
@@ -85,22 +86,23 @@ def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, *rest, scale: float,
     # at sequence position pos + t (T=1 recovers single-token decode).
     dot_dt = jnp.float32 if k_ref.dtype == jnp.float32 else jnp.bfloat16
     q = q_ref[0].astype(dot_dt)                      # (g, T*r, d)
-    k = k_ref[0].astype(dot_dt)                      # (g, BK, d)
-    v = v_ref[0].astype(dot_dt)                      # (g, BK, d)
+    k = k_ref[0].astype(dot_dt)                      # (g, d, BK)
+    v = v_ref[0].astype(dot_dt)                      # (g, d, BK)
     pos = pos_ref[ib, 0]
     # masks built >=2-D from iota: Mosaic cannot insert a minor dim on
     # sub-32-bit (bool) values, so never reshape a 1-D mask
     base = ik * bk
     row = base + jax.lax.broadcasted_iota(jnp.int32, (1, 1, bk), 2)
-    col = base + jax.lax.broadcasted_iota(jnp.int32, (1, bk, 1), 1)
     # per-query causal position: query row t*r+rr masks at pos + t
     qoff = jax.lax.broadcasted_iota(jnp.int32, (1, T * r, 1), 1) // r
     mask_row = (row <= pos + qoff) & (row < max_len)  # (1, T*r, BK)
     # V zeroing: any key a query of this block may attend (<= pos+T-1)
-    mask_col = (col <= pos + (T - 1)) & (col < max_len)  # (1, BK, 1)
+    # — seq-minor V masks over its LAST axis
+    mask_col = (row <= pos + (T - 1)) & (row < max_len)  # (1, 1, BK)
 
-    # batched over the head axis: ((contract d), (batch g))
-    s = jax.lax.dot_general(q, k, (((2,), (2,)), ((0,), (0,))),
+    # batched over the head axis, contracting head_dim — the seq-minor
+    # cache arrives as the MXU-native (d, BK) operand
+    s = jax.lax.dot_general(q, k, (((2,), (1,)), ((0,), (0,))),
                             preferred_element_type=jnp.float32) * scale
     if quant:
         s = s * ks_ref[0]                            # (g, 1, BK)
@@ -121,13 +123,83 @@ def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, *rest, scale: float,
     # tile is uninitialized VMEM and p's zeros would ride 0*NaN into
     # the accumulator, the same hazard v is zeroed for above
     pv = jnp.where(mask_row, p * vs_ref[0], 0.0) if quant else p
+    # p (g, R, BK) x v (g, d, BK), contracting BK
     o_s[...] = o_s[...] * corr[..., None] + jax.lax.dot_general(
-        pv.astype(dot_dt), v, (((2,), (1,)), ((0,), (0,))),
+        pv.astype(dot_dt), v, (((2,), (2,)), ((0,), (0,))),
         preferred_element_type=jnp.float32)
 
     @pl.when(ik == n_k - 1)
     def _flush():
         o_ref[0] = o_s[...] / l_s[...][..., None]
+
+
+def _write_row_kernel(pos_ref, row_ref, cache_ref, out_ref):
+    """Write one (nkv, hd) row into lane ``pos % 128`` of the cache
+    block containing ``pos`` (grid = batch; the block index_map
+    selected column pos // 128). Everything else copies through —
+    out is input_output_aliased, so only THIS 128-lane block moves."""
+    ib = pl.program_id(0)
+    lane = pos_ref[ib] % 128
+    col = jax.lax.broadcasted_iota(jnp.int32, (1, 1, 1, 128), 3)
+    # row arrives (1, nkv, d, 1): Mosaic cannot INSERT a minor dim
+    # inside the kernel (tpu.reshape to ...x1 fails to lower), so the
+    # caller pre-shapes it; the where broadcasts it over the lanes
+    out_ref[...] = jnp.where(col == lane, row_ref[...],
+                             cache_ref[...])
+
+
+def can_write_row(max_len: int) -> bool:
+    """The aliased row-write kernel needs a legal 128-lane block."""
+    return max_len >= 128
+
+
+def write_kv_row(cache, row, pos, *, interpret: Optional[bool] = None):
+    """Aliased single-position cache write: ``cache`` (b, kvh, hd, L)
+    seq-minor, ``row`` (b, kvh, hd), ``pos`` (b,) int32 — returns the
+    cache with row b written at [b, :, :, pos_b].
+
+    Exists because the XLA dynamic-update-slice at a LANE offset
+    fights the flash kernel over layout: layout assignment prefers a
+    transposed layout for the lane-granular DUS and then inserts a
+    full-cache copy per layer per step to feed the pallas custom call
+    (measured: 12 x 76 MB copies per decode step = the entire ~2 ms
+    residual in benchmarks/decode_analysis.py at plen 1024). Doing
+    the write as a pallas kernel with input_output_aliasing removes
+    the XLA-level DUS entirely: every cache consumer is a custom call
+    wanting the default layout, and only the one 128-lane block
+    containing pos is read + written (~8 MB instead of 76)."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    b, nkv, d, L = cache.shape
+    pos = jnp.asarray(pos, jnp.int32)
+    pos = jnp.full((b,), pos) if pos.ndim == 0 else pos.reshape(b)
+    # shard_map vma alignment: a replicated pos/row must carry the
+    # same varying-axes set as the tp-sharded cache (same cast
+    # flash_block_decode does)
+    from rlo_tpu.parallel.mesh import vary_like
+    pos = vary_like(pos, cache)
+    row = vary_like(row, cache)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b,),
+        in_specs=[
+            pl.BlockSpec((1, nkv, d, 1),
+                         lambda ib, pos_ref: (ib, 0, 0, 0)),
+            pl.BlockSpec((1, nkv, d, 128),
+                         lambda ib, pos_ref: (ib, 0, 0,
+                                              pos_ref[ib] // 128)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, nkv, d, 128),
+            lambda ib, pos_ref: (ib, 0, 0, pos_ref[ib] // 128)),
+    )
+    return pl.pallas_call(
+        _write_row_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(cache.shape, cache.dtype),
+        input_output_aliases={2: 0},  # cache (after pos, row) -> out
+        interpret=interpret,
+    )(pos, row.astype(cache.dtype)[..., None], cache)
 
 
 def can_flash_decode(max_len: int, head_dim: int,
@@ -148,11 +220,18 @@ def _pick_bk(L: int, d: int, nkv: int, r: int, itemsize: int,
     ~10 MB). Deliberately independent of T: every block size must
     tile the cache identically or verify/decode numerics diverge."""
     bk = min(block_k, max(L, 1))
+    if bk < L and L % 128 == 0:
+        # prefer a DIVISOR of L: a non-dividing bk makes Mosaic pad
+        # the whole cache operand (materialized XLA pads per step)
+        while bk > 128 and L % bk:
+            bk -= 128
     while bk > 128 and (2 * nkv * bk * d * itemsize
                         + 2 * nkv * r * bk * 4) > (10 << 20):
         # halve, but stay on the multiple-of-128 grid can_flash_decode
         # gated on (e.g. 384 -> 192 would fail Mosaic tiling; use 128)
         bk = max(128, (bk // 2) // 128 * 128)
+        while bk > 128 and L % 128 == 0 and L % bk:
+            bk -= 128
     return bk
 
 
@@ -194,7 +273,7 @@ def flash_block_decode(q, k_cache, v_cache, pos0, scale, k_scale=None,
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     b, T, nh, d = q.shape
-    nkv, L = k_cache.shape[1], k_cache.shape[2]
+    nkv, L = k_cache.shape[1], k_cache.shape[3]
     r = nh // nkv
     R = T * r
     quant = k_scale is not None
@@ -230,8 +309,8 @@ def flash_block_decode(q, k_cache, v_cache, pos0, scale, k_scale=None,
     # pos: whole-array block (block dims == array dims is always legal)
     pos_spec = pl.BlockSpec((b, 1), lambda ib, ik: (0, 0))
     q_spec = pl.BlockSpec((1, nkv, R, d), lambda ib, ik: (ib, 0, 0, 0))
-    kv_spec = pl.BlockSpec((1, nkv, bk, d),
-                           lambda ib, ik: (ib, 0, ik, 0))
+    kv_spec = pl.BlockSpec((1, nkv, d, bk),
+                           lambda ib, ik: (ib, 0, 0, ik))
     o_spec = q_spec
     in_specs = [pos_spec, q_spec, kv_spec, kv_spec]
     args = [posv, qg, k_cache, v_cache]

@@ -24,8 +24,9 @@ B, NH, NKV, D, L = 3, 8, 4, 64, 48
 def data():
     rng = np.random.default_rng(0)
     q = jnp.asarray(rng.standard_normal((B, 1, NH, D)), jnp.float32)
-    kc = jnp.asarray(rng.standard_normal((B, NKV, L, D)), jnp.float32)
-    vc = jnp.asarray(rng.standard_normal((B, NKV, L, D)), jnp.float32)
+    # SEQ-MINOR cache layout (b, kvh, head_dim, L) — models.generate
+    kc = jnp.asarray(rng.standard_normal((B, NKV, D, L)), jnp.float32)
+    vc = jnp.asarray(rng.standard_normal((B, NKV, D, L)), jnp.float32)
     return q, kc, vc, 1.0 / np.sqrt(D)
 
 
@@ -66,12 +67,19 @@ def test_mha_no_grouping(data):
     """nkv == nh (r = 1): the degenerate group size."""
     q, _, _, scale = data
     rng = np.random.default_rng(1)
-    kc = jnp.asarray(rng.standard_normal((B, NH, L, D)), jnp.float32)
-    vc = jnp.asarray(rng.standard_normal((B, NH, L, D)), jnp.float32)
+    kc = jnp.asarray(rng.standard_normal((B, NH, D, L)), jnp.float32)
+    vc = jnp.asarray(rng.standard_normal((B, NH, D, L)), jnp.float32)
     got = np.asarray(flash_decode(q, kc, vc, 20, scale,
                                   interpret=True, block_k=16))
     np.testing.assert_allclose(got, _oracle(q, kc, vc, 20, scale),
                                rtol=2e-5, atol=2e-5)
+
+
+def _quant_seqminor(kc):
+    """Quantize a seq-minor (b, g, d, L) cache per (b, g, L) position:
+    run _quantize_kv on the head-minor view, flip back."""
+    qk, ks = _quantize_kv(kc.transpose(0, 1, 3, 2))
+    return qk.transpose(0, 1, 3, 2), ks
 
 
 def test_int8_matches_f32_dequant_reference(data):
@@ -82,12 +90,12 @@ def test_int8_matches_f32_dequant_reference(data):
     path's own bf16 trick — the point of the kernel is bandwidth, and
     correctness is pinned exactly by the f32 legs above."""
     q, kc, vc, scale = data
-    qk, ks = _quantize_kv(kc)
-    qv, vs = _quantize_kv(vc)
+    qk, ks = _quant_seqminor(kc)
+    qv, vs = _quant_seqminor(vc)
     kd = jnp.asarray(np.asarray(qk, np.float32)
-                     * np.asarray(ks)[..., None])
+                     * np.asarray(ks)[:, :, None, :])
     vd = jnp.asarray(np.asarray(qv, np.float32)
-                     * np.asarray(vs)[..., None])
+                     * np.asarray(vs)[:, :, None, :])
     want = _oracle(q, kd, vd, 30, scale)
     got = np.asarray(flash_decode(q, qk, qv, 30, scale, ks, vs,
                                   interpret=True, block_k=16))
@@ -99,14 +107,14 @@ def test_int8_padded_tail(data):
     is uninitialized too — pv must be re-masked or 0*NaN rides into
     the accumulator (the v-zeroing alone does not cover vs)."""
     q, kc, vc, scale = data
-    qk, ks = _quantize_kv(kc)
-    qv, vs = _quantize_kv(vc)
+    qk, ks = _quant_seqminor(kc)
+    qv, vs = _quant_seqminor(vc)
     got = np.asarray(flash_decode(q, qk, qv, 40, scale, ks, vs,
                                   interpret=True, block_k=32))
     kd = jnp.asarray(np.asarray(qk, np.float32)
-                     * np.asarray(ks)[..., None])
+                     * np.asarray(ks)[:, :, None, :])
     vd = jnp.asarray(np.asarray(qv, np.float32)
-                     * np.asarray(vs)[..., None])
+                     * np.asarray(vs)[:, :, None, :])
     assert np.isfinite(got).all()
     np.testing.assert_allclose(got, _oracle(q, kd, vd, 40, scale),
                                rtol=1e-2, atol=1e-2)
@@ -172,12 +180,12 @@ def test_block_decode_int8(data):
     rng = np.random.default_rng(9)
     T = 4
     q = jnp.asarray(rng.standard_normal((B, T, NH, D)), jnp.float32)
-    qk, ks = _quantize_kv(kc)
-    qv, vs = _quantize_kv(vc)
+    qk, ks = _quant_seqminor(kc)
+    qv, vs = _quant_seqminor(vc)
     kd = jnp.asarray(np.asarray(qk, np.float32)
-                     * np.asarray(ks)[..., None])
+                     * np.asarray(ks)[:, :, None, :])
     vd = jnp.asarray(np.asarray(qv, np.float32)
-                     * np.asarray(vs)[..., None])
+                     * np.asarray(vs)[:, :, None, :])
     got = np.asarray(flash_block_decode(q, qk, qv, 21, scale, ks, vs,
                                         interpret=True, block_k=32))
     assert np.isfinite(got).all()
@@ -224,3 +232,48 @@ def test_jittable_and_sharded(data):
     np.testing.assert_allclose(np.asarray(g(q, kc, vc)),
                                _oracle(q, kc, vc, 12, scale),
                                rtol=2e-5, atol=2e-5)
+
+
+class TestWriteKvRow:
+    """Aliased single-position cache write kernel vs the DUS oracle."""
+
+    def _mk(self, dtype=jnp.float32, L=256):
+        rng = np.random.default_rng(11)
+        cache = jnp.asarray(rng.standard_normal((B, NKV, D, L)), dtype)
+        row = jnp.asarray(rng.standard_normal((B, NKV, D)), dtype)
+        return cache, row
+
+    def test_matches_dus_scalar_pos(self):
+        from rlo_tpu.pallas.decode import write_kv_row
+        cache, row = self._mk()
+        got = np.asarray(write_kv_row(cache, row, 129, interpret=True))
+        want = np.asarray(cache).copy()
+        want[:, :, :, 129] = np.asarray(row)
+        np.testing.assert_array_equal(got, want)
+
+    def test_matches_dus_ragged(self):
+        from rlo_tpu.pallas.decode import write_kv_row
+        cache, row = self._mk()
+        pos = jnp.asarray([0, 255, 131], jnp.int32)
+        got = np.asarray(write_kv_row(cache, row, pos, interpret=True))
+        want = np.asarray(cache).copy()
+        for bidx, p in enumerate(np.asarray(pos)):
+            want[bidx, :, :, p] = np.asarray(row)[bidx]
+        np.testing.assert_array_equal(got, want)
+
+    def test_int8(self):
+        from rlo_tpu.pallas.decode import write_kv_row
+        rng = np.random.default_rng(12)
+        cache = jnp.asarray(rng.integers(-127, 127, (B, NKV, D, 128)),
+                            jnp.int8)
+        row = jnp.asarray(rng.integers(-127, 127, (B, NKV, D)),
+                          jnp.int8)
+        got = np.asarray(write_kv_row(cache, row, 127, interpret=True))
+        want = np.asarray(cache).copy()
+        want[:, :, :, 127] = np.asarray(row)
+        np.testing.assert_array_equal(got, want)
+
+    def test_gate(self):
+        from rlo_tpu.pallas.decode import can_write_row
+        assert can_write_row(128) and can_write_row(1216)
+        assert not can_write_row(64)

@@ -120,10 +120,12 @@ def test_gqa_decode_matches_naive_loop():
 
 
 def test_gqa_cache_stores_only_kv_heads():
-    # head-leading layout (b, kv_heads, max_len, head_dim) — the
+    # head-leading SEQ-MINOR layout (b, kv_heads, head_dim, max_len) — the
     # Mosaic-native tiling the flash-decode kernel requires
     cache = init_kv_cache(GQA, batch=2, max_len=16)
-    assert cache[0]["k"].shape == (2, GQA.kv_heads, 16, GQA.head_dim)
+    exp_len = 16 if jax.default_backend() != "tpu" else 128
+    assert cache[0]["k"].shape == (2, GQA.kv_heads, GQA.head_dim,
+                                   exp_len)
     assert GQA.kv_heads == 2 < GQA.n_heads
 
 
