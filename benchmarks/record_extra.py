@@ -70,7 +70,9 @@ LEGS = [
     # round-5 item 2: the REALIZED speculative speedup — distill a
     # draft on-chip, measure acceptance and end-to-end tokens/s
     ("spec_e2e_b1",
-     [sys.executable, "benchmarks/spec_bench.py", "--e2e"], 3000),
+     [sys.executable, "benchmarks/spec_bench.py", "--e2e",
+      "--gamma", "8", "--draft-layers", "1", "--draft-dim", "256"],
+     3000),
     # round-5 item 1: the decode HBM budget decomposition (per-
     # component GB/s vs a same-window streaming probe)
     ("decode_budget",

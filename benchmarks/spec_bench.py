@@ -117,16 +117,22 @@ def distill_draft(params, cfg, dcfg, *, plen, seq, n_batches, batch,
     from rlo_tpu.models.transformer import forward, init_params
 
     rng = np.random.default_rng(seed)
-    gen = jax.jit(lambda pr: generate(params, pr, cfg,
-                                      max_new=seq - plen))
-    chunks = []
-    for i in range(n_batches + 1):  # +1 held-out
-        pr = jnp.asarray(rng.integers(0, cfg.vocab, (batch, plen)),
-                         jnp.int32)
-        chunks.append(np.concatenate([np.asarray(pr),
-                                      np.asarray(gen(pr))], axis=1))
-    held = jnp.asarray(chunks[-1])
-    data = jnp.asarray(np.stack(chunks[:-1]))   # (nb, batch, seq)
+    # ONE generate call for the whole corpus: every extra tunnel round
+    # trip is a chance for the remote compiler to wedge (two runs died
+    # with broken pipes mid-loop), and a (nb+1)*batch-row generate is
+    # cheap — the cache at seq 128 is a few GB at most
+    rows = (n_batches + 1) * batch
+    pr = jnp.asarray(rng.integers(0, cfg.vocab, (rows, plen)),
+                     jnp.int32)
+    # params MUST be jit arguments, not closure constants: captured
+    # arrays ship inside the remote-compile request body and the 537MB
+    # f32 flagship weights blow the tunnel's HTTP limit (413; at other
+    # sizes it presents as a broken pipe)
+    toks = np.asarray(jax.jit(lambda P, pr: generate(
+        P, pr, cfg, max_new=seq - plen))(params, pr))
+    corpus = np.concatenate([np.asarray(pr), toks], axis=1)
+    held = jnp.asarray(corpus[:batch])
+    data = jnp.asarray(corpus[batch:].reshape(n_batches, batch, seq))
     print(f"distill: teacher data {data.shape} generated",
           file=sys.stderr)
 
@@ -183,14 +189,24 @@ def e2e(args, cfg, dcfg, gamma):
                                    seq=seq, n_batches=nb, batch=dbatch,
                                    steps=steps, lr=lr)
 
+    # measurement prompt length: speculative pays when steps are big
+    # relative to the per-round control machinery — long prompts make
+    # the target step cache-bound (the latency-sensitive serving
+    # case). Distillation stays at short prompts (the corpus is about
+    # the model pair, not the prompt length).
+    plen_m = args.prompt_len if args.prompt_len > plen else plen
+
     # realized acceptance at batch 1: verify rounds over fresh prompts
+    # (vmapped over 8 prompts — one chip call, not eight)
     rng = np.random.default_rng(99)
-    prompts = jnp.asarray(rng.integers(0, cfg.vocab, (8, 1, plen)),
+    prompts = jnp.asarray(rng.integers(0, cfg.vocab, (8, 1, plen_m)),
                           jnp.int32)
-    spec1 = jax.jit(lambda pr: speculative_generate(
-        params, dparams, pr, cfg, dcfg, max_new=max_new, gamma=gamma,
-        return_rounds=True))
-    rounds = [int(spec1(prompts[i])[1]) for i in range(prompts.shape[0])]
+    spec_v = jax.jit(lambda P, D, prs: jax.vmap(
+        lambda pr: speculative_generate(
+            P, D, pr, cfg, dcfg, max_new=max_new, gamma=gamma,
+            return_rounds=True)[1])(prs))
+    rounds = [int(r) for r in np.asarray(
+        spec_v(params, dparams, prompts))]
     tok_round = (max_new - 1) / float(np.mean(rounds))
     print(f"e2e: rounds over 8 prompts {rounds} -> "
           f"{tok_round:.2f} tokens/round (ideal {gamma})",
@@ -202,27 +218,27 @@ def e2e(args, cfg, dcfg, gamma):
     p0 = prompts[0]
 
     @partial(jax.jit, static_argnames=("kk",))
-    def plain_chain(pr, kk):
+    def plain_chain(P, pr, kk):
         def it(i, carry):
             pr, acc = carry
-            toks = generate(params, pr, cfg, max_new=max_new)
+            toks = generate(P, pr, cfg, max_new=max_new)
             pr = pr.at[0, 0].set(toks[0, -1] % cfg.vocab)
             return pr, acc + toks[0, -1]
         return jax.lax.fori_loop(0, kk, it, (pr, jnp.int32(0)))[1]
 
     @partial(jax.jit, static_argnames=("kk",))
-    def spec_chain(pr, kk):
+    def spec_chain(P, D, pr, kk):
         def it(i, carry):
             pr, acc = carry
             toks = speculative_generate(
-                params, dparams, pr, cfg, dcfg, max_new=max_new,
-                gamma=gamma)
+                P, D, pr, cfg, dcfg, max_new=max_new, gamma=gamma)
             pr = pr.at[0, 0].set(toks[0, -1] % cfg.vocab)
             return pr, acc + toks[0, -1]
         return jax.lax.fori_loop(0, kk, it, (pr, jnp.int32(0)))[1]
 
     t_plain, t_spec = chain_time_pair(plain_chain, spec_chain,
-                                      (p0,), (p0,), k)
+                                      (params, p0),
+                                      (params, dparams, p0), k)
     speedup = t_plain / t_spec
     tok_s = max_new / t_spec
     on_tpu = jax.default_backend() == "tpu"
@@ -233,7 +249,7 @@ def e2e(args, cfg, dcfg, gamma):
     print(json.dumps({
         "metric": f"speculative decoding END-TO-END, distilled "
                   f"{dcfg.n_layers}-layer draft, gamma={gamma}, "
-                  f"batch 1, measured acceptance "
+                  f"batch 1, prompt {plen_m}, measured acceptance "
                   f"{round(tok_round, 2)} tok/round "
                   f"(held-out argmax agreement {round(agree, 3)}), "
                   f"{'bf16 v5e chip' if on_tpu else jax.default_backend()}",
@@ -254,6 +270,11 @@ def main():
     ap.add_argument("--e2e", action="store_true",
                     help="distill a draft on-chip and measure the "
                          "realized acceptance + end-to-end speedup")
+    ap.add_argument("--draft-layers", type=int, default=None)
+    ap.add_argument("--draft-dim", type=int, default=None)
+    ap.add_argument("--prompt-len", type=int, default=16,
+                    help="e2e measurement prompt length (the "
+                         "distillation corpus stays short)")
     args = ap.parse_args()
     gamma = args.gamma
 
@@ -273,6 +294,14 @@ def main():
         batch, plen, k = args.batch or 8, 256, 16
 
     if args.e2e:
+        import dataclasses
+        if args.draft_layers or args.draft_dim:
+            dcfg = dataclasses.replace(
+                dcfg,
+                n_layers=args.draft_layers or dcfg.n_layers,
+                d_model=args.draft_dim or dcfg.d_model,
+                n_heads=max(1, (args.draft_dim or dcfg.d_model) // 64),
+                d_ff=4 * (args.draft_dim or dcfg.d_model))
         return e2e(args, cfg, dcfg, gamma)
 
     max_len = plen + gamma + 1

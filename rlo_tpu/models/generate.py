@@ -386,23 +386,49 @@ def block_decode(params: dict, tokens, pos0, cache,
             kt = kt.transpose(0, 1, 3, 2)          # (b, kvh, hd, T)
             vt = vt.transpose(0, 1, 3, 2)
             kvh = lc["k"].shape[1]
-            rows = jnp.arange(b)[:, None, None, None]
-            heads = jnp.arange(kvh)[None, :, None, None]
-            dims = jnp.arange(lc["k"].shape[2])[None, None, :, None]
-            posw = pos_arr[:, None, None, :]       # (b, 1, 1, T)
-            kc = lc["k"].at[rows, heads, dims, posw].set(
-                kt.astype(store_dt))
-            vc = lc["v"].at[rows, heads, dims, posw].set(
-                vt.astype(store_dt))
+            from rlo_tpu.pallas.decode import (can_write_block,
+                                               write_kv_block)
+            use_wb = (jax.default_backend() == "tpu"
+                      and can_write_block(lc["k"].shape[3])
+                      and T <= 128)
+            if use_wb:
+                # the XLA lane-index scatter lowers to a generic
+                # scatter measured ~1.2 ms PER VERIFY at batch 1
+                # (block_decode 1.65 ms vs 0.46 ms decode step) —
+                # the aliased pallas block write replaces it
+                kc = write_kv_block(lc["k"], kt.astype(store_dt),
+                                    pos0)
+                vc = write_kv_block(lc["v"], vt.astype(store_dt),
+                                    pos0)
+            else:
+                rows = jnp.arange(b)[:, None, None, None]
+                heads = jnp.arange(kvh)[None, :, None, None]
+                dims = jnp.arange(lc["k"].shape[2])[None, None, :,
+                                                    None]
+                posw = pos_arr[:, None, None, :]   # (b, 1, 1, T)
+                kc = lc["k"].at[rows, heads, dims, posw].set(
+                    kt.astype(store_dt))
+                vc = lc["v"].at[rows, heads, dims, posw].set(
+                    vt.astype(store_dt))
             entry = {"k": kc, "v": vc}
             ks = vs = None
             if quant:
-                # scale sidecars stay (b, kvh, L): 3-D scatter indices
-                r3 = jnp.arange(b)[:, None, None]
-                h3 = jnp.arange(kvh)[None, :, None]
-                p3 = pos_arr[:, None, :]           # (b, 1, T)
-                ks = lc["ks"].at[r3, h3, p3].set(ks_new)
-                vs = lc["vs"].at[r3, h3, p3].set(vs_new)
+                if use_wb:
+                    # sidecars (b, kvh, L) ride the same kernel via
+                    # the free (b, kvh, 1, L) view
+                    ks = write_kv_block(lc["ks"][:, :, None, :],
+                                        ks_new[:, :, None, :],
+                                        pos0)[:, :, 0, :]
+                    vs = write_kv_block(lc["vs"][:, :, None, :],
+                                        vs_new[:, :, None, :],
+                                        pos0)[:, :, 0, :]
+                else:
+                    # scale sidecars stay (b, kvh, L): 3-D scatter
+                    r3 = jnp.arange(b)[:, None, None]
+                    h3 = jnp.arange(kvh)[None, :, None]
+                    p3 = pos_arr[:, None, :]       # (b, 1, T)
+                    ks = lc["ks"].at[r3, h3, p3].set(ks_new)
+                    vs = lc["vs"].at[r3, h3, p3].set(vs_new)
                 entry.update(ks=ks, vs=vs)
             new_cache.append(entry)
             return _attend_cache_block(q, kc, vc, pos_arr, scale,

@@ -277,3 +277,57 @@ class TestWriteKvRow:
         from rlo_tpu.pallas.decode import can_write_row
         assert can_write_row(128) and can_write_row(1216)
         assert not can_write_row(64)
+
+
+class TestWriteKvBlock:
+    """Aliased T-column cache write (the verify-path scatter killer)."""
+
+    def _mk(self, L=384, T=5):
+        rng = np.random.default_rng(21)
+        cache = jnp.asarray(rng.standard_normal((B, NKV, D, L)),
+                            jnp.float32)
+        rows = jnp.asarray(rng.standard_normal((B, NKV, D, T)),
+                           jnp.float32)
+        return cache, rows
+
+    @pytest.mark.parametrize("pos0", [0, 100, 126, 256, 379])
+    def test_matches_scatter(self, pos0):
+        from rlo_tpu.pallas.decode import write_kv_block
+        cache, rows = self._mk()
+        T = rows.shape[3]
+        got = np.asarray(write_kv_block(cache, rows, pos0,
+                                        interpret=True))
+        want = np.asarray(cache).copy()
+        want[:, :, :, pos0:pos0 + T] = np.asarray(rows)
+        np.testing.assert_array_equal(got, want)
+
+    def test_ragged_pos0_straddles_blocks(self):
+        from rlo_tpu.pallas.decode import write_kv_block
+        cache, rows = self._mk()
+        T = rows.shape[3]
+        pos0 = jnp.asarray([125, 0, 379], jnp.int32)  # straddle/edge
+        got = np.asarray(write_kv_block(cache, rows, pos0,
+                                        interpret=True))
+        want = np.asarray(cache).copy()
+        for bi, p in enumerate(np.asarray(pos0)):
+            want[bi, :, :, p:p + T] = np.asarray(rows)[bi]
+        np.testing.assert_array_equal(got, want)
+
+    def test_gate(self):
+        from rlo_tpu.pallas.decode import can_write_block
+        assert can_write_block(256) and can_write_block(1280)
+        assert not can_write_block(128)   # needs two slidable blocks
+        assert not can_write_block(200)   # non-x128
+
+
+def test_write_row_oob_pos_is_dropped():
+    """serve advances retired slots past max_len: an out-of-range pos
+    must write NOTHING (the scatter it replaced dropped OOB writes)."""
+    from rlo_tpu.pallas.decode import write_kv_row
+    rng = np.random.default_rng(31)
+    cache = jnp.asarray(rng.standard_normal((B, NKV, D, 256)),
+                        jnp.float32)
+    row = jnp.asarray(rng.standard_normal((B, NKV, D)), jnp.float32)
+    pos = jnp.asarray([256, 300, 10_000], jnp.int32)
+    got = np.asarray(write_kv_row(cache, row, pos, interpret=True))
+    np.testing.assert_array_equal(got, np.asarray(cache))
