@@ -102,14 +102,18 @@ def main():
             lengths[j] = len(p)
         key = mx
         if key not in gen:
-            f = jax.jit(lambda pr, ln, m=mx: generate(
-                params, pr, cfg, max_new=m, max_len=bucket + m,
+            # params as a jit ARGUMENT: closures ship the weights in
+            # the remote-compile request and blow the tunnel's HTTP
+            # body limit (413)
+            f = jax.jit(lambda P, pr, ln, m=mx: generate(
+                P, pr, cfg, max_new=m, max_len=bucket + m,
                 prompt_lengths=ln))
-            np.asarray(f(jnp.asarray(prompts),
+            np.asarray(f(params, jnp.asarray(prompts),
                          jnp.asarray(lengths)))  # compile+warm
             gen[key] = f
         t0 = time.perf_counter()
-        np.asarray(gen[key](jnp.asarray(prompts), jnp.asarray(lengths)))
+        np.asarray(gen[key](params, jnp.asarray(prompts),
+                            jnp.asarray(lengths)))
         t_naive += time.perf_counter() - t0
         naive_slot_steps += mx * slots
 

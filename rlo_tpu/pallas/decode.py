@@ -134,7 +134,7 @@ def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, *rest, scale: float,
 
 
 def _write_row_kernel(pos_ref, row_ref, cache_ref, out_ref, *,
-                      n_blocks: int):
+                      n_blocks: int, per_row: bool):
     """Write one (nkv, hd) row into the lane at GLOBAL position
     ``pos`` of the cache block containing it (grid = batch; the block
     index_map selected column min(pos // 128, n_blocks-1)).
@@ -144,7 +144,7 @@ def _write_row_kernel(pos_ref, row_ref, cache_ref, out_ref, *,
     past max_len) matches no column and the write is dropped, exactly
     like the XLA scatter this replaced (a local pos%128 match would
     silently alias into the clamped last block)."""
-    ib = pl.program_id(0)
+    ib = pl.program_id(0) if per_row else 0
     blk = jnp.minimum(pos_ref[ib] // 128, n_blocks - 1)
     col = blk * 128 + jax.lax.broadcasted_iota(jnp.int32,
                                                (1, 1, 1, 128), 3)
@@ -254,6 +254,14 @@ def write_kv_row(cache, row, pos, *, interpret: Optional[bool] = None):
         interpret = jax.default_backend() != "tpu"
     b, nkv, d, L = cache.shape
     pos = jnp.asarray(pos, jnp.int32)
+    # SCALAR pos (plain generate's scan: every row at the same
+    # position): batch-chunked blocks instead of the (b,) grid — b
+    # launches per call x16 calls/step. Even chunked, the 16 calls
+    # cost a fixed ~0.33 ms/step, part of the short-cache launch-
+    # bound regime where seq-minor trades away the plen-16 corner
+    # (DESIGN.md "decode HBM budget"); the win is everywhere the
+    # cache is the bound.
+    per_row = pos.ndim != 0
     pos = jnp.full((b,), pos) if pos.ndim == 0 else pos.reshape(b)
     # shard_map vma alignment: a replicated pos/row must carry the
     # same varying-axes set as the tp-sharded cache (same cast
@@ -261,30 +269,62 @@ def write_kv_row(cache, row, pos, *, interpret: Optional[bool] = None):
     from rlo_tpu.parallel.mesh import vary_like
     pos = vary_like(pos, cache)
     row = vary_like(row, cache)
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,
-        grid=(b,),
-        in_specs=[
+    if per_row:
+        in_specs = [
             pl.BlockSpec((1, nkv, d, 1),
                          lambda ib, pos_ref: (ib, 0, 0, 0)),
             # clamp: an out-of-range pos (serve advances retired
             # slots past max_len) must select a legal block — the
-            # in-kernel col == pos mask then matches nothing, so the
+            # in-kernel GLOBAL col == pos match then fails, so the
             # write is dropped exactly like the scatter it replaced
             pl.BlockSpec((1, nkv, d, 128),
                          lambda ib, pos_ref, _n=L // 128: (
                              ib, 0, 0,
                              jnp.minimum(pos_ref[ib] // 128,
                                          _n - 1))),
-        ],
-        out_specs=pl.BlockSpec(
+        ]
+        out_specs = pl.BlockSpec(
             (1, nkv, d, 128),
             lambda ib, pos_ref, _n=L // 128: (
                 ib, 0, 0,
-                jnp.minimum(pos_ref[ib] // 128, _n - 1))),
+                jnp.minimum(pos_ref[ib] // 128, _n - 1)))
+        grid = (b,)
+    else:
+        # batch-chunked: the largest row-chunk whose cache block fits
+        # ~8 MB of VMEM (in + aliased out), so a 32-row write is 2
+        # launches instead of 32
+        itemsize = cache.dtype.itemsize
+        # Mosaic double-buffers every block across grid steps: the
+        # scoped-VMEM cost is ~2x(cache-in + aliased-out) = 4x the
+        # block bytes (a 2x budget OOM'd at 24 MB on the 16 MB limit)
+        bb = b
+        while bb > 1 and (4 * bb * nkv * d * 128 * itemsize
+                          > (12 << 20) or b % bb):
+            bb -= 1
+        in_specs = [
+            pl.BlockSpec((bb, nkv, d, 1),
+                         lambda i, pos_ref: (i, 0, 0, 0)),
+            pl.BlockSpec((bb, nkv, d, 128),
+                         lambda i, pos_ref, _n=L // 128: (
+                             i, 0, 0,
+                             jnp.minimum(pos_ref[0] // 128,
+                                         _n - 1))),
+        ]
+        out_specs = pl.BlockSpec(
+            (bb, nkv, d, 128),
+            lambda i, pos_ref, _n=L // 128: (
+                i, 0, 0,
+                jnp.minimum(pos_ref[0] // 128, _n - 1)))
+        grid = (b // bb,)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=out_specs,
     )
     return pl.pallas_call(
-        functools.partial(_write_row_kernel, n_blocks=L // 128),
+        functools.partial(_write_row_kernel, n_blocks=L // 128,
+                          per_row=per_row),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct(cache.shape, cache.dtype),
         input_output_aliases={2: 0},  # cache (after pos, row) -> out
