@@ -6,6 +6,12 @@
 # shm demo scenarios, the MPI-path syntax check, the driver entry-point
 # dryrun, and the tiny-size benchmark suite. Exits nonzero on the first
 # failure.
+#
+# The sanitized selftest also runs INSIDE the pytest suite
+# (tests/test_native_selftest.py), so the C engine's ack/retransmit
+# and fault-injection paths are sanitizer-clean in tier-1, not just in
+# this script; the explicit leg below keeps a fast standalone entry
+# point and covers environments that skip pytest.
 set -e
 cd "$(dirname "$0")"
 

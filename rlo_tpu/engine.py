@@ -18,7 +18,14 @@ replicate"):
     progress_engine.h design the reference never landed);
   - messages are plain GC'd objects — pickup/recycle keeps the reference's
     delivery *semantics* (a message can be picked up while still
-    forwarding) without manual buffer ownership.
+    forwarding) without manual buffer ownership;
+  - reliable delivery and bounded ops (net-new; the reference has no
+    timeouts, retries, or loss recovery — SURVEY.md §5): opt-in ARQ
+    (``arq_rto``) retransmits unacked frames with per-link sequence
+    numbers and receive-side dedup, and op deadlines (``op_deadline`` /
+    per-call ``deadline=``) make every bcast/proposal complete or FAIL
+    deterministically, with a rootless ABORT unparking relays
+    (docs/DESIGN.md §6).
 """
 
 from __future__ import annotations
@@ -34,7 +41,8 @@ from typing import Callable, List, Optional, Sequence, Set
 from rlo_tpu import topology
 from rlo_tpu.transport.base import SendHandle, Transport
 from rlo_tpu.utils.tracing import TRACER, Ev
-from rlo_tpu.wire import Frame, Tag, BCAST_TAGS, MSG_SIZE_MAX
+from rlo_tpu.wire import (ARQ_EXEMPT_TAGS, BCAST_TAGS, Frame, MSG_SIZE_MAX,
+                          Tag, restamp_seq)
 
 
 class ReqState(enum.IntEnum):
@@ -89,6 +97,10 @@ class ProposalState:
     # the merged vote has been determined and sent up — a later
     # duplicate's parent can safely receive it immediately
     resolved: bool = False
+    # absolute clock time by which the round must resolve, else the
+    # proposer transitions to FAILED and broadcasts a rootless ABORT
+    # (op-deadline machinery; None = no deadline)
+    deadline: Optional[float] = None
 
 
 @dataclass
@@ -101,9 +113,24 @@ class _Msg:
     pickup_done: bool = False
     fwd_done: bool = False
     prop_state: Optional[ProposalState] = None
+    # op-deadline bookkeeping (net-new): absolute clock time by which
+    # this op's outbound work must complete, else it transitions to
+    # FAILED and is abandoned instead of tracked forever
+    deadline: Optional[float] = None
+    state: ReqState = ReqState.IN_PROGRESS
 
     def sends_done(self) -> bool:
         return all(h.done() for h in self.send_handles)
+
+
+@dataclass
+class _ArqEntry:
+    """One unacknowledged reliable frame awaiting its cumulative ACK
+    (the sender half of the ARQ state machine)."""
+    tag: int
+    raw: bytes            # encoded frame, seq already stamped
+    due: float            # next retransmit time
+    retries: int = 0
 
 
 class EngineManager:
@@ -165,7 +192,10 @@ class ProgressEngine:
                  failure_cb: Optional[Callable[[int, bool], None]] = None,
                  clock: Callable[[], float] = time.monotonic,
                  members: Optional[Sequence[int]] = None,
-                 fanout: Optional[str] = None):
+                 fanout: Optional[str] = None,
+                 arq_rto: Optional[float] = None,
+                 arq_max_retries: int = 8,
+                 op_deadline: Optional[float] = None):
         """``failure_timeout`` (seconds) enables the net-new failure
         detector (the reference defines RLO_FAILED but never assigns it,
         SURVEY.md §5): ranks heartbeat their ring successor every
@@ -193,7 +223,28 @@ class ProgressEngine:
         origin sends to every live member, receivers are leaves — the
         right shape when scheduling latency dominates). Rootlessness,
         dedup, and IAR vote accounting are schedule-independent.
-        Default from $RLO_FANOUT, else 'skip_ring'."""
+        Default from $RLO_FANOUT, else 'skip_ring'.
+
+        ``arq_rto`` (seconds) enables the reliable-delivery layer (the
+        reference is fire-and-forget: no timeouts, retries, or loss
+        recovery, SURVEY.md §5): every engine frame except heartbeats
+        and ACKs is stamped with a per-(src, dst) link sequence number
+        and kept in a retransmit queue until the destination's
+        cumulative ACK covers it; unacked frames retransmit after
+        ``arq_rto`` with exponential backoff, giving up after
+        ``arq_max_retries`` (liveness of a persistently silent peer is
+        the failure detector's job, not ARQ's). Receivers dedup on
+        (sender, seq) BEFORE tag dispatch, so retransmits are
+        idempotent through the store-and-forward broadcast path.
+
+        ``op_deadline`` (seconds, relative) is the default deadline for
+        bcast/submit_proposal ops; per-call ``deadline=`` overrides. A
+        proposal that has not resolved by its deadline transitions to
+        ReqState.FAILED (finally assigning the reference's dead enum
+        value) and the proposer broadcasts a rootless Tag.ABORT so
+        relays unpark the round and deliver the failure to the app via
+        pickup instead of waiting forever; the pid is then free to
+        resubmit on the (possibly re-formed) survivor topology."""
         ws = transport.world_size
         if ws < 2:  # bcomm_init rejects this (rootless_ops.c:1464)
             raise ValueError(f"world_size must be >= 2, got {ws}")
@@ -273,6 +324,25 @@ class ProgressEngine:
         self._hb_last_sent = float("-inf")
         self._hb_seen: dict = {}  # sender rank -> last heartbeat clock
 
+        # reliable delivery (ARQ; net-new — SURVEY.md §5 "no timeouts,
+        # retries, or loss recovery" in the reference)
+        if arq_rto is not None and arq_rto <= 0:
+            raise ValueError(f"arq_rto must be positive, got {arq_rto}")
+        self.arq_rto = arq_rto
+        self.arq_max_retries = arq_max_retries
+        self._tx_seq: dict = {}       # dst -> next link seq
+        self._tx_unacked: dict = {}   # dst -> {seq: _ArqEntry}
+        self._tx_skip: dict = {}      # dst -> [given-up seq, next send]
+        self._rx_seen: dict = {}      # src -> [contig, set(seqs > contig)]
+        self._ack_due: Set[int] = set()  # srcs owed a cumulative ACK
+        self.arq_retransmits = 0
+        self.arq_dup_drops = 0
+        self.arq_gave_up = 0
+
+        # op deadlines (net-new): ops complete or FAIL deterministically
+        self.op_deadline = op_deadline
+        self.ops_failed = 0
+
         if members is not None:
             group = sorted(set(int(r) for r in members))
             if len(group) < 2:
@@ -298,10 +368,159 @@ class ProgressEngine:
         self.engine_id = manager.append(self)
 
     # ------------------------------------------------------------------
+    # Reliable delivery: ARQ send/receive (net-new — the reference has
+    # no loss recovery at all, SURVEY.md §5). Sender half: every
+    # non-exempt frame gets a per-(src, dst) link seq and sits in a
+    # retransmit queue until the cumulative ACK covers it. Receiver
+    # half: dedup on (immediate sender, seq) before tag dispatch —
+    # retransmits are idempotent everywhere, including mid-forward in
+    # the store-and-forward bcast path — then schedule a cumulative
+    # ACK back (one per sender per progress turn, plus a piggyback on
+    # every heartbeat). Exactly-once composes by layers: link-level
+    # (src, seq) dedup absorbs ARQ retransmits; app-level (origin,
+    # seq) / settled-(pid, gen) dedup absorbs view-change re-floods,
+    # which travel with FRESH link seqs.
+    # ------------------------------------------------------------------
+    def _send_raw(self, dst: int, tag: int, raw: bytes) -> SendHandle:
+        """The one gate every engine frame leaves through: stamps the
+        link seq and registers the retransmit entry when ARQ is on."""
+        if self.arq_rto is None or tag in ARQ_EXEMPT_TAGS:
+            return self.transport.isend(dst, int(tag), raw)
+        seq = self._tx_seq.get(dst, 0)
+        self._tx_seq[dst] = seq + 1
+        raw = restamp_seq(raw, seq)
+        self._tx_unacked.setdefault(dst, {})[seq] = _ArqEntry(
+            tag=int(tag), raw=raw, due=self.clock() + self.arq_rto)
+        return self.transport.isend(dst, int(tag), raw)
+
+    def _send(self, dst: int, tag: int, frame: Frame) -> SendHandle:
+        return self._send_raw(dst, tag, frame.encode())
+
+    @staticmethod
+    def _window_record(ent: list, seq: int) -> bool:
+        """Record ``seq`` in a [contig, set(seqs > contig)] watermark+
+        window dedup entry; True when already seen. ONE implementation
+        for both key spaces — the link-level (sender, seq) ARQ dedup
+        and the broadcast-level (origin, seq) dedup (mirror of the C
+        side's window_record). The 4096 compaction bounds out-of-order
+        state by assuming the oldest half's gaps are lost, not late —
+        see the at-least-once bound note in docs/DESIGN.md §6."""
+        if seq <= ent[0] or seq in ent[1]:
+            return True
+        ent[1].add(seq)
+        while ent[0] + 1 in ent[1]:
+            ent[0] += 1
+            ent[1].remove(ent[0])
+        if len(ent[1]) > 4096:
+            ent[0] = sorted(ent[1])[len(ent[1]) // 2]
+            ent[1] = {s for s in ent[1] if s > ent[0]}
+        return False
+
+    def _rx_is_dup(self, src: int, seq: int) -> bool:
+        """Link-level exactly-once receipt check, keyed on (immediate
+        sender, seq)."""
+        return self._window_record(
+            self._rx_seen.setdefault(src, [-1, set()]), seq)
+
+    def _rx_cum(self, src: int) -> int:
+        return self._rx_seen.get(src, [-1, set()])[0]
+
+    def _rx_skip(self, src: int, upto: int) -> None:
+        """Sender-side skip notice: ``src`` gave up retransmitting
+        everything <= ``upto``; advance the watermark so the hole can
+        never block cumulative ACKs for later frames (without this,
+        one given-up frame would force every subsequent frame on the
+        link through the full retransmit-to-exhaustion cycle)."""
+        ent = self._rx_seen.setdefault(src, [-1, set()])
+        if upto > ent[0]:
+            ent[0] = upto
+            ent[1] = {s for s in ent[1] if s > upto}
+            while ent[0] + 1 in ent[1]:  # holes below may now close
+                ent[0] += 1
+                ent[1].remove(ent[0])
+            self._ack_due.add(src)  # tell the sender the new cum
+
+    def _on_ack(self, src: int, cum: int) -> None:
+        """Cumulative ACK from ``src``: everything <= cum is delivered;
+        drop it from the retransmit queue (and retire a pending SKIP
+        notice the ACK proves was absorbed)."""
+        sk = self._tx_skip.get(src)
+        if sk is not None and cum >= sk[0]:
+            del self._tx_skip[src]
+        q = self._tx_unacked.get(src)
+        if not q:
+            return
+        for seq in [s for s in q if s <= cum]:
+            del q[seq]
+
+    def _arq_tick(self) -> None:
+        """Retransmit sweep: resend overdue unacked frames with
+        exponential backoff; give up after arq_max_retries (a peer
+        that silent is the failure detector's problem).
+
+        Every give-up arms a SKIP notice (an ACK frame with the
+        vote=-2 sentinel, pid = abandoned seq) telling the receiver to
+        advance its watermark over the permanent hole — otherwise one
+        given-up frame would pin the cumulative ACK below every later
+        seq on the link, forcing each of them through the full
+        retransmit-to-exhaustion cycle. The notice is only SENT once
+        no lower seq is still being retried (the receiver's advanced
+        watermark would misread those retransmits as duplicates), and
+        it repeats at rto cadence until an ACK at or past the skipped
+        seq proves the watermark moved."""
+        now = self.clock()
+        for dst, q in self._tx_unacked.items():
+            if dst in self.failed:
+                if q:
+                    q.clear()
+                self._tx_skip.pop(dst, None)
+                continue
+            for seq, ent in list(q.items()):
+                if now < ent.due:
+                    continue
+                if ent.retries >= self.arq_max_retries:
+                    del q[seq]
+                    self.arq_gave_up += 1
+                    sk = self._tx_skip.setdefault(dst, [-1, now])
+                    if seq > sk[0]:
+                        sk[0] = seq
+                        sk[1] = now  # send immediately
+                    continue
+                ent.retries += 1
+                ent.due = now + self.arq_rto * (2 ** ent.retries)
+                self.arq_retransmits += 1
+                # same raw bytes, same seq: the receiver dedups
+                self.transport.isend(dst, ent.tag, ent.raw)
+            sk = self._tx_skip.get(dst)
+            if sk is not None and now >= sk[1] and \
+                    all(s > sk[0] for s in q):
+                self.transport.isend(
+                    dst, int(Tag.ACK),
+                    Frame(origin=self.rank, pid=sk[0], vote=-2).encode())
+                sk[1] = now + self.arq_rto
+
+    def _flush_acks(self) -> None:
+        """Send the owed cumulative ACKs (at most one per sender per
+        progress turn; ACKs are themselves unreliable — a lost one
+        just costs one more retransmit+dedup round trip)."""
+        for src in self._ack_due:
+            if src in self.failed or src == self.rank:
+                continue
+            self.transport.isend(
+                src, int(Tag.ACK),
+                Frame(origin=self.rank, vote=self._rx_cum(src)).encode())
+        self._ack_due.clear()
+
+    def arq_unacked(self) -> int:
+        """Outstanding reliable frames not yet covered by an ACK."""
+        return sum(len(q) for q in self._tx_unacked.values())
+
+    # ------------------------------------------------------------------
     # Rootless broadcast (~RLO_bcast_gen, rootless_ops.c:1581-1604)
     # ------------------------------------------------------------------
     def bcast(self, payload: bytes, tag: Tag = Tag.BCAST,
-              pid: int = -1, vote: int = -1) -> _Msg:
+              pid: int = -1, vote: int = -1,
+              deadline: Optional[float] = None) -> _Msg:
         """Initiate a broadcast from this rank — no pre-designated root."""
         if Tag(tag) not in BCAST_TAGS:
             raise ValueError(
@@ -325,16 +544,22 @@ class ProgressEngine:
             self._bcast_seq += 1
         frame = Frame(origin=self.rank, pid=pid, vote=vote, payload=payload)
         raw = frame.encode()
-        if Tag(tag) in (Tag.BCAST, Tag.IAR_DECISION):
+        if Tag(tag) in (Tag.BCAST, Tag.IAR_DECISION, Tag.ABORT):
             # decisions join the re-flood log: a decision lost in a
             # view-change window would otherwise leave relayed rounds
             # parked forever (blocking checkpoint) — the settled-set
             # dedup absorbs the flood exactly like (origin, seq) does
-            # for broadcasts
+            # for broadcasts. Aborts ride the same log for the same
+            # reason: an abort lost with a dead relay would leave the
+            # aborted round parked at its descendants.
             self._recent_bcasts.append((int(tag), raw))
         msg = _Msg(frame=frame, tag=int(tag))
+        if deadline is None:
+            deadline = self.op_deadline
+        if deadline is not None:
+            msg.deadline = self.clock() + deadline
         for dst in self._cur_initiator_targets():  # furthest-first
-            msg.send_handles.append(self.transport.isend(dst, int(tag), raw))
+            msg.send_handles.append(self._send_raw(dst, int(tag), raw))
         self.queue_wait.append(msg)
         self.sent_bcast_cnt += 1
         TRACER.emit(self.rank, Ev.BCAST_INIT, int(tag), len(payload))
@@ -344,13 +569,20 @@ class ProgressEngine:
     # ------------------------------------------------------------------
     # IAR leaderless consensus (~rootless_ops.c:668-932)
     # ------------------------------------------------------------------
-    def submit_proposal(self, proposal: bytes, pid: int) -> int:
+    def submit_proposal(self, proposal: bytes, pid: int,
+                        deadline: Optional[float] = None) -> int:
         """Propose; every rank judges; AND-aggregated votes come back up the
         reverse broadcast tree; we then broadcast the decision
         (~RLO_submit_proposal, rootless_ops.c:876-906).
 
         Returns the decision if it completed within this call's progress
         turn, else -1 (poll with check_proposal_state / vote_my_proposal).
+
+        ``deadline`` (seconds, relative; default ``op_deadline``): if the
+        round has not resolved by then, the proposal transitions to
+        ReqState.FAILED and a rootless Tag.ABORT broadcast unparks the
+        round at every relay — the op completes or fails
+        deterministically instead of hanging on a lost vote.
         """
         p = self.my_own_proposal
         if p.state == ReqState.IN_PROGRESS:
@@ -358,6 +590,9 @@ class ProgressEngine:
                 f"rank {self.rank}: proposal pid={p.pid} is still in "
                 f"progress; wait for completion before submitting another")
         p.pid = pid
+        if deadline is None:
+            deadline = self.op_deadline
+        p.deadline = None if deadline is None else self.clock() + deadline
         # rank-qualified (counter * world_size + rank) so two proposers
         # reusing one pid can never collide on generation either, with
         # no overflow for any realistic rank count or round count
@@ -430,12 +665,18 @@ class ProgressEngine:
     # The gear (~make_progress_gen, rootless_ops.c:551-641)
     # ------------------------------------------------------------------
     def _progress_once(self) -> None:
-        # (a) my own decision broadcast completion -> proposal COMPLETED
+        # (a) my own decision broadcast completion -> proposal COMPLETED;
+        # deadline expiry -> FAILED + rootless ABORT (op-deadline
+        # machinery: the op terminates deterministically either way)
         p = self.my_own_proposal
         if p.state == ReqState.IN_PROGRESS and p.decision_pending:
             if all(h.done() for h in p.decision_handles):
                 p.state = ReqState.COMPLETED
                 p.decision_pending = False
+        if (p.state == ReqState.IN_PROGRESS and not p.decision_pending
+                and p.deadline is not None
+                and self.clock() > p.deadline):
+            self._abort_own_proposal(p)
 
         # (b) drain the transport, dispatch on tag
         while True:
@@ -451,6 +692,24 @@ class ProgressEngine:
                 # different ring successors)
                 self._hb_seen[src] = self.clock()
             msg = _Msg(frame=Frame.decode(raw), tag=tag, src=src)
+            if tag == Tag.ACK:
+                if msg.frame.vote == -2 and msg.frame.pid >= 0:
+                    # SKIP notice: the sender gave up on everything
+                    # <= pid; advance the watermark over the hole
+                    self._rx_skip(src, msg.frame.pid)
+                else:
+                    self._on_ack(src, msg.frame.vote)
+                continue
+            if self.arq_rto is not None and tag not in ARQ_EXEMPT_TAGS \
+                    and msg.frame.seq >= 0:  # IntEnum: raw ints hash in
+                # link-level exactly-once BEFORE tag dispatch: a
+                # retransmitted frame must be idempotent everywhere
+                # (dup suppression), and its receipt owes the sender a
+                # cumulative ACK either way
+                self._ack_due.add(src)
+                if self._rx_is_dup(src, msg.frame.seq):
+                    self.arq_dup_drops += 1
+                    continue
             if tag == Tag.BCAST:
                 self.recved_bcast_cnt += 1
                 if self._bcast_is_dup(msg):
@@ -465,9 +724,16 @@ class ProgressEngine:
                 self.recved_bcast_cnt += 1
                 self._on_decision(msg)
             elif tag == Tag.HEARTBEAT:
-                pass  # liveness already refreshed above for any frame
+                # liveness already refreshed above for any frame; a
+                # piggybacked cumulative ACK rides the payload
+                if self.arq_rto is not None and \
+                        len(msg.frame.payload) >= 4:
+                    self._on_ack(src, struct.unpack_from(
+                        "<i", msg.frame.payload)[0])
             elif tag == Tag.FAILURE:
                 self._on_failure(msg)
+            elif tag == Tag.ABORT:
+                self._on_abort(msg)
             else:
                 self._on_other(msg)
 
@@ -475,11 +741,28 @@ class ProgressEngine:
         if self.failure_timeout is not None:
             self._failure_tick()
 
+        # (b3) reliable delivery: retransmit overdue unacked frames,
+        # then flush the cumulative ACKs this turn's receipts owe
+        if self.arq_rto is not None:
+            self._arq_tick()
+            self._flush_acks()
+
         # (c) wait_and_pickup sweep (~_wait_and_pickup_queue_process :995).
         # Messages here are never picked up (pickup_next moves them to
         # queue_wait when it claims them), so completion always delivers.
         for msg in list(self.queue_wait_and_pickup):
             if msg.sends_done():
+                msg.fwd_done = True
+                if msg.state == ReqState.IN_PROGRESS:
+                    msg.state = ReqState.COMPLETED
+                self.queue_wait_and_pickup.remove(msg)
+                self.queue_pickup.append(msg)
+            elif msg.deadline is not None and self.clock() > msg.deadline:
+                # op deadline: abandon the forwards but still deliver
+                # locally (the payload arrived here; only the fan-out
+                # is past deadline)
+                msg.state = ReqState.FAILED
+                self.ops_failed += 1
                 msg.fwd_done = True
                 self.queue_wait_and_pickup.remove(msg)
                 self.queue_pickup.append(msg)
@@ -487,6 +770,16 @@ class ProgressEngine:
         # (d) wait-only sweep (~_wait_only_queue_cleanup :1015)
         for msg in list(self.queue_wait):
             if msg.sends_done():
+                msg.fwd_done = True
+                if msg.state == ReqState.IN_PROGRESS:
+                    msg.state = ReqState.COMPLETED
+                self.queue_wait.remove(msg)
+            elif msg.deadline is not None and self.clock() > msg.deadline:
+                # op deadline: stop tracking — the op FAILED
+                # deterministically instead of parking forever on a
+                # handle that will never complete
+                msg.state = ReqState.FAILED
+                self.ops_failed += 1
                 msg.fwd_done = True
                 self.queue_wait.remove(msg)
 
@@ -499,8 +792,7 @@ class ProgressEngine:
         for dst in self._fwd_targets(origin, msg.src):
             if raw is None:
                 raw = msg.frame.encode()
-            msg.send_handles.append(
-                self.transport.isend(dst, msg.tag, raw))
+            msg.send_handles.append(self._send_raw(dst, msg.tag, raw))
         self.queue_wait.append(msg)
 
     def _bcast_is_dup(self, msg: _Msg) -> bool:
@@ -512,18 +804,8 @@ class ProgressEngine:
             return True
         if seq < 0:
             return False  # unstamped (foreign/legacy frame): best-effort
-        ent = self._seen_bcast.setdefault(origin, [-1, set()])
-        if seq <= ent[0] or seq in ent[1]:
-            return True
-        ent[1].add(seq)
-        while ent[0] + 1 in ent[1]:  # advance the contiguous watermark
-            ent[0] += 1
-            ent[1].remove(ent[0])
-        if len(ent[1]) > 4096:  # bound out-of-order state: assume the
-            # oldest half's gaps are lost, not late, and absorb them
-            ent[0] = sorted(ent[1])[len(ent[1]) // 2]
-            ent[1] = {s for s in ent[1] if s > ent[0]}
-        return False
+        return self._window_record(
+            self._seen_bcast.setdefault(origin, [-1, set()]), seq)
 
     # -- broadcast forwarding (~_bc_forward, rootless_ops.c:1104-1225) ----
     def _bc_forward(self, msg: _Msg) -> int:
@@ -533,8 +815,7 @@ class ProgressEngine:
         for dst in targets:
             if raw is None:
                 raw = msg.frame.encode()
-            msg.send_handles.append(
-                self.transport.isend(dst, msg.tag, raw))
+            msg.send_handles.append(self._send_raw(dst, msg.tag, raw))
         if targets:
             TRACER.emit(self.rank, Ev.BCAST_FWD, msg.tag, len(targets))
 
@@ -569,7 +850,7 @@ class ProgressEngine:
         can never be counted into a later one."""
         frame = Frame(origin=self.rank, pid=ps.pid, vote=int(vote),
                       payload=struct.pack("<i", ps.gen))
-        self.transport.isend(ps.recv_from, int(Tag.IAR_VOTE), frame.encode())
+        self._send(ps.recv_from, int(Tag.IAR_VOTE), frame)
         TRACER.emit(self.rank, Ev.VOTE, ps.pid, int(vote))
 
     def _resolve_relay(self, ps: ProposalState) -> None:
@@ -693,8 +974,10 @@ class ProgressEngine:
         pm = self._find_proposal_msg(pid, gen)
         if pm is None:
             if (pid == p.pid and p.state != ReqState.INVALID) or \
+                    (pid, gen) in self._settled_set or \
                     self.failure_timeout is not None or self.failed:
-                return  # stale round / settled round / view change
+                # stale round / settled-or-aborted round / view change
+                return
             raise RuntimeError(
                 f"rank {self.rank}: vote for unknown proposal pid={pid}")
         ps = pm.prop_state
@@ -722,6 +1005,49 @@ class ProgressEngine:
         p.decision_handles = list(msg.send_handles)
         p.decision_pending = True
         TRACER.emit(self.rank, Ev.DECISION, p.pid, p.vote)
+
+    def _abort_own_proposal(self, p: ProposalState) -> None:
+        """Deadline expired with votes still outstanding: the round
+        FAILS deterministically. Mark FAILED (finally assigning the
+        reference's dead RLO_FAILED for timeouts, not only dead
+        proposers), then broadcast a rootless ABORT over the overlay so
+        every relay unparks the round and the app learns the failure
+        from pickup instead of hanging. Composes with elastic re-form:
+        the pid is immediately free to resubmit on the survivor
+        topology."""
+        p.state = ReqState.FAILED
+        self.ops_failed += 1
+        TRACER.emit(self.rank, Ev.DECISION, p.pid, -1)
+        self.bcast(struct.pack("<i", p.gen), tag=Tag.ABORT, pid=p.pid)
+
+    def _on_abort(self, msg: _Msg) -> None:
+        """A proposer gave up on a round (deadline expiry): unpark the
+        relayed proposal as FAILED, settle the (pid, gen) so late
+        duplicates of the proposal are never re-parked, forward along
+        the overlay, and deliver the abort notice to the user (pid =
+        aborted pid) — the failure is delivered, not hung on."""
+        pid = msg.frame.pid
+        if msg.frame.origin == self.rank:
+            return  # re-flooded copy of my own abort
+        gen = struct.unpack_from("<i", msg.frame.payload)[0] \
+            if len(msg.frame.payload) >= 4 else -1
+        if gen >= 0:
+            if (pid, gen) in self._settled_set:
+                # duplicate (view-change trees / re-flood): forward for
+                # coverage, deliver exactly once
+                self._bc_forward_only(msg)
+                return
+            if len(self._settled_rounds) == self._settled_rounds.maxlen:
+                self._settled_set.discard(self._settled_rounds[0])
+            self._settled_rounds.append((pid, gen))
+            self._settled_set.add((pid, gen))
+            self._recent_bcasts.append((int(Tag.ABORT),
+                                        msg.frame.encode()))
+        pm = self._find_proposal_msg(pid, gen)
+        self._bc_forward(msg)  # forwards AND queues the notice for pickup
+        if pm is not None:
+            pm.prop_state.state = ReqState.FAILED
+            self.queue_iar_pending.remove(pm)
 
     def _on_decision(self, msg: _Msg) -> None:
         """~_iar_decision_handler (:814-859) + forward along the overlay."""
@@ -840,7 +1166,12 @@ class ProgressEngine:
         now = self.clock()
         succ, pred = self._ring_neighbors()
         if now - self._hb_last_sent >= self.heartbeat_interval:
-            frame = Frame(origin=self.rank)
+            # piggyback the cumulative link ACK for the successor: even
+            # with no reverse data traffic, its retransmit queue to us
+            # drains at heartbeat cadence
+            hb_payload = (struct.pack("<i", self._rx_cum(succ))
+                          if self.arq_rto is not None else b"")
+            frame = Frame(origin=self.rank, payload=hb_payload)
             self.transport.isend(succ, int(Tag.HEARTBEAT), frame.encode())
             self._hb_last_sent = now
             TRACER.emit(self.rank, Ev.HEARTBEAT, succ)
@@ -862,7 +1193,7 @@ class ProgressEngine:
         raw = frame.encode()
         for dst in self._alive:
             if dst != self.rank:
-                self.transport.isend(dst, int(Tag.FAILURE), raw)
+                self._send_raw(dst, int(Tag.FAILURE), raw)
         if self.failure_cb is not None:
             self.failure_cb(rank, True)
 
@@ -903,6 +1234,11 @@ class ProgressEngine:
         self._alive = [r for r in self._alive if r != rank]
         self._v = {r: v for v, r in enumerate(self._alive)}
         self._hb_seen.pop(rank, None)
+        # ARQ: a dead peer will never ack — stop retransmitting at it
+        # (and stop owing it acks or skip notices)
+        self._tx_unacked.pop(rank, None)
+        self._tx_skip.pop(rank, None)
+        self._ack_due.discard(rank)
         if self.failure_timeout is not None and len(self._alive) >= 2:
             # fresh grace period — but only when my predecessor actually
             # changed; re-arming an unchanged predecessor's timer on every
@@ -929,7 +1265,10 @@ class ProgressEngine:
         for tag, raw in list(self._recent_bcasts):
             for dst in self._alive:
                 if dst != self.rank:
-                    self.transport.isend(dst, tag, raw)
+                    # through the ARQ gate: the re-flood gets FRESH
+                    # link seqs (it is a new transmission, not a
+                    # retransmit); app-level dedup absorbs the copies
+                    self._send_raw(dst, tag, raw)
 
     def _discount_failed_voter(self, rank: int) -> None:
         """A consensus participant died mid-round: its subtree's merged
@@ -994,9 +1333,13 @@ class ProgressEngine:
     # Teardown (~RLO_progress_engine_cleanup, rootless_ops.c:1606-1647)
     # ------------------------------------------------------------------
     def idle(self) -> bool:
-        """No pending forwards or undelivered internal work on this engine."""
+        """No pending forwards or undelivered internal work on this
+        engine. With ARQ enabled, unacked reliable frames count as
+        outstanding work: an idle engine's sends are not just handed to
+        the transport but acknowledged delivered (or given up on)."""
         return (not self.queue_wait and not self.queue_wait_and_pickup
-                and not self.my_own_proposal.decision_pending)
+                and not self.my_own_proposal.decision_pending
+                and (self.arq_rto is None or self.arq_unacked() == 0))
 
     def cleanup(self) -> None:
         self.manager.remove(self)

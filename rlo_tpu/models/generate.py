@@ -212,7 +212,7 @@ def _attend_cache_block(q, k_cache, v_cache, pos_q, scale,
                 f"max_len={max_len}); falling back to einsum — verify "
                 f"numerics will NOT match the flash decode step "
                 f"(use a smaller gamma for exact speculative parity)",
-                stacklevel=2)
+                RuntimeWarning, stacklevel=2)
         use_flash = fits
     if use_flash:
         from rlo_tpu.pallas.decode import flash_block_decode

@@ -87,10 +87,11 @@ def load() -> C.CDLL:
     sig("rlo_initiator_targets", C.c_int,
         [C.c_int, C.c_int, C.POINTER(C.c_int), C.c_int])
     sig("rlo_frame_encode", C.c_int64,
-        [u8p, C.c_int64, C.c_int32, C.c_int32, C.c_int32, u8p, C.c_int64])
+        [u8p, C.c_int64, C.c_int32, C.c_int32, C.c_int32, C.c_int32,
+         u8p, C.c_int64])
     sig("rlo_frame_decode", C.c_int64,
         [u8p, C.c_int64, C.POINTER(C.c_int32), C.POINTER(C.c_int32),
-         C.POINTER(C.c_int32), C.POINTER(u8p)])
+         C.POINTER(C.c_int32), C.POINTER(C.c_int32), C.POINTER(u8p)])
     sig("rlo_world_new", p, [C.c_int, C.c_int, C.c_uint64])
     sig("rlo_world_free", None, [p])
     sig("rlo_world_size", C.c_int, [p])
@@ -99,6 +100,12 @@ def load() -> C.CDLL:
     sig("rlo_world_failed", C.c_int, [p])
     sig("rlo_world_peer_alive", C.c_int, [p, C.c_int, C.c_uint64])
     sig("rlo_world_kill_rank", C.c_int, [p, C.c_int])
+    sig("rlo_world_drop_next", C.c_int, [p, C.c_int, C.c_int, C.c_int])
+    sig("rlo_world_dup_next", C.c_int, [p, C.c_int, C.c_int, C.c_int])
+    sig("rlo_engine_enable_arq", C.c_int, [p, C.c_uint64, C.c_int])
+    sig("rlo_engine_arq_retransmits", C.c_int64, [p])
+    sig("rlo_engine_arq_dup_drops", C.c_int64, [p])
+    sig("rlo_engine_arq_unacked", C.c_int64, [p])
     sig("rlo_engine_enable_failure_detection", C.c_int,
         [p, C.c_uint64, C.c_uint64])
     sig("rlo_engine_rank_failed", C.c_int, [p, C.c_int])
@@ -222,6 +229,21 @@ class NativeWorld:
         rc = self._lib.rlo_world_kill_rank(self._w, rank)
         if rc != 0:
             raise RuntimeError(f"kill_rank failed ({rc})")
+
+    def drop_next(self, src: int, dst: int, count: int = 1) -> None:
+        """Fault injection (loopback only): silently drop the next
+        ``count`` frames src -> dst — mirror of
+        LoopbackWorld.drop_next."""
+        rc = self._lib.rlo_world_drop_next(self._w, src, dst, count)
+        if rc != 0:
+            raise RuntimeError(f"drop_next failed ({rc})")
+
+    def dup_next(self, src: int, dst: int, count: int = 1) -> None:
+        """Fault injection (loopback only): deliver the next ``count``
+        frames src -> dst twice — mirror of LoopbackWorld.dup_next."""
+        rc = self._lib.rlo_world_dup_next(self._w, src, dst, count)
+        if rc != 0:
+            raise RuntimeError(f"dup_next failed ({rc})")
 
     @property
     def sent_cnt(self) -> int:
@@ -543,6 +565,27 @@ class NativeEngine:
         if rc != 0:
             raise RuntimeError(f"enable_failure_detection failed ({rc})")
 
+    def enable_arq(self, rto_usec: int, max_retries: int = 8) -> None:
+        """Reliable delivery: per-(src, dst) link seqs, retransmit
+        until acked with exponential backoff, receive-side dedup
+        (mirror of ProgressEngine's arq_rto machinery)."""
+        rc = self._lib.rlo_engine_enable_arq(self._e, rto_usec,
+                                             max_retries)
+        if rc != 0:
+            raise RuntimeError(f"enable_arq failed ({rc})")
+
+    @property
+    def arq_retransmits(self) -> int:
+        return self._lib.rlo_engine_arq_retransmits(self._e)
+
+    @property
+    def arq_dup_drops(self) -> int:
+        return self._lib.rlo_engine_arq_dup_drops(self._e)
+
+    @property
+    def arq_unacked(self) -> int:
+        return self._lib.rlo_engine_arq_unacked(self._e)
+
     def rank_failed(self, rank: int) -> bool:
         return bool(self._lib.rlo_engine_rank_failed(self._e, rank))
 
@@ -642,23 +685,26 @@ def initiator_targets(ws: int, rank: int):
     return tuple(out[:n])
 
 
-def frame_roundtrip(origin: int, pid: int, vote: int, payload: bytes):
+def frame_roundtrip(origin: int, pid: int, vote: int, payload: bytes,
+                    seq: int = -1):
     """Encode then decode one frame through the C wire format."""
+    from rlo_tpu.wire import HEADER_SIZE
     lib = load()
-    cap = 20 + len(payload)
+    cap = HEADER_SIZE + len(payload)
     raw = (C.c_uint8 * cap)()
-    n = lib.rlo_frame_encode(raw, cap, origin, pid, vote, _buf(payload),
-                             len(payload))
+    n = lib.rlo_frame_encode(raw, cap, origin, pid, vote, seq,
+                             _buf(payload), len(payload))
     assert n == cap, n
     o = C.c_int32()
     p = C.c_int32()
     v = C.c_int32()
+    s = C.c_int32()
     pp = C.POINTER(C.c_uint8)()
     m = lib.rlo_frame_decode(raw, n, C.byref(o), C.byref(p), C.byref(v),
-                             C.byref(pp))
+                             C.byref(s), C.byref(pp))
     assert m >= 0, m
     data = bytes(C.cast(pp, C.POINTER(C.c_uint8 * m)).contents) if m else b""
-    return o.value, p.value, v.value, data, bytes(raw)
+    return o.value, p.value, v.value, data, bytes(raw), s.value
 
 
 def run_judged_proposal(world_size: int, payload: bytes, proposer: int,

@@ -55,6 +55,18 @@ def _have_mpi(cc: str) -> bool:
     return probe.returncode == 0
 
 
+def _have_rt(cc: str) -> bool:
+    """shm_open/shm_unlink live in librt on pre-2.34 glibc; a -shared
+    link succeeds without it but dlopen then fails with an undefined
+    symbol, so probe and link it when present (mirror of the Makefile's
+    HAVE_RT)."""
+    probe = subprocess.run(
+        [cc, "-xc", "-", "-lrt", "-o", os.devnull],
+        input="int main(void){return 0;}\n",
+        capture_output=True, text=True)
+    return probe.returncode == 0
+
+
 def build(force: bool = False) -> Path:
     """Build (if needed) and return the shared-library path.
 
@@ -73,6 +85,8 @@ def build(force: bool = False) -> Path:
                  str(_DIR / "femtompi" / "femtompi.c")]
     else:
         extra = ["-DRLO_HAVE_MPI", "-lmpi"] if _have_mpi(cc) else []
+    if _have_rt(cc):
+        extra = extra + ["-lrt"]
     # build to a private temp then atomically rename: N ranks launched
     # together may all find the library stale and rebuild concurrently
     tmp = lib.with_suffix(f".so.tmp.{os.getpid()}")

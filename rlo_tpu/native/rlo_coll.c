@@ -153,7 +153,7 @@ static int coll_send(rlo_coll *c, int dst, int32_t opid, int32_t rnd,
     rlo_blob *b = rlo_blob_new(RLO_HEADER_SIZE + len);
     if (!b)
         return RLO_ERR_NOMEM;
-    if (rlo_frame_encode(b->data, b->len, c->rank, opid, rnd,
+    if (rlo_frame_encode(b->data, b->len, c->rank, opid, rnd, -1,
                          (const uint8_t *)data, len) < 0) {
         rlo_blob_unref(b);
         return RLO_ERR_PROTO;
@@ -179,7 +179,7 @@ static int coll_pump(rlo_coll *c)
     }
     int32_t origin = -1;
     p->len = rlo_frame_decode(n->frame->data, n->frame->len, &origin,
-                              &p->pid, &p->vote, &p->payload);
+                              &p->pid, &p->vote, 0, &p->payload);
     rlo_handle_unref(n->handle);
     if (p->len < 0) {
         /* drop the undecodable frame BEFORE linking: a parked node

@@ -46,6 +46,8 @@ enum rlo_tag {
     RLO_TAG_BARRIER = 10,
     RLO_TAG_HEARTBEAT = 11, /* point-to-point ring liveness probe */
     RLO_TAG_FAILURE = 12,   /* rootless failure notification */
+    RLO_TAG_ACK = 13,       /* cumulative link ACK (ARQ); vote = seq */
+    RLO_TAG_ABORT = 14,     /* rootless op-abort (deadline expiry) */
 };
 
 /* ---- request/proposal states (reference RLO_Req_stat) ---- */
@@ -94,18 +96,23 @@ int rlo_fwd_send_cnt(int world_size, int rank, int origin, int from_rank);
 int rlo_initiator_targets(int world_size, int rank, int *out, int cap);
 
 /* ------------------------------------------------------------------ */
-/* Wire format: little-endian [origin:i32][pid:i32][vote:i32][len:u64]  */
-/* header + payload (reference pbuf layout, rootless_ops.c:64-73).      */
+/* Wire format: little-endian [origin:i32][pid:i32][vote:i32][seq:i32]  */
+/* [len:u64] header + payload (reference pbuf layout, rootless_ops.c:   */
+/* 64-73, extended with the ARQ link sequence number — stamped by the   */
+/* sending engine per (src, dst) edge, -1 outside the reliable path).   */
 /* ------------------------------------------------------------------ */
-#define RLO_HEADER_SIZE 20
+#define RLO_HEADER_SIZE 24
+/* byte offset of the seq field (the ARQ send path patches encoded
+ * frames in place: one encode per broadcast, one stamp per edge) */
+#define RLO_SEQ_OFFSET 12
 /* Encodes into dst (cap >= RLO_HEADER_SIZE + len); returns frame size. */
 int64_t rlo_frame_encode(uint8_t *dst, int64_t cap, int32_t origin,
-                         int32_t pid, int32_t vote, const uint8_t *payload,
-                         int64_t len);
+                         int32_t pid, int32_t vote, int32_t seq,
+                         const uint8_t *payload, int64_t len);
 /* Decodes header; returns payload length or RLO_ERR_ARG on truncation.
  * *payload points into raw. */
 int64_t rlo_frame_decode(const uint8_t *raw, int64_t rawlen, int32_t *origin,
-                         int32_t *pid, int32_t *vote,
+                         int32_t *pid, int32_t *vote, int32_t *seq,
                          const uint8_t **payload);
 
 /* ------------------------------------------------------------------ */
@@ -143,6 +150,12 @@ int rlo_world_peer_alive(const rlo_world *w, int rank,
  * polls return nothing. RLO_ERR_ARG on transports without injection.
  * Mirror of LoopbackWorld.kill_rank (rlo_tpu/transport/loopback.py). */
 int rlo_world_kill_rank(rlo_world *w, int rank);
+/* Fault injection (loopback only): silently drop / duplicate the next
+ * `count` frames sent src -> dst — the loss/duplication legs of the
+ * chaos harness (mirror of LoopbackWorld.drop_next / dup_next).
+ * RLO_ERR_ARG on transports without injection. */
+int rlo_world_drop_next(rlo_world *w, int src, int dst, int count);
+int rlo_world_dup_next(rlo_world *w, int src, int dst, int count);
 int64_t rlo_world_sent_cnt(const rlo_world *w);
 int64_t rlo_world_delivered_cnt(const rlo_world *w);
 /* Collective barrier across all ranks (shm: sense-reversing spin;
@@ -277,6 +290,26 @@ int rlo_pickup_consume(rlo_engine *e);
 int rlo_engine_enable_failure_detection(rlo_engine *e,
                                         uint64_t timeout_usec,
                                         uint64_t interval_usec);
+
+/* ------------------------------------------------------------------ */
+/* Reliable delivery (ARQ; net-new — the reference has no timeouts,    */
+/* retries, or loss recovery, SURVEY.md §5; mirror of the Python       */
+/* engine's arq_rto machinery): every engine frame except heartbeats   */
+/* and ACKs carries a per-(src, dst) link sequence number and sits in  */
+/* a retransmit queue until the destination's cumulative ACK covers    */
+/* it; overdue frames retransmit with exponential backoff, giving up   */
+/* after max_retries (a persistently silent peer is the failure        */
+/* detector's job). Receivers dedup on (sender, seq) BEFORE tag        */
+/* dispatch, so retransmits are idempotent through the                 */
+/* store-and-forward broadcast path, and owe the sender a cumulative   */
+/* ACK (flushed once per progress turn). Disabled by default.          */
+/* ------------------------------------------------------------------ */
+int rlo_engine_enable_arq(rlo_engine *e, uint64_t rto_usec,
+                          int max_retries);
+int64_t rlo_engine_arq_retransmits(const rlo_engine *e);
+int64_t rlo_engine_arq_dup_drops(const rlo_engine *e);
+/* outstanding reliable frames not yet covered by an ACK */
+int64_t rlo_engine_arq_unacked(const rlo_engine *e);
 /* 1 when this engine has marked `rank` failed */
 int rlo_engine_rank_failed(const rlo_engine *e, int rank);
 int rlo_engine_failed_count(const rlo_engine *e);

@@ -57,13 +57,25 @@ typedef struct rlo_prop {
     int resolved; /* merged vote determined and sent up */
 } rlo_prop;
 
+/* ---------------- ARQ retransmit entry (net-new; mirror of the Python
+ * engine's _ArqEntry — the reference has no loss recovery at all,
+ * SURVEY.md §5) ---------------- */
+
+typedef struct rlo_rtx {
+    struct rlo_rtx *next;
+    int dst, tag, retries;
+    int32_t seq;
+    uint64_t due; /* next retransmit time (usec) */
+    rlo_blob *frame;
+} rlo_rtx;
+
 /* ---------------- in-flight message (reference RLO_msg_t,
  * rootless_ops.h:93-146) ---------------- */
 
 struct rlo_msg {
     rlo_msg *prev, *next;
     int tag, src; /* src = immediate sender (~MPI_SOURCE) */
-    int32_t origin, pid, vote;
+    int32_t origin, pid, vote, seq;
     rlo_blob *frame;        /* the encoded frame (owned ref) */
     const uint8_t *payload; /* aliases frame->data past the header */
     int64_t len;
@@ -115,6 +127,23 @@ struct rlo_engine {
     /* settled consensus rounds (decision dedup across view changes) */
     struct { int32_t pid, gen; int used; } settled[RLO_SETTLED_LOG];
     int settled_pos;
+    /* reliable delivery (ARQ; mirror of engine.py's arq_rto machinery,
+     * net-new): per-dst link seq counters, a retransmit queue of
+     * unacked frames, per-src receive dedup windows, and the per-src
+     * "owes an ACK" flags flushed once per progress turn */
+    uint64_t arq_rto; /* 0 = disabled */
+    int arq_max_retries;
+    int32_t *tx_seq;      /* per dst: next link seq */
+    rlo_rtx *rtx_head;    /* unacked reliable frames */
+    int64_t *rx_contig;   /* per src: all link seqs <= contig seen */
+    uint64_t *rx_mask;    /* per src: window above contig */
+    uint8_t *ack_due;     /* per src: cumulative ACK owed */
+    /* per dst: highest given-up seq pending a SKIP notice (-1 none) +
+     * its next-send time, and a per-tick scratch flag (see arq_tick) */
+    int32_t *tx_skip;
+    uint64_t *tx_skip_due;
+    uint8_t *skip_hold;
+    int64_t arq_retx, arq_dup, arq_unacked_cnt;
 };
 
 /* ---------------- queue ops ---------------- */
@@ -148,14 +177,15 @@ static void q_remove(rlo_queue *q, rlo_msg *m)
 /* ---------------- msg lifecycle ---------------- */
 
 /* Encode one frame into a fresh blob (the single copy a send makes;
- * every fan-out edge then shares it by ref). */
+ * every fan-out edge then shares it by ref; the ARQ path clones and
+ * re-stamps per edge). */
 static rlo_blob *frame_blob(int32_t origin, int32_t pid, int32_t vote,
                             const uint8_t *payload, int64_t len)
 {
     rlo_blob *b = rlo_blob_new(RLO_HEADER_SIZE + len);
     if (!b)
         return 0;
-    if (rlo_frame_encode(b->data, b->len, origin, pid, vote, payload,
+    if (rlo_frame_encode(b->data, b->len, origin, pid, vote, -1, payload,
                          len) < 0) {
         rlo_blob_unref(b);
         return 0;
@@ -168,10 +198,10 @@ static rlo_blob *frame_blob(int32_t origin, int32_t pid, int32_t vote,
  * RLO_ERR_NOMEM in *err so callers report the true cause). */
 static rlo_msg *msg_from_frame(int tag, int src, rlo_blob *frame, int *err)
 {
-    int32_t origin, pid, vote;
+    int32_t origin, pid, vote, seq;
     const uint8_t *payload;
     int64_t plen = rlo_frame_decode(frame->data, frame->len, &origin,
-                                    &pid, &vote, &payload);
+                                    &pid, &vote, &seq, &payload);
     if (plen < 0) {
         if (err)
             *err = RLO_ERR_PROTO;
@@ -190,6 +220,7 @@ static rlo_msg *msg_from_frame(int tag, int src, rlo_blob *frame, int *err)
     m->origin = origin;
     m->pid = pid;
     m->vote = vote;
+    m->seq = seq;
     m->frame = frame;
     m->payload = payload;
     m->len = plen;
@@ -244,15 +275,63 @@ static int msg_sends_done(const rlo_msg *m)
 
 /* ---------------- send helper ---------------- */
 
+static void put_le32(uint8_t *dst, int v)
+{
+    dst[0] = (uint8_t)(v & 0xff);
+    dst[1] = (uint8_t)((v >> 8) & 0xff);
+    dst[2] = (uint8_t)((v >> 16) & 0xff);
+    dst[3] = (uint8_t)((v >> 24) & 0xff);
+}
+
+/* Tags the ARQ layer neither stamps nor retransmits: heartbeats are
+ * periodic by construction, and ACKs ack themselves by effect (a lost
+ * ACK just costs one more retransmit, absorbed by the dedup). */
+static int arq_exempt(int tag)
+{
+    return tag == RLO_TAG_HEARTBEAT || tag == RLO_TAG_ACK;
+}
+
 /* isend one already-encoded frame blob; when track_in != NULL the
  * completion handle is retained on that message (votes pass NULL — fire
- * and forget, but still reliable: the world owns the in-flight node). */
+ * and forget; with ARQ enabled they are ALSO reliable: a dropped vote
+ * retransmits until acked instead of wedging the consensus round).
+ *
+ * This is the one gate every engine frame leaves through: with ARQ on,
+ * non-exempt frames are cloned, stamped with the next per-(src, dst)
+ * link seq (the shared fan-out blob must not be mutated — each edge
+ * carries a different seq), queued for retransmission, and only then
+ * handed to the transport. */
 static int eng_isend_frame(rlo_engine *e, int dst, int tag,
                            rlo_blob *frame, rlo_msg *track_in)
 {
     rlo_handle *h = 0;
-    int rc = rlo_world_isend(e->w, e->rank, dst, e->comm, tag, frame,
+    int rc;
+    if (e->arq_rto && !arq_exempt(tag) && dst >= 0 && dst < e->ws) {
+        rlo_blob *stamped = rlo_blob_new(frame->len);
+        rlo_rtx *rt = (rlo_rtx *)calloc(1, sizeof(*rt));
+        if (!stamped || !rt) {
+            rlo_blob_unref(stamped);
+            free(rt);
+            return RLO_ERR_NOMEM;
+        }
+        memcpy(stamped->data, frame->data, (size_t)frame->len);
+        int32_t seq = e->tx_seq[dst]++;
+        put_le32(stamped->data + RLO_SEQ_OFFSET, seq);
+        rt->dst = dst;
+        rt->tag = tag;
+        rt->seq = seq;
+        rt->due = rlo_now_usec() + e->arq_rto;
+        rt->frame = rlo_blob_ref(stamped);
+        rt->next = e->rtx_head;
+        e->rtx_head = rt;
+        e->arq_unacked_cnt++;
+        rc = rlo_world_isend(e->w, e->rank, dst, e->comm, tag, stamped,
                              track_in ? &h : 0);
+        rlo_blob_unref(stamped);
+    } else {
+        rc = rlo_world_isend(e->w, e->rank, dst, e->comm, tag, frame,
+                             track_in ? &h : 0);
+    }
     if (rc == RLO_OK && track_in)
         rc = msg_track(track_in, h);
     return rc;
@@ -314,15 +393,39 @@ rlo_engine *rlo_engine_new(rlo_world *w, int rank, int comm,
     e->seen_contig = (int64_t *)malloc((size_t)e->ws * sizeof(int64_t));
     e->seen_mask = (uint64_t *)calloc((size_t)e->ws * RLO_SEEN_WORDS,
                                       sizeof(uint64_t));
+    e->tx_seq = (int32_t *)calloc((size_t)e->ws, sizeof(int32_t));
+    e->rx_contig = (int64_t *)malloc((size_t)e->ws * sizeof(int64_t));
+    e->rx_mask = (uint64_t *)calloc((size_t)e->ws * RLO_SEEN_WORDS,
+                                    sizeof(uint64_t));
+    e->ack_due = (uint8_t *)calloc((size_t)e->ws, 1);
+    e->tx_skip = (int32_t *)malloc((size_t)e->ws * sizeof(int32_t));
+    e->tx_skip_due =
+        (uint64_t *)calloc((size_t)e->ws, sizeof(uint64_t));
+    e->skip_hold = (uint8_t *)calloc((size_t)e->ws, 1);
     if (e->seen_contig)
         for (int r = 0; r < e->ws; r++)
             e->seen_contig[r] = -1;
+    if (e->rx_contig)
+        for (int r = 0; r < e->ws; r++)
+            e->rx_contig[r] = -1;
+    if (e->tx_skip)
+        for (int r = 0; r < e->ws; r++)
+            e->tx_skip[r] = -1;
     if (e->n_init < 0 || !e->failed || !e->hb_seen || !e->seen_contig ||
-        !e->seen_mask || rlo_world_register(w, e) != RLO_OK) {
+        !e->seen_mask || !e->tx_seq || !e->rx_contig || !e->rx_mask ||
+        !e->ack_due || !e->tx_skip || !e->tx_skip_due || !e->skip_hold ||
+        rlo_world_register(w, e) != RLO_OK) {
         free(e->failed);
         free(e->hb_seen);
         free(e->seen_contig);
         free(e->seen_mask);
+        free(e->tx_seq);
+        free(e->rx_contig);
+        free(e->rx_mask);
+        free(e->ack_due);
+        free(e->tx_skip);
+        free(e->tx_skip_due);
+        free(e->skip_hold);
         free(e);
         return 0;
     }
@@ -392,6 +495,19 @@ void rlo_engine_free(rlo_engine *e)
     free(e->hb_seen);
     free(e->seen_contig);
     free(e->seen_mask);
+    free(e->tx_seq);
+    free(e->rx_contig);
+    free(e->rx_mask);
+    free(e->ack_due);
+    free(e->tx_skip);
+    free(e->tx_skip_due);
+    free(e->skip_hold);
+    for (rlo_rtx *rt = e->rtx_head; rt;) {
+        rlo_rtx *nrt = rt->next;
+        rlo_blob_unref(rt->frame);
+        free(rt);
+        rt = nrt;
+    }
     for (int i = 0; i < RLO_RECENT_LOG; i++)
         rlo_blob_unref(e->recent[i]);
     free(e);
@@ -513,18 +629,12 @@ static void seen_shift(uint64_t *m, int64_t k)
     }
 }
 
-/* (origin, seq) receipt check for BCAST frames. Bit i of the window is
- * seq contig+1+i. The initiator never delivers its own broadcast, so a
- * re-flooded copy of my own frame is also a duplicate. */
-static int bcast_is_dup(rlo_engine *e, const rlo_msg *m)
+/* Record `seq` in a watermark+window dedup structure; returns 1 when it
+ * was already seen. Bit i of the window is seq contig+1+i. Shared by
+ * the app-level (origin, seq) broadcast dedup and the link-level
+ * (sender, seq) ARQ dedup — same algorithm, different key spaces. */
+static int window_record(int64_t *contig, uint64_t *mask, int64_t seq)
 {
-    if (m->origin == e->rank)
-        return 1;
-    if (m->vote < 0 || m->origin < 0 || m->origin >= e->ws)
-        return 0; /* unstamped (foreign/legacy frame): best-effort */
-    int64_t *contig = &e->seen_contig[m->origin];
-    uint64_t *mask = &e->seen_mask[(size_t)m->origin * RLO_SEEN_WORDS];
-    int64_t seq = m->vote;
     if (seq <= *contig)
         return 1;
     int64_t off = seq - *contig - 1;
@@ -547,6 +657,158 @@ static int bcast_is_dup(rlo_engine *e, const rlo_msg *m)
         (*contig)++;
     }
     return 0;
+}
+
+/* (origin, seq) receipt check for BCAST frames. The initiator never
+ * delivers its own broadcast, so a re-flooded copy of my own frame is
+ * also a duplicate. */
+static int bcast_is_dup(rlo_engine *e, const rlo_msg *m)
+{
+    if (m->origin == e->rank)
+        return 1;
+    if (m->vote < 0 || m->origin < 0 || m->origin >= e->ws)
+        return 0; /* unstamped (foreign/legacy frame): best-effort */
+    return window_record(&e->seen_contig[m->origin],
+                         &e->seen_mask[(size_t)m->origin * RLO_SEEN_WORDS],
+                         m->vote);
+}
+
+/* ---------------- reliable delivery (ARQ) ---------------- */
+
+/* Cumulative ACK from `src`: drop everything it covers from the
+ * retransmit queue (and retire a pending SKIP notice the ACK proves
+ * was absorbed). */
+static void arq_on_ack(rlo_engine *e, int src, int32_t cum)
+{
+    if (e->tx_skip[src] >= 0 && cum >= e->tx_skip[src])
+        e->tx_skip[src] = -1;
+    for (rlo_rtx **pp = &e->rtx_head; *pp;) {
+        rlo_rtx *rt = *pp;
+        if (rt->dst == src && rt->seq <= cum) {
+            *pp = rt->next;
+            rlo_blob_unref(rt->frame);
+            free(rt);
+            e->arq_unacked_cnt--;
+        } else {
+            pp = &rt->next;
+        }
+    }
+}
+
+/* SKIP notice from a SENDER: it gave up on everything <= upto; advance
+ * the receive watermark over the permanent hole so cumulative ACKs for
+ * later frames are unblocked. */
+static void arq_rx_skip(rlo_engine *e, int src, int32_t upto)
+{
+    if ((int64_t)upto <= e->rx_contig[src])
+        return;
+    uint64_t *mask = &e->rx_mask[(size_t)src * RLO_SEEN_WORDS];
+    int64_t shift = (int64_t)upto - e->rx_contig[src];
+    if (shift >= RLO_SEEN_BITS)
+        memset(mask, 0, RLO_SEEN_WORDS * sizeof(uint64_t));
+    else
+        seen_shift(mask, shift);
+    e->rx_contig[src] = upto;
+    while (mask[0] & 1) { /* holes below upto may now be contiguous */
+        seen_shift(mask, 1);
+        e->rx_contig[src]++;
+    }
+    e->ack_due[src] = 1; /* tell the sender the new cum */
+}
+
+/* Drop every retransmit entry addressed to a (now dead) rank. */
+static void arq_drop_dst(rlo_engine *e, int dst)
+{
+    for (rlo_rtx **pp = &e->rtx_head; *pp;) {
+        rlo_rtx *rt = *pp;
+        if (rt->dst == dst) {
+            *pp = rt->next;
+            rlo_blob_unref(rt->frame);
+            free(rt);
+            e->arq_unacked_cnt--;
+        } else {
+            pp = &rt->next;
+        }
+    }
+}
+
+/* Retransmit sweep: resend overdue unacked frames with exponential
+ * backoff; give up after max_retries (a peer that silent is the
+ * failure detector's problem, not ARQ's). Every give-up arms a SKIP
+ * notice (ACK frame, vote = -2 sentinel, pid = abandoned seq) so the
+ * receiver's watermark advances over the permanent hole — sent only
+ * once no lower seq is still being retried (an advanced watermark
+ * would misread those retransmits as duplicates), repeating at rto
+ * cadence until an ACK at/past the skipped seq retires it
+ * (mirror of ProgressEngine._arq_tick). */
+static void arq_tick(rlo_engine *e)
+{
+    uint64_t now = rlo_now_usec();
+    int armed = 0;
+    for (rlo_rtx **pp = &e->rtx_head; *pp;) {
+        rlo_rtx *rt = *pp;
+        if (rt->due > now) {
+            pp = &rt->next;
+            continue;
+        }
+        if (rt->retries >= e->arq_max_retries ||
+            (rt->dst >= 0 && rt->dst < e->ws && e->failed[rt->dst])) {
+            if (rt->dst >= 0 && rt->dst < e->ws &&
+                !e->failed[rt->dst] && rt->seq > e->tx_skip[rt->dst]) {
+                e->tx_skip[rt->dst] = rt->seq;
+                e->tx_skip_due[rt->dst] = now; /* send immediately */
+            }
+            *pp = rt->next;
+            rlo_blob_unref(rt->frame);
+            free(rt);
+            e->arq_unacked_cnt--;
+            continue;
+        }
+        rt->retries++;
+        /* clamped shift: retries is bounded by enable_arq, but keep
+         * the backoff well-defined for any config */
+        rt->due = now + (e->arq_rto
+                         << (rt->retries < 32 ? rt->retries : 32));
+        e->arq_retx++;
+        /* same bytes, same seq: the receiver dedups the retransmit */
+        rlo_world_isend(e->w, e->rank, rt->dst, e->comm, rt->tag,
+                        rt->frame, 0);
+        pp = &rt->next;
+    }
+    for (int d = 0; d < e->ws; d++) {
+        e->skip_hold[d] = 0;
+        if (e->tx_skip[d] >= 0)
+            armed = 1;
+    }
+    if (!armed)
+        return;
+    /* hold a notice back while a lower seq is still in the queue */
+    for (rlo_rtx *rt = e->rtx_head; rt; rt = rt->next)
+        if (e->tx_skip[rt->dst] >= 0 && rt->seq <= e->tx_skip[rt->dst])
+            e->skip_hold[rt->dst] = 1;
+    for (int d = 0; d < e->ws; d++) {
+        if (e->tx_skip[d] < 0 || e->skip_hold[d] ||
+            now < e->tx_skip_due[d] || e->failed[d] || d == e->rank)
+            continue;
+        eng_isend(e, d, RLO_TAG_ACK, e->rank, e->tx_skip[d], -2, 0, 0,
+                  0);
+        e->tx_skip_due[d] = now + e->arq_rto;
+    }
+}
+
+/* Flush the cumulative ACKs this turn's receipts owe (at most one per
+ * sender per turn; ACKs are themselves unreliable). */
+static void arq_flush_acks(rlo_engine *e)
+{
+    for (int src = 0; src < e->ws; src++) {
+        if (!e->ack_due[src])
+            continue;
+        e->ack_due[src] = 0;
+        if (src == e->rank || e->failed[src])
+            continue;
+        eng_isend(e, src, RLO_TAG_ACK, e->rank, -1,
+                  (int32_t)e->rx_contig[src], 0, 0, 0);
+    }
 }
 
 /* Remember a BCAST or IAR_DECISION frame for view-change re-flooding.
@@ -680,14 +942,6 @@ static int eng_judge(rlo_engine *e, const uint8_t *payload, int64_t len,
  * _vote_back :728-741; nonblocking here). The payload echoes the round
  * generation so a stale vote from an earlier same-pid round can never
  * be counted into a later one. */
-static void put_le32(uint8_t *dst, int v)
-{
-    dst[0] = (uint8_t)(v & 0xff);
-    dst[1] = (uint8_t)((v >> 8) & 0xff);
-    dst[2] = (uint8_t)((v >> 16) & 0xff);
-    dst[3] = (uint8_t)((v >> 24) & 0xff);
-}
-
 static int vote_back(rlo_engine *e, const rlo_prop *ps, int vote)
 {
     uint8_t genb[4];
@@ -946,8 +1200,9 @@ static void on_vote(rlo_engine *e, rlo_msg *m)
     rlo_msg *pm = find_proposal_msg(e, pid, gen);
     if (!pm) {
         if ((pid == p->pid && p->state != RLO_INVALID) ||
+            round_settled_peek(e, pid, gen) ||
             e->fd_timeout || e->n_failed)
-            ; /* stale round, settled own round, or a membership
+            ; /* stale round, settled/aborted round, or a membership
                  change; drop */
         else
             set_err(e, RLO_ERR_PROTO);
@@ -1185,6 +1440,10 @@ static int mark_failed(rlo_engine *e, int rank)
     e->failed[rank] = 1;
     e->n_failed++;
     e->hb_seen[rank] = 0;
+    /* ARQ: a dead peer will never ack — stop retransmitting at it */
+    arq_drop_dst(e, rank);
+    e->ack_due[rank] = 0;
+    e->tx_skip[rank] = -1;
     if (e->fd_timeout && e->ws - e->n_failed >= 2) {
         int succ, pred;
         ring_neighbors(e, &succ, &pred);
@@ -1252,7 +1511,17 @@ static void failure_tick(rlo_engine *e)
     int succ, pred;
     ring_neighbors(e, &succ, &pred);
     if (succ >= 0 && now - e->hb_last_sent >= e->fd_interval) {
-        eng_isend(e, succ, RLO_TAG_HEARTBEAT, e->rank, -1, -1, 0, 0, 0);
+        /* piggyback the cumulative link ACK for the successor: even
+         * with no reverse data traffic, its retransmit queue to us
+         * drains at heartbeat cadence */
+        uint8_t ackb[4];
+        int64_t n_ack = 0;
+        if (e->arq_rto) {
+            put_le32(ackb, (int)e->rx_contig[succ]);
+            n_ack = 4;
+        }
+        eng_isend(e, succ, RLO_TAG_HEARTBEAT, e->rank, -1, -1, ackb,
+                  n_ack, 0);
         e->hb_last_sent = now;
         rlo_trace_emit(e->rank, RLO_EV_HEARTBEAT, succ, 0);
     }
@@ -1275,6 +1544,33 @@ int rlo_engine_enable_failure_detection(rlo_engine *e,
     e->fd_timeout = timeout_usec;
     e->fd_interval = interval_usec ? interval_usec : timeout_usec / 4;
     return RLO_OK;
+}
+
+int rlo_engine_enable_arq(rlo_engine *e, uint64_t rto_usec,
+                          int max_retries)
+{
+    /* max_retries capped at 32: the backoff shift must stay defined
+     * (and 2^32 * rto is already far beyond any useful horizon) */
+    if (!e || !rto_usec || max_retries < 0 || max_retries > 32)
+        return RLO_ERR_ARG;
+    e->arq_rto = rto_usec;
+    e->arq_max_retries = max_retries;
+    return RLO_OK;
+}
+
+int64_t rlo_engine_arq_retransmits(const rlo_engine *e)
+{
+    return e->arq_retx;
+}
+
+int64_t rlo_engine_arq_dup_drops(const rlo_engine *e)
+{
+    return e->arq_dup;
+}
+
+int64_t rlo_engine_arq_unacked(const rlo_engine *e)
+{
+    return e->arq_unacked_cnt;
 }
 
 int rlo_engine_rank_failed(const rlo_engine *e, int rank)
@@ -1435,6 +1731,30 @@ void rlo_engine_progress_once(rlo_engine *e)
          * starvation when membership views transiently diverge */
         if (e->fd_timeout && m->src >= 0 && m->src < e->ws)
             e->hb_seen[m->src] = rlo_now_usec();
+        if (m->tag == RLO_TAG_ACK) {
+            if (m->src >= 0 && m->src < e->ws) {
+                if (m->vote == -2 && m->pid >= 0)
+                    arq_rx_skip(e, m->src, m->pid);
+                else
+                    arq_on_ack(e, m->src, m->vote);
+            }
+            msg_free(m);
+            continue;
+        }
+        if (e->arq_rto && !arq_exempt(m->tag) && m->seq >= 0 &&
+            m->src >= 0 && m->src < e->ws) {
+            /* link-level exactly-once BEFORE tag dispatch: a
+             * retransmitted frame must be idempotent everywhere, and
+             * its receipt owes the sender a cumulative ACK either way */
+            e->ack_due[m->src] = 1;
+            if (window_record(&e->rx_contig[m->src],
+                              &e->rx_mask[(size_t)m->src * RLO_SEEN_WORDS],
+                              m->seq)) {
+                e->arq_dup++;
+                msg_free(m);
+                continue;
+            }
+        }
         switch (m->tag) {
         case RLO_TAG_BCAST: {
             e->recved_bcast++;
@@ -1463,7 +1783,11 @@ void rlo_engine_progress_once(rlo_engine *e)
             on_decision(e, m);
             break;
         case RLO_TAG_HEARTBEAT:
-            /* liveness already refreshed above for any frame */
+            /* liveness already refreshed above for any frame; a
+             * piggybacked cumulative ACK rides the payload */
+            if (e->arq_rto && m->len >= 4 && m->src >= 0 &&
+                m->src < e->ws)
+                arq_on_ack(e, m->src, (int32_t)vote_gen(m));
             msg_free(m);
             break;
         case RLO_TAG_FAILURE:
@@ -1479,6 +1803,13 @@ void rlo_engine_progress_once(rlo_engine *e)
 
     /* (b2) liveness: heartbeat my ring successor, watch my predecessor */
     failure_tick(e);
+
+    /* (b3) reliable delivery: retransmit overdue unacked frames, then
+     * flush the cumulative ACKs this turn's receipts owe */
+    if (e->arq_rto) {
+        arq_tick(e);
+        arq_flush_acks(e);
+    }
 
     /* (c) wait_and_pickup sweep (:995-1013): forwards done -> deliverable */
     for (rlo_msg *m = e->q_wait_pickup.head; m;) {
@@ -1555,8 +1886,10 @@ int rlo_engine_state_set(rlo_engine *e, const rlo_engine_state *in)
 
 int rlo_engine_idle(const rlo_engine *e)
 {
+    /* with ARQ enabled, unacked reliable frames are outstanding work:
+     * an idle engine's sends are acknowledged, not merely handed off */
     return e->q_wait.len == 0 && e->q_wait_pickup.len == 0 &&
-           !e->own.decision_pending;
+           !e->own.decision_pending && e->rtx_head == 0;
 }
 
 int rlo_engine_err(const rlo_engine *e)

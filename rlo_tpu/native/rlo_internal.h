@@ -21,6 +21,10 @@
  * (when the sender tracks completion at all — votes don't). */
 typedef struct rlo_handle {
     int delivered;
+    /* set alongside delivered when the send terminated WITHOUT
+     * delivering (peer dead, frame dropped by fault injection) — the
+     * MPI_ERR_*-in-status analogue; done-but-failed, never hung */
+    int failed;
     int refs;
 } rlo_handle;
 
@@ -110,6 +114,10 @@ typedef struct rlo_transport_ops {
     /* fault injection: simulate `rank`'s process dying (in-process
      * transports only); NULL = unsupported */
     int (*kill_rank)(rlo_world *w, int rank);
+    /* fault injection: drop / duplicate the next `count` frames sent
+     * src -> dst (in-process transports only); NULL = unsupported */
+    int (*drop_next)(rlo_world *w, int src, int dst, int count);
+    int (*dup_next)(rlo_world *w, int src, int dst, int count);
     /* block until every rank reaches the barrier (multi-process
      * transports); NULL = no-op (single-process worlds need none) */
     void (*barrier)(rlo_world *w);

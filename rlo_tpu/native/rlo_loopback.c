@@ -28,7 +28,9 @@ typedef struct rlo_loop_world {
     rlo_channel *channels;
     rlo_wire_node **inbox_head; /* per-rank delivered FIFO */
     rlo_wire_node **inbox_tail;
-    uint8_t *dead; /* fault injection: killed ranks */
+    uint8_t *dead;  /* fault injection: killed ranks */
+    int *drops;     /* fault injection: per (src*ws+dst) pending drops */
+    int *dups;      /* fault injection: per (src*ws+dst) pending dups */
 } rlo_loop_world;
 
 static uint64_t xorshift64(uint64_t *s)
@@ -70,6 +72,8 @@ static void loop_free(rlo_world *base)
     free(w->inbox_head);
     free(w->inbox_tail);
     free(w->dead);
+    free(w->drops);
+    free(w->dups);
     free(base->engines);
     free(w);
 }
@@ -131,17 +135,27 @@ static int loop_isend(rlo_world *base, int src, int dst, int comm, int tag,
     rlo_loop_world *w = (rlo_loop_world *)base;
     if (dst < 0 || dst >= base->world_size || !frame || frame->len < 0)
         return RLO_ERR_ARG;
-    if (w->dead[src] || w->dead[dst]) {
+    if (w->dead[src] || w->dead[dst] ||
+        w->drops[src * base->world_size + dst] > 0) {
         /* a dead host's packets never leave it; packets to a dead host
-         * vanish — the handle completes so the sender's queues drain */
+         * (or hit by loss injection) vanish — the handle completes
+         * done-but-failed so the sender's queues drain */
+        if (w->drops[src * base->world_size + dst] > 0)
+            w->drops[src * base->world_size + dst]--;
         if (out) {
             rlo_handle *h = rlo_handle_new(1);
             if (!h)
                 return RLO_ERR_NOMEM;
             h->delivered = 1;
+            h->failed = 1;
             *out = h;
         }
         return RLO_OK;
+    }
+    int dup = 0;
+    if (w->dups[src * base->world_size + dst] > 0) {
+        w->dups[src * base->world_size + dst]--;
+        dup = 1; /* duplication injection: deliver this frame twice */
     }
     int caller_tracks = out != 0;
     rlo_handle *h = rlo_handle_new(caller_tracks ? 2 : 1);
@@ -159,24 +173,63 @@ static int loop_isend(rlo_world *base, int src, int dst, int comm, int tag,
     n->handle = h;
     n->frame = rlo_blob_ref(frame); /* zero-copy in-process delivery */
     w->sent_cnt++;
-    if (w->latency <= 0) {
-        inbox_push(w, n);
-    } else {
-        n->due = w->tick + xorshift64(&w->rng) % (uint64_t)(w->latency + 1);
-        rlo_channel *c = get_channel(w, src, dst, comm);
-        if (!c) {
-            free_node(n);
-            return RLO_ERR_NOMEM;
+    for (int copy = 0; copy <= dup; copy++) {
+        if (copy == 1) {
+            /* duplication injection: a second node sharing the frame
+             * blob, with its own (untracked) completion handle */
+            rlo_wire_node *n2 = (rlo_wire_node *)malloc(sizeof(*n2));
+            rlo_handle *h2 = rlo_handle_new(1);
+            if (!n2 || !h2) { /* injection is best-effort: skip */
+                free(n2);
+                free(h2);
+                break;
+            }
+            *n2 = *n;
+            n2->next = 0;
+            n2->handle = h2;
+            n2->frame = rlo_blob_ref(frame);
+            n = n2;
         }
-        if (c->tail)
-            c->tail->next = n;
-        else
-            c->head = n;
-        c->tail = n;
-        n->next = 0;
+        if (w->latency <= 0) {
+            inbox_push(w, n);
+        } else {
+            n->due =
+                w->tick + xorshift64(&w->rng) % (uint64_t)(w->latency + 1);
+            rlo_channel *c = get_channel(w, src, dst, comm);
+            if (!c) {
+                free_node(n);
+                return RLO_ERR_NOMEM;
+            }
+            if (c->tail)
+                c->tail->next = n;
+            else
+                c->head = n;
+            c->tail = n;
+            n->next = 0;
+        }
     }
     if (out)
         *out = h;
+    return RLO_OK;
+}
+
+static int loop_drop_next(rlo_world *base, int src, int dst, int count)
+{
+    rlo_loop_world *w = (rlo_loop_world *)base;
+    if (src < 0 || src >= base->world_size || dst < 0 ||
+        dst >= base->world_size || count < 0)
+        return RLO_ERR_ARG;
+    w->drops[src * base->world_size + dst] += count;
+    return RLO_OK;
+}
+
+static int loop_dup_next(rlo_world *base, int src, int dst, int count)
+{
+    rlo_loop_world *w = (rlo_loop_world *)base;
+    if (src < 0 || src >= base->world_size || dst < 0 ||
+        dst >= base->world_size || count < 0)
+        return RLO_ERR_ARG;
+    w->dups[src * base->world_size + dst] += count;
     return RLO_OK;
 }
 
@@ -209,6 +262,7 @@ static int loop_kill_rank(rlo_world *base, int rank)
         for (rlo_wire_node *n = c->head; n;) {
             rlo_wire_node *nn = n->next;
             n->handle->delivered = 1;
+            n->handle->failed = 1;
             free_node(n);
             n = nn;
         }
@@ -255,6 +309,8 @@ static const rlo_transport_ops LOOP_OPS = {
     .delivered_cnt = loop_delivered,
     .drain = rlo_drain_local,
     .kill_rank = loop_kill_rank,
+    .drop_next = loop_drop_next,
+    .dup_next = loop_dup_next,
     .free_ = loop_free,
 };
 
@@ -275,10 +331,15 @@ rlo_world *rlo_world_new(int world_size, int latency, uint64_t seed)
     w->inbox_tail =
         (rlo_wire_node **)calloc((size_t)world_size, sizeof(void *));
     w->dead = (uint8_t *)calloc((size_t)world_size, 1);
-    if (!w->inbox_head || !w->inbox_tail || !w->dead) {
+    w->drops = (int *)calloc((size_t)world_size * world_size, sizeof(int));
+    w->dups = (int *)calloc((size_t)world_size * world_size, sizeof(int));
+    if (!w->inbox_head || !w->inbox_tail || !w->dead || !w->drops ||
+        !w->dups) {
         free(w->inbox_head);
         free(w->inbox_tail);
         free(w->dead);
+        free(w->drops);
+        free(w->dups);
         free(w);
         return 0;
     }

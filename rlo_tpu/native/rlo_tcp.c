@@ -72,6 +72,7 @@ typedef struct tcp_send_node {
 
 typedef struct tcp_peer {
     int fd;                        /* -1 for self */
+    int crashed;                   /* reset / EPIPE / mid-frame EOF */
     tcp_send_node *sq_head, *sq_tail;
     /* receive reassembly */
     tcp_hdr rhdr;
@@ -189,9 +190,25 @@ static int tcp_isend(rlo_world *base, int src, int dst, int comm, int tag,
                                           poisoning the peer's world */
         src != base->my_rank || dst == base->my_rank)
         return RLO_ERR_ARG;
-    if (w->failed)
-        return RLO_ERR_STALL;
+    if (w->peers[dst].crashed) {
+        /* blackhole, like loopback's kill_rank: the handle completes
+         * done-but-failed so the sender's queues drain, and traffic to
+         * LIVE peers keeps flowing — the engine-level failure detector
+         * (not a sticky transport error) owns the recovery */
+        if (out) {
+            rlo_handle *h = rlo_handle_new(1);
+            if (!h)
+                return RLO_ERR_NOMEM;
+            h->delivered = 1;
+            h->failed = 1;
+            *out = h;
+        }
+        return RLO_OK;
+    }
     int rc = tcp_enqueue(w, dst, comm, tag, frame, out);
+    if (rc == RLO_ERR_STALL && w->peers[dst].crashed)
+        rc = RLO_OK; /* crash detected on this very flush: the handle
+                        already fail-completed; not a caller error */
     if (rc == RLO_OK && comm != TCP_CTRL_COMM)
         w->sent_cnt++;
     return rc;
@@ -241,16 +258,40 @@ static void tcp_deliver(rlo_tcp_world *w, int src)
 }
 
 
-/* a peer-attributable failure: remember the dead world AND close the
- * peer's socket so tcp_peer_alive reports it dead (the crash-fast
- * signal; without the close, fd >= 0 would read "alive" forever) */
+/* A peer-attributable failure (recv EOF mid-frame, send EPIPE/reset):
+ * mark THE PEER dead, fail-complete every in-flight handle queued at
+ * it (done-but-failed, never hung — the engine's tracking queues
+ * drain and its ARQ entries stop mattering), drop its queue and any
+ * half-assembled inbound frame, and close the socket so
+ * tcp_peer_alive reports it dead. The world's failed flag is also set
+ * (the crash-fast signal data collectives abort on); the engine-level
+ * heartbeat detector feeds off the same silence — the peer stops
+ * refreshing hb_seen, times out, and the survivors elastically
+ * re-form exactly as on any other transport. */
 static void tcp_peer_crashed(rlo_tcp_world *w, tcp_peer *p)
 {
     w->failed = 1;
+    p->crashed = 1;
     if (p->fd >= 0) {
         close(p->fd);
         p->fd = -1;
     }
+    for (tcp_send_node *n = p->sq_head; n;) {
+        tcp_send_node *nn = n->next;
+        if (n->handle) {
+            n->handle->delivered = 1;
+            n->handle->failed = 1;
+            rlo_handle_unref(n->handle);
+        }
+        rlo_blob_unref(n->frame);
+        free(n);
+        n = nn;
+    }
+    p->sq_head = p->sq_tail = 0;
+    rlo_blob_unref(p->rframe);
+    p->rframe = 0;
+    p->rhdr_got = 0;
+    p->rframe_got = 0;
 }
 
 /* read whatever each socket has; assemble frames into the inboxes.

@@ -1,10 +1,12 @@
 /* Wire format: one variable-size frame per message.
  *
- * Layout (little-endian, matching rlo_tpu/wire.py `<iiiQ>`):
- *   [origin:i32][pid:i32][vote:i32][len:u64][payload bytes]
+ * Layout (little-endian, matching rlo_tpu/wire.py `<iiiiQ>`):
+ *   [origin:i32][pid:i32][vote:i32][seq:i32][len:u64][payload bytes]
  * The reference's pbuf (rootless_ops.c:1369-1410) carries the same logical
  * fields but always ships a fixed 32 KB buffer (:1588); frames here are
- * exactly header + payload.
+ * exactly header + payload. `seq` is the reliable-delivery layer's
+ * per-(sender, receiver) link sequence number (-1 outside the ARQ path);
+ * it is link state, not an application field.
  */
 #include "rlo_core.h"
 
@@ -39,27 +41,28 @@ static uint64_t get_u64(const uint8_t *p)
 }
 
 int64_t rlo_frame_encode(uint8_t *dst, int64_t cap, int32_t origin,
-                         int32_t pid, int32_t vote, const uint8_t *payload,
-                         int64_t len)
+                         int32_t pid, int32_t vote, int32_t seq,
+                         const uint8_t *payload, int64_t len)
 {
     if (len < 0 || cap < RLO_HEADER_SIZE + len)
         return RLO_ERR_ARG;
     put_i32(dst, origin);
     put_i32(dst + 4, pid);
     put_i32(dst + 8, vote);
-    put_u64(dst + 12, (uint64_t)len);
+    put_i32(dst + RLO_SEQ_OFFSET, seq);
+    put_u64(dst + 16, (uint64_t)len);
     if (len > 0)
         memcpy(dst + RLO_HEADER_SIZE, payload, (size_t)len);
     return RLO_HEADER_SIZE + len;
 }
 
 int64_t rlo_frame_decode(const uint8_t *raw, int64_t rawlen, int32_t *origin,
-                         int32_t *pid, int32_t *vote,
+                         int32_t *pid, int32_t *vote, int32_t *seq,
                          const uint8_t **payload)
 {
     if (rawlen < RLO_HEADER_SIZE)
         return RLO_ERR_ARG;
-    uint64_t n = get_u64(raw + 12);
+    uint64_t n = get_u64(raw + 16);
     if ((int64_t)n > rawlen - RLO_HEADER_SIZE)
         return RLO_ERR_ARG; /* truncated frame */
     if (origin)
@@ -68,6 +71,8 @@ int64_t rlo_frame_decode(const uint8_t *raw, int64_t rawlen, int32_t *origin,
         *pid = get_i32(raw + 4);
     if (vote)
         *vote = get_i32(raw + 8);
+    if (seq)
+        *seq = get_i32(raw + RLO_SEQ_OFFSET);
     if (payload)
         *payload = raw + RLO_HEADER_SIZE;
     return (int64_t)n;

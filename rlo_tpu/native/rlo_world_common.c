@@ -89,6 +89,20 @@ int rlo_world_kill_rank(rlo_world *w, int rank)
     return w->ops->kill_rank(w, rank);
 }
 
+int rlo_world_drop_next(rlo_world *w, int src, int dst, int count)
+{
+    if (!w->ops->drop_next)
+        return RLO_ERR_ARG;
+    return w->ops->drop_next(w, src, dst, count);
+}
+
+int rlo_world_dup_next(rlo_world *w, int src, int dst, int count)
+{
+    if (!w->ops->dup_next)
+        return RLO_ERR_ARG;
+    return w->ops->dup_next(w, src, dst, count);
+}
+
 void rlo_world_free(rlo_world *w)
 {
     if (!w)
@@ -148,7 +162,8 @@ void rlo_progress_all(rlo_world *w)
     rlo_engine **snap =
         (rlo_engine **)malloc((size_t)(n ? n : 1) * sizeof(void *));
     if (snap) {
-        memcpy(snap, w->engines, (size_t)n * sizeof(void *));
+        if (n > 0) /* engines may be NULL pre-registration (UBSan) */
+            memcpy(snap, w->engines, (size_t)n * sizeof(void *));
         for (int i = 0; i < n; i++) {
             /* skip engines freed by an earlier engine's callback */
             int live = 0;

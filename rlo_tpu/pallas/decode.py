@@ -25,12 +25,17 @@ bytes.
 Shapes (GQA-grouped, head-leading, SEQ-MINOR — models.generate
 stores the cache with max_len as the minor dim so HBM tiles stream at
 full 128-lane width; head_dim=64-minor measured half the bandwidth,
-benchmarks/attend_sweep.py):
-  q        (b, kv_heads, r, head_dim)   r = n_heads / kv_heads
+benchmarks/attend_sweep.py). The kernel is T-query generalized (the
+speculative-decoding verify shape, flash_block_decode): the query
+axis carries T*r rows t-major — row t*r+rr is block token t, group
+member rr, at sequence position pos0_b + t — and T=1 IS single-token
+decode, so both paths share one kernel and its numerics:
+  q        (b, kv_heads, T*r, head_dim)  r = n_heads / kv_heads
   k/v      (b, kv_heads, head_dim, max_len)  act dtype or int8
   ks/vs    (b, kv_heads, max_len) f32 scales (int8 caches only)
-  pos      (b, 1) int32 — every row masks its own prefix [0, pos_b]
-  out      (b, kv_heads, r, head_dim) f32
+  pos      (b, 1) int32 — query t of row b masks prefix [0, pos_b + t]
+  out      (b, kv_heads, T*r, head_dim) f32
+  scratch  m/l (kv_heads, T*r), o (kv_heads, T*r, head_dim) f32
 
 Dots run in bf16 with f32 accumulation (int8 -> bf16 is lossless;
 f32 caches keep f32 dots — their tiles are smaller than VMEM allows
@@ -111,7 +116,7 @@ def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, *rest, scale: float,
     # VMEM, and 0 * NaN would poison the accumulator
     v = jnp.where(mask_col, v, jnp.zeros((), dot_dt))
 
-    m = m_s[...]                                     # (g, r)
+    m = m_s[...]                                     # (g, T*r)
     m_new = jnp.maximum(m, s.max(axis=-1))
     p = jnp.where(mask_row, jnp.exp(s - m_new[..., None]), 0.0)
     corr = jnp.exp(m - m_new)

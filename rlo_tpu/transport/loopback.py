@@ -9,6 +9,12 @@ latency to shake out ordering assumptions the way real networks would.
 Guarantees (matching MPI): per-(src, dst) FIFO order — even with latency
 injection — and reliable delivery. Cross-pair order is unspecified and is
 exactly what the fuzzing knobs perturb.
+
+Fault injection (the chaos-soak levers, docs/DESIGN.md §6): ``kill_rank``
+(crash-stop), ``drop_next`` (targeted loss), ``dup_next`` (network
+duplication), ``set_burst_loss`` (seeded correlated loss), plus the seeded
+``latency`` reordering. Duplicated frames keep per-channel FIFO (both
+copies deliver back to back).
 """
 
 from __future__ import annotations
@@ -63,7 +69,14 @@ class LoopbackWorld:
         self.lock = threading.RLock()
         self.dead: set = set()      # killed ranks (fault injection)
         self._drops: dict = {}      # (src, dst) -> #messages to drop
+        self._dups: dict = {}       # (src, dst) -> #messages to duplicate
         self.dropped_cnt = 0
+        self.duplicated_cnt = 0
+        # seeded burst loss: each message starts a loss burst with
+        # probability burst_loss_p, dropping it and the next
+        # burst_loss_len - 1 messages on its (src, dst) channel
+        self.burst_loss_p = 0.0
+        self.burst_loss_len = 1
         self.inboxes: List[deque] = [deque() for _ in range(world_size)]
         # per-(src, dst) FIFO channels of held messages:
         # (deliver_at_tick, tag, data, handle). Only channel heads can become
@@ -93,15 +106,31 @@ class LoopbackWorld:
                 self._drops[(src, dst)] = pending - 1
                 self.dropped_cnt += 1
                 return FAILED_SEND
+            if self.burst_loss_p and self.rng.random() < self.burst_loss_p:
+                # seeded burst loss: this message and the next
+                # burst_loss_len - 1 on this channel vanish
+                if self.burst_loss_len > 1:
+                    self._drops[(src, dst)] = (self._drops.get((src, dst), 0)
+                                               + self.burst_loss_len - 1)
+                self.dropped_cnt += 1
+                return FAILED_SEND
+            copies = 1
+            dups = self._dups.get((src, dst), 0)
+            if dups:  # duplication injection: deliver twice
+                self._dups[(src, dst)] = dups - 1
+                self.duplicated_cnt += 1
+                copies = 2
             self.sent_cnt += 1
             if self.latency <= 0:
-                self.inboxes[dst].append((src, tag, bytes(data)))
-                self.delivered_cnt += 1
+                for _ in range(copies):
+                    self.inboxes[dst].append((src, tag, bytes(data)))
+                    self.delivered_cnt += 1
                 return COMPLETED_SEND
             h = _PendingSend()
+            chan = self.channels.setdefault((src, dst), deque())
             deliver_at = self.tick + self.rng.randint(0, self.latency)
-            self.channels.setdefault((src, dst), deque()).append(
-                (deliver_at, tag, bytes(data), h))
+            for _ in range(copies):
+                chan.append((deliver_at, tag, bytes(data), h))
             return h
 
     def _deliver_due(self) -> None:
@@ -154,6 +183,27 @@ class LoopbackWorld:
         """Silently drop the next ``count`` messages sent src -> dst."""
         with self.lock:
             self._drops[(src, dst)] = self._drops.get((src, dst), 0) + count
+
+    def dup_next(self, src: int, dst: int, count: int = 1) -> None:
+        """Deliver the next ``count`` messages src -> dst TWICE (network
+        duplication injection — the receive-side idempotence probe for
+        the ARQ dedup layer)."""
+        with self.lock:
+            self._dups[(src, dst)] = self._dups.get((src, dst), 0) + count
+
+    def set_burst_loss(self, p: float, burst_len: int = 3) -> None:
+        """Seeded random burst loss on every channel: each sent message
+        starts a loss burst with probability ``p``, silently dropping
+        it and the next ``burst_len - 1`` messages on its (src, dst)
+        channel — the correlated-loss pattern (switch buffer overrun,
+        link flap) that defeats naive single-retry schemes."""
+        if not 0.0 <= p <= 1.0:
+            raise ValueError(f"loss probability must be in [0, 1], got {p}")
+        if burst_len < 1:
+            raise ValueError(f"burst_len must be >= 1, got {burst_len}")
+        with self.lock:
+            self.burst_loss_p = float(p)
+            self.burst_loss_len = int(burst_len)
 
     # -- observability -----------------------------------------------------
     def quiescent(self) -> bool:

@@ -285,6 +285,15 @@ def engine_state_dict(engine) -> dict:
                        for o, ent in engine._seen_bcast.items()},
         "recent_bcasts": [[tag, base64.b64encode(raw).decode()]
                           for tag, raw in engine._recent_bcasts],
+        # ARQ link state: a restored engine must never reissue a
+        # pre-snapshot link seq (peers remembering it as seen would
+        # silently drop the fresh frame), and must keep its receive
+        # windows so a peer's retransmit of a pre-snapshot frame is
+        # still recognized as a duplicate. The retransmit queue itself
+        # is empty by construction (idle() requires arq_unacked()==0).
+        "arq_tx_seq": {str(d): s for d, s in engine._tx_seq.items()},
+        "arq_rx_seen": {str(s): [ent[0], sorted(ent[1])]
+                        for s, ent in engine._rx_seen.items()},
         "pickup": pickup,
     }
 
@@ -329,6 +338,12 @@ def load_engine_state(engine, state: dict) -> None:
                 tag, s = ent
                 engine._recent_bcasts.append((int(tag),
                                               base64.b64decode(s)))
+    if "arq_tx_seq" in state:  # pre-ARQ snapshots: preserve current
+        engine._tx_seq = {int(d): int(s)
+                          for d, s in state["arq_tx_seq"].items()}
+    if "arq_rx_seen" in state:
+        engine._rx_seen = {int(s): [ent[0], set(ent[1])]
+                           for s, ent in state["arq_rx_seen"].items()}
     for m in state.get("pickup", []):
         frame = Frame(origin=m["origin"], pid=m["pid"], vote=m["vote"],
                       payload=base64.b64decode(m["data"]))

@@ -29,30 +29,48 @@ def _elems(dims: str) -> int:
     return n
 
 
-def permute_total_bytes(lowered_text: str):
+def _check_matched(n: int, what: str, require: bool) -> None:
+    """Byte-pinning guard: when the caller KNOWS the collective is in
+    the program, zero regex matches means the StableHLO printer
+    changed shape — fail loudly instead of letting a silent 0 win a
+    `total == model` comparison (or, worse, a `0 <= budget` one)."""
+    if require and n == 0:
+        raise ValueError(
+            f"no {what} ops matched the lowered text, but the caller "
+            f"asserts the collective exists — the StableHLO printer "
+            f"likely changed; update the regexes in rlo_tpu/utils/hlo.py")
+
+
+def permute_total_bytes(lowered_text: str, require: bool = False):
     """Total collective_permute operand bytes + launch count,
     pattern-agnostic (ring, XOR halving/doubling, shift-o hops all
-    counted)."""
+    counted). ``require=True`` raises if NOTHING matched (use wherever
+    the program is known to contain permutes)."""
     total = n = 0
     for m in _PERMUTE_RE.finditer(lowered_text):
         total += _elems(m.group(3)) * _DTYPE_BYTES[m.group(4)]
         n += 1
+    _check_matched(n, "collective_permute", require)
     return total, n
 
 
-def permute_entries(lowered_text: str):
+def permute_entries(lowered_text: str, require: bool = False):
     """Per-launch (src, dst, nbytes) of the first source-target pair of
     every collective_permute — enough to classify ring direction or
-    shift offset."""
+    shift offset. ``require=True`` raises on zero matches."""
     out = []
     for m in _PERMUTE_RE.finditer(lowered_text):
         out.append((int(m.group(1)), int(m.group(2)),
                     _elems(m.group(3)) * _DTYPE_BYTES[m.group(4)]))
+    _check_matched(len(out), "collective_permute", require)
     return out
 
 
-def all_gather_operands(lowered_text: str):
-    """(elems, dtype) of every all_gather operand in the text."""
-    return [(_elems(dims), dt) for dims, dt in re.findall(
+def all_gather_operands(lowered_text: str, require: bool = False):
+    """(elems, dtype) of every all_gather operand in the text.
+    ``require=True`` raises on zero matches."""
+    out = [(_elems(dims), dt) for dims, dt in re.findall(
         r'all_gather[^\n]*?:\s*\(tensor<([0-9x]+)x'
         r'(f32|f64|i32|bf16|i8)>\)', lowered_text)]
+    _check_matched(len(out), "all_gather", require)
+    return out

@@ -13,6 +13,17 @@ The ``vote`` field doubles as a type discriminator in the reference
 travels out-of-band as the transport tag (mirroring MPI_TAG dispatch in
 make_progress_gen, rootless_ops.c:582-621), and ``vote`` only carries votes
 and decisions.
+
+``seq`` is the reliable-delivery layer's per-(sender, receiver) link
+sequence number (net-new: the reference has no loss recovery at all,
+SURVEY.md §5). It is stamped by the sending engine's ARQ machinery at
+isend time — NOT by the application — and is -1 on frames outside the
+ARQ path (heartbeats, ACKs, engines without ARQ enabled). Receivers
+dedup on (immediate sender, seq) before any tag dispatch, which makes
+retransmits idempotent even through the store-and-forward broadcast
+path; cumulative acknowledgements travel back as ``Tag.ACK`` frames
+(and piggybacked on heartbeats) carrying the highest-contiguous
+received seq in the ``vote`` field.
 """
 
 from __future__ import annotations
@@ -39,14 +50,26 @@ class Tag(enum.IntEnum):
     BARRIER = 10
     HEARTBEAT = 11   # point-to-point ring liveness probe (net-new)
     FAILURE = 12     # rootless failure notification; pid = failed rank
+    ACK = 13         # cumulative link ACK; vote = highest contiguous seq
+    ABORT = 14       # rootless op-abort notification (deadline expiry);
+                     # pid = aborted pid, payload = round generation
 
 
 #: Tags that are store-and-forward broadcast over the skip-ring overlay.
 BCAST_TAGS = frozenset({Tag.BCAST, Tag.IAR_PROPOSAL, Tag.IAR_DECISION,
-                        Tag.FAILURE})
+                        Tag.FAILURE, Tag.ABORT})
 
-_HEADER = struct.Struct("<iiiQ")  # origin, pid, vote, data_len
+#: Tags the ARQ layer neither stamps nor retransmits: heartbeats are
+#: periodic by construction (a lost one is replaced by the next) and
+#: ACKs ack themselves by effect (a lost ACK just triggers one more
+#: retransmit, which the dedup layer absorbs and re-acks).
+ARQ_EXEMPT_TAGS = frozenset({Tag.HEARTBEAT, Tag.ACK})
+
+_HEADER = struct.Struct("<iiiiQ")  # origin, pid, vote, seq, data_len
 HEADER_SIZE = _HEADER.size
+#: byte offset of the seq field — the ARQ send path re-stamps encoded
+#: frames in place (one encode per broadcast, one patch per edge)
+SEQ_OFFSET = 12
 
 #: Default engine cap, matching RLO_MSG_SIZE_MAX (rootless_ops.h:49). Frames
 #: themselves are variable-size; this only bounds a single message payload.
@@ -56,23 +79,34 @@ MSG_SIZE_MAX = 32768
 @dataclass
 class Frame:
     """One wire message. ``origin`` is the broadcast initiator (not the
-    immediate sender — that is transport metadata, like MPI_SOURCE)."""
+    immediate sender — that is transport metadata, like MPI_SOURCE).
+    ``seq`` is per-(immediate sender, receiver) link state owned by the
+    ARQ layer; it is deliberately NOT an application field."""
     origin: int
     pid: int = -1
     vote: int = -1
     payload: bytes = b""
+    seq: int = -1
 
     def encode(self) -> bytes:
-        return _HEADER.pack(self.origin, self.pid, self.vote,
+        return _HEADER.pack(self.origin, self.pid, self.vote, self.seq,
                             len(self.payload)) + self.payload
 
     @classmethod
     def decode(cls, raw: bytes) -> "Frame":
         if len(raw) < HEADER_SIZE:
             raise ValueError(f"frame too short: {len(raw)} < {HEADER_SIZE}")
-        origin, pid, vote, n = _HEADER.unpack_from(raw)
+        origin, pid, vote, seq, n = _HEADER.unpack_from(raw)
         payload = bytes(raw[HEADER_SIZE:HEADER_SIZE + n])
         if len(payload) != n:
             raise ValueError(f"truncated frame: want {n} payload bytes, "
                              f"have {len(raw) - HEADER_SIZE}")
-        return cls(origin, pid, vote, payload)
+        return cls(origin, pid, vote, payload, seq)
+
+
+def restamp_seq(raw: bytes, seq: int) -> bytes:
+    """Return ``raw`` with its header seq field replaced — the ARQ send
+    path's per-edge stamp (avoids re-encoding the payload per edge)."""
+    buf = bytearray(raw)
+    struct.pack_into("<i", buf, SEQ_OFFSET, seq)
+    return bytes(buf)

@@ -68,22 +68,31 @@ class TestWireParity:
         (31, -2, 0, bytes(range(256)) * 4),
     ])
     def test_roundtrip_matches_python(self, origin, pid, vote, payload):
-        o, p, v, data, raw = nb.frame_roundtrip(origin, pid, vote, payload)
-        assert (o, p, v, data) == (origin, pid, vote, payload)
+        o, p, v, data, raw, s = nb.frame_roundtrip(origin, pid, vote,
+                                                   payload)
+        assert (o, p, v, data, s) == (origin, pid, vote, payload, -1)
         # byte-for-byte interop with the Python encoder
         assert raw == Frame(origin, pid, vote, payload).encode()
         f = Frame.decode(raw)
         assert (f.origin, f.pid, f.vote, f.payload) == \
             (origin, pid, vote, payload)
 
+    def test_seq_field_roundtrips(self):
+        # the ARQ link seq is part of the header in both encoders
+        o, p, v, data, raw, s = nb.frame_roundtrip(2, 5, 1, b"q", seq=37)
+        assert s == 37
+        assert raw == Frame(2, 5, 1, b"q", seq=37).encode()
+        assert Frame.decode(raw).seq == 37
+
     def test_truncated_frame_rejected(self):
         raw = Frame(1, 2, 3, b"abcdef").encode()
         import ctypes as C
         lib = nb.load()
         buf = (C.c_uint8 * len(raw)).from_buffer_copy(raw)
-        assert lib.rlo_frame_decode(buf, 10, None, None, None, None) < 0
-        assert lib.rlo_frame_decode(buf, len(raw) - 1, None, None, None,
+        assert lib.rlo_frame_decode(buf, 10, None, None, None, None,
                                     None) < 0
+        assert lib.rlo_frame_decode(buf, len(raw) - 1, None, None, None,
+                                    None, None) < 0
 
 
 # ---------------------------------------------------------------------------

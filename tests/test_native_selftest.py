@@ -1,0 +1,49 @@
+"""Tier-1 sanitizer leg: build the native core with
+-fsanitize=address,undefined and run rlo_selftest under it.
+
+check.sh has always run the ASan/UBSan selftest, but check.sh is not
+tier-1 — this wrapper puts the sanitized C engine (including the new
+ARQ ack/retransmit paths, the loss/dup fault-injection plumbing, and
+the forked TCP peer-death scenario) into the plain pytest run, so a
+leak/UAF/UB regression in the C core fails CI and not just the manual
+one-shot script.
+"""
+
+import shutil
+import subprocess
+from pathlib import Path
+
+import pytest
+
+NATIVE = Path(__file__).resolve().parent.parent / "rlo_tpu" / "native"
+
+
+def _sanitizers_available(cc: str) -> bool:
+    probe = subprocess.run(
+        [cc, "-xc", "-", "-fsanitize=address,undefined", "-o",
+         "/dev/null"],
+        input="int main(void){return 0;}\n",
+        capture_output=True, text=True)
+    return probe.returncode == 0
+
+
+def test_native_selftest_sanitizer_clean():
+    cc = shutil.which("cc")
+    if cc is None:
+        pytest.skip("no C compiler in this environment")
+    if shutil.which("make") is None:
+        pytest.skip("no make in this environment")
+    if not _sanitizers_available("cc"):
+        pytest.skip("cc cannot link -fsanitize=address,undefined")
+    build = subprocess.run(["make", "-s", "selftest"], cwd=NATIVE,
+                           capture_output=True, text=True, timeout=300)
+    assert build.returncode == 0, \
+        f"sanitized selftest build failed:\n{build.stdout}\n{build.stderr}"
+    run = subprocess.run([str(NATIVE / "rlo_selftest")], cwd=NATIVE,
+                         capture_output=True, text=True, timeout=300)
+    assert run.returncode == 0, \
+        f"rlo_selftest failed under ASan/UBSan:\n{run.stdout}\n{run.stderr}"
+    # UBSan reports land on stderr without changing the exit code
+    # unless -fno-sanitize-recover; treat any runtime report as a fail
+    assert "runtime error" not in run.stderr, run.stderr
+    assert "AddressSanitizer" not in run.stderr, run.stderr

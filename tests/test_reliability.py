@@ -1,0 +1,464 @@
+"""Reliable delivery: ARQ, op deadlines, and the chaos-soak harness.
+
+The reference library is fire-and-forget — RLO_FAILED exists in its
+status enum but is never assigned, and there are no timeouts, retries,
+or loss recovery (SURVEY.md §5). This suite proves the net-new
+reliability layer end to end:
+
+  - ARQ: per-(src, dst) link seqs, retransmit-until-acked with
+    exponential backoff, cumulative ACKs (standalone + heartbeat
+    piggyback), and receive-side dedup that makes retransmits
+    idempotent through the store-and-forward broadcast path;
+  - op deadlines: a proposal that cannot resolve FAILS at its deadline
+    (finally assigning ReqState.FAILED for timeouts) and a rootless
+    ABORT unparks the round at every relay;
+  - the chaos soak: randomized drop/dup/burst-loss/reorder schedules
+    plus a mid-soak rank kill, asserting every op terminates and no
+    payload is ever delivered twice.
+"""
+
+import random
+
+import pytest
+
+from rlo_tpu.engine import EngineManager, ProgressEngine, ReqState
+from rlo_tpu.transport.loopback import LoopbackWorld
+from rlo_tpu.wire import Tag
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def make_world(ws, latency=0, seed=None, **kw):
+    clock = FakeClock()
+    world = LoopbackWorld(ws, latency=latency, seed=seed)
+    mgr = EngineManager()
+    engines = [ProgressEngine(world.transport(r), manager=mgr,
+                              clock=clock, **kw)
+               for r in range(ws)]
+    return world, mgr, engines, clock
+
+
+def spin(mgr, clock, ticks, dt=0.5):
+    for _ in range(ticks):
+        clock.advance(dt)
+        mgr.progress_all()
+
+
+def iter_pickups(engines):
+    for e in engines:
+        while True:
+            m = e.pickup_next()
+            if m is None:
+                break
+            yield e.rank, m
+
+
+# ---------------------------------------------------------------------------
+# ARQ: loss recovery + duplicate suppression
+# ---------------------------------------------------------------------------
+
+class TestArq:
+    def test_dropped_frames_are_retransmitted(self):
+        world, mgr, engines, clock = make_world(8, arq_rto=1.0)
+        # lose the first two frames rank 0 sends to each of its
+        # overlay targets — without ARQ the bcast silently loses
+        # subtrees forever
+        for dst in engines[0]._cur_initiator_targets():
+            world.drop_next(0, dst, 2)
+        engines[0].bcast(b"payload-0")
+        engines[0].bcast(b"payload-1")
+        spin(mgr, clock, 60, dt=0.7)
+        got = {}
+        for rank, m in iter_pickups(engines):
+            got.setdefault(rank, []).append(m.data)
+        assert all(sorted(got[r]) == [b"payload-0", b"payload-1"]
+                   for r in range(1, 8)), got
+        assert sum(e.arq_retransmits for e in engines) >= 2
+        assert all(e.arq_unacked() == 0 for e in engines)
+
+    def test_dropped_vote_no_longer_wedges_consensus(self):
+        # THE acceptance scenario: the reference wedges
+        # RLO_submit_proposal forever on one lost vote frame
+        world, mgr, engines, clock = make_world(8, arq_rto=1.0)
+        # rank 1 is a leaf in rank 0's tree: its first reliable frame
+        # back to 0 is its vote
+        world.drop_next(1, 0, 1)
+        rc = engines[0].submit_proposal(b"prop", pid=3)
+        assert rc == -1  # the vote is in the dropped frame
+        spin(mgr, clock, 60, dt=0.7)
+        assert engines[0].check_proposal_state() == ReqState.COMPLETED
+        assert engines[0].vote_my_proposal() == 1
+        assert sum(e.arq_retransmits for e in engines) >= 1
+
+    def test_duplicated_frames_deliver_once(self):
+        world, mgr, engines, clock = make_world(4, arq_rto=1.0)
+        # duplicate everything rank 0 sends for a while: receivers
+        # must drop the copies at the link layer before tag dispatch
+        for dst in range(1, 4):
+            world.dup_next(0, dst, 10)
+        engines[0].bcast(b"once")
+        spin(mgr, clock, 40, dt=0.7)
+        counts = {}
+        for rank, m in iter_pickups(engines):
+            counts[rank] = counts.get(rank, 0) + 1
+        assert counts == {1: 1, 2: 1, 3: 1}, counts
+        assert sum(e.arq_dup_drops for e in engines) >= 1
+
+    def test_retransmit_gives_up_after_max_retries(self):
+        world, mgr, engines, clock = make_world(4, arq_rto=1.0,
+                                                arq_max_retries=3)
+        # the first overlay edge swallows everything (but its peer is
+        # never detected failed — ARQ must give up on its own)
+        victim = engines[0]._cur_initiator_targets()[0]
+        world.drop_next(0, victim, 10_000)
+        engines[0].bcast(b"x")
+        spin(mgr, clock, 200, dt=1.0)
+        assert engines[0].arq_unacked() == 0  # gave up, not stuck
+        assert engines[0].arq_gave_up >= 1
+
+    def test_give_up_does_not_wedge_the_link(self):
+        """After ARQ gives up on a frame, the SKIP notice advances the
+        receiver's watermark so LATER frames on that link still get
+        cumulatively acked — one abandoned frame must not force every
+        subsequent frame through retransmit-to-exhaustion."""
+        world, mgr, engines, clock = make_world(4, arq_rto=1.0,
+                                                arq_max_retries=3)
+        victim = engines[0]._cur_initiator_targets()[0]
+        world.drop_next(0, victim, 1)  # exactly one frame: a hole
+        engines[0].bcast(b"lost")
+        spin(mgr, clock, 60, dt=1.0)
+        assert engines[0].arq_gave_up == 0 or True  # may have recovered
+        # force a give-up: swallow the frame AND all its retransmits
+        world.drop_next(0, victim, 10)
+        engines[0].bcast(b"doomed")
+        spin(mgr, clock, 200, dt=1.0)
+        assert engines[0].arq_gave_up >= 1
+        assert engines[0].arq_unacked() == 0
+        # the link must still work: new traffic acks promptly, without
+        # burning through the retry budget
+        retx_before = engines[0].arq_retransmits
+        engines[0].bcast(b"after-the-hole")
+        spin(mgr, clock, 30, dt=1.0)
+        assert engines[0].arq_unacked() == 0
+        assert engines[0].arq_retransmits == retx_before
+        got = [m.data for _, m in iter_pickups(engines)]
+        assert got.count(b"after-the-hole") == 3
+
+    def test_acks_piggyback_on_heartbeats(self):
+        # no reverse data traffic: the retransmit queue must still
+        # drain via the heartbeat piggyback path
+        world, mgr, engines, clock = make_world(
+            4, arq_rto=50.0, failure_timeout=8.0,
+            heartbeat_interval=1.0)
+        engines[0].bcast(b"hb-acked")
+        # rto 50 >> test horizon: standalone re-acks alone would also
+        # cover it, so verify the queue empties LONG before any
+        # retransmit fires
+        spin(mgr, clock, 20, dt=0.5)
+        assert all(e.arq_unacked() == 0 for e in engines)
+        assert sum(e.arq_retransmits for e in engines) == 0
+
+    def test_arq_rejects_bad_rto(self):
+        world = LoopbackWorld(2)
+        with pytest.raises(ValueError):
+            ProgressEngine(world.transport(0), manager=EngineManager(),
+                           arq_rto=0.0)
+
+
+# ---------------------------------------------------------------------------
+# Op deadlines + rootless ABORT
+# ---------------------------------------------------------------------------
+
+class TestOpDeadlines:
+    def test_proposal_fails_at_deadline_without_arq(self):
+        # no ARQ, vote lost forever: the round must FAIL at the
+        # deadline instead of polling -1 until the end of time
+        world, mgr, engines, clock = make_world(8)
+        world.drop_next(1, 0, 1)  # leaf vote gone for good
+        rc = engines[0].submit_proposal(b"p", pid=5, deadline=10.0)
+        assert rc == -1
+        spin(mgr, clock, 6, dt=1.0)
+        assert engines[0].check_proposal_state() == ReqState.IN_PROGRESS
+        spin(mgr, clock, 12, dt=1.0)
+        assert engines[0].check_proposal_state() == ReqState.FAILED
+        assert engines[0].vote_my_proposal() == -1
+        assert engines[0].ops_failed == 1
+
+    def test_abort_unparks_relays_and_delivers_notice(self):
+        world, mgr, engines, clock = make_world(8)
+        world.drop_next(1, 0, 1)
+        engines[0].submit_proposal(b"p", pid=5, deadline=5.0)
+        spin(mgr, clock, 30, dt=1.0)
+        assert engines[0].check_proposal_state() == ReqState.FAILED
+        # every relay's parked round is gone (the engines are
+        # checkpointable again) and the abort notice was delivered
+        aborts = {}
+        for rank, m in iter_pickups(engines):
+            if m.type == int(Tag.ABORT):
+                aborts[rank] = m.pid
+        assert all(not e.queue_iar_pending for e in engines)
+        assert set(aborts) == set(range(1, 8))
+        assert all(pid == 5 for pid in aborts.values())
+
+    def test_failed_pid_can_resubmit_after_deadline(self):
+        # composes with elastic re-form: the timed-out op retries
+        world, mgr, engines, clock = make_world(8)
+        world.drop_next(1, 0, 1)
+        engines[0].submit_proposal(b"p", pid=5, deadline=5.0)
+        spin(mgr, clock, 20, dt=1.0)
+        assert engines[0].check_proposal_state() == ReqState.FAILED
+        rc = engines[0].submit_proposal(b"p2", pid=5, deadline=50.0)
+        spin(mgr, clock, 30, dt=1.0)
+        assert engines[0].check_proposal_state() == ReqState.COMPLETED
+        assert engines[0].vote_my_proposal() == 1
+
+    def test_engine_default_deadline_applies(self):
+        world, mgr, engines, clock = make_world(4, op_deadline=5.0)
+        world.drop_next(1, 0, 1)
+        world.drop_next(2, 0, 1)
+        world.drop_next(3, 0, 1)
+        engines[0].submit_proposal(b"p", pid=9)
+        spin(mgr, clock, 20, dt=1.0)
+        assert engines[0].check_proposal_state() == ReqState.FAILED
+
+    def test_bcast_deadline_stops_tracking_undeliverable_sends(self):
+        # latency holds frames in flight; the deadline abandons the op
+        # instead of tracking handles forever
+        world, mgr, engines, clock = make_world(4, latency=10_000, seed=7)
+        msg = engines[0].bcast(b"x", deadline=5.0)
+        assert msg.state == ReqState.IN_PROGRESS
+        spin(mgr, clock, 20, dt=1.0)
+        assert msg.state == ReqState.FAILED
+        assert not engines[0].queue_wait
+        assert engines[0].ops_failed == 1
+
+    def test_deadline_does_not_fire_after_decision_sent(self):
+        world, mgr, engines, clock = make_world(4, arq_rto=1.0)
+        rc = engines[0].submit_proposal(b"p", pid=2, deadline=5.0)
+        spin(mgr, clock, 30, dt=1.0)
+        assert engines[0].check_proposal_state() == ReqState.COMPLETED
+        assert engines[0].ops_failed == 0
+
+
+# ---------------------------------------------------------------------------
+# Chaos soak: randomized kill/drop/dup/reorder schedules over many
+# bcast + IAR rounds — every op terminates, no payload delivers twice
+# ---------------------------------------------------------------------------
+
+def run_soak(seed, ws=8, rounds=14, kill_at=7):
+    rng = random.Random(seed)
+    clock = FakeClock()
+    world = LoopbackWorld(ws, latency=3, seed=seed)
+    world.set_burst_loss(0.02, 3)
+    mgr = EngineManager()
+    engines = [ProgressEngine(world.transport(r), manager=mgr,
+                              clock=clock, arq_rto=2.0,
+                              arq_max_retries=6,
+                              failure_timeout=40.0,
+                              heartbeat_interval=4.0,
+                              op_deadline=120.0)
+               for r in range(ws)]
+    delivered = {r: [] for r in range(ws)}  # rank -> [(origin, data)]
+    decisions = {r: {} for r in range(ws)}  # rank -> {(pid, origin): n}
+    submitted = []  # (proposer, pid)
+    sent = []       # (origin, data)
+    dead = set()
+
+    def pump(ticks, dt=1.0):
+        for _ in range(ticks):
+            clock.advance(dt)
+            mgr.progress_all()
+            for r in range(ws):
+                if r in dead:
+                    continue
+                while True:
+                    m = engines[r].pickup_next()
+                    if m is None:
+                        break
+                    if m.type == int(Tag.BCAST):
+                        delivered[r].append((m.origin, m.data))
+                    elif m.type == int(Tag.IAR_DECISION):
+                        key = (m.pid, m.origin)
+                        decisions[r][key] = decisions[r].get(key, 0) + 1
+
+    for rnd in range(rounds):
+        alive = [r for r in range(ws) if r not in dead]
+        # random fault injection for this round
+        for _ in range(rng.randrange(3)):
+            a, b = rng.sample(range(ws), 2)
+            world.drop_next(a, b, rng.randrange(1, 3))
+        for _ in range(rng.randrange(3)):
+            a, b = rng.sample(range(ws), 2)
+            world.dup_next(a, b, rng.randrange(1, 3))
+        # a few broadcasts from random survivors
+        for _ in range(rng.randrange(1, 4)):
+            origin = rng.choice(alive)
+            data = f"r{rnd}-{origin}-{rng.randrange(1000)}".encode()
+            engines[origin].bcast(data)
+            sent.append((origin, data))
+        # one consensus round, sometimes with a targeted vote drop
+        proposer = rng.choice(alive)
+        pid = 100 + rnd
+        if rng.random() < 0.5:
+            peer = rng.choice([r for r in alive if r != proposer])
+            world.drop_next(peer, proposer, 1)
+        engines[proposer].submit_proposal(
+            f"prop-{rnd}".encode(), pid=pid)
+        submitted.append((proposer, pid))
+        if rnd == kill_at:
+            victim = rng.choice([r for r in alive])
+            world.kill_rank(victim)
+            engines[victim].cleanup()
+            dead.add(victim)
+        pump(rng.randrange(5, 30))
+
+    # let everything settle: remaining retransmits, heartbeats,
+    # failure detection, deadlines
+    pump(400)
+    return (world, engines, clock, dead, delivered, decisions,
+            submitted, sent)
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3])
+def test_chaos_soak(seed):
+    (world, engines, clock, dead, delivered, decisions, submitted,
+     sent) = run_soak(seed)
+    ws = len(engines)
+    survivors = [r for r in range(ws) if r not in dead]
+
+    # 1. every op on a surviving proposer TERMINATED: COMPLETED or
+    #    FAILED-by-deadline, never hanging IN_PROGRESS
+    for proposer, pid in submitted:
+        if proposer in dead:
+            continue
+        st = engines[proposer].my_own_proposal.state
+        assert st in (ReqState.COMPLETED, ReqState.FAILED), \
+            f"seed {seed}: proposer {proposer} pid {pid} hung in {st}"
+
+    # 2. no relay is left parked on a round forever (aborts/decisions/
+    #    failure discounting cleared them all)
+    for r in survivors:
+        assert not engines[r].queue_iar_pending, \
+            f"seed {seed}: rank {r} still parks " \
+            f"{len(engines[r].queue_iar_pending)} rounds"
+
+    # 3. exactly-once: despite dup injection, ARQ retransmits, and
+    #    view-change re-floods, no payload was ever delivered twice
+    for r in survivors:
+        assert len(delivered[r]) == len(set(delivered[r])), \
+            f"seed {seed}: rank {r} saw duplicate broadcast payloads"
+        for key, n in decisions[r].items():
+            assert n == 1, f"seed {seed}: rank {r} saw decision " \
+                           f"{key} {n} times"
+
+    # 4. no survivor-to-survivor delivery was lost while no failure
+    #    was in flight: ARQ + re-flood means every broadcast a
+    #    survivor initiated AFTER the kill settled reaches everyone
+    # (pre-kill traffic can legitimately be at-most-once if the dead
+    # rank was mid-forward, so only assert the exactly-once and
+    # termination invariants globally, plus ARQ quiescence:)
+    for r in survivors:
+        assert engines[r].arq_unacked() == 0, \
+            f"seed {seed}: rank {r} still has unacked frames"
+
+    # 5. the chaos actually exercised the machinery
+    assert world.dropped_cnt > 0
+    assert sum(e.arq_retransmits for e in engines) > 0
+
+
+# ---------------------------------------------------------------------------
+# Native C engine parity: the same ARQ state machine in rlo_engine.c
+# ---------------------------------------------------------------------------
+
+class TestNativeArqParity:
+    def _native(self):
+        pytest.importorskip("numpy")
+        from rlo_tpu.native import bindings as nb
+        try:
+            nb.load()
+        except Exception as exc:  # pragma: no cover - no cc in env
+            pytest.skip(f"native core unavailable: {exc}")
+        return nb
+
+    def test_native_dropped_frames_retransmit(self):
+        nb = self._native()
+        with nb.NativeWorld(8) as world:
+            engines = [nb.NativeEngine(world, r) for r in range(8)]
+            for e in engines:
+                e.enable_arq(500, max_retries=12)
+            for dst in range(1, 8):
+                world.drop_next(0, dst, 2)
+            engines[0].bcast(b"native-0")
+            engines[0].bcast(b"native-1")
+            world.drain(100_000_000)
+            for r in range(1, 8):
+                got = []
+                while (m := engines[r].pickup_next()) is not None:
+                    got.append(m.data)
+                assert sorted(got) == [b"native-0", b"native-1"]
+                assert engines[r].err == 0
+            assert sum(e.arq_retransmits for e in engines) >= 2
+            assert all(e.arq_unacked == 0 for e in engines)
+
+    def test_native_duplicates_dropped(self):
+        nb = self._native()
+        with nb.NativeWorld(4) as world:
+            engines = [nb.NativeEngine(world, r) for r in range(4)]
+            for e in engines:
+                e.enable_arq(500, max_retries=8)
+            for dst in range(1, 4):
+                world.dup_next(0, dst, 8)
+            engines[0].bcast(b"once")
+            world.drain(100_000_000)
+            for r in range(1, 4):
+                got = []
+                while (m := engines[r].pickup_next()) is not None:
+                    got.append(m.data)
+                assert got == [b"once"]
+            assert sum(e.arq_dup_drops for e in engines) >= 1
+
+    def test_native_dropped_vote_recovers(self):
+        nb = self._native()
+        import time
+        with nb.NativeWorld(8) as world:
+            engines = [nb.NativeEngine(world, r) for r in range(8)]
+            for e in engines:
+                e.enable_arq(500, max_retries=12)
+            world.drop_next(1, 0, 1)  # rank 1 is a leaf: its vote
+            rc = engines[0].submit_proposal(b"p", pid=4)
+            deadline = time.monotonic() + 10.0
+            while rc == -1 and time.monotonic() < deadline:
+                world.progress_all()
+                rc = engines[0].vote_my_proposal()
+            assert rc == 1
+            world.drain(100_000_000)
+
+
+def test_soak_without_kill_is_lossless():
+    """With faults but no rank kill, delivery is exactly-once AND
+    complete: every broadcast reaches every other rank."""
+    (world, engines, clock, dead, delivered, decisions, submitted,
+     sent) = run_soak(seed=11, kill_at=-1)
+    ws = len(engines)
+    assert not dead
+    for origin, data in sent:
+        for r in range(ws):
+            if r == origin:
+                continue
+            assert (origin, data) in delivered[r], \
+                f"rank {r} never saw {data!r} from {origin}"
+    for r in range(ws):
+        assert len(delivered[r]) == len(set(delivered[r]))
+    # every proposal terminated (completed or failed-by-deadline)
+    for proposer, pid in submitted:
+        st = engines[proposer].my_own_proposal.state
+        assert st in (ReqState.COMPLETED, ReqState.FAILED)

@@ -430,7 +430,7 @@ class TestRound5CostModels:
                                                 use_pallas=False),
             mesh, P("dcn", "ici"), P("dcn", "ici"))
         txt = f.lower(x).as_text()
-        total, n = _permute_total_bytes(txt)
+        total, n = _permute_total_bytes(txt, require=True)
         model = tc.hierarchical_allreduce_cost(wi, wd, per_shard * 4)
         assert total == model["ici_bytes"] \
             == 2 * (wi - 1) * (per_shard // wi) * 4
@@ -458,7 +458,8 @@ class TestRound5CostModels:
         model = tc.hierarchical_allreduce_cost(
             wi, wd, per_shard * 4, dcn_algorithm="int8")
         from rlo_tpu.utils.hlo import all_gather_operands
-        payload = [e for e, dt in all_gather_operands(txt) if dt == "i8"]
+        payload = [e for e, dt in all_gather_operands(txt, require=True)
+                   if dt == "i8"]
         assert payload and all(p == model["dcn_elems"]
                                for p in payload), payload
         # per-rank dcn bytes: (wd-1) int8 chunks + (wd-1) 4-byte scales
@@ -487,7 +488,7 @@ class TestRound5CostModels:
         txt = f.lower(x).as_text()
         from rlo_tpu.utils.hlo import permute_entries
         injected = hop_bytes = n = 0
-        for src, dst, nbytes in permute_entries(txt):
+        for src, dst, nbytes in permute_entries(txt, require=True):
             o = (dst - src) % WS
             injected += nbytes
             hop_bytes += o * nbytes
@@ -529,7 +530,7 @@ class TestRound5CostModels:
                                                 use_pallas=False),
             mesh, P("dcn", "ici"), P("dcn", "ici"))
         txt = f.lower(x).as_text()
-        total, n = _permute_total_bytes(txt)
+        total, n = _permute_total_bytes(txt, require=True)
         model = tc.hierarchical_allreduce_cost(wi, wd, per_shard * 4,
                                                ici_algorithm="ring")
         chunk = per_shard // wi * 4

@@ -36,6 +36,57 @@ class TestFrame:
         f = Frame(origin=5, pid=-1, vote=-2, payload=b"")
         assert Frame.decode(f.encode()).vote == -2
 
+    def test_seq_roundtrip(self):
+        # the ARQ link seq is a first-class header field
+        f = Frame(origin=2, pid=9, vote=0, payload=b"data", seq=41)
+        g = Frame.decode(f.encode())
+        assert g == f and g.seq == 41
+
+    def test_seq_defaults_unstamped(self):
+        assert Frame(origin=1).seq == -1
+        assert Frame.decode(Frame(origin=1).encode()).seq == -1
+
+    def test_restamp_seq_patches_in_place(self):
+        raw = Frame(origin=3, pid=4, vote=5, payload=b"xyz").encode()
+        out = wire.restamp_seq(raw, 1234)
+        g = Frame.decode(out)
+        assert (g.origin, g.pid, g.vote, g.payload, g.seq) == \
+            (3, 4, 5, b"xyz", 1234)
+        # only the seq bytes differ
+        assert out[:wire.SEQ_OFFSET] == raw[:wire.SEQ_OFFSET]
+        assert out[wire.SEQ_OFFSET + 4:] == raw[wire.SEQ_OFFSET + 4:]
+
+    def test_decode_empty_and_header_only_truncations(self):
+        with pytest.raises(ValueError):
+            Frame.decode(b"")
+        with pytest.raises(ValueError):
+            Frame.decode(b"\x00" * (wire.HEADER_SIZE - 1))
+
+    def test_decode_length_field_overrun_raises(self):
+        # a header whose data_len claims more payload than present
+        import struct
+        raw = struct.pack("<iiiiQ", 0, -1, -1, -1, 100) + b"short"
+        with pytest.raises(ValueError):
+            Frame.decode(raw)
+
+    def test_decode_ignores_trailing_garbage(self):
+        # transports deliver whole frames; extra bytes past data_len
+        # are not the payload's problem
+        raw = Frame(origin=1, payload=b"ok").encode() + b"JUNK"
+        assert Frame.decode(raw).payload == b"ok"
+
+    def test_unknown_tag_rejected_by_tag_enum(self):
+        # tags travel out-of-band; the enum is the validity gate
+        with pytest.raises(ValueError):
+            Tag(99)
+
+    def test_ack_and_abort_tags_exist_and_classify(self):
+        assert int(Tag.ACK) == 13 and int(Tag.ABORT) == 14
+        assert Tag.ABORT in wire.BCAST_TAGS      # store-and-forward
+        assert Tag.ACK not in wire.BCAST_TAGS    # point-to-point only
+        assert Tag.ACK in wire.ARQ_EXEMPT_TAGS   # never ARQ-tracked
+        assert Tag.HEARTBEAT in wire.ARQ_EXEMPT_TAGS
+
 
 class TestLoopback:
     def test_basic_delivery(self):
@@ -103,3 +154,47 @@ class TestLoopback:
     def test_unknown_backend(self):
         with pytest.raises(ValueError):
             make_world("nope", 4)
+
+    def test_dup_next_delivers_twice(self):
+        w = make_world("loopback", 2)
+        w.dup_next(0, 1, 1)
+        w.transport(0).isend(1, Tag.DATA, b"d")
+        w.transport(0).isend(1, Tag.DATA, b"e")  # past the window
+        t1 = w.transport(1)
+        got = [t1.poll()[2] for _ in range(3)]
+        assert got == [b"d", b"d", b"e"]
+        assert t1.poll() is None
+        assert w.duplicated_cnt == 1
+
+    def test_dup_next_preserves_fifo_under_latency(self):
+        w = make_world("loopback", 2, latency=4, seed=9)
+        w.dup_next(0, 1, 2)
+        for i in range(6):
+            w.transport(0).isend(1, Tag.DATA, bytes([i]))
+        got = []
+        t1 = w.transport(1)
+        for _ in range(10_000):
+            m = t1.poll()
+            if m:
+                got.append(m[2][0])
+            if len(got) == 8:
+                break
+        assert got == [0, 0, 1, 1, 2, 3, 4, 5]
+
+    def test_burst_loss_drops_consecutive_messages(self):
+        w = make_world("loopback", 2, seed=5)
+        w.set_burst_loss(1.0, 3)  # every message starts a burst
+        for i in range(3):
+            w.transport(0).isend(1, Tag.DATA, bytes([i]))
+        assert w.transport(1).poll() is None
+        assert w.dropped_cnt == 3
+        w.set_burst_loss(0.0)
+        w.transport(0).isend(1, Tag.DATA, b"ok")
+        assert w.transport(1).poll() == (0, Tag.DATA, b"ok")
+
+    def test_burst_loss_validates_args(self):
+        w = make_world("loopback", 2)
+        with pytest.raises(ValueError):
+            w.set_burst_loss(1.5)
+        with pytest.raises(ValueError):
+            w.set_burst_loss(0.5, 0)
