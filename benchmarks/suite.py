@@ -109,6 +109,14 @@ def bench_config1(tiny: bool) -> None:
             if r == 0 and abs(float(acc[0]) - float(want[0])) > 1e-3:
                 raise AssertionError(f"bad reduction: {acc[0]} vs {want[0]}")
     t_py = _wall_median(op_python, reps=reps)
+    # observability rides along (docs/DESIGN.md §7): re-run one rep
+    # with the metrics registry on (enabled AFTER timing so the
+    # accounting never pollutes the measured number) and emit the
+    # engine snapshot alongside the timing JSON
+    for e in engines:
+        e.enable_metrics()
+    op_python()
+    metrics_snap = engines[0].metrics()
     for e in engines:
         e.cleanup()
 
@@ -117,7 +125,11 @@ def bench_config1(tiny: bool) -> None:
     _emit(1, f"engine-substrate allreduce (bcast-gather over the rootless "
              f"overlay), {_fmt_bytes(n*4)} fp32, {ws} ranks, C core "
              f"(baseline = pure-Python engines, same algorithm)",
-          t_c * 1e6, "usec", t_py / t_c)
+          t_c * 1e6, "usec", t_py / t_c,
+          metrics_substrate="python-engines",
+          metrics_scope="links/histograms: one un-timed rep; "
+                        "counters: engine lifetime (all reps)",
+          metrics=metrics_snap)
 
     # ring vs bcast-gather, both substrates (rlo_coll.c vs the Python
     # coroutine Comm): the bandwidth-optimal 2*(ws-1) chunk rounds
@@ -382,13 +394,27 @@ def bench_config5(tiny: bool) -> None:
             world.drain()
             proposer.proposal_reset()
         dt = time.perf_counter() - t0
+        # observability rides along: one extra (un-timed) round with
+        # the C-side metrics registry on; the native rlo_engine_stats
+        # snapshot travels with the timing line
+        for e in engines:
+            e.enable_metrics()
+        rc = engines[0].submit_proposal(b"obs", pid=0)
+        if rc == -1:
+            world.drain()
+        engines[0].proposal_reset()
+        metrics_snap = engines[0].metrics()
     rate = rounds / dt
     print(f"config5: {rounds} IAR rounds in {dt*1e3:.1f} ms "
           f"({rate:.0f} ops/s)", file=sys.stderr)
     _emit(5, f"rootless leaderless consensus (IAR) throughput, {ws} ranks, "
              f"rotating proposer, C engine substrate (baseline = 1k ops/s "
              f"north-star target)",
-          rate, "ops/s", rate / 1000.0)
+          rate, "ops/s", rate / 1000.0,
+          metrics_substrate="native-c-engine",
+          metrics_scope="links/histograms: one un-timed round; "
+                        "counters: engine lifetime (all rounds)",
+          metrics=metrics_snap)
 
     # TPU-side decision step: the device pmin vote-merge round-trip on
     # real hardware, measured two ways (the 1k ops/s target needs a

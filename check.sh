@@ -34,6 +34,12 @@ echo "== MPI transport executed (femtompi mpirun) =="
 echo "== TCP transport executed (socket mesh) =="
 (cd rlo_tpu/native && ./tcprun -n 8 -t 240 ./rlo_demo -m 4 -b 65536)
 
+echo "== observability smoke (loopback soak -> chrome timeline) =="
+# 4-rank soak with tracing + metrics on and fault injection, per-rank
+# JSONL dumps merged to a Chrome trace-event file, schema validated
+# (flow edges included) — docs/DESIGN.md §7
+JAX_PLATFORMS=cpu python -m rlo_tpu.utils.timeline smoke
+
 echo "== manual-ring validation (8 virtual devices) =="
 PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
     XLA_FLAGS=--xla_force_host_platform_device_count=8 \

@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import enum
 import itertools
+import logging
 import struct
 import time
 from collections import deque
@@ -40,9 +41,20 @@ from typing import Callable, List, Optional, Sequence, Set
 
 from rlo_tpu import topology
 from rlo_tpu.transport.base import SendHandle, Transport
+from rlo_tpu.utils.metrics import Histogram, LinkStats
 from rlo_tpu.utils.tracing import TRACER, Ev
 from rlo_tpu.wire import (ARQ_EXEMPT_TAGS, BCAST_TAGS, Frame, MSG_SIZE_MAX,
                           Tag, restamp_seq)
+
+logger = logging.getLogger("rlo_tpu.engine")
+
+
+def _trace_ident(tag: int, frame: Frame) -> int:
+    """Correlation identity a trace event carries in its c field: the
+    per-origin exactly-once seq for Tag.BCAST (it travels in the vote
+    field), the pid for everything else (proposals/decisions/aborts
+    carry the round pid there; FAILURE notices the failed rank)."""
+    return frame.vote if tag == Tag.BCAST else frame.pid
 
 
 class ReqState(enum.IntEnum):
@@ -118,6 +130,13 @@ class _Msg:
     # FAILED and is abandoned instead of tracked forever
     deadline: Optional[float] = None
     state: ReqState = ReqState.IN_PROGRESS
+    # metrics stamps (None = metrics were off at the event — a None
+    # sentinel, not 0.0, so an injectable simulated clock starting at
+    # t=0 still records): initiation time of a locally-initiated bcast
+    # (op-latency histogram) and receipt time of a deliverable message
+    # (pickup-wait histogram)
+    born: Optional[float] = None
+    arrived: Optional[float] = None
 
     def sends_done(self) -> bool:
         return all(h.done() for h in self.send_handles)
@@ -131,6 +150,7 @@ class _ArqEntry:
     raw: bytes            # encoded frame, seq already stamped
     due: float            # next retransmit time
     retries: int = 0
+    sent: float = 0.0     # first-transmission time (RTT sampling)
 
 
 class EngineManager:
@@ -335,6 +355,9 @@ class ProgressEngine:
         self._tx_skip: dict = {}      # dst -> [given-up seq, next send]
         self._rx_seen: dict = {}      # src -> [contig, set(seqs > contig)]
         self._ack_due: Set[int] = set()  # srcs owed a cumulative ACK
+        # ARQ counters — part of the metrics registry snapshot
+        # (metrics()["counters"]); the attributes are the canonical
+        # storage and remain the public aliases PR-1 tests read
         self.arq_retransmits = 0
         self.arq_dup_drops = 0
         self.arq_gave_up = 0
@@ -342,6 +365,20 @@ class ProgressEngine:
         # op deadlines (net-new): ops complete or FAIL deterministically
         self.op_deadline = op_deadline
         self.ops_failed = 0
+
+        # metrics registry (docs/DESIGN.md §7): per-link frame/byte/
+        # retransmit/RTT accounting + op-latency histograms, snapshot
+        # via metrics(). Disabled by default — the hot-path cost of
+        # the disabled state is ONE branch per send/receive (the
+        # overhead contract); counters above are plain ints and always
+        # live. _mx_on gates everything that needs a clock read or a
+        # per-link dict access.
+        self._mx_on = False
+        self._mx_link: dict = {}          # peer -> LinkStats
+        self._h_bcast = Histogram()       # bcast init -> sends complete
+        self._h_prop = Histogram()        # proposal submit -> decision
+        self._h_pickup = Histogram()      # frame receipt -> pickup
+        self._prop_born: Optional[float] = None
 
         if members is not None:
             group = sorted(set(int(r) for r in members))
@@ -381,16 +418,39 @@ class ProgressEngine:
     # seq) / settled-(pid, gen) dedup absorbs view-change re-floods,
     # which travel with FRESH link seqs.
     # ------------------------------------------------------------------
+    def _link(self, peer: int) -> LinkStats:
+        ls = self._mx_link.get(peer)
+        if ls is None:
+            ls = self._mx_link[peer] = LinkStats()
+        return ls
+
+    def _isend_counted(self, dst: int, tag: int, raw: bytes) -> SendHandle:
+        """tx-accounted isend for the out-of-band paths (heartbeats,
+        ACKs, retransmits); fresh frames go through _send_raw, which
+        inlines the same accounting to keep the hot path one branch."""
+        if self._mx_on:
+            ls = self._link(dst)
+            ls.tx_frames += 1
+            ls.tx_bytes += len(raw)
+        return self.transport.isend(dst, int(tag), raw)
+
     def _send_raw(self, dst: int, tag: int, raw: bytes) -> SendHandle:
-        """The one gate every engine frame leaves through: stamps the
-        link seq and registers the retransmit entry when ARQ is on."""
+        """The one gate every fresh engine frame leaves through: stamps
+        the link seq and registers the retransmit entry when ARQ is
+        on; per-link tx accounting when metrics are on (one branch
+        when off — the §7 overhead contract)."""
+        if self._mx_on:
+            ls = self._link(dst)
+            ls.tx_frames += 1
+            ls.tx_bytes += len(raw)
         if self.arq_rto is None or tag in ARQ_EXEMPT_TAGS:
             return self.transport.isend(dst, int(tag), raw)
         seq = self._tx_seq.get(dst, 0)
         self._tx_seq[dst] = seq + 1
         raw = restamp_seq(raw, seq)
+        due = self.clock() + self.arq_rto
         self._tx_unacked.setdefault(dst, {})[seq] = _ArqEntry(
-            tag=int(tag), raw=raw, due=self.clock() + self.arq_rto)
+            tag=int(tag), raw=raw, due=due, sent=due - self.arq_rto)
         return self.transport.isend(dst, int(tag), raw)
 
     def _send(self, dst: int, tag: int, frame: Frame) -> SendHandle:
@@ -450,8 +510,14 @@ class ProgressEngine:
         q = self._tx_unacked.get(src)
         if not q:
             return
+        now = self.clock() if self._mx_on else 0.0
         for seq in [s for s in q if s <= cum]:
-            del q[seq]
+            ent = q.pop(seq)
+            if self._mx_on and ent.retries == 0:
+                # RTT sample from ack timing — never-retransmitted
+                # frames only (Karn's rule: a retransmitted frame's
+                # ack is ambiguous about which copy it answers)
+                self._link(src).rtt_sample((now - ent.sent) * 1e6)
 
     def _arq_tick(self) -> None:
         """Retransmit sweep: resend overdue unacked frames with
@@ -489,12 +555,14 @@ class ProgressEngine:
                 ent.retries += 1
                 ent.due = now + self.arq_rto * (2 ** ent.retries)
                 self.arq_retransmits += 1
+                if self._mx_on:
+                    self._link(dst).retransmits += 1
                 # same raw bytes, same seq: the receiver dedups
-                self.transport.isend(dst, ent.tag, ent.raw)
+                self._isend_counted(dst, ent.tag, ent.raw)
             sk = self._tx_skip.get(dst)
             if sk is not None and now >= sk[1] and \
                     all(s > sk[0] for s in q):
-                self.transport.isend(
+                self._isend_counted(
                     dst, int(Tag.ACK),
                     Frame(origin=self.rank, pid=sk[0], vote=-2).encode())
                 sk[1] = now + self.arq_rto
@@ -506,7 +574,7 @@ class ProgressEngine:
         for src in self._ack_due:
             if src in self.failed or src == self.rank:
                 continue
-            self.transport.isend(
+            self._isend_counted(
                 src, int(Tag.ACK),
                 Frame(origin=self.rank, vote=self._rx_cum(src)).encode())
         self._ack_due.clear()
@@ -514,6 +582,62 @@ class ProgressEngine:
     def arq_unacked(self) -> int:
         """Outstanding reliable frames not yet covered by an ACK."""
         return sum(len(q) for q in self._tx_unacked.values())
+
+    # ------------------------------------------------------------------
+    # Metrics registry (docs/DESIGN.md §7). Counter keys, nesting, and
+    # histogram layout are IDENTICAL to the C engine's rlo_engine_stats
+    # (bindings.NativeEngine.metrics()) — asserted by the metrics-parity
+    # test — so dashboards and tests consume one schema.
+    # ------------------------------------------------------------------
+    def enable_metrics(self, on: bool = True) -> None:
+        """Turn on per-link frame/byte/RTT accounting and op-latency
+        histograms. Off (the default), the residual cost is one branch
+        per send/receive; counters (ARQ, bcast/pickup totals) are plain
+        int increments and always live."""
+        self._mx_on = bool(on)
+
+    def metrics(self) -> dict:
+        """Snapshot the engine's metrics as a nested dict (JSON-ready):
+        ``counters`` (monotone totals incl. the ARQ counters),
+        ``queues`` (live depths; ``pickup`` + ``wait_and_pickup`` is
+        the pickup backlog), ``links`` (per-peer tx/rx frames+bytes,
+        retransmits, dup drops, ack-measured RTT EWMA; all peers
+        present, zeros when metrics are off), and ``op_latency_usec``
+        (bcast init->fan-out-complete, proposal submit->decision,
+        frame receipt->pickup)."""
+        links = {}
+        for peer in range(self.world_size):
+            if peer == self.rank:
+                continue
+            ls = self._mx_link.get(peer)
+            # string peer keys: the in-memory dict and its JSON
+            # round-trip (benchmarks emit snapshots) share one schema
+            links[str(peer)] = ls.snapshot() if ls is not None \
+                else LinkStats().snapshot()
+        return {
+            "counters": {
+                "sent_bcast": self.sent_bcast_cnt,
+                "recved_bcast": self.recved_bcast_cnt,
+                "total_pickup": self.total_pickup,
+                "ops_failed": self.ops_failed,
+                "arq_retransmits": self.arq_retransmits,
+                "arq_dup_drops": self.arq_dup_drops,
+                "arq_gave_up": self.arq_gave_up,
+                "arq_unacked": self.arq_unacked(),
+            },
+            "queues": {
+                "wait": len(self.queue_wait),
+                "pickup": len(self.queue_pickup),
+                "wait_and_pickup": len(self.queue_wait_and_pickup),
+                "iar_pending": len(self.queue_iar_pending),
+            },
+            "links": links,
+            "op_latency_usec": {
+                "bcast_complete": self._h_bcast.snapshot(),
+                "proposal_resolve": self._h_prop.snapshot(),
+                "pickup_wait": self._h_pickup.snapshot(),
+            },
+        }
 
     # ------------------------------------------------------------------
     # Rootless broadcast (~RLO_bcast_gen, rootless_ops.c:1581-1604)
@@ -558,11 +682,14 @@ class ProgressEngine:
             deadline = self.op_deadline
         if deadline is not None:
             msg.deadline = self.clock() + deadline
+        if self._mx_on and Tag(tag) == Tag.BCAST:
+            msg.born = self.clock()
         for dst in self._cur_initiator_targets():  # furthest-first
             msg.send_handles.append(self._send_raw(dst, int(tag), raw))
         self.queue_wait.append(msg)
         self.sent_bcast_cnt += 1
-        TRACER.emit(self.rank, Ev.BCAST_INIT, int(tag), len(payload))
+        TRACER.emit(self.rank, Ev.BCAST_INIT, int(tag), len(payload),
+                    _trace_ident(Tag(tag), frame))
         self.manager.progress_all()
         return msg
 
@@ -606,7 +733,9 @@ class ProgressEngine:
         p.decision_handles = []
         p.decision_pending = False
         self.my_proposal_payload = bytes(proposal)
-        TRACER.emit(self.rank, Ev.PROPOSAL_SUBMIT, pid)
+        if self._mx_on:
+            self._prop_born = self.clock()
+        TRACER.emit(self.rank, Ev.PROPOSAL_SUBMIT, pid, 0, p.gen)
         # the proposal frame's vote field carries the round generation
         # (the reference leaves it at the initial vote 1, :888)
         self.bcast(proposal, tag=Tag.IAR_PROPOSAL, pid=pid, vote=p.gen)
@@ -644,16 +773,21 @@ class ProgressEngine:
             msg = self.queue_wait_and_pickup.pop(0)
             msg.pickup_done = True
             self.queue_wait.append(msg)  # keep tracking its forwards
-            self.total_pickup += 1
-            TRACER.emit(self.rank, Ev.DELIVER, msg.tag, msg.frame.origin)
-            return self._to_user(msg)
+            return self._deliver(msg)
         if self.queue_pickup:
             msg = self.queue_pickup.popleft()
             msg.pickup_done = True
-            self.total_pickup += 1
-            TRACER.emit(self.rank, Ev.DELIVER, msg.tag, msg.frame.origin)
-            return self._to_user(msg)
+            return self._deliver(msg)
         return None
+
+    def _deliver(self, msg: _Msg) -> UserMsg:
+        self.total_pickup += 1
+        if msg.arrived is not None:
+            self._h_pickup.observe((self.clock() - msg.arrived) * 1e6)
+        if TRACER.enabled:
+            TRACER.emit(self.rank, Ev.DELIVER, msg.tag, msg.frame.origin,
+                        _trace_ident(msg.tag, msg.frame), msg.src)
+        return self._to_user(msg)
 
     @staticmethod
     def _to_user(msg: _Msg) -> UserMsg:
@@ -673,6 +807,10 @@ class ProgressEngine:
             if all(h.done() for h in p.decision_handles):
                 p.state = ReqState.COMPLETED
                 p.decision_pending = False
+                if self._prop_born is not None:
+                    self._h_prop.observe(
+                        (self.clock() - self._prop_born) * 1e6)
+                    self._prop_born = None
         if (p.state == ReqState.IN_PROGRESS and not p.decision_pending
                 and p.deadline is not None
                 and self.clock() > p.deadline):
@@ -692,6 +830,12 @@ class ProgressEngine:
                 # different ring successors)
                 self._hb_seen[src] = self.clock()
             msg = _Msg(frame=Frame.decode(raw), tag=tag, src=src)
+            if self._mx_on:
+                if 0 <= src < self.world_size:
+                    ls = self._link(src)
+                    ls.rx_frames += 1
+                    ls.rx_bytes += len(raw)
+                msg.arrived = self.clock()
             if tag == Tag.ACK:
                 if msg.frame.vote == -2 and msg.frame.pid >= 0:
                     # SKIP notice: the sender gave up on everything
@@ -709,6 +853,8 @@ class ProgressEngine:
                 self._ack_due.add(src)
                 if self._rx_is_dup(src, msg.frame.seq):
                     self.arq_dup_drops += 1
+                    if self._mx_on:
+                        self._link(src).dup_drops += 1
                     continue
             if tag == Tag.BCAST:
                 self.recved_bcast_cnt += 1
@@ -773,6 +919,10 @@ class ProgressEngine:
                 msg.fwd_done = True
                 if msg.state == ReqState.IN_PROGRESS:
                     msg.state = ReqState.COMPLETED
+                if msg.born is not None:
+                    # locally-initiated bcast: init -> fan-out complete
+                    self._h_bcast.observe(
+                        (self.clock() - msg.born) * 1e6)
                 self.queue_wait.remove(msg)
             elif msg.deadline is not None and self.clock() > msg.deadline:
                 # op deadline: stop tracking — the op FAILED
@@ -816,8 +966,12 @@ class ProgressEngine:
             if raw is None:
                 raw = msg.frame.encode()
             msg.send_handles.append(self._send_raw(dst, msg.tag, raw))
-        if targets:
-            TRACER.emit(self.rank, Ev.BCAST_FWD, msg.tag, len(targets))
+        # receipt+forward step — emitted even for leaf receipts (zero
+        # targets) so the timeline merger always has a receive-side
+        # anchor carrying (origin, identity, immediate sender)
+        if TRACER.enabled:
+            TRACER.emit(self.rank, Ev.BCAST_FWD, msg.tag, origin,
+                        _trace_ident(msg.tag, msg.frame), msg.src)
 
         if msg.tag == Tag.IAR_PROPOSAL:
             # proposals are engine-internal: parked for the decision, never
@@ -851,7 +1005,7 @@ class ProgressEngine:
         frame = Frame(origin=self.rank, pid=ps.pid, vote=int(vote),
                       payload=struct.pack("<i", ps.gen))
         self._send(ps.recv_from, int(Tag.IAR_VOTE), frame)
-        TRACER.emit(self.rank, Ev.VOTE, ps.pid, int(vote))
+        TRACER.emit(self.rank, Ev.VOTE, ps.pid, int(vote), ps.gen)
 
     def _resolve_relay(self, ps: ProposalState) -> None:
         """The relay's merged vote is final: send it to the vote-tree
@@ -1004,7 +1158,7 @@ class ProgressEngine:
                          pid=p.pid, vote=p.vote)
         p.decision_handles = list(msg.send_handles)
         p.decision_pending = True
-        TRACER.emit(self.rank, Ev.DECISION, p.pid, p.vote)
+        TRACER.emit(self.rank, Ev.DECISION, p.pid, p.vote, p.gen)
 
     def _abort_own_proposal(self, p: ProposalState) -> None:
         """Deadline expired with votes still outstanding: the round
@@ -1017,7 +1171,8 @@ class ProgressEngine:
         topology."""
         p.state = ReqState.FAILED
         self.ops_failed += 1
-        TRACER.emit(self.rank, Ev.DECISION, p.pid, -1)
+        self._prop_born = None  # resolve latency tracks successes only
+        TRACER.emit(self.rank, Ev.DECISION, p.pid, -1, p.gen)
         self.bcast(struct.pack("<i", p.gen), tag=Tag.ABORT, pid=p.pid)
 
     def _on_abort(self, msg: _Msg) -> None:
@@ -1172,7 +1327,7 @@ class ProgressEngine:
             hb_payload = (struct.pack("<i", self._rx_cum(succ))
                           if self.arq_rto is not None else b"")
             frame = Frame(origin=self.rank, payload=hb_payload)
-            self.transport.isend(succ, int(Tag.HEARTBEAT), frame.encode())
+            self._isend_counted(succ, int(Tag.HEARTBEAT), frame.encode())
             self._hb_last_sent = now
             TRACER.emit(self.rank, Ev.HEARTBEAT, succ)
         seen = self._hb_seen.setdefault(pred, now)  # grace on first watch
@@ -1185,9 +1340,21 @@ class ProgressEngine:
         every alive rank (belt and braces: overlay forwarding can have
         holes while membership views are still converging; duplicate
         notices are suppressed at the receiver)."""
+        # capture the evidence BEFORE _mark_failed clears the slot: the
+        # last-seen heartbeat age is what makes a false-positive
+        # declaration diagnosable after the fact
+        seen = self._hb_seen.get(rank)
+        age = (self.clock() - seen) if seen is not None else float("inf")
         if not self._mark_failed(rank):
             return
-        TRACER.emit(self.rank, Ev.FAILURE, rank, 1)
+        age_usec = (min(int(age * 1e6), 2**31 - 1)
+                    if age != float("inf") else 2**31 - 1)
+        logger.warning(
+            "rank %d declaring rank %d FAILED: no heartbeat for "
+            "%.1f ms (timeout %.1f ms, interval %.1f ms, alive now %s)",
+            self.rank, rank, age * 1e3, self.failure_timeout * 1e3,
+            self.heartbeat_interval * 1e3, self._alive)
+        TRACER.emit(self.rank, Ev.FAILURE, rank, 1, age_usec)
         self.bcast(b"", tag=Tag.FAILURE, pid=rank)
         frame = Frame(origin=self.rank, pid=rank)
         raw = frame.encode()

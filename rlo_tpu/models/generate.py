@@ -25,6 +25,7 @@ the same weights — pinned by a logits-parity test against the training
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Optional, Tuple
 
 import jax
@@ -585,6 +586,22 @@ def generate(params: dict, prompt, cfg: TransformerConfig, *,
     padding cannot consume expert capacity (see prefill); per-row
     parity then holds under the same capacity_factor >= n_experts
     condition as MoE decode."""
+    logits, cache, pos0 = _generate_prefill(
+        params, prompt, cfg, max_new=max_new, max_len=max_len,
+        temperature=temperature, rng=rng, tp_axis=tp_axis,
+        ep_axis=ep_axis, prompt_lengths=prompt_lengths)
+    keys = (jax.random.split(rng, max_new) if rng is not None
+            else jnp.zeros((max_new, 2), jnp.uint32))
+    return _generate_decode(params, logits, cache, pos0, cfg, keys,
+                            temperature, tp_axis, ep_axis)
+
+
+def _generate_prefill(params, prompt, cfg, *, max_new, max_len,
+                      temperature, rng, tp_axis, ep_axis,
+                      prompt_lengths):
+    """generate()'s argument checks + cache init + prefill; returns
+    (logits, cache, pos0). Shared with generate_timed so the timed
+    variant can never drift from the jitted one."""
     b, plen = prompt.shape
     max_len = max_len or (plen + max_new)
     if plen + max_new > max_len:
@@ -604,22 +621,70 @@ def generate(params: dict, prompt, cfg: TransformerConfig, *,
         logits, cache = prefill(params, prompt, cache, cfg,
                                 tp_axis=tp_axis, ep_axis=ep_axis,
                                 last_index=lengths - 1)
+    return logits, cache, pos0
 
-    def pick(logits, key):
-        if temperature == 0:
-            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        return jax.random.categorical(
-            key, logits / temperature, axis=-1).astype(jnp.int32)
 
-    keys = (jax.random.split(rng, max_new) if rng is not None
-            else jnp.zeros((max_new, 2), jnp.uint32))
+def _pick_token(logits, key, temperature: float):
+    if temperature == 0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return jax.random.categorical(
+        key, logits / temperature, axis=-1).astype(jnp.int32)
 
+
+def _generate_decode(params, logits, cache, pos0, cfg, keys,
+                     temperature, tp_axis, ep_axis):
+    """generate()'s decode loop: one lax.scan over the new positions."""
     def step(carry, key):
         logits, cache, pos = carry
-        tok = pick(logits, key)
+        tok = _pick_token(logits, key, temperature)
         logits, cache = decode_step(params, tok, pos, cache, cfg,
                                     tp_axis=tp_axis, ep_axis=ep_axis)
         return (logits, cache, pos + 1), tok
 
     (_, _, _), toks = lax.scan(step, (logits, cache, pos0), keys)
     return jnp.transpose(toks)  # (b, max_new)
+
+
+def generate_timed(params: dict, prompt, cfg: TransformerConfig, *,
+                   max_new: int, max_len: Optional[int] = None,
+                   temperature: float = 0.0,
+                   rng: Optional[jax.Array] = None,
+                   prompt_lengths=None, metrics=None):
+    """`generate` with serving telemetry: identical tokens, plus TTFT
+    (call -> first token materialized on the host, ``serve.ttft_usec``)
+    and per-token decode latency (``serve.tok_usec``) recorded into
+    ``metrics`` — default the process-wide ``metrics.SERVING``
+    registry, the same one ``DecodeServer`` records into, so one
+    snapshot covers both serving paths.
+
+    Eager by design (the host round-trips after prefill and after the
+    scan are the measurement points); inside jit use plain
+    ``generate``. The first token is computed once here for the TTFT
+    stamp and recomputed inside the scan — picks are deterministic
+    functions of (logits, key), so outputs equal ``generate`` exactly
+    (pinned by test)."""
+    from rlo_tpu.utils.metrics import SERVING
+    reg = SERVING if metrics is None else metrics
+    t0 = time.perf_counter()
+    logits, cache, pos0 = _generate_prefill(
+        params, prompt, cfg, max_new=max_new, max_len=max_len,
+        temperature=temperature, rng=rng, tp_axis=None, ep_axis=None,
+        prompt_lengths=prompt_lengths)
+    keys = (jax.random.split(rng, max_new) if rng is not None
+            else jnp.zeros((max_new, 2), jnp.uint32))
+    if max_new > 0:  # max_new=0: no first token exists to stamp
+        jax.block_until_ready(
+            _pick_token(logits, keys[0], temperature))
+        t1 = time.perf_counter()
+        reg.histogram("serve.ttft_usec").observe((t1 - t0) * 1e6)
+    else:
+        t1 = time.perf_counter()
+    toks = _generate_decode(params, logits, cache, pos0, cfg, keys,
+                            temperature, None, None)
+    jax.block_until_ready(toks)
+    if max_new > 0:
+        t2 = time.perf_counter()
+        reg.histogram("serve.tok_usec").observe(
+            (t2 - t1) * 1e6 / max_new)
+        reg.counter("serve.tokens_out").inc(int(toks.shape[0]) * max_new)
+    return toks

@@ -35,6 +35,7 @@ batching is a scheduling change, not a numerics change.
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import List, Optional, Tuple
 
 import jax
@@ -45,6 +46,7 @@ from jax import lax
 from rlo_tpu.models.generate import (decode_step, init_kv_cache,
                                      prefill, _decode_cfg)
 from rlo_tpu.models.transformer import TransformerConfig
+from rlo_tpu.utils.metrics import Registry, SERVING
 
 
 @dataclasses.dataclass
@@ -71,11 +73,22 @@ class DecodeServer:
     submit() queues requests; run() drives rounds until every request
     completes and returns the per-request token arrays in submission
     order. step_round() is the unit the throughput bench times.
+
+    Serving telemetry (docs/DESIGN.md §7) records into ``metrics``
+    (default: the process-wide ``metrics.SERVING`` registry, shared
+    with ``generate_timed``): TTFT (submit -> first token,
+    ``serve.ttft_usec``), admission-queue wait
+    (``serve.queue_wait_usec``), per-round and per-token decode
+    latency (``serve.round_usec`` / ``serve.tok_usec``), batch
+    occupancy per round (``serve.occupancy_pct``), request/token
+    counters, and live queue-depth gauges. ``stats()`` snapshots it.
     """
 
     def __init__(self, params, cfg: TransformerConfig, *,
                  n_slots: int, max_len: int, round_len: int = 32,
-                 prompt_buckets: Tuple[int, ...] = (64, 256, 1024)):
+                 prompt_buckets: Tuple[int, ...] = (64, 256, 1024),
+                 metrics: Optional[Registry] = None):
+        self.metrics = SERVING if metrics is None else metrics
         self.params = params
         self.cfg = cfg
         self.n_slots = n_slots
@@ -95,6 +108,7 @@ class DecodeServer:
         self._queue: List[Tuple[int, Request]] = []
         self._out: List[Optional[List[int]]] = []
         self._eos: List[Optional[int]] = []
+        self._submit_ts: dict = {}  # rid -> submit time (perf_counter)
         self.rounds_run = 0
         self.steps_run = 0
 
@@ -154,6 +168,9 @@ class DecodeServer:
         self._queue.append((rid, Request(prompt, max_new, eos_id)))
         self._out.append(None)
         self._eos.append(eos_id)
+        self._submit_ts[rid] = time.perf_counter()
+        self.metrics.counter("serve.requests_submitted").inc()
+        self.metrics.gauge("serve.queue_depth").set(len(self._queue))
         return rid
 
     def _admit(self) -> int:
@@ -169,6 +186,11 @@ class DecodeServer:
                 slot += 1
                 continue
             rid, req = self._queue.pop(0)
+            t_sub = self._submit_ts.pop(rid, None)
+            now = time.perf_counter()
+            if t_sub is not None:
+                self.metrics.histogram("serve.queue_wait_usec").observe(
+                    (now - t_sub) * 1e6)
             plen = len(req.prompt)
             bucket = _bucket(plen, self.buckets)
             padded = np.zeros((1, bucket), np.int32)
@@ -179,6 +201,13 @@ class DecodeServer:
             self.cache = self._scatter(self.cache, row,
                                        jnp.int32(slot))
             first = int(np.asarray(first)[0])
+            if t_sub is not None:
+                # first token is materialized on the host here: TTFT
+                # = submit -> first token (queue wait included)
+                self.metrics.histogram("serve.ttft_usec").observe(
+                    (time.perf_counter() - t_sub) * 1e6)
+            self.metrics.counter("serve.tokens_out").inc()
+            self.metrics.gauge("serve.queue_depth").set(len(self._queue))
             self.req_of_slot[slot] = rid
             self._out[rid] = [first]
             self.pos[slot] = plen
@@ -199,6 +228,7 @@ class DecodeServer:
             return
         if self.budget[slot] <= 0:
             self.req_of_slot[slot] = None
+            self.metrics.counter("serve.requests_completed").inc()
 
     # ---- the decode loop --------------------------------------------
     def step_round(self):
@@ -207,6 +237,8 @@ class DecodeServer:
         completed = self._admit()
         if all(r is None for r in self.req_of_slot):
             return completed > 0
+        active = sum(1 for r in self.req_of_slot if r is not None)
+        t0 = time.perf_counter()
         tok, pos, cache, toks = self._round(
             self.params, self.cache, jnp.asarray(self.last_tok),
             jnp.asarray(self.pos), self.round_len)
@@ -214,8 +246,17 @@ class DecodeServer:
         toks = np.asarray(toks)
         self.last_tok = np.asarray(tok).copy()
         self.pos = np.asarray(pos).copy()
+        dt = time.perf_counter() - t0  # toks materialized: round done
+        self.metrics.histogram("serve.round_usec").observe(dt * 1e6)
+        self.metrics.histogram("serve.tok_usec").observe(
+            dt * 1e6 / self.round_len)
+        self.metrics.histogram("serve.occupancy_pct").observe(
+            100.0 * active / self.n_slots)
+        self.metrics.counter("serve.rounds").inc()
+        self.metrics.counter("serve.steps").inc(self.round_len)
         self.rounds_run += 1
         self.steps_run += self.round_len
+        tokens_out = self.metrics.counter("serve.tokens_out")
         for slot in range(self.n_slots):
             rid = self.req_of_slot[slot]
             if rid is None:
@@ -229,6 +270,7 @@ class DecodeServer:
             else:
                 self.budget[slot] -= take
             self._out[rid].extend(seq)
+            tokens_out.inc(len(seq))
             self._retire_if_done(slot)
         return True
 
@@ -240,3 +282,8 @@ class DecodeServer:
             if not progressed and self._queue:  # pragma: no cover
                 raise RuntimeError("queue stuck with no free slots")
         return [np.asarray(o, np.int32) for o in self._out]
+
+    def stats(self) -> dict:
+        """Serving-telemetry snapshot (the registry's nested dict) —
+        what benchmarks/suite.py emits alongside its timing JSON."""
+        return self.metrics.snapshot()

@@ -54,7 +54,49 @@ class _EngineState(C.Structure):
 class _TraceEvent(C.Structure):
     """Mirror of rlo_trace_event (rlo_core.h)."""
     _fields_ = [("ts_usec", C.c_uint64), ("rank", C.c_int32),
-                ("kind", C.c_int32), ("a", C.c_int32), ("b", C.c_int32)]
+                ("kind", C.c_int32), ("a", C.c_int32), ("b", C.c_int32),
+                ("c", C.c_int32), ("d", C.c_int32)]
+
+
+HIST_BUCKETS = 28  # mirror of RLO_HIST_BUCKETS (rlo_core.h)
+
+
+class _Hist(C.Structure):
+    """Mirror of rlo_hist (rlo_core.h) — same layout as the snapshot
+    of rlo_tpu.utils.metrics.Histogram."""
+    _fields_ = [("count", C.c_int64), ("sum", C.c_double),
+                ("min", C.c_double), ("max", C.c_double),
+                ("buckets", C.c_int64 * HIST_BUCKETS)]
+
+    def to_dict(self) -> dict:
+        return {"count": self.count, "sum": self.sum,
+                "min": self.min, "max": self.max,
+                "buckets": list(self.buckets)}
+
+
+class _LinkStats(C.Structure):
+    """Mirror of rlo_link_stats (rlo_core.h)."""
+    _fields_ = [("tx_frames", C.c_int64), ("tx_bytes", C.c_int64),
+                ("rx_frames", C.c_int64), ("rx_bytes", C.c_int64),
+                ("retransmits", C.c_int64), ("dup_drops", C.c_int64),
+                ("rtt_ewma_usec", C.c_double)]
+
+    def to_dict(self) -> dict:
+        return {f: getattr(self, f) for f, _ in self._fields_}
+
+
+class _Stats(C.Structure):
+    """Mirror of rlo_stats (rlo_core.h)."""
+    _fields_ = [("sent_bcast", C.c_int64), ("recved_bcast", C.c_int64),
+                ("total_pickup", C.c_int64), ("ops_failed", C.c_int64),
+                ("arq_retransmits", C.c_int64),
+                ("arq_dup_drops", C.c_int64),
+                ("arq_gave_up", C.c_int64), ("arq_unacked", C.c_int64),
+                ("q_wait", C.c_int64), ("q_pickup", C.c_int64),
+                ("q_wait_and_pickup", C.c_int64),
+                ("q_iar_pending", C.c_int64),
+                ("bcast_complete", _Hist), ("proposal_resolve", _Hist),
+                ("pickup_wait", _Hist)]
 
 _lib = None
 
@@ -106,6 +148,11 @@ def load() -> C.CDLL:
     sig("rlo_engine_arq_retransmits", C.c_int64, [p])
     sig("rlo_engine_arq_dup_drops", C.c_int64, [p])
     sig("rlo_engine_arq_unacked", C.c_int64, [p])
+    sig("rlo_engine_arq_gave_up", C.c_int64, [p])
+    sig("rlo_engine_enable_metrics", C.c_int, [p, C.c_int])
+    sig("rlo_engine_stats", C.c_int, [p, C.POINTER(_Stats)])
+    sig("rlo_engine_link_stats", C.c_int,
+        [p, C.POINTER(_LinkStats), C.c_int])
     sig("rlo_engine_enable_failure_detection", C.c_int,
         [p, C.c_uint64, C.c_uint64])
     sig("rlo_engine_rank_failed", C.c_int, [p, C.c_int])
@@ -168,8 +215,10 @@ def load() -> C.CDLL:
     sig("rlo_now_usec", C.c_uint64, [])
     sig("rlo_trace_set", None, [C.c_int])
     sig("rlo_trace_enabled", C.c_int, [])
+    sig("rlo_trace_emit", None, [C.c_int] * 6)
     sig("rlo_trace_drain", C.c_int, [C.POINTER(_TraceEvent), C.c_int])
     sig("rlo_trace_dropped", C.c_int64, [])
+    sig("rlo_trace_capacity", C.c_int, [])
     sig("rlo_trace_clear", None, [])
     _lib = lib
     return lib
@@ -586,6 +635,60 @@ class NativeEngine:
     def arq_unacked(self) -> int:
         return self._lib.rlo_engine_arq_unacked(self._e)
 
+    @property
+    def arq_gave_up(self) -> int:
+        return self._lib.rlo_engine_arq_gave_up(self._e)
+
+    def enable_metrics(self, on: bool = True) -> None:
+        """Per-link frame/byte/RTT accounting + op-latency histograms
+        (mirror of ProgressEngine.enable_metrics; one branch per
+        send/receive when off)."""
+        rc = self._lib.rlo_engine_enable_metrics(self._e, 1 if on else 0)
+        if rc != 0:
+            raise RuntimeError(f"enable_metrics failed ({rc})")
+
+    def metrics(self) -> dict:
+        """Drain rlo_engine_stats / rlo_engine_link_stats into the
+        SAME nested-dict schema as ProgressEngine.metrics() — counter
+        keys, nesting, and histogram layout are identical by
+        construction (asserted by the metrics-parity test)."""
+        st = _Stats()
+        rc = self._lib.rlo_engine_stats(self._e, C.byref(st))
+        if rc != 0:
+            raise RuntimeError(f"rlo_engine_stats failed ({rc})")
+        ws = self.world_size
+        arr = (_LinkStats * ws)()
+        rc = self._lib.rlo_engine_link_stats(self._e, arr, ws)
+        if rc < 0:
+            raise RuntimeError(f"rlo_engine_link_stats failed ({rc})")
+        return {
+            "counters": {
+                "sent_bcast": st.sent_bcast,
+                "recved_bcast": st.recved_bcast,
+                "total_pickup": st.total_pickup,
+                "ops_failed": st.ops_failed,
+                "arq_retransmits": st.arq_retransmits,
+                "arq_dup_drops": st.arq_dup_drops,
+                "arq_gave_up": st.arq_gave_up,
+                "arq_unacked": st.arq_unacked,
+            },
+            "queues": {
+                "wait": st.q_wait,
+                "pickup": st.q_pickup,
+                "wait_and_pickup": st.q_wait_and_pickup,
+                "iar_pending": st.q_iar_pending,
+            },
+            # string peer keys: identical schema in memory and through
+            # a JSON round-trip (mirror of ProgressEngine.metrics())
+            "links": {str(peer): arr[peer].to_dict()
+                      for peer in range(ws) if peer != self.rank},
+            "op_latency_usec": {
+                "bcast_complete": st.bcast_complete.to_dict(),
+                "proposal_resolve": st.proposal_resolve.to_dict(),
+                "pickup_wait": st.pickup_wait.to_dict(),
+            },
+        }
+
     def rank_failed(self, rank: int) -> bool:
         return bool(self._lib.rlo_engine_rank_failed(self._e, rank))
 
@@ -781,11 +884,24 @@ def trace_dropped() -> int:
     return load().rlo_trace_dropped()
 
 
+def trace_capacity() -> int:
+    return load().rlo_trace_capacity()
+
+
+def trace_emit(rank: int, kind: int, a: int = 0, b: int = 0,
+               c: int = 0, d: int = 0) -> None:
+    """Emit one event into the native ring (test support — the C
+    engine emits its own protocol events)."""
+    load().rlo_trace_emit(rank, int(kind), a, b, c, d)
+
+
 def trace_drain(max_events: int = 65536):
-    """Drain native trace events as dicts matching Event.to_dict()."""
+    """Drain native trace events as dicts matching Event.to_dict() —
+    the per-rank dump schema rlo_tpu/utils/timeline.py merges."""
     from rlo_tpu.utils.tracing import Ev
     buf = (_TraceEvent * max_events)()
     n = load().rlo_trace_drain(buf, max_events)
     return [{"ts_usec": buf[i].ts_usec, "rank": buf[i].rank,
-             "kind": Ev(buf[i].kind).name, "a": buf[i].a, "b": buf[i].b}
+             "kind": Ev(buf[i].kind).name, "a": buf[i].a, "b": buf[i].b,
+             "c": buf[i].c, "d": buf[i].d}
             for i in range(n)]

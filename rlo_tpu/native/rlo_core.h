@@ -310,11 +310,63 @@ int64_t rlo_engine_arq_retransmits(const rlo_engine *e);
 int64_t rlo_engine_arq_dup_drops(const rlo_engine *e);
 /* outstanding reliable frames not yet covered by an ACK */
 int64_t rlo_engine_arq_unacked(const rlo_engine *e);
+/* frames the ARQ layer abandoned after max_retries (skip notices) */
+int64_t rlo_engine_arq_gave_up(const rlo_engine *e);
 /* 1 when this engine has marked `rank` failed */
 int rlo_engine_rank_failed(const rlo_engine *e, int rank);
 int rlo_engine_failed_count(const rlo_engine *e);
 /* 1 when a FAILURE notice about THIS rank arrived (false positive) */
 int rlo_engine_suspected_self(const rlo_engine *e);
+
+/* ------------------------------------------------------------------ */
+/* Metrics registry (rlo_stats) — native twin of ProgressEngine        */
+/* metrics() (rlo_tpu/utils/metrics.py; docs/DESIGN.md §7). Counter    */
+/* keys, nesting, and histogram layout are kept IDENTICAL across the   */
+/* two engines (bindings.py assembles the same nested dict), asserted  */
+/* by the metrics-parity test. Collection of per-link accounting and   */
+/* latency histograms is opt-in (rlo_engine_enable_metrics); when off, */
+/* the residual hot-path cost is one branch per send/receive — plain   */
+/* counters (ARQ totals, bcast/pickup counts) are always live.         */
+/* ------------------------------------------------------------------ */
+
+/* log2 latency histogram: bucket i counts samples whose integer part
+ * has bit_length i (i.e. [2^(i-1), 2^i) usec); bucket 0 is <= 0, the
+ * last bucket absorbs overflow. Mirror of metrics.Histogram. */
+#define RLO_HIST_BUCKETS 28
+typedef struct rlo_hist {
+    int64_t count;
+    double sum, min, max;
+    int64_t buckets[RLO_HIST_BUCKETS];
+} rlo_hist;
+
+/* per-peer link accounting: frames/bytes both ways, retransmits,
+ * duplicate drops, and an RTT EWMA measured from ARQ ack timing
+ * (first-transmission frames only — Karn's rule — smoothed 1/8).
+ * Mirror of metrics.LinkStats. */
+typedef struct rlo_link_stats {
+    int64_t tx_frames, tx_bytes, rx_frames, rx_bytes;
+    int64_t retransmits, dup_drops;
+    double rtt_ewma_usec; /* 0 = unmeasured */
+} rlo_link_stats;
+
+/* engine-level snapshot: counters + live queue depths (q_pickup +
+ * q_wait_and_pickup = the pickup backlog) + op-latency histograms
+ * (bcast init -> fan-out complete, proposal submit -> decision,
+ * frame receipt -> pickup). ops_failed is always 0 in the C engine
+ * (op deadlines are Python-side); the key exists for schema parity. */
+typedef struct rlo_stats {
+    int64_t sent_bcast, recved_bcast, total_pickup, ops_failed;
+    int64_t arq_retransmits, arq_dup_drops, arq_gave_up, arq_unacked;
+    int64_t q_wait, q_pickup, q_wait_and_pickup, q_iar_pending;
+    rlo_hist bcast_complete, proposal_resolve, pickup_wait;
+} rlo_stats;
+
+int rlo_engine_enable_metrics(rlo_engine *e, int on);
+int rlo_engine_stats(const rlo_engine *e, rlo_stats *out);
+/* Fills out[0..min(cap, world_size)-1] (out[rank] for this engine's
+ * own rank stays zeroed); returns world_size or RLO_ERR_ARG. */
+int rlo_engine_link_stats(const rlo_engine *e, rlo_link_stats *out,
+                          int cap);
 
 /* ------------------------------------------------------------------ */
 /* Engine snapshot/restore (mirror of the checkpoint subsystem's        */
@@ -432,33 +484,45 @@ uint64_t rlo_now_usec(void);
 /* (rlo_tpu/utils/tracing.py); disabled by default — one branch per     */
 /* emit when off. Process-local ring; oldest events drop when full.     */
 /* ------------------------------------------------------------------ */
+/* Field semantics are shared with the Python tracer (tracing.Ev); the
+ * c/d fields carry the correlation identity the cross-rank timeline
+ * merger (rlo_tpu/utils/timeline.py) keys on: identity = the
+ * per-origin exactly-once seq for BCAST frames, the pid for IAR /
+ * FAILURE / ABORT traffic; d = the immediate sender (what turns
+ * per-rank logs into send->recv flow edges). */
 enum rlo_ev {
-    RLO_EV_BCAST_INIT = 1, /* a = tag, b = payload len */
-    RLO_EV_BCAST_FWD = 2,  /* a = tag, b = #targets */
-    RLO_EV_DELIVER = 3,    /* a = tag, b = origin */
-    RLO_EV_PROPOSAL_SUBMIT = 4, /* a = pid */
+    RLO_EV_BCAST_INIT = 1, /* a = tag, b = payload len, c = seq/pid */
+    RLO_EV_BCAST_FWD = 2,  /* receipt+forward step (emitted even for
+                            * zero-target leaf receipts): a = tag,
+                            * b = origin, c = seq/pid, d = sender */
+    RLO_EV_DELIVER = 3,    /* a = tag, b = origin, c = seq/pid,
+                            * d = sender */
+    RLO_EV_PROPOSAL_SUBMIT = 4, /* a = pid, c = round generation */
     RLO_EV_JUDGE = 5,      /* a = pid of the judged proposal, b = verdict */
-    RLO_EV_VOTE = 6,       /* a = pid, b = merged vote */
-    RLO_EV_DECISION = 7,   /* a = pid, b = decision */
+    RLO_EV_VOTE = 6,       /* a = pid, b = merged vote, c = generation */
+    RLO_EV_DECISION = 7,   /* a = pid, b = decision, c = generation */
     RLO_EV_DRAIN = 8,      /* a = spins */
     RLO_EV_HEARTBEAT = 9,  /* a = destination rank */
-    RLO_EV_FAILURE = 10,   /* a = failed rank, b = 1 local / 0 learned */
+    RLO_EV_FAILURE = 10,   /* a = failed rank, b = 1 local / 0 learned;
+                            * c = last-seen heartbeat age (usec, clamped
+                            * to int32) on local detections */
 };
 
 typedef struct rlo_trace_event {
     uint64_t ts_usec;
     int32_t rank;
     int32_t kind; /* enum rlo_ev */
-    int32_t a, b;
+    int32_t a, b, c, d;
 } rlo_trace_event;
 
 void rlo_trace_set(int enabled);
 int rlo_trace_enabled(void);
-void rlo_trace_emit(int rank, int kind, int a, int b);
+void rlo_trace_emit(int rank, int kind, int a, int b, int c, int d);
 /* Copies up to max oldest-first events into out and removes them;
  * returns the count. */
 int rlo_trace_drain(rlo_trace_event *out, int max);
 int64_t rlo_trace_dropped(void);
+int rlo_trace_capacity(void);
 void rlo_trace_clear(void);
 
 #ifdef __cplusplus

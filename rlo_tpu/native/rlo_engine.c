@@ -8,6 +8,8 @@
  */
 #include "rlo_internal.h"
 
+#include <stdio.h>
+
 /* depth of the recent-broadcast ring log re-flooded on view changes */
 #define RLO_RECENT_LOG 64
 /* settled consensus rounds remembered for decision dedup */
@@ -65,7 +67,8 @@ typedef struct rlo_rtx {
     struct rlo_rtx *next;
     int dst, tag, retries;
     int32_t seq;
-    uint64_t due; /* next retransmit time (usec) */
+    uint64_t due;  /* next retransmit time (usec) */
+    uint64_t sent; /* first-transmission time (RTT sampling) */
     rlo_blob *frame;
 } rlo_rtx;
 
@@ -83,6 +86,10 @@ struct rlo_msg {
     int n_handles, cap_handles;
     int pickup_done, fwd_done;
     rlo_prop *ps; /* for relayed IAR proposals */
+    /* metrics stamps (0 = metrics were off at the event): initiation
+     * time of a locally-initiated bcast and receipt time of a
+     * deliverable message (mirror of _Msg.born/arrived in engine.py) */
+    uint64_t born, arrived;
 };
 
 struct rlo_engine {
@@ -143,8 +150,60 @@ struct rlo_engine {
     int32_t *tx_skip;
     uint64_t *tx_skip_due;
     uint8_t *skip_hold;
-    int64_t arq_retx, arq_dup, arq_unacked_cnt;
+    int64_t arq_retx, arq_dup, arq_gaveup, arq_unacked_cnt;
+    /* metrics registry (mirror of engine.py's _mx_* machinery; see
+     * rlo_core.h rlo_stats): per-peer link accounting + op-latency
+     * histograms, collected only while metrics_on (one branch per
+     * send/receive when off — the overhead contract) */
+    int metrics_on;
+    rlo_link_stats *links; /* ws entries; links[rank] stays zero */
+    rlo_hist h_bcast, h_prop, h_pickup;
+    uint64_t prop_born;
 };
+
+/* ---------------- metrics helpers ---------------- */
+
+static void hist_obs(rlo_hist *h, double v)
+{
+    int64_t iv = v <= 0 ? 0 : (int64_t)v;
+    int b = 0;
+    while (iv >> b)
+        b++; /* bit_length */
+    if (b > RLO_HIST_BUCKETS - 1)
+        b = RLO_HIST_BUCKETS - 1;
+    if (h->count == 0) {
+        h->min = v;
+        h->max = v;
+    } else {
+        if (v < h->min)
+            h->min = v;
+        if (v > h->max)
+            h->max = v;
+    }
+    h->count++;
+    h->sum += v;
+    h->buckets[b]++;
+}
+
+static void rtt_sample(rlo_link_stats *ls, double usec)
+{
+    if (usec < 1.0)
+        /* below clock resolution; clamp so a real sample can never
+         * collide with the 0.0 "unmeasured" sentinel */
+        usec = 1.0;
+    if (ls->rtt_ewma_usec == 0.0)
+        ls->rtt_ewma_usec = usec;
+    else
+        ls->rtt_ewma_usec += (usec - ls->rtt_ewma_usec) / 8.0;
+}
+
+/* correlation identity a trace event carries in its c field: the
+ * per-origin exactly-once seq for BCAST frames (it travels in the
+ * vote field), the pid for everything else */
+static int32_t trace_ident(int tag, int32_t pid, int32_t vote)
+{
+    return tag == RLO_TAG_BCAST ? vote : pid;
+}
 
 /* ---------------- queue ops ---------------- */
 
@@ -306,6 +365,10 @@ static int eng_isend_frame(rlo_engine *e, int dst, int tag,
 {
     rlo_handle *h = 0;
     int rc;
+    if (e->metrics_on && dst >= 0 && dst < e->ws) {
+        e->links[dst].tx_frames++;
+        e->links[dst].tx_bytes += frame->len;
+    }
     if (e->arq_rto && !arq_exempt(tag) && dst >= 0 && dst < e->ws) {
         rlo_blob *stamped = rlo_blob_new(frame->len);
         rlo_rtx *rt = (rlo_rtx *)calloc(1, sizeof(*rt));
@@ -320,7 +383,8 @@ static int eng_isend_frame(rlo_engine *e, int dst, int tag,
         rt->dst = dst;
         rt->tag = tag;
         rt->seq = seq;
-        rt->due = rlo_now_usec() + e->arq_rto;
+        rt->sent = rlo_now_usec();
+        rt->due = rt->sent + e->arq_rto;
         rt->frame = rlo_blob_ref(stamped);
         rt->next = e->rtx_head;
         e->rtx_head = rt;
@@ -402,6 +466,8 @@ rlo_engine *rlo_engine_new(rlo_world *w, int rank, int comm,
     e->tx_skip_due =
         (uint64_t *)calloc((size_t)e->ws, sizeof(uint64_t));
     e->skip_hold = (uint8_t *)calloc((size_t)e->ws, 1);
+    e->links = (rlo_link_stats *)calloc((size_t)e->ws,
+                                        sizeof(rlo_link_stats));
     if (e->seen_contig)
         for (int r = 0; r < e->ws; r++)
             e->seen_contig[r] = -1;
@@ -414,7 +480,7 @@ rlo_engine *rlo_engine_new(rlo_world *w, int rank, int comm,
     if (e->n_init < 0 || !e->failed || !e->hb_seen || !e->seen_contig ||
         !e->seen_mask || !e->tx_seq || !e->rx_contig || !e->rx_mask ||
         !e->ack_due || !e->tx_skip || !e->tx_skip_due || !e->skip_hold ||
-        rlo_world_register(w, e) != RLO_OK) {
+        !e->links || rlo_world_register(w, e) != RLO_OK) {
         free(e->failed);
         free(e->hb_seen);
         free(e->seen_contig);
@@ -426,6 +492,7 @@ rlo_engine *rlo_engine_new(rlo_world *w, int rank, int comm,
         free(e->tx_skip);
         free(e->tx_skip_due);
         free(e->skip_hold);
+        free(e->links);
         free(e);
         return 0;
     }
@@ -502,6 +569,7 @@ void rlo_engine_free(rlo_engine *e)
     free(e->tx_skip);
     free(e->tx_skip_due);
     free(e->skip_hold);
+    free(e->links);
     for (rlo_rtx *rt = e->rtx_head; rt;) {
         rlo_rtx *nrt = rt->next;
         rlo_blob_unref(rt->frame);
@@ -680,11 +748,21 @@ static int bcast_is_dup(rlo_engine *e, const rlo_msg *m)
  * was absorbed). */
 static void arq_on_ack(rlo_engine *e, int src, int32_t cum)
 {
+    uint64_t now = e->metrics_on ? rlo_now_usec() : 0;
     if (e->tx_skip[src] >= 0 && cum >= e->tx_skip[src])
         e->tx_skip[src] = -1;
     for (rlo_rtx **pp = &e->rtx_head; *pp;) {
         rlo_rtx *rt = *pp;
         if (rt->dst == src && rt->seq <= cum) {
+            if (e->metrics_on && rt->retries == 0 && now >= rt->sent)
+                /* RTT from ack timing — never-retransmitted frames
+                 * only (Karn's rule: a retransmitted frame's ack is
+                 * ambiguous about which copy it answers). now >= sent
+                 * guards a backwards wall-clock step (rlo_now_usec is
+                 * gettimeofday): an underflowed delta would poison
+                 * the EWMA for the process lifetime */
+                rtt_sample(&e->links[src],
+                           (double)(now - rt->sent));
             *pp = rt->next;
             rlo_blob_unref(rt->frame);
             free(rt);
@@ -754,9 +832,15 @@ static void arq_tick(rlo_engine *e)
         if (rt->retries >= e->arq_max_retries ||
             (rt->dst >= 0 && rt->dst < e->ws && e->failed[rt->dst])) {
             if (rt->dst >= 0 && rt->dst < e->ws &&
-                !e->failed[rt->dst] && rt->seq > e->tx_skip[rt->dst]) {
-                e->tx_skip[rt->dst] = rt->seq;
-                e->tx_skip_due[rt->dst] = now; /* send immediately */
+                !e->failed[rt->dst]) {
+                /* retries exhausted on a LIVE peer (a dead peer's
+                 * entries are dropped, not given up on — mirror of
+                 * the Python tick's failed-dst clear) */
+                e->arq_gaveup++;
+                if (rt->seq > e->tx_skip[rt->dst]) {
+                    e->tx_skip[rt->dst] = rt->seq;
+                    e->tx_skip_due[rt->dst] = now; /* send now */
+                }
             }
             *pp = rt->next;
             rlo_blob_unref(rt->frame);
@@ -770,6 +854,11 @@ static void arq_tick(rlo_engine *e)
         rt->due = now + (e->arq_rto
                          << (rt->retries < 32 ? rt->retries : 32));
         e->arq_retx++;
+        if (e->metrics_on && rt->dst >= 0 && rt->dst < e->ws) {
+            e->links[rt->dst].retransmits++;
+            e->links[rt->dst].tx_frames++;
+            e->links[rt->dst].tx_bytes += rt->frame->len;
+        }
         /* same bytes, same seq: the receiver dedups the retransmit */
         rlo_world_isend(e->w, e->rank, rt->dst, e->comm, rt->tag,
                         rt->frame, 0);
@@ -872,7 +961,8 @@ static int bcast_init(rlo_engine *e, int tag, int32_t pid, int32_t vote,
     }
     q_append(&e->q_wait, m);
     e->sent_bcast++;
-    rlo_trace_emit(e->rank, RLO_EV_BCAST_INIT, tag, (int)len);
+    rlo_trace_emit(e->rank, RLO_EV_BCAST_INIT, tag, (int)len,
+                   trace_ident(tag, pid, vote), 0);
     if (out)
         *out = m;
     return RLO_OK;
@@ -890,6 +980,8 @@ int rlo_bcast(rlo_engine *e, const uint8_t *payload, int64_t len)
     int rc = bcast_init(e, RLO_TAG_BCAST, -1, e->bcast_seq++, payload,
                         len, &m);
     if (rc == RLO_OK) {
+        if (e->metrics_on)
+            m->born = rlo_now_usec();
         recent_log_push(e, m->frame, RLO_TAG_BCAST);
         rlo_progress_all(e->w);
     }
@@ -910,8 +1002,11 @@ static int bc_forward(rlo_engine *e, rlo_msg *m)
         if (rc != RLO_OK)
             return rc;
     }
-    if (n > 0)
-        rlo_trace_emit(e->rank, RLO_EV_BCAST_FWD, m->tag, n);
+    /* receipt+forward step — emitted even for leaf receipts (zero
+     * targets) so the timeline merger always has a receive-side
+     * anchor carrying (origin, identity, immediate sender) */
+    rlo_trace_emit(e->rank, RLO_EV_BCAST_FWD, m->tag, m->origin,
+                   trace_ident(m->tag, m->pid, m->vote), m->src);
     if (m->tag == RLO_TAG_IAR_PROPOSAL) {
         /* proposals are engine-internal: parked for the decision, never
          * user-visible (make_progress_gen :591-596) */
@@ -934,7 +1029,7 @@ static int eng_judge(rlo_engine *e, const uint8_t *payload, int64_t len,
 {
     int verdict = e->judge ? (e->judge(payload, len, e->judge_ctx) ? 1 : 0)
                            : 1;
-    rlo_trace_emit(e->rank, RLO_EV_JUDGE, pid, verdict);
+    rlo_trace_emit(e->rank, RLO_EV_JUDGE, pid, verdict, 0, 0);
     return verdict;
 }
 
@@ -946,7 +1041,7 @@ static int vote_back(rlo_engine *e, const rlo_prop *ps, int vote)
 {
     uint8_t genb[4];
     put_le32(genb, ps->gen);
-    rlo_trace_emit(e->rank, RLO_EV_VOTE, ps->pid, vote);
+    rlo_trace_emit(e->rank, RLO_EV_VOTE, ps->pid, vote, ps->gen, 0);
     return eng_isend(e, ps->recv_from, RLO_TAG_IAR_VOTE, e->rank, ps->pid,
                      vote, genb, 4, 0);
 }
@@ -1149,7 +1244,7 @@ static void decision_bcast(rlo_engine *e)
         m->handles[i]->refs++;
     }
     p->decision_pending = 1;
-    rlo_trace_emit(e->rank, RLO_EV_DECISION, p->pid, p->vote);
+    rlo_trace_emit(e->rank, RLO_EV_DECISION, p->pid, p->vote, p->gen, 0);
 }
 
 /* Drop src from the awaited-children list; 0 if it was not awaited. */
@@ -1313,7 +1408,9 @@ int rlo_submit_proposal(rlo_engine *e, const uint8_t *proposal, int64_t len,
             return RLO_ERR_NOMEM;
         memcpy(p->payload, proposal, (size_t)len);
     }
-    rlo_trace_emit(e->rank, RLO_EV_PROPOSAL_SUBMIT, pid, 0);
+    if (e->metrics_on)
+        e->prop_born = rlo_now_usec();
+    rlo_trace_emit(e->rank, RLO_EV_PROPOSAL_SUBMIT, pid, 0, p->gen, 0);
     /* the proposal frame's vote field carries the round generation */
     int rc = bcast_init(e, RLO_TAG_IAR_PROPOSAL, pid, p->gen, proposal,
                         len, 0);
@@ -1459,9 +1556,27 @@ static int mark_failed(rlo_engine *e, int rank)
 
 static void declare_failed(rlo_engine *e, int rank)
 {
+    /* capture the evidence BEFORE mark_failed clears the slot: the
+     * last-seen heartbeat age is what makes a false-positive
+     * declaration diagnosable after the fact */
+    uint64_t now = rlo_now_usec();
+    uint64_t age = (rank >= 0 && rank < e->ws && e->hb_seen[rank])
+                       ? now - e->hb_seen[rank]
+                       : (uint64_t)INT32_MAX;
     if (!mark_failed(e, rank))
         return;
-    rlo_trace_emit(e->rank, RLO_EV_FAILURE, rank, 1);
+    if (age > (uint64_t)INT32_MAX)
+        age = (uint64_t)INT32_MAX;
+    if (!getenv("RLO_QUIET"))
+        /* suppressible like the Python twin's logging.Logger route */
+        fprintf(stderr,
+                "rlo_tpu: rank %d declaring rank %d FAILED: no "
+                "heartbeat for %.1f ms (timeout %.1f ms, interval "
+                "%.1f ms)\n",
+                e->rank, rank, (double)age / 1e3,
+                (double)e->fd_timeout / 1e3,
+                (double)e->fd_interval / 1e3);
+    rlo_trace_emit(e->rank, RLO_EV_FAILURE, rank, 1, (int)age, 0);
     /* tell the world: overlay broadcast AND point-to-point to every
      * alive rank (overlay forwarding can have holes while views are
      * converging; receivers suppress duplicates) */
@@ -1494,7 +1609,7 @@ static void on_failure(rlo_engine *e, rlo_msg *m)
             msg_free(m); /* already known: suppress the duplicate */
             return;
         }
-        rlo_trace_emit(e->rank, RLO_EV_FAILURE, rank, 0);
+        rlo_trace_emit(e->rank, RLO_EV_FAILURE, rank, 0, 0, 0);
     }
     int rc = bc_forward(e, m); /* adopt-before-forward ordering */
     if (rc < 0) {
@@ -1523,7 +1638,7 @@ static void failure_tick(rlo_engine *e)
         eng_isend(e, succ, RLO_TAG_HEARTBEAT, e->rank, -1, -1, ackb,
                   n_ack, 0);
         e->hb_last_sent = now;
-        rlo_trace_emit(e->rank, RLO_EV_HEARTBEAT, succ, 0);
+        rlo_trace_emit(e->rank, RLO_EV_HEARTBEAT, succ, 0, 0, 0);
     }
     if (pred < 0)
         return;
@@ -1571,6 +1686,54 @@ int64_t rlo_engine_arq_dup_drops(const rlo_engine *e)
 int64_t rlo_engine_arq_unacked(const rlo_engine *e)
 {
     return e->arq_unacked_cnt;
+}
+
+int64_t rlo_engine_arq_gave_up(const rlo_engine *e)
+{
+    return e->arq_gaveup;
+}
+
+/* ---------------- metrics registry (see rlo_core.h rlo_stats) ------- */
+
+int rlo_engine_enable_metrics(rlo_engine *e, int on)
+{
+    if (!e)
+        return RLO_ERR_ARG;
+    e->metrics_on = on ? 1 : 0;
+    return RLO_OK;
+}
+
+int rlo_engine_stats(const rlo_engine *e, rlo_stats *out)
+{
+    if (!e || !out)
+        return RLO_ERR_ARG;
+    memset(out, 0, sizeof(*out));
+    out->sent_bcast = e->sent_bcast;
+    out->recved_bcast = e->recved_bcast;
+    out->total_pickup = e->total_pickup;
+    out->ops_failed = 0; /* op deadlines are Python-side (schema parity) */
+    out->arq_retransmits = e->arq_retx;
+    out->arq_dup_drops = e->arq_dup;
+    out->arq_gave_up = e->arq_gaveup;
+    out->arq_unacked = e->arq_unacked_cnt;
+    out->q_wait = e->q_wait.len;
+    out->q_pickup = e->q_pickup.len;
+    out->q_wait_and_pickup = e->q_wait_pickup.len;
+    out->q_iar_pending = e->q_iar_pending.len;
+    out->bcast_complete = e->h_bcast;
+    out->proposal_resolve = e->h_prop;
+    out->pickup_wait = e->h_pickup;
+    return RLO_OK;
+}
+
+int rlo_engine_link_stats(const rlo_engine *e, rlo_link_stats *out,
+                          int cap)
+{
+    if (!e || !out || cap < 0)
+        return RLO_ERR_ARG;
+    int n = cap < e->ws ? cap : e->ws; /* partial fill, per header */
+    memcpy(out, e->links, (size_t)n * sizeof(rlo_link_stats));
+    return e->ws;
 }
 
 int rlo_engine_rank_failed(const rlo_engine *e, int rank)
@@ -1625,7 +1788,14 @@ static rlo_msg *pickup_head(rlo_engine *e, int *from_wait)
 static void pickup_retire(rlo_engine *e, rlo_msg *m, int from_wait)
 {
     e->total_pickup++;
-    rlo_trace_emit(e->rank, RLO_EV_DELIVER, m->tag, m->origin);
+    if (m->arrived) {
+        /* clamp against a backwards wall-clock step (see arq_on_ack) */
+        uint64_t now = rlo_now_usec();
+        if (now >= m->arrived)
+            hist_obs(&e->h_pickup, (double)(now - m->arrived));
+    }
+    rlo_trace_emit(e->rank, RLO_EV_DELIVER, m->tag, m->origin,
+                   trace_ident(m->tag, m->pid, m->vote), m->src);
     if (m == e->peeked)
         e->peeked = 0;
     if (from_wait) {
@@ -1710,6 +1880,13 @@ void rlo_engine_progress_once(rlo_engine *e)
         if (done) {
             p->state = RLO_COMPLETED;
             p->decision_pending = 0;
+            if (e->prop_born) {
+                uint64_t now = rlo_now_usec();
+                if (now >= e->prop_born)
+                    hist_obs(&e->h_prop,
+                             (double)(now - e->prop_born));
+                e->prop_born = 0;
+            }
         }
     }
 
@@ -1726,6 +1903,13 @@ void rlo_engine_progress_once(rlo_engine *e)
         if (!m) {
             set_err(e, err);
             continue;
+        }
+        if (e->metrics_on) {
+            if (m->src >= 0 && m->src < e->ws) {
+                e->links[m->src].rx_frames++;
+                e->links[m->src].rx_bytes += m->frame->len;
+            }
+            m->arrived = rlo_now_usec();
         }
         /* ANY frame proves the sender alive — prevents heartbeat
          * starvation when membership views transiently diverge */
@@ -1751,6 +1935,8 @@ void rlo_engine_progress_once(rlo_engine *e)
                               &e->rx_mask[(size_t)m->src * RLO_SEEN_WORDS],
                               m->seq)) {
                 e->arq_dup++;
+                if (e->metrics_on)
+                    e->links[m->src].dup_drops++;
                 msg_free(m);
                 continue;
             }
@@ -1827,6 +2013,12 @@ void rlo_engine_progress_once(rlo_engine *e)
         rlo_msg *nm = m->next;
         if (msg_sends_done(m)) {
             m->fwd_done = 1;
+            if (m->born) {
+                /* locally-initiated bcast: init -> fan-out complete */
+                uint64_t now = rlo_now_usec();
+                if (now >= m->born)
+                    hist_obs(&e->h_bcast, (double)(now - m->born));
+            }
             q_remove(&e->q_wait, m);
             msg_free(m);
         }

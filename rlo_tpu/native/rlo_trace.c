@@ -26,7 +26,7 @@ int rlo_trace_enabled(void)
     return enabled;
 }
 
-void rlo_trace_emit(int rank, int kind, int a, int b)
+void rlo_trace_emit(int rank, int kind, int a, int b, int c, int d)
 {
     if (!enabled)
         return;
@@ -36,11 +36,18 @@ void rlo_trace_emit(int rank, int kind, int a, int b)
     e->kind = kind;
     e->a = a;
     e->b = b;
+    e->c = c;
+    e->d = d;
     head = (head + 1) % TRACE_CAP;
     if (count < TRACE_CAP)
         count++;
     else
         dropped++;
+}
+
+int rlo_trace_capacity(void)
+{
+    return TRACE_CAP;
 }
 
 int rlo_trace_drain(rlo_trace_event *out, int max)

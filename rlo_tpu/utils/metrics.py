@@ -1,0 +1,201 @@
+"""Unified metrics registry: counters, gauges, log2 histograms.
+
+The reference's only observability is `gettimeofday` brackets around
+test loops (SURVEY.md §5, rootless_ops.c:128-132); the rebuild's
+reliability layer (ARQ retransmits, dedup drops, op aborts, failure
+declarations) makes invisible decisions that need first-class numbers,
+and the serving stack needs TTFT / per-token latency / occupancy before
+any perf PR can claim a win.
+
+Three primitives, deliberately tiny:
+
+  - ``Counter``: monotone int, ``inc()``;
+  - ``Gauge``: last-written value, ``set()``;
+  - ``Histogram``: power-of-two buckets over non-negative values
+    (bucket i holds values whose integer part has bit_length i, i.e.
+    [2^(i-1), 2^i); bucket 0 is <= 0; the last bucket is overflow) with
+    count/sum/min/max — the exact layout of the C core's ``rlo_hist``
+    (rlo_core.h), so Python- and C-engine snapshots share a schema.
+
+``Registry`` groups them by name and snapshots to a nested dict
+(JSON-ready).  The progress engines do NOT route their hot-path
+counters through Registry objects — they keep plain int fields and
+assemble the same snapshot schema in ``ProgressEngine.metrics()`` /
+``rlo_engine_stats`` (one branch per event when disabled; see
+docs/DESIGN.md §7 "overhead contract").  Registry is the serving /
+application face: ``DecodeServer`` and ``generate_timed`` record into
+``SERVING`` (the process-default registry) unless handed their own.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+#: number of histogram buckets — mirror of RLO_HIST_BUCKETS (rlo_core.h)
+HIST_BUCKETS = 28
+
+
+class Counter:
+    """Monotonically increasing integer."""
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = 0
+
+    def inc(self, n: int = 1) -> None:
+        self.value += n
+
+
+class Gauge:
+    """Last-written value."""
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = 0
+
+    def set(self, v) -> None:
+        self.value = v
+
+
+class Histogram:
+    """Log2-bucketed histogram of non-negative samples (usec by
+    convention). Bucket i counts samples whose int part has bit_length
+    i — i.e. [2^(i-1), 2^i) — bucket 0 counts samples <= 0 (or < 1)
+    and the final bucket absorbs overflow. Identical layout to the C
+    core's rlo_hist so cross-implementation snapshots compare."""
+    __slots__ = ("count", "sum", "min", "max", "buckets")
+
+    def __init__(self):
+        self.count = 0
+        self.sum = 0.0
+        self.min = 0.0
+        self.max = 0.0
+        self.buckets: List[int] = [0] * HIST_BUCKETS
+
+    @staticmethod
+    def bucket_index(v) -> int:
+        iv = int(v)
+        if iv <= 0:
+            return 0
+        return min(HIST_BUCKETS - 1, iv.bit_length())
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        if self.count == 0:
+            self.min = v
+            self.max = v
+        else:
+            if v < self.min:
+                self.min = v
+            if v > self.max:
+                self.max = v
+        self.count += 1
+        self.sum += v
+        self.buckets[self.bucket_index(v)] += 1
+
+    def snapshot(self) -> Dict:
+        return {"count": self.count, "sum": self.sum,
+                "min": self.min, "max": self.max,
+                "buckets": list(self.buckets)}
+
+
+class LinkStats:
+    """Per-peer link accounting (one per (this rank, peer) edge):
+    frames/bytes both ways, retransmits, duplicate drops, and an RTT
+    EWMA measured from ARQ ack timing (first-transmission frames only —
+    Karn's rule — smoothed 1/8 like TCP's SRTT). Mirror of the C
+    core's rlo_link_stats."""
+    __slots__ = ("tx_frames", "tx_bytes", "rx_frames", "rx_bytes",
+                 "retransmits", "dup_drops", "rtt_ewma_usec")
+
+    def __init__(self):
+        self.tx_frames = 0
+        self.tx_bytes = 0
+        self.rx_frames = 0
+        self.rx_bytes = 0
+        self.retransmits = 0
+        self.dup_drops = 0
+        self.rtt_ewma_usec = 0.0
+
+    def rtt_sample(self, usec: float) -> None:
+        if usec < 1.0:
+            # below clock resolution; clamp so a real sample can never
+            # collide with the 0.0 "unmeasured" sentinel
+            usec = 1.0
+        if self.rtt_ewma_usec == 0.0:
+            self.rtt_ewma_usec = usec
+        else:
+            self.rtt_ewma_usec += (usec - self.rtt_ewma_usec) / 8.0
+
+    def snapshot(self) -> Dict:
+        return {"tx_frames": self.tx_frames, "tx_bytes": self.tx_bytes,
+                "rx_frames": self.rx_frames, "rx_bytes": self.rx_bytes,
+                "retransmits": self.retransmits,
+                "dup_drops": self.dup_drops,
+                "rtt_ewma_usec": self.rtt_ewma_usec}
+
+
+class Registry:
+    """Named metrics, grouped by kind; snapshot() is a nested dict."""
+
+    def __init__(self):
+        self._counters: Dict[str, Counter] = {}
+        self._gauges: Dict[str, Gauge] = {}
+        self._histograms: Dict[str, Histogram] = {}
+
+    def counter(self, name: str) -> Counter:
+        c = self._counters.get(name)
+        if c is None:
+            c = self._counters[name] = Counter()
+        return c
+
+    def gauge(self, name: str) -> Gauge:
+        g = self._gauges.get(name)
+        if g is None:
+            g = self._gauges[name] = Gauge()
+        return g
+
+    def histogram(self, name: str) -> Histogram:
+        h = self._histograms.get(name)
+        if h is None:
+            h = self._histograms[name] = Histogram()
+        return h
+
+    def snapshot(self) -> Dict:
+        return {
+            "counters": {k: c.value
+                         for k, c in sorted(self._counters.items())},
+            "gauges": {k: g.value
+                       for k, g in sorted(self._gauges.items())},
+            "histograms": {k: h.snapshot()
+                           for k, h in sorted(self._histograms.items())},
+        }
+
+    def clear(self) -> None:
+        self._counters.clear()
+        self._gauges.clear()
+        self._histograms.clear()
+
+
+#: process-default serving registry — DecodeServer and generate_timed
+#: record here unless handed their own Registry
+SERVING = Registry()
+
+
+def hist_quantile(hist: Dict, q: float) -> Optional[float]:
+    """Approximate quantile (bucket upper bound) from a histogram
+    snapshot — good to a factor of 2, which is what log2 buckets buy.
+    None when the histogram is empty."""
+    n = hist["count"]
+    if n == 0:
+        return None
+    want = q * n
+    seen = 0
+    for i, c in enumerate(hist["buckets"]):
+        seen += c
+        if seen >= want and c:
+            if i == HIST_BUCKETS - 1:
+                # overflow bucket has no upper bound; max is exact
+                return float(hist["max"])
+            return float(2 ** i)
+    return float(hist["max"])

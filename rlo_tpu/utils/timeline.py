@@ -1,0 +1,286 @@
+"""Cross-rank causal timelines: merge per-rank tracer dumps into a
+Chrome trace-event / Perfetto file.
+
+A chaos-soak run (tests/test_reliability.py) is ~10k log lines of
+interleaved retransmits, dedups and view changes; this module turns
+the same information into a timeline a human can scrub: one track per
+rank, one slice per protocol event, and **flow edges** (the Chrome
+trace ``s``/``f`` arrow pairs) connecting every store-and-forward
+send to its receipt on the next hop.
+
+Correlation model (docs/DESIGN.md §7): events are joined on the
+protocol's own exactly-once identity — ``(origin, seq)`` for
+Tag.BCAST (the per-origin sequence stamp receivers already dedup on)
+and ``(origin, pid)`` for IAR proposals/decisions and FAILURE/ABORT
+notices. The receive-side anchor is the ``BCAST_FWD`` event (emitted
+on every non-duplicate receipt, including leaf receipts that forward
+nothing), whose ``d`` field names the immediate sender; the send-side
+anchor is that sender's own ``BCAST_INIT`` (when it is the origin) or
+``BCAST_FWD`` (when it relayed). No topology knowledge is needed, so
+the merge stays correct across elastic view changes.
+
+Input: per-rank JSONL files from ``Tracer.dump_jsonl`` (or native
+events from ``bindings.trace_drain()``, which share the schema), or
+iterables of event dicts. Output: the Chrome trace-event JSON object
+(``{"traceEvents": [...]}``), loadable in Perfetto / chrome://tracing.
+
+CLI::
+
+    python -m rlo_tpu.utils.timeline merge --out trace.json r0.jsonl r1.jsonl
+    python -m rlo_tpu.utils.timeline smoke   # loopback soak -> validate
+"""
+
+from __future__ import annotations
+
+import bisect
+import json
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Union
+
+#: transport tags whose frames are store-and-forward broadcast — the
+#: tags BCAST_FWD / BCAST_INIT events can carry in ``a`` (mirror of
+#: rlo_tpu.wire.BCAST_TAGS; numeric to keep this module importable
+#: without the engine stack)
+FLOW_TAGS = {0: "bcast", 2: "proposal", 4: "decision",
+             12: "failure", 14: "abort"}
+
+Source = Union[str, Path, Iterable[Dict]]
+
+
+def load_jsonl(path: Union[str, Path]) -> List[Dict]:
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out
+
+
+def _flow_key(ev: Dict):
+    """(tag, origin, identity) for a send- or receive-side anchor."""
+    kind = ev.get("kind")
+    if kind == "BCAST_INIT":
+        return (ev.get("a"), ev.get("rank"), ev.get("c"))
+    if kind == "BCAST_FWD":
+        return (ev.get("a"), ev.get("b"), ev.get("c"))
+    return None
+
+
+def merge_timeline(sources: List[Source],
+                   out_path: Optional[Union[str, Path]] = None,
+                   slice_usec: int = 1) -> Dict:
+    """Merge per-rank event dumps into one Chrome trace object.
+
+    ``sources``: JSONL paths and/or iterables of event dicts (the
+    ``Event.to_dict()`` / native ``trace_drain()`` schema: ts_usec,
+    rank, kind, a, b, c, d). Ranks may be split across sources any
+    way — events carry their rank. When ``out_path`` is given the
+    trace is also written there as JSON."""
+    events: List[Dict] = []
+    for s in sources:
+        if isinstance(s, (str, Path)):
+            events.extend(load_jsonl(s))
+        else:
+            events.extend(s)
+    events.sort(key=lambda e: (e.get("ts_usec", 0), e.get("rank", 0)))
+    ranks = sorted({e["rank"] for e in events})
+    t0 = events[0]["ts_usec"] if events else 0
+
+    trace_events: List[Dict] = [
+        {"ph": "M", "name": "process_name", "pid": 0, "tid": 0, "ts": 0,
+         "args": {"name": "rlo_tpu"}},
+    ]
+    for r in ranks:
+        trace_events.append(
+            {"ph": "M", "name": "thread_name", "pid": 0, "tid": r,
+             "ts": 0, "args": {"name": f"rank {r}"}})
+
+    # one X slice per protocol event (instants become short slices so
+    # flow events have something to bind to)
+    # send-side anchors: (tag, origin, ident) -> {rank: sorted [ts]}
+    anchors: Dict = {}
+    for e in events:
+        ts = e["ts_usec"] - t0
+        trace_events.append({
+            "ph": "X", "cat": "proto", "name": e["kind"],
+            "pid": 0, "tid": e["rank"], "ts": ts, "dur": slice_usec,
+            "args": {k: e.get(k, 0) for k in ("a", "b", "c", "d")},
+        })
+        key = _flow_key(e)
+        if key is not None:
+            anchors.setdefault(key, {}).setdefault(
+                e["rank"], []).append(ts)
+    for per_rank in anchors.values():
+        for lst in per_rank.values():
+            lst.sort()
+
+    # flow edges: every receive anchor points back at the immediate
+    # sender's latest same-identity anchor at or before the receive
+    flow_id = 0
+    for e in events:
+        if e.get("kind") != "BCAST_FWD":
+            continue
+        key = _flow_key(e)
+        src = e.get("d", -1)
+        sender_ts = anchors.get(key, {}).get(src)
+        if not sender_ts:
+            continue  # sender's dump missing (partial capture): skip
+        recv_ts = e["ts_usec"] - t0
+        i = bisect.bisect_right(sender_ts, recv_ts) - 1
+        if i < 0:
+            # every same-identity sender anchor is LATER than the
+            # receive — cross-process clock skew; a backwards edge
+            # would fail validation, so skip it like a missing dump
+            continue
+        send_ts = sender_ts[i]
+        name = FLOW_TAGS.get(e.get("a"), f"tag{e.get('a')}")
+        label = f"{name} {key[1]}:{key[2]}"
+        flow_id += 1
+        trace_events.append({"ph": "s", "cat": "flow", "name": label,
+                             "id": flow_id, "pid": 0, "tid": src,
+                             "ts": send_ts})
+        trace_events.append({"ph": "f", "bp": "e", "cat": "flow",
+                             "name": label, "id": flow_id, "pid": 0,
+                             "tid": e["rank"], "ts": recv_ts})
+
+    trace = {"traceEvents": trace_events, "displayTimeUnit": "ms",
+             "otherData": {"generator": "rlo_tpu.utils.timeline",
+                           "ranks": ranks, "events": len(events),
+                           "flow_edges": flow_id}}
+    if out_path is not None:
+        with open(out_path, "w") as f:
+            json.dump(trace, f)
+    return trace
+
+
+def count_flow_edges(trace: Dict) -> int:
+    return sum(1 for e in trace.get("traceEvents", [])
+               if e.get("ph") == "s")
+
+
+def validate_chrome_trace(trace: Dict) -> None:
+    """Validate the Chrome trace-event JSON schema (the subset this
+    module emits): raises ValueError on the first violation. Checks
+    JSON-serializability, required per-event fields, and that every
+    flow start has a matching finish no earlier than it."""
+    try:
+        json.dumps(trace)
+    except (TypeError, ValueError) as ex:
+        raise ValueError(f"trace is not JSON-serializable: {ex}")
+    evs = trace.get("traceEvents")
+    if not isinstance(evs, list):
+        raise ValueError("traceEvents must be a list")
+    starts: Dict = {}
+    finishes: Dict = {}
+    for i, e in enumerate(evs):
+        if not isinstance(e, dict):
+            raise ValueError(f"traceEvents[{i}] is not an object")
+        ph = e.get("ph")
+        if ph not in ("M", "X", "B", "E", "i", "s", "t", "f"):
+            raise ValueError(f"traceEvents[{i}]: unknown ph {ph!r}")
+        for fld in ("name", "pid", "tid"):
+            if fld not in e:
+                raise ValueError(f"traceEvents[{i}]: missing {fld!r}")
+        if ph != "M" and "ts" not in e:
+            raise ValueError(f"traceEvents[{i}]: missing 'ts'")
+        if ph == "X":
+            if not isinstance(e.get("dur"), (int, float)) \
+                    or e["dur"] < 0:
+                raise ValueError(
+                    f"traceEvents[{i}]: X event needs dur >= 0")
+        if ph in ("s", "f"):
+            if "id" not in e:
+                raise ValueError(f"traceEvents[{i}]: flow without id")
+            (starts if ph == "s" else finishes)[e["id"]] = e
+    for fid, s in starts.items():
+        f = finishes.get(fid)
+        if f is None:
+            raise ValueError(f"flow {fid}: start without finish")
+        if f["ts"] < s["ts"]:
+            raise ValueError(f"flow {fid}: finish before start")
+    for fid in finishes:
+        if fid not in starts:
+            raise ValueError(f"flow {fid}: finish without start")
+
+
+# ---------------------------------------------------------------------------
+# CLI: merge files, or run the self-contained loopback smoke
+# ---------------------------------------------------------------------------
+
+def _smoke(out: Optional[str]) -> Dict:
+    """4-rank loopback soak with tracing + metrics on, loss/duplication
+    injection and ARQ recovery; dump per-rank JSONL, merge, validate.
+    The check.sh observability smoke step (and a usage example)."""
+    import tempfile
+
+    from rlo_tpu.engine import EngineManager, ProgressEngine, drain
+    from rlo_tpu.transport.loopback import LoopbackWorld
+    from rlo_tpu.utils.tracing import TRACER
+
+    ws = 4
+    world = LoopbackWorld(ws, latency=2, seed=7)
+    mgr = EngineManager()
+    engines = [ProgressEngine(world.transport(r), manager=mgr,
+                              arq_rto=0.01) for r in range(ws)]
+    for e in engines:
+        e.enable_metrics()
+    TRACER.clear()
+    with TRACER.enable():
+        world.dup_next(0, 1, 2)
+        world.drop_next(1, 3, 1)
+        for i in range(6):
+            engines[i % ws].bcast(f"m{i}".encode())
+        drain([world], engines)
+        for e in engines:
+            while e.pickup_next() is not None:
+                pass
+        engines[1].submit_proposal(b"smoke", pid=9)
+        drain([world], engines)
+    with tempfile.TemporaryDirectory() as td:
+        paths = []
+        for r in range(ws):
+            p = str(Path(td) / f"rank{r}.jsonl")
+            TRACER.dump_jsonl(p, rank=r)
+            paths.append(p)
+        trace = merge_timeline(paths, out_path=out)
+    TRACER.clear()
+    validate_chrome_trace(trace)
+    edges = count_flow_edges(trace)
+    if edges < 1:
+        raise AssertionError("smoke produced no flow edges")
+    snap = engines[0].metrics()
+    for e in engines:
+        e.cleanup()
+    return {"ok": True, "ranks": ws, "events": trace["otherData"]["events"],
+            "flow_edges": edges,
+            "rank0_tx_frames": sum(l["tx_frames"]
+                                   for l in snap["links"].values())}
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+    ap = argparse.ArgumentParser(description=__doc__)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    mp = sub.add_parser("merge", help="merge per-rank JSONL dumps")
+    mp.add_argument("inputs", nargs="+")
+    mp.add_argument("--out", required=True)
+    sp = sub.add_parser("smoke", help="loopback soak -> timeline -> "
+                                      "schema validation")
+    sp.add_argument("--out", default=None)
+    args = ap.parse_args(argv)
+    if args.cmd == "merge":
+        trace = merge_timeline(args.inputs, out_path=args.out)
+        validate_chrome_trace(trace)
+        print(json.dumps({"ok": True,
+                          "events": trace["otherData"]["events"],
+                          "flow_edges": count_flow_edges(trace),
+                          "out": args.out}))
+        return 0
+    print(json.dumps(_smoke(args.out)))
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

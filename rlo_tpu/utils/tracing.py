@@ -31,17 +31,29 @@ from typing import Deque, Dict, Iterator, List, Optional
 
 
 class Ev(IntEnum):
-    """Event kinds — numbering shared with the C core (rlo_core.h)."""
-    BCAST_INIT = 1      # a = tag, b = payload len
-    BCAST_FWD = 2       # a = tag, b = #targets
-    DELIVER = 3         # a = tag, b = origin
-    PROPOSAL_SUBMIT = 4  # a = pid
+    """Event kinds — numbering AND field semantics shared with the C
+    core (rlo_core.h enum rlo_ev). ``c``/``d`` carry the correlation
+    identity the cross-rank timeline merger keys on: for store-and-
+    forward frames the identity is (origin, seq) for Tag.BCAST —
+    every initiated broadcast is stamped with a per-origin sequence
+    number — and (origin, pid) for IAR/FAILURE/ABORT traffic; ``d``
+    is the immediate sender, which is what turns per-rank event logs
+    into send->recv flow edges (rlo_tpu/utils/timeline.py)."""
+    BCAST_INIT = 1      # a = tag, b = payload len, c = seq (BCAST) / pid
+    BCAST_FWD = 2       # receipt+forward step of a store-and-forward
+    #                     frame: a = tag, b = origin, c = seq/pid,
+    #                     d = immediate sender (emitted even for leaf
+    #                     receipts with zero forward targets)
+    DELIVER = 3         # a = tag, b = origin, c = seq/pid, d = sender
+    PROPOSAL_SUBMIT = 4  # a = pid, c = round generation
     JUDGE = 5           # a = pid, b = verdict
-    VOTE = 6            # a = pid, b = merged vote
-    DECISION = 7        # a = pid, b = decision
+    VOTE = 6            # a = pid, b = merged vote, c = generation
+    DECISION = 7        # a = pid, b = decision, c = generation
     DRAIN = 8           # a = spins
     HEARTBEAT = 9       # a = destination rank
-    FAILURE = 10        # a = failed rank, b = 1 local detection / 0 learned
+    FAILURE = 10        # a = failed rank, b = 1 local detection /
+    #                     0 learned; c = last-seen heartbeat age (usec,
+    #                     clamped to int32) on local detections
 
 
 @dataclass
@@ -51,10 +63,13 @@ class Event:
     kind: Ev
     a: int = 0
     b: int = 0
+    c: int = 0
+    d: int = 0
 
     def to_dict(self) -> Dict:
         return {"ts_usec": self.ts_usec, "rank": self.rank,
-                "kind": self.kind.name, "a": self.a, "b": self.b}
+                "kind": self.kind.name, "a": self.a, "b": self.b,
+                "c": self.c, "d": self.d}
 
 
 @dataclass
@@ -65,14 +80,15 @@ class Tracer:
     _events: Deque[Event] = field(default_factory=deque)
     dropped: int = 0
 
-    def emit(self, rank: int, kind: Ev, a: int = 0, b: int = 0) -> None:
+    def emit(self, rank: int, kind: Ev, a: int = 0, b: int = 0,
+             c: int = 0, d: int = 0) -> None:
         if not self.enabled:
             return
         if len(self._events) >= self.capacity:
             self._events.popleft()
             self.dropped += 1
         self._events.append(
-            Event(int(time.time() * 1e6), rank, kind, a, b))
+            Event(int(time.time() * 1e6), rank, kind, a, b, c, d))
 
     def events(self, kind: Optional[Ev] = None,
                rank: Optional[int] = None) -> List[Event]:
@@ -84,11 +100,19 @@ class Tracer:
         self._events.clear()
         self.dropped = 0
 
-    def dump_jsonl(self, path: str) -> int:
+    def dump_jsonl(self, path: str, rank: Optional[int] = None) -> int:
+        """Write events as JSON lines; ``rank`` filters to one rank's
+        events (the per-rank dump shape rlo_tpu/utils/timeline.py
+        merges — in multi-process deployments each process dumps its
+        own ranks)."""
+        n = 0
         with open(path, "w") as f:
             for e in self._events:
+                if rank is not None and e.rank != rank:
+                    continue
                 f.write(json.dumps(e.to_dict()) + "\n")
-        return len(self._events)
+                n += 1
+        return n
 
     @contextlib.contextmanager
     def enable(self) -> Iterator["Tracer"]:

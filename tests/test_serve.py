@@ -138,3 +138,73 @@ def test_errors(setup):
         srv.submit(np.zeros(8, np.int32), 20)
     with pytest.raises(ValueError, match="bucket"):
         _bucket(100, (8, 16))
+
+
+def test_serving_telemetry(setup):
+    """Serving telemetry (docs/DESIGN.md §7): every request's TTFT and
+    queue wait are recorded, occupancy/round histograms advance, and
+    the token counter equals the emitted tokens — without perturbing
+    the scheduling oracle (outputs still equal dense generate)."""
+    from rlo_tpu.utils.metrics import Registry
+
+    params = setup
+    rng = np.random.default_rng(7)
+    reg = Registry()
+    srv = DecodeServer(params, CFG, n_slots=2, max_len=64,
+                       round_len=4, prompt_buckets=(8, 16),
+                       metrics=reg)
+    reqs = [(rng.integers(0, CFG.vocab, (int(rng.integers(3, 12)),)),
+             int(rng.integers(1, 7))) for _ in range(3)]
+    for p, m in reqs:
+        srv.submit(p, m)
+    outs = srv.run()
+    for (p, m), got in zip(reqs, outs):
+        np.testing.assert_array_equal(got,
+                                      dense_oracle(params, CFG, p, m))
+
+    snap = srv.stats()
+    c, h = snap["counters"], snap["histograms"]
+    assert c["serve.requests_submitted"] == 3
+    assert c["serve.requests_completed"] == 3
+    assert c["serve.tokens_out"] == sum(len(o) for o in outs)
+    assert h["serve.ttft_usec"]["count"] == 3
+    assert h["serve.queue_wait_usec"]["count"] == 3
+    assert h["serve.round_usec"]["count"] == srv.rounds_run >= 1
+    assert h["serve.tok_usec"]["count"] == srv.rounds_run
+    occ = h["serve.occupancy_pct"]
+    assert occ["count"] == srv.rounds_run
+    assert 0.0 < occ["min"] <= occ["max"] <= 100.0
+    assert snap["gauges"]["serve.queue_depth"] == 0
+    # TTFT >= queue wait for the same request set (it includes it)
+    assert h["serve.ttft_usec"]["sum"] >= h["serve.queue_wait_usec"]["sum"]
+
+
+def test_generate_timed_matches_generate_and_records(setup):
+    """generate_timed: exact token parity with generate() plus TTFT /
+    per-token records into its registry (the DecodeServer-shared
+    schema)."""
+    from rlo_tpu.models.generate import generate_timed
+    from rlo_tpu.utils.metrics import Registry
+
+    params = setup
+    rng = np.random.default_rng(9)
+    prompt = jnp.asarray(rng.integers(0, CFG.vocab, (2, 6)), jnp.int32)
+    reg = Registry()
+    got = np.asarray(generate_timed(params, prompt, CFG, max_new=5,
+                                    metrics=reg))
+    want = np.asarray(generate(params, prompt, CFG, max_new=5))
+    np.testing.assert_array_equal(got, want)
+    snap = reg.snapshot()
+    assert snap["histograms"]["serve.ttft_usec"]["count"] == 1
+    assert snap["histograms"]["serve.tok_usec"]["count"] == 1
+    assert snap["counters"]["serve.tokens_out"] == 2 * 5
+    assert snap["histograms"]["serve.ttft_usec"]["min"] > 0
+
+    # sampling path: same key stream -> same tokens as generate()
+    key = jax.random.PRNGKey(0)
+    got_s = np.asarray(generate_timed(params, prompt, CFG, max_new=3,
+                                      temperature=0.7, rng=key,
+                                      metrics=reg))
+    want_s = np.asarray(generate(params, prompt, CFG, max_new=3,
+                                 temperature=0.7, rng=key))
+    np.testing.assert_array_equal(got_s, want_s)

@@ -1,12 +1,15 @@
-"""Tracing subsystem: Python and C engines emit the same event stream.
+"""Tracing + metrics: Python and C engines emit the same streams.
 
 The reference has no tracing (SURVEY.md §5); the rebuild's oracle is
 cross-implementation: the identical scenario (one bcast + one vetoed IAR
 round on the same world size) must produce the same multiset of protocol
-events from the Python engine and the native C core, and the jax.profiler
-integration must annotate device work without error.
+events — AND the same metrics-registry snapshot (counter keys identical,
+deterministic values equal) — from the Python engine and the native C
+core, and the jax.profiler integration must annotate device work without
+error.
 """
 
+import copy
 from collections import Counter
 
 import pytest
@@ -19,7 +22,7 @@ from rlo_tpu.utils.tracing import TRACER, Ev, Tracer, annotate
 WS = 8
 
 
-def run_python_scenario():
+def run_python_scenario(metrics: bool = False):
     """One bcast from rank 2 + one vetoed proposal from rank 0."""
     world = LoopbackWorld(WS)
     mgr = EngineManager()
@@ -27,6 +30,9 @@ def run_python_scenario():
         world.transport(r),
         judge_cb=lambda payload, ctx, r=r: 0 if r == WS - 1 else 1,
         manager=mgr) for r in range(WS)]
+    if metrics:
+        for e in engines:
+            e.enable_metrics()
     engines[2].bcast(b"hello")
     drain([world], engines)
     for e in engines:
@@ -34,16 +40,21 @@ def run_python_scenario():
             pass
     engines[0].submit_proposal(b"prop", pid=0)
     drain([world], engines)
+    snaps = [e.metrics() for e in engines]
     for e in engines:
         e.cleanup()
+    return snaps
 
 
-def run_native_scenario():
+def run_native_scenario(metrics: bool = False):
     with nb.NativeWorld(WS) as world:
         engines = [nb.NativeEngine(
             world, r,
             judge_cb=lambda payload, ctx, r=r: 0 if r == WS - 1 else 1)
             for r in range(WS)]
+        if metrics:
+            for e in engines:
+                e.enable_metrics()
         engines[2].bcast(b"hello")
         world.drain()
         for e in engines:
@@ -52,6 +63,7 @@ def run_native_scenario():
         rc = engines[0].submit_proposal(b"prop", pid=0)
         if rc == -1:
             world.drain()
+        return [e.metrics() for e in engines]
 
 
 def python_event_counts():
@@ -88,6 +100,94 @@ def test_python_and_native_emit_identical_streams():
     assert py["DELIVER"] == WS - 1
     # every non-proposer judged the proposal (the veto rank too)
     assert py["JUDGE"] == WS - 1
+
+
+def _scrub_timing(snap):
+    """Zero the wall-clock-dependent metric fields (histogram
+    sum/min/max/bucket spread, RTT EWMA) so snapshots compare on the
+    deterministic parts; every KEY stays, so schema parity is asserted
+    in full."""
+    snap = copy.deepcopy(snap)
+    for link in snap["links"].values():
+        link["rtt_ewma_usec"] = 0.0
+    for h in snap["op_latency_usec"].values():
+        h["sum"] = h["min"] = h["max"] = 0.0
+        h["buckets"] = [0] * len(h["buckets"])
+    return snap
+
+
+def test_python_and_native_report_identical_metrics():
+    """Metrics parity (the registry face of the event-parity oracle):
+    same scenario -> identical counter keys AND matching deterministic
+    values — per-link frame/byte counts, ARQ counters, queue depths,
+    histogram counts — from both engines. Only wall-clock-derived
+    fields (latency sums/extremes, RTT EWMA) are exempt."""
+    py = [_scrub_timing(s) for s in run_python_scenario(metrics=True)]
+    nat = [_scrub_timing(s) for s in run_native_scenario(metrics=True)]
+    for r in range(WS):
+        assert py[r] == nat[r], (r, py[r], nat[r])
+    # structural sanity: rank 2's bcast fan-out was accounted, every
+    # rank delivered it, and the histograms saw the ops complete
+    assert py[2]["counters"]["sent_bcast"] == 1
+    assert py[2]["op_latency_usec"]["bcast_complete"]["count"] == 1
+    assert py[0]["op_latency_usec"]["proposal_resolve"]["count"] == 1
+    for r in range(WS):
+        if r == 2:
+            continue
+        assert py[r]["op_latency_usec"]["pickup_wait"]["count"] >= 1
+        total_rx = sum(l["rx_frames"] for l in py[r]["links"].values())
+        assert total_rx >= 1
+
+
+def test_metrics_disabled_schema_is_stable():
+    """metrics() with collection off returns the same keys (zeros in
+    the gated sections) — dashboards need one schema, not two."""
+    on = run_python_scenario(metrics=True)[0]
+    off = run_python_scenario(metrics=False)[0]
+
+    def keys(d, prefix=""):
+        out = set()
+        for k, v in d.items():
+            out.add(f"{prefix}{k}")
+            if isinstance(v, dict):
+                out |= keys(v, f"{prefix}{k}.")
+        return out
+
+    assert keys(on) == keys(off)
+    assert all(l["tx_frames"] == 0 for l in off["links"].values())
+    # counters are always live — they predate the registry
+    assert off["counters"]["sent_bcast"] == on["counters"]["sent_bcast"]
+
+
+def test_tracer_rings_report_dropped_consistently():
+    """Overflow accounting satellite: both rings at capacity report
+    `dropped` with the same semantics — emitted minus capacity — and
+    keep exactly `capacity` newest events."""
+    # Python ring (capacity is a constructor knob)
+    cap, extra = 64, 9
+    t = Tracer(capacity=cap)
+    with t.enable():
+        for i in range(cap + extra):
+            t.emit(0, Ev.DELIVER, i)
+    assert t.dropped == extra
+    evs = t.events()
+    assert len(evs) == cap
+    assert [e.a for e in evs] == list(range(extra, cap + extra))
+
+    # C ring (fixed capacity, same overwrite-oldest semantics)
+    ccap = nb.trace_capacity()
+    nb.trace_clear()
+    nb.trace_set(True)
+    try:
+        for i in range(ccap + extra):
+            nb.trace_emit(0, int(Ev.DELIVER), i)
+    finally:
+        nb.trace_set(False)
+    assert nb.trace_dropped() == extra
+    evs = nb.trace_drain(ccap + extra)
+    assert len(evs) == ccap
+    assert evs[0]["a"] == extra and evs[-1]["a"] == ccap + extra - 1
+    nb.trace_clear()
 
 
 def test_tracer_disabled_emits_nothing():
