@@ -40,6 +40,16 @@ echo "== observability smoke (loopback soak -> chrome timeline) =="
 # (flow edges included) — docs/DESIGN.md §7
 JAX_PLATFORMS=cpu python -m rlo_tpu.utils.timeline smoke
 
+echo "== simulator fuzz sweep (25 seeds x 4 chaos scripts) =="
+# fixed-seed deterministic sweep over the partition/restart/burst-loss/
+# mixed scenario scripts — exactly-once, termination, and membership
+# convergence checked per run; a violation prints the seed + a replay
+# recipe (docs/DESIGN.md §8). The C engine runs the same shapes via
+# the native loopback fault hooks inside pytest
+# (tests/test_membership.py); the long 500-run sweep is
+# `pytest tests/test_sim.py -m slow`.
+JAX_PLATFORMS=cpu python -m rlo_tpu.transport.sim --seeds 25
+
 echo "== manual-ring validation (8 virtual devices) =="
 PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
     XLA_FLAGS=--xla_force_host_platform_device_count=8 \

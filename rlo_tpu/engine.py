@@ -41,12 +41,43 @@ from typing import Callable, List, Optional, Sequence, Set
 
 from rlo_tpu import topology
 from rlo_tpu.transport.base import SendHandle, Transport
-from rlo_tpu.utils.metrics import Histogram, LinkStats
+from rlo_tpu.utils.metrics import ENGINE_COUNTER_KEYS, Histogram, LinkStats
 from rlo_tpu.utils.tracing import TRACER, Ev
-from rlo_tpu.wire import (ARQ_EXEMPT_TAGS, BCAST_TAGS, Frame, MSG_SIZE_MAX,
-                          Tag, restamp_seq)
+from rlo_tpu.wire import (ARQ_EXEMPT_TAGS, BCAST_TAGS, EPOCH_EXEMPT_TAGS,
+                          Frame, MSG_SIZE_MAX, Tag, restamp_epoch,
+                          restamp_link)
 
 logger = logging.getLogger("rlo_tpu.engine")
+
+#: Prefix marking an IAR proposal payload as an internal membership
+#: admission round (docs/DESIGN.md §8): the engine judges and executes
+#: these itself (the rootless consensus op voting on its own
+#: membership) instead of handing them to the application callbacks.
+#: Admission rounds use pids in the reserved NEGATIVE pid namespace.
+MEMBER_MAGIC = b"RLOJ\x01"
+
+#: Membership admission rounds live in the reserved pid namespace
+#: pid <= MEMBER_PID_BASE; app pids are >= -1 (-1 is the unset
+#: sentinel). pid = MEMBER_PID_BASE - (joiner * world_size + proposer)
+#: keeps CONCURRENT admissions of one joiner by different proposers on
+#: distinct pids (IAR forbids concurrent same-pid proposals); the
+#: second decision's admission is an idempotent no-op.
+MEMBER_PID_BASE = -2
+
+#: Incarnation-partitioned sequence spaces: a restarted rank's fresh
+#: broadcast seqs and round generations start at ``incarnation << 20``,
+#: above anything its previous life can have used, so peers' per-origin
+#: dedup windows never swallow post-restart traffic and stale
+#: old-incarnation frames always fall below the watermark. Bounds each
+#: incarnation to ~1M broadcasts/rounds (documented in DESIGN.md §8).
+INCARNATION_SHIFT = 20
+def _incarnation_cap(world_size: int) -> int:
+    """Largest incarnation whose shifted seq/gen base still fits the
+    int32 wire fields AFTER the rank-qualification multiply (gen =
+    counter * world_size + rank, see submit_proposal) — enforced at
+    construction and in rejoin(), mirrored by
+    rlo_engine_set_incarnation."""
+    return ((2**31 - 1) // max(world_size, 1)) >> INCARNATION_SHIFT
 
 
 def _trace_ident(tag: int, frame: Frame) -> int:
@@ -215,7 +246,8 @@ class ProgressEngine:
                  fanout: Optional[str] = None,
                  arq_rto: Optional[float] = None,
                  arq_max_retries: int = 8,
-                 op_deadline: Optional[float] = None):
+                 op_deadline: Optional[float] = None,
+                 incarnation: int = 0):
         """``failure_timeout`` (seconds) enables the net-new failure
         detector (the reference defines RLO_FAILED but never assigns it,
         SURVEY.md §5): ranks heartbeat their ring successor every
@@ -256,6 +288,19 @@ class ProgressEngine:
         the failure detector's job, not ARQ's). Receivers dedup on
         (sender, seq) BEFORE tag dispatch, so retransmits are
         idempotent through the store-and-forward broadcast path.
+
+        ``incarnation`` identifies this engine's life at its rank
+        (docs/DESIGN.md §8): a restarted process passes a HIGHER
+        incarnation than its previous life (or calls ``rejoin()``,
+        which bumps it) so survivors can tell its fresh traffic from
+        the dead incarnation's. Broadcast sequence numbers and round
+        generations are partitioned by incarnation (each life starts
+        its counters at ``incarnation << 20``), keeping the
+        exactly-once dedup windows correct across restarts without any
+        persisted state. An engine constructed with ``incarnation > 0``
+        starts in JOINER mode: it quarantines everything and petitions
+        with Tag.JOIN probes until a surviving member admits it
+        (docs/DESIGN.md §8).
 
         ``op_deadline`` (seconds, relative) is the default deadline for
         bcast/submit_proposal ops; per-call ``deadline=`` overrides. A
@@ -307,8 +352,10 @@ class ProgressEngine:
         # frame's vote field and is echoed by every vote and decision,
         # so a stale message from an earlier same-pid round can never
         # be merged into a later one. Persisted by engine snapshots so
-        # a restored engine never reissues a pre-snapshot generation.
-        self._gen_next = 1
+        # a restored engine never reissues a pre-snapshot generation;
+        # incarnation-partitioned so an unsnapshotted restart never
+        # reissues one either.
+        self._gen_next = (incarnation << INCARNATION_SHIFT) + 1
 
         # exactly-once broadcast bookkeeping: every Tag.BCAST frame this
         # rank initiates is stamped with a monotone sequence number (in
@@ -316,8 +363,10 @@ class ProgressEngine:
         # (origin, seq) so a broadcast whose forwarding crosses a
         # membership change can never deliver twice, and survivors
         # re-flood their recent-broadcast log on every view change so it
-        # cannot be lost either (see _mark_failed)
-        self._bcast_seq = 0
+        # cannot be lost either (see _mark_failed). Incarnation-
+        # partitioned: a restarted rank's fresh seqs start above its
+        # previous life's, so peers' dedup windows stay correct.
+        self._bcast_seq = incarnation << INCARNATION_SHIFT
         # origin -> [contig, set(seqs > contig)]: all seqs <= contig seen
         self._seen_bcast: dict = {}
         # ring log of recently initiated/forwarded BCAST frames (raw
@@ -366,6 +415,70 @@ class ProgressEngine:
         self.op_deadline = op_deadline
         self.ops_failed = 0
 
+        # membership epochs + elastic rejoin (docs/DESIGN.md §8).
+        # ``epoch`` is this rank's monotone view counter: every failure
+        # declaration/adoption and every admission bumps it, and the
+        # send gate stamps it into every outgoing frame (retransmits
+        # and re-floods are restamped with the CURRENT epoch).
+        # ``_epoch_floor[sender]`` is the minimum frame epoch accepted
+        # from a readmitted sender — everything below it is the dead
+        # incarnation's stale traffic and is quarantined, not
+        # dispatched. ``_awaiting_welcome`` is the joiner-side gate: a
+        # rank that has learned it must rejoin quarantines EVERYTHING
+        # except membership frames until the admitting proposer's
+        # JOIN_WELCOME arrives (this is what closes the stale-ACK race
+        # on link-sequence resets — see _execute_admission).
+        inc_cap = _incarnation_cap(self.world_size)
+        if not 0 <= incarnation <= inc_cap:
+            raise ValueError(
+                f"incarnation must be in [0, {inc_cap}] for "
+                f"world_size {self.world_size} (the shifted, "
+                f"rank-qualified gen base must fit int32 wire "
+                f"fields), got {incarnation}")
+        self.incarnation = incarnation
+        self.epoch = 0
+        self.epoch_quarantined = 0
+        self.rejoins = 0
+        self._epoch_floor: dict = {}    # sender -> min accepted epoch
+        self._awaiting_welcome = incarnation > 0
+        self._join_last_probe = float("-inf")
+        self._admitted: dict = {}       # joiner -> admitted incarnation
+        self._admitting: Set[int] = set()  # joiners with a round in flight
+        # joiner -> (incarnation, joiner epoch): petitions waiting for
+        # the (single) own-proposal slot to free up
+        self._pending_joins: dict = {}
+        # joiner -> highest admission epoch EXECUTED here: admissions
+        # are idempotent per (joiner, epoch), so a stale or duplicate
+        # decision re-flooded out of an older view can never re-run
+        # the link-state reset (a one-sided reset permanently desyncs
+        # the ARQ windows) or resurrect a replaced membership view
+        self._admit_epoch: dict = {}
+        # dst -> LINK epoch: the admission epoch of the last link-state
+        # reset on that edge (0 = the original link). This — not the
+        # current view epoch — is what the send gate stamps into the
+        # frame header: the receiver's floor is the epoch of ITS last
+        # reset of the edge, so the stamp identifies which life of the
+        # link a frame belongs to, and a stale life's frames (or
+        # retransmits) can never pollute a freshly reset dedup window
+        self._link_epoch: dict = {}
+        # epoch of the last JOIN_WELCOME this rank adopted — FAILURE
+        # notices about me declared below it are pre-rejoin leftovers
+        self._welcome_epoch = 0
+        # ranks excluded at construction by a sub-communicator engine:
+        # never probed, never admitted (they are not failed members,
+        # they were never members at all)
+        self._sub_excluded: Set[int] = set()
+        # JOIN probe cadence: the failure detector's heartbeat interval
+        # when it is on, else a conservative default for explicit
+        # rejoin() use on detector-less engines
+        self.join_interval = self.heartbeat_interval or 0.5
+        # stale-sender nack stamp: a below-floor frame from a rank we
+        # consider ALIVE means it missed its JOIN_WELCOME (the welcome
+        # is one-shot and ARQ-exempt) — answer with a view probe so
+        # the stale island re-petitions instead of being silently
+        # quarantined forever (rate-limited per sender)
+        self._stale_probe_last: dict = {}
+
         # metrics registry (docs/DESIGN.md §7): per-link frame/byte/
         # retransmit/RTT accounting + op-latency histograms, snapshot
         # via metrics(). Disabled by default — the hot-path cost of
@@ -398,7 +511,8 @@ class ProgressEngine:
             # re-flood, discounting) already consults the alive view
             self.failed = set(range(ws)) - set(group)
             self._alive = group
-            self._v = {r: i for i, r in enumerate(group)}
+            self._v = topology.virtual_map(group)
+            self._sub_excluded = set(range(ws)) - set(group)
         self.group = list(self._alive)
 
         self.manager = manager
@@ -434,9 +548,19 @@ class ProgressEngine:
             ls.tx_bytes += len(raw)
         return self.transport.isend(dst, int(tag), raw)
 
+    def _ep(self, dst: int) -> int:
+        """The LINK epoch stamped into frames toward ``dst``: the
+        admission epoch of the last link reset on that edge
+        (docs/DESIGN.md §8). Receivers quarantine frames below their
+        own floor for the edge, so a stale link-life's traffic can
+        never touch the fresh dedup windows."""
+        return self._link_epoch.get(dst, 0)
+
     def _send_raw(self, dst: int, tag: int, raw: bytes) -> SendHandle:
         """The one gate every fresh engine frame leaves through: stamps
-        the link seq and registers the retransmit entry when ARQ is
+        the link epoch (so a dead link-life's frames are mechanically
+        distinguishable from post-reset traffic, docs/DESIGN.md §8)
+        and the link seq, registering the retransmit entry when ARQ is
         on; per-link tx accounting when metrics are on (one branch
         when off — the §7 overhead contract)."""
         if self._mx_on:
@@ -444,10 +568,11 @@ class ProgressEngine:
             ls.tx_frames += 1
             ls.tx_bytes += len(raw)
         if self.arq_rto is None or tag in ARQ_EXEMPT_TAGS:
+            raw = restamp_epoch(raw, self._ep(dst))
             return self.transport.isend(dst, int(tag), raw)
         seq = self._tx_seq.get(dst, 0)
         self._tx_seq[dst] = seq + 1
-        raw = restamp_seq(raw, seq)
+        raw = restamp_link(raw, seq, self._ep(dst))
         due = self.clock() + self.arq_rto
         self._tx_unacked.setdefault(dst, {})[seq] = _ArqEntry(
             tag=int(tag), raw=raw, due=due, sent=due - self.arq_rto)
@@ -518,6 +643,21 @@ class ProgressEngine:
                 # frames only (Karn's rule: a retransmitted frame's
                 # ack is ambiguous about which copy it answers)
                 self._link(src).rtt_sample((now - ent.sent) * 1e6)
+        # unfillable hole: the receiver's watermark sits below seqs I
+        # no longer hold (its window was reset by an admission/welcome
+        # while mine carried on — tx seqs are monotone per lifetime).
+        # I can never retransmit (cum, min held) — ACKs are FIFO per
+        # channel, so the gap is permanent — so tell it to skip ahead
+        # now instead of retransmitting the held frames to exhaustion
+        # (which would end in a spurious half-dead-link FAILURE)
+        if q:
+            lo = min(q)
+            if lo > cum + 1:
+                sk = self._tx_skip.setdefault(
+                    src, [-1, float("-inf")])
+                if lo - 1 > sk[0]:
+                    sk[0] = lo - 1
+                    sk[1] = self.clock()  # send this tick
 
     def _arq_tick(self) -> None:
         """Retransmit sweep: resend overdue unacked frames with
@@ -533,8 +673,16 @@ class ProgressEngine:
         no lower seq is still being retried (the receiver's advanced
         watermark would misread those retransmits as duplicates), and
         it repeats at rto cadence until an ACK at or past the skipped
-        seq proves the watermark moved."""
+        seq proves the watermark moved.
+
+        A give-up also escalates to the failure detector: a peer that
+        swallowed max_retries retransmits is a half-dead link, and the
+        membership layer treats it exactly like a silent heartbeat
+        predecessor — declared FAILED, announced to the world, overlay
+        re-formed (declared after the sweep: _mark_failed mutates the
+        retransmit queues)."""
         now = self.clock()
+        gave_up_on: List[int] = []
         for dst, q in self._tx_unacked.items():
             if dst in self.failed:
                 if q:
@@ -547,6 +695,10 @@ class ProgressEngine:
                 if ent.retries >= self.arq_max_retries:
                     del q[seq]
                     self.arq_gave_up += 1
+                    TRACER.emit(self.rank, Ev.ARQ_GIVEUP, dst,
+                                ent.retries)
+                    if dst not in gave_up_on:
+                        gave_up_on.append(dst)
                     sk = self._tx_skip.setdefault(dst, [-1, now])
                     if seq > sk[0]:
                         sk[0] = seq
@@ -557,15 +709,27 @@ class ProgressEngine:
                 self.arq_retransmits += 1
                 if self._mx_on:
                     self._link(dst).retransmits += 1
-                # same raw bytes, same seq: the receiver dedups
-                self._isend_counted(dst, ent.tag, ent.raw)
+                # same seq (the receiver dedups), same link epoch (the
+                # retransmit belongs to the same life of the link)
+                self._isend_counted(dst, ent.tag,
+                                    restamp_epoch(ent.raw,
+                                                  self._ep(dst)))
             sk = self._tx_skip.get(dst)
             if sk is not None and now >= sk[1] and \
                     all(s > sk[0] for s in q):
                 self._isend_counted(
                     dst, int(Tag.ACK),
-                    Frame(origin=self.rank, pid=sk[0], vote=-2).encode())
+                    Frame(origin=self.rank, pid=sk[0], vote=-2,
+                          epoch=self._ep(dst)).encode())
                 sk[1] = now + self.arq_rto
+        for dst in gave_up_on:
+            if dst not in self.failed and not self._awaiting_welcome:
+                logger.warning(
+                    "rank %d declaring rank %d FAILED: ARQ gave up "
+                    "after %d retries (half-dead link)", self.rank,
+                    dst, self.arq_max_retries)
+                TRACER.emit(self.rank, Ev.FAILURE, dst, 1)
+                self._announce_failed(dst)
 
     def _flush_acks(self) -> None:
         """Send the owed cumulative ACKs (at most one per sender per
@@ -576,7 +740,8 @@ class ProgressEngine:
                 continue
             self._isend_counted(
                 src, int(Tag.ACK),
-                Frame(origin=self.rank, vote=self._rx_cum(src)).encode())
+                Frame(origin=self.rank, vote=self._rx_cum(src),
+                      epoch=self._ep(src)).encode())
         self._ack_due.clear()
 
     def arq_unacked(self) -> int:
@@ -614,17 +779,24 @@ class ProgressEngine:
             # round-trip (benchmarks emit snapshots) share one schema
             links[str(peer)] = ls.snapshot() if ls is not None \
                 else LinkStats().snapshot()
+        vals = {
+            "sent_bcast": self.sent_bcast_cnt,
+            "recved_bcast": self.recved_bcast_cnt,
+            "total_pickup": self.total_pickup,
+            "ops_failed": self.ops_failed,
+            "arq_retransmits": self.arq_retransmits,
+            "arq_dup_drops": self.arq_dup_drops,
+            "arq_gave_up": self.arq_gave_up,
+            "arq_unacked": self.arq_unacked(),
+            "epoch": self.epoch,
+            "epoch_quarantined": self.epoch_quarantined,
+            "rejoins": self.rejoins,
+        }
         return {
-            "counters": {
-                "sent_bcast": self.sent_bcast_cnt,
-                "recved_bcast": self.recved_bcast_cnt,
-                "total_pickup": self.total_pickup,
-                "ops_failed": self.ops_failed,
-                "arq_retransmits": self.arq_retransmits,
-                "arq_dup_drops": self.arq_dup_drops,
-                "arq_gave_up": self.arq_gave_up,
-                "arq_unacked": self.arq_unacked(),
-            },
+            # ENGINE_COUNTER_KEYS is the schema contract with the C
+            # engine (bindings.NativeEngine.metrics builds from the
+            # same tuple; the parity test asserts dict equality)
+            "counters": {k: vals[k] for k in ENGINE_COUNTER_KEYS},
             "queues": {
                 "wait": len(self.queue_wait),
                 "pickup": len(self.queue_pickup),
@@ -668,14 +840,18 @@ class ProgressEngine:
             self._bcast_seq += 1
         frame = Frame(origin=self.rank, pid=pid, vote=vote, payload=payload)
         raw = frame.encode()
-        if Tag(tag) in (Tag.BCAST, Tag.IAR_DECISION, Tag.ABORT):
+        if Tag(tag) in (Tag.BCAST, Tag.IAR_DECISION, Tag.ABORT,
+                        Tag.FAILURE):
             # decisions join the re-flood log: a decision lost in a
             # view-change window would otherwise leave relayed rounds
             # parked forever (blocking checkpoint) — the settled-set
             # dedup absorbs the flood exactly like (origin, seq) does
             # for broadcasts. Aborts ride the same log for the same
             # reason: an abort lost with a dead relay would leave the
-            # aborted round parked at its descendants.
+            # aborted round parked at its descendants. Failure
+            # declarations ride it too (docs/DESIGN.md §8) — receivers
+            # suppress known failures, and admission purges the log of
+            # stale notices about the readmitted rank.
             self._recent_bcasts.append((int(tag), raw))
         msg = _Msg(frame=frame, tag=int(tag))
         if deadline is None:
@@ -822,13 +998,6 @@ class ProgressEngine:
             if item is None:
                 break
             src, tag, raw = item
-            if self.failure_timeout is not None and 0 <= src < \
-                    self.world_size:
-                # ANY frame proves the sender alive — under heavy
-                # traffic this prevents heartbeat starvation when
-                # membership views transiently diverge (each view picks
-                # different ring successors)
-                self._hb_seen[src] = self.clock()
             msg = _Msg(frame=Frame.decode(raw), tag=tag, src=src)
             if self._mx_on:
                 if 0 <= src < self.world_size:
@@ -836,6 +1005,46 @@ class ProgressEngine:
                     ls.rx_frames += 1
                     ls.rx_bytes += len(raw)
                 msg.arrived = self.clock()
+            # membership frames cross the boundaries the quarantine
+            # below enforces — dispatch them first (docs/DESIGN.md §8)
+            if tag in EPOCH_EXEMPT_TAGS:
+                if tag == Tag.JOIN:
+                    self._on_join(msg)
+                else:
+                    self._on_welcome(msg)
+                continue
+            # stale-epoch / failed-sender quarantine, BEFORE ACK
+            # handling and the ARQ dedup: a dead incarnation's traffic
+            # (and everything while this rank is itself mid-rejoin)
+            # must not touch link state, liveness, or app state
+            if self._awaiting_welcome:
+                self.epoch_quarantined += 1
+                continue
+            if 0 <= src < self.world_size:
+                if src in self.failed:
+                    self.epoch_quarantined += 1
+                    continue
+                floor = self._epoch_floor.get(src)
+                if floor is not None and msg.frame.epoch < floor:
+                    self.epoch_quarantined += 1
+                    # stale-sender nack: an ALIVE sender stamping
+                    # below our floor missed its welcome — show it
+                    # the winning view so it re-petitions (closes the
+                    # lost-JOIN_WELCOME race: no heal probe fires at
+                    # it because neither side holds the other failed)
+                    now = self.clock()
+                    if now - self._stale_probe_last.get(
+                            src, float("-inf")) >= self.join_interval:
+                        self._stale_probe_last[src] = now
+                        self._send_join_probe(src)
+                    continue
+            if self.failure_timeout is not None and 0 <= src < \
+                    self.world_size:
+                # ANY accepted frame proves the sender alive — under
+                # heavy traffic this prevents heartbeat starvation when
+                # membership views transiently diverge (each view picks
+                # different ring successors)
+                self._hb_seen[src] = self.clock()
             if tag == Tag.ACK:
                 if msg.frame.vote == -2 and msg.frame.pid >= 0:
                     # SKIP notice: the sender gave up on everything
@@ -883,9 +1092,22 @@ class ProgressEngine:
             else:
                 self._on_other(msg)
 
-        # (b2) liveness: heartbeat my ring successor, watch my predecessor
-        if self.failure_timeout is not None:
+        # (b2) liveness: heartbeat my ring successor, watch my
+        # predecessor — suspended while mid-rejoin (a joiner
+        # quarantines everything, so its detector would only produce
+        # false declarations against peers it cannot hear)
+        if self.failure_timeout is not None and \
+                not self._awaiting_welcome:
             self._failure_tick()
+
+        # (b2b) membership: JOIN petitions (joiner side), heal probes
+        # at failed-but-maybe-alive peers, and queued admission rounds
+        # waiting for the own-proposal slot (docs/DESIGN.md §8)
+        if self._awaiting_welcome or self._pending_joins or \
+                len(self.failed) > len(self._sub_excluded):
+            # (len compare: _sub_excluded is always a subset of
+            # failed, and the set difference would allocate per tick)
+            self._membership_tick()
 
         # (b3) reliable delivery: retransmit overdue unacked frames,
         # then flush the cumulative ACKs this turn's receipts owe
@@ -990,7 +1212,12 @@ class ProgressEngine:
 
     # -- IAR handlers (~rootless_ops.c:668-859) ---------------------------
     def _judge(self, payload: bytes, pid: int) -> int:
-        if self.judge_cb is None:
+        if payload.startswith(MEMBER_MAGIC):
+            # internal membership admission round (docs/DESIGN.md §8):
+            # the engine judges it itself — the app's judge never sees
+            # protocol-internal rounds
+            verdict = 1
+        elif self.judge_cb is None:
             verdict = 1
         else:
             verdict = int(self.judge_cb(payload, self.app_ctx))
@@ -1026,6 +1253,11 @@ class ProgressEngine:
     def _on_proposal(self, msg: _Msg) -> None:
         """~_iar_proposal_handler (:668-726)."""
         origin = msg.frame.origin
+        if origin == self.rank:
+            # my own proposal echoed back around a re-formed overlay
+            # cycle (mixed views while membership converges): the
+            # proposer holds no relay state and must not re-forward
+            return
         # duplicate across a view change (mixed old/new overlay trees):
         # never re-judge or re-park — a second ProposalState voting to a
         # second parent would corrupt the vote accounting. Forward for
@@ -1149,12 +1381,26 @@ class ProgressEngine:
             # changed the app state since submission (:773)
             p.vote = self._judge(self.my_proposal_payload, p.pid)
         self._decision_bcast(p)
+        if p.pid <= MEMBER_PID_BASE:
+            # membership round: the admitting proposer executes the
+            # admission right after fanning the decision out (the
+            # decision itself was routed over the PRE-admission
+            # member-only overlay), then welcomes + replays to the
+            # joiner (docs/DESIGN.md §8)
+            self._finish_member_round(p)
 
     def _decision_bcast(self, p: ProposalState) -> None:
         """Proposer broadcasts the final decision (~_iar_decision_bcast
         :908-917) — a regular rootless broadcast with the decision in the
-        vote field and the round generation in the payload."""
-        msg = self.bcast(struct.pack("<i", p.gen), tag=Tag.IAR_DECISION,
+        vote field and the round generation in the payload. Membership
+        rounds append the admission record (MEMBER_MAGIC + joiner/
+        incarnation/epoch) so every member can execute the admission
+        from the decision alone, even if it never saw the proposal
+        (generation readers only unpack the first 4 bytes)."""
+        payload = struct.pack("<i", p.gen)
+        if p.pid <= MEMBER_PID_BASE:
+            payload += self.my_proposal_payload
+        msg = self.bcast(payload, tag=Tag.IAR_DECISION,
                          pid=p.pid, vote=p.vote)
         p.decision_handles = list(msg.send_handles)
         p.decision_pending = True
@@ -1173,6 +1419,12 @@ class ProgressEngine:
         self.ops_failed += 1
         self._prop_born = None  # resolve latency tracks successes only
         TRACER.emit(self.rank, Ev.DECISION, p.pid, -1, p.gen)
+        if p.pid <= MEMBER_PID_BASE:
+            # aborted admission round: free the joiner for a retry
+            # (its next JOIN probe re-petitions)
+            joiner = self._member_joiner(p.pid)
+            if joiner is not None:
+                self._admitting.discard(joiner)
         self.bcast(struct.pack("<i", p.gen), tag=Tag.ABORT, pid=p.pid)
 
     def _on_abort(self, msg: _Msg) -> None:
@@ -1199,7 +1451,15 @@ class ProgressEngine:
             self._recent_bcasts.append((int(Tag.ABORT),
                                         msg.frame.encode()))
         pm = self._find_proposal_msg(pid, gen)
-        self._bc_forward(msg)  # forwards AND queues the notice for pickup
+        if pid <= MEMBER_PID_BASE:
+            # aborted membership round: engine-internal — unpark but
+            # never deliver to the app; the joiner stays petitionable
+            joiner = self._member_joiner(pid)
+            if joiner is not None:
+                self._admitting.discard(joiner)
+            self._bc_forward_only(msg)
+        else:
+            self._bc_forward(msg)  # forwards AND queues for pickup
         if pm is not None:
             pm.prop_state.state = ReqState.FAILED
             self.queue_iar_pending.remove(pm)
@@ -1231,6 +1491,25 @@ class ProgressEngine:
                                         msg.frame.encode()))
         pm = self._find_proposal_msg(pid, gen)
         self._bc_forward(msg)  # forward first; delivery below
+        if pid <= MEMBER_PID_BASE:
+            # membership round: engine-internal. Execute the admission
+            # from the decision's embedded record (works even when
+            # this rank never saw the proposal), unpark any relayed
+            # round WITHOUT the app action, and never deliver to
+            # pickup — but keep tracking the forward handles.
+            if pm is not None:
+                pm.prop_state.state = (ReqState.COMPLETED if vote
+                                       else ReqState.FAILED)
+                self.queue_iar_pending.remove(pm)
+            adm = self._member_decode(msg.frame.payload[4:])
+            if adm is not None:
+                joiner, inc, ep = adm
+                self._admitting.discard(joiner)
+                self._pending_joins.pop(joiner, None)
+                if vote:
+                    self._execute_admission(joiner, inc, ep)
+            self.queue_wait.append(msg)
+            return
         if pm is not None:
             if vote:
                 # approved: execute the user action (:842) — on every
@@ -1311,9 +1590,7 @@ class ProgressEngine:
         return tuple(alive[v] for v in vt)
 
     def _ring_neighbors(self):
-        alive = self._alive
-        i = alive.index(self.rank)
-        return alive[(i + 1) % len(alive)], alive[(i - 1) % len(alive)]
+        return topology.ring_neighbors(self._alive, self.rank)
 
     def _failure_tick(self) -> None:
         if len(self._alive) < 2:
@@ -1326,7 +1603,8 @@ class ProgressEngine:
             # drains at heartbeat cadence
             hb_payload = (struct.pack("<i", self._rx_cum(succ))
                           if self.arq_rto is not None else b"")
-            frame = Frame(origin=self.rank, payload=hb_payload)
+            frame = Frame(origin=self.rank, payload=hb_payload,
+                          epoch=self._ep(succ))
             self._isend_counted(succ, int(Tag.HEARTBEAT), frame.encode())
             self._hb_last_sent = now
             TRACER.emit(self.rank, Ev.HEARTBEAT, succ)
@@ -1334,18 +1612,39 @@ class ProgressEngine:
         if now - seen > self.failure_timeout:
             self._declare_failed(pred)
 
+    def _announce_failed(self, rank: int) -> bool:
+        """Adopt + announce a failure THIS rank detected (heartbeat
+        silence or ARQ give-up): mark, then tell the world — the
+        notice rides the rootless broadcast overlay AND goes
+        point-to-point to every alive rank (belt and braces: overlay
+        forwarding can have holes while membership views are still
+        converging; duplicate notices are suppressed at the receiver).
+        Returns False when the failure was already known."""
+        if not self._mark_failed(rank):
+            return False
+        # the vote field carries the DECLARER's epoch at declaration
+        # time: unlike the header epoch (restamped on every re-flood/
+        # retransmit) it is immutable, so receivers can recognize a
+        # stale notice about a rank that was readmitted since
+        self.bcast(b"", tag=Tag.FAILURE, pid=rank, vote=self.epoch)
+        frame = Frame(origin=self.rank, pid=rank, vote=self.epoch)
+        raw = frame.encode()
+        for dst in self._alive:
+            if dst != self.rank:
+                self._send_raw(dst, int(Tag.FAILURE), raw)
+        if self.failure_cb is not None:
+            self.failure_cb(rank, True)
+        return True
+
     def _declare_failed(self, rank: int) -> None:
-        """Local detection: mark, then tell the world — the failure notice
-        rides the rootless broadcast overlay AND goes point-to-point to
-        every alive rank (belt and braces: overlay forwarding can have
-        holes while membership views are still converging; duplicate
-        notices are suppressed at the receiver)."""
+        """Local heartbeat detection: capture the evidence, then adopt
+        + announce via _announce_failed."""
         # capture the evidence BEFORE _mark_failed clears the slot: the
         # last-seen heartbeat age is what makes a false-positive
         # declaration diagnosable after the fact
         seen = self._hb_seen.get(rank)
         age = (self.clock() - seen) if seen is not None else float("inf")
-        if not self._mark_failed(rank):
+        if not self._announce_failed(rank):
             return
         age_usec = (min(int(age * 1e6), 2**31 - 1)
                     if age != float("inf") else 2**31 - 1)
@@ -1355,14 +1654,6 @@ class ProgressEngine:
             self.rank, rank, age * 1e3, self.failure_timeout * 1e3,
             self.heartbeat_interval * 1e3, self._alive)
         TRACER.emit(self.rank, Ev.FAILURE, rank, 1, age_usec)
-        self.bcast(b"", tag=Tag.FAILURE, pid=rank)
-        frame = Frame(origin=self.rank, pid=rank)
-        raw = frame.encode()
-        for dst in self._alive:
-            if dst != self.rank:
-                self._send_raw(dst, int(Tag.FAILURE), raw)
-        if self.failure_cb is not None:
-            self.failure_cb(rank, True)
 
     def _on_failure(self, msg: _Msg) -> None:
         """A FAILURE notification arrived: adopt the new membership BEFORE
@@ -1371,13 +1662,23 @@ class ProgressEngine:
         Duplicates (the notice floods: overlay + direct sends) are
         dropped entirely — each failure is delivered exactly once."""
         rank = msg.frame.pid
+        declared = msg.frame.vote  # declarer's epoch (-1 on legacy)
         if rank == self.rank:
-            # somebody suspects me — a false positive from delays; there
-            # is no un-fail protocol (matching the reference's absence of
-            # recovery), so just record it for the application
+            if 0 <= declared < self._welcome_epoch:
+                return  # pre-rejoin leftover about my previous life
+            # somebody declared me failed: the group has re-formed
+            # without me and is quarantining my traffic, so record the
+            # suspicion AND petition for readmission with JOIN probes
+            # (docs/DESIGN.md §8 — rejoin replaces the old "no un-fail
+            # protocol" dead end)
             if not self.suspected_self:
                 self.suspected_self = True
                 self._bc_forward(msg)
+                self._become_joiner()
+            return
+        if 0 <= declared < self._admit_epoch.get(rank, 0):
+            # stale notice (declared before an admission we already
+            # executed): adopting it would flap the fresh member out
             return
         fresh = self._mark_failed(rank)
         if not fresh:
@@ -1399,7 +1700,15 @@ class ProgressEngine:
                     and len(self._alive) >= 2 else None)
         self.failed.add(rank)
         self._alive = [r for r in self._alive if r != rank]
-        self._v = {r: v for v, r in enumerate(self._alive)}
+        self._v = topology.virtual_map(self._alive)
+        self.group = list(self._alive)
+        # every failure adoption bumps the membership epoch; the
+        # sender-side floor (if it had rejoined before) is obsolete —
+        # the failed-sender quarantine now covers it entirely
+        self.epoch += 1
+        self._epoch_floor.pop(rank, None)
+        self._link_epoch.pop(rank, None)
+        self._pending_joins.pop(rank, None)
         self._hb_seen.pop(rank, None)
         # ARQ: a dead peer will never ack — stop retransmitting at it
         # (and stop owing it acks or skip notices)
@@ -1479,6 +1788,425 @@ class ProgressEngine:
             if pm.frame.origin == rank:
                 ps.state = ReqState.FAILED
                 self.queue_iar_pending.remove(pm)
+
+    # ------------------------------------------------------------------
+    # Membership epochs + elastic rejoin (net-new, docs/DESIGN.md §8).
+    #
+    # The protocol in one paragraph: every rank carries a monotone
+    # membership *epoch* (bumped on every failure adoption and every
+    # admission) that the send gate stamps into every outgoing frame.
+    # Receivers quarantine (a) everything from a sender they consider
+    # failed, (b) frames below the per-sender epoch floor set at that
+    # sender's last admission, and (c) everything while they are
+    # themselves mid-rejoin — so a dead incarnation's stale traffic is
+    # mechanically distinguishable from post-rejoin traffic. A failed-
+    # but-alive rank (network partition, false positive, restart with
+    # a fresh incarnation) converges back in by the JOIN protocol:
+    # ranks probe their failed peers with Tag.JOIN carrying their view
+    # key (epoch, -min-alive-rank, with rank id as the final tiebreak);
+    # the losing view's ranks become *joiners* (quarantine everything,
+    # petition at join_interval), and a winning-side member that
+    # receives a petition runs the EXISTING IAR consensus over the
+    # member set to admit the joiner — the rootless op voting on its
+    # own membership. The admitting proposer then sends JOIN_WELCOME
+    # (agreed epoch + member list) and replays its recent-broadcast
+    # log point-to-point so the joiner converges; both sides reset the
+    # joiner's ARQ link state, and the epoch floor quarantines any
+    # stale in-flight frames that predate the admission.
+    # ------------------------------------------------------------------
+    def _member_pid(self, joiner: int) -> int:
+        return MEMBER_PID_BASE - (joiner * self.world_size + self.rank)
+
+    def _member_joiner(self, pid: int) -> Optional[int]:
+        """joiner rank encoded in a membership pid, or None."""
+        if pid > MEMBER_PID_BASE:
+            return None
+        return (MEMBER_PID_BASE - pid) // self.world_size
+
+    @staticmethod
+    def _member_decode(payload: bytes):
+        """(joiner, incarnation, new_epoch) from an admission payload
+        (MEMBER_MAGIC + <iii>), or None."""
+        if not payload.startswith(MEMBER_MAGIC) or \
+                len(payload) < len(MEMBER_MAGIC) + 12:
+            return None
+        return struct.unpack_from("<iii", payload, len(MEMBER_MAGIC))
+
+    def _view_key(self):
+        """Total order on membership views: higher epoch wins, then
+        the side containing the lower rank (disjoint split-brain views
+        always differ there); _on_join breaks exact ties by rank id."""
+        base = min(self._alive) if self._alive else self.rank
+        return (self.epoch, -base)
+
+    def _become_joiner(self) -> None:
+        """Enter joiner mode: quarantine everything except membership
+        frames and petition for readmission until a JOIN_WELCOME
+        arrives. The full-quarantine gate is what makes the admission's
+        link-sequence reset safe — no stale ACK or old-seq frame can
+        touch the fresh link state."""
+        if self._awaiting_welcome:
+            return
+        # my own in-flight round can never resolve once I quarantine
+        # everything (its votes would be dropped unread): fail it now
+        # and free the slot instead of waiting out the op deadline
+        p = self.my_own_proposal
+        if p.state == ReqState.IN_PROGRESS and not p.decision_pending:
+            self._abort_own_proposal(p)
+        self._awaiting_welcome = True
+        self._join_last_probe = float("-inf")
+
+    def rejoin(self, incarnation: Optional[int] = None) -> int:
+        """Explicitly petition for readmission with a fresh
+        incarnation (docs/DESIGN.md §8): bumps ``incarnation`` (or
+        adopts the given one), re-partitions the broadcast-seq and
+        round-generation spaces so peers' dedup windows stay correct,
+        and enters joiner mode — JOIN probes go out at
+        ``join_interval`` until an admitting member's JOIN_WELCOME
+        arrives (``rejoins`` increments on adoption). A restarted
+        process can equivalently pass ``incarnation=`` at
+        construction, which starts the engine in joiner mode. Returns
+        the new incarnation."""
+        inc = self.incarnation + 1 if incarnation is None \
+            else int(incarnation)
+        if inc < self.incarnation:
+            raise ValueError(
+                f"incarnation must not go backwards: {inc} < "
+                f"{self.incarnation}")
+        if inc > _incarnation_cap(self.world_size):
+            raise ValueError(
+                f"incarnation {inc} exceeds the cap "
+                f"{_incarnation_cap(self.world_size)} for world_size "
+                f"{self.world_size}: the shifted, rank-qualified gen "
+                f"base must fit the int32 wire fields")
+        self.incarnation = inc
+        base = inc << INCARNATION_SHIFT
+        if self._bcast_seq < base:
+            self._bcast_seq = base
+        if self._gen_next <= base:
+            self._gen_next = base + 1
+        self._become_joiner()
+        self._join_last_probe = float("-inf")
+        self.manager.progress_all()
+        return inc
+
+    def _send_join_probe(self, dst: int) -> None:
+        # (incarnation, epoch, min-alive-rank, petition): petition=1
+        # marks a JOINER's plea (it has reset itself and quarantines
+        # everything) vs a survivor's heal probe at a failed peer
+        payload = struct.pack(
+            "<iiii", self.incarnation, self.epoch,
+            min(self._alive) if self._alive else self.rank,
+            1 if self._awaiting_welcome else 0)
+        self._send_raw(dst, int(Tag.JOIN),
+                       Frame(origin=self.rank, payload=payload).encode())
+        TRACER.emit(self.rank, Ev.JOIN, dst, 1, self.incarnation,
+                    self.epoch)
+
+    def _membership_tick(self) -> None:
+        """Joiner side: petition every potential member at
+        join_interval. Survivor side: launch queued admission rounds
+        once the (single) own-proposal slot frees up, and probe
+        failed-but-maybe-alive peers so a healed partition or silent
+        restart is discovered without any out-of-band signal."""
+        now = self.clock()
+        if self._awaiting_welcome:
+            if now - self._join_last_probe >= self.join_interval:
+                self._join_last_probe = now
+                for dst in range(self.world_size):
+                    if dst != self.rank and \
+                            dst not in self._sub_excluded:
+                        self._send_join_probe(dst)
+            return
+        if self._pending_joins and \
+                self.my_own_proposal.state != ReqState.IN_PROGRESS:
+            joiner = next(iter(self._pending_joins))
+            inc, jep = self._pending_joins.pop(joiner)
+            if joiner in self.failed and joiner not in self._admitting:
+                self._admitting.add(joiner)
+                # the agreed post-admission epoch: above BOTH sides'
+                # views, so the joiner's fresh frames clear every
+                # member's floor and its old life's frames never do
+                new_epoch = max(self.epoch, jep) + 1
+                payload = MEMBER_MAGIC + struct.pack(
+                    "<iii", joiner, inc, new_epoch)
+                # membership watchdog (mirror of the C engine's
+                # own_deadline): an engine-initiated round straddling
+                # a view change can park into a cyclic mixed-view
+                # vote tree; it must fail-and-retry even when the app
+                # runs without op deadlines
+                deadline = self.op_deadline
+                if deadline is None:
+                    deadline = max(
+                        4 * (self.failure_timeout or 0.0),
+                        20 * self.join_interval)
+                self.submit_proposal(payload,
+                                     pid=self._member_pid(joiner),
+                                     deadline=deadline)
+        # cadence gate first: the set difference allocates, and this
+        # runs every progress turn while any peer is failed
+        if now - self._join_last_probe >= self.join_interval:
+            probe = self.failed - self._sub_excluded
+            if probe:
+                self._join_last_probe = now
+                for dst in sorted(probe):
+                    self._send_join_probe(dst)
+
+    def _on_join(self, msg: _Msg) -> None:
+        """A JOIN probe/petition arrived: compare view keys. If the
+        sender's view loses and it is failed here, petition to admit
+        it (IAR over the member set). If its view wins, become a
+        joiner ourselves (split-brain heal = mutual rejoin, higher
+        epoch winning). If it probes us while we hold the winning view
+        but consider it alive, answer with our own probe so it
+        petitions us."""
+        src = msg.src
+        if not (0 <= src < self.world_size) or src == self.rank or \
+                src in self._sub_excluded:
+            return
+        f = msg.frame
+        if len(f.payload) < 16:
+            return
+        inc, ep, malive, petition = struct.unpack_from("<iiii",
+                                                       f.payload)
+        TRACER.emit(self.rank, Ev.JOIN, src, 0, inc, ep)
+        if self._awaiting_welcome:
+            return  # mid-rejoin ourselves; the winning side sorts us
+        my_key, their_key = self._view_key(), (ep, -malive)
+        mine_wins = my_key > their_key or \
+            (my_key == their_key and self.rank < src)
+        if src in self.failed:
+            if not mine_wins:
+                self._become_joiner()
+                return
+            if inc < self._admitted.get(src, -1):
+                return  # stale probe from an already-replaced life
+            if src in self._admitting or src in self._pending_joins:
+                return  # a round for it is already queued/in flight
+            self._pending_joins[src] = (inc, ep)
+        elif not mine_wins:
+            self._become_joiner()
+        elif petition:
+            # a rank we consider ALIVE is petitioning against our
+            # winning view: it has reset itself and quarantines our
+            # traffic, so it is effectively failed here — adopt +
+            # announce that, then run the normal admission (without
+            # this, a lone stale-view winner would answer petitions
+            # with probes forever and nobody would ever admit anyone)
+            self._announce_failed(src)
+            if inc >= self._admitted.get(src, -1) and \
+                    src not in self._admitting:
+                self._pending_joins[src] = (inc, ep)
+        else:
+            # the prober holds a losing view yet thinks we are alive
+            # (asymmetric partition): show it the winning view
+            self._send_join_probe(src)
+
+    def _finish_member_round(self, p: ProposalState) -> None:
+        """Admitting proposer's epilogue: execute the admission, then
+        welcome + replay to the joiner."""
+        adm = self._member_decode(self.my_proposal_payload)
+        if adm is None:
+            return
+        joiner, inc, new_epoch = adm
+        self._admitting.discard(joiner)
+        self._pending_joins.pop(joiner, None)
+        if not p.vote:
+            return
+        self._execute_admission(joiner, inc, new_epoch)
+        self._send_welcome(joiner, inc, new_epoch)
+        self._replay_recent(joiner)
+
+    def _execute_admission(self, joiner: int, inc: int,
+                           new_epoch: int) -> None:
+        """Adopt an admission decision into the membership view
+        (idempotent): re-form the overlay to include the joiner, raise
+        the epoch to the agreed value, set the joiner's epoch floor
+        (its dead incarnation's frames all fall below it), and clear
+        the RECEIVE-side ARQ window toward the joiner — a restarted
+        joiner's link seqs start at 0, which the old window would
+        misread as duplicates. The send-side seq counter is never
+        reset (monotone for this process's lifetime), so a peer that
+        keeps its window across our reset can never misread our fresh
+        frames as duplicates either."""
+        if not (0 <= joiner < self.world_size) or joiner == self.rank \
+                or joiner in self._sub_excluded:
+            return
+        if new_epoch <= self._admit_epoch.get(joiner, 0):
+            # stale or duplicate admission artifact (an old decision
+            # re-flooded out of a replaced view): executing it would
+            # re-run the link reset ONE-SIDED and permanently desync
+            # the ARQ windows on that edge
+            return
+        self._admit_epoch[joiner] = new_epoch
+        self.epoch = max(self.epoch, new_epoch)
+        self._admitted[joiner] = max(inc, self._admitted.get(joiner, -1))
+        self._epoch_floor[joiner] = new_epoch
+        self._link_epoch[joiner] = new_epoch
+        # clear the receive window even when we never marked the
+        # joiner failed ourselves (another member re-declared and
+        # re-admitted it; the joiner reset its half at the welcome, so
+        # keeping ours would swallow its fresh seqs as duplicates).
+        # Our tx seq counter is NOT reset — seq spaces are monotone
+        # per process lifetime, so the joiner's window (fresh or kept)
+        # never misreads our next frames; the unfillable-hole rule in
+        # _on_ack re-syncs its cumulative-ACK watermark in one round
+        # trip. App-level dedup ((origin, seq) windows + the
+        # settled-round ring) keeps delivery exactly-once across the
+        # reset.
+        self._tx_unacked.pop(joiner, None)
+        self._tx_skip.pop(joiner, None)
+        self._rx_seen.pop(joiner, None)
+        self._ack_due.discard(joiner)
+        # fresh heartbeat grace — the joiner may be our new predecessor
+        # and a stale stamp would re-declare it instantly
+        self._hb_seen[joiner] = self.clock()
+        # abandoned concurrent admission rounds for this joiner (their
+        # proposer's watchdog fired, or the round wedged in a
+        # mixed-view tree) are settled by THIS admission: unpark
+        # their parked relays so they don't accumulate across heal
+        # churn (mirror of the C execute_admission sweep)
+        for pm in list(self.queue_iar_pending):
+            if pm.prop_state is not None and \
+                    pm.prop_state.pid <= MEMBER_PID_BASE and \
+                    self._member_joiner(pm.prop_state.pid) == joiner:
+                pm.prop_state.state = ReqState.FAILED
+                self.queue_iar_pending.remove(pm)
+        # a stale FAILURE notice about the joiner must never be
+        # re-flooded: it would kill the fresh incarnation
+        self._purge_stale_failures({joiner})
+        if joiner not in self.failed:
+            return  # view unchanged (concurrent admitting proposer)
+        self.failed.discard(joiner)
+        self._alive = sorted(self._alive + [joiner])
+        self._v = topology.virtual_map(self._alive)
+        self.group = list(self._alive)
+        self.rejoins += 1
+        TRACER.emit(self.rank, Ev.ADMIT, joiner, self.epoch, inc)
+        logger.info("rank %d admitted rank %d (incarnation %d, epoch "
+                    "%d); members now %s", self.rank, joiner, inc,
+                    self.epoch, self._alive)
+        # plug forwarding holes across the overlay re-form, exactly
+        # like the failure path does
+        self._reflood_recent_bcasts()
+
+    def _send_welcome(self, joiner: int, inc: int,
+                      new_epoch: int) -> None:
+        members = list(self._alive)
+        payload = struct.pack("<iii", new_epoch, inc, len(members)) + \
+            struct.pack(f"<{len(members)}i", *members)
+        self._send_raw(joiner, int(Tag.JOIN_WELCOME),
+                       Frame(origin=self.rank, payload=payload).encode())
+
+    def _replay_recent(self, joiner: int) -> None:
+        """Point-to-point replay of the recent-broadcast log to a
+        freshly admitted joiner so it converges on recent traffic
+        (its (origin, seq) dedup absorbs anything it already saw).
+        FAILURE notices AND membership decisions are skipped — the
+        welcome's member list is the authoritative view, and a stale
+        admission decision about a since-re-failed rank would pass the
+        joiner's _admit_epoch guard (reset by the welcome) and
+        resurrect the dead rank in its view. The guarantee is bounded
+        by the admitting proposer's log depth (the same 64-frame bound
+        as the view-change re-flood, docs/DESIGN.md §6)."""
+        for tag, raw in list(self._recent_bcasts):
+            if tag == int(Tag.FAILURE):
+                continue
+            if tag == int(Tag.IAR_DECISION) and \
+                    Frame.decode(raw).pid <= MEMBER_PID_BASE:
+                continue
+            self._send_raw(joiner, tag, raw)
+
+    def _purge_stale_failures(self, ranks: Set[int]) -> None:
+        keep = deque(maxlen=self._recent_bcasts.maxlen)
+        for tag, raw in self._recent_bcasts:
+            if tag == int(Tag.FAILURE) and \
+                    Frame.decode(raw).pid in ranks:
+                continue
+            keep.append((tag, raw))
+        self._recent_bcasts = keep
+
+    def _on_welcome(self, msg: _Msg) -> None:
+        """The admitting proposer's JOIN_WELCOME: adopt its membership
+        view wholesale — epoch, member list, fresh link state and
+        heartbeat grace everywhere, per-member epoch floors at the
+        agreed epoch (members only send to us AFTER executing the
+        admission, so everything below the floor is pre-partition
+        leftovers). The replay of the proposer's recent-broadcast log
+        follows on the same FIFO channel."""
+        f = msg.frame
+        if len(f.payload) < 12:
+            return
+        new_epoch, inc, n = struct.unpack_from("<iii", f.payload)
+        if inc != self.incarnation:
+            return  # welcome addressed to an older life of this rank
+        if n < 0 or len(f.payload) < 12 + 4 * n:
+            return
+        members = list(struct.unpack_from(f"<{n}i", f.payload, 12)) \
+            if n else []
+        if not self._awaiting_welcome and \
+                new_epoch <= self._welcome_epoch:
+            # duplicate/stale welcome (concurrent admitting proposers).
+            # Deliberately compared against the last ADOPTED welcome
+            # epoch, not self.epoch: our own epoch can outrun the
+            # round's agreed epoch via local declarations, and
+            # rejecting the welcome then would leave the admitting
+            # side's link-state reset one-sided (a permanently
+            # desynced ARQ window) — the exact mirror of the members'
+            # _admit_epoch idempotence rule.
+            return
+        # out-of-range entries (corrupt/foreign frame) are dropped,
+        # not adopted — the C on_welcome filters identically
+        mem = sorted({m for m in members
+                      if 0 <= m < self.world_size} | {self.rank})
+        self._awaiting_welcome = False
+        self.suspected_self = False
+        self._welcome_epoch = max(self._welcome_epoch, new_epoch)
+        self.epoch = max(self.epoch, new_epoch)
+        for m in mem:
+            if m != self.rank:
+                # members of the adopted view are known-alive at this
+                # epoch: FAILURE notices declared below it are stale
+                self._admit_epoch[m] = max(
+                    self._admit_epoch.get(m, 0), new_epoch)
+        self._alive = mem
+        self.failed = (set(range(self.world_size)) - set(mem)) | \
+            set(self._sub_excluded)
+        self._v = topology.virtual_map(mem)
+        self.group = list(mem)
+        # clear receive windows and in-flight state; the tx seq
+        # counters are PRESERVED (monotone per process lifetime) so a
+        # member whose matching admission execution was suppressed as
+        # stale — its rx watermark intact — still reads our next
+        # frames as fresh instead of silently dup-dropping them (the
+        # half-dead-link deadlock: every IAR round crossing that edge
+        # would hang, invisible to the heartbeat detector because
+        # liveness refreshes before the dup check)
+        self._tx_unacked.clear()
+        self._tx_skip.clear()
+        self._rx_seen.clear()
+        self._ack_due.clear()
+        self._hb_seen = {}
+        self._hb_last_sent = float("-inf")
+        self._epoch_floor = {m: new_epoch for m in mem
+                             if m != self.rank}
+        self._link_epoch = {m: new_epoch for m in mem
+                            if m != self.rank}
+        self._purge_stale_failures(set(mem))
+        # relayed rounds whose proposer is outside the adopted view
+        # can never resolve here — unpark them as FAILED (the mirror
+        # of _abort_orphaned_proposals for the joiner side)
+        for pm in list(self.queue_iar_pending):
+            if pm.frame.origin not in mem and pm.prop_state is not None:
+                pm.prop_state.state = ReqState.FAILED
+                self.queue_iar_pending.remove(pm)
+        self.rejoins += 1
+        self._join_last_probe = float("-inf")
+        TRACER.emit(self.rank, Ev.ADMIT, self.rank, self.epoch, inc,
+                    msg.src)
+        logger.info("rank %d rejoined at epoch %d (welcomed by rank "
+                    "%d); members %s", self.rank, self.epoch, msg.src,
+                    mem)
 
     def _on_other(self, msg: _Msg) -> None:
         """Unknown/aux tags go straight to pickup (reference prints and

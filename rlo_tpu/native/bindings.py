@@ -31,6 +31,7 @@ IN_PROGRESS = 1
 FAILED = 2
 INVALID = 3
 
+from rlo_tpu.utils.metrics import ENGINE_COUNTER_KEYS
 from rlo_tpu.wire import MSG_SIZE_MAX  # single shared engine-wide cap
 
 _JUDGE_CB = C.CFUNCTYPE(C.c_int, C.POINTER(C.c_uint8), C.c_int64,
@@ -92,6 +93,8 @@ class _Stats(C.Structure):
                 ("arq_retransmits", C.c_int64),
                 ("arq_dup_drops", C.c_int64),
                 ("arq_gave_up", C.c_int64), ("arq_unacked", C.c_int64),
+                ("epoch", C.c_int64), ("epoch_quarantined", C.c_int64),
+                ("rejoins", C.c_int64),
                 ("q_wait", C.c_int64), ("q_pickup", C.c_int64),
                 ("q_wait_and_pickup", C.c_int64),
                 ("q_iar_pending", C.c_int64),
@@ -158,6 +161,14 @@ def load() -> C.CDLL:
     sig("rlo_engine_rank_failed", C.c_int, [p, C.c_int])
     sig("rlo_engine_failed_count", C.c_int, [p])
     sig("rlo_engine_suspected_self", C.c_int, [p])
+    sig("rlo_world_partition", C.c_int, [p, C.POINTER(C.c_int), C.c_int])
+    sig("rlo_world_revive_rank", C.c_int, [p, C.c_int])
+    sig("rlo_engine_set_incarnation", C.c_int, [p, C.c_int])
+    sig("rlo_engine_rejoin", C.c_int, [p])
+    sig("rlo_engine_epoch", C.c_int64, [p])
+    sig("rlo_engine_epoch_quarantined", C.c_int64, [p])
+    sig("rlo_engine_rejoins", C.c_int64, [p])
+    sig("rlo_engine_awaiting_welcome", C.c_int, [p])
     sig("rlo_engine_state_get", C.c_int, [p, p])
     sig("rlo_engine_state_set", C.c_int, [p, p])
     sig("rlo_mpi_available", C.c_int, [])
@@ -293,6 +304,43 @@ class NativeWorld:
         rc = self._lib.rlo_world_dup_next(self._w, src, dst, count)
         if rc != 0:
             raise RuntimeError(f"dup_next failed ({rc})")
+
+    def partition(self, groups) -> None:
+        """Fault injection (loopback only): split the network into
+        ``groups`` (sequences of ranks) — frames crossing the cut are
+        dropped, including frames already in flight across it. Ranks
+        not named fall into singleton groups. Mirror of
+        SimWorld.partition."""
+        gmap = {}
+        for gi, g in enumerate(groups):
+            for r in g:
+                if not 0 <= r < self.world_size:
+                    raise ValueError(f"bad rank {r} in partition")
+                if r in gmap:
+                    raise ValueError(f"rank {r} in two groups")
+                gmap[r] = gi
+        arr = (C.c_int * self.world_size)(
+            *[gmap.get(r, len(groups) + r)
+              for r in range(self.world_size)])
+        rc = self._lib.rlo_world_partition(self._w, arr,
+                                           self.world_size)
+        if rc != 0:
+            raise RuntimeError(f"partition failed ({rc})")
+
+    def heal(self) -> None:
+        """Remove the partition; traffic flows everywhere again."""
+        rc = self._lib.rlo_world_partition(
+            self._w, C.cast(None, C.POINTER(C.c_int)), 0)
+        if rc != 0:
+            raise RuntimeError(f"heal failed ({rc})")
+
+    def revive_rank(self, rank: int) -> None:
+        """Revive a killed rank's endpoint with an empty inbox (build a
+        fresh engine with a bumped incarnation on top — mirror of
+        SimWorld.restart_rank)."""
+        rc = self._lib.rlo_world_revive_rank(self._w, rank)
+        if rc != 0:
+            raise RuntimeError(f"revive_rank failed ({rc})")
 
     @property
     def sent_cnt(self) -> int:
@@ -662,16 +710,11 @@ class NativeEngine:
         if rc < 0:
             raise RuntimeError(f"rlo_engine_link_stats failed ({rc})")
         return {
-            "counters": {
-                "sent_bcast": st.sent_bcast,
-                "recved_bcast": st.recved_bcast,
-                "total_pickup": st.total_pickup,
-                "ops_failed": st.ops_failed,
-                "arq_retransmits": st.arq_retransmits,
-                "arq_dup_drops": st.arq_dup_drops,
-                "arq_gave_up": st.arq_gave_up,
-                "arq_unacked": st.arq_unacked,
-            },
+            # ENGINE_COUNTER_KEYS is the schema contract with the
+            # Python engine (ProgressEngine.metrics builds from the
+            # same tuple; the parity test asserts dict equality)
+            "counters": {k: getattr(st, k)
+                         for k in ENGINE_COUNTER_KEYS},
             "queues": {
                 "wait": st.q_wait,
                 "pickup": st.q_pickup,
@@ -688,6 +731,46 @@ class NativeEngine:
                 "pickup_wait": st.pickup_wait.to_dict(),
             },
         }
+
+    def set_incarnation(self, incarnation: int) -> None:
+        """Partition this engine's life at its rank: a RESTARTED
+        process passes a fresh incarnation BEFORE any traffic;
+        broadcast seqs and round generations re-base so peers' dedup
+        windows never swallow the new life's frames. incarnation > 0
+        also starts the engine in joiner mode (petitioning until
+        welcomed) — mirror of ProgressEngine(incarnation=...)."""
+        rc = self._lib.rlo_engine_set_incarnation(self._e, incarnation)
+        if rc != 0:
+            raise ValueError(
+                f"set_incarnation({incarnation}) failed ({rc}): the "
+                f"incarnation must not go backwards, be negative, or "
+                f"exceed the world-size-qualified cap (the shifted "
+                f"gen base must fit int32 after * world_size)")
+
+    def rejoin(self) -> int:
+        """Explicitly petition for readmission with a fresh
+        incarnation (docs/DESIGN.md §8) — mirror of
+        ProgressEngine.rejoin(). Returns the new incarnation."""
+        rc = self._lib.rlo_engine_rejoin(self._e)
+        if rc < 0:
+            raise RuntimeError(f"rejoin failed ({rc})")
+        return rc
+
+    @property
+    def epoch(self) -> int:
+        return self._lib.rlo_engine_epoch(self._e)
+
+    @property
+    def epoch_quarantined(self) -> int:
+        return self._lib.rlo_engine_epoch_quarantined(self._e)
+
+    @property
+    def rejoins(self) -> int:
+        return self._lib.rlo_engine_rejoins(self._e)
+
+    @property
+    def awaiting_welcome(self) -> bool:
+        return bool(self._lib.rlo_engine_awaiting_welcome(self._e))
 
     def rank_failed(self, rank: int) -> bool:
         return bool(self._lib.rlo_engine_rank_failed(self._e, rank))

@@ -48,6 +48,13 @@ enum rlo_tag {
     RLO_TAG_FAILURE = 12,   /* rootless failure notification */
     RLO_TAG_ACK = 13,       /* cumulative link ACK (ARQ); vote = seq */
     RLO_TAG_ABORT = 14,     /* rootless op-abort (deadline expiry) */
+    RLO_TAG_JOIN = 15,      /* membership probe/petition: payload =
+                             * (incarnation, epoch, min-alive, petition),
+                             * 4 x le32 (docs/DESIGN.md S8) */
+    RLO_TAG_JOIN_WELCOME = 16, /* admission notice: payload = (epoch,
+                             * incarnation echo, n) + n member ranks;
+                             * followed by a point-to-point replay of
+                             * the recent-broadcast log */
 };
 
 /* ---- request/proposal states (reference RLO_Req_stat) ---- */
@@ -97,15 +104,22 @@ int rlo_initiator_targets(int world_size, int rank, int *out, int cap);
 
 /* ------------------------------------------------------------------ */
 /* Wire format: little-endian [origin:i32][pid:i32][vote:i32][seq:i32]  */
-/* [len:u64] header + payload (reference pbuf layout, rootless_ops.c:   */
-/* 64-73, extended with the ARQ link sequence number — stamped by the   */
-/* sending engine per (src, dst) edge, -1 outside the reliable path).   */
+/* [epoch:i32][len:u64] header + payload (reference pbuf layout,        */
+/* rootless_ops.c:64-73, extended with the ARQ link sequence number and */
+/* the membership LINK epoch — both stamped by the sending engine per   */
+/* (src, dst) edge; seq is -1 outside the reliable path, epoch is the   */
+/* admission epoch of the edge's last link reset, 0 on the original     */
+/* link. Matches rlo_tpu/wire.py `<iiiiiQ>` byte for byte.)             */
 /* ------------------------------------------------------------------ */
-#define RLO_HEADER_SIZE 24
+#define RLO_HEADER_SIZE 28
 /* byte offset of the seq field (the ARQ send path patches encoded
  * frames in place: one encode per broadcast, one stamp per edge) */
 #define RLO_SEQ_OFFSET 12
-/* Encodes into dst (cap >= RLO_HEADER_SIZE + len); returns frame size. */
+/* byte offset of the link-epoch field (patched by the engine send
+ * gate; receivers quarantine frames below their per-sender floor) */
+#define RLO_EPOCH_OFFSET 16
+/* Encodes into dst (cap >= RLO_HEADER_SIZE + len); returns frame size.
+ * The epoch field is written as 0 — the send gate stamps it. */
 int64_t rlo_frame_encode(uint8_t *dst, int64_t cap, int32_t origin,
                          int32_t pid, int32_t vote, int32_t seq,
                          const uint8_t *payload, int64_t len);
@@ -114,6 +128,9 @@ int64_t rlo_frame_encode(uint8_t *dst, int64_t cap, int32_t origin,
 int64_t rlo_frame_decode(const uint8_t *raw, int64_t rawlen, int32_t *origin,
                          int32_t *pid, int32_t *vote, int32_t *seq,
                          const uint8_t **payload);
+/* Link-epoch accessors (raw must hold >= RLO_HEADER_SIZE bytes). */
+int32_t rlo_frame_epoch(const uint8_t *raw);
+void rlo_frame_set_epoch(uint8_t *raw, int32_t epoch);
 
 /* ------------------------------------------------------------------ */
 /* Loopback transport world: N in-process ranks, per-(src,dst,comm)     */
@@ -156,6 +173,15 @@ int rlo_world_kill_rank(rlo_world *w, int rank);
  * RLO_ERR_ARG on transports without injection. */
 int rlo_world_drop_next(rlo_world *w, int src, int dst, int count);
 int rlo_world_dup_next(rlo_world *w, int src, int dst, int count);
+/* Fault injection (loopback only): network partition — frames whose
+ * endpoints fall in different groups of group_of[0..n-1] (n ==
+ * world_size; group_of[r] = r's group id) are silently dropped.
+ * Passing NULL heals the partition. RLO_ERR_ARG where unsupported. */
+int rlo_world_partition(rlo_world *w, const int *group_of, int n);
+/* Fault injection (loopback only): revive a killed rank's endpoint
+ * with an empty inbox (the harness then builds a fresh engine with a
+ * bumped incarnation — the restart leg of the membership tests). */
+int rlo_world_revive_rank(rlo_world *w, int rank);
 int64_t rlo_world_sent_cnt(const rlo_world *w);
 int64_t rlo_world_delivered_cnt(const rlo_world *w);
 /* Collective barrier across all ranks (shm: sense-reversing spin;
@@ -319,6 +345,32 @@ int rlo_engine_failed_count(const rlo_engine *e);
 int rlo_engine_suspected_self(const rlo_engine *e);
 
 /* ------------------------------------------------------------------ */
+/* Membership epochs + elastic rejoin (net-new, docs/DESIGN.md S8;     */
+/* mirror of the Python engine's incarnation/epoch/JOIN machinery).    */
+/* Every rank carries a monotone membership epoch (bumped on every     */
+/* failure adoption and admission); the send gate stamps the LINK      */
+/* epoch of each edge into outgoing frames and receivers quarantine    */
+/* (a) traffic from senders they consider failed, (b) frames below    */
+/* the per-sender floor set at that sender's admission, (c)           */
+/* everything while mid-rejoin. A failed-but-alive rank converges     */
+/* back in via Tag.JOIN probes + an IAR admission round over the      */
+/* member set, finished by a JOIN_WELCOME + recent-broadcast replay.  */
+/* ------------------------------------------------------------------ */
+/* Partition the engine's life at this rank: a RESTARTED process       */
+/* passes a fresh incarnation BEFORE any traffic; broadcast seqs and   */
+/* round generations start at incarnation << 20 so peers' dedup        */
+/* windows never swallow the new life's frames. incarnation > 0 also   */
+/* starts the engine in JOINER mode (petitioning until welcomed).      */
+int rlo_engine_set_incarnation(rlo_engine *e, int incarnation);
+/* Explicit rejoin: bump the incarnation, enter joiner mode, petition. */
+int rlo_engine_rejoin(rlo_engine *e);
+int64_t rlo_engine_epoch(const rlo_engine *e);
+int64_t rlo_engine_epoch_quarantined(const rlo_engine *e);
+int64_t rlo_engine_rejoins(const rlo_engine *e);
+/* 1 while the engine is mid-rejoin (quarantining everything) */
+int rlo_engine_awaiting_welcome(const rlo_engine *e);
+
+/* ------------------------------------------------------------------ */
 /* Metrics registry (rlo_stats) — native twin of ProgressEngine        */
 /* metrics() (rlo_tpu/utils/metrics.py; docs/DESIGN.md §7). Counter    */
 /* keys, nesting, and histogram layout are kept IDENTICAL across the   */
@@ -357,6 +409,10 @@ typedef struct rlo_link_stats {
 typedef struct rlo_stats {
     int64_t sent_bcast, recved_bcast, total_pickup, ops_failed;
     int64_t arq_retransmits, arq_dup_drops, arq_gave_up, arq_unacked;
+    /* membership (docs/DESIGN.md S8): current view epoch, frames
+     * dropped by the stale-epoch / failed-sender quarantine, and
+     * admissions executed (or adopted, joiner side) */
+    int64_t epoch, epoch_quarantined, rejoins;
     int64_t q_wait, q_pickup, q_wait_and_pickup, q_iar_pending;
     rlo_hist bcast_complete, proposal_resolve, pickup_wait;
 } rlo_stats;
@@ -506,6 +562,13 @@ enum rlo_ev {
     RLO_EV_FAILURE = 10,   /* a = failed rank, b = 1 local / 0 learned;
                             * c = last-seen heartbeat age (usec, clamped
                             * to int32) on local detections */
+    RLO_EV_ARQ_GIVEUP = 11, /* ARQ exhausted its retries at a live peer
+                             * (now declared failed): a = peer,
+                             * b = retransmit count */
+    RLO_EV_JOIN = 12,      /* membership probe: a = peer, b = 1 sent /
+                            * 0 received, c = incarnation, d = epoch */
+    RLO_EV_ADMIT = 13,     /* admission executed/adopted: a = joiner,
+                            * b = new epoch, c = joiner incarnation */
 };
 
 typedef struct rlo_trace_event {

@@ -159,7 +159,55 @@ struct rlo_engine {
     rlo_link_stats *links; /* ws entries; links[rank] stays zero */
     rlo_hist h_bcast, h_prop, h_pickup;
     uint64_t prop_born;
+    /* membership-round watchdog: app op deadlines are Python-side,
+     * but the ENGINE-initiated admission rounds need one here — a
+     * round straddling a view change can park into a cyclic vote
+     * tree (mixed old/new overlays) that never resolves, wedging the
+     * own-proposal slot forever. 0 = unarmed. */
+    uint64_t own_deadline;
+    /* membership epochs + elastic rejoin (docs/DESIGN.md S8; mirror of
+     * the Python engine's incarnation/epoch/JOIN machinery) */
+    int32_t epoch;          /* monotone membership view counter */
+    int64_t quarantined;    /* frames dropped by the epoch quarantine */
+    int64_t rejoins_cnt;    /* admissions executed/adopted here */
+    int incarnation;        /* this engine's life at its rank */
+    int awaiting_welcome;   /* joiner mode: quarantine + petition */
+    int32_t welcome_epoch;  /* epoch of the last ADOPTED welcome */
+    uint64_t join_last;     /* last JOIN probe burst (usec) */
+    uint64_t join_interval; /* probe cadence (usec; 0 = default) */
+    int32_t *epoch_floor;   /* per sender: min accepted link epoch
+                             * (0 = no floor; floors are >= 1) */
+    int32_t *link_epoch;    /* per dst: epoch of the edge's last
+                             * link-state reset (the wire stamp) */
+    int32_t *admit_epoch;   /* per joiner: highest admission epoch
+                             * EXECUTED here (idempotence guard) */
+    int32_t *admitted_inc;  /* per joiner: admitted incarnation (-1) */
+    uint8_t *admitting;     /* joiners with an admission in flight */
+    uint8_t *pending_join;  /* queued petitions awaiting the slot */
+    int32_t *pending_inc;   /* petition incarnation per joiner */
+    int32_t *pending_ep;    /* petition epoch per joiner */
+    uint8_t *sub_excluded;  /* never probed/admitted (engine_new_sub) */
+    uint8_t *gave_scratch;  /* per dst: ARQ give-up escalation flags */
+    uint64_t *stale_probe_last; /* per src: last stale-sender nack */
+    int n_pending;          /* pending_join population */
+    int n_excluded;         /* sub_excluded population */
 };
+
+/* Membership admission rounds live in the reserved pid namespace
+ * pid <= RLO_MEMBER_PID_BASE (app pids are >= -1); pid =
+ * BASE - (joiner * ws + proposer) keeps concurrent admissions of one
+ * joiner by different proposers on distinct pids. Payload =
+ * MAGIC + [joiner:i32][incarnation:i32][new_epoch:i32]. */
+#define RLO_MEMBER_PID_BASE (-2)
+#define RLO_MEMBER_MAGIC_LEN 5
+static const uint8_t RLO_MEMBER_MAGIC[RLO_MEMBER_MAGIC_LEN] = {
+    'R', 'L', 'O', 'J', 1};
+
+static int32_t get_le32(const uint8_t *p)
+{
+    return (int32_t)((uint32_t)p[0] | ((uint32_t)p[1] << 8) |
+                     ((uint32_t)p[2] << 16) | ((uint32_t)p[3] << 24));
+}
 
 /* ---------------- metrics helpers ---------------- */
 
@@ -344,10 +392,14 @@ static void put_le32(uint8_t *dst, int v)
 
 /* Tags the ARQ layer neither stamps nor retransmits: heartbeats are
  * periodic by construction, and ACKs ack themselves by effect (a lost
- * ACK just costs one more retransmit, absorbed by the dedup). */
+ * ACK just costs one more retransmit, absorbed by the dedup). JOIN
+ * probes repeat at their own cadence until answered, and a lost
+ * WELCOME is replaced when the next probe arrives — both must also
+ * work across the membership boundary where link state is reset. */
 static int arq_exempt(int tag)
 {
-    return tag == RLO_TAG_HEARTBEAT || tag == RLO_TAG_ACK;
+    return tag == RLO_TAG_HEARTBEAT || tag == RLO_TAG_ACK ||
+           tag == RLO_TAG_JOIN || tag == RLO_TAG_JOIN_WELCOME;
 }
 
 /* isend one already-encoded frame blob; when track_in != NULL the
@@ -380,6 +432,7 @@ static int eng_isend_frame(rlo_engine *e, int dst, int tag,
         memcpy(stamped->data, frame->data, (size_t)frame->len);
         int32_t seq = e->tx_seq[dst]++;
         put_le32(stamped->data + RLO_SEQ_OFFSET, seq);
+        rlo_frame_set_epoch(stamped->data, e->link_epoch[dst]);
         rt->dst = dst;
         rt->tag = tag;
         rt->seq = seq;
@@ -393,8 +446,27 @@ static int eng_isend_frame(rlo_engine *e, int dst, int tag,
                              track_in ? &h : 0);
         rlo_blob_unref(stamped);
     } else {
-        rc = rlo_world_isend(e->w, e->rank, dst, e->comm, tag, frame,
-                             track_in ? &h : 0);
+        /* link-epoch stamp (docs/DESIGN.md S8): the fan-out blob is
+         * SHARED across edges and (zero-copy) with in-process
+         * receivers, so when the edge's link epoch differs from what
+         * the blob carries, stamp a private copy — with all link
+         * epochs at 0 (no membership churn) this never copies */
+        int32_t lep = (dst >= 0 && dst < e->ws) ? e->link_epoch[dst]
+                                                : 0;
+        if (frame->len >= RLO_HEADER_SIZE &&
+            rlo_frame_epoch(frame->data) != lep) {
+            rlo_blob *st = rlo_blob_new(frame->len);
+            if (!st)
+                return RLO_ERR_NOMEM;
+            memcpy(st->data, frame->data, (size_t)frame->len);
+            rlo_frame_set_epoch(st->data, lep);
+            rc = rlo_world_isend(e->w, e->rank, dst, e->comm, tag, st,
+                                 track_in ? &h : 0);
+            rlo_blob_unref(st);
+        } else {
+            rc = rlo_world_isend(e->w, e->rank, dst, e->comm, tag,
+                                 frame, track_in ? &h : 0);
+        }
     }
     if (rc == RLO_OK && track_in)
         rc = msg_track(track_in, h);
@@ -468,6 +540,18 @@ rlo_engine *rlo_engine_new(rlo_world *w, int rank, int comm,
     e->skip_hold = (uint8_t *)calloc((size_t)e->ws, 1);
     e->links = (rlo_link_stats *)calloc((size_t)e->ws,
                                         sizeof(rlo_link_stats));
+    e->epoch_floor = (int32_t *)calloc((size_t)e->ws, sizeof(int32_t));
+    e->link_epoch = (int32_t *)calloc((size_t)e->ws, sizeof(int32_t));
+    e->admit_epoch = (int32_t *)calloc((size_t)e->ws, sizeof(int32_t));
+    e->admitted_inc = (int32_t *)malloc((size_t)e->ws * sizeof(int32_t));
+    e->admitting = (uint8_t *)calloc((size_t)e->ws, 1);
+    e->pending_join = (uint8_t *)calloc((size_t)e->ws, 1);
+    e->pending_inc = (int32_t *)calloc((size_t)e->ws, sizeof(int32_t));
+    e->pending_ep = (int32_t *)calloc((size_t)e->ws, sizeof(int32_t));
+    e->sub_excluded = (uint8_t *)calloc((size_t)e->ws, 1);
+    e->gave_scratch = (uint8_t *)calloc((size_t)e->ws, 1);
+    e->stale_probe_last =
+        (uint64_t *)calloc((size_t)e->ws, sizeof(uint64_t));
     if (e->seen_contig)
         for (int r = 0; r < e->ws; r++)
             e->seen_contig[r] = -1;
@@ -477,10 +561,18 @@ rlo_engine *rlo_engine_new(rlo_world *w, int rank, int comm,
     if (e->tx_skip)
         for (int r = 0; r < e->ws; r++)
             e->tx_skip[r] = -1;
+    if (e->admitted_inc)
+        for (int r = 0; r < e->ws; r++)
+            e->admitted_inc[r] = -1;
     if (e->n_init < 0 || !e->failed || !e->hb_seen || !e->seen_contig ||
         !e->seen_mask || !e->tx_seq || !e->rx_contig || !e->rx_mask ||
         !e->ack_due || !e->tx_skip || !e->tx_skip_due || !e->skip_hold ||
-        !e->links || rlo_world_register(w, e) != RLO_OK) {
+        !e->links || !e->epoch_floor || !e->link_epoch ||
+        !e->admit_epoch || !e->admitted_inc || !e->admitting ||
+        !e->pending_join || !e->pending_inc || !e->pending_ep ||
+        !e->sub_excluded || !e->gave_scratch ||
+        !e->stale_probe_last ||
+        rlo_world_register(w, e) != RLO_OK) {
         free(e->failed);
         free(e->hb_seen);
         free(e->seen_contig);
@@ -493,6 +585,17 @@ rlo_engine *rlo_engine_new(rlo_world *w, int rank, int comm,
         free(e->tx_skip_due);
         free(e->skip_hold);
         free(e->links);
+        free(e->epoch_floor);
+        free(e->link_epoch);
+        free(e->admit_epoch);
+        free(e->admitted_inc);
+        free(e->admitting);
+        free(e->pending_join);
+        free(e->pending_inc);
+        free(e->pending_ep);
+        free(e->sub_excluded);
+        free(e->gave_scratch);
+        free(e->stale_probe_last);
         free(e);
         return 0;
     }
@@ -523,14 +626,19 @@ rlo_engine *rlo_engine_new_sub(rlo_world *w, int rank, int comm,
     /* subset = the elastic-reforming translation with the non-members
      * permanently excluded: every routed path (cur_init_targets,
      * cur_fwd_targets, ring_neighbors, reflood, discounting) already
-     * consults the alive view (mirror of ProgressEngine(members=...)) */
+     * consults the alive view (mirror of ProgressEngine(members=...)).
+     * Excluded ranks are never probed or admitted (they are not
+     * failed members — they were never members at all). */
     for (int r = 0; r < e->ws; r++)
         e->failed[r] = 1;
     for (int i = 0; i < n_members; i++)
         e->failed[members[i]] = 0;
     e->n_failed = 0;
-    for (int r = 0; r < e->ws; r++)
+    for (int r = 0; r < e->ws; r++) {
         e->n_failed += e->failed[r];
+        e->sub_excluded[r] = e->failed[r];
+    }
+    e->n_excluded = e->n_failed;
     return e;
 }
 
@@ -570,6 +678,17 @@ void rlo_engine_free(rlo_engine *e)
     free(e->tx_skip_due);
     free(e->skip_hold);
     free(e->links);
+    free(e->epoch_floor);
+    free(e->link_epoch);
+    free(e->admit_epoch);
+    free(e->admitted_inc);
+    free(e->admitting);
+    free(e->pending_join);
+    free(e->pending_inc);
+    free(e->pending_ep);
+    free(e->sub_excluded);
+    free(e->gave_scratch);
+    free(e->stale_probe_last);
     for (rlo_rtx *rt = e->rtx_head; rt;) {
         rlo_rtx *nrt = rt->next;
         rlo_blob_unref(rt->frame);
@@ -676,6 +795,11 @@ static int cur_fwd_targets(rlo_engine *e, int origin, int src, int *out,
 
 static int round_settled_peek(const rlo_engine *e, int32_t pid,
                               int32_t gen);
+static int announce_failed(rlo_engine *e, int rank);
+static void become_joiner(rlo_engine *e);
+static void execute_admission(rlo_engine *e, int joiner, int inc,
+                              int32_t new_epoch);
+static void finish_member_round(rlo_engine *e);
 
 /* ---------------- exactly-once broadcast dedup -------------------- */
 
@@ -749,6 +873,7 @@ static int bcast_is_dup(rlo_engine *e, const rlo_msg *m)
 static void arq_on_ack(rlo_engine *e, int src, int32_t cum)
 {
     uint64_t now = e->metrics_on ? rlo_now_usec() : 0;
+    int32_t lo = INT32_MAX; /* lowest seq still held for src */
     if (e->tx_skip[src] >= 0 && cum >= e->tx_skip[src])
         e->tx_skip[src] = -1;
     for (rlo_rtx **pp = &e->rtx_head; *pp;) {
@@ -768,8 +893,21 @@ static void arq_on_ack(rlo_engine *e, int src, int32_t cum)
             free(rt);
             e->arq_unacked_cnt--;
         } else {
+            if (rt->dst == src && rt->seq < lo)
+                lo = rt->seq;
             pp = &rt->next;
         }
+    }
+    /* unfillable hole: the receiver's watermark sits below seqs we no
+     * longer hold (its window was reset by an admission/welcome while
+     * ours carried on — tx seqs are monotone per lifetime). We can
+     * never retransmit (cum, lo) — ACKs are FIFO per channel, so the
+     * gap is permanent — so tell it to skip ahead now instead of
+     * retransmitting the held frames to exhaustion (which would end
+     * in a spurious half-dead-link FAILURE). */
+    if (lo != INT32_MAX && lo > cum + 1 && lo - 1 > e->tx_skip[src]) {
+        e->tx_skip[src] = lo - 1;
+        e->tx_skip_due[src] = 0; /* send at the next tick */
     }
 }
 
@@ -835,8 +973,15 @@ static void arq_tick(rlo_engine *e)
                 !e->failed[rt->dst]) {
                 /* retries exhausted on a LIVE peer (a dead peer's
                  * entries are dropped, not given up on — mirror of
-                 * the Python tick's failed-dst clear) */
+                 * the Python tick's failed-dst clear). A give-up is a
+                 * half-dead link: escalate to the failure detector
+                 * after the sweep (announce_failed mutates this
+                 * queue) */
                 e->arq_gaveup++;
+                rlo_trace_emit(e->rank, RLO_EV_ARQ_GIVEUP, rt->dst,
+                               rt->retries, 0, 0);
+                if (!e->awaiting_welcome)
+                    e->gave_scratch[rt->dst] = 1;
                 if (rt->seq > e->tx_skip[rt->dst]) {
                     e->tx_skip[rt->dst] = rt->seq;
                     e->tx_skip_due[rt->dst] = now; /* send now */
@@ -882,6 +1027,28 @@ static void arq_tick(rlo_engine *e)
         eng_isend(e, d, RLO_TAG_ACK, e->rank, e->tx_skip[d], -2, 0, 0,
                   0);
         e->tx_skip_due[d] = now + e->arq_rto;
+    }
+}
+
+/* ARQ give-up escalation, AFTER the retransmit sweep: a peer that
+ * swallowed max_retries retransmits is a half-dead link — declared
+ * FAILED exactly like a silent heartbeat predecessor (mirror of the
+ * Python tick's gave_up_on epilogue). */
+static void arq_escalate_gaveup(rlo_engine *e)
+{
+    for (int d = 0; d < e->ws; d++) {
+        if (!e->gave_scratch[d])
+            continue;
+        e->gave_scratch[d] = 0;
+        if (e->failed[d] || e->awaiting_welcome)
+            continue;
+        if (!getenv("RLO_QUIET"))
+            fprintf(stderr,
+                    "rlo_tpu: rank %d declaring rank %d FAILED: ARQ "
+                    "gave up after %d retries (half-dead link)\n",
+                    e->rank, d, e->arq_max_retries);
+        rlo_trace_emit(e->rank, RLO_EV_FAILURE, d, 1, 0, 0);
+        announce_failed(e, d);
     }
 }
 
@@ -1027,7 +1194,15 @@ static int bc_forward(rlo_engine *e, rlo_msg *m)
 static int eng_judge(rlo_engine *e, const uint8_t *payload, int64_t len,
                      int pid)
 {
-    int verdict = e->judge ? (e->judge(payload, len, e->judge_ctx) ? 1 : 0)
+    int verdict;
+    if (len >= RLO_MEMBER_MAGIC_LEN && payload &&
+        !memcmp(payload, RLO_MEMBER_MAGIC, RLO_MEMBER_MAGIC_LEN))
+        /* internal membership admission round (docs/DESIGN.md S8):
+         * the engine judges it itself — the app's judge never sees
+         * protocol-internal rounds */
+        verdict = 1;
+    else
+        verdict = e->judge ? (e->judge(payload, len, e->judge_ctx) ? 1 : 0)
                            : 1;
     rlo_trace_emit(e->rank, RLO_EV_JUDGE, pid, verdict, 0, 0);
     return verdict;
@@ -1117,6 +1292,13 @@ static void bc_forward_only(rlo_engine *e, rlo_msg *m)
 
 static void on_proposal(rlo_engine *e, rlo_msg *m)
 {
+    if (m->origin == e->rank) {
+        /* my own proposal echoed back around a re-formed overlay
+         * cycle (mixed views while membership converges): the
+         * proposer holds no relay state and must not re-forward */
+        msg_free(m);
+        return;
+    }
     /* duplicate across a view change (mixed old/new overlay trees):
      * never re-judge or re-park — a second proposal state voting to a
      * second parent would corrupt the vote accounting. Forward for
@@ -1220,11 +1402,21 @@ static void decision_bcast(rlo_engine *e)
 {
     rlo_prop *p = &e->own;
     rlo_msg *m = 0;
-    /* decision in the vote field, round generation in the payload */
-    uint8_t genb[4];
+    /* decision in the vote field, round generation in the payload.
+     * Membership rounds append the admission record (MAGIC + joiner/
+     * incarnation/epoch) so every member can execute the admission
+     * from the decision alone, even if it never saw the proposal
+     * (generation readers only unpack the first 4 bytes). */
+    uint8_t genb[4 + RLO_MEMBER_MAGIC_LEN + 12];
+    int64_t plen = 4;
     put_le32(genb, p->gen);
-    int rc = bcast_init(e, RLO_TAG_IAR_DECISION, p->pid, p->vote, genb, 4,
-                        &m);
+    if (p->pid <= RLO_MEMBER_PID_BASE && p->payload &&
+        p->len <= (int64_t)sizeof(genb) - 4) {
+        memcpy(genb + 4, p->payload, (size_t)p->len);
+        plen += p->len;
+    }
+    int rc = bcast_init(e, RLO_TAG_IAR_DECISION, p->pid, p->vote, genb,
+                        plen, &m);
     if (rc != RLO_OK) {
         set_err(e, rc);
         return;
@@ -1266,6 +1458,13 @@ static void complete_own(rlo_engine *e)
          * since submission (reference :773) */
         p->vote = eng_judge(e, p->payload, p->len, p->pid);
     decision_bcast(e);
+    if (p->pid <= RLO_MEMBER_PID_BASE)
+        /* membership round: the admitting proposer executes the
+         * admission right after fanning the decision out (the
+         * decision itself was routed over the PRE-admission
+         * member-only overlay), then welcomes + replays to the
+         * joiner (docs/DESIGN.md S8) */
+        finish_member_round(e);
 }
 
 static void on_vote(rlo_engine *e, rlo_msg *m)
@@ -1367,6 +1566,36 @@ static void on_decision(rlo_engine *e, rlo_msg *m)
     int rc = bc_forward(e, m); /* forward first; delivery below */
     if (rc < 0)
         set_err(e, rc);
+    if (m->pid <= RLO_MEMBER_PID_BASE) {
+        /* membership round: engine-internal. Execute the admission
+         * from the decision's embedded record (works even when this
+         * rank never saw the proposal), unpark any relayed round
+         * WITHOUT the app action, and never deliver to pickup — but
+         * keep tracking the forward handles (docs/DESIGN.md S8). */
+        if (pm) {
+            pm->ps->state = m->vote ? RLO_COMPLETED : RLO_FAILED;
+            q_remove(&e->q_iar_pending, pm);
+            msg_free(pm);
+        }
+        if (m->len >= 4 + RLO_MEMBER_MAGIC_LEN + 12 &&
+            !memcmp(m->payload + 4, RLO_MEMBER_MAGIC,
+                    RLO_MEMBER_MAGIC_LEN)) {
+            int joiner = get_le32(m->payload + 4 + RLO_MEMBER_MAGIC_LEN);
+            int inc = get_le32(m->payload + 8 + RLO_MEMBER_MAGIC_LEN);
+            int32_t ep = get_le32(m->payload + 12 + RLO_MEMBER_MAGIC_LEN);
+            if (joiner >= 0 && joiner < e->ws) {
+                e->admitting[joiner] = 0;
+                if (e->pending_join[joiner]) {
+                    e->pending_join[joiner] = 0;
+                    e->n_pending--;
+                }
+                if (m->vote)
+                    execute_admission(e, joiner, inc, ep);
+            }
+        }
+        q_append(&e->q_wait, m);
+        return;
+    }
     if (pm) {
         if (m->vote && e->action)
             e->action(pm->payload, pm->len, e->action_ctx);
@@ -1390,6 +1619,7 @@ int rlo_submit_proposal(rlo_engine *e, const uint8_t *proposal, int64_t len,
         rlo_handle_unref(p->decision_handles[i]);
     free(p->decision_handles);
     memset(p, 0, sizeof(*p));
+    e->own_deadline = 0; /* the watchdog never outlives its round */
     p->pid = pid;
     /* rank-qualified (counter * world_size + rank) so two proposers
      * reusing one pid can never collide on generation either, with no
@@ -1537,6 +1767,16 @@ static int mark_failed(rlo_engine *e, int rank)
     e->failed[rank] = 1;
     e->n_failed++;
     e->hb_seen[rank] = 0;
+    /* every failure adoption bumps the membership epoch; the edge's
+     * floor/link-epoch bookkeeping is obsolete — the failed-sender
+     * quarantine now covers the rank entirely (docs/DESIGN.md S8) */
+    e->epoch++;
+    e->epoch_floor[rank] = 0;
+    e->link_epoch[rank] = 0;
+    if (e->pending_join[rank]) {
+        e->pending_join[rank] = 0;
+        e->n_pending--;
+    }
     /* ARQ: a dead peer will never ack — stop retransmitting at it */
     arq_drop_dst(e, rank);
     e->ack_due[rank] = 0;
@@ -1554,6 +1794,37 @@ static int mark_failed(rlo_engine *e, int rank)
     return 1;
 }
 
+/* Adopt + announce a failure THIS rank detected (heartbeat silence or
+ * ARQ give-up): mark, then tell the world — overlay broadcast AND
+ * point-to-point to every alive rank (overlay forwarding can have
+ * holes while views are converging; receivers suppress duplicates).
+ * The notice's vote field carries the DECLARER's epoch at declaration
+ * time: unlike the header link epoch it is immutable through
+ * re-floods, so receivers can recognize a stale notice about a rank
+ * readmitted since. Returns 0 when the failure was already known. */
+static int announce_failed(rlo_engine *e, int rank)
+{
+    if (!mark_failed(e, rank))
+        return 0;
+    rlo_msg *fm = 0;
+    int rc = bcast_init(e, RLO_TAG_FAILURE, rank, e->epoch, 0, 0, &fm);
+    if (rc != RLO_OK)
+        set_err(e, rc);
+    else if (fm)
+        /* declarations join the re-flood log (docs/DESIGN.md S8);
+         * admission purges stale notices about the readmitted rank */
+        recent_log_push(e, fm->frame, RLO_TAG_FAILURE);
+    for (int dst = 0; dst < e->ws; dst++) {
+        if (dst == e->rank || e->failed[dst])
+            continue;
+        rc = eng_isend(e, dst, RLO_TAG_FAILURE, e->rank, rank,
+                       e->epoch, 0, 0, 0);
+        if (rc != RLO_OK)
+            set_err(e, rc);
+    }
+    return 1;
+}
+
 static void declare_failed(rlo_engine *e, int rank)
 {
     /* capture the evidence BEFORE mark_failed clears the slot: the
@@ -1563,10 +1834,10 @@ static void declare_failed(rlo_engine *e, int rank)
     uint64_t age = (rank >= 0 && rank < e->ws && e->hb_seen[rank])
                        ? now - e->hb_seen[rank]
                        : (uint64_t)INT32_MAX;
-    if (!mark_failed(e, rank))
-        return;
     if (age > (uint64_t)INT32_MAX)
         age = (uint64_t)INT32_MAX;
+    if (!announce_failed(e, rank))
+        return;
     if (!getenv("RLO_QUIET"))
         /* suppressible like the Python twin's logging.Logger route */
         fprintf(stderr,
@@ -1577,40 +1848,46 @@ static void declare_failed(rlo_engine *e, int rank)
                 (double)e->fd_timeout / 1e3,
                 (double)e->fd_interval / 1e3);
     rlo_trace_emit(e->rank, RLO_EV_FAILURE, rank, 1, (int)age, 0);
-    /* tell the world: overlay broadcast AND point-to-point to every
-     * alive rank (overlay forwarding can have holes while views are
-     * converging; receivers suppress duplicates) */
-    int rc = bcast_init(e, RLO_TAG_FAILURE, rank, -1, 0, 0, 0);
-    if (rc != RLO_OK)
-        set_err(e, rc);
-    for (int dst = 0; dst < e->ws; dst++) {
-        if (dst == e->rank || e->failed[dst])
-            continue;
-        rc = eng_isend(e, dst, RLO_TAG_FAILURE, e->rank, rank, -1, 0, 0,
-                       0);
-        if (rc != RLO_OK)
-            set_err(e, rc);
-    }
 }
 
 static void on_failure(rlo_engine *e, rlo_msg *m)
 {
     int rank = m->pid;
+    int32_t declared = m->vote; /* declarer's epoch (-1 on legacy) */
     if (rank == e->rank) {
-        /* somebody suspects me — record it; there is no un-fail
-         * protocol (matching the reference's absence of recovery) */
+        if (declared >= 0 && declared < e->welcome_epoch) {
+            msg_free(m); /* pre-rejoin leftover about my old life */
+            return;
+        }
+        /* somebody declared me failed: the group re-formed without me
+         * and quarantines my traffic — record the suspicion AND
+         * petition for readmission (docs/DESIGN.md S8; rejoin
+         * replaces the old "no un-fail protocol" dead end) */
         if (e->suspected_self) {
             msg_free(m); /* duplicate */
             return;
         }
         e->suspected_self = 1;
-    } else {
-        if (!mark_failed(e, rank)) {
-            msg_free(m); /* already known: suppress the duplicate */
-            return;
+        int rc0 = bc_forward(e, m);
+        if (rc0 < 0) {
+            set_err(e, rc0);
+            msg_free(m);
         }
-        rlo_trace_emit(e->rank, RLO_EV_FAILURE, rank, 0, 0, 0);
+        become_joiner(e);
+        return;
     }
+    if (declared >= 0 && rank >= 0 && rank < e->ws &&
+        declared < e->admit_epoch[rank]) {
+        /* stale notice (declared before an admission we already
+         * executed): adopting it would flap the fresh member out */
+        msg_free(m);
+        return;
+    }
+    if (!mark_failed(e, rank)) {
+        msg_free(m); /* already known: suppress the duplicate */
+        return;
+    }
+    rlo_trace_emit(e->rank, RLO_EV_FAILURE, rank, 0, 0, 0);
     int rc = bc_forward(e, m); /* adopt-before-forward ordering */
     if (rc < 0) {
         set_err(e, rc);
@@ -1716,6 +1993,9 @@ int rlo_engine_stats(const rlo_engine *e, rlo_stats *out)
     out->arq_dup_drops = e->arq_dup;
     out->arq_gave_up = e->arq_gaveup;
     out->arq_unacked = e->arq_unacked_cnt;
+    out->epoch = e->epoch;
+    out->epoch_quarantined = e->quarantined;
+    out->rejoins = e->rejoins_cnt;
     out->q_wait = e->q_wait.len;
     out->q_pickup = e->q_pickup.len;
     out->q_wait_and_pickup = e->q_wait_pickup.len;
@@ -1749,6 +2029,584 @@ int rlo_engine_failed_count(const rlo_engine *e)
 int rlo_engine_suspected_self(const rlo_engine *e)
 {
     return e->suspected_self;
+}
+
+/* ---------------- membership epochs + elastic rejoin ----------------
+ * Mirror of rlo_tpu/engine.py's membership machinery (docs/DESIGN.md
+ * S8; see the protocol paragraph in rlo_core.h). Every rank carries a
+ * monotone membership epoch; a failed-but-alive rank converges back
+ * in by JOIN probes + an IAR admission round over the member set —
+ * the rootless op voting on its own membership — finished by a
+ * JOIN_WELCOME + recent-broadcast replay. */
+
+static int member_pid(const rlo_engine *e, int joiner)
+{
+    return RLO_MEMBER_PID_BASE - (joiner * e->ws + e->rank);
+}
+
+/* Fail my own in-flight round deterministically (watchdog expiry or
+ * entering joiner mode): free the slot, and for a membership round
+ * clear the admitting flag so the joiner's next probe re-petitions.
+ * Decision-pending rounds are left alone — their completion needs
+ * only the local send handles, no inbound frame.
+ *
+ * Known divergence from the Python twin: no RLO_TAG_ABORT broadcast
+ * (the C engine has no ABORT receive path — unknown tags go to app
+ * pickup, and leaking engine-internal frames there would be worse).
+ * Relays that parked the round are swept by the next successful
+ * admission of the same joiner (execute_admission); only a joiner
+ * that dies for good leaves its in-flight rounds parked, a bounded
+ * retention (no new petitions => no new rounds). */
+static void abort_own_round(rlo_engine *e)
+{
+    rlo_prop *p = &e->own;
+    if (p->state != RLO_IN_PROGRESS || p->decision_pending)
+        return;
+    p->state = RLO_FAILED;
+    e->prop_born = 0;
+    e->own_deadline = 0;
+    rlo_trace_emit(e->rank, RLO_EV_DECISION, p->pid, -1, p->gen, 0);
+    if (p->pid <= RLO_MEMBER_PID_BASE && p->payload &&
+        p->len >= RLO_MEMBER_MAGIC_LEN + 12 &&
+        !memcmp(p->payload, RLO_MEMBER_MAGIC, RLO_MEMBER_MAGIC_LEN)) {
+        int joiner = get_le32(p->payload + RLO_MEMBER_MAGIC_LEN);
+        if (joiner >= 0 && joiner < e->ws)
+            e->admitting[joiner] = 0;
+    }
+}
+
+static int min_alive(const rlo_engine *e)
+{
+    /* self always counts as alive (failed[rank] is never set) */
+    for (int r = 0; r < e->ws; r++)
+        if (r == e->rank || !e->failed[r])
+            return r;
+    return e->rank;
+}
+
+static uint64_t join_iv(const rlo_engine *e)
+{
+    if (e->join_interval)
+        return e->join_interval;
+    /* the failure detector's heartbeat interval when it is on, else a
+     * conservative default for explicit rejoin on detector-less
+     * engines (mirror of ProgressEngine.join_interval) */
+    return e->fd_interval ? e->fd_interval : 500000;
+}
+
+/* Total order on membership views: higher epoch wins, then the side
+ * containing the lower rank (disjoint split-brain views always differ
+ * there); exact ties break by rank id. Returns 1 when MY view wins
+ * against (ep, malive) as reported by `src`. */
+static int view_wins(const rlo_engine *e, int32_t ep, int malive,
+                     int src)
+{
+    int my_min = min_alive(e);
+    if (e->epoch != ep)
+        return e->epoch > ep;
+    if (my_min != malive)
+        return my_min < malive; /* -min_alive: lower base rank wins */
+    return e->rank < src;
+}
+
+/* Enter joiner mode: quarantine everything except membership frames
+ * and petition for readmission until a JOIN_WELCOME arrives. The
+ * full-quarantine gate is what makes the admission's link-sequence
+ * reset safe — no stale ACK or old-seq frame can touch the fresh
+ * link state. */
+static void become_joiner(rlo_engine *e)
+{
+    if (e->awaiting_welcome)
+        return;
+    /* my own in-flight round can never resolve once I quarantine
+     * everything (its votes would be dropped unread): fail it now
+     * and free the slot instead of wedging it forever */
+    abort_own_round(e);
+    e->awaiting_welcome = 1;
+    e->join_last = 0; /* probe immediately */
+}
+
+/* (incarnation, epoch, min-alive-rank, petition): petition=1 marks a
+ * JOINER's plea (it has reset itself and quarantines everything) vs a
+ * survivor's heal probe at a failed peer. */
+static void send_join_probe(rlo_engine *e, int dst)
+{
+    uint8_t payload[16];
+    put_le32(payload, e->incarnation);
+    put_le32(payload + 4, e->epoch);
+    put_le32(payload + 8, min_alive(e));
+    put_le32(payload + 12, e->awaiting_welcome ? 1 : 0);
+    eng_isend(e, dst, RLO_TAG_JOIN, e->rank, -1, -1, payload, 16, 0);
+    rlo_trace_emit(e->rank, RLO_EV_JOIN, dst, 1, e->incarnation,
+                   e->epoch);
+}
+
+/* Drop stale FAILURE notices about `keep`-flagged ranks from the
+ * re-flood log: a re-flooded declaration about a readmitted rank
+ * would kill the fresh incarnation. */
+static void purge_stale_failures_impl(rlo_engine *e,
+                                      const uint8_t *target, int rank)
+{
+    for (int i = 0; i < RLO_RECENT_LOG; i++) {
+        rlo_blob *b = e->recent[i];
+        if (!b || e->recent_tag[i] != RLO_TAG_FAILURE)
+            continue;
+        int32_t pid;
+        if (rlo_frame_decode(b->data, b->len, 0, &pid, 0, 0, 0) < 0)
+            continue;
+        if (target ? (pid >= 0 && pid < e->ws && target[pid])
+                   : pid == rank) {
+            rlo_blob_unref(b);
+            e->recent[i] = 0;
+        }
+    }
+}
+
+static void purge_stale_failures(rlo_engine *e, const uint8_t *target)
+{
+    purge_stale_failures_impl(e, target, -1);
+}
+
+static void purge_stale_failure_rank(rlo_engine *e, int rank)
+{
+    purge_stale_failures_impl(e, 0, rank);
+}
+
+/* Adopt an admission decision into the membership view (idempotent):
+ * re-form the overlay to include the joiner, raise the epoch to the
+ * agreed value, set the joiner's epoch floor (its dead incarnation's
+ * frames all fall below it), and clear the RECEIVE-side ARQ window
+ * toward the joiner — a restarted joiner's link seqs start at 0,
+ * which the old window would misread as duplicates. The send-side
+ * seq counter is never reset (monotone for this process's lifetime),
+ * so a peer that keeps its window across our reset can never misread
+ * our fresh frames as duplicates either. */
+static void execute_admission(rlo_engine *e, int joiner, int inc,
+                              int32_t new_epoch)
+{
+    if (joiner < 0 || joiner >= e->ws || joiner == e->rank ||
+        e->sub_excluded[joiner])
+        return;
+    if (new_epoch <= e->admit_epoch[joiner])
+        /* stale or duplicate admission artifact (an old decision
+         * re-flooded out of a replaced view): executing it would
+         * re-run the link reset ONE-SIDED and permanently desync the
+         * ARQ windows on that edge */
+        return;
+    e->admit_epoch[joiner] = new_epoch;
+    if (new_epoch > e->epoch)
+        e->epoch = new_epoch;
+    if (inc > e->admitted_inc[joiner])
+        e->admitted_inc[joiner] = inc;
+    e->epoch_floor[joiner] = new_epoch;
+    e->link_epoch[joiner] = new_epoch;
+    /* clear the receive window even when we never marked the joiner
+     * failed ourselves (another member re-declared and re-admitted
+     * it; the joiner reset its half at the welcome, so keeping ours
+     * would swallow its fresh seqs as duplicates). Our tx seq counter
+     * is NOT reset — seq spaces are monotone per process lifetime, so
+     * the joiner's window (fresh or kept) never misreads our next
+     * frames; the unfillable-hole rule in arq_on_ack re-syncs its
+     * cumulative-ACK watermark in one round trip. App-level dedup
+     * ((origin, seq) windows + the settled-round ring) keeps delivery
+     * exactly-once across the reset. */
+    arq_drop_dst(e, joiner);
+    e->tx_skip[joiner] = -1;
+    e->rx_contig[joiner] = -1;
+    memset(&e->rx_mask[(size_t)joiner * RLO_SEEN_WORDS], 0,
+           RLO_SEEN_WORDS * sizeof(uint64_t));
+    e->ack_due[joiner] = 0;
+    /* fresh heartbeat grace — the joiner may be our new predecessor
+     * and a stale stamp would re-declare it instantly */
+    e->hb_seen[joiner] = rlo_now_usec();
+    /* abandoned concurrent admission rounds for this joiner (their
+     * proposer's watchdog fired, or the round wedged in a mixed-view
+     * tree) are settled by THIS admission: unpark their parked relays
+     * so they don't accumulate across heal churn */
+    for (rlo_msg *pm = e->q_iar_pending.head; pm;) {
+        rlo_msg *nm = pm->next;
+        if (pm->ps && pm->pid <= RLO_MEMBER_PID_BASE &&
+            (RLO_MEMBER_PID_BASE - pm->pid) / e->ws == joiner) {
+            pm->ps->state = RLO_FAILED;
+            q_remove(&e->q_iar_pending, pm);
+            msg_free(pm);
+        }
+        pm = nm;
+    }
+    purge_stale_failure_rank(e, joiner);
+    if (!e->failed[joiner])
+        return; /* view unchanged (concurrent admitting proposer) */
+    e->failed[joiner] = 0;
+    e->n_failed--;
+    e->rejoins_cnt++;
+    rlo_trace_emit(e->rank, RLO_EV_ADMIT, joiner, e->epoch, inc, 0);
+    if (!getenv("RLO_QUIET"))
+        fprintf(stderr,
+                "rlo_tpu: rank %d admitted rank %d (incarnation %d, "
+                "epoch %d)\n",
+                e->rank, joiner, inc, (int)e->epoch);
+    /* plug forwarding holes across the overlay re-form, exactly like
+     * the failure path does */
+    reflood_recent(e);
+}
+
+static void send_welcome(rlo_engine *e, int joiner, int inc,
+                         int32_t new_epoch)
+{
+    int64_t cap = 12 + 4 * (int64_t)e->ws;
+    uint8_t *payload = (uint8_t *)malloc((size_t)cap);
+    if (!payload) {
+        set_err(e, RLO_ERR_NOMEM);
+        return;
+    }
+    int n = 0;
+    for (int r = 0; r < e->ws; r++)
+        if (r == e->rank || !e->failed[r])
+            put_le32(payload + 12 + 4 * n++, r);
+    put_le32(payload, new_epoch);
+    put_le32(payload + 4, inc);
+    put_le32(payload + 8, n);
+    eng_isend(e, joiner, RLO_TAG_JOIN_WELCOME, e->rank, -1, -1, payload,
+              12 + 4 * (int64_t)n, 0);
+    free(payload);
+}
+
+/* Point-to-point replay of the recent-broadcast log to a freshly
+ * admitted joiner so it converges on recent traffic (its (origin,
+ * seq) dedup absorbs anything it already saw). FAILURE notices AND
+ * membership decisions are skipped — the welcome's member list is
+ * the authoritative view, and a stale admission decision about a
+ * since-re-failed rank would pass the joiner's admit_epoch guard
+ * (reset by the welcome) and resurrect the dead rank in its view. */
+static void replay_recent(rlo_engine *e, int joiner)
+{
+    for (int i = 0; i < RLO_RECENT_LOG; i++) {
+        rlo_blob *b = e->recent[i];
+        if (!b || e->recent_tag[i] == RLO_TAG_FAILURE)
+            continue;
+        if (e->recent_tag[i] == RLO_TAG_IAR_DECISION) {
+            int32_t pid;
+            if (rlo_frame_decode(b->data, b->len, 0, &pid, 0, 0,
+                                 0) >= 0 &&
+                pid <= RLO_MEMBER_PID_BASE)
+                continue;
+        }
+        eng_isend_frame(e, joiner, e->recent_tag[i], b, 0);
+    }
+}
+
+/* Admitting proposer's epilogue: execute the admission, then welcome
+ * + replay to the joiner. */
+static void finish_member_round(rlo_engine *e)
+{
+    rlo_prop *p = &e->own;
+    if (!p->payload || p->len < RLO_MEMBER_MAGIC_LEN + 12 ||
+        memcmp(p->payload, RLO_MEMBER_MAGIC, RLO_MEMBER_MAGIC_LEN))
+        return;
+    int joiner = get_le32(p->payload + RLO_MEMBER_MAGIC_LEN);
+    int inc = get_le32(p->payload + RLO_MEMBER_MAGIC_LEN + 4);
+    int32_t new_epoch = get_le32(p->payload + RLO_MEMBER_MAGIC_LEN + 8);
+    if (joiner < 0 || joiner >= e->ws)
+        return;
+    e->admitting[joiner] = 0;
+    if (e->pending_join[joiner]) {
+        e->pending_join[joiner] = 0;
+        e->n_pending--;
+    }
+    if (!p->vote)
+        return;
+    execute_admission(e, joiner, inc, new_epoch);
+    send_welcome(e, joiner, inc, new_epoch);
+    replay_recent(e, joiner);
+}
+
+/* A JOIN probe/petition arrived: compare view keys. If the sender's
+ * view loses and it is failed here, petition to admit it (IAR over
+ * the member set). If its view wins, become a joiner ourselves
+ * (split-brain heal = mutual rejoin, higher epoch winning). If it
+ * probes us while we hold the winning view but consider it alive,
+ * answer with our own probe so it petitions us. Does NOT consume m. */
+static void on_join(rlo_engine *e, rlo_msg *m)
+{
+    int src = m->src;
+    if (src < 0 || src >= e->ws || src == e->rank ||
+        e->sub_excluded[src] || m->len < 16)
+        return;
+    int inc = get_le32(m->payload);
+    int32_t ep = get_le32(m->payload + 4);
+    int malive = get_le32(m->payload + 8);
+    int petition = get_le32(m->payload + 12);
+    rlo_trace_emit(e->rank, RLO_EV_JOIN, src, 0, inc, ep);
+    if (e->awaiting_welcome)
+        return; /* mid-rejoin ourselves; the winning side sorts us */
+    int mine_wins = view_wins(e, ep, malive, src);
+    if (e->failed[src]) {
+        if (!mine_wins) {
+            become_joiner(e);
+            return;
+        }
+        if (inc < e->admitted_inc[src])
+            return; /* stale probe from an already-replaced life */
+        if (e->admitting[src] || e->pending_join[src])
+            return; /* a round for it is already queued/in flight */
+        e->pending_join[src] = 1;
+        e->pending_inc[src] = inc;
+        e->pending_ep[src] = ep;
+        e->n_pending++;
+    } else if (!mine_wins) {
+        become_joiner(e);
+    } else if (petition) {
+        /* a rank we consider ALIVE is petitioning against our winning
+         * view: it has reset itself and quarantines our traffic, so
+         * it is effectively failed here — adopt + announce that, then
+         * run the normal admission (without this, a lone stale-view
+         * winner would answer petitions with probes forever and
+         * nobody would ever admit anyone) */
+        announce_failed(e, src);
+        if (inc >= e->admitted_inc[src] && !e->admitting[src]) {
+            if (!e->pending_join[src]) {
+                e->pending_join[src] = 1;
+                e->n_pending++;
+            }
+            e->pending_inc[src] = inc;
+            e->pending_ep[src] = ep;
+        }
+    } else {
+        /* the prober holds a losing view yet thinks we are alive
+         * (asymmetric partition): show it the winning view */
+        send_join_probe(e, src);
+    }
+}
+
+/* The admitting proposer's JOIN_WELCOME: adopt its membership view
+ * wholesale — epoch, member list, fresh link state and heartbeat
+ * grace everywhere, per-member epoch floors at the agreed epoch
+ * (members only send to us AFTER executing the admission, so
+ * everything below the floor is pre-partition leftovers). The replay
+ * of the proposer's recent-broadcast log follows on the same FIFO
+ * channel. Does NOT consume m. */
+static void on_welcome(rlo_engine *e, rlo_msg *m)
+{
+    if (m->len < 12)
+        return;
+    int32_t new_epoch = get_le32(m->payload);
+    int inc = get_le32(m->payload + 4);
+    int n = get_le32(m->payload + 8);
+    if (inc != e->incarnation)
+        return; /* welcome addressed to an older life of this rank */
+    if (n < 0 || m->len < 12 + 4 * (int64_t)n)
+        return;
+    if (!e->awaiting_welcome && new_epoch <= e->welcome_epoch)
+        /* duplicate/stale welcome (concurrent admitting proposers).
+         * Deliberately compared against the last ADOPTED welcome
+         * epoch, not e->epoch: our own epoch can outrun the round's
+         * agreed epoch via local declarations, and rejecting the
+         * welcome then would leave the admitting side's link-state
+         * reset one-sided (a permanently desynced ARQ window) — the
+         * exact mirror of the members' admit_epoch idempotence rule */
+        return;
+    uint8_t *mem = (uint8_t *)calloc((size_t)e->ws, 1);
+    if (!mem) {
+        set_err(e, RLO_ERR_NOMEM);
+        return;
+    }
+    mem[e->rank] = 1;
+    for (int i = 0; i < n; i++) {
+        int r = get_le32(m->payload + 12 + 4 * i);
+        if (r >= 0 && r < e->ws)
+            mem[r] = 1;
+    }
+    e->awaiting_welcome = 0;
+    e->suspected_self = 0;
+    if (new_epoch > e->welcome_epoch)
+        e->welcome_epoch = new_epoch;
+    if (new_epoch > e->epoch)
+        e->epoch = new_epoch;
+    e->n_failed = 0;
+    for (int r = 0; r < e->ws; r++) {
+        if (mem[r] && r != e->rank && e->admit_epoch[r] < new_epoch)
+            /* members of the adopted view are known-alive at this
+             * epoch: FAILURE notices declared below it are stale */
+            e->admit_epoch[r] = new_epoch;
+        e->failed[r] = (!mem[r] || e->sub_excluded[r]) ? 1 : 0;
+        if (r == e->rank)
+            e->failed[r] = 0;
+        e->n_failed += e->failed[r];
+        /* fresh receive state everywhere (skip notices, windows,
+         * floors); tx_seq is PRESERVED — seq spaces are monotone per
+         * process lifetime, so a member whose matching admission
+         * execution was suppressed as stale (its rx watermark intact)
+         * still reads our next frames as fresh instead of silently
+         * dup-dropping them into a half-dead-link deadlock */
+        e->tx_skip[r] = -1;
+        e->tx_skip_due[r] = 0;
+        e->skip_hold[r] = 0;
+        e->ack_due[r] = 0;
+        e->rx_contig[r] = -1;
+        e->hb_seen[r] = 0;
+        int in_view = mem[r] && r != e->rank;
+        e->epoch_floor[r] = in_view ? new_epoch : 0;
+        e->link_epoch[r] = in_view ? new_epoch : 0;
+    }
+    memset(e->rx_mask, 0,
+           (size_t)e->ws * RLO_SEEN_WORDS * sizeof(uint64_t));
+    for (rlo_rtx *rt = e->rtx_head; rt;) {
+        rlo_rtx *nrt = rt->next;
+        rlo_blob_unref(rt->frame);
+        free(rt);
+        rt = nrt;
+    }
+    e->rtx_head = 0;
+    e->arq_unacked_cnt = 0;
+    e->hb_last_sent = 0;
+    purge_stale_failures(e, mem);
+    /* relayed rounds whose proposer is outside the adopted view can
+     * never resolve here — unpark them as FAILED (the mirror of
+     * abort_orphaned_proposals for the joiner side) */
+    for (rlo_msg *pm = e->q_iar_pending.head; pm;) {
+        rlo_msg *nm = pm->next;
+        if (pm->ps &&
+            (pm->origin < 0 || pm->origin >= e->ws || !mem[pm->origin])) {
+            pm->ps->state = RLO_FAILED;
+            q_remove(&e->q_iar_pending, pm);
+            msg_free(pm);
+        }
+        pm = nm;
+    }
+    e->rejoins_cnt++;
+    e->join_last = 0;
+    rlo_trace_emit(e->rank, RLO_EV_ADMIT, e->rank, e->epoch, inc,
+                   m->src);
+    if (!getenv("RLO_QUIET"))
+        fprintf(stderr,
+                "rlo_tpu: rank %d rejoined at epoch %d (welcomed by "
+                "rank %d)\n",
+                e->rank, (int)e->epoch, m->src);
+    free(mem);
+}
+
+/* Joiner side: petition every potential member at join_interval.
+ * Survivor side: launch queued admission rounds once the (single)
+ * own-proposal slot frees up, and probe failed-but-maybe-alive peers
+ * so a healed partition or silent restart is discovered without any
+ * out-of-band signal. */
+static void membership_tick(rlo_engine *e)
+{
+    uint64_t now = rlo_now_usec();
+    uint64_t iv = join_iv(e);
+    if (e->awaiting_welcome) {
+        if (now - e->join_last >= iv) {
+            e->join_last = now;
+            for (int dst = 0; dst < e->ws; dst++)
+                if (dst != e->rank && !e->sub_excluded[dst])
+                    send_join_probe(e, dst);
+        }
+        return;
+    }
+    if (e->n_pending && e->own.state != RLO_IN_PROGRESS) {
+        int joiner = -1;
+        for (int r = 0; r < e->ws; r++)
+            if (e->pending_join[r]) {
+                joiner = r;
+                break;
+            }
+        if (joiner >= 0) {
+            e->pending_join[joiner] = 0;
+            e->n_pending--;
+            if (e->failed[joiner] && !e->admitting[joiner]) {
+                e->admitting[joiner] = 1;
+                /* the agreed post-admission epoch: above BOTH sides'
+                 * views, so the joiner's fresh frames clear every
+                 * member's floor and its old life's frames never do */
+                int32_t jep = e->pending_ep[joiner];
+                int32_t new_epoch =
+                    (e->epoch > jep ? e->epoch : jep) + 1;
+                uint8_t payload[RLO_MEMBER_MAGIC_LEN + 12];
+                memcpy(payload, RLO_MEMBER_MAGIC, RLO_MEMBER_MAGIC_LEN);
+                put_le32(payload + RLO_MEMBER_MAGIC_LEN, joiner);
+                put_le32(payload + RLO_MEMBER_MAGIC_LEN + 4,
+                         e->pending_inc[joiner]);
+                put_le32(payload + RLO_MEMBER_MAGIC_LEN + 8, new_epoch);
+                rlo_submit_proposal(e, payload, sizeof(payload),
+                                    member_pid(e, joiner));
+                /* arm the membership watchdog: if the round wedges
+                 * (mixed-view vote-tree cycle), fail it and let the
+                 * joiner's next probe retry on the settled view */
+                if (e->own.state == RLO_IN_PROGRESS) {
+                    uint64_t budget = 4 * e->fd_timeout;
+                    if (20 * iv > budget)
+                        budget = 20 * iv;
+                    e->own_deadline = now + budget;
+                }
+            }
+        }
+    }
+    int probe = 0;
+    for (int r = 0; r < e->ws; r++)
+        if (e->failed[r] && !e->sub_excluded[r])
+            probe = 1;
+    if (probe && now - e->join_last >= iv) {
+        e->join_last = now;
+        for (int r = 0; r < e->ws; r++)
+            if (e->failed[r] && !e->sub_excluded[r])
+                send_join_probe(e, r);
+    }
+}
+
+int rlo_engine_set_incarnation(rlo_engine *e, int incarnation)
+{
+    /* bounded so the shifted base fits the int32 wire fields AFTER
+     * the rank-qualification multiply in rlo_submit_proposal
+     * (gen = gen_counter * ws + rank; mirror of engine.py's
+     * _incarnation_cap — the plain INT32_MAX >> 20 bound would let
+     * the multiply overflow signed int, which is UB) */
+    if (!e || incarnation < 0 || incarnation < e->incarnation ||
+        (int64_t)incarnation >
+            ((int64_t)INT32_MAX / e->ws) >> 20)
+        return RLO_ERR_ARG;
+    e->incarnation = incarnation;
+    /* re-partition the broadcast-seq and round-generation spaces so
+     * peers' dedup windows never swallow the new life's frames */
+    int32_t base = (int32_t)incarnation << 20;
+    if (e->bcast_seq < base)
+        e->bcast_seq = base;
+    if (e->gen_counter < base)
+        e->gen_counter = base;
+    if (incarnation > 0)
+        become_joiner(e);
+    return RLO_OK;
+}
+
+int rlo_engine_rejoin(rlo_engine *e)
+{
+    if (!e)
+        return RLO_ERR_ARG;
+    int rc = rlo_engine_set_incarnation(e, e->incarnation + 1);
+    if (rc != RLO_OK)
+        return rc;
+    e->join_last = 0;
+    rlo_progress_all(e->w);
+    return e->incarnation;
+}
+
+int64_t rlo_engine_epoch(const rlo_engine *e)
+{
+    return e->epoch;
+}
+
+int64_t rlo_engine_epoch_quarantined(const rlo_engine *e)
+{
+    return e->quarantined;
+}
+
+int64_t rlo_engine_rejoins(const rlo_engine *e)
+{
+    return e->rejoins_cnt;
+}
+
+int rlo_engine_awaiting_welcome(const rlo_engine *e)
+{
+    return e->awaiting_welcome;
 }
 
 /* ---------------- delivery ---------------- */
@@ -1880,6 +2738,7 @@ void rlo_engine_progress_once(rlo_engine *e)
         if (done) {
             p->state = RLO_COMPLETED;
             p->decision_pending = 0;
+            e->own_deadline = 0;
             if (e->prop_born) {
                 uint64_t now = rlo_now_usec();
                 if (now >= e->prop_born)
@@ -1889,6 +2748,9 @@ void rlo_engine_progress_once(rlo_engine *e)
             }
         }
     }
+    if (p->state == RLO_IN_PROGRESS && !p->decision_pending &&
+        e->own_deadline && rlo_now_usec() > e->own_deadline)
+        abort_own_round(e); /* membership watchdog expired */
 
     /* (b) drain the transport, dispatch on tag (:569-624) */
     for (;;) {
@@ -1911,8 +2773,54 @@ void rlo_engine_progress_once(rlo_engine *e)
             }
             m->arrived = rlo_now_usec();
         }
-        /* ANY frame proves the sender alive — prevents heartbeat
-         * starvation when membership views transiently diverge */
+        /* membership frames cross the boundaries the quarantine below
+         * enforces — dispatch them first (docs/DESIGN.md S8) */
+        if (m->tag == RLO_TAG_JOIN) {
+            on_join(e, m);
+            msg_free(m);
+            continue;
+        }
+        if (m->tag == RLO_TAG_JOIN_WELCOME) {
+            on_welcome(e, m);
+            msg_free(m);
+            continue;
+        }
+        /* stale-epoch / failed-sender quarantine, BEFORE ACK handling
+         * and the ARQ dedup: a dead incarnation's traffic (and
+         * everything while this rank is itself mid-rejoin) must not
+         * touch link state, liveness, or app state */
+        if (e->awaiting_welcome) {
+            e->quarantined++;
+            msg_free(m);
+            continue;
+        }
+        if (m->src >= 0 && m->src < e->ws) {
+            if (e->failed[m->src]) {
+                e->quarantined++;
+                msg_free(m);
+                continue;
+            }
+            if (e->epoch_floor[m->src] &&
+                rlo_frame_epoch(m->frame->data) <
+                    e->epoch_floor[m->src]) {
+                e->quarantined++;
+                /* stale-sender nack: an ALIVE sender stamping below
+                 * our floor missed its one-shot JOIN_WELCOME — show
+                 * it the winning view so it re-petitions (no heal
+                 * probe fires at it: neither side holds the other
+                 * failed). Rate-limited at the probe cadence. */
+                uint64_t snow = rlo_now_usec();
+                if (snow - e->stale_probe_last[m->src] >= join_iv(e)) {
+                    e->stale_probe_last[m->src] = snow;
+                    send_join_probe(e, m->src);
+                }
+                msg_free(m);
+                continue;
+            }
+        }
+        /* ANY accepted frame proves the sender alive — prevents
+         * heartbeat starvation when membership views transiently
+         * diverge */
         if (e->fd_timeout && m->src >= 0 && m->src < e->ws)
             e->hb_seen[m->src] = rlo_now_usec();
         if (m->tag == RLO_TAG_ACK) {
@@ -1987,13 +2895,26 @@ void rlo_engine_progress_once(rlo_engine *e)
         }
     }
 
-    /* (b2) liveness: heartbeat my ring successor, watch my predecessor */
-    failure_tick(e);
+    /* (b2) liveness: heartbeat my ring successor, watch my predecessor
+     * — suspended while mid-rejoin (a joiner quarantines everything,
+     * so its detector would only produce false declarations against
+     * peers it cannot hear) */
+    if (!e->awaiting_welcome)
+        failure_tick(e);
 
-    /* (b3) reliable delivery: retransmit overdue unacked frames, then
-     * flush the cumulative ACKs this turn's receipts owe */
+    /* (b2b) membership: JOIN petitions (joiner side), heal probes at
+     * failed-but-maybe-alive peers, and queued admission rounds
+     * waiting for the own-proposal slot (docs/DESIGN.md S8) */
+    if (e->awaiting_welcome || e->n_pending ||
+        e->n_failed > e->n_excluded)
+        membership_tick(e);
+
+    /* (b3) reliable delivery: retransmit overdue unacked frames,
+     * escalate give-ups to the failure detector, then flush the
+     * cumulative ACKs this turn's receipts owe */
     if (e->arq_rto) {
         arq_tick(e);
+        arq_escalate_gaveup(e);
         arq_flush_acks(e);
     }
 
@@ -2069,8 +2990,14 @@ int rlo_engine_state_set(rlo_engine *e, const rlo_engine_state *in)
     e->own.vote = in->prop_vote;
     e->own.votes_needed = in->prop_votes_needed;
     e->own.votes_recved = in->prop_votes_recved;
-    e->gen_counter = in->gen_counter;
-    e->bcast_seq = in->bcast_seq;
+    /* never rewind below the incarnation base: a restarted process
+     * that set a fresh incarnation BEFORE restoring a pre-crash
+     * snapshot would otherwise reissue its dead life's (pid, gen)
+     * and bcast seqs, which peers' dedup windows silently swallow */
+    int32_t inc_base = (int32_t)e->incarnation << 20;
+    e->gen_counter =
+        in->gen_counter < inc_base ? inc_base : in->gen_counter;
+    e->bcast_seq = in->bcast_seq < inc_base ? inc_base : in->bcast_seq;
     return RLO_OK;
 }
 

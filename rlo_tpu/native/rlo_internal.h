@@ -118,6 +118,11 @@ typedef struct rlo_transport_ops {
      * src -> dst (in-process transports only); NULL = unsupported */
     int (*drop_next)(rlo_world *w, int src, int dst, int count);
     int (*dup_next)(rlo_world *w, int src, int dst, int count);
+    /* fault injection: group partition (NULL group_of = heal) and
+     * killed-rank revival (in-process transports only);
+     * NULL = unsupported */
+    int (*partition)(rlo_world *w, const int *group_of, int n);
+    int (*revive)(rlo_world *w, int rank);
     /* block until every rank reaches the barrier (multi-process
      * transports); NULL = no-op (single-process worlds need none) */
     void (*barrier)(rlo_world *w);

@@ -31,6 +31,9 @@ typedef struct rlo_loop_world {
     uint8_t *dead;  /* fault injection: killed ranks */
     int *drops;     /* fault injection: per (src*ws+dst) pending drops */
     int *dups;      /* fault injection: per (src*ws+dst) pending dups */
+    int *pgroup;    /* fault injection: partition group per rank
+                     * (NULL = no partition); frames crossing groups
+                     * are dropped at send time */
 } rlo_loop_world;
 
 static uint64_t xorshift64(uint64_t *s)
@@ -74,6 +77,7 @@ static void loop_free(rlo_world *base)
     free(w->dead);
     free(w->drops);
     free(w->dups);
+    free(w->pgroup);
     free(base->engines);
     free(w);
 }
@@ -136,6 +140,7 @@ static int loop_isend(rlo_world *base, int src, int dst, int comm, int tag,
     if (dst < 0 || dst >= base->world_size || !frame || frame->len < 0)
         return RLO_ERR_ARG;
     if (w->dead[src] || w->dead[dst] ||
+        (w->pgroup && w->pgroup[src] != w->pgroup[dst]) ||
         w->drops[src * base->world_size + dst] > 0) {
         /* a dead host's packets never leave it; packets to a dead host
          * (or hit by loss injection) vanish — the handle completes
@@ -233,6 +238,57 @@ static int loop_dup_next(rlo_world *base, int src, int dst, int count)
     return RLO_OK;
 }
 
+/* Group partition: sends crossing the cut vanish (handles complete
+ * done-but-failed); frames already in flight across the cut are
+ * dropped too, like a link going dark. NULL group_of = heal. */
+static int loop_partition(rlo_world *base, const int *group_of, int n)
+{
+    rlo_loop_world *w = (rlo_loop_world *)base;
+    if (!group_of) {
+        free(w->pgroup);
+        w->pgroup = 0;
+        return RLO_OK;
+    }
+    if (n != base->world_size)
+        return RLO_ERR_ARG;
+    if (!w->pgroup) {
+        w->pgroup = (int *)malloc((size_t)n * sizeof(int));
+        if (!w->pgroup)
+            return RLO_ERR_NOMEM;
+    }
+    memcpy(w->pgroup, group_of, (size_t)n * sizeof(int));
+    for (rlo_channel *c = w->channels; c; c = c->next) {
+        if (w->pgroup[c->src] == w->pgroup[c->dst])
+            continue;
+        for (rlo_wire_node *nd = c->head; nd;) {
+            rlo_wire_node *nn = nd->next;
+            nd->handle->delivered = 1;
+            nd->handle->failed = 1;
+            free_node(nd);
+            nd = nn;
+        }
+        c->head = c->tail = 0;
+    }
+    return RLO_OK;
+}
+
+/* Revive a killed rank's endpoint (empty inbox; the harness builds a
+ * fresh engine with a bumped incarnation on top). */
+static int loop_revive(rlo_world *base, int rank)
+{
+    rlo_loop_world *w = (rlo_loop_world *)base;
+    if (rank < 0 || rank >= base->world_size)
+        return RLO_ERR_ARG;
+    w->dead[rank] = 0;
+    for (rlo_wire_node *n = w->inbox_head[rank]; n;) {
+        rlo_wire_node *nn = n->next;
+        free_node(n);
+        n = nn;
+    }
+    w->inbox_head[rank] = w->inbox_tail[rank] = 0;
+    return RLO_OK;
+}
+
 /* Move every due channel head to its inbox. Only heads can become due,
  * which preserves per-channel FIFO under latency injection. */
 static void pump(rlo_loop_world *w)
@@ -311,6 +367,8 @@ static const rlo_transport_ops LOOP_OPS = {
     .kill_rank = loop_kill_rank,
     .drop_next = loop_drop_next,
     .dup_next = loop_dup_next,
+    .partition = loop_partition,
+    .revive = loop_revive,
     .free_ = loop_free,
 };
 

@@ -1,12 +1,15 @@
 /* Wire format: one variable-size frame per message.
  *
- * Layout (little-endian, matching rlo_tpu/wire.py `<iiiiQ>`):
- *   [origin:i32][pid:i32][vote:i32][seq:i32][len:u64][payload bytes]
+ * Layout (little-endian, matching rlo_tpu/wire.py `<iiiiiQ>`):
+ *   [origin:i32][pid:i32][vote:i32][seq:i32][epoch:i32][len:u64][payload]
  * The reference's pbuf (rootless_ops.c:1369-1410) carries the same logical
  * fields but always ships a fixed 32 KB buffer (:1588); frames here are
  * exactly header + payload. `seq` is the reliable-delivery layer's
- * per-(sender, receiver) link sequence number (-1 outside the ARQ path);
- * it is link state, not an application field.
+ * per-(sender, receiver) link sequence number (-1 outside the ARQ path)
+ * and `epoch` is the membership layer's LINK epoch for the edge (the
+ * admission epoch of its last link-state reset, 0 on the original link;
+ * receivers quarantine frames below their per-sender floor —
+ * docs/DESIGN.md S8). Both are link state, not application fields.
  */
 #include "rlo_core.h"
 
@@ -50,7 +53,8 @@ int64_t rlo_frame_encode(uint8_t *dst, int64_t cap, int32_t origin,
     put_i32(dst + 4, pid);
     put_i32(dst + 8, vote);
     put_i32(dst + RLO_SEQ_OFFSET, seq);
-    put_u64(dst + 16, (uint64_t)len);
+    put_i32(dst + RLO_EPOCH_OFFSET, 0); /* stamped by the send gate */
+    put_u64(dst + 20, (uint64_t)len);
     if (len > 0)
         memcpy(dst + RLO_HEADER_SIZE, payload, (size_t)len);
     return RLO_HEADER_SIZE + len;
@@ -62,7 +66,7 @@ int64_t rlo_frame_decode(const uint8_t *raw, int64_t rawlen, int32_t *origin,
 {
     if (rawlen < RLO_HEADER_SIZE)
         return RLO_ERR_ARG;
-    uint64_t n = get_u64(raw + 16);
+    uint64_t n = get_u64(raw + 20);
     if ((int64_t)n > rawlen - RLO_HEADER_SIZE)
         return RLO_ERR_ARG; /* truncated frame */
     if (origin)
@@ -76,4 +80,14 @@ int64_t rlo_frame_decode(const uint8_t *raw, int64_t rawlen, int32_t *origin,
     if (payload)
         *payload = raw + RLO_HEADER_SIZE;
     return (int64_t)n;
+}
+
+int32_t rlo_frame_epoch(const uint8_t *raw)
+{
+    return get_i32(raw + RLO_EPOCH_OFFSET);
+}
+
+void rlo_frame_set_epoch(uint8_t *raw, int32_t epoch)
+{
+    put_i32(raw + RLO_EPOCH_OFFSET, epoch);
 }

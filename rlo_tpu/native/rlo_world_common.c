@@ -103,6 +103,20 @@ int rlo_world_dup_next(rlo_world *w, int src, int dst, int count)
     return w->ops->dup_next(w, src, dst, count);
 }
 
+int rlo_world_partition(rlo_world *w, const int *group_of, int n)
+{
+    if (!w->ops->partition)
+        return RLO_ERR_ARG;
+    return w->ops->partition(w, group_of, n);
+}
+
+int rlo_world_revive_rank(rlo_world *w, int rank)
+{
+    if (!w->ops->revive)
+        return RLO_ERR_ARG;
+    return w->ops->revive(w, rank);
+}
+
 void rlo_world_free(rlo_world *w)
 {
     if (!w)

@@ -178,10 +178,20 @@ def _write_block_kernel(pos_ref, rows_ref, cache_ref, out_ref, *,
     blk = jnp.minimum(start // 128 + j, n_blocks - 1)
     base = blk * 128
     col = base + jax.lax.broadcasted_iota(jnp.int32, (1, 1, 1, 128), 3)
-    out = cache_ref[...]
-    for t in range(T):  # T is small and static: T masked selects
-        out = jnp.where(col == start + t, rows_ref[..., t:t + 1], out)
-    out_ref[...] = out
+    # single range-compare (start <= col < start + T) instead of a
+    # T-deep masked-select chain (round-5 advisor finding #4): each
+    # in-window lane picks its row through a (T, 128) one-hot
+    # contraction (exact — exactly one nonzero term per lane), and ONE
+    # select applies the window; out-of-window lanes copy the cache
+    t_iota = jax.lax.broadcasted_iota(jnp.int32, (T, 128), 0)
+    c_iota = base + jax.lax.broadcasted_iota(jnp.int32, (T, 128), 1)
+    onehot = (c_iota == start + t_iota).astype(jnp.float32)
+    vals = jax.lax.dot_general(
+        rows_ref[...].astype(jnp.float32), onehot,
+        (((3,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(cache_ref.dtype)
+    in_window = (col >= start) & (col < start + T)
+    out_ref[...] = jnp.where(in_window, vals, cache_ref[...])
 
 
 def can_write_block(max_len: int) -> bool:

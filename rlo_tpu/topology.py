@@ -149,6 +149,27 @@ def initiator_targets(world_size: int, rank: int) -> Tuple[int, ...]:
 
 
 # ---------------------------------------------------------------------------
+# Membership-view helpers (elastic re-forming + rejoin, docs/DESIGN.md §8)
+# ---------------------------------------------------------------------------
+
+def virtual_map(alive: Sequence[int]) -> dict:
+    """real rank -> virtual rank over a sorted alive list — the
+    translation the elastic overlay runs the skip-ring math through
+    (identity while nothing has failed). One definition shared by the
+    failure re-form and the rejoin admission paths, so both always
+    rebuild the same view."""
+    return {r: v for v, r in enumerate(alive)}
+
+
+def ring_neighbors(alive: Sequence[int], rank: int) -> Tuple[int, int]:
+    """(successor, predecessor) of ``rank`` on the alive ring — the
+    heartbeat monitoring edges of the failure detector."""
+    i = alive.index(rank)
+    n = len(alive)
+    return alive[(i + 1) % n], alive[(i - 1) % n]
+
+
+# ---------------------------------------------------------------------------
 # Static schedules (TPU lowering; also reused by engine-level collectives)
 # ---------------------------------------------------------------------------
 

@@ -323,8 +323,16 @@ def load_engine_state(engine, state: dict) -> None:
     p.pid, p.vote = snap["pid"], snap["vote"]
     p.state = type(p.state)(snap["state"])
     p.votes_needed, p.votes_recved = snap["votes_needed"], snap["votes_recved"]
-    engine._gen_next = state.get("gen_next", engine._gen_next)
-    engine._bcast_seq = state.get("bcast_seq", engine._bcast_seq)
+    # never rewind below the incarnation base: a restarted process
+    # that bumped its incarnation BEFORE restoring a pre-crash
+    # snapshot would otherwise reissue its dead life's (pid, gen)
+    # and bcast seqs, which peers' dedup windows silently swallow
+    from rlo_tpu.engine import INCARNATION_SHIFT
+    inc_base = engine.incarnation << INCARNATION_SHIFT
+    engine._gen_next = max(state.get("gen_next", engine._gen_next),
+                           inc_base + 1)
+    engine._bcast_seq = max(state.get("bcast_seq", engine._bcast_seq),
+                            inc_base)
     if "seen_bcast" in state:  # pre-feature snapshots: preserve current
         engine._seen_bcast = {int(o): [ent[0], set(ent[1])]
                               for o, ent in state["seen_bcast"].items()}

@@ -34,6 +34,21 @@ from typing import Dict, List, Optional
 #: number of histogram buckets — mirror of RLO_HIST_BUCKETS (rlo_core.h)
 HIST_BUCKETS = 28
 
+#: The engine-counter schema, in snapshot order — the single source of
+#: truth for the ``metrics()["counters"]`` keys both engines emit
+#: (ProgressEngine.metrics() and bindings.NativeEngine.metrics() build
+#: from this tuple; the parity test asserts the dicts are identical).
+#: ``epoch`` is the current membership epoch (monotone view counter),
+#: ``epoch_quarantined`` counts frames dropped by the stale-epoch /
+#: failed-sender quarantine, and ``rejoins`` counts membership
+#: admissions executed (or adopted, on the joiner side) —
+#: docs/DESIGN.md §8.
+ENGINE_COUNTER_KEYS = (
+    "sent_bcast", "recved_bcast", "total_pickup", "ops_failed",
+    "arq_retransmits", "arq_dup_drops", "arq_gave_up", "arq_unacked",
+    "epoch", "epoch_quarantined", "rejoins",
+)
+
 
 class Counter:
     """Monotonically increasing integer."""

@@ -34,8 +34,11 @@ from __future__ import annotations
 
 import bisect
 import json
+import logging
 from pathlib import Path
 from typing import Dict, Iterable, List, Optional, Union
+
+logger = logging.getLogger("rlo_tpu.timeline")
 
 #: transport tags whose frames are store-and-forward broadcast — the
 #: tags BCAST_FWD / BCAST_INIT events can carry in ``a`` (mirror of
@@ -48,12 +51,40 @@ Source = Union[str, Path, Iterable[Dict]]
 
 
 def load_jsonl(path: Union[str, Path]) -> List[Dict]:
+    """Load one per-rank dump, tolerating crashed-rank artifacts: a
+    missing or empty file yields no events, and a truncated final line
+    (the rank died mid-write) is dropped — in both cases the merge
+    keeps the SURVIVING tracks instead of raising, because a partial
+    timeline of a wedged chaos run is precisely when you need one.
+    A malformed line anywhere except the tail still raises (that is
+    corruption, not a crash artifact)."""
     out = []
-    with open(path) as f:
-        for line in f:
-            line = line.strip()
-            if line:
-                out.append(json.loads(line))
+    try:
+        f = open(path)
+    except FileNotFoundError:
+        logger.warning("timeline: per-rank dump %s missing (rank "
+                       "crashed before dump?); keeping other tracks",
+                       path)
+        return out
+    with f:
+        lines = f.read().splitlines()
+    for i, line in enumerate(lines):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            out.append(json.loads(line))
+        except json.JSONDecodeError:
+            if i == len(lines) - 1:
+                logger.warning(
+                    "timeline: %s truncated at line %d (rank crashed "
+                    "mid-dump?); dropping the partial record", path,
+                    i + 1)
+                break
+            raise
+    if not out:
+        logger.warning("timeline: per-rank dump %s is empty; keeping "
+                       "other tracks", path)
     return out
 
 

@@ -54,6 +54,13 @@ class Ev(IntEnum):
     FAILURE = 10        # a = failed rank, b = 1 local detection /
     #                     0 learned; c = last-seen heartbeat age (usec,
     #                     clamped to int32) on local detections
+    ARQ_GIVEUP = 11     # ARQ exhausted its retries at a live peer and
+    #                     the peer is being declared failed: a = peer,
+    #                     b = retransmit count of the abandoned frame
+    JOIN = 12           # membership probe: a = peer, b = 1 sent /
+    #                     0 received, c = incarnation, d = epoch
+    ADMIT = 13          # membership admission executed: a = joiner,
+    #                     b = new epoch, c = joiner incarnation
 
 
 @dataclass

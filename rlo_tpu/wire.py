@@ -24,6 +24,17 @@ retransmits idempotent even through the store-and-forward broadcast
 path; cumulative acknowledgements travel back as ``Tag.ACK`` frames
 (and piggybacked on heartbeats) carrying the highest-contiguous
 received seq in the ``vote`` field.
+
+``epoch`` is the membership subsystem's link-level view stamp
+(docs/DESIGN.md §8): the sending engine stamps its current membership
+epoch into every frame at transmission time (retransmits are restamped
+with the CURRENT epoch — the seq, not the epoch, is the frame's
+identity). Receivers quarantine frames from senders they consider
+failed, and frames whose epoch is below the per-sender floor set when
+that sender was last declared failed or readmitted — this is what
+makes stale frames from a "dead" peer distinguishable from its
+post-rejoin traffic. ``Tag.JOIN`` / ``Tag.JOIN_WELCOME`` are exempt
+(they are the frames that cross membership boundaries to heal them).
 """
 
 from __future__ import annotations
@@ -53,6 +64,14 @@ class Tag(enum.IntEnum):
     ACK = 13         # cumulative link ACK; vote = highest contiguous seq
     ABORT = 14       # rootless op-abort notification (deadline expiry);
                      # pid = aborted pid, payload = round generation
+    JOIN = 15        # membership probe/petition; payload = 4 x le32
+                     # (incarnation, epoch, min-alive-rank, petition)
+                     # of the sender's view — petition=1 marks a
+                     # joiner's plea vs a survivor's heal probe
+    JOIN_WELCOME = 16  # admission notice from the admitting proposer:
+                     # payload = (epoch, incarnation echo, member list);
+                     # followed by a point-to-point replay of the
+                     # recent-broadcast log
 
 
 #: Tags that are store-and-forward broadcast over the skip-ring overlay.
@@ -62,14 +81,26 @@ BCAST_TAGS = frozenset({Tag.BCAST, Tag.IAR_PROPOSAL, Tag.IAR_DECISION,
 #: Tags the ARQ layer neither stamps nor retransmits: heartbeats are
 #: periodic by construction (a lost one is replaced by the next) and
 #: ACKs ack themselves by effect (a lost ACK just triggers one more
-#: retransmit, which the dedup layer absorbs and re-acks).
-ARQ_EXEMPT_TAGS = frozenset({Tag.HEARTBEAT, Tag.ACK})
+#: retransmit, which the dedup layer absorbs and re-acks). JOIN probes
+#: repeat at their own cadence until answered, and a lost WELCOME is
+#: replaced when the joiner's next probe arrives — both must also work
+#: across the membership boundary where ARQ link state is being reset.
+ARQ_EXEMPT_TAGS = frozenset({Tag.HEARTBEAT, Tag.ACK, Tag.JOIN,
+                             Tag.JOIN_WELCOME})
 
-_HEADER = struct.Struct("<iiiiQ")  # origin, pid, vote, seq, data_len
+#: Tags exempt from the stale-epoch quarantine: the membership frames
+#: themselves must cross partition/incarnation boundaries to heal them.
+EPOCH_EXEMPT_TAGS = frozenset({Tag.JOIN, Tag.JOIN_WELCOME})
+
+# origin, pid, vote, seq, epoch, data_len
+_HEADER = struct.Struct("<iiiiiQ")
 HEADER_SIZE = _HEADER.size
 #: byte offset of the seq field — the ARQ send path re-stamps encoded
 #: frames in place (one encode per broadcast, one patch per edge)
 SEQ_OFFSET = 12
+#: byte offset of the epoch field — stamped by the engine send gate at
+#: every transmission (including retransmits) with the CURRENT epoch
+EPOCH_OFFSET = 16
 
 #: Default engine cap, matching RLO_MSG_SIZE_MAX (rootless_ops.h:49). Frames
 #: themselves are variable-size; this only bounds a single message payload.
@@ -81,27 +112,30 @@ class Frame:
     """One wire message. ``origin`` is the broadcast initiator (not the
     immediate sender — that is transport metadata, like MPI_SOURCE).
     ``seq`` is per-(immediate sender, receiver) link state owned by the
-    ARQ layer; it is deliberately NOT an application field."""
+    ARQ layer and ``epoch`` is the sender's membership epoch at
+    transmission time (stamped by the engine send gate); neither is an
+    application field."""
     origin: int
     pid: int = -1
     vote: int = -1
     payload: bytes = b""
     seq: int = -1
+    epoch: int = 0
 
     def encode(self) -> bytes:
         return _HEADER.pack(self.origin, self.pid, self.vote, self.seq,
-                            len(self.payload)) + self.payload
+                            self.epoch, len(self.payload)) + self.payload
 
     @classmethod
     def decode(cls, raw: bytes) -> "Frame":
         if len(raw) < HEADER_SIZE:
             raise ValueError(f"frame too short: {len(raw)} < {HEADER_SIZE}")
-        origin, pid, vote, seq, n = _HEADER.unpack_from(raw)
+        origin, pid, vote, seq, epoch, n = _HEADER.unpack_from(raw)
         payload = bytes(raw[HEADER_SIZE:HEADER_SIZE + n])
         if len(payload) != n:
             raise ValueError(f"truncated frame: want {n} payload bytes, "
                              f"have {len(raw) - HEADER_SIZE}")
-        return cls(origin, pid, vote, payload, seq)
+        return cls(origin, pid, vote, payload, seq, epoch)
 
 
 def restamp_seq(raw: bytes, seq: int) -> bytes:
@@ -109,4 +143,25 @@ def restamp_seq(raw: bytes, seq: int) -> bytes:
     path's per-edge stamp (avoids re-encoding the payload per edge)."""
     buf = bytearray(raw)
     struct.pack_into("<i", buf, SEQ_OFFSET, seq)
+    return bytes(buf)
+
+
+def restamp_epoch(raw: bytes, epoch: int) -> bytes:
+    """Return ``raw`` with its header epoch field replaced — the send
+    gate's per-transmission membership stamp (re-flooded and
+    retransmitted frames carry the CURRENT epoch, so a live sender's
+    old traffic is never mistaken for a dead incarnation's). Returns
+    ``raw`` itself when the stamp already matches (the common case —
+    all link epochs 0 — never copies; mirror of the C send gate)."""
+    if struct.unpack_from("<i", raw, EPOCH_OFFSET)[0] == epoch:
+        return raw
+    buf = bytearray(raw)
+    struct.pack_into("<i", buf, EPOCH_OFFSET, epoch)
+    return bytes(buf)
+
+
+def restamp_link(raw: bytes, seq: int, epoch: int) -> bytes:
+    """One-copy combined seq + epoch stamp for the ARQ send path."""
+    buf = bytearray(raw)
+    struct.pack_into("<ii", buf, SEQ_OFFSET, seq, epoch)
     return bytes(buf)

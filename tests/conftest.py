@@ -19,3 +19,23 @@ os.environ["PALLAS_AXON_POOL_IPS"] = ""  # disable axon TPU registration path
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running sweeps excluded from tier-1 "
+        "(run explicitly with `pytest -m slow`)")
+
+
+def pytest_collection_modifyitems(config, items):
+    """Deselect `slow` tests unless a -m expression names them, so the
+    tier-1 run (`pytest tests/`) never pays for the 500-run sweeps."""
+    import pytest
+
+    if "slow" in (config.getoption("-m") or ""):
+        return
+    skip = pytest.mark.skip(reason="slow sweep: opt in with -m slow")
+    for item in items:
+        if "slow" in item.keywords:
+            item.add_marker(skip)

@@ -185,3 +185,34 @@ def test_smoke_entry_point(tmp_path):
     res = _smoke(str(out))
     assert res["ok"] and res["flow_edges"] >= 1
     validate_chrome_trace(json.loads(out.read_text()))
+
+
+def test_merge_tolerates_crashed_rank_dumps(tmp_path, caplog):
+    """A rank that crashed before (or during) its dump must not sink
+    the whole merge: missing, empty, and tail-truncated per-rank files
+    are warned about and skipped, the surviving tracks are kept."""
+    ok = tmp_path / "r0.jsonl"
+    ok.write_text(json.dumps({"ts_usec": 10, "rank": 0,
+                              "kind": "HEARTBEAT", "a": 1, "b": 0,
+                              "c": 0, "d": 0}) + "\n")
+    truncated = tmp_path / "r1.jsonl"
+    truncated.write_text(
+        json.dumps({"ts_usec": 11, "rank": 1, "kind": "HEARTBEAT",
+                    "a": 0, "b": 0, "c": 0, "d": 0}) +
+        '\n{"ts_usec": 12, "ra')  # died mid-write
+    empty = tmp_path / "r2.jsonl"
+    empty.write_text("")
+    missing = tmp_path / "r3.jsonl"  # never created
+    trace = merge_timeline([str(ok), str(truncated), str(empty),
+                            str(missing)])
+    validate_chrome_trace(trace)
+    assert trace["otherData"]["ranks"] == [0, 1]
+    assert trace["otherData"]["events"] == 2
+
+    # corruption in the MIDDLE of a file is not a crash artifact
+    corrupt = tmp_path / "bad.jsonl"
+    corrupt.write_text('{"broken\n' + json.dumps(
+        {"ts_usec": 1, "rank": 0, "kind": "HEARTBEAT",
+         "a": 0, "b": 0, "c": 0, "d": 0}) + "\n")
+    with pytest.raises(json.JSONDecodeError):
+        merge_timeline([str(corrupt)])
