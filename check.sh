@@ -15,11 +15,33 @@
 set -e
 cd "$(dirname "$0")"
 
+echo "== rlo-lint (static cross-engine conformance) =="
+# wire/metrics/ctypes/dispatch/determinism parity between the Python
+# and C engines, checked without importing or compiling anything —
+# docs/DESIGN.md §9. Also runs inside tier-1 (tests/test_lint.py).
+python -m rlo_tpu.tools.rlo_lint
+
 echo "== pytest =="
 python -m pytest tests/ -q
 
 echo "== native selftest (ASan/UBSan) =="
 (cd rlo_tpu/native && make -s selftest && ./rlo_selftest)
+
+echo "== native selftest (TSan) =="
+# ThreadSanitizer variant of the full selftest (loopback chaos paths
+# included). The engine model is single-threaded cooperative polling,
+# so tsan.supp is expected to stay empty — a report here is a real
+# race, most likely in a transport that grew threads.
+(cd rlo_tpu/native && make -s tsan && \
+    TSAN_OPTIONS="suppressions=$PWD/tsan.supp" ./rlo_selftest_tsan)
+
+echo "== TCP transport under TSan (socket mesh) =="
+(cd rlo_tpu/native && TSAN_OPTIONS="suppressions=$PWD/tsan.supp" \
+    ./tcprun -n 8 -t 240 ./rlo_demo_tsan -m 4 -b 65536)
+
+echo "== multi-process demo + TCP under ASan/UBSan =="
+(cd rlo_tpu/native && make -s demo_asan && ./rlo_demo_asan -n 8 -m 8 && \
+    ./tcprun -n 8 -t 240 ./rlo_demo_asan -m 4 -b 65536)
 
 echo "== multi-process demo =="
 (cd rlo_tpu/native && make -s demo && ./rlo_demo -n 8 -m 8)

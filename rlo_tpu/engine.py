@@ -1010,7 +1010,7 @@ class ProgressEngine:
             if tag in EPOCH_EXEMPT_TAGS:
                 if tag == Tag.JOIN:
                     self._on_join(msg)
-                else:
+                elif tag == Tag.JOIN_WELCOME:
                     self._on_welcome(msg)
                 continue
             # stale-epoch / failed-sender quarantine, BEFORE ACK

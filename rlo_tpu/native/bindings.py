@@ -31,12 +31,18 @@ IN_PROGRESS = 1
 FAILED = 2
 INVALID = 3
 
+# spanning-tree shapes
+FANOUT_SKIP_RING = 0  # rlo-lint: paired-with rlo_core.h:RLO_FANOUT_SKIP_RING
+FANOUT_FLAT = 1  # rlo-lint: paired-with rlo_core.h:RLO_FANOUT_FLAT
+
 from rlo_tpu.utils.metrics import ENGINE_COUNTER_KEYS
 from rlo_tpu.wire import MSG_SIZE_MAX  # single shared engine-wide cap
 
 _JUDGE_CB = C.CFUNCTYPE(C.c_int, C.POINTER(C.c_uint8), C.c_int64,
                         C.c_void_p)
 _ACTION_CB = C.CFUNCTYPE(None, C.POINTER(C.c_uint8), C.c_int64, C.c_void_p)
+# rlo_rank_fn (rlo_core.h): per-rank body run by the shm launcher
+_RANK_FN = C.CFUNCTYPE(C.c_int, C.c_void_p, C.c_int, C.c_void_p)
 
 
 class _EngineState(C.Structure):
@@ -137,6 +143,8 @@ def load() -> C.CDLL:
     sig("rlo_frame_decode", C.c_int64,
         [u8p, C.c_int64, C.POINTER(C.c_int32), C.POINTER(C.c_int32),
          C.POINTER(C.c_int32), C.POINTER(C.c_int32), C.POINTER(u8p)])
+    sig("rlo_frame_epoch", C.c_int32, [u8p])
+    sig("rlo_frame_set_epoch", None, [u8p, C.c_int32])
     sig("rlo_world_new", p, [C.c_int, C.c_int, C.c_uint64])
     sig("rlo_world_free", None, [p])
     sig("rlo_world_size", C.c_int, [p])
@@ -169,8 +177,11 @@ def load() -> C.CDLL:
     sig("rlo_engine_epoch_quarantined", C.c_int64, [p])
     sig("rlo_engine_rejoins", C.c_int64, [p])
     sig("rlo_engine_awaiting_welcome", C.c_int, [p])
-    sig("rlo_engine_state_get", C.c_int, [p, p])
-    sig("rlo_engine_state_set", C.c_int, [p, p])
+    sig("rlo_engine_state_get", C.c_int, [p, C.POINTER(_EngineState)])
+    sig("rlo_engine_state_set", C.c_int, [p, C.POINTER(_EngineState)])
+    sig("rlo_engine_set_fanout", C.c_int, [p, C.c_int])
+    sig("rlo_shm_launch", C.c_int, [C.c_int, C.c_int64, _RANK_FN, p])
+    sig("rlo_shm_barrier", None, [p])
     sig("rlo_mpi_available", C.c_int, [])
     sig("rlo_mpi_world_new", p, [])
     sig("rlo_tcp_available", C.c_int, [])
@@ -200,6 +211,7 @@ def load() -> C.CDLL:
     sig("rlo_bench_allreduce", C.c_double, [C.c_int, C.c_int64, C.c_int])
     sig("rlo_bench_allreduce_ring", C.c_double,
         [C.c_int, C.c_int64, C.c_int])
+    sig("rlo_bench_bcast_usec", C.c_double, [C.c_int, C.c_int64, C.c_int])
     sig("rlo_coll_new", p, [p, C.c_int, C.c_int])
     sig("rlo_coll_new_sub", p,
         [p, C.c_int, C.c_int, C.POINTER(C.c_int), C.c_int])
@@ -732,6 +744,15 @@ class NativeEngine:
             },
         }
 
+    def set_fanout(self, mode: int) -> None:
+        """Select the bcast/IAR spanning-tree shape (FANOUT_SKIP_RING /
+        FANOUT_FLAT, rlo_core.h RLO_FANOUT_*) — only while the engine
+        is idle between rounds; mirror of ProgressEngine(fanout=)."""
+        rc = self._lib.rlo_engine_set_fanout(self._e, mode)
+        if rc != 0:
+            raise ValueError(f"set_fanout({mode}) failed ({rc}): bad "
+                             f"mode or engine mid-round")
+
     def set_incarnation(self, incarnation: int) -> None:
         """Partition this engine's life at its rank: a RESTARTED
         process passes a fresh incarnation BEFORE any traffic;
@@ -893,6 +914,26 @@ def frame_roundtrip(origin: int, pid: int, vote: int, payload: bytes,
     return o.value, p.value, v.value, data, bytes(raw), s.value
 
 
+def frame_epoch(raw: bytes) -> int:
+    """Read the link-epoch field of an encoded frame (C accessor —
+    the parity twin of wire.Frame.decode(raw).epoch)."""
+    from rlo_tpu.wire import HEADER_SIZE
+    if len(raw) < HEADER_SIZE:
+        raise ValueError(f"frame too short: {len(raw)} < {HEADER_SIZE}")
+    return load().rlo_frame_epoch(_buf(raw))
+
+
+def frame_set_epoch(raw: bytes, epoch: int) -> bytes:
+    """Return ``raw`` with its link-epoch field restamped through the C
+    send-gate accessor (parity twin of wire.restamp_epoch)."""
+    from rlo_tpu.wire import HEADER_SIZE
+    if len(raw) < HEADER_SIZE:
+        raise ValueError(f"frame too short: {len(raw)} < {HEADER_SIZE}")
+    buf = _buf(raw)
+    load().rlo_frame_set_epoch(buf, epoch)
+    return bytes(buf)
+
+
 def run_judged_proposal(world_size: int, payload: bytes, proposer: int,
                         judge_for=None, action_cb=None, pid: int = None
                         ) -> int:
@@ -946,6 +987,15 @@ def bench_allreduce_ring(world_size: int, count: int,
     rc = load().rlo_bench_allreduce_ring(world_size, count, reps)
     if rc < 0:
         raise RuntimeError(f"native ring bench failed ({int(rc)})")
+    return float(rc)
+
+
+def bench_bcast_usec(world_size: int, nbytes: int, reps: int = 5) -> float:
+    """Median usec per wholly-native rootless broadcast of `nbytes`
+    (initiation to full delivery; rlo_demo's nbcast floor line)."""
+    rc = load().rlo_bench_bcast_usec(world_size, nbytes, reps)
+    if rc < 0:
+        raise RuntimeError(f"native bcast bench failed ({int(rc)})")
     return float(rc)
 
 

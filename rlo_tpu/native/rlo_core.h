@@ -32,22 +32,30 @@ extern "C" {
 #endif
 
 /* ---- message tags (reference RLO_COMM_TAGS, rootless_ops.h:50-61) ---- */
+/* Tags without their own dispatch case go straight to pickup through
+ * the progress switch's default label; rlo-lint R4 requires each such
+ * tag to carry the `rlo-lint: default-route` annotation below (the
+ * Python twin's annotations live on wire.py's Tag members). */
 enum rlo_tag {
     RLO_TAG_BCAST = 0,
-    RLO_TAG_JOB_DONE = 1,
+    RLO_TAG_JOB_DONE = 1,     /* rlo-lint: default-route */
     RLO_TAG_IAR_PROPOSAL = 2,
     RLO_TAG_IAR_VOTE = 3,
     RLO_TAG_IAR_DECISION = 4,
-    RLO_TAG_BC_TEARDOWN = 5,
-    RLO_TAG_IAR_TEARDOWN = 6,
-    RLO_TAG_P2P = 7,
-    RLO_TAG_SYS = 8,
-    RLO_TAG_DATA = 9,
-    RLO_TAG_BARRIER = 10,
+    RLO_TAG_BC_TEARDOWN = 5,  /* rlo-lint: default-route */
+    RLO_TAG_IAR_TEARDOWN = 6, /* rlo-lint: default-route */
+    RLO_TAG_P2P = 7,          /* rlo-lint: default-route */
+    RLO_TAG_SYS = 8,          /* rlo-lint: default-route */
+    RLO_TAG_DATA = 9,         /* rlo-lint: default-route */
+    RLO_TAG_BARRIER = 10,     /* rlo-lint: default-route */
     RLO_TAG_HEARTBEAT = 11, /* point-to-point ring liveness probe */
     RLO_TAG_FAILURE = 12,   /* rootless failure notification */
     RLO_TAG_ACK = 13,       /* cumulative link ACK (ARQ); vote = seq */
-    RLO_TAG_ABORT = 14,     /* rootless op-abort (deadline expiry) */
+    RLO_TAG_ABORT = 14,     /* rootless op-abort (deadline expiry);
+                             * the C engine has no op deadlines, so a
+                             * received ABORT delivers via pickup
+                             * (documented divergence, rlo_engine.c).
+                             * rlo-lint: default-route */
     RLO_TAG_JOIN = 15,      /* membership probe/petition: payload =
                              * (incarnation, epoch, min-alive, petition),
                              * 4 x le32 (docs/DESIGN.md S8) */
@@ -527,6 +535,20 @@ int rlo_coll_barrier_start(rlo_coll *c);
 int rlo_coll_poll(rlo_coll *c);
 /* spin poll to completion — one-process-per-rank transports only */
 int rlo_coll_wait(rlo_coll *c, long max_spins);
+
+/* ------------------------------------------------------------------ */
+/* Wholly-native micro-benchmarks (rlo_bench.c / rlo_coll.c): median   */
+/* usec per op on an in-process loopback world, no Python in the       */
+/* measured loop. ctypes entry points for benchmarks/suite.py; also    */
+/* linked by rlo_demo's nbcast floor analysis. Negative = rlo_err.     */
+/* ------------------------------------------------------------------ */
+/* bcast-gather fp32 allreduce over the engine substrate */
+double rlo_bench_allreduce(int world_size, int64_t count, int reps);
+/* ring fp32 allreduce (rlo_coll.c state machines round-robined in C) —
+ * the bandwidth-optimal comparison line against bcast-gather */
+double rlo_bench_allreduce_ring(int world_size, int64_t count, int reps);
+/* one rootless broadcast of nbytes, initiation to full delivery */
+double rlo_bench_bcast_usec(int world_size, int64_t nbytes, int reps);
 
 /* ------------------------------------------------------------------ */
 /* Timing utils (reference RLO_get_time_usec, rootless_ops.c:128-132).  */

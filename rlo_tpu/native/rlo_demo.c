@@ -32,9 +32,6 @@
  */
 #include "rlo_core.h"
 
-/* rlo_bench.c loopback micro-bench (the nbcast floor analysis) */
-double rlo_bench_bcast_usec(int world_size, int64_t nbytes, int reps);
-
 #include <sched.h>
 #include <stdio.h>
 #include <stdlib.h>

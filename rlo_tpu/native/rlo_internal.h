@@ -155,9 +155,4 @@ void rlo_engine_progress_once(rlo_engine *e);
  * one process. */
 int rlo_drain_local(rlo_world *w, int max_spins);
 
-/* rlo_bench.c: in-process loopback micro-benchmarks (ctypes entry
- * points; rlo_demo's nbcast floor analysis also links them) */
-double rlo_bench_allreduce(int world_size, int64_t count, int reps);
-double rlo_bench_bcast_usec(int world_size, int64_t nbytes, int reps);
-
 #endif /* RLO_INTERNAL_H */

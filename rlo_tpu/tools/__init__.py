@@ -1,0 +1,8 @@
+"""Developer tooling for the rlo_tpu rebuild.
+
+``rlo_tpu.tools.rlo_lint`` is the cross-engine protocol-conformance
+analyzer (docs/DESIGN.md §9): it statically parses the C core and the
+Python engine — no imports, no compilation — and fails when the two
+implementations drift on wire layout, metrics schema, ctypes
+contracts, tag dispatch, or determinism hygiene.
+"""

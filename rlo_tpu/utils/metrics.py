@@ -32,7 +32,7 @@ from __future__ import annotations
 from typing import Dict, List, Optional
 
 #: number of histogram buckets — mirror of RLO_HIST_BUCKETS (rlo_core.h)
-HIST_BUCKETS = 28
+HIST_BUCKETS = 28  # rlo-lint: paired-with rlo_core.h:RLO_HIST_BUCKETS
 
 #: The engine-counter schema, in snapshot order — the single source of
 #: truth for the ``metrics()["counters"]`` keys both engines emit
@@ -43,6 +43,7 @@ HIST_BUCKETS = 28
 #: failed-sender quarantine, and ``rejoins`` counts membership
 #: admissions executed (or adopted, on the joiner side) —
 #: docs/DESIGN.md §8.
+# rlo-lint: paired-with rlo_core.h:rlo_stats
 ENGINE_COUNTER_KEYS = (
     "sent_bcast", "recved_bcast", "total_pickup", "ops_failed",
     "arq_retransmits", "arq_dup_drops", "arq_gave_up", "arq_unacked",

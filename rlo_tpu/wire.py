@@ -47,18 +47,23 @@ from dataclasses import dataclass, field
 class Tag(enum.IntEnum):
     """Transport-level message tags (reference RLO_COMM_TAGS,
     rootless_ops.h:50-61). Values 0-8 match the reference enum order;
-    DATA/BARRIER are net-new for the data-carrying collectives."""
+    DATA/BARRIER are net-new for the data-carrying collectives.
+
+    Values are paired with the C ``enum rlo_tag`` (rlo_core.h) and
+    checked by rlo-lint R1. Members without their own branch in the
+    engine dispatch are delivered through the ``_on_other`` catch-all
+    and carry the ``rlo-lint: default-route`` annotation (R4)."""
     BCAST = 0
-    JOB_DONE = 1
+    JOB_DONE = 1      # rlo-lint: default-route
     IAR_PROPOSAL = 2
     IAR_VOTE = 3
     IAR_DECISION = 4
-    BC_TEARDOWN = 5
-    IAR_TEARDOWN = 6
-    P2P = 7
-    SYS = 8
-    DATA = 9
-    BARRIER = 10
+    BC_TEARDOWN = 5   # rlo-lint: default-route
+    IAR_TEARDOWN = 6  # rlo-lint: default-route
+    P2P = 7           # rlo-lint: default-route
+    SYS = 8           # rlo-lint: default-route
+    DATA = 9          # rlo-lint: default-route
+    BARRIER = 10      # rlo-lint: default-route
     HEARTBEAT = 11   # point-to-point ring liveness probe (net-new)
     FAILURE = 12     # rootless failure notification; pid = failed rank
     ACK = 13         # cumulative link ACK; vote = highest contiguous seq
@@ -93,18 +98,19 @@ ARQ_EXEMPT_TAGS = frozenset({Tag.HEARTBEAT, Tag.ACK, Tag.JOIN,
 EPOCH_EXEMPT_TAGS = frozenset({Tag.JOIN, Tag.JOIN_WELCOME})
 
 # origin, pid, vote, seq, epoch, data_len
+# rlo-lint: paired-with rlo_core.h:RLO_HEADER_SIZE
 _HEADER = struct.Struct("<iiiiiQ")
 HEADER_SIZE = _HEADER.size
 #: byte offset of the seq field — the ARQ send path re-stamps encoded
 #: frames in place (one encode per broadcast, one patch per edge)
-SEQ_OFFSET = 12
+SEQ_OFFSET = 12  # rlo-lint: paired-with rlo_core.h:RLO_SEQ_OFFSET
 #: byte offset of the epoch field — stamped by the engine send gate at
 #: every transmission (including retransmits) with the CURRENT epoch
-EPOCH_OFFSET = 16
+EPOCH_OFFSET = 16  # rlo-lint: paired-with rlo_core.h:RLO_EPOCH_OFFSET
 
 #: Default engine cap, matching RLO_MSG_SIZE_MAX (rootless_ops.h:49). Frames
 #: themselves are variable-size; this only bounds a single message payload.
-MSG_SIZE_MAX = 32768
+MSG_SIZE_MAX = 32768  # rlo-lint: paired-with rlo_core.h:RLO_MSG_SIZE_MAX
 
 
 @dataclass

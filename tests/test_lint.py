@@ -1,0 +1,196 @@
+"""rlo-lint self-verification (docs/DESIGN.md §9).
+
+Two halves:
+
+  1. The clean-tree contract: ``run_lint`` on this checkout reports
+     zero findings. This is the tier-1 wrapper the CI step leans on —
+     any parity drift between the Python and C engines (wire layout,
+     metrics schema, ctypes contracts, dispatch coverage, determinism
+     hygiene) fails the ordinary test suite, not just check.sh.
+
+  2. Mutation fixtures: for each rule family R1–R5 a temp copy of the
+     tree is seeded with exactly one violation and the lint must trip
+     with the right rule ID at the right file:line — proving every
+     rule actually fires (a linter that never fires is
+     indistinguishable from no linter).
+"""
+
+from __future__ import annotations
+
+import shutil
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from rlo_tpu.tools.rlo_lint import run_lint
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+
+_IGNORE = shutil.ignore_patterns(
+    "__pycache__", ".pytest_cache", "*.so", "*.o", "*.pyc",
+    "rlo_selftest*", "rlo_demo", "rlo_demo_mpi", "rlo_demo_tsan",
+    "rlo_demo_asan", "femtompirun")
+
+
+@pytest.fixture()
+def tree(tmp_path):
+    """A lintable copy of the source tree (sources only, no build
+    artifacts) that fixtures may mutate freely."""
+    shutil.copytree(REPO_ROOT / "rlo_tpu", tmp_path / "rlo_tpu",
+                    ignore=_IGNORE)
+    return tmp_path
+
+
+def mutate(root: Path, rel: str, old: str, new: str) -> int:
+    """Replace ``old`` (must occur exactly once) with ``new``; returns
+    the 1-indexed line of the edit."""
+    path = root / rel
+    text = path.read_text()
+    assert text.count(old) == 1, \
+        f"fixture drift: {old!r} occurs {text.count(old)}x in {rel}"
+    line = text[:text.index(old)].count("\n") + 1
+    path.write_text(text.replace(old, new))
+    return line
+
+
+def findings_for(root: Path, rule: str):
+    return [f for f in run_lint(root) if f.rule == rule]
+
+
+# ---------------------------------------------------------------------------
+# 1. clean tree
+# ---------------------------------------------------------------------------
+
+def test_head_is_clean():
+    """Zero findings on this checkout — the tier-1 drift gate."""
+    findings = run_lint(REPO_ROOT)
+    assert findings == [], "\n".join(str(f) for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# 2. one seeded violation per rule family
+# ---------------------------------------------------------------------------
+
+def test_r1_fires_on_wire_offset_drift(tree):
+    line = mutate(tree, "rlo_tpu/wire.py",
+                  "SEQ_OFFSET = 12", "SEQ_OFFSET = 13")
+    hits = findings_for(tree, "R1")
+    assert any(f.file == "rlo_tpu/wire.py" and f.line == line and
+               "SEQ_OFFSET" in f.msg for f in hits), hits
+
+
+def test_r1_fires_on_tag_value_drift(tree):
+    line = mutate(tree, "rlo_tpu/wire.py",
+                  "HEARTBEAT = 11", "HEARTBEAT = 42")
+    hits = findings_for(tree, "R1")
+    assert any(f.file == "rlo_tpu/wire.py" and f.line == line and
+               "HEARTBEAT" in f.msg for f in hits), hits
+
+
+def test_r1_fires_on_deleted_error_constant(tree):
+    """A constant pair with one side missing is a finding, not a
+    silently skipped check."""
+    mutate(tree, "rlo_tpu/native/bindings.py", "ERR_STALL = -15\n", "")
+    hits = findings_for(tree, "R1")
+    assert any(f.file == "rlo_tpu/native/bindings.py" and
+               "ERR_STALL" in f.msg for f in hits), hits
+
+
+def test_r1_fires_on_fanout_drift(tree):
+    line = mutate(tree, "rlo_tpu/native/bindings.py",
+                  "FANOUT_FLAT = 1", "FANOUT_FLAT = 2")
+    hits = findings_for(tree, "R1")
+    assert any(f.line == line and "FANOUT_FLAT" in f.msg
+               for f in hits), hits
+
+
+def test_r2_fires_on_counter_key_drift(tree):
+    mutate(tree, "rlo_tpu/utils/metrics.py",
+           '"epoch", "epoch_quarantined", "rejoins",',
+           '"epoch", "epoch_quarantined",')
+    hits = findings_for(tree, "R2")
+    assert any("rejoins" in f.msg for f in hits), hits
+    assert any(f.file == "rlo_tpu/utils/metrics.py" for f in hits), hits
+
+
+def test_r3_fires_on_missing_binding(tree):
+    mutate(tree, "rlo_tpu/native/bindings.py",
+           '    sig("rlo_engine_set_fanout", C.c_int, [p, C.c_int])\n',
+           "")
+    hits = findings_for(tree, "R3")
+    assert any(f.file == "rlo_tpu/native/bindings.py" and
+               "rlo_engine_set_fanout" in f.msg and
+               "no argtypes/restype" in f.msg for f in hits), hits
+
+
+def test_r3_fires_on_64bit_truncation(tree):
+    """A uint64_t-returning function declared c_int is exactly the
+    truncation hazard R3 exists for."""
+    line = mutate(tree, "rlo_tpu/native/bindings.py",
+                  'sig("rlo_now_usec", C.c_uint64, [])',
+                  'sig("rlo_now_usec", C.c_int, [])')
+    hits = findings_for(tree, "R3")
+    assert any(f.line == line and "rlo_now_usec" in f.msg
+               for f in hits), hits
+
+
+def test_r4_fires_on_dispatch_hole(tree):
+    # ABORT loses its handler (BARRIER is default-routed, so the
+    # rewritten branch itself stays legal)
+    mutate(tree, "rlo_tpu/engine.py",
+           "elif tag == Tag.ABORT:", "elif tag == Tag.BARRIER:")
+    hits = findings_for(tree, "R4")
+    assert any(f.file == "rlo_tpu/wire.py" and "Tag.ABORT" in f.msg
+               for f in hits), hits
+
+
+def test_r4_fires_on_deleted_membership_handler(tree):
+    """A membership guard (`tag in EPOCH_EXEMPT_TAGS`) must not mask a
+    deleted handler inside it: only the explicit `tag == Tag.X`
+    comparison counts as dispatch."""
+    mutate(tree, "rlo_tpu/engine.py",
+           "                elif tag == Tag.JOIN_WELCOME:\n"
+           "                    self._on_welcome(msg)\n",
+           "")
+    hits = findings_for(tree, "R4")
+    assert any(f.file == "rlo_tpu/wire.py" and "Tag.JOIN_WELCOME" in
+               f.msg for f in hits), hits
+
+
+def test_r5_fires_on_wallclock_leak(tree):
+    path = tree / "rlo_tpu/transport/sim.py"
+    path.write_text(path.read_text() +
+                    "\nimport time\n_T0 = time.time()\n")
+    hits = findings_for(tree, "R5")
+    assert any(f.file == "rlo_tpu/transport/sim.py" and
+               "time.time" in f.msg for f in hits), hits
+
+
+def test_r5_anchor_suppresses(tree):
+    path = tree / "rlo_tpu/transport/sim.py"
+    path.write_text(path.read_text() +
+                    "\nimport time\n"
+                    "_T0 = time.time()  # rlo-lint: allow-wallclock\n")
+    assert findings_for(tree, "R5") == []
+
+
+# ---------------------------------------------------------------------------
+# CLI contract
+# ---------------------------------------------------------------------------
+
+def test_cli_exit_codes(tree):
+    mutate(tree, "rlo_tpu/wire.py", "SEQ_OFFSET = 12", "SEQ_OFFSET = 13")
+    proc = subprocess.run(
+        [sys.executable, "-m", "rlo_tpu.tools.rlo_lint",
+         "--root", str(tree)],
+        capture_output=True, text=True, cwd=REPO_ROOT)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "R1" in proc.stdout
+    # rule selection: a family that is still clean exits 0
+    proc = subprocess.run(
+        [sys.executable, "-m", "rlo_tpu.tools.rlo_lint",
+         "--root", str(tree), "--rules", "R5"],
+        capture_output=True, text=True, cwd=REPO_ROOT)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
