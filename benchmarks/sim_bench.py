@@ -1,0 +1,214 @@
+"""Fleet-scale simulator benchmark — the published scaling curve
+(ROADMAP item 4: "push transport/sim.py from 4-8 ranks to thousands").
+
+Runs the deterministic simulator's **protocol-only fast path**
+(SimWorld(protocol_only=True): no payload copies, no schedule digest)
+and records two curves:
+
+  - **bcast fan-out latency vs. n**: virtual time from a rank-0
+    broadcast to the last of n-1 deliveries, plus the schedule length
+    (delivery events) per broadcast. Both are seed-exact, so the gate
+    compares them at ZERO tolerance — an O(log n) overlay schedule
+    regressing toward O(n) moves these numbers and fails mechanically.
+  - **membership convergence vs. n**: virtual time from a crash-stop
+    kill to every survivor holding the converged view (heartbeats,
+    FAILURE flood, overlay re-form, re-flood all included) — again
+    seed-exact.
+
+Wall-clock events/sec per size is recorded with a generous tolerance
+(machine-dependent). The driver uses targeted stepping: only the rank
+that just received a frame is progressed, plus a periodic full sweep
+at half the heartbeat interval so time-driven machinery still fires —
+this is what makes n >= 1024 tractable in Python.
+
+Output schema is shared with benchmarks/engine_bench.py and consumed
+by ``rlo_tpu.tools.perf_gate``. The committed BENCH_sim.json baseline
+— and the check.sh gate step — use the FULL curve (no --quick; the
+fast path makes n=1024 cheap enough to run every time, ~7 s total).
+``--quick`` is the small-n config for unit tests; the full sweep also
+reruns against the committed baseline under tier-1's `-m slow` marker
+(tests/test_perf_gate.py).
+
+Usage:
+    python benchmarks/sim_bench.py --out BENCH_sim.json  # full, n to 1024
+    python benchmarks/sim_bench.py --quick               # test config
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+FANOUT_NS_QUICK = (4, 16, 64, 256)
+FANOUT_NS_FULL = (4, 16, 64, 256, 1024)
+MEMBER_NS_QUICK = (4, 8, 16)
+MEMBER_NS_FULL = (4, 16, 64, 256, 1024)
+
+
+def exact(value):
+    return {"value": value, "direction": "exact", "tolerance": None}
+
+
+def wall(value):
+    """Wall-clock rate, recorded but NOT gated: the small-n legs
+    finish in milliseconds, where scheduler noise swamps any honest
+    tolerance (a 5x factor flaked in practice). The deterministic
+    vtime/event metrics are this suite's gate; sustained wall-clock
+    throughput gating lives in engine_bench's longer runs."""
+    return {"value": value, "direction": "higher", "tolerance": None}
+
+
+def bench_fanout(n: int, n_bcast: int = 3, seed: int = 0):
+    """Virtual-time bcast fan-out latency at n ranks (protocol-only
+    fast path + targeted stepping). Returns (mean vtime per bcast,
+    TOTAL schedule events, broadcasts run, wall seconds)."""
+    from rlo_tpu.engine import EngineManager, ProgressEngine
+    from rlo_tpu.transport.sim import SimWorld
+
+    world = SimWorld(n, seed=seed, protocol_only=True)
+    mgr = EngineManager()
+    engines = [ProgressEngine(world.transport(r), manager=mgr,
+                              clock=world.clock) for r in range(n)]
+    t_wall = time.perf_counter()
+    vtimes = []
+    ev0 = world.events
+    for i in range(n_bcast):
+        got = 0
+        t0 = world.now
+        engines[0].bcast(b"s")
+        t_last = t0
+        while got < n - 1:
+            if not world.step():
+                continue
+            d = world.last_dst
+            if d is None:
+                continue
+            engines[d]._progress_once()
+            while engines[d].pickup_next() is not None:
+                got += 1
+                t_last = world.now
+        vtimes.append(t_last - t0)
+    wall_dt = time.perf_counter() - t_wall
+    events = world.events - ev0
+    for e in engines:
+        e.cleanup()
+    return (sum(vtimes) / len(vtimes), events, n_bcast, wall_dt)
+
+
+def bench_membership(n: int, seed: int = 0, kill_at: float = 2.0,
+                     failure_timeout: float = 3.0,
+                     heartbeat: float = 1.0, limit: float = 120.0):
+    """Virtual time from a crash-stop kill of rank n-1 to every
+    survivor's membership view converging on the survivor set.
+    Targeted stepping + a full progress sweep every heartbeat/2 keeps
+    n >= 1024 tractable. Returns (convergence vtime, schedule events,
+    wall seconds)."""
+    from rlo_tpu.engine import EngineManager, ProgressEngine
+    from rlo_tpu.transport.sim import SimWorld
+
+    world = SimWorld(n, seed=seed, protocol_only=True)
+    mgr = EngineManager()
+    engines = [ProgressEngine(world.transport(r), manager=mgr,
+                              clock=world.clock,
+                              failure_timeout=failure_timeout,
+                              heartbeat_interval=heartbeat)
+               for r in range(n)]
+    victim = n - 1
+    want = list(range(n - 1))
+    t_wall = time.perf_counter()
+    killed_at = None
+    last_full = world.now
+
+    def converged():
+        return all(engines[r]._alive == want for r in range(n - 1))
+
+    t_conv = None
+    while world.now < limit:
+        if killed_at is None and world.now >= kill_at:
+            world.kill_rank(victim)
+            engines[victim].cleanup()
+            killed_at = world.now
+        world.step()
+        d = world.last_dst
+        if d is not None and d != victim:
+            engines[d]._progress_once()
+            while engines[d].pickup_next() is not None:
+                pass
+        if world.now - last_full >= heartbeat / 2.0:
+            last_full = world.now
+            mgr.progress_all()
+            for r in range(n):
+                if r == victim:
+                    continue
+                while engines[r].pickup_next() is not None:
+                    pass
+            if killed_at is not None and converged():
+                t_conv = world.now - killed_at
+                break
+    wall_dt = time.perf_counter() - t_wall
+    events = world.events
+    for e in engines:
+        e.cleanup()
+    if t_conv is None:
+        raise RuntimeError(
+            f"membership did not converge at n={n} within {limit} "
+            f"virtual seconds")
+    return (t_conv, events, wall_dt)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--quick", action="store_true",
+                    help="small-n test config (the committed baseline "
+                         "and check.sh use the FULL curve)")
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args(argv)
+
+    import logging
+    logging.getLogger("rlo_tpu").setLevel(logging.ERROR)
+
+    fanout_ns = FANOUT_NS_QUICK if args.quick else FANOUT_NS_FULL
+    member_ns = MEMBER_NS_QUICK if args.quick else MEMBER_NS_FULL
+    metrics = {}
+    for n in fanout_ns:
+        vt, events, n_bcast, wdt = bench_fanout(n)
+        metrics[f"fanout.n{n}.vtime"] = exact(vt)
+        metrics[f"fanout.n{n}.events_per_bcast"] = exact(
+            events / n_bcast)
+        metrics[f"fanout.n{n}.wall_events_per_sec"] = wall(
+            events / wdt if wdt > 0 else 0.0)
+        print(f"fanout n={n}: {vt:.3f} vsec/bcast, "
+              f"{events / n_bcast:.1f} events/bcast, {wdt:.2f}s wall",
+              file=sys.stderr)
+    for n in member_ns:
+        vt, ev, wdt = bench_membership(n)
+        metrics[f"member.n{n}.converge_vtime"] = exact(vt)
+        metrics[f"member.n{n}.events"] = exact(ev)
+        metrics[f"member.n{n}.wall_events_per_sec"] = wall(
+            ev / wdt if wdt > 0 else 0.0)
+        print(f"member n={n}: converged {vt:.2f} vsec after kill, "
+              f"{ev} events, {wdt:.2f}s wall", file=sys.stderr)
+    doc = {
+        "suite": "sim_bench",
+        "schema": 1,
+        "quick": bool(args.quick),
+        "config": {"fanout_ns": list(fanout_ns),
+                   "member_ns": list(member_ns),
+                   "quick": bool(args.quick)},
+        "metrics": metrics,
+    }
+    text = json.dumps(doc, indent=1, sort_keys=True)
+    if args.out:
+        Path(args.out).write_text(text + "\n")
+    print(text)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

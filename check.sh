@@ -72,6 +72,28 @@ echo "== simulator fuzz sweep (25 seeds x 4 chaos scripts) =="
 # `pytest tests/test_sim.py -m slow`.
 JAX_PLATFORMS=cpu python -m rlo_tpu.transport.sim --seeds 25
 
+echo "== engine bench smoke + perf gate (BENCH_engine.json) =="
+# message-engine throughput at the committed-baseline (--quick) config,
+# gated against the committed numbers: wall metrics at generous factors,
+# seed-deterministic frame counts at zero tolerance — docs/DESIGN.md §10
+fresh_engine=$(mktemp -t rlo_bench_engine.XXXXXX)
+JAX_PLATFORMS=cpu python benchmarks/engine_bench.py --quick \
+    --out "$fresh_engine" > /dev/null
+JAX_PLATFORMS=cpu python -m rlo_tpu.tools.perf_gate \
+    --baseline BENCH_engine.json --fresh "$fresh_engine"
+rm -f "$fresh_engine"
+
+echo "== simulator scaling curve + perf gate (BENCH_sim.json) =="
+# protocol-only fast path: fan-out latency + membership convergence vs n
+# up to 1024 simulated ranks; virtual-time metrics gate at zero tolerance
+# (same seed => identical schedule), so O(log n) regressions fail here
+fresh_sim=$(mktemp -t rlo_bench_sim.XXXXXX)
+JAX_PLATFORMS=cpu python benchmarks/sim_bench.py \
+    --out "$fresh_sim" > /dev/null
+JAX_PLATFORMS=cpu python -m rlo_tpu.tools.perf_gate \
+    --baseline BENCH_sim.json --fresh "$fresh_sim"
+rm -f "$fresh_sim"
+
 echo "== manual-ring validation (8 virtual devices) =="
 PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
     XLA_FLAGS=--xla_force_host_platform_device_count=8 \

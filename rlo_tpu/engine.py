@@ -41,13 +41,18 @@ from typing import Callable, List, Optional, Sequence, Set
 
 from rlo_tpu import topology
 from rlo_tpu.transport.base import SendHandle, Transport
-from rlo_tpu.utils.metrics import ENGINE_COUNTER_KEYS, Histogram, LinkStats
+from rlo_tpu.utils.metrics import (ENGINE_COUNTER_KEYS, ENGINE_PHASE_KEYS,
+                                   Histogram, LinkStats)
 from rlo_tpu.utils.tracing import TRACER, Ev
 from rlo_tpu.wire import (ARQ_EXEMPT_TAGS, BCAST_TAGS, EPOCH_EXEMPT_TAGS,
                           Frame, MSG_SIZE_MAX, Tag, restamp_epoch,
                           restamp_link)
 
 logger = logging.getLogger("rlo_tpu.engine")
+
+#: phase name -> trace index (Ev.PHASE's a field) — fixed by the
+#: ENGINE_PHASE_KEYS snapshot order the C core shares
+_PHASE_IDX = {k: i for i, k in enumerate(ENGINE_PHASE_KEYS)}
 
 #: Prefix marking an IAR proposal payload as an internal membership
 #: admission round (docs/DESIGN.md §8): the engine judges and executes
@@ -168,6 +173,11 @@ class _Msg:
     # (pickup-wait histogram)
     born: Optional[float] = None
     arrived: Optional[float] = None
+    # profiler stamps (None = profiler off at init, docs/DESIGN.md §10):
+    # bcast init time for the first-forward/all-delivered phase timers,
+    # and whether the first fan-out completion was already observed
+    p_born: Optional[float] = None
+    first_fwd: bool = False
 
     def sends_done(self) -> bool:
         return all(h.done() for h in self.send_handles)
@@ -493,6 +503,18 @@ class ProgressEngine:
         self._h_pickup = Histogram()      # frame receipt -> pickup
         self._prop_born: Optional[float] = None
 
+        # in-engine phase profiler (docs/DESIGN.md §10): per-stage log2
+        # duration histograms over the ENGINE_PHASE_KEYS taxonomy —
+        # hot-path stages (encode/decode/send/ARQ scan/dispatch/pickup)
+        # and per-op protocol phases (bcast init->first-fwd->all-
+        # delivered, proposal submit->votes->decision). Independent of
+        # the metrics registry gate: off by default, and the disabled
+        # path costs ONE predictable branch per instrumented site (the
+        # §10 overhead contract — no clock read, no dict access).
+        self._prof_on = False
+        self._ph = {k: Histogram() for k in ENGINE_PHASE_KEYS}
+        self._p_prop_born: Optional[float] = None
+
         if members is not None:
             group = sorted(set(int(r) for r in members))
             if len(group) < 2:
@@ -538,6 +560,24 @@ class ProgressEngine:
             ls = self._mx_link[peer] = LinkStats()
         return ls
 
+    def _phobs(self, key: str, t0: float) -> None:
+        """Record one profiler stage sample: the duration since ``t0``
+        into the phase's log2 histogram, plus an Ev.PHASE trace event
+        when the tracer is live (the Chrome-timeline duration slice).
+        Callers gate on ``_prof_on`` — this is never reached on the
+        disabled path (the §10 one-branch overhead contract). The
+        start/observe pattern is deliberately REPEATED inline at each
+        send/encode site rather than factored into a delegating
+        wrapper: a wrapper would put a Python call on the disabled
+        hot path, which is exactly the overhead the contract rules
+        out (the C side's isend_timed is a static function the
+        compiler inlines; Python has no such luxury)."""
+        dur = (self.clock() - t0) * 1e6
+        self._ph[key].observe(dur)
+        if TRACER.enabled:
+            TRACER.emit(self.rank, Ev.PHASE, _PHASE_IDX[key],
+                        min(int(dur), 2**31 - 1))
+
     def _isend_counted(self, dst: int, tag: int, raw: bytes) -> SendHandle:
         """tx-accounted isend for the out-of-band paths (heartbeats,
         ACKs, retransmits); fresh frames go through _send_raw, which
@@ -546,6 +586,11 @@ class ProgressEngine:
             ls = self._link(dst)
             ls.tx_frames += 1
             ls.tx_bytes += len(raw)
+        if self._prof_on:
+            t0 = self.clock()
+            h = self.transport.isend(dst, int(tag), raw)
+            self._phobs("send", t0)
+            return h
         return self.transport.isend(dst, int(tag), raw)
 
     def _ep(self, dst: int) -> int:
@@ -569,6 +614,11 @@ class ProgressEngine:
             ls.tx_bytes += len(raw)
         if self.arq_rto is None or tag in ARQ_EXEMPT_TAGS:
             raw = restamp_epoch(raw, self._ep(dst))
+            if self._prof_on:
+                t0 = self.clock()
+                h = self.transport.isend(dst, int(tag), raw)
+                self._phobs("send", t0)
+                return h
             return self.transport.isend(dst, int(tag), raw)
         seq = self._tx_seq.get(dst, 0)
         self._tx_seq[dst] = seq + 1
@@ -576,6 +626,11 @@ class ProgressEngine:
         due = self.clock() + self.arq_rto
         self._tx_unacked.setdefault(dst, {})[seq] = _ArqEntry(
             tag=int(tag), raw=raw, due=due, sent=due - self.arq_rto)
+        if self._prof_on:
+            t0 = self.clock()
+            h = self.transport.isend(dst, int(tag), raw)
+            self._phobs("send", t0)
+            return h
         return self.transport.isend(dst, int(tag), raw)
 
     def _send(self, dst: int, tag: int, frame: Frame) -> SendHandle:
@@ -761,15 +816,28 @@ class ProgressEngine:
         int increments and always live."""
         self._mx_on = bool(on)
 
+    def enable_profiler(self, on: bool = True) -> None:
+        """Turn on the in-engine phase profiler (docs/DESIGN.md §10):
+        per-stage duration histograms over the ENGINE_PHASE_KEYS
+        taxonomy, snapshot under ``metrics()["phases"]`` and mirrored
+        by the C engine's rlo_phase_stats. Off (the default), every
+        instrumented site costs exactly one predictable branch — no
+        clock read, no histogram touch (the overhead contract). With
+        the tracer live, every sample also lands in the Chrome
+        timeline as an Ev.PHASE duration slice."""
+        self._prof_on = bool(on)
+
     def metrics(self) -> dict:
         """Snapshot the engine's metrics as a nested dict (JSON-ready):
         ``counters`` (monotone totals incl. the ARQ counters),
         ``queues`` (live depths; ``pickup`` + ``wait_and_pickup`` is
         the pickup backlog), ``links`` (per-peer tx/rx frames+bytes,
         retransmits, dup drops, ack-measured RTT EWMA; all peers
-        present, zeros when metrics are off), and ``op_latency_usec``
+        present, zeros when metrics are off), ``op_latency_usec``
         (bcast init->fan-out-complete, proposal submit->decision,
-        frame receipt->pickup)."""
+        frame receipt->pickup), and ``phases`` (the in-engine phase
+        profiler's per-stage duration histograms over
+        ENGINE_PHASE_KEYS; all zeros while the profiler is off)."""
         links = {}
         for peer in range(self.world_size):
             if peer == self.rank:
@@ -792,6 +860,24 @@ class ProgressEngine:
             "epoch_quarantined": self.epoch_quarantined,
             "rejoins": self.rejoins,
         }
+        # the phase-profiler schema contract with the C engine: literal
+        # keys here, ENGINE_PHASE_KEYS, and the rlo_phase_stats field
+        # order are pinned to each other by rlo-lint R2 (the parity
+        # test asserts snapshot equality at runtime)
+        phs = {
+            "frame_encode": self._ph["frame_encode"].snapshot(),
+            "frame_decode": self._ph["frame_decode"].snapshot(),
+            "send": self._ph["send"].snapshot(),
+            "arq_scan": self._ph["arq_scan"].snapshot(),
+            "tag_dispatch": self._ph["tag_dispatch"].snapshot(),
+            "pickup_drain": self._ph["pickup_drain"].snapshot(),
+            "bcast_first_fwd": self._ph["bcast_first_fwd"].snapshot(),
+            "bcast_all_delivered":
+                self._ph["bcast_all_delivered"].snapshot(),
+            "prop_votes_aggregated":
+                self._ph["prop_votes_aggregated"].snapshot(),
+            "prop_decision": self._ph["prop_decision"].snapshot(),
+        }
         return {
             # ENGINE_COUNTER_KEYS is the schema contract with the C
             # engine (bindings.NativeEngine.metrics builds from the
@@ -809,6 +895,7 @@ class ProgressEngine:
                 "proposal_resolve": self._h_prop.snapshot(),
                 "pickup_wait": self._h_pickup.snapshot(),
             },
+            "phases": {k: phs[k] for k in ENGINE_PHASE_KEYS},
         }
 
     # ------------------------------------------------------------------
@@ -839,7 +926,12 @@ class ProgressEngine:
             vote = self._bcast_seq
             self._bcast_seq += 1
         frame = Frame(origin=self.rank, pid=pid, vote=vote, payload=payload)
-        raw = frame.encode()
+        if self._prof_on:
+            t0 = self.clock()
+            raw = frame.encode()
+            self._phobs("frame_encode", t0)
+        else:
+            raw = frame.encode()
         if Tag(tag) in (Tag.BCAST, Tag.IAR_DECISION, Tag.ABORT,
                         Tag.FAILURE):
             # decisions join the re-flood log: a decision lost in a
@@ -858,8 +950,12 @@ class ProgressEngine:
             deadline = self.op_deadline
         if deadline is not None:
             msg.deadline = self.clock() + deadline
-        if self._mx_on and Tag(tag) == Tag.BCAST:
-            msg.born = self.clock()
+        if Tag(tag) == Tag.BCAST and (self._mx_on or self._prof_on):
+            now = self.clock()
+            if self._mx_on:
+                msg.born = now
+            if self._prof_on:
+                msg.p_born = now
         for dst in self._cur_initiator_targets():  # furthest-first
             msg.send_handles.append(self._send_raw(dst, int(tag), raw))
         self.queue_wait.append(msg)
@@ -911,6 +1007,8 @@ class ProgressEngine:
         self.my_proposal_payload = bytes(proposal)
         if self._mx_on:
             self._prop_born = self.clock()
+        if self._prof_on:
+            self._p_prop_born = self.clock()
         TRACER.emit(self.rank, Ev.PROPOSAL_SUBMIT, pid, 0, p.gen)
         # the proposal frame's vote field carries the round generation
         # (the reference leaves it at the initial vote 1, :888)
@@ -945,16 +1043,21 @@ class ProgressEngine:
     def pickup_next(self) -> Optional[UserMsg]:
         """Next delivered message, or None. Messages still forwarding are
         eligible (wait_and_pickup first, then pickup — reference order)."""
+        t0 = self.clock() if self._prof_on else None
         if self.queue_wait_and_pickup:
             msg = self.queue_wait_and_pickup.pop(0)
             msg.pickup_done = True
             self.queue_wait.append(msg)  # keep tracking its forwards
-            return self._deliver(msg)
-        if self.queue_pickup:
+            out = self._deliver(msg)
+        elif self.queue_pickup:
             msg = self.queue_pickup.popleft()
             msg.pickup_done = True
-            return self._deliver(msg)
-        return None
+            out = self._deliver(msg)
+        else:
+            return None
+        if t0 is not None:
+            self._phobs("pickup_drain", t0)
+        return out
 
     def _deliver(self, msg: _Msg) -> UserMsg:
         self.total_pickup += 1
@@ -987,6 +1090,10 @@ class ProgressEngine:
                     self._h_prop.observe(
                         (self.clock() - self._prop_born) * 1e6)
                     self._prop_born = None
+                if self._p_prop_born is not None:
+                    # submit -> decision fan-out complete (§10 phase)
+                    self._phobs("prop_decision", self._p_prop_born)
+                    self._p_prop_born = None
         if (p.state == ReqState.IN_PROGRESS and not p.decision_pending
                 and p.deadline is not None
                 and self.clock() > p.deadline):
@@ -998,7 +1105,13 @@ class ProgressEngine:
             if item is None:
                 break
             src, tag, raw = item
-            msg = _Msg(frame=Frame.decode(raw), tag=tag, src=src)
+            if self._prof_on:
+                t0 = self.clock()
+                frame = Frame.decode(raw)
+                self._phobs("frame_decode", t0)
+            else:
+                frame = Frame.decode(raw)
+            msg = _Msg(frame=frame, tag=tag, src=src)
             if self._mx_on:
                 if 0 <= src < self.world_size:
                     ls = self._link(src)
@@ -1065,6 +1178,10 @@ class ProgressEngine:
                     if self._mx_on:
                         self._link(src).dup_drops += 1
                     continue
+            # §10 tag_dispatch phase: dispatch + handler for one
+            # protocol frame (quarantine/ACK/dedup exits above are not
+            # counted — they never reach a handler)
+            t_disp = self.clock() if self._prof_on else None
             if tag == Tag.BCAST:
                 self.recved_bcast_cnt += 1
                 if self._bcast_is_dup(msg):
@@ -1091,6 +1208,8 @@ class ProgressEngine:
                 self._on_abort(msg)
             else:
                 self._on_other(msg)
+            if t_disp is not None:
+                self._phobs("tag_dispatch", t_disp)
 
         # (b2) liveness: heartbeat my ring successor, watch my
         # predecessor — suspended while mid-rejoin (a joiner
@@ -1112,7 +1231,12 @@ class ProgressEngine:
         # (b3) reliable delivery: retransmit overdue unacked frames,
         # then flush the cumulative ACKs this turn's receipts owe
         if self.arq_rto is not None:
-            self._arq_tick()
+            if self._prof_on:
+                t0 = self.clock()
+                self._arq_tick()
+                self._phobs("arq_scan", t0)
+            else:
+                self._arq_tick()
             self._flush_acks()
 
         # (c) wait_and_pickup sweep (~_wait_and_pickup_queue_process :995).
@@ -1137,6 +1261,13 @@ class ProgressEngine:
 
         # (d) wait-only sweep (~_wait_only_queue_cleanup :1015)
         for msg in list(self.queue_wait):
+            if msg.p_born is not None and not msg.first_fwd and \
+                    any(h.done() for h in msg.send_handles):
+                # §10 bcast_first_fwd: init -> the FIRST fan-out send
+                # completed (the earliest handoff to a peer); observed
+                # once per locally-initiated broadcast
+                msg.first_fwd = True
+                self._phobs("bcast_first_fwd", msg.p_born)
             if msg.sends_done():
                 msg.fwd_done = True
                 if msg.state == ReqState.IN_PROGRESS:
@@ -1145,6 +1276,8 @@ class ProgressEngine:
                     # locally-initiated bcast: init -> fan-out complete
                     self._h_bcast.observe(
                         (self.clock() - msg.born) * 1e6)
+                if msg.p_born is not None:
+                    self._phobs("bcast_all_delivered", msg.p_born)
                 self.queue_wait.remove(msg)
             elif msg.deadline is not None and self.clock() > msg.deadline:
                 # op deadline: stop tracking — the op FAILED
@@ -1186,7 +1319,12 @@ class ProgressEngine:
         raw = None
         for dst in targets:
             if raw is None:
-                raw = msg.frame.encode()
+                if self._prof_on:
+                    t0 = self.clock()
+                    raw = msg.frame.encode()
+                    self._phobs("frame_encode", t0)
+                else:
+                    raw = msg.frame.encode()
             msg.send_handles.append(self._send_raw(dst, msg.tag, raw))
         # receipt+forward step — emitted even for leaf receipts (zero
         # targets) so the timeline merger always has a receive-side
@@ -1376,6 +1514,10 @@ class ProgressEngine:
             self._resolve_relay(ps)
 
     def _complete_own_proposal(self, p: ProposalState) -> None:
+        if self._p_prop_born is not None:
+            # §10 prop_votes_aggregated: submit -> every awaited vote
+            # merged (or discounted); the decision fan-out starts here
+            self._phobs("prop_votes_aggregated", self._p_prop_born)
         if p.vote:
             # re-judge own proposal: a competing proposal may have
             # changed the app state since submission (:773)
@@ -1418,6 +1560,7 @@ class ProgressEngine:
         p.state = ReqState.FAILED
         self.ops_failed += 1
         self._prop_born = None  # resolve latency tracks successes only
+        self._p_prop_born = None  # phase timers track successes only
         TRACER.emit(self.rank, Ev.DECISION, p.pid, -1, p.gen)
         if p.pid <= MEMBER_PID_BASE:
             # aborted admission round: free the joiner for a retry

@@ -46,7 +46,7 @@ from jax import lax
 from rlo_tpu.models.generate import (decode_step, init_kv_cache,
                                      prefill, _decode_cfg)
 from rlo_tpu.models.transformer import TransformerConfig
-from rlo_tpu.utils.metrics import Registry, SERVING
+from rlo_tpu.utils.metrics import Registry, SERVING, hist_summary
 
 
 @dataclasses.dataclass
@@ -284,6 +284,13 @@ class DecodeServer:
         return [np.asarray(o, np.int32) for o in self._out]
 
     def stats(self) -> dict:
-        """Serving-telemetry snapshot (the registry's nested dict) —
-        what benchmarks/suite.py emits alongside its timing JSON."""
-        return self.metrics.snapshot()
+        """Serving-telemetry snapshot: counters and gauges verbatim,
+        histograms as percentile SUMMARIES (count/mean/min/max +
+        p50/p90/p99 estimated from the log2 buckets,
+        metrics.hist_summary) — dashboards read quantiles, not raw
+        28-bucket dumps. The bucket layout stays available through
+        ``self.metrics.snapshot()`` for anyone who wants it."""
+        snap = self.metrics.snapshot()
+        snap["histograms"] = {k: hist_summary(h)
+                              for k, h in snap["histograms"].items()}
+        return snap

@@ -35,7 +35,7 @@ INVALID = 3
 FANOUT_SKIP_RING = 0  # rlo-lint: paired-with rlo_core.h:RLO_FANOUT_SKIP_RING
 FANOUT_FLAT = 1  # rlo-lint: paired-with rlo_core.h:RLO_FANOUT_FLAT
 
-from rlo_tpu.utils.metrics import ENGINE_COUNTER_KEYS
+from rlo_tpu.utils.metrics import ENGINE_COUNTER_KEYS, ENGINE_PHASE_KEYS
 from rlo_tpu.wire import MSG_SIZE_MAX  # single shared engine-wide cap
 
 _JUDGE_CB = C.CFUNCTYPE(C.c_int, C.POINTER(C.c_uint8), C.c_int64,
@@ -90,6 +90,20 @@ class _LinkStats(C.Structure):
 
     def to_dict(self) -> dict:
         return {f: getattr(self, f) for f, _ in self._fields_}
+
+
+class _PhaseStats(C.Structure):
+    """Mirror of rlo_phase_stats (rlo_core.h) — the in-engine phase
+    profiler's per-stage histograms; field order is the
+    metrics.ENGINE_PHASE_KEYS snapshot order (rlo-lint R2 pins the
+    pair)."""
+    _fields_ = [("frame_encode", _Hist), ("frame_decode", _Hist),
+                ("send", _Hist), ("arq_scan", _Hist),
+                ("tag_dispatch", _Hist), ("pickup_drain", _Hist),
+                ("bcast_first_fwd", _Hist),
+                ("bcast_all_delivered", _Hist),
+                ("prop_votes_aggregated", _Hist),
+                ("prop_decision", _Hist)]
 
 
 class _Stats(C.Structure):
@@ -162,6 +176,8 @@ def load() -> C.CDLL:
     sig("rlo_engine_arq_gave_up", C.c_int64, [p])
     sig("rlo_engine_enable_metrics", C.c_int, [p, C.c_int])
     sig("rlo_engine_stats", C.c_int, [p, C.POINTER(_Stats)])
+    sig("rlo_engine_enable_profiler", C.c_int, [p, C.c_int])
+    sig("rlo_engine_phase_stats", C.c_int, [p, C.POINTER(_PhaseStats)])
     sig("rlo_engine_link_stats", C.c_int,
         [p, C.POINTER(_LinkStats), C.c_int])
     sig("rlo_engine_enable_failure_detection", C.c_int,
@@ -707,6 +723,16 @@ class NativeEngine:
         if rc != 0:
             raise RuntimeError(f"enable_metrics failed ({rc})")
 
+    def enable_profiler(self, on: bool = True) -> None:
+        """In-engine phase profiler (docs/DESIGN.md §10): per-stage
+        duration histograms over the ENGINE_PHASE_KEYS taxonomy
+        (mirror of ProgressEngine.enable_profiler; one branch per
+        instrumented site when off)."""
+        rc = self._lib.rlo_engine_enable_profiler(self._e,
+                                                  1 if on else 0)
+        if rc != 0:
+            raise RuntimeError(f"enable_profiler failed ({rc})")
+
     def metrics(self) -> dict:
         """Drain rlo_engine_stats / rlo_engine_link_stats into the
         SAME nested-dict schema as ProgressEngine.metrics() — counter
@@ -721,6 +747,10 @@ class NativeEngine:
         rc = self._lib.rlo_engine_link_stats(self._e, arr, ws)
         if rc < 0:
             raise RuntimeError(f"rlo_engine_link_stats failed ({rc})")
+        ph = _PhaseStats()
+        rc = self._lib.rlo_engine_phase_stats(self._e, C.byref(ph))
+        if rc != 0:
+            raise RuntimeError(f"rlo_engine_phase_stats failed ({rc})")
         return {
             # ENGINE_COUNTER_KEYS is the schema contract with the
             # Python engine (ProgressEngine.metrics builds from the
@@ -742,6 +772,11 @@ class NativeEngine:
                 "proposal_resolve": st.proposal_resolve.to_dict(),
                 "pickup_wait": st.pickup_wait.to_dict(),
             },
+            # ENGINE_PHASE_KEYS doubles as the rlo_phase_stats field
+            # order (rlo-lint R2), so the same tuple drives both
+            # engines' "phases" assembly
+            "phases": {k: getattr(ph, k).to_dict()
+                       for k in ENGINE_PHASE_KEYS},
         }
 
     def set_fanout(self, mode: int) -> None:

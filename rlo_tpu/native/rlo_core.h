@@ -433,6 +433,31 @@ int rlo_engine_link_stats(const rlo_engine *e, rlo_link_stats *out,
                           int cap);
 
 /* ------------------------------------------------------------------ */
+/* In-engine phase profiler (docs/DESIGN.md S10) — native twin of the  */
+/* Python engine's ENGINE_PHASE_KEYS schema (rlo_tpu/utils/metrics.py):*/
+/* one log2 duration histogram (usec) per stage, FIELD ORDER IDENTICAL */
+/* to the Python tuple (rlo-lint R2 pins the pair; the profiler parity */
+/* test asserts snapshot equality). Hot-path stages: wire encode /     */
+/* decode, one transport isend, one ARQ retransmit-window sweep, tag   */
+/* dispatch + handler, one pickup delivery. Per-op protocol phases     */
+/* (local observation points): bcast init -> first fan-out send done   */
+/* -> all fan-out sends done; proposal submit -> all votes merged ->   */
+/* decision fan-out done. Off by default; the disabled path costs one  */
+/* predictable branch per instrumented site (no clock read) — the same */
+/* overhead contract as the metrics registry. With tracing enabled,    */
+/* every sample also emits RLO_EV_PHASE (a = field index, b = usec)    */
+/* for the Chrome-timeline duration slices.                            */
+/* ------------------------------------------------------------------ */
+typedef struct rlo_phase_stats {
+    rlo_hist frame_encode, frame_decode, send, arq_scan, tag_dispatch,
+             pickup_drain, bcast_first_fwd, bcast_all_delivered,
+             prop_votes_aggregated, prop_decision;
+} rlo_phase_stats;
+
+int rlo_engine_enable_profiler(rlo_engine *e, int on);
+int rlo_engine_phase_stats(const rlo_engine *e, rlo_phase_stats *out);
+
+/* ------------------------------------------------------------------ */
 /* Engine snapshot/restore (mirror of the checkpoint subsystem's        */
 /* engine_state_dict, rlo_tpu/utils/checkpoint.py): a quiesced engine's */
 /* durable identity — bcast/pickup counters and own-proposal            */
@@ -591,6 +616,12 @@ enum rlo_ev {
                             * 0 received, c = incarnation, d = epoch */
     RLO_EV_ADMIT = 13,     /* admission executed/adopted: a = joiner,
                             * b = new epoch, c = joiner incarnation */
+    RLO_EV_PHASE = 14,     /* phase-profiler stage sample (docs/DESIGN.md
+                            * S10): a = field index in rlo_phase_stats /
+                            * ENGINE_PHASE_KEYS order, b = duration
+                            * (usec, clamped to int32); the timeline
+                            * merger renders a duration slice ENDING at
+                            * ts_usec */
 };
 
 typedef struct rlo_trace_event {

@@ -6,9 +6,14 @@
  * RLO_bcast_gen :1581, _bc_forward :1104, IAR handlers :668-932, pickup
  * :938-992) with the deliberate departures listed in rlo_core.h.
  */
+/* for clock_gettime(CLOCK_MONOTONIC) under -std=c11 (the profiler
+ * clock, now_usec_f) — must precede every system header */
+#define _POSIX_C_SOURCE 199309L
+
 #include "rlo_internal.h"
 
 #include <stdio.h>
+#include <time.h>
 
 /* depth of the recent-broadcast ring log re-flooded on view changes */
 #define RLO_RECENT_LOG 64
@@ -90,6 +95,12 @@ struct rlo_msg {
      * time of a locally-initiated bcast and receipt time of a
      * deliverable message (mirror of _Msg.born/arrived in engine.py) */
     uint64_t born, arrived;
+    /* profiler stamps (0 = profiler off at init, docs/DESIGN.md S10):
+     * bcast init time for the first-forward/all-delivered phase
+     * timers, and whether the first fan-out completion was observed
+     * (mirror of _Msg.p_born/first_fwd in engine.py) */
+    double p_born;
+    int first_fwd;
 };
 
 struct rlo_engine {
@@ -159,6 +170,13 @@ struct rlo_engine {
     rlo_link_stats *links; /* ws entries; links[rank] stays zero */
     rlo_hist h_bcast, h_prop, h_pickup;
     uint64_t prop_born;
+    /* in-engine phase profiler (docs/DESIGN.md S10; mirror of
+     * engine.py's _prof_on/_ph machinery): per-stage log2 duration
+     * histograms, collected only while profiler_on — one branch per
+     * instrumented site when off (the overhead contract) */
+    int profiler_on;
+    rlo_phase_stats ph;
+    double p_prop_born; /* submit stamp for the proposal phases (0=off) */
     /* membership-round watchdog: app op deadlines are Python-side,
      * but the ENGINE-initiated admission rounds need one here — a
      * round straddling a view change can park into a cyclic vote
@@ -231,6 +249,49 @@ static void hist_obs(rlo_hist *h, double v)
     h->count++;
     h->sum += v;
     h->buckets[b]++;
+}
+
+/* ---------------- phase profiler (docs/DESIGN.md S10) ---------------- */
+
+/* field indices into rlo_phase_stats — the ENGINE_PHASE_KEYS snapshot
+ * order shared with the Python engine (and the Ev.PHASE a field) */
+enum {
+    RLO_PH_FRAME_ENCODE = 0,
+    RLO_PH_FRAME_DECODE,
+    RLO_PH_SEND,
+    RLO_PH_ARQ_SCAN,
+    RLO_PH_TAG_DISPATCH,
+    RLO_PH_PICKUP_DRAIN,
+    RLO_PH_BCAST_FIRST_FWD,
+    RLO_PH_BCAST_ALL_DELIVERED,
+    RLO_PH_PROP_VOTES_AGGREGATED,
+    RLO_PH_PROP_DECISION,
+};
+
+/* profiler clock: monotonic, sub-usec resolution as double usec —
+ * rlo_now_usec's 1 usec granularity would round most hot-path stages
+ * (a header pack, one isend into an in-process ring) to zero */
+static double now_usec_f(void)
+{
+    struct timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    return (double)ts.tv_sec * 1e6 + (double)ts.tv_nsec / 1e3;
+}
+
+/* Record one stage sample: duration since t0 into the phase histogram
+ * (rlo_phase_stats is 10 contiguous rlo_hist fields, so indexing off
+ * the first is well-defined), plus an RLO_EV_PHASE trace event when
+ * the tracer is live. Callers gate on profiler_on — this is never
+ * reached on the disabled path. */
+static void ph_obs(rlo_engine *e, int idx, double t0)
+{
+    double dur = now_usec_f() - t0;
+    hist_obs((rlo_hist *)&e->ph + idx, dur);
+    if (rlo_trace_enabled())
+        rlo_trace_emit(e->rank, RLO_EV_PHASE, idx,
+                       dur >= 2147483647.0 ? 2147483647
+                                           : (int)(dur < 0 ? 0 : dur),
+                       0, 0);
 }
 
 static void rtt_sample(rlo_link_stats *ls, double usec)
@@ -380,6 +441,16 @@ static int msg_sends_done(const rlo_msg *m)
     return 1;
 }
 
+/* Any fan-out send completed (the profiler's first-forward phase
+ * anchor); zero handles counts as none. */
+static int msg_any_send_done(const rlo_msg *m)
+{
+    for (int i = 0; i < m->n_handles; i++)
+        if (m->handles[i]->delivered)
+            return 1;
+    return 0;
+}
+
 /* ---------------- send helper ---------------- */
 
 static void put_le32(uint8_t *dst, int v)
@@ -412,6 +483,21 @@ static int arq_exempt(int tag)
  * link seq (the shared fan-out blob must not be mutated — each edge
  * carries a different seq), queued for retransmission, and only then
  * handed to the transport. */
+/* rlo_world_isend with the profiler's send-stage timer (one branch on
+ * the disabled path — the S10 overhead contract). */
+static int isend_timed(rlo_engine *e, int dst, int tag, rlo_blob *frame,
+                       rlo_handle **h)
+{
+    if (!e->profiler_on)
+        return rlo_world_isend(e->w, e->rank, dst, e->comm, tag, frame,
+                               h);
+    double t0 = now_usec_f();
+    int rc = rlo_world_isend(e->w, e->rank, dst, e->comm, tag, frame,
+                             h);
+    ph_obs(e, RLO_PH_SEND, t0);
+    return rc;
+}
+
 static int eng_isend_frame(rlo_engine *e, int dst, int tag,
                            rlo_blob *frame, rlo_msg *track_in)
 {
@@ -442,8 +528,7 @@ static int eng_isend_frame(rlo_engine *e, int dst, int tag,
         rt->next = e->rtx_head;
         e->rtx_head = rt;
         e->arq_unacked_cnt++;
-        rc = rlo_world_isend(e->w, e->rank, dst, e->comm, tag, stamped,
-                             track_in ? &h : 0);
+        rc = isend_timed(e, dst, tag, stamped, track_in ? &h : 0);
         rlo_blob_unref(stamped);
     } else {
         /* link-epoch stamp (docs/DESIGN.md S8): the fan-out blob is
@@ -460,12 +545,10 @@ static int eng_isend_frame(rlo_engine *e, int dst, int tag,
                 return RLO_ERR_NOMEM;
             memcpy(st->data, frame->data, (size_t)frame->len);
             rlo_frame_set_epoch(st->data, lep);
-            rc = rlo_world_isend(e->w, e->rank, dst, e->comm, tag, st,
-                                 track_in ? &h : 0);
+            rc = isend_timed(e, dst, tag, st, track_in ? &h : 0);
             rlo_blob_unref(st);
         } else {
-            rc = rlo_world_isend(e->w, e->rank, dst, e->comm, tag,
-                                 frame, track_in ? &h : 0);
+            rc = isend_timed(e, dst, tag, frame, track_in ? &h : 0);
         }
     }
     if (rc == RLO_OK && track_in)
@@ -478,7 +561,14 @@ static int eng_isend(rlo_engine *e, int dst, int tag, int32_t origin,
                      int32_t pid, int32_t vote, const uint8_t *payload,
                      int64_t len, rlo_msg *track_in)
 {
-    rlo_blob *frame = frame_blob(origin, pid, vote, payload, len);
+    rlo_blob *frame;
+    if (e->profiler_on) {
+        double t0 = now_usec_f();
+        frame = frame_blob(origin, pid, vote, payload, len);
+        ph_obs(e, RLO_PH_FRAME_ENCODE, t0);
+    } else {
+        frame = frame_blob(origin, pid, vote, payload, len);
+    }
     if (!frame)
         return RLO_ERR_NOMEM;
     int rc = eng_isend_frame(e, dst, tag, frame, track_in);
@@ -1005,8 +1095,7 @@ static void arq_tick(rlo_engine *e)
             e->links[rt->dst].tx_bytes += rt->frame->len;
         }
         /* same bytes, same seq: the receiver dedups the retransmit */
-        rlo_world_isend(e->w, e->rank, rt->dst, e->comm, rt->tag,
-                        rt->frame, 0);
+        isend_timed(e, rt->dst, rt->tag, rt->frame, 0);
         pp = &rt->next;
     }
     for (int d = 0; d < e->ws; d++) {
@@ -1106,7 +1195,14 @@ static int bcast_init(rlo_engine *e, int tag, int32_t pid, int32_t vote,
     if (len < 0 || len > e->msg_size_max)
         return RLO_ERR_TOO_BIG;
     /* encode ONCE; every fan-out edge shares the blob by ref */
-    rlo_blob *frame = frame_blob(e->rank, pid, vote, payload, len);
+    rlo_blob *frame;
+    if (e->profiler_on) {
+        double t0 = now_usec_f();
+        frame = frame_blob(e->rank, pid, vote, payload, len);
+        ph_obs(e, RLO_PH_FRAME_ENCODE, t0);
+    } else {
+        frame = frame_blob(e->rank, pid, vote, payload, len);
+    }
     if (!frame)
         return RLO_ERR_NOMEM;
     int err = RLO_ERR_NOMEM;
@@ -1149,6 +1245,8 @@ int rlo_bcast(rlo_engine *e, const uint8_t *payload, int64_t len)
     if (rc == RLO_OK) {
         if (e->metrics_on)
             m->born = rlo_now_usec();
+        if (e->profiler_on)
+            m->p_born = now_usec_f();
         recent_log_push(e, m->frame, RLO_TAG_BCAST);
         rlo_progress_all(e->w);
     }
@@ -1453,6 +1551,10 @@ static int await_remove(rlo_prop *p, int src)
 static void complete_own(rlo_engine *e)
 {
     rlo_prop *p = &e->own;
+    if (e->p_prop_born != 0)
+        /* S10 prop_votes_aggregated: submit -> every awaited vote
+         * merged (or discounted); the decision fan-out starts here */
+        ph_obs(e, RLO_PH_PROP_VOTES_AGGREGATED, e->p_prop_born);
     if (p->vote)
         /* re-judge: a competing proposal may have changed app state
          * since submission (reference :773) */
@@ -1640,6 +1742,8 @@ int rlo_submit_proposal(rlo_engine *e, const uint8_t *proposal, int64_t len,
     }
     if (e->metrics_on)
         e->prop_born = rlo_now_usec();
+    if (e->profiler_on)
+        e->p_prop_born = now_usec_f();
     rlo_trace_emit(e->rank, RLO_EV_PROPOSAL_SUBMIT, pid, 0, p->gen, 0);
     /* the proposal frame's vote field carries the round generation */
     int rc = bcast_init(e, RLO_TAG_IAR_PROPOSAL, pid, p->gen, proposal,
@@ -2016,6 +2120,22 @@ int rlo_engine_link_stats(const rlo_engine *e, rlo_link_stats *out,
     return e->ws;
 }
 
+int rlo_engine_enable_profiler(rlo_engine *e, int on)
+{
+    if (!e)
+        return RLO_ERR_ARG;
+    e->profiler_on = on ? 1 : 0;
+    return RLO_OK;
+}
+
+int rlo_engine_phase_stats(const rlo_engine *e, rlo_phase_stats *out)
+{
+    if (!e || !out)
+        return RLO_ERR_ARG;
+    *out = e->ph;
+    return RLO_OK;
+}
+
 int rlo_engine_rank_failed(const rlo_engine *e, int rank)
 {
     return e->failed && rank >= 0 && rank < e->ws && e->failed[rank];
@@ -2064,6 +2184,7 @@ static void abort_own_round(rlo_engine *e)
         return;
     p->state = RLO_FAILED;
     e->prop_born = 0;
+    e->p_prop_born = 0; /* phase timers track successes only */
     e->own_deadline = 0;
     rlo_trace_emit(e->rank, RLO_EV_DECISION, p->pid, -1, p->gen, 0);
     if (p->pid <= RLO_MEMBER_PID_BASE && p->payload &&
@@ -2679,6 +2800,7 @@ static int in_wait_pickup(const rlo_engine *e, const rlo_msg *m)
 int64_t rlo_pickup_next(rlo_engine *e, int *tag, int *origin, int *pid,
                         int *vote, uint8_t *buf, int64_t cap)
 {
+    double t0 = e->profiler_on ? now_usec_f() : 0;
     int from_wait;
     rlo_msg *m = pickup_head(e, &from_wait);
     if (!m)
@@ -2687,6 +2809,8 @@ int64_t rlo_pickup_next(rlo_engine *e, int *tag, int *origin, int *pid,
     if (n < 0)
         return n;
     pickup_retire(e, m, from_wait);
+    if (e->profiler_on)
+        ph_obs(e, RLO_PH_PICKUP_DRAIN, t0);
     return n;
 }
 
@@ -2720,7 +2844,12 @@ int rlo_pickup_consume(rlo_engine *e)
     rlo_msg *m = e->peeked;
     if (!m)
         return RLO_ERR_ARG;
+    /* the peek/consume pair is one delivery: time the retire leg (the
+     * peek already handed the payload out zero-copy) */
+    double t0 = e->profiler_on ? now_usec_f() : 0;
     pickup_retire(e, m, in_wait_pickup(e, m));
+    if (e->profiler_on)
+        ph_obs(e, RLO_PH_PICKUP_DRAIN, t0);
     return RLO_OK;
 }
 
@@ -2746,6 +2875,11 @@ void rlo_engine_progress_once(rlo_engine *e)
                              (double)(now - e->prop_born));
                 e->prop_born = 0;
             }
+            if (e->p_prop_born != 0) {
+                /* submit -> decision fan-out complete (S10 phase) */
+                ph_obs(e, RLO_PH_PROP_DECISION, e->p_prop_born);
+                e->p_prop_born = 0;
+            }
         }
     }
     if (p->state == RLO_IN_PROGRESS && !p->decision_pending &&
@@ -2759,7 +2893,14 @@ void rlo_engine_progress_once(rlo_engine *e)
             break;
         /* steal the node's frame ref into the message — no copy */
         int err = RLO_ERR_PROTO;
-        rlo_msg *m = msg_from_frame(n->tag, n->src, n->frame, &err);
+        rlo_msg *m;
+        if (e->profiler_on) {
+            double t0 = now_usec_f();
+            m = msg_from_frame(n->tag, n->src, n->frame, &err);
+            ph_obs(e, RLO_PH_FRAME_DECODE, t0);
+        } else {
+            m = msg_from_frame(n->tag, n->src, n->frame, &err);
+        }
         rlo_handle_unref(n->handle);
         free(n);
         if (!m) {
@@ -2849,13 +2990,21 @@ void rlo_engine_progress_once(rlo_engine *e)
                 continue;
             }
         }
+        /* S10 tag_dispatch phase: dispatch + handler for one protocol
+         * frame (quarantine/ACK/dedup exits above are not counted —
+         * they never reach a handler) */
+        double t_disp = e->profiler_on ? now_usec_f() : 0;
         switch (m->tag) {
         case RLO_TAG_BCAST: {
             e->recved_bcast++;
             if (bcast_is_dup(e, m)) {
-                /* exactly-once: drop, don't re-forward or deliver */
+                /* exactly-once: drop, don't re-forward or deliver.
+                 * `continue` (not break): a dup drop is not a
+                 * dispatch, so no tag_dispatch phase sample — keeps
+                 * the profiler counts in lockstep with the Python
+                 * engine's `continue` on this path */
                 msg_free(m);
-                break;
+                continue;
             }
             recent_log_push(e, m->frame, RLO_TAG_BCAST);
             int rc = bc_forward(e, m);
@@ -2893,6 +3042,8 @@ void rlo_engine_progress_once(rlo_engine *e)
             q_append(&e->q_pickup, m);
             break;
         }
+        if (e->profiler_on)
+            ph_obs(e, RLO_PH_TAG_DISPATCH, t_disp);
     }
 
     /* (b2) liveness: heartbeat my ring successor, watch my predecessor
@@ -2913,7 +3064,13 @@ void rlo_engine_progress_once(rlo_engine *e)
      * escalate give-ups to the failure detector, then flush the
      * cumulative ACKs this turn's receipts owe */
     if (e->arq_rto) {
-        arq_tick(e);
+        if (e->profiler_on) {
+            double t0 = now_usec_f();
+            arq_tick(e);
+            ph_obs(e, RLO_PH_ARQ_SCAN, t0);
+        } else {
+            arq_tick(e);
+        }
         arq_escalate_gaveup(e);
         arq_flush_acks(e);
     }
@@ -2932,6 +3089,12 @@ void rlo_engine_progress_once(rlo_engine *e)
     /* (d) wait-only sweep (:1015-1034): completed sends are released */
     for (rlo_msg *m = e->q_wait.head; m;) {
         rlo_msg *nm = m->next;
+        if (m->p_born != 0 && !m->first_fwd && msg_any_send_done(m)) {
+            /* S10 bcast_first_fwd: init -> the FIRST fan-out send
+             * completed; observed once per locally-initiated bcast */
+            m->first_fwd = 1;
+            ph_obs(e, RLO_PH_BCAST_FIRST_FWD, m->p_born);
+        }
         if (msg_sends_done(m)) {
             m->fwd_done = 1;
             if (m->born) {
@@ -2940,6 +3103,8 @@ void rlo_engine_progress_once(rlo_engine *e)
                 if (now >= m->born)
                     hist_obs(&e->h_bcast, (double)(now - m->born));
             }
+            if (m->p_born != 0)
+                ph_obs(e, RLO_PH_BCAST_ALL_DELIVERED, m->p_born);
             q_remove(&e->q_wait, m);
             msg_free(m);
         }

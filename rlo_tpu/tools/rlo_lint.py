@@ -21,7 +21,10 @@ Rule families (docs/DESIGN.md §9 has the full catalogue):
      each paired constant carries a ``rlo-lint: paired-with`` anchor.
   R2 metrics-schema parity — ENGINE_COUNTER_KEYS (utils/metrics.py)
      ⇔ the leading counter fields of ``struct rlo_stats`` ⇔ the keys
-     ProgressEngine.metrics() assembles.
+     ProgressEngine.metrics() assembles; ENGINE_PHASE_KEYS ⇔ the
+     field order of ``struct rlo_phase_stats`` ⇔ the phase literal
+     metrics() assembles ⇔ the engine's ``_phobs()`` observation
+     sites (every phase observed, every observation schema-valid).
   R3 ctypes contract — every exported ``rlo_*`` prototype in
      rlo_core.h has a bindings.py declaration whose argtypes/restype
      match the parsed C signature (pointer-returning and 64-bit-
@@ -469,6 +472,7 @@ STRUCT_MIRRORS = {
     "rlo_hist": "_Hist",
     "rlo_engine_state": "_EngineState",
     "rlo_trace_event": "_TraceEvent",
+    "rlo_phase_stats": "_PhaseStats",
 }
 
 _SCALAR_CTYPES = {
@@ -696,7 +700,8 @@ def rule_r1(ctx: "LintContext") -> List[Finding]:
 
 def rule_r2(ctx: "LintContext") -> List[Finding]:
     """Metrics-schema parity: ENGINE_COUNTER_KEYS <-> rlo_stats <->
-    ProgressEngine.metrics()."""
+    ProgressEngine.metrics(); ENGINE_PHASE_KEYS <-> rlo_phase_stats
+    <-> the metrics() phase literal <-> _phobs() call sites."""
     f: List[Finding] = []
     metrics, hdr = ctx.metrics, ctx.header
     assigns = py_top_assigns(metrics)
@@ -750,6 +755,82 @@ def rule_r2(ctx: "LintContext") -> List[Finding]:
             "R2", ctx.engine.path, vals_line,
             f"metrics() assembles counters {sorted(vals_keys)} but "
             f"ENGINE_COUNTER_KEYS is {sorted(keys)}"))
+
+    # --- phase-profiler schema (docs/DESIGN.md §10): Python registry
+    # tuple <-> rlo_phase_stats field order <-> the metrics() 'phs'
+    # literal <-> the engine's _phobs() observation sites ---
+    if "ENGINE_PHASE_KEYS" not in assigns:
+        f.append(Finding("R2", metrics.path, 1,
+                         "ENGINE_PHASE_KEYS not defined"))
+        return f
+    pnode, pline = assigns["ENGINE_PHASE_KEYS"]
+    _require_anchor(f, metrics, pline, "ENGINE_PHASE_KEYS")
+    if not isinstance(pnode, (ast.Tuple, ast.List)):
+        f.append(Finding("R2", metrics.path, pline,
+                         "ENGINE_PHASE_KEYS is not a literal tuple"))
+        return f
+    pkeys = tuple(e.value for e in pnode.elts
+                  if isinstance(e, ast.Constant))
+    pstats = hdr.structs.get("rlo_phase_stats")
+    if pstats is None:
+        f.append(Finding("R2", hdr.path, 1,
+                         "struct rlo_phase_stats not found"))
+        return f
+    c_phases = tuple(name for name, _, _, _ in pstats)
+    if pkeys != c_phases:
+        f.append(Finding(
+            "R2", metrics.path, pline,
+            f"ENGINE_PHASE_KEYS {pkeys} != rlo_phase_stats fields "
+            f"{c_phases} ({hdr.path}) — the field ORDER is the "
+            f"snapshot/trace-index contract"))
+
+    # the Python engine's metrics() phase literal ('phs') must
+    # assemble exactly the schema keys (mirror of the 'vals' check)
+    phs_keys: Optional[Set[str]] = None
+    phs_line = pline
+    if mfn is not None:
+        for n in ast.walk(mfn):
+            if isinstance(n, ast.Assign) and len(n.targets) == 1 and \
+                    isinstance(n.targets[0], ast.Name) and \
+                    n.targets[0].id == "phs" and \
+                    isinstance(n.value, ast.Dict):
+                phs_keys = {k.value for k in n.value.keys
+                            if isinstance(k, ast.Constant)}
+                phs_line = n.lineno
+    if phs_keys is None:
+        f.append(Finding("R2", ctx.engine.path, 1,
+                         "ProgressEngine.metrics() phase dict "
+                         "('phs') not found"))
+    elif phs_keys != set(pkeys):
+        f.append(Finding(
+            "R2", ctx.engine.path, phs_line,
+            f"metrics() assembles phases {sorted(phs_keys)} but "
+            f"ENGINE_PHASE_KEYS is {sorted(pkeys)}"))
+
+    # every _phobs("<stage>", ...) call site names a schema key, and
+    # every key has at least one observation site — a phase with no
+    # observations (or an observation into a key the snapshot never
+    # emits) is silent schema drift
+    observed: Set[str] = set()
+    for n in ast.walk(ctx.engine.tree):
+        if isinstance(n, ast.Call) and \
+                isinstance(n.func, ast.Attribute) and \
+                n.func.attr == "_phobs" and n.args and \
+                isinstance(n.args[0], ast.Constant):
+            key = n.args[0].value
+            if key not in pkeys:
+                f.append(Finding(
+                    "R2", ctx.engine.path, n.lineno,
+                    f"_phobs({key!r}) is not an ENGINE_PHASE_KEYS "
+                    f"member — the sample would KeyError at runtime"))
+            else:
+                observed.add(key)
+    for key in pkeys:
+        if key not in observed:
+            f.append(Finding(
+                "R2", metrics.path, pline,
+                f"phase {key!r} has no _phobs() observation site in "
+                f"{ctx.engine.path}"))
     return f
 
 

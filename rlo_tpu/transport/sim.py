@@ -80,7 +80,16 @@ class SimWorld:
     def __init__(self, world_size: int, seed: int = 0,
                  min_delay: float = 0.001, max_delay: float = 0.25,
                  drop_p: float = 0.0, dup_p: float = 0.0,
-                 idle_dt: float = 0.05):
+                 idle_dt: float = 0.05, protocol_only: bool = False):
+        """``protocol_only`` is the fleet-scale fast path (ROADMAP item
+        4 / docs/DESIGN.md §10): payloads are passed by reference
+        (no defensive copy) and the SHA-256 schedule digest is skipped
+        — the two per-frame costs that dominate at n >= 1024 simulated
+        ranks. Delivery order, delays, drops and every engine decision
+        stay seed-deterministic; only ``schedule_digest()`` (which
+        returns the "protocol-only" sentinel) is given up, so replay
+        ASSERTIONS need the full mode while scaling CURVES
+        (benchmarks/sim_bench.py) use this one."""
         if world_size < 2:
             raise ValueError(f"world_size must be >= 2, got {world_size}")
         if not 0.0 < min_delay <= max_delay:
@@ -106,7 +115,12 @@ class SimWorld:
         self.dropped_cnt = 0
         self.duplicated_cnt = 0
         self.events = 0  # schedule length (delivery attempts)
-        self._digest = hashlib.sha256()
+        self.protocol_only = protocol_only
+        self._digest = None if protocol_only else hashlib.sha256()
+        #: rank that received the last step()'s frame (None on idle
+        #: ticks and dropped deliveries) — lets a bench driver step
+        #: only the engine with fresh input instead of all n
+        self.last_dst: Optional[int] = None
         self.transports = [SimTransport(self, r)
                            for r in range(world_size)]
 
@@ -141,7 +155,9 @@ class SimWorld:
             t = last
         self._chan_last[(src, dst)] = t
         h = _SimSend()
-        payload = bytes(data)
+        # protocol-only fast path: skip the defensive copy — engines
+        # hand in immutable bytes and never alias them afterwards
+        payload = data if self.protocol_only else bytes(data)
         for _ in range(copies):
             heapq.heappush(self._heap,
                            (t, next(self._ctr), src, dst, tag, payload,
@@ -167,6 +183,7 @@ class SimWorld:
         in flight — advance idle time by ``idle_dt`` (False) so
         time-driven machinery (heartbeats, RTOs, deadlines, JOIN
         probes) keeps firing."""
+        self.last_dst = None
         if not self._heap:
             self.now += self.idle_dt
             return False
@@ -181,20 +198,26 @@ class SimWorld:
                     self._group.get(dst, -1 - dst)))
         # the digest covers every delivery ATTEMPT (time, edge, tag,
         # outcome, payload): two runs with one seed must make the
-        # identical sequence of decisions, drops included
-        self._digest.update(struct.pack("<diiii", t, src, dst, tag,
-                                        0 if dropped else 1))
-        self._digest.update(data)
+        # identical sequence of decisions, drops included (skipped
+        # entirely on the protocol-only fast path)
+        if self._digest is not None:
+            self._digest.update(struct.pack("<diiii", t, src, dst, tag,
+                                            0 if dropped else 1))
+            self._digest.update(data)
         if dropped:
             h.failed = True
             self.dropped_cnt += 1
             return True
         self.inboxes[dst].append((src, tag, data))
         self.delivered_cnt += 1
+        self.last_dst = dst
         return True
 
     def schedule_digest(self) -> str:
-        """SHA-256 over the delivery schedule so far (see step())."""
+        """SHA-256 over the delivery schedule so far (see step());
+        the "protocol-only" sentinel when the fast path disabled it."""
+        if self._digest is None:
+            return "protocol-only"
         return self._digest.hexdigest()
 
     def quiescent(self) -> bool:
@@ -304,8 +327,48 @@ class Scenario:
                 f"drop_p={self.drop_p}, dup_p={self.dup_p}).run()")
 
     def _fail(self, why: str):
+        art = self._dump_violation_artifacts(why)
         raise SimViolation(
-            f"seed {self.seed}: {why}\nreplay: {self._replay_recipe()}")
+            f"seed {self.seed}: {why}\nreplay: {self._replay_recipe()}"
+            + (f"\nper-rank metrics snapshot: {art}" if art else ""))
+
+    def _dump_violation_artifacts(self, why: str) -> Optional[str]:
+        """On a property violation, dump every live rank's engine
+        ``metrics()`` snapshot (counters, queue depths, links, op
+        latency, profiler phases) as JSON next to the replay recipe,
+        so the perf/protocol state AT the failure is inspectable —
+        not just reproducible. Directory from $RLO_SIM_ARTIFACTS
+        (default: the system tempdir); best-effort, never masks the
+        violation itself."""
+        import json
+        import os
+        import tempfile
+
+        engines = getattr(self, "_engines", None)
+        world = getattr(self, "_world", None)
+        if not engines:
+            return None
+        outdir = os.environ.get("RLO_SIM_ARTIFACTS") or \
+            tempfile.gettempdir()
+        path = os.path.join(
+            outdir, f"rlo_sim_violation_seed{self.seed}.json")
+        try:
+            os.makedirs(outdir, exist_ok=True)
+            with open(path, "w") as fh:
+                json.dump({
+                    "seed": self.seed,
+                    "violation": why,
+                    "replay": self._replay_recipe(),
+                    "virtual_time": world.now if world else None,
+                    "schedule_events": world.events if world else None,
+                    "metrics": {str(e.rank): e.metrics()
+                                for e in engines
+                                if e.rank not in
+                                (world.dead if world else ())},
+                }, fh, indent=1)
+        except OSError:
+            return None
+        return path
 
     def run(self) -> Dict:
         from rlo_tpu.engine import (EngineManager, ProgressEngine,
@@ -319,6 +382,8 @@ class Scenario:
             ProgressEngine(world.transport(r), manager=mgr,
                            clock=world.clock, **self.engine_kw)
             for r in range(self.ws)]
+        # exposed for the violation artifact dump (_fail)
+        self._world, self._engines = world, engines
         incarnation = [0] * self.ws
         live = set(range(self.ws))
         ever_disturbed: set = set()   # ranks killed/restarted at any point

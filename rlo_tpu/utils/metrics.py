@@ -50,6 +50,34 @@ ENGINE_COUNTER_KEYS = (
     "epoch", "epoch_quarantined", "rejoins",
 )
 
+#: The in-engine phase-profiler schema, in snapshot order — the single
+#: source of truth for the ``metrics()["phases"]`` keys both engines
+#: emit (ProgressEngine.metrics() and bindings.NativeEngine.metrics()
+#: build from this tuple; rlo-lint R2 pins it to the field order of the
+#: C core's ``struct rlo_phase_stats`` and to the literal keys the
+#: Python engine assembles, and the profiler parity test asserts the
+#: snapshots are structurally identical). Each key names one log2
+#: histogram of stage durations in usec (docs/DESIGN.md §10):
+#:
+#:   hot-path stages —
+#:     ``frame_encode``   wire-frame encode (header pack + payload)
+#:     ``frame_decode``   wire-frame decode on receipt
+#:     ``send``           one transport isend call (the syscall slot)
+#:     ``arq_scan``       one ARQ retransmit-window sweep
+#:     ``tag_dispatch``   tag dispatch + handler for one protocol frame
+#:     ``pickup_drain``   one pickup_next delivery
+#:   per-op protocol phases (local observation points) —
+#:     ``bcast_first_fwd``        bcast init -> FIRST fan-out send done
+#:     ``bcast_all_delivered``    bcast init -> every fan-out send done
+#:     ``prop_votes_aggregated``  proposal submit -> all votes merged
+#:     ``prop_decision``          proposal submit -> decision fan-out done
+# rlo-lint: paired-with rlo_core.h:rlo_phase_stats
+ENGINE_PHASE_KEYS = (
+    "frame_encode", "frame_decode", "send", "arq_scan", "tag_dispatch",
+    "pickup_drain", "bcast_first_fwd", "bcast_all_delivered",
+    "prop_votes_aggregated", "prop_decision",
+)
+
 
 class Counter:
     """Monotonically increasing integer."""
@@ -113,6 +141,28 @@ class Histogram:
         return {"count": self.count, "sum": self.sum,
                 "min": self.min, "max": self.max,
                 "buckets": list(self.buckets)}
+
+    def quantile(self, q: float) -> Optional[float]:
+        """Approximate quantile (log2 bucket upper bound; exact max for
+        the overflow bucket) — None while empty. Good to a factor of 2,
+        which is what log2 buckets buy."""
+        return hist_quantile(self.snapshot(), q)
+
+    def p50(self) -> Optional[float]:
+        return self.quantile(0.50)
+
+    def p90(self) -> Optional[float]:
+        return self.quantile(0.90)
+
+    def p99(self) -> Optional[float]:
+        return self.quantile(0.99)
+
+    def summary(self) -> Dict:
+        """Human/dashboard-shaped digest: count, mean, min/max and the
+        p50/p90/p99 estimates — what DecodeServer.stats() and the bench
+        reports emit instead of the raw 28-bucket dump (the raw layout
+        stays available via snapshot())."""
+        return hist_summary(self.snapshot())
 
 
 class LinkStats:
@@ -215,3 +265,20 @@ def hist_quantile(hist: Dict, q: float) -> Optional[float]:
                 return float(hist["max"])
             return float(2 ** i)
     return float(hist["max"])
+
+
+def hist_summary(hist: Dict) -> Dict:
+    """Percentile digest of a histogram SNAPSHOT (the dict shape both
+    engines and the Registry emit): count/mean/min/max + p50/p90/p99
+    estimated from the log2 buckets — the serving/bench-facing shape
+    (raw buckets stay in the snapshot for anyone who wants them)."""
+    n = hist["count"]
+    return {
+        "count": n,
+        "mean": (hist["sum"] / n) if n else None,
+        "min": hist["min"] if n else None,
+        "max": hist["max"] if n else None,
+        "p50": hist_quantile(hist, 0.50),
+        "p90": hist_quantile(hist, 0.90),
+        "p99": hist_quantile(hist, 0.99),
+    }

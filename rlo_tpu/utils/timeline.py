@@ -47,6 +47,12 @@ logger = logging.getLogger("rlo_tpu.timeline")
 FLOW_TAGS = {0: "bcast", 2: "proposal", 4: "decision",
              12: "failure", 14: "abort"}
 
+#: phase-profiler stage names, indexed by the Ev.PHASE ``a`` field —
+#: the metrics.ENGINE_PHASE_KEYS snapshot order (imported so the
+#: timeline can never drift from the schema; utils.metrics has no
+#: engine/jax dependencies, keeping this module standalone-importable)
+from rlo_tpu.utils.metrics import ENGINE_PHASE_KEYS as PHASE_NAMES
+
 Source = Union[str, Path, Iterable[Dict]]
 
 
@@ -133,6 +139,21 @@ def merge_timeline(sources: List[Source],
     anchors: Dict = {}
     for e in events:
         ts = e["ts_usec"] - t0
+        if e.get("kind") == "PHASE":
+            # profiler stage sample (docs/DESIGN.md §10): a true
+            # duration slice — emitted at stage END with the measured
+            # duration in b, so the slice spans [end - dur, end] and
+            # nests visually under the protocol events it timed
+            a = e.get("a", -1)
+            name = (PHASE_NAMES[a] if 0 <= a < len(PHASE_NAMES)
+                    else f"phase{a}")
+            dur = max(int(e.get("b", 0)), slice_usec)
+            trace_events.append({
+                "ph": "X", "cat": "phase", "name": name, "pid": 0,
+                "tid": e["rank"], "ts": max(0, ts - dur), "dur": dur,
+                "args": {"usec": e.get("b", 0)},
+            })
+            continue
         trace_events.append({
             "ph": "X", "cat": "proto", "name": e["kind"],
             "pid": 0, "tid": e["rank"], "ts": ts, "dur": slice_usec,
@@ -256,6 +277,7 @@ def _smoke(out: Optional[str]) -> Dict:
                               arq_rto=0.01) for r in range(ws)]
     for e in engines:
         e.enable_metrics()
+        e.enable_profiler()  # §10 phase slices ride the same timeline
     TRACER.clear()
     with TRACER.enable():
         world.dup_next(0, 1, 2)
@@ -280,11 +302,17 @@ def _smoke(out: Optional[str]) -> Dict:
     edges = count_flow_edges(trace)
     if edges < 1:
         raise AssertionError("smoke produced no flow edges")
+    phase_slices = sum(1 for ev in trace["traceEvents"]
+                       if ev.get("cat") == "phase")
+    if phase_slices < 1:
+        raise AssertionError("smoke produced no profiler phase slices")
     snap = engines[0].metrics()
+    if snap["phases"]["send"]["count"] < 1:
+        raise AssertionError("profiler recorded no send-stage samples")
     for e in engines:
         e.cleanup()
     return {"ok": True, "ranks": ws, "events": trace["otherData"]["events"],
-            "flow_edges": edges,
+            "flow_edges": edges, "phase_slices": phase_slices,
             "rank0_tx_frames": sum(l["tx_frames"]
                                    for l in snap["links"].values())}
 

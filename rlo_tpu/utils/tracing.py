@@ -61,6 +61,12 @@ class Ev(IntEnum):
     #                     0 received, c = incarnation, d = epoch
     ADMIT = 13          # membership admission executed: a = joiner,
     #                     b = new epoch, c = joiner incarnation
+    PHASE = 14          # phase-profiler stage sample (docs/DESIGN.md
+    #                     §10): a = phase index in the
+    #                     metrics.ENGINE_PHASE_KEYS snapshot order,
+    #                     b = duration (usec, clamped to int32); the
+    #                     timeline merger renders it as a Chrome
+    #                     duration slice ENDING at ts_usec
 
 
 @dataclass

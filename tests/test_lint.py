@@ -115,6 +115,39 @@ def test_r2_fires_on_counter_key_drift(tree):
     assert any(f.file == "rlo_tpu/utils/metrics.py" for f in hits), hits
 
 
+def test_r2_fires_on_phase_key_drift(tree):
+    """Profiler-schema parity: dropping a phase from
+    ENGINE_PHASE_KEYS breaks the tuple <-> rlo_phase_stats field-order
+    pin (docs/DESIGN.md §10)."""
+    mutate(
+        tree, "rlo_tpu/utils/metrics.py",
+        '"frame_encode", "frame_decode", "send", "arq_scan", '
+        '"tag_dispatch",',
+        '"frame_encode", "frame_decode", "send", "tag_dispatch",')
+    hits = findings_for(tree, "R2")
+    # anchored at the tuple assignment: keys != C struct field order
+    assert any(f.file == "rlo_tpu/utils/metrics.py" and
+               "rlo_phase_stats" in f.msg for f in hits), hits
+    # the engine's phase literal now disagrees with the shrunk tuple
+    assert any(f.file == "rlo_tpu/engine.py" and
+               "assembles phases" in f.msg for f in hits), hits
+
+
+def test_r2_fires_on_phobs_key_typo(tree):
+    """A _phobs() observation into a key the snapshot never emits is
+    silent schema drift — and a runtime KeyError — R2 catches it
+    statically."""
+    line = mutate(tree, "rlo_tpu/engine.py",
+                  'self._phobs("arq_scan", t0)',
+                  'self._phobs("arq_scanz", t0)')
+    hits = findings_for(tree, "R2")
+    assert any(f.file == "rlo_tpu/engine.py" and f.line == line and
+               "arq_scanz" in f.msg for f in hits), hits
+    # ...and the schema key it abandoned is now unobserved
+    assert any("no _phobs() observation site" in f.msg
+               for f in hits), hits
+
+
 def test_r3_fires_on_missing_binding(tree):
     mutate(tree, "rlo_tpu/native/bindings.py",
            '    sig("rlo_engine_set_fanout", C.c_int, [p, C.c_int])\n',

@@ -1,0 +1,194 @@
+"""perf-gate + benchmark-suite contract (docs/DESIGN.md §10).
+
+Unit half: synthetic baseline/fresh documents drive every comparison
+rule (exact / factor / rel / abs, both directions, informational
+metrics, structural drift) and the rlo-lint-style 0/1/2 exit codes.
+
+Integration half: the committed benchmark scripts produce gateable
+documents — the sim scaling curve reproduces its own exact metrics
+from a fresh run (tier-1 at --quick; the full n=1024 sweep against
+the committed BENCH_sim.json baseline rides the `slow` marker).
+"""
+
+import copy
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from rlo_tpu.tools.perf_gate import (GateError, compare_metric, main,
+                                     run_gate)
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+
+
+def doc(**metrics):
+    return {"suite": "engine_bench", "schema": 1, "quick": True,
+            "config": {"payload": 256},
+            "metrics": copy.deepcopy(metrics)}
+
+
+def m(value, direction="higher", tolerance=None):
+    return {"value": value, "direction": direction,
+            "tolerance": tolerance}
+
+
+class TestCompareRules:
+    def test_exact_pass_and_fail(self):
+        base = m(4.125, "exact")
+        assert compare_metric("x", base, 4.125) is None
+        msg = compare_metric("x", base, 4.25)
+        assert msg and "seed-deterministic" in msg
+
+    def test_factor_higher_better(self):
+        base = m(1000.0, "higher", {"factor": 5.0})
+        assert compare_metric("x", base, 201.0) is None
+        assert compare_metric("x", base, 5000.0) is None  # improvement
+        assert compare_metric("x", base, 199.0) is not None
+
+    def test_factor_lower_better(self):
+        base = m(100.0, "lower", {"factor": 5.0})
+        assert compare_metric("x", base, 499.0) is None
+        assert compare_metric("x", base, 1.0) is None  # improvement
+        assert compare_metric("x", base, 501.0) is not None
+
+    def test_rel_and_abs(self):
+        assert compare_metric("x", m(100.0, "higher", {"rel": 0.1}),
+                              91.0) is None
+        assert compare_metric("x", m(100.0, "higher", {"rel": 0.1}),
+                              89.0) is not None
+        assert compare_metric("x", m(100.0, "lower", {"abs": 7.0}),
+                              106.0) is None
+        assert compare_metric("x", m(100.0, "lower", {"abs": 7.0}),
+                              108.0) is not None
+
+    def test_informational_never_fails(self):
+        assert compare_metric("x", m(100.0, "higher", None),
+                              0.001) is None
+
+    def test_unknown_direction_is_a_finding(self):
+        msg = compare_metric("x", m(100.0, "Higher", {"factor": 2.0}),
+                             100.0)
+        assert msg and "unknown direction" in msg
+
+
+class TestRunGate:
+    def test_clean_run(self):
+        base = doc(a=m(100.0, "higher", {"factor": 2.0}),
+                   b=m(3.0, "exact"))
+        fresh = doc(a=m(60.0), b=m(3.0))
+        assert run_gate(base, fresh) == []
+
+    def test_regression_found(self):
+        base = doc(a=m(100.0, "higher", {"factor": 2.0}))
+        fresh = doc(a=m(40.0))
+        findings = run_gate(base, fresh)
+        assert len(findings) == 1 and "a:" in findings[0]
+
+    def test_missing_metric_is_a_finding_both_directions(self):
+        base = doc(a=m(100.0, "higher", {"factor": 2.0}))
+        fresh = doc(b=m(1.0))
+        findings = run_gate(base, fresh)
+        assert len(findings) == 2
+        assert "missing from the fresh run" in findings[0]
+        # fresh-only metrics would run ungated — also a finding
+        assert "absent from the baseline" in findings[1]
+
+    def test_malformed_fresh_metric_is_an_error(self):
+        base = doc(a=m(1.0, "exact"))
+        broken = doc()
+        broken["metrics"]["a"] = {}
+        with pytest.raises(GateError):
+            run_gate(base, broken)
+
+    def test_suite_and_config_mismatch_are_errors(self):
+        base = doc(a=m(1.0, "exact"))
+        other = doc(a=m(1.0, "exact"))
+        other["suite"] = "sim_bench"
+        with pytest.raises(GateError):
+            run_gate(base, other)
+        other = doc(a=m(1.0, "exact"))
+        other["config"] = {"payload": 999}
+        with pytest.raises(GateError):
+            run_gate(base, other)
+
+
+class TestCliExitCodes:
+    def _write(self, tmp_path, name, document):
+        p = tmp_path / name
+        p.write_text(json.dumps(document))
+        return str(p)
+
+    def test_exit_0_1_2(self, tmp_path, capsys):
+        base = self._write(tmp_path, "base.json",
+                           doc(a=m(100.0, "higher", {"factor": 2.0}),
+                               b=m(4.0, "exact")))
+        good = self._write(tmp_path, "good.json",
+                           doc(a=m(90.0), b=m(4.0)))
+        bad = self._write(tmp_path, "bad.json",
+                          doc(a=m(10.0), b=m(4.0)))
+        drifted = self._write(
+            tmp_path, "drifted.json",
+            {**doc(a=m(90.0), b=m(4.0)), "suite": "sim_bench"})
+        assert main(["--baseline", base, "--fresh", good]) == 0
+        assert main(["--baseline", base, "--fresh", bad]) == 1
+        out = capsys.readouterr().out
+        assert "a:" in out and "regression" in out
+        assert main(["--baseline", base, "--fresh", drifted]) == 2
+        assert main(["--baseline", base,
+                     "--fresh", str(tmp_path / "nope.json")]) == 2
+        # exact drift is a finding (exit 1), not an error
+        exact_drift = self._write(tmp_path, "exact.json",
+                                  doc(a=m(90.0), b=m(4.5)))
+        assert main(["--baseline", base, "--fresh", exact_drift]) == 1
+
+
+class TestBenchIntegration:
+    def test_sim_bench_quick_reproduces_itself(self, tmp_path):
+        """Two --quick sim_bench runs gate clean against each other:
+        the virtual-time scaling metrics are seed-exact end to end
+        (produce -> JSON -> gate)."""
+        outs = []
+        for name in ("a.json", "b.json"):
+            out = tmp_path / name
+            proc = subprocess.run(
+                [sys.executable, "benchmarks/sim_bench.py", "--quick",
+                 "--out", str(out)],
+                capture_output=True, text=True, cwd=REPO_ROOT,
+                timeout=240)
+            assert proc.returncode == 0, proc.stderr
+            outs.append(out)
+        rc = main(["--baseline", str(outs[0]), "--fresh", str(outs[1])])
+        assert rc == 0
+        d = json.loads(outs[0].read_text())
+        assert d["suite"] == "sim_bench"
+        # the curve covers the documented quick sizes with exact vtime
+        assert any(k.startswith("fanout.n256.") for k in d["metrics"])
+
+    @pytest.mark.slow
+    def test_full_sweep_gates_against_committed_baseline(self, tmp_path):
+        """The full n=1024 scaling sweep reproduces the committed
+        BENCH_sim.json exactly (the check.sh gate, run from tier-1's
+        slow lane)."""
+        out = tmp_path / "sim_full.json"
+        proc = subprocess.run(
+            [sys.executable, "benchmarks/sim_bench.py", "--out",
+             str(out)],
+            capture_output=True, text=True, cwd=REPO_ROOT, timeout=600)
+        assert proc.returncode == 0, proc.stderr
+        rc = main(["--baseline", str(REPO_ROOT / "BENCH_sim.json"),
+                   "--fresh", str(out)])
+        assert rc == 0
+
+    def test_committed_baselines_are_wellformed(self):
+        """The committed BENCH_engine.json / BENCH_sim.json parse and
+        carry gateable tolerance specs (every metric has a direction;
+        exact metrics exist so protocol drift is actually pinned)."""
+        for name in ("BENCH_engine.json", "BENCH_sim.json"):
+            d = json.loads((REPO_ROOT / name).read_text())
+            assert d["metrics"], name
+            dirs = {v["direction"] for v in d["metrics"].values()}
+            assert dirs <= {"higher", "lower", "exact"}
+            assert "exact" in dirs, f"{name} pins nothing exactly"

@@ -1,0 +1,255 @@
+"""In-engine phase profiler (docs/DESIGN.md §10).
+
+Covers the three contracts the performance observatory rests on:
+
+  1. cross-engine schema parity — ProgressEngine.metrics()["phases"]
+     and NativeEngine.metrics()["phases"] emit the IDENTICAL nested
+     schema (the ENGINE_PHASE_KEYS order mirrored by rlo_phase_stats),
+     with matching deterministic counts for the per-op phases;
+  2. the disabled-path overhead contract — off by default, zero
+     collection while off, and a (generously bounded) wall-clock smoke
+     showing the disabled run does not cost more than the enabled one;
+  3. timeline integration — Ev.PHASE samples render as validated
+     Chrome duration slices alongside the PR-2 flow edges.
+"""
+
+import time
+
+import pytest
+
+from rlo_tpu.engine import EngineManager, ProgressEngine, drain
+from rlo_tpu.transport.loopback import LoopbackWorld
+from rlo_tpu.utils.metrics import ENGINE_PHASE_KEYS, HIST_BUCKETS
+from rlo_tpu.utils.tracing import TRACER, Ev
+
+WS = 4
+
+
+def _drive_python(profiler: bool):
+    world = LoopbackWorld(WS, latency=2, seed=7)
+    mgr = EngineManager()
+    engines = [ProgressEngine(world.transport(r), manager=mgr,
+                              arq_rto=0.05) for r in range(WS)]
+    if profiler:
+        for e in engines:
+            e.enable_profiler()
+    for r in range(WS):
+        engines[r].bcast(f"m{r}".encode())
+    drain([world], engines)
+    for e in engines:
+        while e.pickup_next() is not None:
+            pass
+    if engines[1].submit_proposal(b"prop", pid=11) == -1:
+        drain([world], engines)
+        assert engines[1].vote_my_proposal() in (0, 1)
+    for e in engines:
+        while e.pickup_next() is not None:
+            pass
+    snaps = [e.metrics() for e in engines]
+    for e in engines:
+        e.cleanup()
+    return snaps
+
+
+def _drive_native(profiler: bool):
+    from rlo_tpu.native.bindings import NativeEngine, NativeWorld
+
+    with NativeWorld(WS, latency=2, seed=7) as world:
+        engines = [NativeEngine(world, r) for r in range(WS)]
+        for e in engines:
+            e.enable_arq(50_000)
+            if profiler:
+                e.enable_profiler()
+        for r in range(WS):
+            engines[r].bcast(f"m{r}".encode())
+        world.drain()
+        for e in engines:
+            while e.pickup_next() is not None:
+                pass
+        if engines[1].submit_proposal(b"prop", pid=11) == -1:
+            world.drain()
+            assert engines[1].vote_my_proposal() in (0, 1)
+        for e in engines:
+            while e.pickup_next() is not None:
+                pass
+        return [e.metrics() for e in engines]
+
+
+def _schema(snap_phases):
+    """(phase key -> histogram field names) — the structural shape."""
+    return {k: sorted(v) for k, v in snap_phases.items()}
+
+
+class TestSchemaParity:
+    def test_python_and_native_phase_schema_identical(self):
+        """The profiler twin of
+        test_python_and_native_report_identical_metrics: same scenario,
+        both engines, identical phase keys, histogram layout, and
+        deterministic per-op counts."""
+        py = _drive_python(profiler=True)
+        nat = _drive_native(profiler=True)
+        for r in range(WS):
+            pp, np_ = py[r]["phases"], nat[r]["phases"]
+            assert tuple(pp) == tuple(np_) == ENGINE_PHASE_KEYS
+            assert _schema(pp) == _schema(np_)
+            for k in ENGINE_PHASE_KEYS:
+                assert len(pp[k]["buckets"]) == HIST_BUCKETS
+                assert len(np_[k]["buckets"]) == HIST_BUCKETS
+            # per-op phases are scenario-deterministic: each rank
+            # initiated exactly one broadcast, so both timers fired
+            # exactly once on both engines
+            assert pp["bcast_all_delivered"]["count"] == 1
+            assert np_["bcast_all_delivered"]["count"] == 1
+            assert pp["bcast_first_fwd"]["count"] == 1
+            assert np_["bcast_first_fwd"]["count"] == 1
+            # hot-path stages saw real traffic on both engines
+            for k in ("frame_decode", "send", "tag_dispatch",
+                      "pickup_drain", "arq_scan"):
+                assert pp[k]["count"] > 0, k
+                assert np_[k]["count"] > 0, k
+        # the proposer resolved its round: both proposal phases fired
+        assert py[1]["phases"]["prop_votes_aggregated"]["count"] == 1
+        assert nat[1]["phases"]["prop_votes_aggregated"]["count"] == 1
+        assert py[1]["phases"]["prop_decision"]["count"] == 1
+        assert nat[1]["phases"]["prop_decision"]["count"] == 1
+
+    def test_disabled_phases_identical_across_engines(self):
+        """Profiler off (the default): both engines report the same
+        all-zero phase block — one schema, not two."""
+        py = _drive_python(profiler=False)
+        nat = _drive_native(profiler=False)
+        for r in range(WS):
+            assert py[r]["phases"] == nat[r]["phases"]
+            assert all(h["count"] == 0
+                       for h in py[r]["phases"].values())
+
+
+class TestDisabledPath:
+    def test_off_by_default_and_toggleable(self):
+        world = LoopbackWorld(2)
+        mgr = EngineManager()
+        engines = [ProgressEngine(world.transport(r), manager=mgr)
+                   for r in range(2)]
+        engines[0].bcast(b"a")
+        drain([world], engines)
+        assert all(h["count"] == 0
+                   for h in engines[0].metrics()["phases"].values())
+        engines[0].enable_profiler()
+        engines[0].bcast(b"b")
+        drain([world], engines)
+        on_counts = {k: h["count"] for k, h in
+                     engines[0].metrics()["phases"].items()}
+        assert on_counts["bcast_all_delivered"] == 1
+        assert on_counts["send"] >= 1
+        engines[0].enable_profiler(False)
+        engines[0].bcast(b"c")
+        drain([world], engines)
+        assert {k: h["count"] for k, h in
+                engines[0].metrics()["phases"].items()} == on_counts
+        for e in engines:
+            e.cleanup()
+
+    def test_disabled_overhead_smoke(self):
+        """The §10 overhead contract, coarsely: the profiler-off run
+        of an identical workload must not be slower than the
+        profiler-on run beyond generous noise bounds (off does
+        strictly less work per event)."""
+        def run(profiler: bool) -> float:
+            world = LoopbackWorld(2, latency=0, seed=1)
+            mgr = EngineManager()
+            engines = [ProgressEngine(world.transport(r), manager=mgr)
+                       for r in range(2)]
+            if profiler:
+                for e in engines:
+                    e.enable_profiler()
+            t0 = time.perf_counter()
+            for _ in range(150):
+                engines[0].bcast(b"x" * 64)
+                drain([world], engines)
+                while engines[1].pickup_next() is not None:
+                    pass
+            dt = time.perf_counter() - t0
+            for e in engines:
+                e.cleanup()
+            return dt
+
+        run(False)  # warm caches
+        off, on = run(False), run(True)
+        assert off <= on * 1.5 + 0.5, (off, on)
+
+
+class TestTimeline:
+    def test_phase_samples_render_as_duration_slices(self):
+        from rlo_tpu.utils.timeline import (PHASE_NAMES, merge_timeline,
+                                            validate_chrome_trace)
+
+        world = LoopbackWorld(2, latency=1, seed=3)
+        mgr = EngineManager()
+        engines = [ProgressEngine(world.transport(r), manager=mgr)
+                   for r in range(2)]
+        for e in engines:
+            e.enable_profiler()
+        TRACER.clear()
+        with TRACER.enable():
+            engines[0].bcast(b"slice me")
+            drain([world], engines)
+            while engines[1].pickup_next() is not None:
+                pass
+        phase_evs = TRACER.events(Ev.PHASE)
+        assert phase_evs, "no Ev.PHASE samples emitted"
+        assert all(0 <= e.a < len(ENGINE_PHASE_KEYS) for e in phase_evs)
+        assert all(e.b >= 0 for e in phase_evs)
+        trace = merge_timeline([[e.to_dict() for e in TRACER.events()]])
+        validate_chrome_trace(trace)
+        slices = [ev for ev in trace["traceEvents"]
+                  if ev.get("cat") == "phase"]
+        assert slices
+        names = {ev["name"] for ev in slices}
+        assert names <= set(PHASE_NAMES)
+        assert all(ev["dur"] >= 1 for ev in slices)
+        TRACER.clear()
+        for e in engines:
+            e.cleanup()
+
+    def test_native_phase_events_drain_with_names(self):
+        from rlo_tpu.native import bindings
+
+        bindings.trace_clear()
+        bindings.trace_set(True)
+        try:
+            with bindings.NativeWorld(2) as world:
+                engines = [bindings.NativeEngine(world, r)
+                           for r in range(2)]
+                for e in engines:
+                    e.enable_profiler()
+                engines[0].bcast(b"native slice")
+                world.drain()
+                while engines[1].pickup_next() is not None:
+                    pass
+                evs = bindings.trace_drain()
+        finally:
+            bindings.trace_set(False)
+            bindings.trace_clear()
+        phases = [e for e in evs if e["kind"] == "PHASE"]
+        assert phases, "C engine emitted no PHASE events"
+        assert all(0 <= e["a"] < len(ENGINE_PHASE_KEYS)
+                   for e in phases)
+
+
+class TestRegistrySurface:
+    def test_histogram_percentile_helpers(self):
+        from rlo_tpu.utils.metrics import Histogram, hist_summary
+
+        h = Histogram()
+        assert h.p50() is None and h.summary()["p99"] is None
+        for v in [1] * 90 + [1000] * 10:
+            h.observe(v)
+        assert h.p50() == 2.0
+        assert h.p90() == 2.0
+        assert h.p99() == 1024.0
+        s = h.summary()
+        assert s["count"] == 100
+        assert s["mean"] == pytest.approx((90 + 10 * 1000) / 100)
+        assert s["min"] == 1 and s["max"] == 1000
+        assert s == hist_summary(h.snapshot())
+        assert "buckets" not in s
