@@ -1,0 +1,157 @@
+"""Serving-fabric benchmark — BENCH_fabric.json (docs/DESIGN.md §11).
+
+Runs the rootless serving fabric (rlo_tpu/serving) over the
+deterministic simulator with the stub backend and records, per leg:
+
+  - **drain_vtime**: virtual time from the first client arrival to
+    every accepted request completed at every live rank — admission
+    broadcast, IAR placement, decode rounds, and (in the failover leg)
+    failure detection + re-queue all included. Seed-exact, so the gate
+    compares at ZERO tolerance: a protocol change that adds a hop or
+    slows fail-over moves this number and fails mechanically.
+  - **events**: total simulator schedule length — the fabric's
+    message cost. Seed-exact.
+  - **requeues / e2e_mean_usec**: fail-over work and the fleet
+    end-to-end latency rollup (virtual usec) — seed-exact.
+  - **wall_events_per_sec**: host throughput, informational.
+
+Legs: ``steady4`` (4 ranks, no faults), ``failover4`` (4 ranks, the
+warm-up owner killed mid-decode), ``steady8`` (8 ranks). Output schema
+shared with engine_bench/sim_bench, consumed by
+``rlo_tpu.tools.perf_gate`` (check.sh gates against the committed
+BENCH_fabric.json).
+
+Usage:
+    python benchmarks/fabric_bench.py --out BENCH_fabric.json
+    python benchmarks/fabric_bench.py --quick   # smaller leg set
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+from random import Random
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+
+def exact(value):
+    return {"value": value, "direction": "exact", "tolerance": None}
+
+
+def info(value):
+    return {"value": value, "direction": "higher", "tolerance": None}
+
+
+def run_leg(n: int, n_req: int, seed: int, kill_at=None,
+            decode_interval: float = 0.5, limit: float = 600.0):
+    """One fabric run to drain: returns (drain vtime, events,
+    requeues, fleet e2e mean usec, wall seconds)."""
+    import logging
+    logging.getLogger("rlo_tpu").setLevel(logging.ERROR)
+    from rlo_tpu.engine import EngineManager, ProgressEngine
+    from rlo_tpu.serving.backend import StubBackend
+    from rlo_tpu.serving.fabric import DecodeFabric, fleet_stats
+    from rlo_tpu.serving.scenario import FABRIC_ENGINE_KW
+    from rlo_tpu.transport.sim import SimWorld
+
+    world = SimWorld(n, seed=seed, protocol_only=True)
+    mgr = EngineManager()
+    engines = [ProgressEngine(world.transport(r), manager=mgr,
+                              clock=world.clock, **FABRIC_ENGINE_KW)
+               for r in range(n)]
+    fabrics = [DecodeFabric(engines[r], StubBackend(n_slots=2),
+                            decode_interval=decode_interval)
+               for r in range(n)]
+    rng = Random(seed * 9_176_867 + 5)
+    victim = 0 if kill_at is not None else None
+    gateways = [r for r in range(n) if r != victim]
+    # client arrivals spread over the first 12 vtime units
+    arrivals = sorted(
+        (round(rng.uniform(1.0, 12.0), 3), rng.choice(gateways))
+        for _ in range(n_req))
+    submitted = []
+    live = set(range(n))
+    killed = False
+    ai = 0
+    t_first = arrivals[0][0]
+    t_wall = time.perf_counter()
+    drain_at = None
+    while world.now < limit:
+        while ai < len(arrivals) and arrivals[ai][0] <= world.now:
+            t, g = arrivals[ai]
+            ai += 1
+            plen = rng.randrange(3, 10)
+            prompt = tuple(rng.randrange(1, 1 << 15)
+                           for _ in range(plen))
+            rid = fabrics[g].submit(prompt, rng.randrange(6, 30))
+            submitted.append(rid)
+        if kill_at is not None and not killed and \
+                world.now >= kill_at:
+            killed = True
+            world.kill_rank(victim)
+            engines[victim].cleanup()
+            live.discard(victim)
+        world.step()
+        mgr.progress_all()
+        for r in sorted(live):
+            fabrics[r].pump()
+        if ai == len(arrivals) and (kill_at is None or killed):
+            if all(rid in fabrics[r].done
+                   for r in live for rid in submitted):
+                drain_at = world.now
+                break
+    wall = time.perf_counter() - t_wall
+    if drain_at is None:
+        raise RuntimeError(
+            f"fabric leg (n={n}, kill={kill_at}) did not drain by "
+            f"vtime {limit}")
+    fl = fleet_stats([fabrics[r] for r in sorted(live)])
+    requeues = sum(fabrics[r].requeues for r in live)
+    e2e_mean = fl["e2e_usec"]["mean"] or 0.0
+    return (drain_at - t_first, world.events, requeues,
+            e2e_mean, wall)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--quick", action="store_true",
+                    help="drop the 8-rank leg (unit-test config)")
+    ap.add_argument("--out", help="write benchmark JSON here")
+    args = ap.parse_args(argv)
+
+    metrics = {}
+    legs = [("steady4", dict(n=4, n_req=16, seed=0)),
+            ("failover4", dict(n=4, n_req=16, seed=0, kill_at=8.0))]
+    if not args.quick:
+        legs.append(("steady8", dict(n=8, n_req=32, seed=0)))
+    for name, kw in legs:
+        vt, events, requeues, e2e, wall = run_leg(**kw)
+        print(f"{name}: drain {vt:.2f} vtime, {events} events, "
+              f"{requeues} requeues, e2e mean {e2e/1e6:.2f} vsec, "
+              f"wall {wall:.2f}s", file=sys.stderr)
+        metrics[f"{name}.drain_vtime"] = exact(round(vt, 9))
+        metrics[f"{name}.events"] = exact(events)
+        metrics[f"{name}.requeues"] = exact(requeues)
+        metrics[f"{name}.e2e_mean_usec"] = exact(round(e2e, 3))
+        metrics[f"{name}.wall_events_per_sec"] = info(
+            round(events / wall, 1) if wall > 0 else 0.0)
+
+    doc = {"suite": "fabric_bench",
+           "config": {"quick": bool(args.quick)},
+           "metrics": metrics}
+    text = json.dumps(doc, indent=1, sort_keys=True)
+    if args.out:
+        with open(args.out, "w") as fh:
+            fh.write(text + "\n")
+    else:
+        print(text)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -19,7 +19,23 @@ Two readings, both printed:
     the efficiency ratio is what wall-clock converges to. The
     recorded vs_baseline is the efficiency ratio for that reason.
 
+The ``--arrivals poisson`` leg (pre-work for ROADMAP item 2) replaces
+the closed-loop submit-everything-up-front workload with an OPEN-loop
+production mix: per-round Poisson arrivals of a bimodal
+short-interactive / long-batch request distribution, measuring
+sustained tokens/s and occupancy under load rather than batch-drain
+latency. Arrival times are measured in decode ROUNDS (the scheduler's
+own clock), so the scheduling metrics — occupancy, rounds,
+slot-step efficiency, end-to-end latency in rounds — are
+seed-deterministic and gate at ZERO tolerance through
+``rlo_tpu.tools.perf_gate`` (committed baseline BENCH_serve.json);
+wall tokens/s is recorded informationally. No eos is used, so decode
+lengths are budget-fixed and the exact metrics are machine- and
+model-output-independent.
+
 Usage: python benchmarks/serve_bench.py [--tiny] [--n-req N]
+       python benchmarks/serve_bench.py --tiny --arrivals poisson \
+           --out BENCH_serve.json
 """
 
 import argparse
@@ -41,12 +57,113 @@ from rlo_tpu.models.transformer import (TransformerConfig,  # noqa: E402
                                         init_params)
 
 
+def exact(value):
+    return {"value": value, "direction": "exact", "tolerance": None}
+
+
+def info(value):
+    return {"value": value, "direction": "higher", "tolerance": None}
+
+
+def poisson_leg(params, cfg, *, tiny, n_req, slots, round_len,
+                max_len, buckets, rate, seed):
+    """Open-loop Poisson arrival mix: per-round arrival counts drawn
+    Poisson(rate), bimodal prompt/budget distribution (70% short
+    interactive, 30% long batch). Returns a perf_gate benchmark
+    document; the scheduling metrics are functions of the seed alone
+    (no eos => budget-fixed decode lengths), the tokens/s is wall."""
+    from rlo_tpu.utils.metrics import Registry
+
+    rng = np.random.default_rng(seed)
+    reqs = []
+    for _ in range(n_req):
+        if rng.random() < 0.7:  # short interactive
+            plen = int(rng.integers(3, 9))
+            budget = int(rng.integers(4, 13))
+        else:                   # long batch
+            plen = int(rng.integers(8, min(15, buckets[-1] + 1)))
+            budget = int(rng.integers(24, min(41, max_len - plen)))
+        reqs.append((rng.integers(0, cfg.vocab, (plen,)), budget))
+    # arrival round of each request: cumulative Poisson per round
+    arrival, rnd = [], 0
+    while len(arrival) < n_req:
+        k = int(rng.poisson(rate))
+        arrival.extend([rnd] * min(k, n_req - len(arrival)))
+        rnd += 1
+
+    reg = Registry()
+    srv = DecodeServer(params, cfg, n_slots=slots, max_len=max_len,
+                       round_len=round_len, prompt_buckets=buckets,
+                       metrics=reg)
+    submit_round = {}
+    e2e_rounds = []
+    submitted = 0
+    round_idx = 0
+    t0 = time.perf_counter()
+    while submitted < n_req or srv.has_work():
+        while submitted < n_req and arrival[submitted] <= round_idx:
+            p, m = reqs[submitted]
+            rid = srv.submit(p, m)
+            submit_round[rid] = round_idx
+            submitted += 1
+        if not srv.has_work():
+            # open-loop idle gap: fast-forward to the next arrival
+            round_idx = arrival[submitted]
+            continue
+        srv.step_round()
+        for rid, _toks in srv.poll_completed():
+            e2e_rounds.append(round_idx - submit_round[rid])
+        round_idx += 1
+    wall = time.perf_counter() - t0
+    useful = sum(m for _, m in reqs)
+    occ = reg.histogram("serve.occupancy_pct")
+    occ_mean = occ.sum / occ.count if occ.count else 0.0
+    e2e_rounds.sort()
+    p50 = e2e_rounds[len(e2e_rounds) // 2]
+    p99 = e2e_rounds[min(len(e2e_rounds) - 1,
+                         (len(e2e_rounds) * 99) // 100)]
+    print(f"poisson mix: {n_req} reqs, rate {rate}/round, "
+          f"{srv.rounds_run} rounds, occupancy {occ_mean:.1f}%, "
+          f"e2e p50/p99 {p50}/{p99} rounds, "
+          f"{useful/wall:,.0f} tok/s wall", file=sys.stderr)
+    return {
+        "suite": "serve_bench",
+        "config": {"tiny": tiny, "arrivals": "poisson",
+                   "n_req": n_req, "slots": slots,
+                   "round_len": round_len, "rate": rate,
+                   "seed": seed},
+        "metrics": {
+            # seed-deterministic scheduling numbers: gate exact
+            "poisson.rounds": exact(srv.rounds_run),
+            "poisson.useful_tokens": exact(useful),
+            "poisson.occupancy_mean_pct": exact(round(occ_mean, 6)),
+            "poisson.slot_step_efficiency": exact(
+                round(useful / (srv.steps_run * slots), 6)),
+            "poisson.e2e_rounds_p50": exact(p50),
+            "poisson.e2e_rounds_p99": exact(p99),
+            # wall throughput: machine-dependent, informational
+            "poisson.sustained_tokens_per_sec": info(
+                round(useful / wall, 1)),
+        },
+    }
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--tiny", action="store_true")
     ap.add_argument("--n-req", type=int, default=32)
     ap.add_argument("--slots", type=int, default=8)
     ap.add_argument("--round-len", type=int, default=32)
+    ap.add_argument("--arrivals", choices=("batch", "poisson"),
+                    default="batch",
+                    help="batch: the closed-loop continuous-vs-naive "
+                         "comparison; poisson: the open-loop "
+                         "production arrival mix (perf_gate schema)")
+    ap.add_argument("--rate", type=float, default=1.5,
+                    help="poisson: mean arrivals per decode round")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--out", help="poisson: write the benchmark JSON "
+                                  "here instead of stdout")
     args = ap.parse_args()
 
     if args.tiny:
@@ -63,6 +180,20 @@ def main():
                                                256, (64,))
 
     params = init_params(jax.random.PRNGKey(0), cfg)
+
+    if args.arrivals == "poisson":
+        doc = poisson_leg(params, cfg, tiny=args.tiny, n_req=n_req,
+                          slots=slots, round_len=round_len,
+                          max_len=max_len, buckets=buckets,
+                          rate=args.rate, seed=args.seed)
+        text = json.dumps(doc, indent=1, sort_keys=True)
+        if args.out:
+            with open(args.out, "w") as fh:
+                fh.write(text + "\n")
+        else:
+            print(text)
+        return
+
     rng = np.random.default_rng(7)
     reqs = [(rng.integers(0, cfg.vocab, (int(rng.integers(*plen_rng)),)),
              int(rng.integers(*bud_rng))) for _ in range(n_req)]

@@ -62,13 +62,16 @@ echo "== observability smoke (loopback soak -> chrome timeline) =="
 # (flow edges included) — docs/DESIGN.md §7
 JAX_PLATFORMS=cpu python -m rlo_tpu.utils.timeline smoke
 
-echo "== simulator fuzz sweep (25 seeds x 4 chaos scripts) =="
+echo "== simulator fuzz sweep (25 seeds x 7 chaos scripts) =="
 # fixed-seed deterministic sweep over the partition/restart/burst-loss/
 # mixed scenario scripts — exactly-once, termination, and membership
-# convergence checked per run; a violation prints the seed + a replay
-# recipe (docs/DESIGN.md §8). The C engine runs the same shapes via
-# the native loopback fault hooks inside pytest
-# (tests/test_membership.py); the long 500-run sweep is
+# convergence checked per run — PLUS the serving-fabric shapes
+# (fabric_kill/fabric_split/fabric_rejoin, docs/DESIGN.md §11):
+# exactly-once request completion with oracle-identical tokens,
+# re-admission after heal, and placement convergence. A violation
+# prints the seed + a replay recipe (docs/DESIGN.md §8). The C engine
+# runs the same protocol shapes via the native loopback fault hooks
+# inside pytest (tests/test_membership.py); the long 500-run sweep is
 # `pytest tests/test_sim.py -m slow`.
 JAX_PLATFORMS=cpu python -m rlo_tpu.transport.sim --seeds 25
 
@@ -93,6 +96,29 @@ JAX_PLATFORMS=cpu python benchmarks/sim_bench.py \
 JAX_PLATFORMS=cpu python -m rlo_tpu.tools.perf_gate \
     --baseline BENCH_sim.json --fresh "$fresh_sim"
 rm -f "$fresh_sim"
+
+echo "== serving-fabric bench + perf gate (BENCH_fabric.json) =="
+# 4/8-rank fabric legs in the deterministic simulator: drain vtime,
+# schedule events, fail-over requeues and fleet e2e latency are all
+# seed-exact and gate at zero tolerance — a protocol change that adds
+# a hop or slows fail-over fails mechanically (docs/DESIGN.md §11)
+fresh_fabric=$(mktemp -t rlo_bench_fabric.XXXXXX)
+JAX_PLATFORMS=cpu python benchmarks/fabric_bench.py \
+    --out "$fresh_fabric" > /dev/null
+JAX_PLATFORMS=cpu python -m rlo_tpu.tools.perf_gate \
+    --baseline BENCH_fabric.json --fresh "$fresh_fabric"
+rm -f "$fresh_fabric"
+
+echo "== serve bench arrival mix + perf gate (BENCH_serve.json) =="
+# open-loop Poisson production mix on the tiny model: the scheduling
+# metrics (rounds, occupancy, slot-step efficiency, e2e-in-rounds)
+# are seed-deterministic and gate exact; wall tok/s is informational
+fresh_serve=$(mktemp -t rlo_bench_serve.XXXXXX)
+JAX_PLATFORMS=cpu python benchmarks/serve_bench.py --tiny \
+    --arrivals poisson --out "$fresh_serve"
+JAX_PLATFORMS=cpu python -m rlo_tpu.tools.perf_gate \
+    --baseline BENCH_serve.json --fresh "$fresh_serve"
+rm -f "$fresh_serve"
 
 echo "== manual-ring validation (8 virtual devices) =="
 PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \

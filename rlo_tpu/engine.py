@@ -69,6 +69,12 @@ MEMBER_MAGIC = b"RLOJ\x01"
 #: second decision's admission is an idempotent no-op.
 MEMBER_PID_BASE = -2
 
+#: Tags a user may hand to ``send_direct``: delivered via the
+#: ``_on_other`` pickup route at the destination, never interpreted by
+#: the engine (Tag.SERVE is the serving fabric's load-report channel,
+#: docs/DESIGN.md §11).
+DIRECT_TAGS = frozenset({Tag.SERVE, Tag.P2P, Tag.DATA, Tag.SYS})
+
 #: Incarnation-partitioned sequence spaces: a restarted rank's fresh
 #: broadcast seqs and round generations start at ``incarnation << 20``,
 #: above anything its previous life can have used, so peers' per-origin
@@ -1035,6 +1041,57 @@ class ProgressEngine:
         if self.my_own_proposal.state != ReqState.COMPLETED:
             return -1
         return self.my_own_proposal.vote
+
+    # ------------------------------------------------------------------
+    # Fabric-facing surface (docs/DESIGN.md §11): post-construction
+    # callback wiring, reliable point-to-point user frames, and the
+    # rejoin-state probe the serving layer gates its pump on.
+    # ------------------------------------------------------------------
+    def set_app(self, judge_cb: Optional[JudgeCb] = None,
+                action_cb: Optional[ActionCb] = None,
+                app_ctx: object = None):
+        """Swap the application callbacks after construction (the
+        serving fabric attaches to an engine the harness already
+        built). Returns the previous ``(judge_cb, action_cb,
+        app_ctx)`` triple so a layered consumer can chain to it."""
+        prev = (self.judge_cb, self.action_cb, self.app_ctx)
+        self.judge_cb = judge_cb
+        self.action_cb = action_cb
+        self.app_ctx = app_ctx
+        return prev
+
+    def send_direct(self, dst: int, payload: bytes,
+                    tag: Tag = Tag.SERVE, pid: int = -1,
+                    vote: int = -1) -> SendHandle:
+        """Reliable point-to-point user frame: goes through the normal
+        send gate (link-epoch stamp; ARQ seq + retransmit-until-acked
+        when ARQ is on) and is delivered at the destination via
+        ``pickup_next`` (the ``_on_other`` route). Only user-routable
+        tags are accepted — engine-internal tags would corrupt
+        protocol state at the receiver."""
+        if Tag(tag) not in DIRECT_TAGS:
+            raise ValueError(
+                f"tag {Tag(tag).name} is engine-internal; direct sends "
+                f"allow {sorted(t.name for t in DIRECT_TAGS)}")
+        if len(payload) > self.msg_size_max:
+            raise ValueError(
+                f"payload {len(payload)}B exceeds msg_size_max "
+                f"{self.msg_size_max}B")
+        if not 0 <= dst < self.world_size or dst == self.rank:
+            raise ValueError(f"bad destination rank {dst}")
+        h = self._send_raw(dst, int(tag),
+                           Frame(origin=self.rank, pid=pid, vote=vote,
+                                 payload=payload).encode())
+        self.manager.progress_all()
+        return h
+
+    @property
+    def mid_rejoin(self) -> bool:
+        """True while this engine is a joiner awaiting its
+        JOIN_WELCOME (it quarantines all non-membership traffic and
+        its peers quarantine its frames — docs/DESIGN.md §8); the
+        serving fabric suspends its pump until admission."""
+        return self._awaiting_welcome
 
     # ------------------------------------------------------------------
     # Delivery (~RLO_user_pickup_next / RLO_user_msg_recycle,

@@ -78,10 +78,12 @@ class DecodeServer:
     (default: the process-wide ``metrics.SERVING`` registry, shared
     with ``generate_timed``): TTFT (submit -> first token,
     ``serve.ttft_usec``), admission-queue wait
-    (``serve.queue_wait_usec``), per-round and per-token decode
-    latency (``serve.round_usec`` / ``serve.tok_usec``), batch
-    occupancy per round (``serve.occupancy_pct``), request/token
-    counters, and live queue-depth gauges. ``stats()`` snapshots it.
+    (``serve.queue_wait_usec``), per-request end-to-end latency
+    (submit -> last token, ``serve.e2e_usec``), per-round and
+    per-token decode latency (``serve.round_usec`` /
+    ``serve.tok_usec``), batch occupancy per round
+    (``serve.occupancy_pct``), request/token counters, and live
+    queue-depth gauges. ``stats()`` snapshots it.
     """
 
     def __init__(self, params, cfg: TransformerConfig, *,
@@ -109,6 +111,14 @@ class DecodeServer:
         self._out: List[Optional[List[int]]] = []
         self._eos: List[Optional[int]] = []
         self._submit_ts: dict = {}  # rid -> submit time (perf_counter)
+        # rid -> submit time, RETAINED until completion (the e2e
+        # latency stamp; _submit_ts is popped at admission for the
+        # queue-wait/TTFT numbers)
+        self._accept_ts: dict = {}
+        self._canceled: set = set()
+        # newly completed (rid, tokens) pairs awaiting poll_completed()
+        # — the serving fabric's incremental face (docs/DESIGN.md §11)
+        self._completed_log: List[Tuple[int, np.ndarray]] = []
         self.rounds_run = 0
         self.steps_run = 0
 
@@ -168,7 +178,9 @@ class DecodeServer:
         self._queue.append((rid, Request(prompt, max_new, eos_id)))
         self._out.append(None)
         self._eos.append(eos_id)
-        self._submit_ts[rid] = time.perf_counter()
+        now = time.perf_counter()
+        self._submit_ts[rid] = now
+        self._accept_ts[rid] = now
         self.metrics.counter("serve.requests_submitted").inc()
         self.metrics.gauge("serve.queue_depth").set(len(self._queue))
         return rid
@@ -229,6 +241,70 @@ class DecodeServer:
         if self.budget[slot] <= 0:
             self.req_of_slot[slot] = None
             self.metrics.counter("serve.requests_completed").inc()
+            self._completed_log.append(
+                (rid, np.asarray(self._out[rid], np.int32)))
+            t_sub = self._accept_ts.pop(rid, None)
+            if t_sub is not None:
+                # end-to-end latency: submit -> last token, queue wait
+                # and every decode round included (the fail-over-aware
+                # fleet twin is fabric.e2e_usec, docs/DESIGN.md §11)
+                self.metrics.histogram("serve.e2e_usec").observe(
+                    (time.perf_counter() - t_sub) * 1e6)
+
+    # ---- fabric-facing hooks (docs/DESIGN.md §11) --------------------
+    def poll_completed(self) -> List[Tuple[int, np.ndarray]]:
+        """Drain the (rid, tokens) pairs completed since the last
+        poll — the incremental completion face the serving fabric
+        consumes round by round (``run()`` remains the drive-to-empty
+        batch face)."""
+        out, self._completed_log = self._completed_log, []
+        return out
+
+    def cancel(self, rid: int) -> bool:
+        """Withdraw a request: de-queue it, or free its slot mid-
+        decode (the fabric's re-queue/ownership-move hook). Returns
+        False when the rid is unknown or already completed. A canceled
+        request's ``run()`` output is its partial prefix — the caller
+        owns whatever exactly-once story spans the re-queue (the
+        fabric dedups by its own request id)."""
+        if not 0 <= rid < len(self._out) or rid in self._canceled:
+            return False
+        for i, (qrid, _) in enumerate(self._queue):
+            if qrid == rid:
+                del self._queue[i]
+                self._canceled.add(rid)
+                self._submit_ts.pop(rid, None)
+                self._accept_ts.pop(rid, None)
+                self.metrics.counter("serve.requests_canceled").inc()
+                self.metrics.gauge("serve.queue_depth").set(
+                    len(self._queue))
+                return True
+        for slot in range(self.n_slots):
+            if self.req_of_slot[slot] == rid:
+                self.req_of_slot[slot] = None
+                self.budget[slot] = 0
+                self._canceled.add(rid)
+                self._accept_ts.pop(rid, None)
+                self.metrics.counter("serve.requests_canceled").inc()
+                return True
+        return False
+
+    def has_work(self) -> bool:
+        """Queued or in-flight requests remain."""
+        return bool(self._queue) or any(
+            r is not None for r in self.req_of_slot)
+
+    def free_slots(self) -> int:
+        return sum(1 for r in self.req_of_slot if r is None)
+
+    def queue_depth(self) -> int:
+        return len(self._queue)
+
+    def slot_ownership(self) -> Tuple[Optional[int], ...]:
+        """Which rid occupies each slot (None = free) — the
+        slot-ownership view the fabric's placement records reason
+        about."""
+        return tuple(self.req_of_slot)
 
     # ---- the decode loop --------------------------------------------
     def step_round(self):
@@ -281,7 +357,9 @@ class DecodeServer:
             progressed = self.step_round()
             if not progressed and self._queue:  # pragma: no cover
                 raise RuntimeError("queue stuck with no free slots")
-        return [np.asarray(o, np.int32) for o in self._out]
+        # a request canceled before admission never produced tokens
+        return [np.asarray(o if o is not None else [], np.int32)
+                for o in self._out]
 
     def stats(self) -> dict:
         """Serving-telemetry snapshot: counters and gauges verbatim,

@@ -63,6 +63,11 @@ enum rlo_tag {
                              * incarnation echo, n) + n member ranks;
                              * followed by a point-to-point replay of
                              * the recent-broadcast log */
+    RLO_TAG_SERVE = 17,     /* serving-fabric point-to-point frame
+                             * (load reports, docs/DESIGN.md S11):
+                             * ARQ-stamped, epoch-gated, delivered
+                             * straight to pickup.
+                             * rlo-lint: default-route */
 };
 
 /* ---- request/proposal states (reference RLO_Req_stat) ---- */

@@ -1,0 +1,37 @@
+"""Rootless elastic serving fabric (docs/DESIGN.md §11, API.md round
+11): a multi-rank DecodeServer tier scheduled by the paper's own
+primitives — rootless-broadcast admission, IAR-consensus placement,
+failure-machinery fail-over with exactly-once re-queue.
+
+Import surface:
+
+  - ``DecodeFabric`` / ``fleet_stats`` — the per-rank fabric node and
+    the fleet telemetry rollup (``fabric.py``);
+  - ``Placement`` / ``rendezvous_owner`` / ``owner_of`` /
+    ``pick_owner`` — the consensus-decided routing records
+    (``placement.py``);
+  - ``StubBackend`` / ``ModelBackend`` / ``stub_tokens`` — decode
+    backends (``backend.py``; ModelBackend adapts the real
+    ``models.serve.DecodeServer`` and imports jax lazily);
+  - ``FabricScenario`` / ``make_fabric_scenario`` /
+    ``FABRIC_SCENARIO_KINDS`` — deterministic-simulator scenarios
+    (``scenario.py``), also reachable through
+    ``transport.sim.make_scenario`` / ``fuzz_sweep``.
+"""
+
+from rlo_tpu.serving.backend import (ModelBackend, StubBackend,
+                                     stub_tokens)
+from rlo_tpu.serving.fabric import (FABRIC_MAGIC, FABRIC_PID_BASE,
+                                    DecodeFabric, Rec, fleet_stats)
+from rlo_tpu.serving.placement import (Placement, owner_of,
+                                       pick_owner, rendezvous_owner)
+from rlo_tpu.serving.scenario import (FABRIC_SCENARIO_KINDS,
+                                      FabricScenario,
+                                      make_fabric_scenario)
+
+__all__ = [
+    "DecodeFabric", "fleet_stats", "FABRIC_MAGIC", "FABRIC_PID_BASE",
+    "Rec", "Placement", "owner_of", "pick_owner", "rendezvous_owner",
+    "ModelBackend", "StubBackend", "stub_tokens", "FabricScenario",
+    "make_fabric_scenario", "FABRIC_SCENARIO_KINDS",
+]

@@ -1,0 +1,171 @@
+"""Decode backends for the serving fabric (docs/DESIGN.md §11).
+
+The fabric is generic over the thing that actually decodes: any object
+with the slot-server face
+
+    submit(key, prompt, max_new, eos_id=None) -> None
+    step_round() -> [(key, tokens_tuple), ...]   # newly completed
+    cancel(key) -> bool
+    has_work() -> bool
+    load() -> (free_slots, queue_depth)
+    stats() -> dict
+
+Two implementations:
+
+  - ``ModelBackend`` adapts the real ``models.serve.DecodeServer``
+    (continuous batching over a jitted slot pool) — the production
+    face. Requires jax; imported lazily so the simulator sweeps stay
+    dependency-free.
+  - ``StubBackend`` is the deterministic, model-free twin the
+    simulator scenarios and benchmarks run: tokens are a pure
+    function of the prompt (a crc32 chain), which is exactly the
+    property a replicated-weights fleet has under greedy decoding —
+    ANY rank re-decoding a re-queued request emits identical tokens.
+    This is what makes the exactly-once-with-identical-tokens fabric
+    property seed-checkable without hardware.
+"""
+
+from __future__ import annotations
+
+import struct
+import zlib
+from typing import Dict, List, Optional, Sequence, Tuple
+
+
+def stub_tokens(prompt: Sequence[int], max_new: int,
+                eos_id: Optional[int] = None,
+                vocab: int = 32768) -> Tuple[int, ...]:
+    """The stub model's greedy decode: a crc32 chain seeded by the
+    prompt. Deterministic in the prompt alone — independent of which
+    rank decodes, of batching, and of restarts — mirroring greedy
+    decode over replicated weights."""
+    prompt = tuple(int(t) for t in prompt)
+    state = zlib.crc32(struct.pack(f"<{len(prompt)}i", *prompt))
+    out: List[int] = []
+    for i in range(max_new):
+        state = zlib.crc32(struct.pack("<i", i), state)
+        tok = state % vocab
+        out.append(tok)
+        if eos_id is not None and tok == eos_id:
+            break
+    return tuple(out)
+
+
+class StubBackend:
+    """Slot-pool scheduler with the stub model: ``n_slots`` concurrent
+    requests, ``round_len`` tokens per request per ``step_round()``,
+    FIFO admission from the queue — the same scheduling shape as
+    ``DecodeServer`` with the jit replaced by ``stub_tokens``."""
+
+    def __init__(self, n_slots: int = 4, round_len: int = 8,
+                 vocab: int = 32768):
+        self.n_slots = n_slots
+        self.round_len = round_len
+        self.vocab = vocab
+        self._queue: List = []         # keys awaiting a slot
+        self._req: Dict = {}           # key -> (tokens, emitted_count)
+        self._active: List = []        # keys holding a slot
+        self.rounds_run = 0
+        self.tokens_out = 0
+
+    def submit(self, key, prompt: Sequence[int], max_new: int,
+               eos_id: Optional[int] = None) -> None:
+        if key in self._req:
+            return
+        self._req[key] = [stub_tokens(prompt, max_new, eos_id,
+                                      self.vocab), 0]
+        self._queue.append(key)
+
+    def cancel(self, key) -> bool:
+        if key not in self._req:
+            return False
+        del self._req[key]
+        if key in self._queue:
+            self._queue.remove(key)
+        if key in self._active:
+            self._active.remove(key)
+        return True
+
+    def step_round(self) -> List[Tuple[object, Tuple[int, ...]]]:
+        while self._queue and len(self._active) < self.n_slots:
+            self._active.append(self._queue.pop(0))
+        done: List[Tuple[object, Tuple[int, ...]]] = []
+        for key in list(self._active):
+            toks, emitted = self._req[key]
+            emitted = min(emitted + self.round_len, len(toks))
+            self.tokens_out += emitted - self._req[key][1]
+            self._req[key][1] = emitted
+            if emitted >= len(toks):
+                done.append((key, toks))
+                self._active.remove(key)
+                del self._req[key]
+        self.rounds_run += 1
+        return done
+
+    def has_work(self) -> bool:
+        return bool(self._req)
+
+    def load(self) -> Tuple[int, int]:
+        return (self.n_slots - len(self._active), len(self._queue))
+
+    def stats(self) -> dict:
+        return {"backend": "stub", "n_slots": self.n_slots,
+                "round_len": self.round_len,
+                "rounds_run": self.rounds_run,
+                "tokens_out": self.tokens_out,
+                "active": len(self._active),
+                "queued": len(self._queue)}
+
+
+class ModelBackend:
+    """The real continuous-batching ``DecodeServer`` behind the
+    backend face: fabric request keys map to server rids, completions
+    drain through the server's ``poll_completed()`` hook, and
+    ownership moves translate to ``cancel()`` (the re-queued request
+    restarts from the prompt on its new owner — greedy decode over
+    replicated weights makes the re-decode token-identical)."""
+
+    def __init__(self, server):
+        import numpy as np  # lazy: the sim sweeps never pay for jax
+        self._np = np
+        self.server = server
+        self._rid_of: Dict = {}   # fabric key -> server rid
+        self._key_of: Dict = {}   # server rid -> fabric key
+
+    def submit(self, key, prompt: Sequence[int], max_new: int,
+               eos_id: Optional[int] = None) -> None:
+        if key in self._rid_of:
+            return
+        rid = self.server.submit(
+            self._np.asarray(list(prompt), self._np.int32), max_new,
+            eos_id=eos_id)
+        self._rid_of[key] = rid
+        self._key_of[rid] = key
+
+    def cancel(self, key) -> bool:
+        rid = self._rid_of.pop(key, None)
+        if rid is None:
+            return False
+        self._key_of.pop(rid, None)
+        return self.server.cancel(rid)
+
+    def step_round(self) -> List[Tuple[object, Tuple[int, ...]]]:
+        if self.server.has_work():
+            self.server.step_round()
+        out = []
+        for rid, toks in self.server.poll_completed():
+            key = self._key_of.pop(rid, None)
+            if key is None:
+                continue  # canceled while the round ran
+            self._rid_of.pop(key, None)
+            out.append((key, tuple(int(t) for t in toks)))
+        return out
+
+    def has_work(self) -> bool:
+        return self.server.has_work()
+
+    def load(self) -> Tuple[int, int]:
+        return (self.server.free_slots(), self.server.queue_depth())
+
+    def stats(self) -> dict:
+        return {"backend": "decode_server", **self.server.stats()}

@@ -1,0 +1,521 @@
+"""Rootless elastic serving fabric (docs/DESIGN.md §11).
+
+N ranks each run a ``DecodeFabric`` over one ``ProgressEngine`` and a
+decode backend, and coordinate ENTIRELY through the paper's own
+primitives — no scheduler rank, no root, no global synchronization:
+
+  - **admission**: whichever rank a client reaches (the *gateway*)
+    assigns a globally-unique request id ``(gateway, seq)`` and
+    rootlessly broadcasts an ADMIT record; every member learns every
+    accepted request, so any survivor can take over any of them.
+  - **placement/routing**: slot-ownership records are decided by IAR
+    consensus (``placement.Placement``) — the paper's protocol doing
+    production scheduling. Admit-time owners come from the gateway's
+    gossiped load view (Tag.SERVE reports); fail-over owners from
+    rendezvous hashing over the agreed members.
+  - **fail-over**: a killed or partitioned owner is detected by the
+    PR-1/PR-3 machinery (heartbeats, ARQ give-up, epochs); the
+    survivors agree on a new placement and the deterministic
+    re-placement rule re-queues the orphaned requests, each on exactly
+    one survivor.
+  - **exactly-once completion**: DONE records (the decoded tokens)
+    broadcast to every member and dedup by request id — the first
+    completion wins everywhere, re-decodes after ownership races are
+    counted (``fabric.dup_decodes``), never delivered twice. Re-admission
+    after a heal or rejoin re-broadcasts pending ADMITs and recent
+    DONEs; the rid-level dedup absorbs every copy (the broadcast
+    layer's own (origin, seq) dedup absorbs transport-level copies
+    below it).
+
+The fabric is clock-injectable (it takes the engine's clock) and free
+of wall-clock and module randomness, so whole fleets replay
+bit-for-bit inside the deterministic simulator — rlo-lint R5 enforces
+this for ``serving/`` exactly as it does for the engine.
+"""
+
+from __future__ import annotations
+
+import enum
+import struct
+from collections import deque
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from rlo_tpu.engine import (INCARNATION_SHIFT, ProgressEngine, ReqState,
+                            UserMsg)
+from rlo_tpu.serving.placement import (Placement, owner_of, pick_owner)
+from rlo_tpu.utils.metrics import HIST_BUCKETS, Registry, hist_summary
+from rlo_tpu.wire import Tag
+
+#: Prefix marking a payload as a serving-fabric record (the serving
+#: analogue of the engine's MEMBER_MAGIC): ADMIT/DONE ride Tag.BCAST,
+#: LOAD rides Tag.SERVE, PLACE rides IAR proposal/decision payloads.
+FABRIC_MAGIC = b"RLOF\x01"
+
+#: Placement rounds use pid = FABRIC_PID_BASE + proposer rank: unique
+#: per concurrent proposer (IAR forbids concurrent same-pid rounds),
+#: reused across sequential rounds (the generation disambiguates), and
+#: far above any test/app pid space.
+FABRIC_PID_BASE = 1 << 20
+
+#: request id: (gateway rank, gateway-local seq). Seqs are partitioned
+#: by the gateway engine's incarnation (base = incarnation << 20,
+#: mirroring the engine's own seq spaces) so a restarted gateway can
+#: never reissue a dead life's rid.
+Rid = Tuple[int, int]
+
+
+class Rec(enum.IntEnum):
+    """Fabric record kinds, dispatched in ``DecodeFabric._on_record``.
+    rlo-lint R4 requires every member to be explicitly dispatched
+    there (or annotated ``rlo-lint: default-route``) — the fabric twin
+    of the engine's Tag-dispatch exhaustiveness rule."""
+    ADMIT = 1   # gateway accepted a request: rid, owner, budget, prompt
+    DONE = 2    # owner finished a request: rid, decoder, tokens
+    PLACE = 3   # slot-ownership record (IAR payload; also re-floodable)
+    LOAD = 4    # Tag.SERVE gossip: (free_slots, queue_depth)
+
+
+class _FabReq:
+    """One admitted request as every member tracks it."""
+    __slots__ = ("prompt", "max_new", "eos_id", "gateway", "owner",
+                 "t_admit")
+
+    def __init__(self, prompt: Tuple[int, ...], max_new: int,
+                 eos_id: int, gateway: int, owner: int,
+                 t_admit: float):
+        self.prompt = prompt
+        self.max_new = max_new
+        self.eos_id = eos_id
+        self.gateway = gateway
+        self.owner = owner
+        self.t_admit = t_admit
+
+
+def _enc_admit(rid: Rid, owner: int, max_new: int, eos_id: int,
+               prompt: Sequence[int]) -> bytes:
+    p = tuple(int(t) for t in prompt)
+    return (FABRIC_MAGIC + bytes([Rec.ADMIT]) +
+            struct.pack(f"<iiiii{len(p)}i", rid[0], rid[1], owner,
+                        max_new, eos_id, *p))
+
+
+def _enc_done(rid: Rid, decoder: int,
+              tokens: Sequence[int]) -> bytes:
+    t = tuple(int(x) for x in tokens)
+    return (FABRIC_MAGIC + bytes([Rec.DONE]) +
+            struct.pack(f"<iii{len(t)}i", rid[0], rid[1], decoder, *t))
+
+
+def _enc_place(place: Placement) -> bytes:
+    return FABRIC_MAGIC + bytes([Rec.PLACE]) + place.encode()
+
+
+def _enc_load(free: int, depth: int) -> bytes:
+    return (FABRIC_MAGIC + bytes([Rec.LOAD]) +
+            struct.pack("<ii", free, depth))
+
+
+class DecodeFabric:
+    """One rank's serving-fabric node: an engine endpoint plus a
+    decode backend, driven by ``pump()`` from the harness/server loop
+    (the same cooperative-polling inversion as the engine itself).
+
+    ``decode_interval`` paces backend rounds on the ENGINE's clock
+    (virtual time in the simulator), ``load_interval`` paces the
+    Tag.SERVE load gossip, ``place_retry`` paces placement-round
+    retries while the agreed record trails the membership view.
+    """
+
+    def __init__(self, engine: ProgressEngine, backend, *,
+                 decode_interval: float = 0.25,
+                 load_interval: float = 1.0,
+                 place_retry: float = 2.0,
+                 metrics: Optional[Registry] = None):
+        self.engine = engine
+        self.backend = backend
+        self.rank = engine.rank
+        self.clock = engine.clock
+        self.decode_interval = decode_interval
+        self.load_interval = load_interval
+        self.place_retry = place_retry
+        self.metrics = Registry() if metrics is None else metrics
+
+        #: PENDING requests only — entries are evicted at completion
+        #: (the prompt is dead weight once decoded), so every per-pump
+        #: scan (_reconcile, the gauge) is O(in-flight work), not
+        #: O(requests ever served)
+        self.requests: Dict[Rid, _FabReq] = {}
+        #: rid -> completed tokens; retained for result() reads and
+        #: rid-level dedup (bounding this is a client-protocol
+        #: question — see the §11 known-bounds note)
+        self.done: Dict[Rid, Tuple[int, ...]] = {}
+        self.done_by: Dict[Rid, int] = {}
+        #: client-visible exactly-once completion log (rid, in the
+        #: order completions were accepted here)
+        self.completions: List[Rid] = []
+        self.requeues = 0
+        self.dup_done = 0
+        self._local: set = set()    # rids submitted to my backend
+        self._next_seq = engine.incarnation << INCARNATION_SHIFT
+        self._loads: Dict[int, Tuple[int, int]] = {}
+        self._recent_done: deque = deque(maxlen=64)
+        self._last_view = tuple(sorted(engine.group))
+        self._next_decode = float("-inf")
+        self._next_load = float("-inf")
+        self._next_place = float("-inf")
+        self._my_place_pid = FABRIC_PID_BASE + self.rank
+        self._proposed: Optional[Placement] = None
+        #: the agreed slot-ownership record; construction-time members
+        #: (identical everywhere) seed it, IAR rounds replace it
+        self.placement = Placement(
+            version=0, proposer=-1,
+            members=tuple(sorted(engine.group)))
+        # take over the engine's app surface; chain non-fabric
+        # payloads to whatever was wired before
+        self._prev_app = engine.set_app(judge_cb=self._judge,
+                                        action_cb=self._action)
+
+    # ------------------------------------------------------------------
+    # client face
+    # ------------------------------------------------------------------
+    def submit(self, prompt: Sequence[int], max_new: int,
+               eos_id: Optional[int] = None) -> Rid:
+        """Accept a request at this gateway: assign the rid, pick the
+        admit-time owner from the load view, apply locally, and
+        rootlessly broadcast the ADMIT record to the fleet."""
+        rid: Rid = (self.rank, self._next_seq)
+        self._next_seq += 1
+        owner = pick_owner(self.rank, self.placement.members,
+                           self._loads)
+        eos = -1 if eos_id is None else int(eos_id)
+        self._apply_admit(rid, owner, int(max_new), eos,
+                          tuple(int(t) for t in prompt))
+        self.engine.bcast(_enc_admit(rid, owner, int(max_new), eos,
+                                     prompt))
+        return rid
+
+    def result(self, rid: Rid) -> Optional[Tuple[int, ...]]:
+        """Completed tokens for ``rid``, or None while pending."""
+        return self.done.get(rid)
+
+    def pending(self) -> List[Rid]:
+        return list(self.requests)
+
+    # ------------------------------------------------------------------
+    # IAR face: placement rounds (docs/DESIGN.md §11)
+    # ------------------------------------------------------------------
+    def _judge(self, payload: bytes, ctx) -> int:
+        if payload.startswith(FABRIC_MAGIC):
+            if len(payload) <= len(FABRIC_MAGIC) or \
+                    payload[len(FABRIC_MAGIC)] != Rec.PLACE:
+                return 0
+            place = Placement.decode(payload, len(FABRIC_MAGIC) + 1)
+            if place is None:
+                return 0
+            # veto a record that disagrees with MY membership view —
+            # the consensus only adopts routing the whole (converged)
+            # fleet can execute; a vetoed round retries after the
+            # views converge
+            return 1 if set(place.members) == set(self.engine.group) \
+                else 0
+        prev_judge = self._prev_app[0]
+        if prev_judge is None:
+            return 1
+        return prev_judge(payload, self._prev_app[2])
+
+    def _action(self, payload: bytes, ctx):
+        if payload.startswith(FABRIC_MAGIC):
+            place = Placement.decode(payload, len(FABRIC_MAGIC) + 1)
+            if place is not None:
+                self._adopt_place(place)
+            return None
+        prev_action = self._prev_app[1]
+        if prev_action is None:
+            return None
+        return prev_action(payload, self._prev_app[2])
+
+    def _adopt_place(self, place: Placement) -> None:
+        """Newest-wins adoption ((version, proposer) order): stale
+        records re-flooded out of replaced views can never regress
+        routing; equal-key records are byte-identical by construction
+        (a proposer's epoch moves with every view change)."""
+        if place.key() <= self.placement.key():
+            return
+        self.placement = place
+        self.metrics.counter("fabric.placements_adopted").inc()
+        self.metrics.gauge("fabric.placement_version").set(
+            place.version)
+
+    def _propose_place(self, members: Tuple[int, ...]) -> None:
+        place = Placement(version=self.engine.epoch,
+                          proposer=self.rank, members=members)
+        self._proposed = place
+        self.metrics.counter("fabric.placements_proposed").inc()
+        self.engine.submit_proposal(_enc_place(place),
+                                    pid=self._my_place_pid)
+
+    # ------------------------------------------------------------------
+    # the pump (the fabric's progress turn)
+    # ------------------------------------------------------------------
+    def pump(self) -> List[UserMsg]:
+        """One fabric turn: drain engine pickups, reconcile placement
+        and ownership, run a decode round and the load gossip when
+        due. Returns pickups that were not fabric records (the
+        embedding application's traffic). No-op while the engine is
+        mid-rejoin — a joiner's frames are quarantined fleet-wide, so
+        acting on stale local state would only waste decode work."""
+        eng = self.engine
+        if eng.mid_rejoin:
+            return []
+        unhandled: List[UserMsg] = []
+        while (m := eng.pickup_next()) is not None:
+            if m.type in (int(Tag.BCAST), int(Tag.SERVE)) and \
+                    m.data.startswith(FABRIC_MAGIC):
+                self._on_record(m.data, m.origin)
+            elif m.type in (int(Tag.IAR_DECISION), int(Tag.ABORT)) \
+                    and FABRIC_PID_BASE <= m.pid < \
+                    FABRIC_PID_BASE + eng.world_size:
+                # placement-round outcome: _action already adopted the
+                # decision (an abort just frees the pid for the retry
+                # the staleness check below schedules)
+                continue
+            else:
+                # everything else — the embedding app's traffic,
+                # INCLUDING Tag.FAILURE/foreign-abort notices (the
+                # fabric reacts off the engine's adopted view, but the
+                # app may be watching rank deaths through pickup)
+                unhandled.append(m)
+
+        # proposer-side adoption: the engine fires action_cb on relays
+        # only; the proposer adopts its own approved record here
+        p = eng.my_own_proposal
+        if self._proposed is not None and \
+                p.pid == self._my_place_pid and \
+                p.state != ReqState.IN_PROGRESS:
+            if p.state == ReqState.COMPLETED and p.vote:
+                self._adopt_place(self._proposed)
+            self._proposed = None  # declined/failed: retried below
+
+        now = self.clock()
+        view = tuple(sorted(eng.group))
+        if view != self._last_view:
+            grown = set(view) - set(self._last_view)
+            self._last_view = view
+            if grown:
+                # heal/admission re-sync: re-broadcast what the new
+                # members may have missed; rid-level dedup absorbs
+                # every duplicate (docs/DESIGN.md §11 exactly-once)
+                self._rebroadcast()
+        if set(self.placement.members) != set(view) or \
+                self.placement.version < eng.epoch:
+            # the agreed routing record trails the membership view —
+            # wrong members, or decided before the latest view change
+            # (the version-vs-epoch check is what re-converges a
+            # rejoined rank whose fresh construction-time record
+            # happens to name the right members): the lowest-ranked
+            # member petitions a new record through IAR (anyone
+            # could; one proposer avoids N identical concurrent
+            # rounds)
+            if self.rank == min(view) and now >= self._next_place \
+                    and p.state != ReqState.IN_PROGRESS:
+                self._next_place = now + self.place_retry
+                self._propose_place(view)
+
+        self._reconcile()
+
+        if now >= self._next_decode and self.backend.has_work():
+            self._next_decode = now + self.decode_interval
+            for rid, toks in self.backend.step_round():
+                self._local.discard(rid)
+                if rid in self.done:
+                    # completed elsewhere while my round ran (an
+                    # ownership race across a heal): genuinely
+                    # duplicated decode work; the first completion
+                    # won, never re-broadcast
+                    self.dup_done += 1
+                    self.metrics.counter("fabric.dup_decodes").inc()
+                else:
+                    self._complete(rid, toks)
+        if now >= self._next_load:
+            self._next_load = now + self.load_interval
+            free, depth = self.backend.load()
+            self._loads[self.rank] = (free, depth)
+            raw = _enc_load(free, depth)
+            for dst in view:
+                if dst != self.rank:
+                    eng.send_direct(dst, raw)
+        self.metrics.gauge("fabric.pending").set(len(self.requests))
+        return unhandled
+
+    # ------------------------------------------------------------------
+    # record handling
+    # ------------------------------------------------------------------
+    def _on_record(self, data: bytes, origin: int) -> None:
+        kind = data[len(FABRIC_MAGIC)]
+        body = data[len(FABRIC_MAGIC) + 1:]
+        if kind == Rec.ADMIT:
+            self._on_admit(body, origin)
+        elif kind == Rec.DONE:
+            self._on_done(body)
+        elif kind == Rec.PLACE:
+            # an in-band placement record (e.g. a future re-flood
+            # path): newest-wins adoption is idempotent
+            place = Placement.decode(body)
+            if place is not None:
+                self._adopt_place(place)
+        elif kind == Rec.LOAD:
+            if len(body) >= 8:
+                self._loads[origin] = struct.unpack_from("<ii", body)
+        else:
+            self.metrics.counter("fabric.unknown_records").inc()
+
+    def _on_admit(self, body: bytes, origin: int) -> None:
+        if len(body) < 20:
+            return
+        g, s, owner, max_new, eos = struct.unpack_from("<iiiii", body)
+        n = (len(body) - 20) // 4
+        prompt = struct.unpack_from(f"<{n}i", body, 20)
+        rid: Rid = (g, s)
+        if rid in self.done:
+            # a re-admission of a completed request (the admitter
+            # missed the DONE): answer with the completion directly
+            if origin != self.rank and origin in self.engine.group:
+                self.engine.send_direct(
+                    origin, _enc_done(rid, self.done_by.get(rid, -1),
+                                      self.done[rid]))
+            return
+        if rid in self.requests:
+            return  # duplicate admission: rid-level exactly-once
+        self._apply_admit(rid, owner, max_new, eos, prompt)
+
+    def _apply_admit(self, rid: Rid, owner: int, max_new: int,
+                     eos: int, prompt: Tuple[int, ...]) -> None:
+        self.requests[rid] = _FabReq(prompt, max_new, eos, rid[0],
+                                     owner, self.clock())
+        self.metrics.counter("fabric.requests_admitted").inc()
+
+    def _on_done(self, body: bytes) -> None:
+        if len(body) < 12:
+            return
+        g, s, decoder = struct.unpack_from("<iii", body)
+        n = (len(body) - 12) // 4
+        toks = struct.unpack_from(f"<{n}i", body, 12)
+        self._record_done((g, s), decoder, toks)
+
+    def _complete(self, rid: Rid, toks: Tuple[int, ...]) -> None:
+        """My backend finished ``rid``: record + broadcast the DONE."""
+        self._record_done(rid, self.rank, toks)
+        self.engine.bcast(_enc_done(rid, self.rank, toks))
+
+    def _record_done(self, rid: Rid, decoder: int,
+                     toks: Tuple[int, ...]) -> None:
+        if rid in self.done:
+            # a DONE copy for a settled rid (heal re-broadcasts, a
+            # direct reply racing the broadcast): exactly-once means
+            # the first one won. Absorbed copies are bookkeeping, not
+            # wasted decode work — that is fabric.dup_decodes.
+            self.metrics.counter("fabric.done_copies").inc()
+            return
+        self.done[rid] = tuple(toks)
+        self.done_by[rid] = decoder
+        self.completions.append(rid)
+        self._recent_done.append(rid)
+        self.metrics.counter("fabric.requests_completed").inc()
+        req = self.requests.pop(rid, None)  # evict: decoded == done
+        if req is not None:
+            self.metrics.histogram("fabric.e2e_usec").observe(
+                (self.clock() - req.t_admit) * 1e6)
+        if rid in self._local:
+            # completed elsewhere first: stop decoding it here
+            self.backend.cancel(rid)
+            self._local.discard(rid)
+
+    # ------------------------------------------------------------------
+    # ownership reconciliation + re-sync
+    # ------------------------------------------------------------------
+    def _reconcile(self) -> None:
+        """Align my backend with the agreed placement: enqueue every
+        pending request the current record says is mine (counting the
+        ones I picked up from a departed owner — the re-queue), and
+        withdraw the ones whose ownership moved away."""
+        for rid, req in self.requests.items():
+            owner = owner_of(rid, req.owner, self.placement)
+            if owner == self.rank:
+                if rid not in self._local:
+                    if req.owner != self.rank:
+                        self.requeues += 1
+                        self.metrics.counter("fabric.requeued").inc()
+                    self.backend.submit(
+                        rid, req.prompt, req.max_new,
+                        None if req.eos_id < 0 else req.eos_id)
+                    self._local.add(rid)
+            elif rid in self._local:
+                self.backend.cancel(rid)
+                self._local.discard(rid)
+                self.metrics.counter("fabric.ownership_moved").inc()
+
+    def _rebroadcast(self) -> None:
+        """Members joined my view (heal / admission / my own rejoin):
+        re-broadcast every pending ADMIT and the recent DONE ring so
+        they converge on the request state. Dedup by rid makes every
+        copy idempotent; the cost is O(pending + ring) broadcasts per
+        view growth (documented §11 scaling note)."""
+        for rid, req in self.requests.items():
+            self.metrics.counter("fabric.readmitted").inc()
+            self.engine.bcast(_enc_admit(rid, req.owner, req.max_new,
+                                         req.eos_id, req.prompt))
+        for rid in list(self._recent_done):
+            self.engine.bcast(_enc_done(rid, self.done_by.get(rid, -1),
+                                        self.done[rid]))
+
+    # ------------------------------------------------------------------
+    # telemetry
+    # ------------------------------------------------------------------
+    def stats(self) -> dict:
+        """Per-rank fabric snapshot: counters/gauges verbatim,
+        histograms as percentile summaries (the DecodeServer.stats()
+        convention), plus placement and backend state."""
+        snap = self.metrics.snapshot()
+        snap["histograms"] = {k: hist_summary(h)
+                              for k, h in snap["histograms"].items()}
+        snap["placement"] = {"version": self.placement.version,
+                             "proposer": self.placement.proposer,
+                             "members": list(self.placement.members)}
+        snap["pending"] = len(self.pending())
+        snap["completions"] = len(self.completions)
+        snap["requeues"] = self.requeues
+        snap["dup_done"] = self.dup_done
+        snap["backend"] = self.backend.stats()
+        return snap
+
+
+def fleet_stats(fabrics: Sequence[DecodeFabric]) -> dict:
+    """Fleet-level rollup over live fabric nodes: summed counters, a
+    merged end-to-end latency summary (submit -> last token, re-queue
+    and fail-over time included — the first-class fail-over-cost
+    metric), and the per-rank snapshots."""
+    ranks = {str(f.rank): f.stats() for f in fabrics}
+    counters: Dict[str, int] = {}
+    merged = {"count": 0, "sum": 0.0, "min": 0.0, "max": 0.0,
+              "buckets": None}
+    for f in fabrics:
+        snap = f.metrics.snapshot()
+        for k, v in snap["counters"].items():
+            counters[k] = counters.get(k, 0) + v
+        h = snap["histograms"].get("fabric.e2e_usec")
+        if h and h["count"]:
+            if merged["count"] == 0:
+                merged["min"], merged["max"] = h["min"], h["max"]
+                merged["buckets"] = list(h["buckets"])
+            else:
+                merged["min"] = min(merged["min"], h["min"])
+                merged["max"] = max(merged["max"], h["max"])
+                for i, b in enumerate(h["buckets"]):
+                    merged["buckets"][i] += b
+            merged["count"] += h["count"]
+            merged["sum"] += h["sum"]
+    if merged["buckets"] is None:
+        merged["buckets"] = [0] * HIST_BUCKETS
+    return {"counters": counters,
+            "e2e_usec": hist_summary(merged),
+            "ranks": ranks}

@@ -1,0 +1,108 @@
+"""Placement and routing for the serving fabric (docs/DESIGN.md §11).
+
+A ``Placement`` is the fleet's agreed slot-ownership record: the set of
+serving members (and, implicitly, their KV slot pools) that request
+ownership is computed against. Records are DECIDED by the paper's own
+IAR consensus — a survivor proposes the record, every member judges it
+against its own membership view, and the AND-merged decision makes it
+authoritative — so routing changes are agreed by the same rootless
+protocol that agrees on membership itself (the fabric's whole point).
+
+Routing is two-layered, both layers deterministic:
+
+  - admit-time: the gateway that accepted the request picks the owner
+    from its (gossiped) load view — least-loaded wins — and embeds the
+    choice in the ADMIT record, so every rank agrees on the owner
+    without any extra coordination;
+  - re-placement: when the admit-time owner leaves the member set, the
+    owner is recomputed by rendezvous (highest-random-weight) hashing
+    of the request id over the CURRENT placement members — a pure
+    function, so every survivor independently agrees on who re-queues
+    the orphan without a per-request consensus round.
+
+All hashing is ``zlib.crc32`` (process-stable); ``hash()`` is salted
+per interpreter and would break cross-rank agreement.
+"""
+
+from __future__ import annotations
+
+import struct
+import zlib
+from dataclasses import dataclass
+from typing import Dict, Optional, Sequence, Tuple
+
+
+@dataclass(frozen=True)
+class Placement:
+    """One agreed slot-ownership record. ``version`` is the proposer's
+    membership epoch at proposal time; ``(version, proposer)`` totally
+    orders records (epochs converge upward across heals, rank id
+    breaks exact ties), and adoption is newest-wins so a stale record
+    re-flooded out of an old view can never regress routing."""
+    version: int
+    proposer: int
+    members: Tuple[int, ...]
+
+    def key(self) -> Tuple[int, int]:
+        return (self.version, self.proposer)
+
+    def encode(self) -> bytes:
+        m = tuple(self.members)
+        return struct.pack(f"<iii{len(m)}i", self.version,
+                           self.proposer, len(m), *m)
+
+    @classmethod
+    def decode(cls, raw: bytes, off: int = 0) -> Optional["Placement"]:
+        if len(raw) - off < 12:
+            return None
+        version, proposer, n = struct.unpack_from("<iii", raw, off)
+        if n < 0 or len(raw) - off - 12 < 4 * n:
+            return None
+        members = struct.unpack_from(f"<{n}i", raw, off + 12)
+        return cls(version, proposer, tuple(sorted(members)))
+
+
+def rendezvous_owner(gateway: int, seq: int,
+                     members: Sequence[int]) -> int:
+    """Highest-random-weight owner of request id ``(gateway, seq)``
+    over ``members`` — the deterministic re-placement rule every
+    survivor computes independently (identical inputs => identical
+    owner, no coordination)."""
+    if not members:
+        raise ValueError("rendezvous over an empty member set")
+    key = struct.pack("<ii", gateway, seq)
+    best, best_w = -1, -1
+    for m in members:
+        w = zlib.crc32(key + struct.pack("<i", m))
+        if w > best_w or (w == best_w and (best < 0 or m < best)):
+            best_w, best = w, m
+    return best
+
+
+def owner_of(rid: Tuple[int, int], admit_owner: int,
+             placement: Placement) -> int:
+    """Current owner of a request: the admit-time owner while it is
+    still a placement member (the record is authoritative — ownership
+    does not churn under load changes), else the rendezvous
+    re-placement over the current members (the fail-over rule)."""
+    if admit_owner in placement.members:
+        return admit_owner
+    return rendezvous_owner(rid[0], rid[1], placement.members)
+
+
+def pick_owner(self_rank: int, members: Sequence[int],
+               loads: Dict[int, Tuple[int, int]]) -> int:
+    """Gateway-side admit routing: the member with the most free
+    slots, then the shallowest queue, then the lowest rank (every tie
+    broken deterministically). ``loads`` maps rank -> (free_slots,
+    queue_depth) from the Tag.SERVE gossip; members with no report yet
+    rank behind reported ones with free capacity but ahead of
+    saturated ones (free=0 assumed, depth 0)."""
+    best = None
+    best_key = None
+    for m in sorted(members):
+        free, depth = loads.get(m, (0, 0))
+        key = (-free, depth, m)
+        if best_key is None or key < best_key:
+            best_key, best = key, m
+    return self_rank if best is None else best

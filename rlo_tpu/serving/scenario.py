@@ -1,0 +1,308 @@
+"""Scripted serving-fabric scenarios over the deterministic simulator.
+
+The fabric twin of ``transport.sim.Scenario`` (docs/DESIGN.md §8/§11):
+N engines + N ``DecodeFabric`` nodes (stub backend) run over one
+seeded ``SimWorld``; a script injects client traffic and faults at
+virtual times; end-of-run property checks raise ``SimViolation`` with
+the seed and a replay recipe. Same seed => byte-identical schedule =>
+identical request ids, owners, completions, and tokens.
+
+Script steps (``(t, action, *args)``):
+
+  ("submit", gateway, n)    — n client requests through that gateway
+  ("kill", r) / ("restart", r) / ("partition", groups) / ("heal",) /
+  ("loss", p)               — the Scenario fault vocabulary
+
+Properties checked at the end of ``run()`` (runs that end healed):
+
+  - **drained**: every request a live fabric knows is completed there
+    (no accepted request hangs);
+  - **exactly-once**: no live fabric's client-visible completion log
+    contains a rid twice;
+  - **identical tokens**: every completion equals the stub model's
+    oracle (``backend.stub_tokens``) — the re-queued, re-decoded,
+    re-admitted copies all produced the same tokens;
+  - **acceptance**: every request submitted through a never-disturbed
+    gateway outside partition windows is known and completed at every
+    live fabric (the fabric analogue of the clean-broadcast delivery
+    check);
+  - **placement convergence**: every live fabric holds the SAME
+    placement record, spanning exactly the live set.
+"""
+
+from __future__ import annotations
+
+from random import Random
+from typing import Dict, List, Optional, Sequence
+
+from rlo_tpu.serving.backend import StubBackend, stub_tokens
+from rlo_tpu.serving.fabric import DecodeFabric
+from rlo_tpu.transport.sim import SimViolation, SimWorld
+
+#: default engine knobs for fabric runs: the Scenario defaults with a
+#: tighter op deadline so a placement round wedged across a view
+#: change fails-and-retries quickly instead of parking the
+#: own-proposal slot for a minute of virtual time
+FABRIC_ENGINE_KW = dict(failure_timeout=6.0, heartbeat_interval=1.0,
+                        arq_rto=1.5, arq_max_retries=6,
+                        op_deadline=20.0)
+
+
+class FabricScenario:
+    """One scripted, seeded, fully deterministic N-node fabric run."""
+
+    def __init__(self, world_size: int = 4, seed: int = 0,
+                 duration: float = 240.0, script: Sequence = (),
+                 drop_p: float = 0.0, dup_p: float = 0.0,
+                 n_slots: int = 2, round_len: int = 8,
+                 decode_interval: float = 0.25,
+                 engine_kw: Optional[dict] = None,
+                 check_acceptance: bool = True):
+        self.ws = world_size
+        self.seed = seed
+        self.duration = duration
+        self.script = sorted(script, key=lambda s: s[0])
+        self.drop_p = drop_p
+        self.dup_p = dup_p
+        self.n_slots = n_slots
+        self.round_len = round_len
+        # vtime per decode round: scripts that must catch requests
+        # MID-decode (kill/partition with work in flight) stretch this
+        # so budgets span several seconds of virtual time
+        self.decode_interval = decode_interval
+        self.engine_kw = dict(FABRIC_ENGINE_KW if engine_kw is None
+                              else engine_kw)
+        self.check_acceptance = check_acceptance
+
+    def _replay_recipe(self) -> str:
+        return (f"FabricScenario(world_size={self.ws}, "
+                f"seed={self.seed}, duration={self.duration}, "
+                f"script={self.script!r}, drop_p={self.drop_p}, "
+                f"dup_p={self.dup_p}).run()")
+
+    def _fail(self, why: str):
+        raise SimViolation(
+            f"seed {self.seed}: {why}\nreplay: {self._replay_recipe()}")
+
+    def run(self) -> Dict:
+        from rlo_tpu.engine import EngineManager, ProgressEngine
+
+        world = SimWorld(self.ws, seed=self.seed, drop_p=self.drop_p,
+                         dup_p=self.dup_p)
+        mgr = EngineManager()
+        engines: List[ProgressEngine] = [
+            ProgressEngine(world.transport(r), manager=mgr,
+                           clock=world.clock, **self.engine_kw)
+            for r in range(self.ws)]
+        def make_fabric(r: int) -> DecodeFabric:
+            return DecodeFabric(
+                engines[r],
+                StubBackend(n_slots=self.n_slots,
+                            round_len=self.round_len),
+                decode_interval=self.decode_interval)
+
+        fabrics: List[DecodeFabric] = [make_fabric(r)
+                                       for r in range(self.ws)]
+        rng = Random(self.seed * 1_000_003 + 17)
+        incarnation = [0] * self.ws
+        live = set(range(self.ws))
+        ever_disturbed: set = set()
+        partitioned = False
+        ends_healed = True
+        #: rid -> (prompt, max_new, clean) for every client submission
+        submitted: Dict = {}
+        si = 0
+
+        while world.now < self.duration:
+            while si < len(self.script) and \
+                    self.script[si][0] <= world.now:
+                step = self.script[si]
+                si += 1
+                act, args = step[1], step[2:]
+                if act == "partition":
+                    world.partition(args[0])
+                    partitioned = True
+                    ends_healed = False
+                elif act == "heal":
+                    world.heal()
+                    partitioned = False
+                    ends_healed = True
+                elif act == "kill":
+                    r = args[0]
+                    world.kill_rank(r)
+                    engines[r].cleanup()
+                    live.discard(r)
+                    ever_disturbed.add(r)
+                elif act == "restart":
+                    r = args[0]
+                    if r in live:
+                        continue
+                    world.restart_rank(r)
+                    incarnation[r] += 1
+                    engines[r] = ProgressEngine(
+                        world.transport(r), manager=mgr,
+                        clock=world.clock,
+                        incarnation=incarnation[r], **self.engine_kw)
+                    fabrics[r] = make_fabric(r)
+                    live.add(r)
+                elif act == "submit":
+                    g, n = args[0], args[1]
+                    if g not in live:
+                        continue
+                    for _ in range(n):
+                        plen = rng.randrange(3, 10)
+                        prompt = tuple(rng.randrange(1, 1 << 15)
+                                       for _ in range(plen))
+                        max_new = rng.randrange(4, 24)
+                        rid = fabrics[g].submit(prompt, max_new)
+                        clean = (not partitioned and
+                                 g not in ever_disturbed)
+                        submitted[rid] = (prompt, max_new, clean)
+                elif act == "loss":
+                    world.drop_p = args[0]
+                else:
+                    raise ValueError(f"unknown script action {act!r}")
+            world.step()
+            mgr.progress_all()
+            for r in sorted(live):
+                fabrics[r].pump()
+
+        # -- property checks ------------------------------------------
+        live_fabrics = [fabrics[r] for r in sorted(live)]
+        for f in live_fabrics:
+            if len(f.completions) != len(set(f.completions)):
+                dups = [c for c in f.completions
+                        if f.completions.count(c) > 1]
+                self._fail(f"rank {f.rank} delivered duplicate "
+                           f"completions: {sorted(set(dups))[:4]}")
+        if ends_healed:
+            for f in live_fabrics:
+                hung = [rid for rid in f.requests
+                        if rid not in f.done]
+                if hung:
+                    self._fail(f"rank {f.rank} holds accepted "
+                               f"requests that never completed: "
+                               f"{hung[:4]}")
+            for f in live_fabrics:
+                for rid, toks in f.done.items():
+                    info = submitted.get(rid)
+                    if info is None:
+                        continue  # a restarted life's re-admission
+                    want = stub_tokens(info[0], info[1])
+                    if tuple(toks) != want:
+                        self._fail(
+                            f"rank {f.rank} completion for {rid} "
+                            f"diverged from the oracle: got "
+                            f"{toks[:6]}..., want {want[:6]}...")
+            if self.check_acceptance:
+                undisturbed = live - ever_disturbed
+                for rid, (_, _, clean) in submitted.items():
+                    if not clean or rid[0] not in undisturbed:
+                        continue
+                    for f in live_fabrics:
+                        if rid not in f.done:
+                            self._fail(
+                                f"rank {f.rank} never completed "
+                                f"clean-window request {rid} "
+                                f"(gateway {rid[0]})")
+            places = {f.rank: (f.placement.key(),
+                               tuple(f.placement.members))
+                      for f in live_fabrics}
+            want_members = tuple(sorted(live))
+            first = next(iter(places.values()))
+            for r, pl in places.items():
+                if pl != first or pl[1] != want_members:
+                    self._fail(f"placement diverged: {places} "
+                               f"(live {want_members})")
+        return {
+            "seed": self.seed,
+            "digest": world.schedule_digest(),
+            "events": world.events,
+            "submitted": len(submitted),
+            "completed": {f.rank: len(f.completions)
+                          for f in live_fabrics},
+            "done_tokens": {f.rank: dict(f.done)
+                            for f in live_fabrics},
+            "requeues": sum(f.requeues for f in live_fabrics),
+            "dup_done": sum(f.dup_done for f in live_fabrics),
+            "readmitted": sum(
+                f.metrics.counter("fabric.readmitted").value
+                for f in live_fabrics),
+            "rejoins": sum(engines[r].rejoins for r in live),
+            "placement_version": max(
+                (f.placement.version for f in live_fabrics),
+                default=-1),
+        }
+
+
+def make_fabric_scenario(kind: str, seed: int,
+                         world_size: int = 4) -> FabricScenario:
+    """Canned fabric chaos shapes, deterministically derived from
+    (kind, seed) — the serving rows of ``transport.sim.make_scenario``:
+
+      - 'fabric_kill':   client bursts, then a serving rank is killed
+        mid-decode; survivors re-queue its orphans exactly once;
+      - 'fabric_split':  a split-brain lands in the middle of a
+        request burst; both sides keep serving, the minority's
+        accepted requests are re-admitted after the heal without
+        duplication;
+      - 'fabric_rejoin': kill + elastic rejoin under continuous load;
+        the rejoined rank converges and takes ownership back.
+    """
+    import zlib
+    rng = Random((zlib.crc32(kind.encode()) & 0xffff) * 1_000_003
+                 + seed)
+    ws = world_size
+    half = ws // 2
+    if kind == "fabric_kill":
+        # rank 0 is the default least-loaded owner while the load
+        # gossip warms up, so killing it right after a burst reliably
+        # orphans IN-FLIGHT decodes (the re-queue path under test);
+        # the slow decode_interval keeps budgets spanning the kill
+        victim = 0
+        gw = 1 + rng.randrange(ws - 1)
+        script = (
+            [(2.0 + 1.5 * i, "submit", rng.randrange(ws), 2)
+             for i in range(4)] +
+            [(10.0, "submit", gw, 3),
+             (12.0, "kill", victim),
+             (14.0, "submit", gw, 2),
+             (40.0, "submit", 1 + rng.randrange(ws - 1), 2)])
+        return FabricScenario(world_size=ws, seed=seed, script=script,
+                              duration=150.0, decode_interval=1.0)
+    if kind == "fabric_split":
+        cut = [list(range(half)), list(range(half, ws))]
+        script = (
+            [(2.0 + 1.0 * i, "submit", rng.randrange(ws), 2)
+             for i in range(6)] +
+            [(10.0, "partition", cut),
+             (12.0, "submit", rng.randrange(half), 2),
+             (13.0, "submit", half + rng.randrange(ws - half), 2),
+             # late-minority burst: still decoding when the heal
+             # lands, so the re-admission path (pending ADMITs
+             # re-broadcast on view growth) is actually exercised
+             (57.0, "submit", half + rng.randrange(ws - half), 2),
+             (60.0, "heal"),
+             (150.0, "submit", rng.randrange(ws), 2)])
+        return FabricScenario(world_size=ws, seed=seed, script=script,
+                              duration=240.0, decode_interval=1.0,
+                              round_len=4)
+    if kind == "fabric_rejoin":
+        victim = 0  # see fabric_kill: the warm-up owner
+        gw = 1 + rng.randrange(ws - 1)
+        script = (
+            [(2.0 + 1.5 * i, "submit", rng.randrange(ws), 2)
+             for i in range(4)] +
+            [(13.0, "submit", gw, 3),
+             (15.0, "kill", victim),
+             (18.0, "submit", gw, 3),
+             (40.0, "restart", victim),
+             (120.0, "submit", gw, 2),
+             (125.0, "submit", 1 + rng.randrange(ws - 1), 2)])
+        return FabricScenario(world_size=ws, seed=seed, script=script,
+                              duration=240.0, decode_interval=1.0)
+    raise ValueError(f"unknown fabric scenario kind {kind!r}")
+
+
+FABRIC_SCENARIO_KINDS = ("fabric_kill", "fabric_split",
+                         "fabric_rejoin")

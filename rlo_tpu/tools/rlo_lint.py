@@ -37,13 +37,17 @@ Rule families (docs/DESIGN.md §9 has the full catalogue):
      rlo_engine_progress_once switch, or annotated
      ``rlo-lint: default-route`` at its definition site (wire.py for
      the Python side, rlo_core.h for the C side) with a catch-all
-     present; every guarded ReqState assignment is an allowed
-     transition; C state assignments name real enum rlo_state members.
+     present; every serving-fabric Rec record kind is explicitly
+     dispatched in DecodeFabric._on_record (or annotated likewise) —
+     docs/DESIGN.md §11; every guarded ReqState assignment is an
+     allowed transition; C state assignments name real enum rlo_state
+     members.
   R5 determinism hygiene — no wall-clock (``time.time``/``sleep``/…)
-     or module-level ``random`` calls in the engine/transport/sim code
-     paths outside the injectable ``clock``/seeded ``random.Random``
-     abstractions the deterministic simulator depends on
-     (``# rlo-lint: allow-wallclock`` suppresses a sanctioned line).
+     or module-level ``random`` calls in the engine/transport/sim or
+     serving-fabric code paths outside the injectable ``clock``/seeded
+     ``random.Random`` abstractions the deterministic simulator
+     depends on (``# rlo-lint: allow-wallclock`` suppresses a
+     sanctioned line).
 
 Anchor comments the linter understands:
 
@@ -80,11 +84,16 @@ BINDINGS_PY = "rlo_tpu/native/bindings.py"
 CORE_H = "rlo_tpu/native/rlo_core.h"
 WIRE_C = "rlo_tpu/native/rlo_wire.c"
 ENGINE_C = "rlo_tpu/native/rlo_engine.c"
+FABRIC_PY = "rlo_tpu/serving/fabric.py"
+
 #: R5 scope: the seed-deterministic code paths (engine + transports the
-#: simulator drives). Launchers, benchmarks, and observability tooling
-#: may use wall clocks freely.
+#: simulator drives, plus the serving fabric, which whole fleets replay
+#: inside the simulator — docs/DESIGN.md §11). Launchers, benchmarks,
+#: and observability tooling may use wall clocks freely.
 R5_FILES = (ENGINE_PY, "rlo_tpu/transport/base.py",
-            "rlo_tpu/transport/loopback.py", "rlo_tpu/transport/sim.py")
+            "rlo_tpu/transport/loopback.py", "rlo_tpu/transport/sim.py",
+            FABRIC_PY, "rlo_tpu/serving/placement.py",
+            "rlo_tpu/serving/backend.py", "rlo_tpu/serving/scenario.py")
 
 PAIRED_ANCHOR = "rlo-lint: paired-with"
 DEFAULT_ROUTE_ANCHOR = "rlo-lint: default-route"
@@ -988,13 +997,15 @@ ALLOWED_REQSTATE_TRANSITIONS = {
 }
 
 
-def _tag_names_in(node: ast.AST) -> Set[str]:
-    """Tag members NAMED by a dispatch comparison: `tag == Tag.X` or
-    `tag in (Tag.X, ...)` with literally-enumerated members. A
+def _tag_names_in(node: ast.AST, enum_name: str = "Tag") -> Set[str]:
+    """Enum members NAMED by a dispatch comparison: `x == Enum.X` or
+    `x in (Enum.X, ...)` with literally-enumerated members. A
     membership test against an opaque set name (`tag in
     EPOCH_EXEMPT_TAGS`) deliberately does NOT count — the guard proves
     the tag reached a block, not that the block dispatches it, so a
-    deleted handler inside the guard must still be a finding."""
+    deleted handler inside the guard must still be a finding. Used for
+    the engine's ``Tag`` dispatch and the serving fabric's ``Rec``
+    record dispatch."""
     out: Set[str] = set()
     for n in ast.walk(node):
         if not isinstance(n, ast.Compare) or len(n.ops) != 1:
@@ -1004,13 +1015,13 @@ def _tag_names_in(node: ast.AST) -> Set[str]:
         for cand in [n.comparators[0]]:
             if isinstance(cand, ast.Attribute) and \
                     isinstance(cand.value, ast.Name) and \
-                    cand.value.id == "Tag":
+                    cand.value.id == enum_name:
                 out.add(cand.attr)
             elif isinstance(cand, (ast.Tuple, ast.List, ast.Set)):
                 for e in cand.elts:
                     if isinstance(e, ast.Attribute) and \
                             isinstance(e.value, ast.Name) and \
-                            e.value.id == "Tag":
+                            e.value.id == enum_name:
                         out.add(e.attr)
     return out
 
@@ -1093,6 +1104,35 @@ def rule_r4(ctx: "LintContext") -> List[Finding]:
                     "R4", ENGINE_C, 1,
                     f"{c_name} is default-routed but the tag switch "
                     f"has no default label"))
+
+    # --- fabric record dispatch (serving/fabric.py, when present) ---
+    # New Tag values the fabric rides on are covered by the Tag loop
+    # above (SERVE is default-routed in both engines); the fabric's
+    # OWN protocol surface is its Rec record kinds, dispatched in
+    # DecodeFabric._on_record — hold them to the same exhaustiveness
+    # bar so a record kind can never silently lose its handler.
+    fab = ctx.extra_py.get(FABRIC_PY)
+    if fab is not None:
+        try:
+            rec_members = py_enum_members(fab, "Rec")
+        except LintError:
+            rec_members = {}
+        fdisp = _find_funcdef(fab.tree, "_on_record")
+        if rec_members and fdisp is None:
+            f.append(Finding(
+                "R4", fab.path, 1,
+                "_on_record (the fabric record dispatch) not found"))
+        elif rec_members:
+            fab_explicit = _tag_names_in(fdisp, enum_name="Rec")
+            for name, (_, line) in sorted(rec_members.items(),
+                                          key=lambda kv: kv[1][0]):
+                if name not in fab_explicit and \
+                        not annotated(fab.lines, line):
+                    f.append(Finding(
+                        "R4", fab.path, line,
+                        f"Rec.{name} has no branch in DecodeFabric."
+                        f"_on_record and is not annotated "
+                        f"'# {DEFAULT_ROUTE_ANCHOR}'"))
 
     # --- ReqState transitions (Python) ---
     states = set(py_enum_members(engine, "ReqState"))

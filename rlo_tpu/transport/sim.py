@@ -513,10 +513,19 @@ class Scenario:
 # Canned scripts + the fixed-seed fuzz sweep (check.sh)
 # ---------------------------------------------------------------------------
 
-def make_scenario(kind: str, seed: int, world_size: int = 4) -> Scenario:
+def make_scenario(kind: str, seed: int, world_size: int = 4):
     """One of the canned chaos shapes, deterministically derived from
     (kind, seed): 'partition' (split-brain + heal), 'restart' (kill +
-    elastic rejoin), 'burst' (loss window), 'mixed' (all of it)."""
+    elastic rejoin), 'burst' (loss window), 'mixed' (all of it).
+    Serving-fabric kinds ('fabric_kill', 'fabric_split',
+    'fabric_rejoin' — docs/DESIGN.md §11) return a ``FabricScenario``
+    with the same ``run()`` contract and property-violation
+    behavior."""
+    if kind in FABRIC_SCENARIO_KINDS:
+        # lazy: serving imports the engine (and this module); the
+        # plain protocol sweeps never pay for the fabric layer
+        from rlo_tpu.serving.scenario import make_fabric_scenario
+        return make_fabric_scenario(kind, seed, world_size)
     # zlib.crc32, NOT hash(): str hashes are salted per process and
     # would make the derived script irreproducible across runs
     import zlib
@@ -576,6 +585,14 @@ def make_scenario(kind: str, seed: int, world_size: int = 4) -> Scenario:
 
 SCENARIO_KINDS = ("partition", "restart", "burst", "mixed")
 
+#: serving-fabric scenario kinds (rlo_tpu/serving/scenario.py); listed
+#: here so the CLI sweep covers them without importing the serving
+#: layer up front
+FABRIC_SCENARIO_KINDS = ("fabric_kill", "fabric_split",
+                         "fabric_rejoin")
+
+ALL_SCENARIO_KINDS = SCENARIO_KINDS + FABRIC_SCENARIO_KINDS
+
 
 def fuzz_sweep(seeds: Sequence[int],
                kinds: Sequence[str] = SCENARIO_KINDS,
@@ -608,7 +625,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--seeds", type=int, default=25,
                     help="seeds 0..N-1 per scenario kind")
-    ap.add_argument("--kinds", default=",".join(SCENARIO_KINDS))
+    ap.add_argument("--kinds", default=",".join(ALL_SCENARIO_KINDS))
     ap.add_argument("--world-size", type=int, default=4)
     ap.add_argument("-v", "--verbose", action="store_true")
     args = ap.parse_args(argv)

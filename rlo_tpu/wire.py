@@ -77,6 +77,11 @@ class Tag(enum.IntEnum):
                      # payload = (epoch, incarnation echo, member list);
                      # followed by a point-to-point replay of the
                      # recent-broadcast log
+    SERVE = 17       # rlo-lint: default-route
+                     # serving-fabric point-to-point frame (load
+                     # reports, docs/DESIGN.md §11): reliable (ARQ-
+                     # stamped), epoch-gated, delivered via pickup —
+                     # the payload is a fabric record, not engine state
 
 
 #: Tags that are store-and-forward broadcast over the skip-ring overlay.

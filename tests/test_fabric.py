@@ -1,0 +1,351 @@
+"""Rootless elastic serving fabric (rlo_tpu/serving, docs/DESIGN.md
+§11): a multi-rank DecodeServer tier scheduled by the paper's own
+primitives, proven in the deterministic simulator before any real
+transport — the PR-3 convention.
+
+The acceptance scenarios:
+
+  - a serving rank killed MID-decode: every accepted request completes
+    exactly once on a survivor with identical tokens (seed-exact);
+  - split-brain during a request burst: both sides keep serving, the
+    minority's accepted requests are re-admitted after the heal with
+    no duplicate completions;
+  - kill + elastic rejoin under continuous load: the rejoined rank
+    converges (placement included) and the fleet drains;
+  - same seed => byte-identical schedule AND identical completion
+    tokens on every rank;
+  - the real ``models.serve.DecodeServer`` behind the fabric
+    (ModelBackend): fabric completions equal the dense ``generate``
+    oracle, including a request re-queued across a kill.
+"""
+
+import logging
+
+import pytest
+
+from rlo_tpu.serving.backend import StubBackend, stub_tokens
+from rlo_tpu.serving.fabric import DecodeFabric, fleet_stats
+from rlo_tpu.serving.placement import (Placement, owner_of, pick_owner,
+                                       rendezvous_owner)
+from rlo_tpu.serving.scenario import (FABRIC_SCENARIO_KINDS,
+                                      FabricScenario,
+                                      make_fabric_scenario)
+from rlo_tpu.transport.sim import SimViolation, make_scenario
+
+logging.getLogger("rlo_tpu").setLevel(logging.ERROR)
+
+
+# ---------------------------------------------------------------------------
+# placement / routing units
+# ---------------------------------------------------------------------------
+
+class TestPlacement:
+    def test_rendezvous_deterministic_and_stable(self):
+        members = (0, 1, 2, 3)
+        owners = [rendezvous_owner(1, s, members) for s in range(64)]
+        assert owners == [rendezvous_owner(1, s, members)
+                          for s in range(64)]
+        # spreads across members (HRW, not all-on-one)
+        assert len(set(owners)) > 1
+        # removing a member only moves ITS requests (HRW minimality)
+        shrunk = (0, 1, 3)
+        for s, o in enumerate(owners):
+            if o != 2:
+                assert rendezvous_owner(1, s, shrunk) == o
+
+    def test_owner_of_admit_record_authoritative(self):
+        pl = Placement(version=1, proposer=0, members=(0, 1, 2))
+        assert owner_of((0, 7), 2, pl) == 2
+        # admit-time owner left the member set: rendezvous re-places
+        pl2 = Placement(version=2, proposer=0, members=(0, 1))
+        assert owner_of((0, 7), 2, pl2) in (0, 1)
+
+    def test_placement_codec_and_order(self):
+        pl = Placement(version=3, proposer=1, members=(0, 2, 3))
+        assert Placement.decode(pl.encode()) == pl
+        assert Placement.decode(b"\x01") is None
+        assert Placement(4, 0, (0, 1)).key() > pl.key()
+
+    def test_pick_owner_least_loaded(self):
+        loads = {0: (0, 5), 1: (2, 0), 2: (1, 0)}
+        assert pick_owner(0, (0, 1, 2), loads) == 1
+        # no reports at all: lowest rank
+        assert pick_owner(2, (1, 2, 3), {}) == 1
+
+
+class TestStubBackend:
+    def test_tokens_deterministic_and_rank_independent(self):
+        a = stub_tokens((5, 6, 7), 12)
+        assert a == stub_tokens((5, 6, 7), 12)
+        assert len(a) == 12
+        assert a != stub_tokens((5, 6, 8), 12)
+
+    def test_slot_scheduling_and_cancel(self):
+        b = StubBackend(n_slots=1, round_len=4)
+        b.submit("a", (1, 2), 8)
+        b.submit("b", (3, 4), 4)
+        assert b.load() == (1, 2)
+        assert b.step_round() == []          # a mid-flight
+        assert b.cancel("a") is True
+        done = b.step_round()                # b admitted + finishes
+        assert [k for k, _ in done] == ["b"]
+        assert done[0][1] == stub_tokens((3, 4), 4)
+        assert not b.has_work()
+
+
+# ---------------------------------------------------------------------------
+# the acceptance scenarios (deterministic simulator, stub backend)
+# ---------------------------------------------------------------------------
+
+class TestFabricScenarios:
+    def test_kill_mid_decode_exactly_once_on_survivors(self):
+        """Kill the warm-up owner with decodes in flight: the
+        PR-1/PR-3 failure machinery detects it, IAR re-places, and
+        survivors complete EVERY accepted request exactly once with
+        oracle-identical tokens — and no decode work is duplicated."""
+        res = make_fabric_scenario("fabric_kill", seed=2).run()
+        assert res["requeues"] > 0          # orphans were re-queued
+        assert res["dup_done"] == 0         # no duplicated decode
+        # every survivor completed every accepted request
+        assert set(res["completed"].values()) == {res["submitted"]}
+        # identical tokens on every rank (oracle equality is checked
+        # inside FabricScenario.run property checks)
+        views = list(res["done_tokens"].values())
+        assert all(v == views[0] for v in views[1:])
+
+    def test_split_brain_burst_readmitted_after_heal(self):
+        """A partition lands mid-burst: both sides keep serving under
+        their own placements; after the heal the minority's accepted
+        requests are re-admitted (pending ADMITs re-broadcast on view
+        growth) and the fleet converges with no duplicate
+        completions."""
+        res = make_fabric_scenario("fabric_split", seed=0).run()
+        assert res["readmitted"] > 0        # re-admission exercised
+        assert res["requeues"] > 0          # cross-side re-placement
+        assert res["rejoins"] > 0           # the heal went through IAR
+        assert set(res["completed"].values()) == {res["submitted"]}
+
+    def test_rejoin_under_load_converges(self):
+        """Kill + elastic rejoin with submissions continuing
+        throughout: the restarted rank is admitted through IAR,
+        adopts the fleet's request state (ADMIT/DONE re-broadcast),
+        and the final placement covers all four ranks again."""
+        res = make_fabric_scenario("fabric_rejoin", seed=0).run()
+        assert res["rejoins"] > 0
+        assert res["requeues"] > 0
+        assert res["placement_version"] > 0
+        assert set(res["completed"].values()) == {res["submitted"]}
+
+    def test_same_seed_identical_schedule_and_tokens(self):
+        a = make_fabric_scenario("fabric_kill", seed=1).run()
+        b = make_fabric_scenario("fabric_kill", seed=1).run()
+        assert a["digest"] == b["digest"] != "protocol-only"
+        assert a["done_tokens"] == b["done_tokens"]
+
+    def test_make_scenario_routes_fabric_kinds(self):
+        for kind in FABRIC_SCENARIO_KINDS:
+            assert isinstance(make_scenario(kind, 0), FabricScenario)
+
+    def test_violation_carries_seed_and_replay_recipe(self):
+        sc = FabricScenario(world_size=4, seed=31)
+        with pytest.raises(SimViolation) as ei:
+            sc._fail("synthetic")
+        msg = str(ei.value)
+        assert "seed 31" in msg and "FabricScenario(" in msg
+
+
+# ---------------------------------------------------------------------------
+# telemetry
+# ---------------------------------------------------------------------------
+
+def test_fleet_stats_rollup():
+    """Fleet stats: summed counters, merged e2e latency summary
+    (submit -> last token INCLUDING fail-over re-queue time), and
+    per-rank snapshots — run off the kill scenario so the e2e
+    histogram really contains post-kill completions."""
+    from rlo_tpu.engine import EngineManager, ProgressEngine
+    from rlo_tpu.transport.sim import SimWorld
+
+    world = SimWorld(4, seed=0)
+    mgr = EngineManager()
+    engines = [ProgressEngine(world.transport(r), manager=mgr,
+                              clock=world.clock, failure_timeout=6.0,
+                              heartbeat_interval=1.0, arq_rto=1.5,
+                              arq_max_retries=6, op_deadline=20.0)
+               for r in range(4)]
+    fabrics = [DecodeFabric(engines[r], StubBackend(n_slots=2),
+                            decode_interval=1.0) for r in range(4)]
+    rids = [fabrics[1].submit((9, 9, 9), 20),
+            fabrics[2].submit((8, 8), 12)]
+    live = {0, 1, 2, 3}
+    killed = False
+    while world.now < 80.0:
+        if world.now >= 3.0 and not killed:
+            killed = True          # kill the warm-up owner mid-decode
+            world.kill_rank(0)
+            engines[0].cleanup()
+            live.discard(0)
+        world.step()
+        mgr.progress_all()
+        for r in sorted(live):
+            fabrics[r].pump()
+        if all(f.result(rid) is not None
+               for f in (fabrics[1], fabrics[2], fabrics[3])
+               for rid in rids):
+            break
+    fl = fleet_stats([fabrics[r] for r in sorted(live)])
+    assert fl["counters"]["fabric.requests_completed"] >= 2 * 3
+    assert fl["e2e_usec"]["count"] >= 2 * 3
+    assert fl["e2e_usec"]["p50"] is not None
+    assert set(fl["ranks"]) == {"1", "2", "3"}
+    one = fl["ranks"]["1"]
+    assert one["placement"]["members"] == [1, 2, 3]
+    assert one["backend"]["backend"] == "stub"
+    # both requests completed exactly once everywhere, tokens = oracle
+    for r in (1, 2, 3):
+        assert fabrics[r].result(rids[0]) == stub_tokens((9, 9, 9), 20)
+        assert len(fabrics[r].completions) == \
+            len(set(fabrics[r].completions))
+
+
+# ---------------------------------------------------------------------------
+# the real DecodeServer behind the fabric (ModelBackend)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    import jax
+
+    from rlo_tpu.models.transformer import TransformerConfig, init_params
+    cfg = TransformerConfig(vocab=64, d_model=32, n_heads=4,
+                            n_layers=2, d_ff=64, dtype="float32")
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    return params, cfg
+
+
+def _model_fabric_world(params, cfg, n_ranks):
+    from rlo_tpu.engine import EngineManager, ProgressEngine
+    from rlo_tpu.models.serve import DecodeServer
+    from rlo_tpu.serving.backend import ModelBackend
+    from rlo_tpu.transport.sim import SimWorld
+
+    world = SimWorld(n_ranks, seed=0)
+    mgr = EngineManager()
+    engines = [ProgressEngine(world.transport(r), manager=mgr,
+                              clock=world.clock, failure_timeout=6.0,
+                              heartbeat_interval=1.0, arq_rto=1.5,
+                              arq_max_retries=6, op_deadline=20.0)
+               for r in range(n_ranks)]
+    fabrics = [DecodeFabric(
+        engines[r],
+        ModelBackend(DecodeServer(params, cfg, n_slots=2, max_len=64,
+                                  round_len=4, prompt_buckets=(8, 16))),
+        decode_interval=1.0) for r in range(n_ranks)]
+    return world, mgr, engines, fabrics
+
+
+def _dense_oracle(params, cfg, prompt, max_new):
+    import jax.numpy as jnp
+    import numpy as np
+
+    from rlo_tpu.models.generate import generate
+    out = generate(params, jnp.asarray(prompt, jnp.int32)[None, :],
+                   cfg, max_new=max_new)
+    return tuple(int(t) for t in np.asarray(out)[0])
+
+
+def test_model_backend_fabric_matches_dense_generate(tiny_model):
+    """2-rank fabric over the REAL continuous-batching DecodeServer:
+    every fabric completion equals the dense generate oracle — the
+    fabric is a scheduling/placement layer, not a numerics change."""
+    import numpy as np
+
+    params, cfg = tiny_model
+    world, mgr, engines, fabrics = _model_fabric_world(params, cfg, 2)
+    rng = np.random.default_rng(0)
+    reqs = [(tuple(int(t) for t in rng.integers(0, cfg.vocab, (p,))),
+             m) for p, m in ((5, 6), (9, 8), (4, 3))]
+    rids = [fabrics[0].submit(p, m) for p, m in reqs]
+    live = (0, 1)
+    while world.now < 60.0:
+        world.step()
+        mgr.progress_all()
+        for r in live:
+            fabrics[r].pump()
+        if all(fabrics[r].result(rid) is not None
+               for r in live for rid in rids):
+            break
+    for (p, m), rid in zip(reqs, rids):
+        want = _dense_oracle(params, cfg, p, m)
+        for r in live:
+            assert fabrics[r].result(rid) == want
+
+
+def test_model_backend_requeue_after_kill_identical_tokens(tiny_model):
+    """3-rank fabric, the owner killed mid-decode: the re-queued
+    request restarts from the prompt on a survivor's DecodeServer and
+    completes with tokens identical to the dense oracle (greedy decode
+    over replicated weights)."""
+    import numpy as np
+
+    params, cfg = tiny_model
+    world, mgr, engines, fabrics = _model_fabric_world(params, cfg, 3)
+    rng = np.random.default_rng(1)
+    prompt = tuple(int(t) for t in rng.integers(0, cfg.vocab, (6,)))
+    # gateway 1; the admit-time owner is rank 0 (least-loaded default
+    # before any gossip lands), which we kill mid-decode
+    rid = fabrics[1].submit(prompt, 14)
+    live = {0, 1, 2}
+    killed = False
+    while world.now < 90.0:
+        if not killed and world.now >= 2.5:
+            killed = True
+            world.kill_rank(0)
+            engines[0].cleanup()
+            live.discard(0)
+        world.step()
+        mgr.progress_all()
+        for r in sorted(live):
+            fabrics[r].pump()
+        if killed and all(fabrics[r].result(rid) is not None
+                          for r in live):
+            break
+    assert killed
+    want = _dense_oracle(params, cfg, prompt, 14)
+    for r in sorted(live):
+        assert fabrics[r].result(rid) == want, f"rank {r} diverged"
+    assert sum(f.requeues for f in (fabrics[1], fabrics[2])) == 1
+    # exactly-once: one completion record per rank, no duplicates
+    for r in sorted(live):
+        assert fabrics[r].completions.count(rid) == 1
+
+
+# ---------------------------------------------------------------------------
+# fabric_bench reproduces itself (the BENCH_fabric.json contract)
+# ---------------------------------------------------------------------------
+
+def test_fabric_bench_quick_reproduces_itself(tmp_path):
+    """Two --quick fabric_bench runs agree on every seed-exact metric
+    (produce -> JSON -> gate contract of BENCH_fabric.json), and the
+    failover leg actually re-queues work."""
+    import json
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    repo = Path(__file__).resolve().parent.parent
+    docs = []
+    for name in ("a.json", "b.json"):
+        out = tmp_path / name
+        proc = subprocess.run(
+            [sys.executable, "benchmarks/fabric_bench.py", "--quick",
+             "--out", str(out)],
+            capture_output=True, text=True, cwd=repo, timeout=240)
+        assert proc.returncode == 0, proc.stderr
+        docs.append(json.loads(out.read_text()))
+    da, db = docs
+    assert da["suite"] == "fabric_bench"
+    for name, m in da["metrics"].items():
+        if m["direction"] == "exact":
+            assert db["metrics"][name]["value"] == m["value"], name
+    assert da["metrics"]["failover4.requeues"]["value"] > 0

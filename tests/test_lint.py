@@ -192,6 +192,29 @@ def test_r4_fires_on_deleted_membership_handler(tree):
                f.msg for f in hits), hits
 
 
+def test_r4_fires_on_fabric_record_dispatch_hole(tree):
+    """The serving fabric's Rec record kinds are held to the same
+    dispatch-exhaustiveness bar as engine Tags (docs/DESIGN.md §11):
+    a kind whose _on_record branch disappears is a finding."""
+    line = mutate(tree, "rlo_tpu/serving/fabric.py",
+                  "elif kind == Rec.LOAD:", "elif False:")
+    hits = findings_for(tree, "R4")
+    assert any(f.file == "rlo_tpu/serving/fabric.py" and
+               "Rec.LOAD" in f.msg for f in hits), hits
+    assert line > 0
+
+
+def test_r5_fires_on_fabric_wallclock_leak(tree):
+    """serving/ is in the deterministic-replay scope: a wall-clock
+    read in the fabric would break seed-exact fleet replays."""
+    path = tree / "rlo_tpu/serving/fabric.py"
+    path.write_text(path.read_text() +
+                    "\nimport time\n_T0 = time.time()\n")
+    hits = findings_for(tree, "R5")
+    assert any(f.file == "rlo_tpu/serving/fabric.py" and
+               "time.time" in f.msg for f in hits), hits
+
+
 def test_r5_fires_on_wallclock_leak(tree):
     path = tree / "rlo_tpu/transport/sim.py"
     path.write_text(path.read_text() +

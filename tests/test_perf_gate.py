@@ -183,10 +183,11 @@ class TestBenchIntegration:
         assert rc == 0
 
     def test_committed_baselines_are_wellformed(self):
-        """The committed BENCH_engine.json / BENCH_sim.json parse and
-        carry gateable tolerance specs (every metric has a direction;
-        exact metrics exist so protocol drift is actually pinned)."""
-        for name in ("BENCH_engine.json", "BENCH_sim.json"):
+        """The committed benchmark baselines parse and carry gateable
+        tolerance specs (every metric has a direction; exact metrics
+        exist so protocol drift is actually pinned)."""
+        for name in ("BENCH_engine.json", "BENCH_sim.json",
+                     "BENCH_fabric.json", "BENCH_serve.json"):
             d = json.loads((REPO_ROOT / name).read_text())
             assert d["metrics"], name
             dirs = {v["direction"] for v in d["metrics"].values()}

@@ -140,6 +140,64 @@ def test_errors(setup):
         _bucket(100, (8, 16))
 
 
+def test_poll_completed_and_cancel(setup):
+    """The fabric-facing hooks (docs/DESIGN.md §11): poll_completed
+    drains (rid, tokens) incrementally and matches the dense oracle;
+    cancel frees a slot mid-decode (or de-queues) so ownership can
+    move; canceled requests never complete and free capacity for the
+    rest of the stream."""
+    from rlo_tpu.utils.metrics import Registry
+
+    params = setup
+    rng = np.random.default_rng(11)
+    reg = Registry()
+    srv = DecodeServer(params, CFG, n_slots=2, max_len=64,
+                       round_len=4, prompt_buckets=(8, 16),
+                       metrics=reg)
+    reqs = [(rng.integers(0, CFG.vocab, (5,)), 10),
+            (rng.integers(0, CFG.vocab, (7,)), 6),
+            (rng.integers(0, CFG.vocab, (4,)), 8)]
+    rids = [srv.submit(p, m) for p, m in reqs]
+    assert srv.has_work() and srv.queue_depth() == 3
+    srv.step_round()  # admits rids 0+1 into the 2 slots
+    assert srv.queue_depth() == 1
+    assert set(srv.slot_ownership()) <= {rids[0], rids[1], None}
+    assert srv.cancel(rids[0]) is True          # in-slot cancel
+    assert srv.cancel(rids[0]) is False         # idempotent
+    outs = srv.run()
+    got = dict()
+    for rid, toks in srv.poll_completed():
+        got[rid] = toks
+    assert srv.poll_completed() == []           # drained
+    assert set(got) == {rids[1], rids[2]}       # canceled never lands
+    for i in (1, 2):
+        p, m = reqs[i]
+        np.testing.assert_array_equal(got[rids[i]],
+                                      dense_oracle(params, CFG, p, m))
+        np.testing.assert_array_equal(outs[rids[i]], got[rids[i]])
+    snap = srv.stats()
+    assert snap["counters"]["serve.requests_canceled"] == 1
+    assert snap["counters"]["serve.requests_completed"] == 2
+    # e2e latency (submit -> last token) recorded per completion only
+    assert snap["histograms"]["serve.e2e_usec"]["count"] == 2
+    assert snap["histograms"]["serve.e2e_usec"]["p50"] is not None
+    assert srv.free_slots() == 2 and not srv.has_work()
+
+
+def test_cancel_queued_before_admission(setup):
+    """A request canceled while still queued never prefills; run()
+    returns an empty row for it and the stream completes."""
+    params = setup
+    rng = np.random.default_rng(12)
+    srv = DecodeServer(params, CFG, n_slots=1, max_len=64,
+                       round_len=4, prompt_buckets=(8,))
+    r0 = srv.submit(rng.integers(0, CFG.vocab, (5,)), 6)
+    r1 = srv.submit(rng.integers(0, CFG.vocab, (6,)), 4)
+    assert srv.cancel(r1) is True
+    outs = srv.run()
+    assert len(outs[r0]) == 6 and len(outs[r1]) == 0
+
+
 def test_serving_telemetry(setup):
     """Serving telemetry (docs/DESIGN.md §7): every request's TTFT and
     queue wait are recorded, occupancy/round histograms advance, and
