@@ -65,16 +65,16 @@ def info(value):
     return {"value": value, "direction": "higher", "tolerance": None}
 
 
-def poisson_leg(params, cfg, *, tiny, n_req, slots, round_len,
-                max_len, buckets, rate, seed):
-    """Open-loop Poisson arrival mix: per-round arrival counts drawn
-    Poisson(rate), bimodal prompt/budget distribution (70% short
-    interactive, 30% long batch). Returns a perf_gate benchmark
-    document; the scheduling metrics are functions of the seed alone
-    (no eos => budget-fixed decode lengths), the tokens/s is wall."""
-    from rlo_tpu.utils.metrics import Registry
-
+def _poisson_trace(cfg, *, n_req, rate, seed, max_len, buckets,
+                   prefix_len=0):
+    """The seed-deterministic open-loop trace: bimodal requests plus
+    per-round Poisson arrival counts. ``prefix_len`` > 0 prepends a
+    SHARED system prefix of that many tokens to ~70% of the prompts
+    (the prefix-heavy variant the radix cache serves); 0 reproduces
+    the original dense-leg trace byte-for-byte."""
     rng = np.random.default_rng(seed)
+    prefix = (rng.integers(0, cfg.vocab, (prefix_len,))
+              if prefix_len else None)
     reqs = []
     for _ in range(n_req):
         if rng.random() < 0.7:  # short interactive
@@ -83,22 +83,31 @@ def poisson_leg(params, cfg, *, tiny, n_req, slots, round_len,
         else:                   # long batch
             plen = int(rng.integers(8, min(15, buckets[-1] + 1)))
             budget = int(rng.integers(24, min(41, max_len - plen)))
-        reqs.append((rng.integers(0, cfg.vocab, (plen,)), budget))
+        prompt = rng.integers(0, cfg.vocab, (plen,))
+        if prefix is not None and rng.random() < 0.7:
+            prompt = np.concatenate([prefix, prompt])
+        if prefix is not None and reqs and rng.random() < 0.25:
+            # an exact resubmission: the full-prefix radix hit whose
+            # first decode write lands in a shared page — the COW path
+            prompt = reqs[rng.integers(0, len(reqs))][0]
+        reqs.append((prompt, budget))
     # arrival round of each request: cumulative Poisson per round
     arrival, rnd = [], 0
     while len(arrival) < n_req:
         k = int(rng.poisson(rate))
         arrival.extend([rnd] * min(k, n_req - len(arrival)))
         rnd += 1
+    return reqs, arrival
 
-    reg = Registry()
-    srv = DecodeServer(params, cfg, n_slots=slots, max_len=max_len,
-                       round_len=round_len, prompt_buckets=buckets,
-                       metrics=reg)
+
+def _drive_open_loop(srv, reqs, arrival):
+    """Run the open-loop trace to drain; returns (occupancy mean %,
+    e2e p50/p99 in rounds, wall seconds)."""
     submit_round = {}
     e2e_rounds = []
     submitted = 0
     round_idx = 0
+    n_req = len(reqs)
     t0 = time.perf_counter()
     while submitted < n_req or srv.has_work():
         while submitted < n_req and arrival[submitted] <= round_idx:
@@ -115,37 +124,141 @@ def poisson_leg(params, cfg, *, tiny, n_req, slots, round_len,
             e2e_rounds.append(round_idx - submit_round[rid])
         round_idx += 1
     wall = time.perf_counter() - t0
-    useful = sum(m for _, m in reqs)
-    occ = reg.histogram("serve.occupancy_pct")
+    occ = srv.metrics.histogram("serve.occupancy_pct")
     occ_mean = occ.sum / occ.count if occ.count else 0.0
     e2e_rounds.sort()
     p50 = e2e_rounds[len(e2e_rounds) // 2]
     p99 = e2e_rounds[min(len(e2e_rounds) - 1,
                          (len(e2e_rounds) * 99) // 100)]
+    return occ_mean, p50, p99, wall
+
+
+def poisson_leg(params, cfg, *, tiny, n_req, slots, round_len,
+                max_len, buckets, rate, seed, paged=False,
+                page_size=8):
+    """Open-loop Poisson arrival mix: per-round arrival counts drawn
+    Poisson(rate), bimodal prompt/budget distribution (70% short
+    interactive, 30% long batch). Returns a perf_gate benchmark
+    document; the scheduling metrics are functions of the seed alone
+    (no eos => budget-fixed decode lengths), the tokens/s is wall.
+
+    ``--paged`` adds two more legs over the SAME arrival process
+    (docs/DESIGN.md §12): ``poisson_paged.*`` runs the paged server
+    on the identical trace — chunked prefill, page pool, and
+    budget-clipped rounds must STRICTLY improve occupancy and
+    slot-step efficiency over the dense leg (asserted here, gated
+    exact) — and ``poisson_prefix.*`` runs a prefix-heavy variant
+    (a shared system prefix on ~70% of prompts) whose radix-reuse
+    counters (prefix hits, shared tokens, COW copies) gate exact."""
+    from rlo_tpu.utils.metrics import Registry
+
+    reqs, arrival = _poisson_trace(cfg, n_req=n_req, rate=rate,
+                                   seed=seed, max_len=max_len,
+                                   buckets=buckets)
+    useful = sum(m for _, m in reqs)
+
+    reg = Registry()
+    srv = DecodeServer(params, cfg, n_slots=slots, max_len=max_len,
+                       round_len=round_len, prompt_buckets=buckets,
+                       metrics=reg)
+    occ_mean, p50, p99, wall = _drive_open_loop(srv, reqs, arrival)
+    eff = useful / (srv.steps_run * slots)
     print(f"poisson mix: {n_req} reqs, rate {rate}/round, "
           f"{srv.rounds_run} rounds, occupancy {occ_mean:.1f}%, "
           f"e2e p50/p99 {p50}/{p99} rounds, "
           f"{useful/wall:,.0f} tok/s wall", file=sys.stderr)
-    return {
+    metrics = {
+        # seed-deterministic scheduling numbers: gate exact
+        "poisson.rounds": exact(srv.rounds_run),
+        "poisson.useful_tokens": exact(useful),
+        "poisson.occupancy_mean_pct": exact(round(occ_mean, 6)),
+        "poisson.slot_step_efficiency": exact(round(eff, 6)),
+        "poisson.e2e_rounds_p50": exact(p50),
+        "poisson.e2e_rounds_p99": exact(p99),
+        # wall throughput: machine-dependent, informational
+        "poisson.sustained_tokens_per_sec": info(
+            round(useful / wall, 1)),
+    }
+    doc = {
         "suite": "serve_bench",
         "config": {"tiny": tiny, "arrivals": "poisson",
                    "n_req": n_req, "slots": slots,
                    "round_len": round_len, "rate": rate,
-                   "seed": seed},
-        "metrics": {
-            # seed-deterministic scheduling numbers: gate exact
-            "poisson.rounds": exact(srv.rounds_run),
-            "poisson.useful_tokens": exact(useful),
-            "poisson.occupancy_mean_pct": exact(round(occ_mean, 6)),
-            "poisson.slot_step_efficiency": exact(
-                round(useful / (srv.steps_run * slots), 6)),
-            "poisson.e2e_rounds_p50": exact(p50),
-            "poisson.e2e_rounds_p99": exact(p99),
-            # wall throughput: machine-dependent, informational
-            "poisson.sustained_tokens_per_sec": info(
-                round(useful / wall, 1)),
-        },
+                   "seed": seed, "paged": bool(paged)},
+        "metrics": metrics,
     }
+    if not paged:
+        return doc
+
+    # ---- paged leg: the SAME trace through the paged server --------
+    reg_p = Registry()
+    srv_p = DecodeServer(params, cfg, n_slots=slots, max_len=max_len,
+                         round_len=round_len, metrics=reg_p,
+                         paged=True, page_size=page_size)
+    occ_p, p50_p, p99_p, wall_p = _drive_open_loop(srv_p, reqs,
+                                                   arrival)
+    eff_p = useful / (srv_p.steps_run * slots)
+    snap_p = reg_p.snapshot()["counters"]
+    print(f"paged:       {srv_p.rounds_run} rounds, occupancy "
+          f"{occ_p:.1f}%, efficiency {eff_p:.3f} (dense {eff:.3f}), "
+          f"e2e p50/p99 {p50_p}/{p99_p}, "
+          f"{useful/wall_p:,.0f} tok/s wall", file=sys.stderr)
+    # the acceptance bar: the paged scheduler must STRICTLY beat the
+    # dense one on the same trace — fail the bench loudly, not just
+    # the gate, if the win ever evaporates
+    assert occ_p > occ_mean, (occ_p, occ_mean)
+    assert eff_p > eff, (eff_p, eff)
+    metrics.update({
+        "poisson_paged.rounds": exact(srv_p.rounds_run),
+        "poisson_paged.occupancy_mean_pct": exact(round(occ_p, 6)),
+        "poisson_paged.slot_step_efficiency": exact(round(eff_p, 6)),
+        "poisson_paged.e2e_rounds_p50": exact(p50_p),
+        "poisson_paged.e2e_rounds_p99": exact(p99_p),
+        "poisson_paged.prefill_chunks": exact(
+            snap_p.get("serve.prefill_chunks", 0)),
+        "poisson_paged.pages_peak": exact(
+            srv_p.allocator.peak_in_use),
+        "poisson_paged.sustained_tokens_per_sec": info(
+            round(useful / wall_p, 1)),
+    })
+
+    # ---- prefix-heavy leg: shared system prefix, radix reuse -------
+    reqs_x, arrival_x = _poisson_trace(
+        cfg, n_req=n_req, rate=rate, seed=seed + 1,
+        max_len=max_len, buckets=buckets, prefix_len=page_size)
+    useful_x = sum(m for _, m in reqs_x)
+    reg_x = Registry()
+    srv_x = DecodeServer(params, cfg, n_slots=slots, max_len=max_len,
+                         round_len=round_len, metrics=reg_x,
+                         paged=True, page_size=page_size)
+    occ_x, p50_x, p99_x, _ = _drive_open_loop(srv_x, reqs_x,
+                                              arrival_x)
+    snap_x = reg_x.snapshot()["counters"]
+    hits = snap_x.get("serve.prefix_hits", 0)
+    shared_toks = snap_x.get("serve.prefix_tokens_shared", 0)
+    print(f"prefix-heavy: {hits} prefix hits, {shared_toks} prompt "
+          f"tokens served from the radix cache, "
+          f"{snap_x.get('serve.cow_copies', 0)} COW copies, "
+          f"{snap_x.get('serve.prefill_chunks', 0)} prefill chunks",
+          file=sys.stderr)
+    # >= 1 measured prefill skipped via radix reuse (the acceptance
+    # criterion); gate the exact counters so reuse can never silently
+    # regress to zero
+    assert hits >= 1 and shared_toks >= page_size, (hits, shared_toks)
+    metrics.update({
+        "poisson_prefix.useful_tokens": exact(useful_x),
+        "poisson_prefix.rounds": exact(srv_x.rounds_run),
+        "poisson_prefix.occupancy_mean_pct": exact(round(occ_x, 6)),
+        "poisson_prefix.prefix_hits": exact(hits),
+        "poisson_prefix.prefix_tokens_shared": exact(shared_toks),
+        "poisson_prefix.cow_copies": exact(
+            snap_x.get("serve.cow_copies", 0)),
+        "poisson_prefix.prefill_chunks": exact(
+            snap_x.get("serve.prefill_chunks", 0)),
+        "poisson_prefix.e2e_rounds_p50": exact(p50_x),
+        "poisson_prefix.e2e_rounds_p99": exact(p99_x),
+    })
+    return doc
 
 
 def main():
@@ -161,6 +274,11 @@ def main():
                          "production arrival mix (perf_gate schema)")
     ap.add_argument("--rate", type=float, default=1.5,
                     help="poisson: mean arrivals per decode round")
+    ap.add_argument("--paged", action="store_true",
+                    help="poisson: add the paged-server leg (same "
+                         "trace; occupancy/efficiency must strictly "
+                         "beat dense) and the prefix-heavy radix-"
+                         "reuse leg (docs/DESIGN.md §12)")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--out", help="poisson: write the benchmark JSON "
                                   "here instead of stdout")
@@ -185,7 +303,8 @@ def main():
         doc = poisson_leg(params, cfg, tiny=args.tiny, n_req=n_req,
                           slots=slots, round_len=round_len,
                           max_len=max_len, buckets=buckets,
-                          rate=args.rate, seed=args.seed)
+                          rate=args.rate, seed=args.seed,
+                          paged=args.paged)
         text = json.dumps(doc, indent=1, sort_keys=True)
         if args.out:
             with open(args.out, "w") as fh:

@@ -112,10 +112,13 @@ rm -f "$fresh_fabric"
 echo "== serve bench arrival mix + perf gate (BENCH_serve.json) =="
 # open-loop Poisson production mix on the tiny model: the scheduling
 # metrics (rounds, occupancy, slot-step efficiency, e2e-in-rounds)
-# are seed-deterministic and gate exact; wall tok/s is informational
+# are seed-deterministic and gate exact; wall tok/s is informational.
+# --paged adds the paged-server leg (same trace, occupancy/efficiency
+# must strictly beat dense — asserted in the bench AND gated exact)
+# and the prefix-heavy radix-reuse leg (docs/DESIGN.md §12)
 fresh_serve=$(mktemp -t rlo_bench_serve.XXXXXX)
 JAX_PLATFORMS=cpu python benchmarks/serve_bench.py --tiny \
-    --arrivals poisson --out "$fresh_serve"
+    --arrivals poisson --paged --out "$fresh_serve"
 JAX_PLATFORMS=cpu python -m rlo_tpu.tools.perf_gate \
     --baseline BENCH_serve.json --fresh "$fresh_serve"
 rm -f "$fresh_serve"

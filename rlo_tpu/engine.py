@@ -31,6 +31,7 @@ replicate"):
 from __future__ import annotations
 
 import enum
+import heapq
 import itertools
 import logging
 import struct
@@ -420,6 +421,19 @@ class ProgressEngine:
         self._tx_skip: dict = {}      # dst -> [given-up seq, next send]
         self._rx_seen: dict = {}      # src -> [contig, set(seqs > contig)]
         self._ack_due: Set[int] = set()  # srcs owed a cumulative ACK
+        # batched due-list keyed by deadline (ROADMAP item 2): a lazy
+        # min-heap of (due, dst, seq) wake-ups — seq -1 marks a skip-
+        # notice deadline — so the per-tick retransmit scan is O(1)
+        # peek-and-return until something is actually due, instead of
+        # a per-frame walk of every unacked queue on every progress
+        # turn. Entries are never removed eagerly: an entry whose
+        # (dst, seq) no longer matches the live due (acked, resent
+        # with backoff, failed peer) is stale and popped on sight.
+        # The heap only GATES the sweep — the sweep itself still walks
+        # in the original (dst insertion, seq) order, so retransmit
+        # ordering (and with it every seed-exact simulator schedule)
+        # is byte-identical to the un-gated scan.
+        self._arq_due: List[tuple] = []
         # ARQ counters — part of the metrics registry snapshot
         # (metrics()["counters"]); the attributes are the canonical
         # storage and remain the public aliases PR-1 tests read
@@ -632,6 +646,7 @@ class ProgressEngine:
         due = self.clock() + self.arq_rto
         self._tx_unacked.setdefault(dst, {})[seq] = _ArqEntry(
             tag=int(tag), raw=raw, due=due, sent=due - self.arq_rto)
+        heapq.heappush(self._arq_due, (due, dst, seq))
         if self._prof_on:
             t0 = self.clock()
             h = self.transport.isend(dst, int(tag), raw)
@@ -719,6 +734,30 @@ class ProgressEngine:
                 if lo - 1 > sk[0]:
                     sk[0] = lo - 1
                     sk[1] = self.clock()  # send this tick
+                    heapq.heappush(self._arq_due, (sk[1], src, -1))
+
+    def _arq_wake(self, now: float) -> bool:
+        """The due-list gate for the retransmit sweep: pop stale heap
+        heads (acked, resent-with-backoff, failed-peer, or retired
+        skip notices no longer match their recorded deadline) and
+        report whether the earliest LIVE deadline has arrived. The
+        heap is a min-heap on the deadline, so a not-yet-due head
+        means nothing anywhere is due — the common idle tick returns
+        here without touching a single unacked queue."""
+        heap = self._arq_due
+        while heap:
+            due, dst, seq = heap[0]
+            if seq >= 0:
+                ent = self._tx_unacked.get(dst, {}).get(seq)
+                live = ent is not None and ent.due == due
+            else:
+                sk = self._tx_skip.get(dst)
+                live = sk is not None and sk[1] == due
+            if not live:
+                heapq.heappop(heap)
+                continue
+            return due <= now
+        return False
 
     def _arq_tick(self) -> None:
         """Retransmit sweep: resend overdue unacked frames with
@@ -743,6 +782,8 @@ class ProgressEngine:
         re-formed (declared after the sweep: _mark_failed mutates the
         retransmit queues)."""
         now = self.clock()
+        if not self._arq_wake(now):
+            return  # nothing due: the heap gate keeps this tick O(1)
         gave_up_on: List[int] = []
         for dst, q in self._tx_unacked.items():
             if dst in self.failed:
@@ -764,9 +805,11 @@ class ProgressEngine:
                     if seq > sk[0]:
                         sk[0] = seq
                         sk[1] = now  # send immediately
+                    heapq.heappush(self._arq_due, (sk[1], dst, -1))
                     continue
                 ent.retries += 1
                 ent.due = now + self.arq_rto * (2 ** ent.retries)
+                heapq.heappush(self._arq_due, (ent.due, dst, seq))
                 self.arq_retransmits += 1
                 if self._mx_on:
                     self._link(dst).retransmits += 1
@@ -783,6 +826,7 @@ class ProgressEngine:
                     Frame(origin=self.rank, pid=sk[0], vote=-2,
                           epoch=self._ep(dst)).encode())
                 sk[1] = now + self.arq_rto
+                heapq.heappush(self._arq_due, (sk[1], dst, -1))
         for dst in gave_up_on:
             if dst not in self.failed and not self._awaiting_welcome:
                 logger.warning(

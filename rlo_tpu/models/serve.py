@@ -18,33 +18,46 @@ TPU-shaped design decisions:
     (one lax.scan inside one jit): the tunneled chip's ~110 ms
     dispatch floor makes per-token host round-trips absurd; round_len
     amortizes it. Iteration-level batching a la Orca.
-  - A fresh request prefills into its slot with the blockwise prefill
-    (one forward at a padded prompt bucket — a handful of distinct
-    bucket lengths keeps the compile cache small), then the row's
-    cache is scattered into the pool cache at the slot index.
+  - DENSE mode (the original): a fresh request prefills into its slot
+    with the blockwise prefill (one forward at a padded prompt bucket),
+    then the row's cache is scattered into the pool cache at the slot
+    index. Prompts longer than the largest bucket extend past it in
+    jitted ``block_decode`` chunks — admission never rejects a prompt
+    that fits ``max_len - max_new``.
+  - PAGED mode (``paged=True``, docs/DESIGN.md §12): the per-slot
+    dense cache becomes a global pool of ``page_size``-token seq-minor
+    pages plus a per-slot int32 page table
+    (models.paged / serving.pages). Prompts stream through CHUNKED
+    prefill (page-aligned ≤ page_size-token forwards interleaved with
+    decode rounds — no prompt buckets, no padding waste), shared
+    prompt prefixes map the same physical pages copy-on-write through
+    a radix trie, and rounds clip to the shortest active budget so
+    finished rows never burn slot-steps.
   - Finished rows keep decoding masked garbage until the round ends
     (their budget exhausted); outputs are truncated to the request's
     max_new, and slot reuse is safe because every attend masks at the
     row's own position and cache writes overwrite in order.
 
-Oracle (tests/test_serve.py): any stream of requests produces, per
-request, EXACTLY the tokens of its dense `generate` — continuous
-batching is a scheduling change, not a numerics change.
+Oracle (tests/test_serve.py, tests/test_paged.py): any stream of
+requests produces, per request, EXACTLY the tokens of its dense
+`generate` — continuous batching, chunked prefill, and page
+indirection are scheduling/layout changes, not numerics changes.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import time
-from typing import List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from rlo_tpu.models.generate import (decode_step, init_kv_cache,
-                                     prefill, _decode_cfg)
+from rlo_tpu.models.generate import (block_decode, decode_step,
+                                     init_kv_cache, prefill,
+                                     _decode_cfg)
 from rlo_tpu.models.transformer import TransformerConfig
 from rlo_tpu.utils.metrics import Registry, SERVING, hist_summary
 
@@ -84,25 +97,42 @@ class DecodeServer:
     ``serve.tok_usec``), batch occupancy per round
     (``serve.occupancy_pct``), request/token counters, and live
     queue-depth gauges. ``stats()`` snapshots it.
+
+    PAGED mode adds the page-pool telemetry (docs/DESIGN.md §12):
+    ``serve.pages_in_use`` / ``serve.pages_free`` gauges, prefix-cache
+    counters (``serve.prefix_hits``, ``serve.prefix_tokens_shared``,
+    ``serve.cow_copies``, ``serve.trie_evictions``), chunked-prefill
+    counters (``serve.prefill_chunks``), and
+    ``serve.admission_stalls`` (allocator backpressure).
+
+    Paged knobs: ``page_size`` (128 on TPU — one lane block; smaller
+    is legal off-TPU for tests), ``n_pages`` (pool size; default fits
+    every slot at max_len plus the null page), ``prefill_budget``
+    (max prompt tokens prefilled per slot per round — None finishes a
+    prompt's prefill in its admission round; a finite budget
+    interleaves long prompts' chunks with decode rounds, bounding
+    their latency interference), ``prefix_cache`` (the radix trie),
+    and ``clip_rounds`` (clip each round to the shortest active
+    budget so finished rows never decode garbage; defaults on in
+    paged mode, the dense scheduler is left byte-for-byte alone).
     """
 
     def __init__(self, params, cfg: TransformerConfig, *,
                  n_slots: int, max_len: int, round_len: int = 32,
                  prompt_buckets: Tuple[int, ...] = (64, 256, 1024),
-                 metrics: Optional[Registry] = None):
+                 metrics: Optional[Registry] = None,
+                 paged: bool = False, page_size: int = 128,
+                 n_pages: Optional[int] = None,
+                 prefill_budget: Optional[int] = None,
+                 prefix_cache: bool = True,
+                 clip_rounds: Optional[bool] = None):
         self.metrics = SERVING if metrics is None else metrics
         self.params = params
         self.cfg = cfg
         self.n_slots = n_slots
         self.max_len = max_len
         self.round_len = round_len
-        self.buckets = tuple(b for b in sorted(prompt_buckets)
-                             if b <= max_len)
-        if not self.buckets:
-            raise ValueError(
-                f"no prompt bucket fits max_len {max_len} "
-                f"(buckets {tuple(sorted(prompt_buckets))})")
-        self.cache = init_kv_cache(cfg, n_slots, max_len)
+        self.paged = paged
         self.pos = np.zeros((n_slots,), np.int32)
         self.last_tok = np.zeros((n_slots,), np.int32)
         self.budget = np.zeros((n_slots,), np.int64)  # tokens still due
@@ -123,6 +153,20 @@ class DecodeServer:
         self.steps_run = 0
 
         cfg_d = _decode_cfg(cfg)
+        if paged:
+            self._init_paged(cfg_d, page_size, n_pages,
+                             prefill_budget, prefix_cache,
+                             True if clip_rounds is None
+                             else clip_rounds)
+            return
+        self.clip_rounds = bool(clip_rounds)
+        self.buckets = tuple(b for b in sorted(prompt_buckets)
+                             if b <= max_len)
+        if not self.buckets:
+            raise ValueError(
+                f"no prompt bucket fits max_len {max_len} "
+                f"(buckets {tuple(sorted(prompt_buckets))})")
+        self.cache = init_kv_cache(cfg, n_slots, max_len)
 
         def round_fn(params, cache, last_tok, pos, kk):
             def body(carry, _):
@@ -152,6 +196,25 @@ class DecodeServer:
 
         self._prefill = jax.jit(prefill_slot)
 
+        # long prompts (plen > the largest bucket) extend the
+        # bucket-prefilled row cache through jitted block_decode
+        # chunks — the chunked-prefill unit on the dense path, so
+        # admission never rejects a prompt that fits max_len - max_new
+        self._chunk_w = min(128, self.buckets[-1])
+
+        def extend_chunk(params, row, toks, pos0, n_valid):
+            logits, row = block_decode(params, toks,
+                                       pos0[None], row, cfg)
+            idx = jnp.clip(n_valid - 1, 0,
+                           toks.shape[1] - 1)[None, None, None]
+            xl = jnp.take_along_axis(
+                logits, jnp.broadcast_to(
+                    idx, (1, 1, logits.shape[-1])), axis=1)[:, 0]
+            first = jnp.argmax(xl, axis=-1).astype(jnp.int32)
+            return first, row
+
+        self._extend = jax.jit(extend_chunk, donate_argnums=(1,))
+
         def scatter_slot(cache, row, slot):
             def put(big, small):
                 return lax.dynamic_update_slice(
@@ -161,19 +224,82 @@ class DecodeServer:
 
         self._scatter = jax.jit(scatter_slot, donate_argnums=(0,))
 
+    # ---- paged mode (docs/DESIGN.md §12) -----------------------------
+    def _init_paged(self, cfg_d, page_size, n_pages, prefill_budget,
+                    prefix_cache, clip_rounds):
+        from rlo_tpu.models.paged import (copy_page, init_page_pool,
+                                          paged_decode_step,
+                                          paged_prefill_chunk)
+        from rlo_tpu.serving.pages import PageAllocator, PrefixTrie
+        if jax.default_backend() == "tpu" and page_size % 128:
+            raise ValueError(
+                f"TPU pages must be 128-lane multiples, got "
+                f"{page_size}")
+        self.page_size = page_size
+        self.max_pages = -(-self.max_len // page_size)
+        if n_pages is None:
+            n_pages = self.n_slots * self.max_pages + 1
+        self.n_pages = n_pages
+        self.clip_rounds = clip_rounds
+        self.prefill_budget = prefill_budget
+        self.pools = init_page_pool(self.cfg, n_pages, page_size)
+        self.allocator = PageAllocator(n_pages, page_size)
+        self.trie = PrefixTrie(page_size) if prefix_cache else None
+        self.table = np.zeros((self.n_slots, self.max_pages), np.int32)
+        self.active = np.zeros((self.n_slots,), bool)
+        #: pages owned (one reference each) per slot, table order
+        self._slot_pages: List[List[int]] = \
+            [[] for _ in range(self.n_slots)]
+        #: slot -> in-flight chunked prefill state
+        self._prefilling: Dict[int, dict] = {}
+
+        def round_fn(params, pools, table, last_tok, pos, active, kk):
+            def body(carry, _):
+                tok, pos, pools = carry
+                logits, pools = paged_decode_step(
+                    params, tok, pos, pools, table, active, cfg_d)
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                tok = jnp.where(active, nxt, tok)
+                pos = pos + active.astype(pos.dtype)
+                return (tok, pos, pools), tok
+
+            (tok, pos, pools), toks = lax.scan(
+                body, (last_tok, pos, pools), None, length=kk)
+            return tok, pos, pools, jnp.transpose(toks)  # (b, kk)
+
+        self._round_paged = jax.jit(round_fn, static_argnames=("kk",),
+                                    donate_argnums=(1,))
+
+        def chunk_fn(params, pools, table_row, toks, pos0, n_valid):
+            return paged_prefill_chunk(params, toks, pos0, n_valid,
+                                       pools, table_row, self.cfg)
+
+        self._chunk = jax.jit(chunk_fn, donate_argnums=(1,))
+        self._copy = jax.jit(copy_page, donate_argnums=(0,))
+
     # ---- request lifecycle ------------------------------------------
     def submit(self, prompt, max_new: int,
                eos_id: Optional[int] = None) -> int:
-        """Queue a request; returns its id (position in results)."""
+        """Queue a request; returns its id (position in results).
+        Any prompt with plen + max_new <= max_len is admissible (long
+        prompts stream through chunked prefill); only truly oversized
+        requests are rejected."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if len(prompt) == 0:
+            # an empty prompt has no last token to take logits from —
+            # the paged prefill would wedge at next=-1 and the dense
+            # prefill would index position -1; reject it cleanly
+            raise ValueError("empty prompt")
         if len(prompt) + max_new > self.max_len:
             raise ValueError(
                 f"prompt {len(prompt)} + max_new {max_new} exceeds "
                 f"max_len {self.max_len}")
-        if len(prompt) > self.buckets[-1]:
-            raise ValueError(
-                f"prompt length {len(prompt)} exceeds the largest "
-                f"prompt bucket {self.buckets[-1]}")
+        if self.paged:
+            need = -(-(len(prompt) + max_new) // self.page_size)
+            if need > self.n_pages - 1:
+                raise ValueError(
+                    f"request spans {need} pages but the pool holds "
+                    f"only {self.n_pages - 1} allocatable pages")
         rid = len(self._out)
         self._queue.append((rid, Request(prompt, max_new, eos_id)))
         self._out.append(None)
@@ -191,6 +317,8 @@ class DecodeServer:
         immediate eos retires the slot at once — the freed slot is
         re-offered to the queue in the same pass, and the completion
         count keeps step_round truthful about progress)."""
+        if self.paged:
+            return self._admit_paged()
         completed = 0
         slot = 0
         while slot < self.n_slots:
@@ -204,15 +332,28 @@ class DecodeServer:
                 self.metrics.histogram("serve.queue_wait_usec").observe(
                     (now - t_sub) * 1e6)
             plen = len(req.prompt)
-            bucket = _bucket(plen, self.buckets)
+            head = min(plen, self.buckets[-1])
+            bucket = _bucket(head, self.buckets)
             padded = np.zeros((1, bucket), np.int32)
-            padded[0, :plen] = req.prompt
+            padded[0, :head] = req.prompt[:head]
             row, first = self._prefill(
                 self.params, jnp.asarray(padded),
-                jnp.asarray([plen], jnp.int32))
+                jnp.asarray([head], jnp.int32))
+            # long prompt: extend the row past the bucket in jitted
+            # block_decode chunks (write-then-attend; the final
+            # chunk's last-position logits seed the first token)
+            off = head
+            while off < plen:
+                n = min(self._chunk_w, plen - off)
+                toks = np.zeros((1, self._chunk_w), np.int32)
+                toks[0, :n] = req.prompt[off:off + n]
+                first, row = self._extend(
+                    self.params, row, jnp.asarray(toks),
+                    jnp.int32(off), jnp.int32(n))
+                off += n
             self.cache = self._scatter(self.cache, row,
                                        jnp.int32(slot))
-            first = int(np.asarray(first)[0])
+            first = int(np.asarray(first).reshape(-1)[0])
             if t_sub is not None:
                 # first token is materialized on the host here: TTFT
                 # = submit -> first token (queue wait included)
@@ -234,12 +375,170 @@ class DecodeServer:
                 slot += 1
         return completed
 
+    # ---- paged admission / chunked prefill ---------------------------
+    def _try_map(self, slot: int, req: Request) -> bool:
+        """Reserve and map every page the request will ever touch
+        (positions 0..plen+max_new-1) into the slot's table row:
+        trie-shared leading pages are retained in place, the one
+        shared page the request must write into is copied-on-write,
+        the rest come fresh off the free list. All-at-admission
+        reservation means a mapped request can never stall mid-decode
+        on an empty pool — backpressure is an admission-time-only
+        phenomenon. Returns False (nothing mapped) when the pool
+        cannot cover it even after trie eviction."""
+        ps = self.page_size
+        plen = len(req.prompt)
+        need_pages = -(-(plen + req.max_new) // ps)
+        shared: List[int] = []
+        covered = 0
+        if self.trie is not None:
+            shared, covered = self.trie.match(req.prompt)
+        # always recompute at least the last prompt token (the first
+        # generated token needs its logits; the cache alone has none)
+        prefill_from = min(covered, plen - 1)
+        n_keep = min(len(shared), prefill_from // ps)
+        n_cow = len(shared) - n_keep      # 0 or 1 by construction
+        n_new = need_pages - n_keep       # COW copies + fresh pages
+        # pin every matched page across the eviction call: un-retained
+        # refcount-1 trie pages are exactly what evict() frees
+        for p in shared:
+            self.allocator.retain(p)
+        if not self.allocator.can_alloc(n_new):
+            if self.trie is not None:
+                ev = self.trie.evict(
+                    self.allocator,
+                    n_new - self.allocator.free_pages)
+                if ev:
+                    self.metrics.counter(
+                        "serve.trie_evictions").inc(ev)
+            if not self.allocator.can_alloc(n_new):
+                for p in shared:
+                    self.allocator.release(p)
+                return False
+        pages: List[int] = list(shared[:n_keep])
+        for src in shared[n_keep:]:
+            dst = self.allocator.alloc()
+            self.pools = self._copy(self.pools, jnp.int32(src),
+                                    jnp.int32(dst))
+            self.allocator.release(src)   # drop the COW pin
+            pages.append(dst)
+            self.metrics.counter("serve.cow_copies").inc()
+        for _ in range(need_pages - len(pages)):
+            pages.append(self.allocator.alloc())
+        self.table[slot, :] = 0
+        self.table[slot, :need_pages] = pages
+        self._slot_pages[slot] = pages
+        if covered > 0:
+            self.metrics.counter("serve.prefix_hits").inc()
+            self.metrics.counter("serve.prefix_tokens_shared").inc(
+                prefill_from)
+        self._prefilling[slot] = {
+            "req": req, "next": prefill_from, "plen": plen}
+        return True
+
+    def _release_slot_pages(self, slot: int) -> None:
+        for p in self._slot_pages[slot]:
+            self.allocator.release(p)
+        self._slot_pages[slot] = []
+        self.table[slot, :] = 0
+        self.active[slot] = False
+        self._prefilling.pop(slot, None)
+
+    def _admit_paged(self) -> int:
+        """Paged admission + the chunked-prefill tick. Head-of-line
+        FIFO: when the queue head cannot reserve its pages the whole
+        admission stalls (deterministic backpressure — decode rounds
+        keep draining, retirements free pages, the head admits next
+        round)."""
+        for slot in range(self.n_slots):
+            if self.req_of_slot[slot] is not None or not self._queue:
+                continue
+            rid, req = self._queue[0]
+            if not self._try_map(slot, req):
+                self.metrics.counter("serve.admission_stalls").inc()
+                break
+            self._queue.pop(0)
+            t_sub = self._submit_ts.pop(rid, None)
+            if t_sub is not None:
+                self.metrics.histogram(
+                    "serve.queue_wait_usec").observe(
+                    (time.perf_counter() - t_sub) * 1e6)
+            self.metrics.gauge("serve.queue_depth").set(
+                len(self._queue))
+            self.req_of_slot[slot] = rid
+            self._out[rid] = []
+        completed, _ = self._prefill_tick()
+        self._page_gauges()
+        return completed
+
+    def _prefill_tick(self) -> Tuple[int, bool]:
+        """Advance every prefilling slot by up to ``prefill_budget``
+        prompt tokens (None = finish it now) in page-aligned chunks.
+        Returns (requests completed at prefill time, any progress)."""
+        completed = 0
+        progressed = False
+        ps = self.page_size
+        for slot in list(self._prefilling):
+            st = self._prefilling[slot]
+            req, plen = st["req"], st["plen"]
+            budget = (plen if self.prefill_budget is None
+                      else self.prefill_budget)
+            logits = None
+            while st["next"] < plen and budget > 0:
+                a = st["next"]
+                end = min(plen, (a // ps + 1) * ps, a + budget)
+                n = end - a
+                toks = np.zeros((1, ps), np.int32)
+                toks[0, :n] = req.prompt[a:end]
+                logits, self.pools = self._chunk(
+                    self.params, self.pools,
+                    jnp.asarray(self.table[slot:slot + 1]),
+                    jnp.asarray(toks), jnp.int32(a), jnp.int32(n))
+                st["next"] = end
+                budget -= n
+                progressed = True
+                self.metrics.counter("serve.prefill_chunks").inc()
+            if st["next"] < plen:
+                continue  # budget spent; more chunks next round
+            # prefill complete: seed the first token, open decoding
+            first = int(np.asarray(
+                jnp.argmax(logits, axis=-1)).reshape(-1)[0])
+            rid = self.req_of_slot[slot]
+            t_sub = self._accept_ts.get(rid)
+            if t_sub is not None:
+                self.metrics.histogram("serve.ttft_usec").observe(
+                    (time.perf_counter() - t_sub) * 1e6)
+            self.metrics.counter("serve.tokens_out").inc()
+            self._out[rid] = [first]
+            self.pos[slot] = plen
+            self.last_tok[slot] = first
+            self.budget[slot] = req.max_new - 1
+            if req.eos_id is not None and first == req.eos_id:
+                self.budget[slot] = 0
+            self.active[slot] = True
+            del self._prefilling[slot]
+            if self.trie is not None:
+                self.trie.register(req.prompt, plen,
+                                   self.table[slot], self.allocator)
+            self._retire_if_done(slot)
+            if self.req_of_slot[slot] is None:
+                completed += 1
+        return completed, progressed
+
+    def _page_gauges(self) -> None:
+        self.metrics.gauge("serve.pages_in_use").set(
+            self.allocator.pages_in_use)
+        self.metrics.gauge("serve.pages_free").set(
+            self.allocator.free_pages)
+
     def _retire_if_done(self, slot: int):
         rid = self.req_of_slot[slot]
         if rid is None:
             return
         if self.budget[slot] <= 0:
             self.req_of_slot[slot] = None
+            if self.paged:
+                self._release_slot_pages(slot)
             self.metrics.counter("serve.requests_completed").inc()
             self._completed_log.append(
                 (rid, np.asarray(self._out[rid], np.int32)))
@@ -283,6 +582,8 @@ class DecodeServer:
             if self.req_of_slot[slot] == rid:
                 self.req_of_slot[slot] = None
                 self.budget[slot] = 0
+                if self.paged:
+                    self._release_slot_pages(slot)
                 self._canceled.add(rid)
                 self._accept_ts.pop(rid, None)
                 self.metrics.counter("serve.requests_canceled").inc()
@@ -308,36 +609,82 @@ class DecodeServer:
 
     # ---- the decode loop --------------------------------------------
     def step_round(self):
-        """Admit pending requests, run one jitted round of
-        ``round_len`` ragged decode steps, distribute tokens."""
+        """Admit pending requests, run one jitted round of ragged
+        decode steps (``round_len`` of them; paged mode clips the
+        round to the shortest active budget), distribute tokens."""
+        if self.paged:
+            return self._step_round_paged()
         completed = self._admit()
         if all(r is None for r in self.req_of_slot):
             return completed > 0
         active = sum(1 for r in self.req_of_slot if r is not None)
+        kk = self.round_len
+        if self.clip_rounds:
+            kk = max(1, min(kk, int(min(
+                self.budget[s] for s in range(self.n_slots)
+                if self.req_of_slot[s] is not None))))
         t0 = time.perf_counter()
         tok, pos, cache, toks = self._round(
             self.params, self.cache, jnp.asarray(self.last_tok),
-            jnp.asarray(self.pos), self.round_len)
+            jnp.asarray(self.pos), kk)
         self.cache = cache
         toks = np.asarray(toks)
         self.last_tok = np.asarray(tok).copy()
         self.pos = np.asarray(pos).copy()
         dt = time.perf_counter() - t0  # toks materialized: round done
+        self._observe_round(dt, kk, active)
+        self._distribute(toks, kk)
+        return True
+
+    def _step_round_paged(self):
+        """The paged round: admission + chunked-prefill tick, then a
+        budget-clipped decode round over the active slots, then token
+        distribution and page release."""
+        completed = self._admit()
+        if not self.active.any():
+            return completed > 0 or bool(self._prefilling)
+        active_slots = [s for s in range(self.n_slots)
+                        if self.active[s]]
+        kk = self.round_len
+        if self.clip_rounds:
+            kk = max(1, min(kk, int(min(self.budget[s]
+                                        for s in active_slots))))
+        t0 = time.perf_counter()
+        tok, pos, pools, toks = self._round_paged(
+            self.params, self.pools, jnp.asarray(self.table),
+            jnp.asarray(self.last_tok), jnp.asarray(self.pos),
+            jnp.asarray(self.active), kk)
+        self.pools = pools
+        toks = np.asarray(toks)
+        self.last_tok = np.asarray(tok).copy()
+        self.pos = np.asarray(pos).copy()
+        dt = time.perf_counter() - t0
+        self._observe_round(dt, kk, len(active_slots))
+        self._distribute(toks, kk, only_active=True)
+        self._page_gauges()
+        return True
+
+    def _observe_round(self, dt: float, kk: int, active: int) -> None:
         self.metrics.histogram("serve.round_usec").observe(dt * 1e6)
         self.metrics.histogram("serve.tok_usec").observe(
-            dt * 1e6 / self.round_len)
+            dt * 1e6 / kk)
         self.metrics.histogram("serve.occupancy_pct").observe(
             100.0 * active / self.n_slots)
         self.metrics.counter("serve.rounds").inc()
-        self.metrics.counter("serve.steps").inc(self.round_len)
+        self.metrics.counter("serve.steps").inc(kk)
         self.rounds_run += 1
-        self.steps_run += self.round_len
+        self.steps_run += kk
+
+    def _distribute(self, toks, kk: int,
+                    only_active: bool = False) -> None:
         tokens_out = self.metrics.counter("serve.tokens_out")
         for slot in range(self.n_slots):
             rid = self.req_of_slot[slot]
             if rid is None:
                 continue
-            take = int(min(self.budget[slot], self.round_len))
+            if only_active and not self.active[slot]:
+                continue  # mid-prefill: nothing decoded this round
+            take = int(min(self.budget[slot], kk))
             seq = toks[slot, :take].tolist()
             eos = self._eos[rid]
             if eos is not None and eos in seq:
@@ -348,7 +695,6 @@ class DecodeServer:
             self._out[rid].extend(seq)
             tokens_out.inc(len(seq))
             self._retire_if_done(slot)
-        return True
 
     def run(self) -> List[np.ndarray]:
         """Drive rounds until every submitted request completes."""
@@ -367,8 +713,13 @@ class DecodeServer:
         p50/p90/p99 estimated from the log2 buckets,
         metrics.hist_summary) — dashboards read quantiles, not raw
         28-bucket dumps. The bucket layout stays available through
-        ``self.metrics.snapshot()`` for anyone who wants it."""
+        ``self.metrics.snapshot()`` for anyone who wants it. Paged
+        servers add the allocator's own counters under ``pages``."""
         snap = self.metrics.snapshot()
         snap["histograms"] = {k: hist_summary(h)
                               for k, h in snap["histograms"].items()}
+        if self.paged:
+            snap["pages"] = self.allocator.stats()
+            if self.trie is not None:
+                snap["pages"]["trie_entries"] = self.trie.entries
         return snap

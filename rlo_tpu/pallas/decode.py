@@ -347,6 +347,262 @@ def write_kv_row(cache, row, pos, *, interpret: Optional[bool] = None):
     )(pos, row.astype(cache.dtype)[..., None], cache)
 
 
+def _write_page_row_kernel(page_ref, off_ref, row_ref, pool_ref,
+                           out_ref, *, ps: int):
+    """Write one (nkv, hd) row into lane ``off`` of pool page
+    ``page`` (grid = batch; the block index_map selected the page).
+    An off of ps (the DROP sentinel — inactive slots) matches no lane
+    and the write copies through, exactly like an out-of-range XLA
+    scatter index. Distinct active rows never share a page (the COW
+    invariant), so revisiting a block only happens for dropped writes
+    — identical output, benign."""
+    ib = pl.program_id(0)
+    col = jax.lax.broadcasted_iota(jnp.int32, (1, 1, 1, ps), 3)
+    out_ref[...] = jnp.where(col == off_ref[ib], row_ref[...],
+                             pool_ref[...])
+
+
+def write_kv_page_row(pool, row, page, off, *,
+                      interpret: Optional[bool] = None):
+    """Aliased paged row write: ``pool`` (P, kvh, hd, ps) — the paged
+    twin of write_kv_row — ``row`` (b, kvh, hd), ``page``/``off``
+    (b,) int32; row b lands at [page_b, :, :, off_b], off == ps drops
+    the write. Only the b touched pages move (~page bytes per slot
+    instead of the dense layout's whole 128-lane column across the
+    slot pool)."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    P, nkv, d, ps = pool.shape
+    b = row.shape[0]
+    page = jnp.minimum(jnp.asarray(page, jnp.int32).reshape(b), P - 1)
+    off = jnp.asarray(off, jnp.int32).reshape(b)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b,),
+        in_specs=[
+            pl.BlockSpec((1, nkv, d, 1),
+                         lambda ib, page_ref, off_ref: (ib, 0, 0, 0)),
+            pl.BlockSpec((1, nkv, d, ps),
+                         lambda ib, page_ref, off_ref: (
+                             page_ref[ib], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, nkv, d, ps),
+            lambda ib, page_ref, off_ref: (page_ref[ib], 0, 0, 0)),
+    )
+    return pl.pallas_call(
+        functools.partial(_write_page_row_kernel, ps=ps),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(pool.shape, pool.dtype),
+        input_output_aliases={3: 0},  # pool (after page, off, row)
+        interpret=interpret,
+    )(page, off, row.astype(pool.dtype)[..., None], pool)
+
+
+def _write_page_block_kernel(page_ref, off_ref, nv_ref, rows_ref,
+                             pool_ref, out_ref, *, T: int, ps: int):
+    """Write ``nv`` consecutive lanes starting at ``off0`` of ONE pool
+    page from a (nkv, hd, T) chunk — the chunked-prefill write. A
+    chunk never crosses a page boundary (off0 + nv <= ps, scheduled by
+    the server), so a single program owns every written lane: in-window
+    lanes pick their row through a (T, ps) one-hot contraction (the
+    _write_block_kernel technique), everything else copies through."""
+    off0 = off_ref[0]
+    nv = nv_ref[0]
+    col = jax.lax.broadcasted_iota(jnp.int32, (1, 1, 1, ps), 3)
+    t_iota = jax.lax.broadcasted_iota(jnp.int32, (T, ps), 0)
+    c_iota = jax.lax.broadcasted_iota(jnp.int32, (T, ps), 1)
+    onehot = ((c_iota == off0 + t_iota) &
+              (t_iota < nv)).astype(jnp.float32)
+    vals = jax.lax.dot_general(
+        rows_ref[...].astype(jnp.float32), onehot,
+        (((3,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(pool_ref.dtype)
+    in_window = (col >= off0) & (col < off0 + nv)
+    out_ref[...] = jnp.where(in_window, vals, pool_ref[...])
+
+
+def write_kv_page_block(pool, rows, page, off0, n_valid, *,
+                        interpret: Optional[bool] = None):
+    """Aliased paged chunk write: ``pool`` (P, kvh, hd, ps), ``rows``
+    (kvh, hd, T) seq-minor, scalars ``page``/``off0``/``n_valid`` —
+    token t < n_valid lands at [page, :, :, off0 + t]; pads beyond
+    n_valid never touch the pool. Requires off0 + n_valid <= ps (the
+    page-aligned chunk schedule guarantees it)."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    P, nkv, d, ps = pool.shape
+    T = rows.shape[2]
+    if T > ps:
+        raise ValueError(f"chunk T={T} exceeds page size {ps}")
+    page = jnp.minimum(jnp.asarray(page, jnp.int32).reshape(1), P - 1)
+    off0 = jnp.asarray(off0, jnp.int32).reshape(1)
+    nv = jnp.asarray(n_valid, jnp.int32).reshape(1)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(1,),
+        in_specs=[
+            pl.BlockSpec((1, nkv, d, T),
+                         lambda i, page_ref, off_ref, nv_ref: (
+                             0, 0, 0, 0)),
+            pl.BlockSpec((1, nkv, d, ps),
+                         lambda i, page_ref, off_ref, nv_ref: (
+                             page_ref[0], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, nkv, d, ps),
+            lambda i, page_ref, off_ref, nv_ref: (
+                page_ref[0], 0, 0, 0)),
+    )
+    return pl.pallas_call(
+        functools.partial(_write_page_block_kernel, T=T, ps=ps),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(pool.shape, pool.dtype),
+        input_output_aliases={4: 0},
+        interpret=interpret,
+    )(page, off0, nv, rows.astype(pool.dtype)[None], pool)
+
+
+def _paged_decode_kernel(pt_ref, pos_ref, q_ref, k_ref, v_ref, *rest,
+                         scale: float, n_k: int, ps: int,
+                         quant: bool, r: int, T: int):
+    """The paged twin of _decode_kernel: the grid's cache axis walks
+    the slot's LOGICAL pages (tile ik = positions [ik*ps, (ik+1)*ps))
+    while the BlockSpec index_map resolved the PHYSICAL page through
+    the prefetched table — masking and online-softmax accumulation are
+    position-identical to the dense kernel at bk = ps, so the page
+    indirection is invisible to the math. Unmapped tiles resolve to
+    the null page (zeros) and mask out entirely."""
+    if quant:
+        ks_ref, vs_ref, o_ref, m_s, l_s, o_s = rest
+    else:
+        o_ref, m_s, l_s, o_s = rest
+    ib = pl.program_id(0)
+    ik = pl.program_id(1)
+
+    @pl.when(ik == 0)
+    def _init():
+        m_s[...] = jnp.full_like(m_s[...], _NEG)
+        l_s[...] = jnp.zeros_like(l_s[...])
+        o_s[...] = jnp.zeros_like(o_s[...])
+
+    dot_dt = jnp.float32 if k_ref.dtype == jnp.float32 else jnp.bfloat16
+    q = q_ref[0].astype(dot_dt)                      # (g, T*r, d)
+    k = k_ref[0].astype(dot_dt)                      # (g, d, ps)
+    v = v_ref[0].astype(dot_dt)                      # (g, d, ps)
+    pos = pos_ref[ib]
+    base = ik * ps
+    row = base + jax.lax.broadcasted_iota(jnp.int32, (1, 1, ps), 2)
+    qoff = jax.lax.broadcasted_iota(jnp.int32, (1, T * r, 1), 1) // r
+    mask_row = row <= pos + qoff                     # (1, T*r, ps)
+    mask_col = row <= pos + (T - 1)                  # (1, 1, ps)
+
+    s = jax.lax.dot_general(q, k, (((2,), (1,)), ((0,), (0,))),
+                            preferred_element_type=jnp.float32) * scale
+    if quant:
+        s = s * ks_ref[0]                            # (g, 1, ps)
+    s = jnp.where(mask_row, s, _NEG)
+    v = jnp.where(mask_col, v, jnp.zeros((), dot_dt))
+
+    m = m_s[...]
+    m_new = jnp.maximum(m, s.max(axis=-1))
+    p = jnp.where(mask_row, jnp.exp(s - m_new[..., None]), 0.0)
+    corr = jnp.exp(m - m_new)
+    m_s[...] = m_new
+    l_s[...] = l_s[...] * corr + p.sum(axis=-1)
+    pv = jnp.where(mask_row, p * vs_ref[0], 0.0) if quant else p
+    o_s[...] = o_s[...] * corr[..., None] + jax.lax.dot_general(
+        pv.astype(dot_dt), v, (((2,), (2,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32)
+
+    @pl.when(ik == n_k - 1)
+    def _flush():
+        o_ref[0] = o_s[...] / l_s[...][..., None]
+
+
+def can_paged_flash(page_size: int, head_dim: int) -> bool:
+    """Shape gate for the paged decode kernel: a page must be a legal
+    128-lane cache block and head_dim lane-friendly (the
+    can_flash_decode rule at bk = page_size)."""
+    return page_size % 128 == 0 and (head_dim % 128 == 0
+                                     or head_dim == 64)
+
+
+def paged_flash_decode(q, k_pool, v_pool, table, pos0, scale,
+                       ks_pool=None, vs_pool=None, *,
+                       interpret: Optional[bool] = None):
+    """Fused paged decode attention: ``q`` (b, T, n_heads, head_dim)
+    with row b's query t at position pos0_b + t (T=1 is single-token
+    decode), pools (P, kv_heads, head_dim, ps) seq-minor pages,
+    ``table`` (b, mp) int32 mapping slot b's logical page i to its
+    physical page. The cache-axis grid walks logical pages and the
+    kernel streams the PHYSICAL page through VMEM via scalar-prefetch
+    indirection — HBM cache traffic is exactly the live pages' stored
+    bytes, shared prefix pages included. int8 pools pass
+    (P, kv_heads, ps) f32 scale sidecar pools. Returns
+    (b, T, n_heads, head_dim) f32."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    b, T, nh, d = q.shape
+    P, nkv, _, ps = k_pool.shape
+    mp = table.shape[1]
+    r = nh // nkv
+    R = T * r
+    quant = ks_pool is not None
+
+    qg = (q.reshape(b, T, nkv, r, d).transpose(0, 2, 1, 3, 4)
+          .reshape(b, nkv, R, d))
+    posv = jnp.asarray(pos0, jnp.int32)
+    posv = jnp.full((b,), posv) if posv.ndim == 0 else posv.reshape(b)
+    tablev = jnp.minimum(jnp.asarray(table, jnp.int32), P - 1)
+
+    q_spec = pl.BlockSpec((1, nkv, R, d),
+                          lambda ib, ik, pt, ps_: (ib, 0, 0, 0))
+    kv_spec = pl.BlockSpec((1, nkv, d, ps),
+                           lambda ib, ik, pt, ps_: (
+                               pt[ib, ik], 0, 0, 0))
+    in_specs = [q_spec, kv_spec, kv_spec]
+    args = [qg, k_pool, v_pool]
+    if quant:
+        s_spec = pl.BlockSpec((1, nkv, 1, ps),
+                              lambda ib, ik, pt, ps_: (
+                                  pt[ib, ik], 0, 0, 0))
+        in_specs += [s_spec, s_spec]
+        args += [ks_pool[:, :, None, :], vs_pool[:, :, None, :]]
+
+    kwargs = {}
+    if not interpret and pltpu is not None:
+        kwargs["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"))
+    if pltpu is not None:
+        scratch = [pltpu.VMEM((nkv, R), jnp.float32),
+                   pltpu.VMEM((nkv, R), jnp.float32),
+                   pltpu.VMEM((nkv, R, d), jnp.float32)]
+    else:  # pragma: no cover — interpret-only builds without pltpu
+        scratch = [jax.ShapeDtypeStruct((nkv, R), jnp.float32),
+                   jax.ShapeDtypeStruct((nkv, R), jnp.float32),
+                   jax.ShapeDtypeStruct((nkv, R, d), jnp.float32)]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, mp),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, nkv, R, d),
+                               lambda ib, ik, pt, ps_: (ib, 0, 0, 0)),
+        scratch_shapes=scratch,
+    )
+    out = pl.pallas_call(
+        functools.partial(_paged_decode_kernel, scale=float(scale),
+                          n_k=mp, ps=ps, quant=quant, r=r, T=T),
+        grid_spec=grid_spec,
+        out_shape=out_struct((b, nkv, R, d), jnp.float32, q, k_pool),
+        interpret=interpret,
+        **kwargs,
+    )(tablev, posv, *args)
+    return (out.reshape(b, nkv, T, r, d).transpose(0, 2, 1, 3, 4)
+            .reshape(b, T, nh, d))
+
+
 def can_flash_decode(max_len: int, head_dim: int,
                      block_k: int = _BLOCK_K) -> bool:
     """Shape gate: a lane-friendly head_dim, and a cache tile Mosaic

@@ -117,6 +117,125 @@ class StubBackend:
                 "queued": len(self._queue)}
 
 
+class PagedStubBackend(StubBackend):
+    """The paged server's page-accounting twin over the stub model
+    (docs/DESIGN.md §12): the SAME crc-chain tokens and slot
+    scheduling as StubBackend, plus the real ``PageAllocator`` /
+    ``PrefixTrie`` bookkeeping the paged ``DecodeServer`` runs —
+    admission reserves ceil((plen+max_new)/page_size) pages (minus
+    trie-shared prefix pages, COW-splitting the written one),
+    head-of-line backpressure when the pool is dry, completion
+    releases pages and registers the prompt's prefix. No device
+    arrays move, so fabric scenarios can exercise allocator churn,
+    prefix reuse, COW and eviction seed-deterministically."""
+
+    def __init__(self, n_slots: int = 4, round_len: int = 8,
+                 vocab: int = 32768, n_pages: int = 33,
+                 page_size: int = 8):
+        from rlo_tpu.serving.pages import PageAllocator, PrefixTrie
+        super().__init__(n_slots=n_slots, round_len=round_len,
+                         vocab=vocab)
+        self.alloc = PageAllocator(n_pages, page_size)
+        self.trie = PrefixTrie(page_size)
+        self._meta: Dict = {}    # key -> (prompt tuple, max_new)
+        self._pages: Dict = {}   # key -> owned pages, table order
+        self.prefix_hits = 0
+        self.cow_copies = 0
+        self.stalls = 0
+        self.evictions = 0
+
+    def submit(self, key, prompt: Sequence[int], max_new: int,
+               eos_id: Optional[int] = None) -> None:
+        if key in self._req:
+            return
+        super().submit(key, prompt, max_new, eos_id)
+        self._meta[key] = (tuple(int(t) for t in prompt), max_new)
+
+    def _reserve(self, key) -> bool:
+        """The stub twin of DecodeServer._try_map: trie match, COW the
+        written shared page, fresh pages for the rest; False (nothing
+        held) under pool pressure even after eviction."""
+        prompt, max_new = self._meta[key]
+        ps = self.alloc.page_size
+        plen = len(prompt)
+        need = -(-(plen + max_new) // ps)
+        shared, covered = self.trie.match(prompt)
+        prefill_from = min(covered, plen - 1)
+        n_keep = min(len(shared), prefill_from // ps)
+        n_new = need - n_keep
+        for p in shared:
+            self.alloc.retain(p)
+        if not self.alloc.can_alloc(n_new):
+            self.evictions += self.trie.evict(
+                self.alloc, n_new - self.alloc.free_pages)
+            if not self.alloc.can_alloc(n_new):
+                for p in shared:
+                    self.alloc.release(p)
+                return False
+        pages = list(shared[:n_keep])
+        for src in shared[n_keep:]:
+            pages.append(self.alloc.alloc())   # the COW copy
+            self.alloc.release(src)
+            self.cow_copies += 1
+        while len(pages) < need:
+            pages.append(self.alloc.alloc())
+        self._pages[key] = pages
+        if covered > 0:
+            self.prefix_hits += 1
+        return True
+
+    def _release(self, key) -> None:
+        for p in self._pages.pop(key, ()):
+            self.alloc.release(p)
+        self._meta.pop(key, None)
+
+    def cancel(self, key) -> bool:
+        ok = super().cancel(key)
+        if ok:
+            self._release(key)
+        else:
+            self._meta.pop(key, None)
+        return ok
+
+    def step_round(self) -> List[Tuple[object, Tuple[int, ...]]]:
+        # paged admission: FIFO with head-of-line backpressure, the
+        # paged DecodeServer's discipline — then the stock decode round
+        admitted: List = []
+        while self._queue and len(self._active) + len(admitted) \
+                < self.n_slots:
+            key = self._queue[0]
+            if not self._reserve(key):
+                self.stalls += 1
+                break
+            admitted.append(self._queue.pop(0))
+        # the parent round must admit exactly the RESERVED keys: park
+        # the backpressured tail out of its reach for the round
+        tail, self._queue = self._queue, admitted
+        done = super().step_round()
+        self._queue.extend(tail)
+        for key, _toks in done:
+            prompt, _ = self._meta.get(key, ((), 0))
+            if prompt and key in self._pages:
+                self.trie.register(prompt, len(prompt),
+                                   self._pages[key], self.alloc)
+            self._release(key)
+        return done
+
+    def has_work(self) -> bool:
+        return bool(self._req)
+
+    def stats(self) -> dict:
+        base = super().stats()
+        base.update(backend="paged_stub",
+                    pages=self.alloc.stats(),
+                    trie_entries=self.trie.entries,
+                    prefix_hits=self.prefix_hits,
+                    cow_copies=self.cow_copies,
+                    stalls=self.stalls,
+                    evictions=self.evictions)
+        return base
+
+
 class ModelBackend:
     """The real continuous-batching ``DecodeServer`` behind the
     backend face: fabric request keys map to server rids, completions

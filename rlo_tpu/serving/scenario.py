@@ -57,7 +57,10 @@ class FabricScenario:
                  n_slots: int = 2, round_len: int = 8,
                  decode_interval: float = 0.25,
                  engine_kw: Optional[dict] = None,
-                 check_acceptance: bool = True):
+                 check_acceptance: bool = True,
+                 paged_stub: bool = False, n_pages: int = 33,
+                 page_size: int = 8,
+                 prefix_pool: Optional[Sequence[Sequence[int]]] = None):
         self.ws = world_size
         self.seed = seed
         self.duration = duration
@@ -73,6 +76,15 @@ class FabricScenario:
         self.engine_kw = dict(FABRIC_ENGINE_KW if engine_kw is None
                               else engine_kw)
         self.check_acceptance = check_acceptance
+        # paged serving twin (docs/DESIGN.md §12): back every node
+        # with PagedStubBackend so allocator churn / COW / eviction /
+        # backpressure run under fabric chaos; ``prefix_pool`` makes
+        # submitted prompts share leading chunks (radix-reuse traffic)
+        self.paged_stub = paged_stub
+        self.n_pages = n_pages
+        self.page_size = page_size
+        self.prefix_pool = (None if prefix_pool is None else
+                            [tuple(p) for p in prefix_pool])
 
     def _replay_recipe(self) -> str:
         return (f"FabricScenario(world_size={self.ws}, "
@@ -94,11 +106,19 @@ class FabricScenario:
             ProgressEngine(world.transport(r), manager=mgr,
                            clock=world.clock, **self.engine_kw)
             for r in range(self.ws)]
+        def make_backend():
+            if self.paged_stub:
+                from rlo_tpu.serving.backend import PagedStubBackend
+                return PagedStubBackend(n_slots=self.n_slots,
+                                        round_len=self.round_len,
+                                        n_pages=self.n_pages,
+                                        page_size=self.page_size)
+            return StubBackend(n_slots=self.n_slots,
+                               round_len=self.round_len)
+
         def make_fabric(r: int) -> DecodeFabric:
             return DecodeFabric(
-                engines[r],
-                StubBackend(n_slots=self.n_slots,
-                            round_len=self.round_len),
+                engines[r], make_backend(),
                 decode_interval=self.decode_interval)
 
         fabrics: List[DecodeFabric] = [make_fabric(r)
@@ -153,6 +173,9 @@ class FabricScenario:
                         plen = rng.randrange(3, 10)
                         prompt = tuple(rng.randrange(1, 1 << 15)
                                        for _ in range(plen))
+                        if self.prefix_pool is not None:
+                            prompt = (self.prefix_pool[rng.randrange(
+                                len(self.prefix_pool))] + prompt)
                         max_new = rng.randrange(4, 24)
                         rid = fabrics[g].submit(prompt, max_new)
                         clean = (not partitioned and
@@ -205,6 +228,19 @@ class FabricScenario:
                                 f"rank {f.rank} never completed "
                                 f"clean-window request {rid} "
                                 f"(gateway {rid[0]})")
+            if self.paged_stub:
+                # page-leak check: with every request drained, the
+                # only live references are the trie's own (one per
+                # registered entry) — anything else is a leaked
+                # request/COW reservation
+                for f in live_fabrics:
+                    be = f.backend
+                    if be.alloc.pages_in_use != be.trie.entries:
+                        self._fail(
+                            f"rank {f.rank} leaked pages: "
+                            f"{be.alloc.pages_in_use} in use vs "
+                            f"{be.trie.entries} trie entries "
+                            f"({be.alloc.stats()})")
             places = {f.rank: (f.placement.key(),
                                tuple(f.placement.members))
                       for f in live_fabrics}
@@ -248,6 +284,11 @@ def make_fabric_scenario(kind: str, seed: int,
         duplication;
       - 'fabric_rejoin': kill + elastic rejoin under continuous load;
         the rejoined rank converges and takes ownership back.
+      - 'fabric_paged':  the fabric_kill shape over PagedStubBackend
+        nodes with a TIGHT page pool and a shared-prefix prompt mix —
+        allocator churn, radix reuse, COW, eviction and admission
+        backpressure all run under fail-over, and the end-of-run
+        page-leak check proves re-queues never strand a reservation.
     """
     import zlib
     rng = Random((zlib.crc32(kind.encode()) & 0xffff) * 1_000_003
@@ -287,6 +328,24 @@ def make_fabric_scenario(kind: str, seed: int,
         return FabricScenario(world_size=ws, seed=seed, script=script,
                               duration=240.0, decode_interval=1.0,
                               round_len=4)
+    if kind == "fabric_paged":
+        victim = 0  # see fabric_kill: the warm-up owner
+        gw = 1 + rng.randrange(ws - 1)
+        # two shared system prefixes spanning 1-2 full 8-token pages
+        prefixes = [tuple(rng.randrange(1, 1 << 15)
+                          for _ in range(8 * (1 + i % 2)))
+                    for i in range(2)]
+        script = (
+            [(2.0 + 1.5 * i, "submit", rng.randrange(ws), 2)
+             for i in range(5)] +
+            [(10.0, "submit", gw, 3),
+             (12.0, "kill", victim),
+             (14.0, "submit", gw, 3),
+             (40.0, "submit", 1 + rng.randrange(ws - 1), 2)])
+        return FabricScenario(world_size=ws, seed=seed, script=script,
+                              duration=150.0, decode_interval=1.0,
+                              paged_stub=True, n_pages=17,
+                              page_size=8, prefix_pool=prefixes)
     if kind == "fabric_rejoin":
         victim = 0  # see fabric_kill: the warm-up owner
         gw = 1 + rng.randrange(ws - 1)
@@ -305,4 +364,4 @@ def make_fabric_scenario(kind: str, seed: int,
 
 
 FABRIC_SCENARIO_KINDS = ("fabric_kill", "fabric_split",
-                         "fabric_rejoin")
+                         "fabric_rejoin", "fabric_paged")

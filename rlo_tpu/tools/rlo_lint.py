@@ -91,6 +91,7 @@ FABRIC_PY = "rlo_tpu/serving/fabric.py"
 #: inside the simulator — docs/DESIGN.md §11). Launchers, benchmarks,
 #: and observability tooling may use wall clocks freely.
 R5_FILES = (ENGINE_PY, "rlo_tpu/transport/base.py",
+            "rlo_tpu/serving/pages.py",
             "rlo_tpu/transport/loopback.py", "rlo_tpu/transport/sim.py",
             FABRIC_PY, "rlo_tpu/serving/placement.py",
             "rlo_tpu/serving/backend.py", "rlo_tpu/serving/scenario.py")

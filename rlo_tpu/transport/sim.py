@@ -589,7 +589,7 @@ SCENARIO_KINDS = ("partition", "restart", "burst", "mixed")
 #: here so the CLI sweep covers them without importing the serving
 #: layer up front
 FABRIC_SCENARIO_KINDS = ("fabric_kill", "fabric_split",
-                         "fabric_rejoin")
+                         "fabric_rejoin", "fabric_paged")
 
 ALL_SCENARIO_KINDS = SCENARIO_KINDS + FABRIC_SCENARIO_KINDS
 

@@ -215,6 +215,19 @@ def test_r5_fires_on_fabric_wallclock_leak(tree):
                "time.time" in f.msg for f in hits), hits
 
 
+def test_r5_fires_on_page_allocator_wallclock_leak(tree):
+    """The paged-KV allocator module (serving/pages.py) is in the
+    deterministic-replay scope (docs/DESIGN.md §12): page handout
+    order replays seed-exactly in fleet scenarios, so a wall-clock
+    (or module-random) dependency there is a finding."""
+    path = tree / "rlo_tpu/serving/pages.py"
+    path.write_text(path.read_text() +
+                    "\nimport time\n_T0 = time.time()\n")
+    hits = findings_for(tree, "R5")
+    assert any(f.file == "rlo_tpu/serving/pages.py" and
+               "time.time" in f.msg for f in hits), hits
+
+
 def test_r5_fires_on_wallclock_leak(tree):
     path = tree / "rlo_tpu/transport/sim.py"
     path.write_text(path.read_text() +

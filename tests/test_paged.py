@@ -1,0 +1,559 @@
+"""Paged KV cache + chunked prefill + radix prefix sharing
+(docs/DESIGN.md §12) — the oracle is unchanged from test_serve: every
+request's tokens equal its dense ``generate`` EXACTLY, for any stream
+shape, because pages, chunking, prefix sharing and COW are layout and
+scheduling changes, never numerics changes. On top of the parity
+oracle: allocator/trie unit semantics, exhaustion backpressure,
+page-leak freedom, interpret-mode parity for the paged pallas
+kernels, and the fabric's kill-mid-decode exactly-once story over the
+paged ModelBackend."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rlo_tpu.models.generate import generate
+from rlo_tpu.models.serve import DecodeServer
+from rlo_tpu.models.transformer import TransformerConfig, init_params
+from rlo_tpu.serving.pages import (PageAllocator, PageError,
+                                   PrefixTrie)
+from rlo_tpu.utils.metrics import Registry
+
+CFG = TransformerConfig(vocab=64, d_model=32, n_heads=4, n_layers=2,
+                        d_ff=64, dtype="float32")
+
+
+@pytest.fixture(scope="module")
+def setup():
+    return init_params(jax.random.PRNGKey(0), CFG)
+
+
+def dense_oracle(params, cfg, prompt, max_new):
+    out = generate(params, jnp.asarray(prompt, jnp.int32)[None, :],
+                   cfg, max_new=max_new)
+    return np.asarray(out)[0]
+
+
+# ---------------------------------------------------------------------------
+# allocator + trie units (rlo_tpu/serving/pages.py)
+# ---------------------------------------------------------------------------
+
+def test_allocator_lifecycle_and_errors():
+    a = PageAllocator(5, 8)
+    assert a.free_pages == 4           # page 0 is the null page
+    p1, p2 = a.alloc(), a.alloc()
+    assert (p1, p2) == (1, 2)          # LIFO hands out 1, 2, ...
+    a.retain(p1)
+    assert a.release(p1) is False      # still referenced
+    assert a.release(p1) is True
+    a.release(p2)
+    assert a.free_pages == 4 and a.pages_in_use == 0
+    # most recently freed is reused first
+    assert a.alloc() == p2
+    with pytest.raises(PageError):
+        a.release(0)                   # the null page is untouchable
+    with pytest.raises(PageError):
+        a.retain(4)                    # free page
+    a.release(p2)
+    with pytest.raises(PageError):
+        a.release(p2)                  # double free
+    # exhaustion returns None and counts
+    for _ in range(4):
+        assert a.alloc() is not None
+    assert a.alloc() is None and a.alloc_failures == 1
+
+
+def test_trie_match_register_evict():
+    a = PageAllocator(10, 4)
+    t = PrefixTrie(4)
+    prompt = list(range(10))           # 2 full pages + 2-token tail
+    pages = [a.alloc() for _ in range(3)]
+    assert t.register(prompt, 10, pages, a) == 3
+    assert a.refcount(pages[0]) == 2   # trie holds its own reference
+    # full prompt (and beyond) matches all three pages
+    m, cov = t.match(prompt + [99])
+    assert m == pages and cov == 10
+    # a full-page-only prefix matches just the full pages
+    m, cov = t.match(list(range(8)) + [77])
+    assert m == pages[:2] and cov == 8
+    # divergent first page: no match
+    assert t.match([5, 1, 2, 3]) == ([], 0)
+    # first-wins: re-registering identical chunks adds nothing
+    assert t.register(prompt, 10, [7, 8, 9], a) == 0
+    # release the request's own references; trie keeps pages alive
+    for p in pages:
+        a.release(p)
+    assert a.pages_in_use == 3 == t.entries
+    # eviction drops trie-only pages, leaf-most first
+    assert t.evict(a, 99) == 3
+    assert a.pages_in_use == 0 and t.entries == 0
+
+
+def test_trie_partial_tail_longest_match():
+    a = PageAllocator(10, 4)
+    t = PrefixTrie(4)
+    short, long_ = [1, 2, 3, 4, 5], [1, 2, 3, 4, 5, 6, 7]
+    t.register(short, 5, [a.alloc(), a.alloc()], a)
+    t.register(long_, 7, [t.match(short)[0][0], a.alloc()], a)
+    # the longer stored partial wins when both prefix the prompt
+    m, cov = t.match([1, 2, 3, 4, 5, 6, 7, 8])
+    assert cov == 7
+    m, cov = t.match([1, 2, 3, 4, 5, 9])
+    assert cov == 5
+
+
+# ---------------------------------------------------------------------------
+# paged server == dense generate (the parity oracle)
+# ---------------------------------------------------------------------------
+
+def test_paged_stream_matches_dense(setup):
+    """8 mixed requests through 3 slots over 8-token pages: prompts
+    span 1-4 pages, slots are reused, and every result equals the
+    dense generate bit-for-bit."""
+    params = setup
+    rng = np.random.default_rng(0)
+    srv = DecodeServer(params, CFG, n_slots=3, max_len=96,
+                       round_len=5, paged=True, page_size=8)
+    reqs = []
+    for _ in range(8):
+        plen = int(rng.integers(3, 30))
+        max_new = int(rng.integers(1, 20))
+        prompt = rng.integers(0, CFG.vocab, (plen,))
+        reqs.append((prompt, max_new))
+        srv.submit(prompt, max_new)
+    outs = srv.run()
+    assert len(outs) == 8
+    for (prompt, max_new), got in zip(reqs, outs):
+        np.testing.assert_array_equal(
+            got, dense_oracle(params, CFG, prompt, max_new))
+    # everything was released: only the radix cache still holds pages
+    assert srv.allocator.pages_in_use == srv.trie.entries
+
+
+@pytest.mark.parametrize("variant", ["gqa_rope", "int8"])
+def test_paged_variants(setup, variant):
+    cfg = (dataclasses.replace(CFG, n_kv_heads=2, pos_encoding="rope")
+           if variant == "gqa_rope"
+           else dataclasses.replace(CFG, kv_cache_dtype="int8"))
+    params = init_params(jax.random.PRNGKey(3), cfg)
+    rng = np.random.default_rng(3)
+    srv = DecodeServer(params, cfg, n_slots=2, max_len=64,
+                       round_len=3, paged=True, page_size=8)
+    reqs = [(rng.integers(0, cfg.vocab, (int(rng.integers(3, 20)),)),
+             int(rng.integers(2, 10))) for _ in range(5)]
+    for p, m in reqs:
+        srv.submit(p, m)
+    outs = srv.run()
+    for (p, m), got in zip(reqs, outs):
+        np.testing.assert_array_equal(got,
+                                      dense_oracle(params, cfg, p, m))
+
+
+def test_paged_eos_and_late_submission(setup):
+    """eos early-exit frees pages mid-stream and late submissions
+    join the running pool — both with exact dense parity."""
+    params = setup
+    rng = np.random.default_rng(2)
+    prompt = rng.integers(0, CFG.vocab, (7,))
+    dense = dense_oracle(params, CFG, prompt, 16)
+    eos = int(dense[3])
+    srv = DecodeServer(params, CFG, n_slots=1, max_len=64,
+                       round_len=4, paged=True, page_size=8)
+    srv.submit(prompt, 16, eos_id=eos)
+    srv.step_round()
+    late = rng.integers(0, CFG.vocab, (6,))
+    srv.submit(late, 5)
+    outs = srv.run()
+    want = dense[:list(dense).index(eos) + 1]
+    np.testing.assert_array_equal(outs[0], want)
+    np.testing.assert_array_equal(outs[1],
+                                  dense_oracle(params, CFG, late, 5))
+
+
+def test_prefix_shared_admission_matches_dense(setup):
+    """Requests sharing a 16-token system prefix map the same
+    physical pages (radix reuse: prefill skipped for the shared full
+    pages) and still decode bit-identically to dense."""
+    params = setup
+    rng = np.random.default_rng(4)
+    reg = Registry()
+    srv = DecodeServer(params, CFG, n_slots=2, max_len=64,
+                       round_len=4, paged=True, page_size=8,
+                       metrics=reg)
+    sys_p = rng.integers(0, CFG.vocab, (16,))
+    reqs = [(np.concatenate([sys_p,
+                             rng.integers(0, CFG.vocab, (t,))]), 8)
+            for t in (5, 9, 3)]
+    for p, m in reqs:
+        srv.submit(p, m)
+    outs = srv.run()
+    for (p, m), got in zip(reqs, outs):
+        np.testing.assert_array_equal(got,
+                                      dense_oracle(params, CFG, p, m))
+    snap = srv.stats()
+    assert snap["counters"]["serve.prefix_hits"] >= 1
+    # at least the two full prefix pages were served from the cache
+    assert snap["counters"]["serve.prefix_tokens_shared"] >= 16
+
+
+def test_exact_duplicate_prompt_cow(setup):
+    """An exact resubmission takes the full radix hit (only the last
+    prompt token recomputed) and its first decode write lands in a
+    shared page — the copy-on-write path — with identical tokens."""
+    params = setup
+    rng = np.random.default_rng(5)
+    reg = Registry()
+    srv = DecodeServer(params, CFG, n_slots=1, max_len=64,
+                       round_len=4, paged=True, page_size=8,
+                       metrics=reg)
+    prompt = rng.integers(0, CFG.vocab, (13,))
+    srv.submit(prompt, 6)
+    srv.run()
+    srv.submit(prompt.copy(), 9)   # resubmission, different budget
+    outs = srv.run()
+    np.testing.assert_array_equal(
+        outs[1], dense_oracle(params, CFG, prompt, 9))
+    snap = srv.stats()
+    assert snap["counters"]["serve.prefix_hits"] == 1
+    assert snap["counters"]["serve.cow_copies"] >= 1
+    # the duplicate's whole prompt except the last token was shared
+    assert snap["counters"]["serve.prefix_tokens_shared"] == 12
+
+
+def test_allocator_exhaustion_backpressure(setup):
+    """A pool too small for every request at once: admission stalls
+    (head-of-line, counted), decode drains, freed pages admit the
+    rest — every request still completes with dense parity and no
+    page leaks."""
+    params = setup
+    rng = np.random.default_rng(6)
+    reg = Registry()
+    # 8 usable pages; each request spans 4 (plen 8 + max_new 24 over
+    # 8-token pages), so only two can ever be resident
+    srv = DecodeServer(params, CFG, n_slots=3, max_len=64,
+                       round_len=4, paged=True, page_size=8,
+                       n_pages=9, metrics=reg)
+    reqs = [(rng.integers(0, CFG.vocab, (8,)), 24) for _ in range(4)]
+    for p, m in reqs:
+        srv.submit(p, m)
+    outs = srv.run()
+    for (p, m), got in zip(reqs, outs):
+        np.testing.assert_array_equal(got,
+                                      dense_oracle(params, CFG, p, m))
+    assert reg.snapshot()["counters"]["serve.admission_stalls"] >= 1
+    assert srv.allocator.pages_in_use == srv.trie.entries
+
+
+def test_oversized_request_rejected(setup):
+    srv = DecodeServer(setup, CFG, n_slots=1, max_len=64,
+                       round_len=4, paged=True, page_size=8,
+                       n_pages=5)
+    with pytest.raises(ValueError, match="max_len"):
+        srv.submit(np.zeros(60, np.int32), 20)
+    with pytest.raises(ValueError, match="pool"):
+        # fits max_len but spans more pages than the pool holds
+        srv.submit(np.zeros(30, np.int32), 20)
+    # an empty prompt has no last token whose logits could seed the
+    # first generation — rejected cleanly in BOTH modes (the paged
+    # prefill would otherwise wedge at next=-1 forever)
+    with pytest.raises(ValueError, match="empty"):
+        srv.submit(np.zeros(0, np.int32), 4)
+    dense = DecodeServer(setup, CFG, n_slots=1, max_len=64,
+                         round_len=4, prompt_buckets=(8,))
+    with pytest.raises(ValueError, match="empty"):
+        dense.submit(np.zeros(0, np.int32), 4)
+
+
+def test_prefill_budget_interleaves_chunks(setup):
+    """A finite prefill budget spreads a long prompt's chunks across
+    rounds (decode of other slots proceeds between them) without
+    changing any tokens."""
+    params = setup
+    rng = np.random.default_rng(7)
+    reg = Registry()
+    srv = DecodeServer(params, CFG, n_slots=2, max_len=96,
+                       round_len=3, paged=True, page_size=8,
+                       prefill_budget=8, metrics=reg)
+    short = (rng.integers(0, CFG.vocab, (4,)), 12)
+    long_ = (rng.integers(0, CFG.vocab, (29,)), 6)   # 4 chunks
+    srv.submit(short[0], short[1])
+    srv.submit(long_[0], long_[1])
+    outs = srv.run()
+    for (p, m), got in zip((short, long_), outs):
+        np.testing.assert_array_equal(got,
+                                      dense_oracle(params, CFG, p, m))
+    assert reg.snapshot()["counters"]["serve.prefill_chunks"] >= 4
+
+
+def test_clipped_rounds_beat_dense_slot_steps(setup):
+    """Budget-clipped rounds: the paged server spends strictly fewer
+    slot-steps than the fixed-round dense server on a mixed-budget
+    stream (the serve_bench poisson win, in miniature)."""
+    params = setup
+    rng = np.random.default_rng(8)
+    reqs = [(rng.integers(0, CFG.vocab, (int(rng.integers(3, 12)),)),
+             int(rng.integers(2, 15))) for _ in range(6)]
+    dense = DecodeServer(params, CFG, n_slots=2, max_len=64,
+                         round_len=5, prompt_buckets=(8, 16))
+    paged = DecodeServer(params, CFG, n_slots=2, max_len=64,
+                         round_len=5, paged=True, page_size=8)
+    for p, m in reqs:
+        dense.submit(p, m)
+        paged.submit(p, m)
+    outs_d = dense.run()
+    outs_p = paged.run()
+    for a, b in zip(outs_d, outs_p):
+        np.testing.assert_array_equal(a, b)
+    assert paged.steps_run < dense.steps_run
+
+
+def test_paged_telemetry_surface(setup):
+    """The §12 page-pool telemetry flows through the PR-2 registry:
+    pages gauges, prefix/COW/chunk counters, and the allocator block
+    in stats()."""
+    params = setup
+    rng = np.random.default_rng(9)
+    reg = Registry()
+    srv = DecodeServer(params, CFG, n_slots=2, max_len=64,
+                       round_len=4, paged=True, page_size=8,
+                       metrics=reg)
+    p = rng.integers(0, CFG.vocab, (10,))
+    srv.submit(p, 6)
+    srv.run()
+    srv.submit(p.copy(), 4)   # radix hit against the finished run
+    srv.run()
+    snap = srv.stats()
+    assert snap["gauges"]["serve.pages_in_use"] == \
+        srv.allocator.pages_in_use
+    assert snap["gauges"]["serve.pages_free"] == \
+        srv.allocator.free_pages
+    for key in ("serve.prefix_hits", "serve.cow_copies",
+                "serve.prefill_chunks"):
+        assert key in snap["counters"]
+    pages = snap["pages"]
+    assert pages["page_size"] == 8
+    assert pages["pages_in_use"] + pages["pages_free"] == \
+        srv.n_pages - 1
+    assert pages["trie_entries"] == srv.trie.entries
+
+
+# ---------------------------------------------------------------------------
+# paged pallas kernels (interpret mode — the TPU path's numerics twin)
+# ---------------------------------------------------------------------------
+
+def test_write_kv_page_row_kernel_matches_scatter():
+    from rlo_tpu.pallas.decode import write_kv_page_row
+    rng = np.random.default_rng(0)
+    P, nkv, d, ps = 6, 2, 64, 128
+    pool = jnp.asarray(rng.standard_normal((P, nkv, d, ps)),
+                       jnp.float32)
+    row = jnp.asarray(rng.standard_normal((3, nkv, d)), jnp.float32)
+    page = jnp.asarray([2, 0, 5], jnp.int32)
+    off = jnp.asarray([17, ps, 3], jnp.int32)   # ps = drop sentinel
+    got = np.asarray(write_kv_page_row(pool, row, page, off,
+                                       interpret=True))
+    want = np.asarray(pool).copy()
+    want[2, :, :, 17] = row[0]
+    want[5, :, :, 3] = row[2]                   # row 1 dropped
+    np.testing.assert_array_equal(got, want)
+
+
+def test_write_kv_page_block_kernel_matches_slice():
+    from rlo_tpu.pallas.decode import write_kv_page_block
+    rng = np.random.default_rng(1)
+    P, nkv, d, ps = 6, 2, 64, 128
+    pool = jnp.asarray(rng.standard_normal((P, nkv, d, ps)),
+                       jnp.float32)
+    rows = jnp.asarray(rng.standard_normal((nkv, d, 32)), jnp.float32)
+    got = np.asarray(write_kv_page_block(pool, rows, 4, 90, 20,
+                                         interpret=True))
+    want = np.asarray(pool).copy()
+    want[4, :, :, 90:110] = np.asarray(rows)[:, :, :20]  # pads dropped
+    np.testing.assert_array_equal(got, want)
+
+
+@pytest.mark.parametrize("T", [1, 4])
+def test_paged_flash_decode_matches_gather_einsum(T):
+    from rlo_tpu.models.generate import _attend_cache_block
+    from rlo_tpu.models.paged import paged_view
+    from rlo_tpu.pallas.decode import paged_flash_decode
+    rng = np.random.default_rng(2)
+    P, nkv, d, ps, b, mp, nh = 7, 2, 64, 128, 3, 4, 4
+    kp = jnp.asarray(rng.standard_normal((P, nkv, d, ps)), jnp.float32)
+    vp = jnp.asarray(rng.standard_normal((P, nkv, d, ps)), jnp.float32)
+    table = jnp.asarray(rng.integers(0, P, (b, mp)), jnp.int32)
+    pos0 = jnp.asarray([200, 37, 410], jnp.int32)
+    q = jnp.asarray(rng.standard_normal((b, T, nh, d)), jnp.float32)
+    got = paged_flash_decode(q, kp, vp, table, pos0, 0.125,
+                             interpret=True)
+    kg, vg, _, _ = paged_view({"k": kp, "v": vp}, table)
+    pos_q = pos0[:, None] + jnp.arange(T)[None, :]
+    want = _attend_cache_block(q, kg, vg, pos_q, 0.125,
+                               use_flash=False)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_paged_flash_decode_int8_scales():
+    from rlo_tpu.models.generate import (_attend_cache_block,
+                                         _quantize_kv)
+    from rlo_tpu.models.paged import paged_view
+    from rlo_tpu.pallas.decode import paged_flash_decode
+    rng = np.random.default_rng(3)
+    P, nkv, d, ps, b, mp, nh = 5, 2, 64, 128, 2, 3, 4
+    kf = jnp.asarray(rng.standard_normal((P, nkv, ps, d)), jnp.float32)
+    vf = jnp.asarray(rng.standard_normal((P, nkv, ps, d)), jnp.float32)
+    kq, ks = _quantize_kv(kf)
+    vq, vs = _quantize_kv(vf)
+    kq, vq = kq.transpose(0, 1, 3, 2), vq.transpose(0, 1, 3, 2)
+    table = jnp.asarray(rng.integers(0, P, (b, mp)), jnp.int32)
+    pos0 = jnp.asarray([150, 40], jnp.int32)
+    q = jnp.asarray(rng.standard_normal((b, 1, nh, d)), jnp.float32)
+    got = paged_flash_decode(q, kq, vq, table, pos0, 0.125, ks, vs,
+                             interpret=True)
+    kg, vg, ksg, vsg = paged_view(
+        {"k": kq, "v": vq, "ks": ks, "vs": vs}, table)
+    want = _attend_cache_block(q, kg, vg, pos0[:, None], 0.125,
+                               k_scale=ksg, v_scale=vsg,
+                               use_flash=False)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=3e-2, atol=3e-2)
+
+
+# ---------------------------------------------------------------------------
+# the paged stub backend + fabric scenarios (docs/DESIGN.md §11+§12)
+# ---------------------------------------------------------------------------
+
+def test_paged_stub_backend_accounting():
+    from rlo_tpu.serving.backend import PagedStubBackend, stub_tokens
+    be = PagedStubBackend(n_slots=3, round_len=8, n_pages=9,
+                          page_size=8)
+    # three 4-page requests through an 8-page pool: the third has a
+    # slot but no pages — head-of-line backpressure
+    keys = ["a", "b", "c"]
+    for k in keys:
+        be.submit(k, (1, 2, 3, 4, 5, 6, 7, 8), 24)
+    done = {}
+    for _ in range(30):
+        for k, toks in be.step_round():
+            done[k] = toks
+        if not be.has_work():
+            break
+    assert set(done) == set(keys)
+    for k in keys:
+        assert done[k] == stub_tokens((1, 2, 3, 4, 5, 6, 7, 8), 24)
+    assert be.stalls >= 1          # backpressure actually happened
+    assert be.prefix_hits >= 1     # identical prompts share pages
+    # drained: only the radix cache still references pages
+    assert be.alloc.pages_in_use == be.trie.entries
+    st = be.stats()
+    assert st["backend"] == "paged_stub" and "pages" in st
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_fabric_paged_scenario_kind(seed):
+    """The fabric_paged chaos shape: kill mid-decode over paged stub
+    backends with a tight pool and shared-prefix traffic — the
+    scenario's own property checks (exactly-once, oracle tokens,
+    drained, page-leak-free) are the assertions."""
+    from rlo_tpu.transport.sim import make_scenario
+    res = make_scenario("fabric_paged", seed).run()
+    assert res["submitted"] > 0
+    assert res["requeues"] >= 1    # the kill actually orphaned work
+
+
+def test_fabric_kill_paged_model_backend_exactly_once(setup):
+    """3-rank fabric over the REAL paged DecodeServer: the owner dies
+    mid-decode, the re-queued request re-prefills (radix cache cold on
+    the survivor) and completes exactly once with oracle tokens."""
+    from rlo_tpu.engine import EngineManager, ProgressEngine
+    from rlo_tpu.serving.backend import ModelBackend
+    from rlo_tpu.serving.fabric import DecodeFabric
+    from rlo_tpu.transport.sim import SimWorld
+
+    params = setup
+    n_ranks = 3
+    world = SimWorld(n_ranks, seed=0)
+    mgr = EngineManager()
+    engines = [ProgressEngine(world.transport(r), manager=mgr,
+                              clock=world.clock, failure_timeout=6.0,
+                              heartbeat_interval=1.0, arq_rto=1.5,
+                              arq_max_retries=6, op_deadline=20.0)
+               for r in range(n_ranks)]
+    fabrics = [DecodeFabric(
+        engines[r],
+        ModelBackend(DecodeServer(params, CFG, n_slots=2, max_len=64,
+                                  round_len=4, paged=True,
+                                  page_size=8)),
+        decode_interval=1.0) for r in range(n_ranks)]
+    rng = np.random.default_rng(1)
+    prompt = tuple(int(t) for t in rng.integers(0, CFG.vocab, (6,)))
+    rid = fabrics[1].submit(prompt, 14)
+    live = {0, 1, 2}
+    killed = False
+    while world.now < 90.0:
+        if not killed and world.now >= 2.5:
+            killed = True
+            world.kill_rank(0)
+            engines[0].cleanup()
+            live.discard(0)
+        world.step()
+        mgr.progress_all()
+        for r in sorted(live):
+            fabrics[r].pump()
+        if killed and all(fabrics[r].result(rid) is not None
+                          for r in live):
+            break
+    assert killed
+    want = tuple(int(t) for t in dense_oracle(params, CFG, prompt, 14))
+    for r in sorted(live):
+        assert fabrics[r].result(rid) == want, f"rank {r} diverged"
+    # exactly-once client delivery despite the re-queue
+    for r in sorted(live):
+        assert fabrics[r].completions.count(rid) == 1
+
+
+# ---------------------------------------------------------------------------
+# the ARQ due-heap gate (ROADMAP item 2 starter, engine.py)
+# ---------------------------------------------------------------------------
+
+def test_arq_due_heap_gates_scan_and_preserves_retransmit():
+    """The due-list gate: before the earliest deadline the tick is a
+    pure heap peek (no retransmits); past it, the sweep fires exactly
+    as before; an ACK turns heap entries stale and they are popped
+    lazily without a scan."""
+    from rlo_tpu.engine import EngineManager, ProgressEngine
+    from rlo_tpu.transport.loopback import LoopbackWorld
+
+    clock = [0.0]
+    world = LoopbackWorld(2, latency=0, seed=3)
+    mgr = EngineManager()
+    engines = [ProgressEngine(world.transport(r), manager=mgr,
+                              arq_rto=1.0, clock=lambda: clock[0])
+               for r in range(2)]
+    e = engines[0]
+    world.drop_next(0, 1)              # lose the first frame 0 -> 1
+    e.bcast(b"hello")
+    assert e.arq_unacked() >= 1 and len(e._arq_due) >= 1
+    # not due: the gate short-circuits, nothing retransmitted
+    clock[0] = 0.5
+    e._arq_tick()
+    assert e.arq_retransmits == 0
+    # due: the sweep fires
+    clock[0] = 1.5
+    e._arq_tick()
+    assert e.arq_retransmits >= 1
+    # drain: ACKs flow, queues empty, stale heap entries get popped
+    for _ in range(50):
+        mgr.progress_all()
+        if e.arq_unacked() == 0:
+            break
+    assert e.arq_unacked() == 0
+    clock[0] = 10.0
+    e._arq_tick()                      # pops stale entries, no sweep
+    assert e._arq_wake(clock[0]) is False
+    for eng in engines:
+        eng.cleanup()

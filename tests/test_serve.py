@@ -131,6 +131,26 @@ def test_slot_reuse_no_stale_leak(setup):
         outs[1], dense_oracle(params, CFG, short_p, 12))
 
 
+def test_long_prompt_exceeds_largest_bucket(setup):
+    """Prompts LONGER than the largest bucket are admissible now:
+    admission prefills the bucket-sized head and extends through
+    jitted block_decode chunks — dense-generate parity holds for any
+    plen <= max_len - max_new (docs/DESIGN.md §12 satellite)."""
+    params = setup
+    rng = np.random.default_rng(13)
+    srv = DecodeServer(params, CFG, n_slots=2, max_len=96,
+                       round_len=4, prompt_buckets=(8, 16))
+    reqs = [(rng.integers(0, CFG.vocab, (30,)), 10),   # 1 chunk
+            (rng.integers(0, CFG.vocab, (41,)), 7),    # 2 chunks
+            (rng.integers(0, CFG.vocab, (5,)), 6)]     # in-bucket
+    for p, m in reqs:
+        srv.submit(p, m)
+    outs = srv.run()
+    for (p, m), got in zip(reqs, outs):
+        np.testing.assert_array_equal(got,
+                                      dense_oracle(params, CFG, p, m))
+
+
 def test_errors(setup):
     srv = DecodeServer(setup, CFG, n_slots=1, max_len=16,
                        prompt_buckets=(8,))
