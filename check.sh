@@ -78,7 +78,11 @@ JAX_PLATFORMS=cpu python -m rlo_tpu.transport.sim --seeds 25
 echo "== engine bench smoke + perf gate (BENCH_engine.json) =="
 # message-engine throughput at the committed-baseline (--quick) config,
 # gated against the committed numbers: wall metrics at generous factors,
-# seed-deterministic frame counts at zero tolerance — docs/DESIGN.md §10
+# seed-deterministic frame counts at zero tolerance — docs/DESIGN.md §10.
+# Includes the round-13 native_batched leg (batched vs one-call-per-
+# frame driving, ARQ+metrics+profiler on; the bench itself asserts the
+# >=5x bar); the full (non-quick) run's tcp leg drives the socket mesh
+# through the batched GIL-releasing pump — docs/DESIGN.md §13
 fresh_engine=$(mktemp -t rlo_bench_engine.XXXXXX)
 JAX_PLATFORMS=cpu python benchmarks/engine_bench.py --quick \
     --out "$fresh_engine" > /dev/null

@@ -791,6 +791,7 @@ class MpiBackend(Backend):
         self.world._w = w
         self.world.world_size = lib.rlo_world_size(w)
         self.world.engines = []
+        self.world.colls = []
         self.world_size = self.world.world_size
         self.rank = lib.rlo_world_my_rank(w)
         # position within this communicator (== rank for the full

@@ -205,6 +205,13 @@ def load() -> C.CDLL:
     sig("rlo_world_quiescent", C.c_int, [p])
     sig("rlo_world_sent_cnt", C.c_int64, [p])
     sig("rlo_world_delivered_cnt", C.c_int64, [p])
+    sig("rlo_engine_progress_n", C.c_int64,
+        [p, C.c_int64, C.c_uint64])
+    sig("rlo_world_progress_all_n", C.c_int64,
+        [p, C.c_int64, C.c_uint64])
+    sig("rlo_engine_frames_dispatched", C.c_int64, [p])
+    sig("rlo_engine_arq_heap_len", C.c_int64, [p])
+    sig("rlo_engine_arq_scan_gated", C.c_int64, [p])
     sig("rlo_engine_new", p,
         [p, C.c_int, C.c_int, _JUDGE_CB, p, _ACTION_CB, p, C.c_int64])
     sig("rlo_engine_new_sub", p,
@@ -287,9 +294,31 @@ class NativeWorld:
             raise ValueError(f"world_size must be >= 2, got {world_size}")
         self.world_size = world_size
         self.engines: List["NativeEngine"] = []
+        #: NativeColl instances bound to this world — closed before the
+        #: world is freed (pooled objects must never outlive the world
+        #: that owns their freelists, rlo_internal.h pool rules)
+        self.colls: List["NativeColl"] = []
 
     def progress_all(self) -> None:
         self._lib.rlo_progress_all(self._w)
+
+    def progress_n(self, max_frames: int = 0,
+                   deadline_usec: int = 0) -> int:
+        """Batched progress (docs/DESIGN.md §13): loop progress sweeps
+        INSIDE C until ``max_frames`` frames were processed (0 = no
+        budget), ``deadline_usec`` microseconds elapsed (0 = no
+        deadline), or — with no deadline — the first fruitless sweep
+        with a quiescent transport. Returns frames processed. ctypes
+        releases the GIL for the call's whole duration, so one Python
+        crossing progresses thousands of frames (and with a deadline
+        the call is a GIL-released poll-wait — the serving-pump
+        shape). Re-entrant calls (from a judge/action callback) are
+        no-ops returning 0."""
+        rc = self._lib.rlo_world_progress_all_n(
+            self._w, max_frames, deadline_usec)
+        if rc < 0:
+            raise RuntimeError(f"progress_n failed ({rc})")
+        return rc
 
     def quiescent(self) -> bool:
         return bool(self._lib.rlo_world_quiescent(self._w))
@@ -401,6 +430,8 @@ class NativeWorld:
     def close(self) -> None:
         for e in list(self.engines):
             e.close()
+        for c in list(getattr(self, "colls", [])):
+            c.close()
         if self._w:
             self._lib.rlo_world_free(self._w)
             self._w = None
@@ -448,12 +479,16 @@ class NativeColl:
                 raise ValueError(
                     f"bad subset for rank {rank}: members={ms} (need "
                     f"2..64 in-range members including this rank)")
+        getattr(world, "colls", []).append(self)
         self._keep = None  # buffers pinned while an op is in flight
 
     def close(self) -> None:
         if self._c:
             self._lib.rlo_coll_free(self._c)
             self._c = None
+        colls = getattr(self.world, "colls", None)
+        if colls is not None and self in colls:
+            colls.remove(self)
 
     def __del__(self):  # pragma: no cover
         try:
@@ -680,6 +715,37 @@ class NativeEngine:
         self._check(self._lib.rlo_pickup_consume(self._e))
         return NativeUserMsg(type=tag.value, origin=origin.value,
                              pid=pid.value, vote=vote.value, data=data)
+
+    def progress(self, max_frames: int = 0,
+                 deadline_usec: int = 0) -> int:
+        """Batched single-engine progress (docs/DESIGN.md §13): loop
+        THIS engine's progress turns inside C until the budget fills,
+        the deadline expires, or — with no deadline — the first
+        fruitless turn (it never spins on other engines' traffic, so
+        one-frame-at-a-time stepping is ``progress(max_frames=1)``).
+        Returns frames processed; the GIL is released throughout."""
+        rc = self._lib.rlo_engine_progress_n(
+            self._e, max_frames, deadline_usec)
+        if rc < 0:
+            raise RuntimeError(f"progress failed ({rc})")
+        return rc
+
+    @property
+    def frames_dispatched(self) -> int:
+        """Lifetime frames this engine polled off the transport (every
+        polled frame counts: ACKs, heartbeats, duplicates)."""
+        return self._lib.rlo_engine_frames_dispatched(self._e)
+
+    @property
+    def arq_heap_len(self) -> int:
+        """Live population of the lazy ARQ due-heap (stale entries for
+        acked frames linger until their deadline pops them)."""
+        return self._lib.rlo_engine_arq_heap_len(self._e)
+
+    @property
+    def arq_scan_gated(self) -> int:
+        """Retransmit sweeps skipped on the O(1) due-heap peek."""
+        return self._lib.rlo_engine_arq_scan_gated(self._e)
 
     def enable_failure_detection(self, timeout_usec: int,
                                  interval_usec: int = 0) -> None:

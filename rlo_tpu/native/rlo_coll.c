@@ -174,7 +174,7 @@ static int coll_pump(rlo_coll *c)
     if (!p) {
         rlo_handle_unref(n->handle);
         rlo_blob_unref(n->frame);
-        free(n);
+        rlo_pool_free(n);
         return RLO_ERR_NOMEM;
     }
     int32_t origin = -1;
@@ -186,7 +186,7 @@ static int coll_pump(rlo_coll *c)
          * with garbage (src, pid, vote) and negative len could later
          * match a coll_take and memcpy from junk (advisor finding) */
         rlo_blob_unref(n->frame);
-        free(n);
+        rlo_pool_free(n);
         free(p);
         return RLO_ERR_PROTO;
     }
@@ -194,7 +194,7 @@ static int coll_pump(rlo_coll *c)
     p->frame = n->frame; /* steal the ref */
     p->next = c->pend;
     c->pend = p;
-    free(n);
+    rlo_pool_free(n);
     return 1;
 }
 

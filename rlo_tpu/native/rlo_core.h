@@ -276,6 +276,39 @@ void rlo_engine_free(rlo_engine *e);
  * rootless_ops.c:538-549); re-entrant calls are no-ops. */
 void rlo_progress_all(rlo_world *w);
 
+/* ------------------------------------------------------------------ */
+/* Batched progress (docs/DESIGN.md S13): loop progress turns INSIDE C */
+/* so a driver (the ctypes bindings release the GIL for the call's     */
+/* whole duration) pays one crossing for thousands of frames instead   */
+/* of one per turn. Both entry points return the number of frames      */
+/* polled off the transport (every frame counts: ACKs, heartbeats,     */
+/* quarantined and duplicate frames included), or a negative rlo_err.  */
+/*                                                                     */
+/* Stop conditions (first one wins):                                   */
+/*   - max_frames > 0 and that many frames were processed (the budget  */
+/*     binds exactly: a turn stops polling mid-inbox, the remainder    */
+/*     waits for the next call);                                       */
+/*   - deadline_usec > 0 and that many MICROSECONDS have elapsed since */
+/*     call entry: the call becomes a busy poll-wait that keeps        */
+/*     progressing through idle periods — the serving-pump shape       */
+/*     (GIL released, one wakeup per deadline window);                 */
+/*   - with no deadline armed, the natural end of the currently        */
+/*     flowing work: rlo_world_progress_all_n returns at the first     */
+/*     fruitless sweep with the world quiescent (in-flight latency     */
+/*     frames on the loopback keep it sweeping until delivered);       */
+/*     rlo_engine_progress_n — the single-engine face for the          */
+/*     one-process-per-rank transports (shm/tcp/mpi) — returns at the  */
+/*     first fruitless turn (it must not spin a multi-engine world     */
+/*     whose pending frames belong to other engines).                  */
+/* Re-entrant calls (from a judge/action callback) are no-ops          */
+/* returning 0, like rlo_progress_all.                                 */
+int64_t rlo_engine_progress_n(rlo_engine *e, int64_t max_frames,
+                              uint64_t deadline_usec);
+int64_t rlo_world_progress_all_n(rlo_world *w, int64_t max_frames,
+                                 uint64_t deadline_usec);
+/* lifetime count of frames this engine polled off the transport */
+int64_t rlo_engine_frames_dispatched(const rlo_engine *e);
+
 /* Rootless broadcast from this rank (reference RLO_bcast_gen :1581). */
 int rlo_bcast(rlo_engine *e, const uint8_t *payload, int64_t len);
 
@@ -351,6 +384,13 @@ int64_t rlo_engine_arq_dup_drops(const rlo_engine *e);
 int64_t rlo_engine_arq_unacked(const rlo_engine *e);
 /* frames the ARQ layer abandoned after max_retries (skip notices) */
 int64_t rlo_engine_arq_gave_up(const rlo_engine *e);
+/* due-heap introspection (docs/DESIGN.md S13; C analogue of the
+ * Python engine's _arq_due lazy heap): live heap population (stale
+ * entries for acked/re-timed frames linger until their deadline pops
+ * them — lazy by design) and the count of O(1) gated retransmit
+ * sweeps (ticks that returned on the heap peek alone) */
+int64_t rlo_engine_arq_heap_len(const rlo_engine *e);
+int64_t rlo_engine_arq_scan_gated(const rlo_engine *e);
 /* 1 when this engine has marked `rank` failed */
 int rlo_engine_rank_failed(const rlo_engine *e, int rank);
 int rlo_engine_failed_count(const rlo_engine *e);

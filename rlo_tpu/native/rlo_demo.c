@@ -56,7 +56,10 @@ typedef struct demo_cfg {
 #define DRAIN_SPINS 50000000
 
 /* spin progress until pickup returns something, a peer rank dies, or the
- * budget runs out */
+ * budget runs out. Progress is BATCHED (docs/DESIGN.md S13): each
+ * iteration lets the C loop run sweeps until the currently flowing
+ * work is done, so the demo exercises rlo_world_progress_all_n on
+ * every transport (shm rings, the tcp sendmsg coalescing, femtompi). */
 static int64_t pickup_spin(rlo_world *w, rlo_engine *e, int *tag,
                            int *origin, int *pid, int *vote, uint8_t *buf,
                            int64_t cap)
@@ -67,9 +70,13 @@ static int64_t pickup_spin(rlo_world *w, rlo_engine *e, int *tag,
             return n;
         if (rlo_world_failed(w))
             return -1;
-        rlo_progress_all(w);
-        if ((i & 63) == 63) /* ranks are oversubscribed on few cores */
-            sched_yield();
+        /* bounded deadline: on shm the no-deadline world call would
+         * spin its fruitless fuse whenever the GLOBAL in-flight count
+         * is nonzero because of OTHER ranks' traffic; 200 usec per
+         * crossing keeps every local engine co-progressing (a rank
+         * may host several) without hogging an oversubscribed core */
+        if (rlo_world_progress_all_n(w, 0, 200) == 0)
+            sched_yield(); /* nothing for us: let the sender run */
     }
     return -1;
 }

@@ -69,12 +69,26 @@ typedef struct rlo_prop {
  * SURVEY.md §5) ---------------- */
 
 typedef struct rlo_rtx {
-    struct rlo_rtx *next;
+    /* main queue: doubly-linked, insertion (newest-first) order — the
+     * retransmit SWEEP walks this list, so its walk order is exactly
+     * the historical one */
+    struct rlo_rtx *next, *prev;
+    /* per-destination chain: cumulative ACKs from one peer touch only
+     * that peer's entries (the ack scan was O(all unacked) before) */
+    struct rlo_rtx *dnext, *dprev;
     int dst, tag, retries;
     int32_t seq;
     uint64_t due;  /* next retransmit time (usec) */
     uint64_t sent; /* first-transmission time (RTT sampling) */
     rlo_blob *frame;
+    /* zero-copy large-payload entry (docs/DESIGN.md S13): frame is a
+     * ref on the SHARED unstamped fan-out blob and hdr[] carries the
+     * per-edge stamped header — sends and retransmits go through
+     * rlo_world_isend_hdr, so the payload is never copied into a
+     * per-frame arena. split == 0 entries own a stamped private
+     * clone (the historical small-frame path, kept byte for byte). */
+    int split;
+    uint8_t hdr[RLO_HEADER_SIZE];
 } rlo_rtx;
 
 /* ---------------- in-flight message (reference RLO_msg_t,
@@ -152,7 +166,8 @@ struct rlo_engine {
     uint64_t arq_rto; /* 0 = disabled */
     int arq_max_retries;
     int32_t *tx_seq;      /* per dst: next link seq */
-    rlo_rtx *rtx_head;    /* unacked reliable frames */
+    rlo_rtx *rtx_head;    /* unacked reliable frames (sweep order) */
+    rlo_rtx **rtx_by_dst; /* per dst: that peer's chain (ack scans) */
     int64_t *rx_contig;   /* per src: all link seqs <= contig seen */
     uint64_t *rx_mask;    /* per src: window above contig */
     uint8_t *ack_due;     /* per src: cumulative ACK owed */
@@ -162,6 +177,28 @@ struct rlo_engine {
     uint64_t *tx_skip_due;
     uint8_t *skip_hold;
     int64_t arq_retx, arq_dup, arq_gaveup, arq_unacked_cnt;
+    /* lazy due-heap gating the retransmit sweep (docs/DESIGN.md S13;
+     * C analogue of engine.py's _arq_due from PR 7): a binary
+     * min-heap of wake-up times — one push per reliable send, per
+     * retransmit re-arm, and per armed skip notice. Entries are PLAIN
+     * DEADLINES (no identity): an acked frame's entry goes stale and
+     * costs one empty sweep when it expires, which is what keeps the
+     * hot path O(1) — arq_tick returns on a single heap peek while
+     * the earliest deadline is in the future. INVARIANT: every live
+     * retransmit entry and every armed skip notice has a heap entry
+     * at or before its deadline, so the gate can never sleep past
+     * real work. The sweep itself still walks the queue in insertion
+     * order — wake-ups come from the heap, the walk order does not. */
+    uint64_t *arq_heap;
+    int arq_heap_len, arq_heap_cap;
+    /* a wake-up was lost to a failed heap grow: the gate would sleep
+     * past it, so sweeps run ungated until the queue fully drains and
+     * the gate can re-arm from a clean slate */
+    int arq_gate_degraded;
+    int64_t arq_gated; /* sweeps skipped on the O(1) heap peek */
+    /* lifetime frames polled off the transport (batched-progress
+     * budget accounting; every polled frame counts, ACKs included) */
+    int64_t frames_dispatched;
     /* metrics registry (mirror of engine.py's _mx_* machinery; see
      * rlo_core.h rlo_stats): per-peer link accounting + op-latency
      * histograms, collected only while metrics_on (one branch per
@@ -347,10 +384,11 @@ static void q_remove(rlo_queue *q, rlo_msg *m)
 /* Encode one frame into a fresh blob (the single copy a send makes;
  * every fan-out edge then shares it by ref; the ARQ path clones and
  * re-stamps per edge). */
-static rlo_blob *frame_blob(int32_t origin, int32_t pid, int32_t vote,
-                            const uint8_t *payload, int64_t len)
+static rlo_blob *frame_blob(rlo_world *w, int32_t origin, int32_t pid,
+                            int32_t vote, const uint8_t *payload,
+                            int64_t len)
 {
-    rlo_blob *b = rlo_blob_new(RLO_HEADER_SIZE + len);
+    rlo_blob *b = rlo_blob_new_w(w, RLO_HEADER_SIZE + len);
     if (!b)
         return 0;
     if (rlo_frame_encode(b->data, b->len, origin, pid, vote, -1, payload,
@@ -364,7 +402,8 @@ static rlo_blob *frame_blob(int32_t origin, int32_t pid, int32_t vote,
 /* Wrap a received or freshly-encoded frame blob into a message; STEALS
  * the caller's blob ref (unrefs it on failure, storing RLO_ERR_PROTO or
  * RLO_ERR_NOMEM in *err so callers report the true cause). */
-static rlo_msg *msg_from_frame(int tag, int src, rlo_blob *frame, int *err)
+static rlo_msg *msg_from_frame(rlo_world *w, int tag, int src,
+                               rlo_blob *frame, int *err)
 {
     int32_t origin, pid, vote, seq;
     const uint8_t *payload;
@@ -376,7 +415,9 @@ static rlo_msg *msg_from_frame(int tag, int src, rlo_blob *frame, int *err)
         rlo_blob_unref(frame);
         return 0;
     }
-    rlo_msg *m = (rlo_msg *)calloc(1, sizeof(*m));
+    rlo_msg *m = (rlo_msg *)rlo_pool_alloc(w, sizeof(*m));
+    if (m)
+        memset(m, 0, sizeof(*m));
     if (!m) {
         if (err)
             *err = RLO_ERR_NOMEM;
@@ -415,7 +456,7 @@ static void msg_free(rlo_msg *m)
     free(m->handles);
     rlo_blob_unref(m->frame);
     prop_free(m->ps);
-    free(m);
+    rlo_pool_free(m);
 }
 
 static int msg_track(rlo_msg *m, rlo_handle *h)
@@ -461,6 +502,43 @@ static void put_le32(uint8_t *dst, int v)
     dst[3] = (uint8_t)((v >> 24) & 0xff);
 }
 
+static void arq_heap_push(rlo_engine *e, uint64_t due);
+
+static void rtx_link(rlo_engine *e, rlo_rtx *rt)
+{
+    rt->prev = 0;
+    rt->next = e->rtx_head;
+    if (e->rtx_head)
+        e->rtx_head->prev = rt;
+    e->rtx_head = rt;
+    rt->dprev = 0;
+    rt->dnext = e->rtx_by_dst[rt->dst];
+    if (rt->dnext)
+        rt->dnext->dprev = rt;
+    e->rtx_by_dst[rt->dst] = rt;
+    e->arq_unacked_cnt++;
+}
+
+/* Unlink from both lists and release; O(1). */
+static void rtx_release(rlo_engine *e, rlo_rtx *rt)
+{
+    if (rt->prev)
+        rt->prev->next = rt->next;
+    else
+        e->rtx_head = rt->next;
+    if (rt->next)
+        rt->next->prev = rt->prev;
+    if (rt->dprev)
+        rt->dprev->dnext = rt->dnext;
+    else
+        e->rtx_by_dst[rt->dst] = rt->dnext;
+    if (rt->dnext)
+        rt->dnext->dprev = rt->dprev;
+    rlo_blob_unref(rt->frame);
+    rlo_pool_free(rt);
+    e->arq_unacked_cnt--;
+}
+
 /* Tags the ARQ layer neither stamps nor retransmits: heartbeats are
  * periodic by construction, and ACKs ack themselves by effect (a lost
  * ACK just costs one more retransmit, absorbed by the dedup). JOIN
@@ -498,6 +576,24 @@ static int isend_timed(rlo_engine *e, int dst, int tag, rlo_blob *frame,
     return rc;
 }
 
+/* Gather-send twin of isend_timed for the zero-copy ARQ path: the
+ * stamped header travels as caller staging, the payload stays in the
+ * shared fan-out blob (rlo_world_isend_hdr materializes a contiguous
+ * copy only on transports without scatter-gather). */
+static int isend_hdr_timed(rlo_engine *e, int dst, int tag,
+                           const uint8_t *hdr, rlo_blob *frame,
+                           rlo_handle **h)
+{
+    if (!e->profiler_on)
+        return rlo_world_isend_hdr(e->w, e->rank, dst, e->comm, tag,
+                                   hdr, frame, h);
+    double t0 = now_usec_f();
+    int rc = rlo_world_isend_hdr(e->w, e->rank, dst, e->comm, tag, hdr,
+                                 frame, h);
+    ph_obs(e, RLO_PH_SEND, t0);
+    return rc;
+}
+
 static int eng_isend_frame(rlo_engine *e, int dst, int tag,
                            rlo_blob *frame, rlo_msg *track_in)
 {
@@ -508,28 +604,46 @@ static int eng_isend_frame(rlo_engine *e, int dst, int tag,
         e->links[dst].tx_bytes += frame->len;
     }
     if (e->arq_rto && !arq_exempt(tag) && dst >= 0 && dst < e->ws) {
-        rlo_blob *stamped = rlo_blob_new(frame->len);
-        rlo_rtx *rt = (rlo_rtx *)calloc(1, sizeof(*rt));
-        if (!stamped || !rt) {
-            rlo_blob_unref(stamped);
-            free(rt);
+        rlo_rtx *rt = (rlo_rtx *)rlo_pool_alloc(e->w, sizeof(*rt));
+        if (!rt)
             return RLO_ERR_NOMEM;
+        memset(rt, 0, sizeof(*rt));
+        /* large payloads take the zero-copy path (docs/DESIGN.md
+         * S13): the per-edge seq/epoch is stamped into a 28-byte
+         * header staging inside the retransmit entry and the SHARED
+         * fan-out blob is ref'd as-is — no payload clone per edge.
+         * Small frames keep the historical clone-and-stamp path.
+         * Retransmits resend the same bytes either way. */
+        int split = frame->len >= RLO_HEADER_SIZE + RLO_ZC_MIN_PAYLOAD;
+        rlo_blob *stamped = 0;
+        if (!split) {
+            stamped = rlo_blob_new_w(e->w, frame->len);
+            if (!stamped) {
+                rlo_pool_free(rt);
+                return RLO_ERR_NOMEM;
+            }
+            memcpy(stamped->data, frame->data, (size_t)frame->len);
+        } else {
+            memcpy(rt->hdr, frame->data, RLO_HEADER_SIZE);
         }
-        memcpy(stamped->data, frame->data, (size_t)frame->len);
+        uint8_t *stamp = split ? rt->hdr : stamped->data;
         int32_t seq = e->tx_seq[dst]++;
-        put_le32(stamped->data + RLO_SEQ_OFFSET, seq);
-        rlo_frame_set_epoch(stamped->data, e->link_epoch[dst]);
+        put_le32(stamp + RLO_SEQ_OFFSET, seq);
+        rlo_frame_set_epoch(stamp, e->link_epoch[dst]);
+        rt->split = split;
         rt->dst = dst;
         rt->tag = tag;
         rt->seq = seq;
         rt->sent = rlo_now_usec();
         rt->due = rt->sent + e->arq_rto;
-        rt->frame = rlo_blob_ref(stamped);
-        rt->next = e->rtx_head;
-        e->rtx_head = rt;
-        e->arq_unacked_cnt++;
-        rc = isend_timed(e, dst, tag, stamped, track_in ? &h : 0);
-        rlo_blob_unref(stamped);
+        rt->frame = rlo_blob_ref(split ? frame : stamped);
+        rtx_link(e, rt);
+        arq_heap_push(e, rt->due);
+        rc = split ? isend_hdr_timed(e, dst, tag, rt->hdr, frame,
+                                     track_in ? &h : 0)
+                   : isend_timed(e, dst, tag, stamped,
+                                 track_in ? &h : 0);
+        rlo_blob_unref(stamped); /* NULL-safe on the split path */
     } else {
         /* link-epoch stamp (docs/DESIGN.md S8): the fan-out blob is
          * SHARED across edges and (zero-copy) with in-process
@@ -540,7 +654,7 @@ static int eng_isend_frame(rlo_engine *e, int dst, int tag,
                                                 : 0;
         if (frame->len >= RLO_HEADER_SIZE &&
             rlo_frame_epoch(frame->data) != lep) {
-            rlo_blob *st = rlo_blob_new(frame->len);
+            rlo_blob *st = rlo_blob_new_w(e->w, frame->len);
             if (!st)
                 return RLO_ERR_NOMEM;
             memcpy(st->data, frame->data, (size_t)frame->len);
@@ -564,10 +678,10 @@ static int eng_isend(rlo_engine *e, int dst, int tag, int32_t origin,
     rlo_blob *frame;
     if (e->profiler_on) {
         double t0 = now_usec_f();
-        frame = frame_blob(origin, pid, vote, payload, len);
+        frame = frame_blob(e->w, origin, pid, vote, payload, len);
         ph_obs(e, RLO_PH_FRAME_ENCODE, t0);
     } else {
-        frame = frame_blob(origin, pid, vote, payload, len);
+        frame = frame_blob(e->w, origin, pid, vote, payload, len);
     }
     if (!frame)
         return RLO_ERR_NOMEM;
@@ -620,6 +734,8 @@ rlo_engine *rlo_engine_new(rlo_world *w, int rank, int comm,
     e->seen_mask = (uint64_t *)calloc((size_t)e->ws * RLO_SEEN_WORDS,
                                       sizeof(uint64_t));
     e->tx_seq = (int32_t *)calloc((size_t)e->ws, sizeof(int32_t));
+    e->rtx_by_dst =
+        (rlo_rtx **)calloc((size_t)e->ws, sizeof(void *));
     e->rx_contig = (int64_t *)malloc((size_t)e->ws * sizeof(int64_t));
     e->rx_mask = (uint64_t *)calloc((size_t)e->ws * RLO_SEEN_WORDS,
                                     sizeof(uint64_t));
@@ -655,7 +771,8 @@ rlo_engine *rlo_engine_new(rlo_world *w, int rank, int comm,
         for (int r = 0; r < e->ws; r++)
             e->admitted_inc[r] = -1;
     if (e->n_init < 0 || !e->failed || !e->hb_seen || !e->seen_contig ||
-        !e->seen_mask || !e->tx_seq || !e->rx_contig || !e->rx_mask ||
+        !e->seen_mask || !e->tx_seq || !e->rtx_by_dst ||
+        !e->rx_contig || !e->rx_mask ||
         !e->ack_due || !e->tx_skip || !e->tx_skip_due || !e->skip_hold ||
         !e->links || !e->epoch_floor || !e->link_epoch ||
         !e->admit_epoch || !e->admitted_inc || !e->admitting ||
@@ -668,6 +785,7 @@ rlo_engine *rlo_engine_new(rlo_world *w, int rank, int comm,
         free(e->seen_contig);
         free(e->seen_mask);
         free(e->tx_seq);
+        free(e->rtx_by_dst);
         free(e->rx_contig);
         free(e->rx_mask);
         free(e->ack_due);
@@ -779,12 +897,10 @@ void rlo_engine_free(rlo_engine *e)
     free(e->sub_excluded);
     free(e->gave_scratch);
     free(e->stale_probe_last);
-    for (rlo_rtx *rt = e->rtx_head; rt;) {
-        rlo_rtx *nrt = rt->next;
-        rlo_blob_unref(rt->frame);
-        free(rt);
-        rt = nrt;
-    }
+    while (e->rtx_head)
+        rtx_release(e, e->rtx_head);
+    free(e->rtx_by_dst);
+    free(e->arq_heap);
     for (int i = 0; i < RLO_RECENT_LOG; i++)
         rlo_blob_unref(e->recent[i]);
     free(e);
@@ -957,6 +1073,57 @@ static int bcast_is_dup(rlo_engine *e, const rlo_msg *m)
 
 /* ---------------- reliable delivery (ARQ) ---------------- */
 
+/* Push one wake-up deadline onto the lazy due-heap. Allocation
+ * failure degrades gracefully: heap_len 0 with a non-empty queue
+ * makes arq_tick fall back to the ungated sweep. */
+static void arq_heap_push(rlo_engine *e, uint64_t due)
+{
+    if (e->arq_heap_len == e->arq_heap_cap) {
+        int cap = e->arq_heap_cap ? e->arq_heap_cap * 2 : 64;
+        uint64_t *h = (uint64_t *)realloc(
+            e->arq_heap, (size_t)cap * sizeof(uint64_t));
+        if (!h) {
+            /* the lost wake-up breaks the gate invariant: degrade to
+             * ungated sweeps (arq_tick re-arms once the queue drains) */
+            e->arq_gate_degraded = 1;
+            return;
+        }
+        e->arq_heap = h;
+        e->arq_heap_cap = cap;
+    }
+    int i = e->arq_heap_len++;
+    uint64_t *h = e->arq_heap;
+    while (i > 0 && h[(i - 1) / 2] > due) {
+        h[i] = h[(i - 1) / 2];
+        i = (i - 1) / 2;
+    }
+    h[i] = due;
+}
+
+/* Pop every deadline at or before `now` (they are consumed whether
+ * live or stale: a sweep follows and re-arms whatever remains). */
+static void arq_heap_pop_due(rlo_engine *e, uint64_t now)
+{
+    uint64_t *h = e->arq_heap;
+    while (e->arq_heap_len && h[0] <= now) {
+        uint64_t last = h[--e->arq_heap_len];
+        int i = 0;
+        for (;;) {
+            int kid = 2 * i + 1;
+            if (kid >= e->arq_heap_len)
+                break;
+            if (kid + 1 < e->arq_heap_len && h[kid + 1] < h[kid])
+                kid++;
+            if (h[kid] >= last)
+                break;
+            h[i] = h[kid];
+            i = kid;
+        }
+        if (e->arq_heap_len)
+            h[i] = last;
+    }
+}
+
 /* Cumulative ACK from `src`: drop everything it covers from the
  * retransmit queue (and retire a pending SKIP notice the ACK proves
  * was absorbed). */
@@ -966,9 +1133,9 @@ static void arq_on_ack(rlo_engine *e, int src, int32_t cum)
     int32_t lo = INT32_MAX; /* lowest seq still held for src */
     if (e->tx_skip[src] >= 0 && cum >= e->tx_skip[src])
         e->tx_skip[src] = -1;
-    for (rlo_rtx **pp = &e->rtx_head; *pp;) {
-        rlo_rtx *rt = *pp;
-        if (rt->dst == src && rt->seq <= cum) {
+    for (rlo_rtx *rt = e->rtx_by_dst[src]; rt;) {
+        rlo_rtx *nrt = rt->dnext;
+        if (rt->seq <= cum) {
             if (e->metrics_on && rt->retries == 0 && now >= rt->sent)
                 /* RTT from ack timing — never-retransmitted frames
                  * only (Karn's rule: a retransmitted frame's ack is
@@ -978,15 +1145,11 @@ static void arq_on_ack(rlo_engine *e, int src, int32_t cum)
                  * the EWMA for the process lifetime */
                 rtt_sample(&e->links[src],
                            (double)(now - rt->sent));
-            *pp = rt->next;
-            rlo_blob_unref(rt->frame);
-            free(rt);
-            e->arq_unacked_cnt--;
-        } else {
-            if (rt->dst == src && rt->seq < lo)
-                lo = rt->seq;
-            pp = &rt->next;
+            rtx_release(e, rt);
+        } else if (rt->seq < lo) {
+            lo = rt->seq;
         }
+        rt = nrt;
     }
     /* unfillable hole: the receiver's watermark sits below seqs we no
      * longer hold (its window was reset by an admission/welcome while
@@ -999,6 +1162,12 @@ static void arq_on_ack(rlo_engine *e, int src, int32_t cum)
         e->tx_skip[src] = lo - 1;
         e->tx_skip_due[src] = 0; /* send at the next tick */
     }
+    /* any ACK that leaves a notice armed wakes the gated sweep NOW:
+     * it may have just released the lower-seq entry that was HOLDING
+     * the notice back, and the notice's own wake could be a full rto
+     * away (review finding: the pre-gate code sent it next tick) */
+    if (e->tx_skip[src] >= 0)
+        arq_heap_push(e, 0);
 }
 
 /* SKIP notice from a SENDER: it gave up on everything <= upto; advance
@@ -1025,16 +1194,10 @@ static void arq_rx_skip(rlo_engine *e, int src, int32_t upto)
 /* Drop every retransmit entry addressed to a (now dead) rank. */
 static void arq_drop_dst(rlo_engine *e, int dst)
 {
-    for (rlo_rtx **pp = &e->rtx_head; *pp;) {
-        rlo_rtx *rt = *pp;
-        if (rt->dst == dst) {
-            *pp = rt->next;
-            rlo_blob_unref(rt->frame);
-            free(rt);
-            e->arq_unacked_cnt--;
-        } else {
-            pp = &rt->next;
-        }
+    for (rlo_rtx *rt = e->rtx_by_dst[dst]; rt;) {
+        rlo_rtx *nrt = rt->dnext;
+        rtx_release(e, rt);
+        rt = nrt;
     }
 }
 
@@ -1051,6 +1214,42 @@ static void arq_tick(rlo_engine *e)
 {
     uint64_t now = rlo_now_usec();
     int armed = 0;
+    /* lazy due-heap gate (PR 7's Python _arq_wake, docs/DESIGN.md
+     * S13): while the earliest armed wake-up is in the future nothing
+     * anywhere can be due, so the common idle tick is one heap peek.
+     * Stale entries (acked / re-timed frames) pop when they expire
+     * and cost one empty sweep — laziness is the O(1) deal. An empty
+     * heap with a non-empty queue (a failed heap allocation) falls
+     * back to the ungated sweep. */
+    if (e->arq_gate_degraded) {
+        /* a wake-up was lost to a failed heap grow: sweep ungated
+         * until everything armed has drained, then reset the gate
+         * from a clean slate (all future wakes get fresh pushes) */
+        if (!e->rtx_head) {
+            int armed_skip = 0;
+            for (int d = 0; d < e->ws; d++)
+                if (e->tx_skip[d] >= 0)
+                    armed_skip = 1;
+            if (!armed_skip) {
+                e->arq_gate_degraded = 0;
+                e->arq_heap_len = 0; /* stale entries, wholesale */
+                e->arq_gated++;
+                return;
+            }
+        }
+    } else {
+        if (e->arq_heap_len && e->arq_heap[0] > now) {
+            e->arq_gated++;
+            return;
+        }
+        if (!e->arq_heap_len && !e->rtx_head) {
+            /* nothing unacked and no wake-ups armed (armed skip
+             * notices always hold a heap entry, so none starve here) */
+            e->arq_gated++;
+            return;
+        }
+    }
+    arq_heap_pop_due(e, now);
     for (rlo_rtx **pp = &e->rtx_head; *pp;) {
         rlo_rtx *rt = *pp;
         if (rt->due > now) {
@@ -1077,10 +1276,10 @@ static void arq_tick(rlo_engine *e)
                     e->tx_skip_due[rt->dst] = now; /* send now */
                 }
             }
-            *pp = rt->next;
-            rlo_blob_unref(rt->frame);
-            free(rt);
-            e->arq_unacked_cnt--;
+            /* rtx_release unlinks by writing rt->prev->next — the
+             * very field *pp aliases — so *pp is now rt's successor
+             * and the walk continues without advancing pp */
+            rtx_release(e, rt);
             continue;
         }
         rt->retries++;
@@ -1088,6 +1287,7 @@ static void arq_tick(rlo_engine *e)
          * the backoff well-defined for any config */
         rt->due = now + (e->arq_rto
                          << (rt->retries < 32 ? rt->retries : 32));
+        arq_heap_push(e, rt->due); /* re-arm the gate */
         e->arq_retx++;
         if (e->metrics_on && rt->dst >= 0 && rt->dst < e->ws) {
             e->links[rt->dst].retransmits++;
@@ -1095,7 +1295,10 @@ static void arq_tick(rlo_engine *e)
             e->links[rt->dst].tx_bytes += rt->frame->len;
         }
         /* same bytes, same seq: the receiver dedups the retransmit */
-        isend_timed(e, rt->dst, rt->tag, rt->frame, 0);
+        if (rt->split)
+            isend_hdr_timed(e, rt->dst, rt->tag, rt->hdr, rt->frame, 0);
+        else
+            isend_timed(e, rt->dst, rt->tag, rt->frame, 0);
         pp = &rt->next;
     }
     for (int d = 0; d < e->ws; d++) {
@@ -1117,6 +1320,14 @@ static void arq_tick(rlo_engine *e)
                   0);
         e->tx_skip_due[d] = now + e->arq_rto;
     }
+    /* re-arm the gate for every notice still armed (just sent, held
+     * behind a lower seq, or not yet due): the heap invariant needs a
+     * wake at or before each notice's next action time */
+    for (int d = 0; d < e->ws; d++)
+        if (e->tx_skip[d] >= 0)
+            arq_heap_push(e, e->tx_skip_due[d] > now
+                                 ? e->tx_skip_due[d]
+                                 : now + e->arq_rto);
 }
 
 /* ARQ give-up escalation, AFTER the retransmit sweep: a peer that
@@ -1198,15 +1409,16 @@ static int bcast_init(rlo_engine *e, int tag, int32_t pid, int32_t vote,
     rlo_blob *frame;
     if (e->profiler_on) {
         double t0 = now_usec_f();
-        frame = frame_blob(e->rank, pid, vote, payload, len);
+        frame = frame_blob(e->w, e->rank, pid, vote, payload, len);
         ph_obs(e, RLO_PH_FRAME_ENCODE, t0);
     } else {
-        frame = frame_blob(e->rank, pid, vote, payload, len);
+        frame = frame_blob(e->w, e->rank, pid, vote, payload, len);
     }
     if (!frame)
         return RLO_ERR_NOMEM;
     int err = RLO_ERR_NOMEM;
-    rlo_msg *m = msg_from_frame(tag, -1, frame, &err); /* steals the ref */
+    rlo_msg *m = msg_from_frame(e->w, tag, -1, frame,
+                                &err); /* steals the ref */
     if (!m)
         return err;
     int targets[64];
@@ -2069,6 +2281,21 @@ int64_t rlo_engine_arq_unacked(const rlo_engine *e)
     return e->arq_unacked_cnt;
 }
 
+int64_t rlo_engine_arq_heap_len(const rlo_engine *e)
+{
+    return e->arq_heap_len;
+}
+
+int64_t rlo_engine_arq_scan_gated(const rlo_engine *e)
+{
+    return e->arq_gated;
+}
+
+int64_t rlo_engine_frames_dispatched(const rlo_engine *e)
+{
+    return e->frames_dispatched;
+}
+
 int64_t rlo_engine_arq_gave_up(const rlo_engine *e)
 {
     return e->arq_gaveup;
@@ -2571,14 +2798,11 @@ static void on_welcome(rlo_engine *e, rlo_msg *m)
     }
     memset(e->rx_mask, 0,
            (size_t)e->ws * RLO_SEEN_WORDS * sizeof(uint64_t));
-    for (rlo_rtx *rt = e->rtx_head; rt;) {
-        rlo_rtx *nrt = rt->next;
-        rlo_blob_unref(rt->frame);
-        free(rt);
-        rt = nrt;
-    }
-    e->rtx_head = 0;
-    e->arq_unacked_cnt = 0;
+    while (e->rtx_head)
+        /* rtx_release keeps the per-dst ack chains and the unacked
+         * counter consistent in one place — no companion bookkeeping
+         * for the next editor to forget */
+        rtx_release(e, e->rtx_head);
     e->hb_last_sent = 0;
     purge_stale_failures(e, mem);
     /* relayed rounds whose proposer is outside the adopted view can
@@ -2855,8 +3079,16 @@ int rlo_pickup_consume(rlo_engine *e)
 
 /* ---------------- the gear (reference make_progress_gen :551-641) ------ */
 
-void rlo_engine_progress_once(rlo_engine *e)
+/* One progress turn. max_frames < 0 = unbounded (the historical
+ * progress_once); >= 0 caps how many frames the transport drain may
+ * poll this turn — the remainder stays queued in FIFO order for the
+ * next turn, so budgeted and unbudgeted driving deliver identical
+ * sequences. Returns frames polled (the batched entry points slice
+ * their budget through this; every polled frame counts, ACKs and
+ * quarantined frames included). */
+int64_t rlo_engine_progress_budget(rlo_engine *e, int64_t max_frames)
 {
+    int64_t polled = 0;
     /* (a) my own decision fan-out completion -> proposal COMPLETED */
     rlo_prop *p = &e->own;
     if (p->state == RLO_IN_PROGRESS && p->decision_pending) {
@@ -2888,21 +3120,25 @@ void rlo_engine_progress_once(rlo_engine *e)
 
     /* (b) drain the transport, dispatch on tag (:569-624) */
     for (;;) {
+        if (max_frames >= 0 && polled >= max_frames)
+            break; /* frame budget: the rest waits, FIFO intact */
         rlo_wire_node *n = rlo_world_poll(e->w, e->rank, e->comm);
         if (!n)
             break;
+        polled++;
+        e->frames_dispatched++;
         /* steal the node's frame ref into the message — no copy */
         int err = RLO_ERR_PROTO;
         rlo_msg *m;
         if (e->profiler_on) {
             double t0 = now_usec_f();
-            m = msg_from_frame(n->tag, n->src, n->frame, &err);
+            m = msg_from_frame(e->w, n->tag, n->src, n->frame, &err);
             ph_obs(e, RLO_PH_FRAME_DECODE, t0);
         } else {
-            m = msg_from_frame(n->tag, n->src, n->frame, &err);
+            m = msg_from_frame(e->w, n->tag, n->src, n->frame, &err);
         }
         rlo_handle_unref(n->handle);
-        free(n);
+        rlo_pool_free(n);
         if (!m) {
             set_err(e, err);
             continue;
@@ -3110,6 +3346,44 @@ void rlo_engine_progress_once(rlo_engine *e)
         }
         m = nm;
     }
+    return polled;
+}
+
+void rlo_engine_progress_once(rlo_engine *e)
+{
+    rlo_engine_progress_budget(e, -1);
+}
+
+/* Batched single-engine progress (docs/DESIGN.md S13; contract in
+ * rlo_core.h): loop turns in C until the budget fills, the deadline
+ * expires, or — with no deadline — the first fruitless turn. The
+ * world's stepping guard is held through each turn so a judge/action
+ * callback initiating a broadcast re-enters as a no-op, exactly as it
+ * does inside rlo_progress_all. */
+int64_t rlo_engine_progress_n(rlo_engine *e, int64_t max_frames,
+                              uint64_t deadline_usec)
+{
+    if (!e)
+        return RLO_ERR_ARG;
+    rlo_world *w = e->w;
+    if (w->stepping)
+        return 0; /* re-entered from a handler: no-op */
+    uint64_t end = deadline_usec ? rlo_now_usec() + deadline_usec : 0;
+    int64_t total = 0;
+    for (;;) {
+        w->stepping = 1;
+        int64_t got = rlo_engine_progress_budget(
+            e, max_frames > 0 ? max_frames - total : -1);
+        w->stepping = 0;
+        total += got;
+        if (max_frames > 0 && total >= max_frames)
+            break;
+        if (got == 0 && !end)
+            break; /* fruitless turn, no poll-wait requested */
+        if (end && rlo_now_usec() >= end)
+            break;
+    }
+    return total;
 }
 
 /* ---------------- snapshot/restore (see rlo_core.h) ---------------- */

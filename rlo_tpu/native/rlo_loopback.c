@@ -14,7 +14,8 @@
 
 /* per-(src,dst,comm) FIFO of frames still "in flight" */
 typedef struct rlo_channel {
-    struct rlo_channel *next;
+    struct rlo_channel *next;      /* global list: pump/teardown order */
+    struct rlo_channel *pair_next; /* per-(src,dst) lookup chain */
     int src, dst, comm;
     rlo_wire_node *head, *tail;
 } rlo_channel;
@@ -25,7 +26,15 @@ typedef struct rlo_loop_world {
     uint64_t rng;
     uint64_t tick;
     int64_t sent_cnt, delivered_cnt;
+    /* frames currently in flight or waiting in an inbox — kept live
+     * so quiescent() is O(1) (docs/DESIGN.md S13: the batched
+     * progress loop and the drain spin consult it every sweep; the
+     * historical walk was O(channels + ranks) per call) */
+    int64_t pending;
     rlo_channel *channels;
+    rlo_channel **pair_idx; /* ws*ws buckets: O(1) channel lookup
+                             * (the linear scan of `channels` was the
+                             * hottest line under batched progress) */
     rlo_wire_node **inbox_head; /* per-rank delivered FIFO */
     rlo_wire_node **inbox_tail;
     uint8_t *dead;  /* fault injection: killed ranks */
@@ -49,7 +58,7 @@ static void free_node(rlo_wire_node *n)
 {
     rlo_handle_unref(n->handle);
     rlo_blob_unref(n->frame);
-    free(n);
+    rlo_pool_free(n);
 }
 
 static void loop_free(rlo_world *base)
@@ -72,6 +81,7 @@ static void loop_free(rlo_world *base)
             n = nn;
         }
     }
+    free(w->pair_idx);
     free(w->inbox_head);
     free(w->inbox_tail);
     free(w->dead);
@@ -79,6 +89,7 @@ static void loop_free(rlo_world *base)
     free(w->dups);
     free(w->pgroup);
     free(base->engines);
+    rlo_pool_drain(base);
     free(w);
 }
 
@@ -94,14 +105,7 @@ static int64_t loop_delivered(const rlo_world *base)
 
 static int loop_quiescent(const rlo_world *base)
 {
-    const rlo_loop_world *w = (const rlo_loop_world *)base;
-    for (const rlo_channel *c = w->channels; c; c = c->next)
-        if (c->head)
-            return 0;
-    for (int r = 0; r < base->world_size; r++)
-        if (w->inbox_head[r])
-            return 0;
-    return 1;
+    return ((const rlo_loop_world *)base)->pending == 0;
 }
 
 static void inbox_push(rlo_loop_world *w, rlo_wire_node *n)
@@ -119,8 +123,10 @@ static void inbox_push(rlo_loop_world *w, rlo_wire_node *n)
 static rlo_channel *get_channel(rlo_loop_world *w, int src, int dst,
                                 int comm)
 {
-    for (rlo_channel *c = w->channels; c; c = c->next)
-        if (c->src == src && c->dst == dst && c->comm == comm)
+    rlo_channel **bucket =
+        &w->pair_idx[src * w->base.world_size + dst];
+    for (rlo_channel *c = *bucket; c; c = c->pair_next)
+        if (c->comm == comm)
             return c;
     rlo_channel *c = (rlo_channel *)calloc(1, sizeof(*c));
     if (!c)
@@ -128,8 +134,10 @@ static rlo_channel *get_channel(rlo_loop_world *w, int src, int dst,
     c->src = src;
     c->dst = dst;
     c->comm = comm;
-    c->next = w->channels;
+    c->next = w->channels; /* same global order as the historical scan */
     w->channels = c;
+    c->pair_next = *bucket;
+    *bucket = c;
     return c;
 }
 
@@ -148,7 +156,7 @@ static int loop_isend(rlo_world *base, int src, int dst, int comm, int tag,
         if (w->drops[src * base->world_size + dst] > 0)
             w->drops[src * base->world_size + dst]--;
         if (out) {
-            rlo_handle *h = rlo_handle_new(1);
+            rlo_handle *h = rlo_handle_new_w(base, 1);
             if (!h)
                 return RLO_ERR_NOMEM;
             h->delivered = 1;
@@ -163,11 +171,12 @@ static int loop_isend(rlo_world *base, int src, int dst, int comm, int tag,
         dup = 1; /* duplication injection: deliver this frame twice */
     }
     int caller_tracks = out != 0;
-    rlo_handle *h = rlo_handle_new(caller_tracks ? 2 : 1);
-    rlo_wire_node *n = (rlo_wire_node *)malloc(sizeof(*n));
+    rlo_handle *h = rlo_handle_new_w(base, caller_tracks ? 2 : 1);
+    rlo_wire_node *n =
+        (rlo_wire_node *)rlo_pool_alloc(base, sizeof(*n));
     if (!h || !n) {
-        free(h);
-        free(n);
+        rlo_pool_free(h);
+        rlo_pool_free(n);
         return RLO_ERR_NOMEM;
     }
     n->next = 0;
@@ -182,11 +191,12 @@ static int loop_isend(rlo_world *base, int src, int dst, int comm, int tag,
         if (copy == 1) {
             /* duplication injection: a second node sharing the frame
              * blob, with its own (untracked) completion handle */
-            rlo_wire_node *n2 = (rlo_wire_node *)malloc(sizeof(*n2));
-            rlo_handle *h2 = rlo_handle_new(1);
+            rlo_wire_node *n2 =
+                (rlo_wire_node *)rlo_pool_alloc(base, sizeof(*n2));
+            rlo_handle *h2 = rlo_handle_new_w(base, 1);
             if (!n2 || !h2) { /* injection is best-effort: skip */
-                free(n2);
-                free(h2);
+                rlo_pool_free(n2);
+                rlo_pool_free(h2);
                 break;
             }
             *n2 = *n;
@@ -212,6 +222,7 @@ static int loop_isend(rlo_world *base, int src, int dst, int comm, int tag,
             c->tail = n;
             n->next = 0;
         }
+        w->pending++; /* enqueued (inbox or channel): in flight */
     }
     if (out)
         *out = h;
@@ -265,6 +276,7 @@ static int loop_partition(rlo_world *base, const int *group_of, int n)
             nd->handle->delivered = 1;
             nd->handle->failed = 1;
             free_node(nd);
+            w->pending--;
             nd = nn;
         }
         c->head = c->tail = 0;
@@ -283,6 +295,7 @@ static int loop_revive(rlo_world *base, int rank)
     for (rlo_wire_node *n = w->inbox_head[rank]; n;) {
         rlo_wire_node *nn = n->next;
         free_node(n);
+        w->pending--;
         n = nn;
     }
     w->inbox_head[rank] = w->inbox_tail[rank] = 0;
@@ -320,6 +333,7 @@ static int loop_kill_rank(rlo_world *base, int rank)
             n->handle->delivered = 1;
             n->handle->failed = 1;
             free_node(n);
+            w->pending--;
             n = nn;
         }
         c->head = c->tail = 0;
@@ -327,10 +341,44 @@ static int loop_kill_rank(rlo_world *base, int rank)
     for (rlo_wire_node *n = w->inbox_head[rank]; n;) {
         rlo_wire_node *nn = n->next;
         free_node(n);
+        w->pending--;
         n = nn;
     }
     w->inbox_head[rank] = w->inbox_tail[rank] = 0;
     return RLO_OK;
+}
+
+/* Dead-time skip for the batched progress loop (rlo_internal.h
+ * `advance`): jump the tick clock straight to the earliest due frame
+ * and move every head due by then — identical per-channel FIFO and the
+ * same cross-channel walk order as pump(), just without burning one
+ * poll per empty tick. */
+static int64_t loop_advance(rlo_world *base)
+{
+    rlo_loop_world *w = (rlo_loop_world *)base;
+    uint64_t min_due = 0;
+    int have = 0;
+    for (rlo_channel *c = w->channels; c; c = c->next)
+        if (c->head && (!have || c->head->due < min_due)) {
+            min_due = c->head->due;
+            have = 1;
+        }
+    if (!have)
+        return 0;
+    if (min_due > w->tick)
+        w->tick = min_due;
+    int64_t moved = 0;
+    for (rlo_channel *c = w->channels; c; c = c->next) {
+        while (c->head && c->head->due <= w->tick) {
+            rlo_wire_node *n = c->head;
+            c->head = n->next;
+            if (!c->head)
+                c->tail = 0;
+            inbox_push(w, n);
+            moved++;
+        }
+    }
+    return moved;
 }
 
 static rlo_wire_node *loop_poll(rlo_world *base, int rank, int comm)
@@ -351,6 +399,7 @@ static rlo_wire_node *loop_poll(rlo_world *base, int rank, int comm)
         if (w->inbox_tail[rank] == n)
             w->inbox_tail[rank] = prev;
         n->next = 0;
+        w->pending--; /* handed to the engine */
         return n;
     }
     return 0;
@@ -370,6 +419,7 @@ static const rlo_transport_ops LOOP_OPS = {
     .partition = loop_partition,
     .revive = loop_revive,
     .free_ = loop_free,
+    .advance = loop_advance,
 };
 
 rlo_world *rlo_world_new(int world_size, int latency, uint64_t seed)
@@ -391,8 +441,11 @@ rlo_world *rlo_world_new(int world_size, int latency, uint64_t seed)
     w->dead = (uint8_t *)calloc((size_t)world_size, 1);
     w->drops = (int *)calloc((size_t)world_size * world_size, sizeof(int));
     w->dups = (int *)calloc((size_t)world_size * world_size, sizeof(int));
+    w->pair_idx = (rlo_channel **)calloc(
+        (size_t)world_size * world_size, sizeof(void *));
     if (!w->inbox_head || !w->inbox_tail || !w->dead || !w->drops ||
-        !w->dups) {
+        !w->dups || !w->pair_idx) {
+        free(w->pair_idx);
         free(w->inbox_head);
         free(w->inbox_tail);
         free(w->dead);

@@ -95,10 +95,10 @@ static int mpi_isend(rlo_world *base, int src, int dst, int comm, int tag,
     int64_t len = frame->len;
     mpi_send_node *n = (mpi_send_node *)calloc(1, sizeof(*n));
     /* world ref + optional caller ref */
-    rlo_handle *h = rlo_handle_new(out ? 2 : 1);
+    rlo_handle *h = rlo_handle_new_w(base, out ? 2 : 1);
     if (!n || !h) {
         free(n);
-        free(h);
+        rlo_pool_free(h);
         return RLO_ERR_NOMEM;
     }
     /* zero-copy: MPI sends straight from the shared frame blob, whose
@@ -110,7 +110,7 @@ static int mpi_isend(rlo_world *base, int src, int dst, int comm, int tag,
                   &n->req) != MPI_SUCCESS) {
         rlo_blob_unref(n->frame);
         free(n);
-        free(h);
+        rlo_pool_free(h);
         return RLO_ERR_PROTO;
     }
     n->next = w->sends;
@@ -132,10 +132,11 @@ static int mpi_pump(rlo_mpi_world *w)
             return RLO_OK;
         int nbytes = 0;
         MPI_Get_count(&st, MPI_BYTE, &nbytes);
-        rlo_wire_node *n = (rlo_wire_node *)malloc(sizeof(*n));
-        rlo_blob *frame = rlo_blob_new(nbytes);
+        rlo_wire_node *n =
+            (rlo_wire_node *)rlo_pool_alloc(&w->base, sizeof(*n));
+        rlo_blob *frame = rlo_blob_new_w(&w->base, nbytes);
         if (!n || !frame) {
-            free(n);
+            rlo_pool_free(n);
             rlo_blob_unref(frame);
             return RLO_ERR_NOMEM;
         }
@@ -146,9 +147,9 @@ static int mpi_pump(rlo_mpi_world *w)
         n->comm = st.MPI_TAG / MPI_TAG_STRIDE;
         n->due = 0;
         n->frame = frame;
-        n->handle = rlo_handle_new(1);
+        n->handle = rlo_handle_new_w(&w->base, 1);
         if (!n->handle) {
-            free(n);
+            rlo_pool_free(n);
             rlo_blob_unref(frame);
             return RLO_ERR_NOMEM;
         }
@@ -276,11 +277,12 @@ static void mpi_free(rlo_world *base)
         rlo_wire_node *nn = n->next;
         rlo_handle_unref(n->handle);
         rlo_blob_unref(n->frame);
-        free(n);
+        rlo_pool_free(n);
         n = nn;
     }
     MPI_Comm_free(&w->comm);
     free(base->engines);
+    rlo_pool_drain(base);
     free(w);
 }
 

@@ -173,10 +173,11 @@ static int shm_pump(rlo_shm_world *w)
                 break;
             shm_rec rec;
             ring_read(r, cap, tail, &rec, sizeof(rec));
-            rlo_wire_node *n = (rlo_wire_node *)malloc(sizeof(*n));
-            rlo_blob *frame = rlo_blob_new(rec.len);
+            rlo_wire_node *n = (rlo_wire_node *)rlo_pool_alloc(
+                &w->base, sizeof(*n));
+            rlo_blob *frame = rlo_blob_new_w(&w->base, rec.len);
             if (!n || !frame) {
-                free(n);
+                rlo_pool_free(n);
                 rlo_blob_unref(frame);
                 return RLO_ERR_NOMEM;
             }
@@ -187,9 +188,9 @@ static int shm_pump(rlo_shm_world *w)
             n->comm = rec.comm;
             n->due = 0;
             n->frame = frame;
-            n->handle = rlo_handle_new(1);
+            n->handle = rlo_handle_new_w(&w->base, 1);
             if (!n->handle) {
-                free(n);
+                rlo_pool_free(n);
                 rlo_blob_unref(frame);
                 return RLO_ERR_NOMEM;
             }
@@ -233,7 +234,7 @@ static int shm_isend(rlo_world *base, int src, int dst, int comm, int tag,
      * actually happened */
     rlo_handle *h = 0;
     if (out) {
-        h = rlo_handle_new(1);
+        h = rlo_handle_new_w(base, 1);
         if (!h)
             return RLO_ERR_NOMEM;
         h->delivered = 1; /* buffered-send semantics */
@@ -415,12 +416,13 @@ static void shm_free(rlo_world *base)
         rlo_wire_node *nn = n->next;
         rlo_handle_unref(n->handle);
         rlo_blob_unref(n->frame);
-        free(n);
+        rlo_pool_free(n);
         n = nn;
     }
     /* the segment is unmapped at process exit; unmapping here would break
      * other engines still bound to it in this process */
     free(base->engines);
+    rlo_pool_drain(base);
     free(w);
 }
 

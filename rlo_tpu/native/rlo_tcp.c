@@ -17,6 +17,18 @@
  * opportunistically from isend/poll, and the completion handle reports
  * delivered once the kernel accepted every byte.
  *
+ * Flushing is scatter-gather (docs/DESIGN.md S13): one sendmsg carries
+ * up to TCP_IOV_BATCH iovecs spanning as many queued frames as fit, so
+ * ACKs, heartbeats, and small broadcasts share a syscall instead of
+ * paying one each. A short write leaves the first incomplete frame's
+ * offset mid-node and the next flush resumes exactly there — per-peer
+ * byte order is the queue order regardless of batching. The transport
+ * also implements the optional isend_hdr gather op: a restamped
+ * 28-byte frame header rides node-local staging while the payload goes
+ * to the kernel straight from the engine's shared blob (zero-copy for
+ * large ARQ-stamped messages). RLO_TCP_SNDBUF shrinks SO_SNDBUF —
+ * selftest support for forcing partial writes deterministically.
+ *
  * Termination detection (reference rootless_ops.c:1613-1625 drain,
  * generalized like the MPI transport's): when all local engines are
  * idle and the socket queues quiescent, a two-pass ring allreduce of
@@ -41,6 +53,7 @@
 #include <sched.h>
 #include <stdio.h>
 #include <sys/socket.h>
+#include <sys/uio.h>
 #include <time.h>
 #include <unistd.h>
 
@@ -49,6 +62,10 @@
 #define TCP_CONNECT_TIMEOUT_SEC 30
 #define TCP_CTRL_TIMEOUT_SEC 120
 #define TCP_MAX_FRAME (1ll << 30)
+/* iovecs per sendmsg batch: 3 per frame worst case (transport header,
+ * staged frame header, payload), comfortably under every platform's
+ * IOV_MAX (Linux 1024) */
+#define TCP_IOV_BATCH 64
 
 #define TCP_CTRL_COMM 0x7ffffffe /* transport-internal frames */
 /* ctrl tags */
@@ -65,10 +82,26 @@ typedef struct tcp_hdr {
 typedef struct tcp_send_node {
     struct tcp_send_node *next;
     tcp_hdr hdr;
+    /* isend_hdr gather nodes: the restamped frame header lives in
+     * this staging and the payload stays in `frame` past body_off
+     * (fhdr_len == 0 marks a whole-frame node — every wire byte after
+     * the transport header comes from `frame` at offset 0). Both node
+     * shapes emit exactly hdr.len == frame->len frame bytes, so the
+     * receiver cannot tell them apart. */
+    uint8_t fhdr[RLO_HEADER_SIZE];
+    size_t fhdr_len; /* 0 or RLO_HEADER_SIZE */
+    size_t body_off; /* first frame byte taken from frame->data */
     rlo_blob *frame;
-    size_t off; /* bytes of (hdr+frame) already written */
+    size_t off; /* bytes of (hdr+fhdr+body) already written */
     rlo_handle *handle;
 } tcp_send_node;
+
+/* wire bytes this node emits in total */
+static size_t node_total(const tcp_send_node *n)
+{
+    return sizeof n->hdr + n->fhdr_len +
+           ((size_t)n->frame->len - n->body_off);
+}
 
 typedef struct tcp_peer {
     int fd;                        /* -1 for self */
@@ -111,63 +144,109 @@ static void set_nodelay(int fd)
 
 static void tcp_peer_crashed(rlo_tcp_world *w, tcp_peer *p);
 
-/* flush as much of dst's queue as the kernel accepts right now */
+/* Flush as much of dst's queue as the kernel accepts right now: gather
+ * up to TCP_IOV_BATCH iovecs across queued frames into one sendmsg
+ * (the coalescing rules of docs/DESIGN.md S13 — frames already queued
+ * when the syscall fires share it; nothing is delayed waiting for
+ * company). A short write advances node offsets in queue order and
+ * the next flush resumes at the first incomplete byte. */
 static int tcp_flush_peer(rlo_tcp_world *w, int dst)
 {
     tcp_peer *p = &w->peers[dst];
     while (p->sq_head) {
-        tcp_send_node *n = p->sq_head;
-        size_t hdr_sz = sizeof n->hdr;
-        size_t total = hdr_sz + (size_t)n->hdr.len;
-        while (n->off < total) {
-            const uint8_t *src;
-            size_t avail;
-            if (n->off < hdr_sz) {
-                src = (const uint8_t *)&n->hdr + n->off;
-                avail = hdr_sz - n->off;
-            } else {
-                src = n->frame->data + (n->off - hdr_sz);
-                avail = total - n->off;
+        struct iovec iov[TCP_IOV_BATCH];
+        int niov = 0;
+        size_t batch = 0;
+        for (tcp_send_node *n = p->sq_head;
+             n && niov + 3 <= TCP_IOV_BATCH; n = n->next) {
+            size_t hdr_sz = sizeof n->hdr;
+            size_t fhdr_end = hdr_sz + n->fhdr_len;
+            size_t total = node_total(n);
+            size_t off = n->off;
+            if (off < hdr_sz) {
+                iov[niov].iov_base = (uint8_t *)&n->hdr + off;
+                iov[niov++].iov_len = hdr_sz - off;
+                off = hdr_sz;
             }
-            ssize_t k = send(p->fd, src, avail, MSG_NOSIGNAL);
-            if (k > 0) {
-                n->off += (size_t)k;
-                continue;
+            if (off < fhdr_end) {
+                iov[niov].iov_base = n->fhdr + (off - hdr_sz);
+                iov[niov++].iov_len = fhdr_end - off;
+                off = fhdr_end;
             }
-            if (k < 0 && (errno == EAGAIN || errno == EWOULDBLOCK))
+            if (off < total) {
+                iov[niov].iov_base =
+                    n->frame->data + n->body_off + (off - fhdr_end);
+                iov[niov++].iov_len = total - off;
+            }
+            batch += total - n->off;
+        }
+        struct msghdr mh;
+        memset(&mh, 0, sizeof mh);
+        mh.msg_iov = iov;
+        mh.msg_iovlen = (size_t)niov;
+        ssize_t k = sendmsg(p->fd, &mh, MSG_NOSIGNAL);
+        if (k < 0) {
+            if (errno == EAGAIN || errno == EWOULDBLOCK)
                 return RLO_OK; /* kernel buffer full: try later */
             tcp_peer_crashed(w, p); /* EPIPE/reset: the peer died */
             return RLO_ERR_STALL;
         }
-        /* fully written */
-        p->sq_head = n->next;
-        if (!p->sq_head)
-            p->sq_tail = 0;
-        if (n->handle) {
-            n->handle->delivered = 1;
-            rlo_handle_unref(n->handle);
+        size_t wrote = (size_t)k;
+        /* consume the written bytes across the queue head (partial-
+         * write resume: the first incomplete node keeps its offset) */
+        size_t left = wrote;
+        while (left > 0 && p->sq_head) {
+            tcp_send_node *n = p->sq_head;
+            size_t total = node_total(n);
+            size_t take =
+                left < total - n->off ? left : total - n->off;
+            n->off += take;
+            left -= take;
+            if (n->off < total)
+                break;
+            p->sq_head = n->next;
+            if (!p->sq_head)
+                p->sq_tail = 0;
+            if (n->handle) {
+                n->handle->delivered = 1;
+                rlo_handle_unref(n->handle);
+            }
+            rlo_blob_unref(n->frame);
+            rlo_pool_free(n);
         }
-        rlo_blob_unref(n->frame);
-        free(n);
+        if (wrote < batch)
+            return RLO_OK; /* kernel took a partial batch: try later */
     }
     return RLO_OK;
 }
 
+/* Queue one frame for dst. fhdr != NULL is the gather shape: fhdr's
+ * RLO_HEADER_SIZE restamped bytes replace the frame blob's own header
+ * on the wire and the payload is taken from the blob past the header
+ * (the isend_hdr zero-copy path); fhdr == NULL ships the whole blob. */
 static int tcp_enqueue(rlo_tcp_world *w, int dst, int comm, int tag,
-                       rlo_blob *frame, rlo_handle **out)
+                       const uint8_t *fhdr, rlo_blob *frame,
+                       rlo_handle **out)
 {
     tcp_peer *p = &w->peers[dst];
-    tcp_send_node *n = (tcp_send_node *)calloc(1, sizeof(*n));
-    rlo_handle *h = out ? rlo_handle_new(2) : 0;
+    tcp_send_node *n =
+        (tcp_send_node *)rlo_pool_alloc(&w->base, sizeof(*n));
+    rlo_handle *h = out ? rlo_handle_new_w(&w->base, 2) : 0;
     if (!n || (out && !h)) {
-        free(n);
-        free(h);
+        rlo_pool_free(n);
+        rlo_pool_free(h);
         return RLO_ERR_NOMEM;
     }
+    memset(n, 0, sizeof(*n));
     n->hdr.src = w->base.my_rank;
     n->hdr.tag = tag;
     n->hdr.comm = comm;
     n->hdr.len = frame->len;
+    if (fhdr) {
+        memcpy(n->fhdr, fhdr, RLO_HEADER_SIZE);
+        n->fhdr_len = RLO_HEADER_SIZE;
+        n->body_off = RLO_HEADER_SIZE;
+    }
     n->frame = rlo_blob_ref(frame);
     n->handle = h;
     if (p->sq_tail)
@@ -180,8 +259,9 @@ static int tcp_enqueue(rlo_tcp_world *w, int dst, int comm, int tag,
     return tcp_flush_peer(w, dst);
 }
 
-static int tcp_isend(rlo_world *base, int src, int dst, int comm, int tag,
-                     rlo_blob *frame, rlo_handle **out)
+static int tcp_send_common(rlo_world *base, int src, int dst, int comm,
+                           int tag, const uint8_t *fhdr, rlo_blob *frame,
+                           rlo_handle **out)
 {
     rlo_tcp_world *w = (rlo_tcp_world *)base;
     if (dst < 0 || dst >= base->world_size || !frame || frame->len < 0 ||
@@ -196,7 +276,7 @@ static int tcp_isend(rlo_world *base, int src, int dst, int comm, int tag,
          * LIVE peers keeps flowing — the engine-level failure detector
          * (not a sticky transport error) owns the recovery */
         if (out) {
-            rlo_handle *h = rlo_handle_new(1);
+            rlo_handle *h = rlo_handle_new_w(base, 1);
             if (!h)
                 return RLO_ERR_NOMEM;
             h->delivered = 1;
@@ -205,7 +285,7 @@ static int tcp_isend(rlo_world *base, int src, int dst, int comm, int tag,
         }
         return RLO_OK;
     }
-    int rc = tcp_enqueue(w, dst, comm, tag, frame, out);
+    int rc = tcp_enqueue(w, dst, comm, tag, fhdr, frame, out);
     if (rc == RLO_ERR_STALL && w->peers[dst].crashed)
         rc = RLO_OK; /* crash detected on this very flush: the handle
                         already fail-completed; not a caller error */
@@ -214,10 +294,27 @@ static int tcp_isend(rlo_world *base, int src, int dst, int comm, int tag,
     return rc;
 }
 
+static int tcp_isend(rlo_world *base, int src, int dst, int comm, int tag,
+                     rlo_blob *frame, rlo_handle **out)
+{
+    return tcp_send_common(base, src, dst, comm, tag, 0, frame, out);
+}
+
+/* Zero-copy gather op (rlo_internal.h isend_hdr): the caller's
+ * restamped header is copied into node staging, the payload goes to
+ * sendmsg straight from the shared blob. */
+static int tcp_isend_hdr(rlo_world *base, int src, int dst, int comm,
+                         int tag, const uint8_t *hdr, rlo_blob *frame,
+                         rlo_handle **out)
+{
+    return tcp_send_common(base, src, dst, comm, tag, hdr, frame, out);
+}
+
 static void tcp_deliver(rlo_tcp_world *w, int src)
 {
     tcp_peer *p = &w->peers[src];
-    rlo_wire_node *n = (rlo_wire_node *)malloc(sizeof(*n));
+    rlo_wire_node *n =
+        (rlo_wire_node *)rlo_pool_alloc(&w->base, sizeof(*n));
     if (!n) {
         w->failed = 1;
         return;
@@ -229,10 +326,10 @@ static void tcp_deliver(rlo_tcp_world *w, int src)
     n->comm = p->rhdr.comm;
     n->due = 0;
     n->frame = p->rframe;
-    n->handle = rlo_handle_new(1);
+    n->handle = rlo_handle_new_w(&w->base, 1);
     if (!n->handle) {
         rlo_blob_unref(p->rframe);
-        free(n);
+        rlo_pool_free(n);
         w->failed = 1;
         p->rframe = 0;
         return;
@@ -284,7 +381,7 @@ static void tcp_peer_crashed(rlo_tcp_world *w, tcp_peer *p)
             rlo_handle_unref(n->handle);
         }
         rlo_blob_unref(n->frame);
-        free(n);
+        rlo_pool_free(n);
         n = nn;
     }
     p->sq_head = p->sq_tail = 0;
@@ -331,7 +428,7 @@ static void tcp_pump(rlo_tcp_world *w)
                     tcp_peer_crashed(w, p);
                     return;
                 }
-                p->rframe = rlo_blob_new(p->rhdr.len);
+                p->rframe = rlo_blob_new_w(&w->base, p->rhdr.len);
                 if (!p->rframe) {
                     w->failed = 1;
                     return;
@@ -428,11 +525,11 @@ static int tcp_peer_alive(const rlo_world *base, int rank,
 static int ctrl_send(rlo_tcp_world *w, int dst, int tag,
                      const int64_t *payload, int n64)
 {
-    rlo_blob *b = rlo_blob_new((int64_t)n64 * 8);
+    rlo_blob *b = rlo_blob_new_w(&w->base, (int64_t)n64 * 8);
     if (!b)
         return RLO_ERR_NOMEM;
     memcpy(b->data, payload, (size_t)n64 * 8);
-    int rc = tcp_enqueue(w, dst, TCP_CTRL_COMM, tag, b, 0);
+    int rc = tcp_enqueue(w, dst, TCP_CTRL_COMM, tag, 0, b, 0);
     rlo_blob_unref(b);
     if (rc != RLO_OK)
         return rc;
@@ -465,13 +562,13 @@ static int ctrl_wait(rlo_tcp_world *w, int tag, int64_t *payload, int n64)
             if (n->frame->len < (int64_t)n64 * 8) {
                 rlo_handle_unref(n->handle);
                 rlo_blob_unref(n->frame);
-                free(n);
+                rlo_pool_free(n);
                 return RLO_ERR_PROTO;
             }
             memcpy(payload, n->frame->data, (size_t)n64 * 8);
             rlo_handle_unref(n->handle);
             rlo_blob_unref(n->frame);
-            free(n);
+            rlo_pool_free(n);
             return RLO_OK;
         }
         rlo_progress_all(&w->base); /* keep data + engine frames moving */
@@ -569,7 +666,7 @@ static void tcp_free(rlo_world *base)
             tcp_send_node *nn = n->next;
             rlo_handle_unref(n->handle);
             rlo_blob_unref(n->frame);
-            free(n);
+            rlo_pool_free(n);
             n = nn;
         }
         rlo_blob_unref(p->rframe);
@@ -582,10 +679,11 @@ static void tcp_free(rlo_world *base)
             rlo_wire_node *nn = n->next;
             rlo_handle_unref(n->handle);
             rlo_blob_unref(n->frame);
-            free(n);
+            rlo_pool_free(n);
             n = nn;
         }
     free(base->engines);
+    rlo_pool_drain(base);
     free(w);
 }
 
@@ -602,6 +700,7 @@ static const rlo_transport_ops TCP_OPS = {
     .kill_rank = 0,
     .barrier = tcp_barrier,
     .free_ = tcp_free,
+    .isend_hdr = tcp_isend_hdr,
 };
 
 /* parse "host:port" entry i of RLO_TCP_HOSTS, or default localhost */
@@ -754,10 +853,18 @@ rlo_world *rlo_tcp_world_new(void)
         w->peers[hello].fd = fd;
     }
     close(lfd);
+    /* RLO_TCP_SNDBUF: shrink the kernel send buffer (test support —
+     * the writev partial-write-resume selftest forces short writes
+     * deterministically this way; unset = kernel default) */
+    const char *sb = getenv("RLO_TCP_SNDBUF");
+    int sndbuf = sb ? atoi(sb) : 0;
     for (int r = 0; r < ws; r++)
         if (w->peers[r].fd >= 0) {
             set_nonblock(w->peers[r].fd);
             set_nodelay(w->peers[r].fd);
+            if (sndbuf > 0)
+                setsockopt(w->peers[r].fd, SOL_SOCKET, SO_SNDBUF,
+                           &sndbuf, sizeof sndbuf);
         }
     /* everyone connected everywhere before any traffic */
     tcp_barrier(&w->base);

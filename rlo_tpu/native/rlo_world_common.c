@@ -12,6 +12,66 @@
 
 #include <string.h>
 
+/* ---- small-object pool (rlo_internal.h has the design notes) ---- */
+
+static const size_t POOL_CEILING[RLO_POOL_CLASSES] = {
+    RLO_POOL_C0, RLO_POOL_C1, RLO_POOL_C2, RLO_POOL_C3};
+
+void *rlo_pool_alloc(rlo_world *w, size_t size)
+{
+#ifdef RLO_POOL_PASSTHROUGH
+    w = 0; /* sanitizer builds: every object is a fresh malloc */
+#endif
+    size_t cls = RLO_POOL_CLASSES;
+    if (w)
+        for (cls = 0; cls < RLO_POOL_CLASSES; cls++)
+            if (size <= POOL_CEILING[cls])
+                break;
+    if (w && cls < RLO_POOL_CLASSES && w->pool_free[cls]) {
+        rlo_pool_hdr *h = (rlo_pool_hdr *)w->pool_free[cls];
+        w->pool_free[cls] = h->link;
+        h->link = w;
+        return h + 1;
+    }
+    rlo_pool_hdr *h = (rlo_pool_hdr *)malloc(
+        sizeof(*h) +
+        (cls < RLO_POOL_CLASSES ? POOL_CEILING[cls] : size));
+    if (!h)
+        return 0;
+    h->link = cls < RLO_POOL_CLASSES ? (void *)w : 0;
+    h->cls = cls;
+    return h + 1;
+}
+
+void rlo_pool_free(void *p)
+{
+    if (!p)
+        return;
+    rlo_pool_hdr *h = (rlo_pool_hdr *)p - 1;
+    rlo_world *w = (rlo_world *)h->link;
+    if (!w || h->cls >= RLO_POOL_CLASSES) {
+        free(h);
+        return;
+    }
+    h->link = w->pool_free[h->cls];
+    w->pool_free[h->cls] = h;
+}
+
+void rlo_pool_drain(rlo_world *w)
+{
+    for (int c = 0; c < RLO_POOL_CLASSES; c++) {
+        for (void *p = w->pool_free[c]; p;) {
+            void *next = ((rlo_pool_hdr *)p)->link;
+            free(p);
+            p = next;
+        }
+        w->pool_free[c] = 0;
+    }
+    free(w->sweep_scratch);
+    w->sweep_scratch = 0;
+    w->sweep_cap = 0;
+}
+
 int rlo_world_size(const rlo_world *w)
 {
     return w->world_size;
@@ -130,6 +190,29 @@ int rlo_world_isend(rlo_world *w, int src, int dst, int comm, int tag,
     return w->ops->isend(w, src, dst, comm, tag, frame, out);
 }
 
+int rlo_world_isend_hdr(rlo_world *w, int src, int dst, int comm,
+                        int tag, const uint8_t *hdr, rlo_blob *frame,
+                        rlo_handle **out)
+{
+    if (frame->len < RLO_HEADER_SIZE)
+        return RLO_ERR_ARG;
+    if (w->ops->isend_hdr)
+        return w->ops->isend_hdr(w, src, dst, comm, tag, hdr, frame,
+                                 out);
+    /* fallback: materialize the stamped header + shared payload into
+     * one contiguous frame (one copy — the pre-S13 behavior for
+     * transports without scatter-gather) */
+    rlo_blob *b = rlo_blob_new_w(w, frame->len);
+    if (!b)
+        return RLO_ERR_NOMEM;
+    memcpy(b->data, hdr, RLO_HEADER_SIZE);
+    memcpy(b->data + RLO_HEADER_SIZE, frame->data + RLO_HEADER_SIZE,
+           (size_t)(frame->len - RLO_HEADER_SIZE));
+    int rc = w->ops->isend(w, src, dst, comm, tag, b, out);
+    rlo_blob_unref(b);
+    return rc;
+}
+
 rlo_wire_node *rlo_world_poll(rlo_world *w, int rank, int comm)
 {
     return w->ops->poll(w, rank, comm);
@@ -162,34 +245,102 @@ void rlo_world_unregister(rlo_world *w, rlo_engine *e)
     }
 }
 
-void rlo_progress_all(rlo_world *w)
+/* One sweep: every engine gets one progress turn, sharing a frame
+ * budget (budget < 0 = unbounded). Returns frames polled across the
+ * sweep. Re-entrant calls are no-ops returning 0. */
+static int64_t world_sweep(rlo_world *w, int64_t budget)
 {
     /* handlers may initiate broadcasts (decision bcast inside the vote
      * handler) which re-enter; make nested turns no-ops (mirrors
      * EngineManager._stepping, rlo_tpu/engine.py) */
     if (w->stepping)
-        return;
+        return 0;
     w->stepping = 1;
+    int64_t total = 0;
     /* step over a snapshot: callbacks may register/unregister engines
-     * mid-turn (the Python side iterates a copy for the same reason) */
+     * mid-turn (the Python side iterates a copy for the same reason).
+     * The snapshot buffer is world-owned scratch, reused sweep to
+     * sweep — the stepping guard rules out concurrent sweeps. */
     int n = w->n_engines;
-    rlo_engine **snap =
-        (rlo_engine **)malloc((size_t)(n ? n : 1) * sizeof(void *));
-    if (snap) {
+    if (n > w->sweep_cap) {
+        int cap = w->sweep_cap ? w->sweep_cap * 2 : 8;
+        while (cap < n)
+            cap *= 2;
+        rlo_engine **s = (rlo_engine **)realloc(
+            w->sweep_scratch, (size_t)cap * sizeof(void *));
+        if (s) {
+            w->sweep_scratch = s;
+            w->sweep_cap = cap;
+        }
+    }
+    rlo_engine **snap = w->sweep_scratch;
+    if (snap && n <= w->sweep_cap) {
         if (n > 0) /* engines may be NULL pre-registration (UBSan) */
             memcpy(snap, w->engines, (size_t)n * sizeof(void *));
         for (int i = 0; i < n; i++) {
+            if (budget >= 0 && total >= budget)
+                break; /* the rest of the sweep waits for more budget */
             /* skip engines freed by an earlier engine's callback */
             int live = 0;
             for (int j = 0; j < w->n_engines; j++)
                 if (w->engines[j] == snap[i])
                     live = 1;
             if (live)
-                rlo_engine_progress_once(snap[i]);
+                total += rlo_engine_progress_budget(
+                    snap[i], budget >= 0 ? budget - total : -1);
         }
-        free(snap);
     }
     w->stepping = 0;
+    return total;
+}
+
+void rlo_progress_all(rlo_world *w)
+{
+    world_sweep(w, -1);
+}
+
+/* Batched world progress (docs/DESIGN.md S13; contract in rlo_core.h):
+ * sweep until the budget fills, the deadline expires, or — with no
+ * deadline — the first fruitless sweep with a quiescent transport
+ * (in-flight latency frames keep it sweeping: every loopback poll
+ * advances the delivery clock, so a non-quiescent world always makes
+ * progress toward the next due frame). A fruitless-sweep fuse bounds
+ * the pathological case of in-flight frames no registered engine will
+ * ever poll (a comm whose engine was freed mid-traffic). */
+#define RLO_PROGRESS_FRUITLESS_FUSE 65536
+
+int64_t rlo_world_progress_all_n(rlo_world *w, int64_t max_frames,
+                                 uint64_t deadline_usec)
+{
+    if (!w)
+        return RLO_ERR_ARG;
+    if (w->stepping)
+        return 0; /* re-entered from a handler: no-op */
+    uint64_t end = deadline_usec ? rlo_now_usec() + deadline_usec : 0;
+    int64_t total = 0;
+    int64_t fruitless = 0;
+    for (;;) {
+        /* dead-time skip BEFORE each sweep: frames waiting out
+         * injected latency jump straight to deliverable (the one-poll-
+         * per-tick path would burn a sweep per dead tick); a no-op on
+         * real-time transports and on latency-free worlds */
+        int64_t moved = w->ops->advance ? w->ops->advance(w) : 0;
+        int64_t got = world_sweep(
+            w, max_frames > 0 ? max_frames - total : -1);
+        total += got;
+        if (max_frames > 0 && total >= max_frames)
+            break;
+        if (got == 0 && moved == 0) {
+            if (!end && (rlo_world_quiescent(w) ||
+                         ++fruitless >= RLO_PROGRESS_FRUITLESS_FUSE))
+                break;
+        } else {
+            fruitless = 0;
+        }
+        if (end && rlo_now_usec() >= end)
+            break;
+    }
+    return total;
 }
 
 int rlo_drain(rlo_world *w, int max_spins)

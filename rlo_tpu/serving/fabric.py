@@ -124,12 +124,31 @@ class DecodeFabric:
     (virtual time in the simulator), ``load_interval`` paces the
     Tag.SERVE load gossip, ``place_retry`` paces placement-round
     retries while the agreed record trails the membership view.
+
+    ``done_ttl`` bounds the rid→tokens completion cache (the §12
+    known-bounds rider): completions older than the horizon (engine
+    clock seconds) are evicted during ``pump()`` and counted in
+    ``fabric.done_evicted``, so a long-lived fabric's DONE table stops
+    growing with lifetime traffic. Eviction drops the TOKEN PAYLOADS,
+    not the exactly-once property: evicted rids leave tombstones in a
+    bounded ring (``_EVICTED_RING``, two ints per entry), so a DONE or
+    ADMIT replayed by a heal re-broadcast is absorbed, never
+    re-completed or re-decoded. The practical contract for clients:
+    ``result()`` returns None once a completion ages out, so read
+    results within the horizon; size the TTL past the longest
+    heal/replay window so tombstones are still ringed when replays
+    arrive. ``None`` (the default) keeps the historical
+    keep-everything behavior.
     """
+
+    #: tombstone-ring depth for evicted completions (see done_ttl)
+    _EVICTED_RING = 1 << 16
 
     def __init__(self, engine: ProgressEngine, backend, *,
                  decode_interval: float = 0.25,
                  load_interval: float = 1.0,
                  place_retry: float = 2.0,
+                 done_ttl: Optional[float] = None,
                  metrics: Optional[Registry] = None):
         self.engine = engine
         self.backend = backend
@@ -138,6 +157,7 @@ class DecodeFabric:
         self.decode_interval = decode_interval
         self.load_interval = load_interval
         self.place_retry = place_retry
+        self.done_ttl = done_ttl
         self.metrics = Registry() if metrics is None else metrics
 
         #: PENDING requests only — entries are evicted at completion
@@ -156,6 +176,21 @@ class DecodeFabric:
         self.requeues = 0
         self.dup_done = 0
         self._local: set = set()    # rids submitted to my backend
+        #: completion order with timestamps, for TTL eviction (clock
+        #: values are monotone, so the left end is always the oldest)
+        self._done_order: deque = deque()
+        #: tombstones for evicted rids: the token payloads are gone but
+        #: the rid-level exactly-once dedup must survive eviction — a
+        #: DONE replayed by a heal re-broadcast (or a re-admission)
+        #: for an aged-out rid must not re-complete it. BOUNDED: the
+        #: ring caps tombstone memory (two ints per entry); replays
+        #: only originate from peers' 64-deep ``_recent_done`` rings
+        #: and pending-ADMIT re-broadcasts, so a ring orders of
+        #: magnitude deeper than any fleet's replay sources keeps the
+        #: dedup airtight while the table stays O(1) in lifetime
+        #: traffic.
+        self._evicted: set = set()
+        self._evicted_ring: deque = deque(maxlen=self._EVICTED_RING)
         self._next_seq = engine.incarnation << INCARNATION_SHIFT
         self._loads: Dict[int, Tuple[int, int]] = {}
         self._recent_done: deque = deque(maxlen=64)
@@ -195,7 +230,9 @@ class DecodeFabric:
         return rid
 
     def result(self, rid: Rid) -> Optional[Tuple[int, ...]]:
-        """Completed tokens for ``rid``, or None while pending."""
+        """Completed tokens for ``rid``, or None while pending (or
+        after the completion aged out of the ``done_ttl`` cache —
+        clients must read results within the horizon)."""
         return self.done.get(rid)
 
     def pending(self) -> List[Rid]:
@@ -344,8 +381,28 @@ class DecodeFabric:
             for dst in view:
                 if dst != self.rank:
                     eng.send_direct(dst, raw)
+        if self.done_ttl is not None:
+            self._evict_done(now)
         self.metrics.gauge("fabric.pending").set(len(self.requests))
         return unhandled
+
+    def _evict_done(self, now: float) -> None:
+        """Age the completion cache past the ``done_ttl`` horizon (the
+        order deque is completion-ordered, so this pops only expired
+        heads — O(evicted), not O(table))."""
+        horizon = now - self.done_ttl
+        evicted = 0
+        while self._done_order and self._done_order[0][0] <= horizon:
+            _, rid = self._done_order.popleft()
+            if self.done.pop(rid, None) is not None:
+                self.done_by.pop(rid, None)
+                if len(self._evicted_ring) == self._evicted_ring.maxlen:
+                    self._evicted.discard(self._evicted_ring[0])
+                self._evicted_ring.append(rid)
+                self._evicted.add(rid)
+                evicted += 1
+        if evicted:
+            self.metrics.counter("fabric.done_evicted").inc(evicted)
 
     # ------------------------------------------------------------------
     # record handling
@@ -384,6 +441,11 @@ class DecodeFabric:
                     origin, _enc_done(rid, self.done_by.get(rid, -1),
                                       self.done[rid]))
             return
+        if rid in self._evicted:
+            # completed here but aged out of the done_ttl cache: the
+            # tokens are gone, so there is nothing to answer with —
+            # but re-admitting would re-decode a settled request
+            return
         if rid in self.requests:
             return  # duplicate admission: rid-level exactly-once
         self._apply_admit(rid, owner, max_new, eos, prompt)
@@ -409,17 +471,26 @@ class DecodeFabric:
 
     def _record_done(self, rid: Rid, decoder: int,
                      toks: Tuple[int, ...]) -> None:
-        if rid in self.done:
+        if rid in self.done or rid in self._evicted:
             # a DONE copy for a settled rid (heal re-broadcasts, a
-            # direct reply racing the broadcast): exactly-once means
+            # direct reply racing the broadcast, or a replay for a rid
+            # the done_ttl cache already evicted): exactly-once means
             # the first one won. Absorbed copies are bookkeeping, not
             # wasted decode work — that is fabric.dup_decodes.
             self.metrics.counter("fabric.done_copies").inc()
+            # a replayed ADMIT may have ghost-revived the request
+            # before this tombstoned DONE copy arrived: retire it
+            if self.requests.pop(rid, None) is not None and \
+                    rid in self._local:
+                self.backend.cancel(rid)
+                self._local.discard(rid)
             return
         self.done[rid] = tuple(toks)
         self.done_by[rid] = decoder
         self.completions.append(rid)
         self._recent_done.append(rid)
+        if self.done_ttl is not None:
+            self._done_order.append((self.clock(), rid))
         self.metrics.counter("fabric.requests_completed").inc()
         req = self.requests.pop(rid, None)  # evict: decoded == done
         if req is not None:
@@ -465,8 +536,11 @@ class DecodeFabric:
             self.engine.bcast(_enc_admit(rid, req.owner, req.max_new,
                                          req.eos_id, req.prompt))
         for rid in list(self._recent_done):
+            toks = self.done.get(rid)
+            if toks is None:
+                continue  # aged out of the completion cache (done_ttl)
             self.engine.bcast(_enc_done(rid, self.done_by.get(rid, -1),
-                                        self.done[rid]))
+                                        toks))
 
     # ------------------------------------------------------------------
     # telemetry

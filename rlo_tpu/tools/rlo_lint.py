@@ -1047,13 +1047,19 @@ def rule_r4(ctx: "LintContext") -> List[Finding]:
             isinstance(n, ast.Attribute) and n.attr == "_on_other"
             for n in ast.walk(disp))
 
-    # --- C dispatch (rlo_engine_progress_once) ---
+    # --- C dispatch: the progress-turn body. Since the batched-
+    # progress refactor (docs/DESIGN.md §13) the switch lives in
+    # rlo_engine_progress_budget (rlo_engine_progress_once is a
+    # wrapper); older trees keep it in progress_once. ---
     body = _extract_c_function(ctx.engine_c_stripped,
-                               "rlo_engine_progress_once")
+                               "rlo_engine_progress_budget")
+    if body is None:
+        body = _extract_c_function(ctx.engine_c_stripped,
+                                   "rlo_engine_progress_once")
     if body is None:
         f.append(Finding("R4", ENGINE_C, 1,
-                         "rlo_engine_progress_once (the tag switch) "
-                         "not found"))
+                         "rlo_engine_progress_budget/_once (the tag "
+                         "switch) not found"))
         c_explicit: Set[str] = set()
         c_catchall = False
     else:

@@ -158,6 +158,75 @@ class TestFabricScenarios:
 # telemetry
 # ---------------------------------------------------------------------------
 
+def test_done_ttl_evicts_completion_cache():
+    """ISSUE-11 satellite: with ``done_ttl`` set, the rid→tokens DONE
+    table ages out past the horizon (fabric.done_evicted counts it),
+    while the default keeps everything; exactly-once completion is
+    untouched within the horizon."""
+    from rlo_tpu.engine import EngineManager, ProgressEngine
+    from rlo_tpu.transport.sim import SimWorld
+
+    world = SimWorld(3, seed=5)
+    mgr = EngineManager()
+    engines = [ProgressEngine(world.transport(r), manager=mgr,
+                              clock=world.clock)
+               for r in range(3)]
+    fabrics = [DecodeFabric(engines[r], StubBackend(n_slots=2),
+                            decode_interval=1.0,
+                            done_ttl=30.0 if r != 2 else None)
+               for r in range(3)]
+
+    def run_until(cond, limit):
+        while world.now < limit:
+            world.step()
+            mgr.progress_all()
+            for f in fabrics:
+                f.pump()
+            if cond():
+                return True
+        return False
+
+    rid = fabrics[0].submit((4, 4), 6)
+    assert run_until(
+        lambda: all(f.result(rid) is not None for f in fabrics), 40.0)
+    tokens = fabrics[0].result(rid)
+    assert tokens == stub_tokens((4, 4), 6, None)
+    # age the fleet past the horizon: TTL fabrics evict, default keeps
+    # (each rank evicts on its own clock — wait for both)
+    assert run_until(lambda: all(f.result(rid) is None
+                                 for f in fabrics[:2]), 120.0)
+    for f in fabrics[:2]:
+        assert f.result(rid) is None
+        snap = f.metrics.snapshot()
+        assert snap["counters"]["fabric.done_evicted"] >= 1
+        assert not f.done and not f.done_by
+    assert fabrics[2].result(rid) == tokens  # default: keep forever
+    assert "fabric.done_evicted" not in \
+        fabrics[2].metrics.snapshot()["counters"]
+    # the completion LOG (client-visible exactly-once record) survives
+    assert all(rid in f.completions for f in fabrics)
+    # a DONE replayed for an evicted rid (heal re-broadcast from a
+    # keep-everything peer) must NOT re-complete it: the tombstone
+    # absorbs the copy and the log stays exactly-once
+    from rlo_tpu.serving.fabric import _enc_done
+    replay = _enc_done(rid, fabrics[2].done_by[rid],
+                       fabrics[2].done[rid])
+    fabrics[0]._on_record(replay, 2)
+    assert fabrics[0].completions.count(rid) == 1
+    assert fabrics[0].result(rid) is None
+    snap0 = fabrics[0].metrics.snapshot()["counters"]
+    assert snap0["fabric.done_copies"] >= 1
+    # ...and a replayed ADMIT for the evicted rid is not re-admitted
+    from rlo_tpu.serving.fabric import _enc_admit
+    fabrics[0]._on_record(_enc_admit(rid, 0, 6, -1, (4, 4)), 2)
+    assert rid not in fabrics[0].requests
+    # a fresh request after eviction still completes exactly once
+    rid2 = fabrics[1].submit((7,), 4)
+    assert run_until(
+        lambda: all(f.result(rid2) is not None for f in fabrics), 200.0)
+    assert all(f.completions.count(rid2) == 1 for f in fabrics)
+
+
 def test_fleet_stats_rollup():
     """Fleet stats: summed counters, merged e2e latency summary
     (submit -> last token INCLUDING fail-over re-queue time), and

@@ -158,6 +158,20 @@ def test_r3_fires_on_missing_binding(tree):
                "no argtypes/restype" in f.msg for f in hits), hits
 
 
+def test_r3_fires_on_missing_batched_binding(tree):
+    """ISSUE-11 surface: dropping the batched entry point's sig()
+    declaration must fail R3 (its int64 return would otherwise ride
+    the implicit-int default and truncate frame counts)."""
+    mutate(tree, "rlo_tpu/native/bindings.py",
+           '    sig("rlo_engine_progress_n", C.c_int64,\n'
+           '        [p, C.c_int64, C.c_uint64])\n',
+           "")
+    hits = findings_for(tree, "R3")
+    assert any(f.file == "rlo_tpu/native/bindings.py" and
+               "rlo_engine_progress_n" in f.msg and
+               "no argtypes/restype" in f.msg for f in hits), hits
+
+
 def test_r3_fires_on_64bit_truncation(tree):
     """A uint64_t-returning function declared c_int is exactly the
     truncation hazard R3 exists for."""

@@ -1,0 +1,182 @@
+"""Batched C-engine progress (docs/DESIGN.md §13).
+
+The contract under test: `NativeWorld.progress_n` / `NativeEngine.progress`
+change how often the driver crosses into C — never what the engines do.
+Driving the same seeded loopback world one sweep per ctypes call and
+batched must produce byte-identical delivery order and identical engine
+counters, budgets must bind exactly, the deadline must turn the call
+into a GIL-released poll-wait, and the C ARQ due-heap must gate the
+retransmit sweep without changing retransmit behavior.
+"""
+
+import time
+
+import pytest
+
+from rlo_tpu.native.bindings import NativeEngine, NativeWorld
+
+WS = 5
+ROUNDS = 4
+PAYLOADS = [b"alpha", b"beta-beta", b"g" * 64]
+
+
+def _workload(batched: bool):
+    """Drive ROUNDS rounds of every-rank broadcasts on a fresh seeded
+    world (ARQ + metrics + profiler on, latency 0 so the schedule is a
+    pure function of the isend/poll order, which both driving modes
+    share sweep for sweep). Returns (per-rank delivery order, per-rank
+    counters, world sent/delivered)."""
+    world = NativeWorld(WS, latency=0, seed=11)
+    engines = [NativeEngine(world, r) for r in range(WS)]
+    for e in engines:
+        e.enable_arq(60_000_000)  # rto >> test: no retransmit jitter
+        e.enable_metrics()
+        e.enable_profiler()
+    order = [[] for _ in range(WS)]
+    for rnd in range(ROUNDS):
+        for r, e in enumerate(engines):
+            e.bcast(PAYLOADS[(rnd + r) % len(PAYLOADS)])
+        if batched:
+            world.progress_n(max_frames=4096)
+        else:
+            while not world.quiescent():
+                world.progress_all()
+        world.drain()
+        for r, e in enumerate(engines):
+            while (m := e.pickup_next()) is not None:
+                order[r].append((m.origin, m.data))
+    counters = [e.metrics()["counters"] for e in engines]
+    sent, delivered = world.sent_cnt, world.delivered_cnt
+    world.close()
+    return order, counters, (sent, delivered)
+
+
+def test_batched_vs_single_step_parity():
+    """progress_n(max_frames=4096) == the one-sweep-per-call loop:
+    byte-identical delivery order and metrics() counters."""
+    o_single, c_single, w_single = _workload(batched=False)
+    o_batched, c_batched, w_batched = _workload(batched=True)
+    assert o_single == o_batched
+    assert c_single == c_batched
+    assert w_single == w_batched
+    # every broadcast delivered exactly once at every other rank
+    assert all(len(o) == ROUNDS * (WS - 1) for o in o_single)
+    for c in c_single:
+        assert c["arq_unacked"] == 0
+        assert c["arq_dup_drops"] == 0
+
+
+def test_progress_n_budget_binds_exactly():
+    world = NativeWorld(4, latency=0, seed=3)
+    engines = [NativeEngine(world, r) for r in range(4)]
+    engines[0].bcast(b"x" * 32)
+    total = 0
+    for _ in range(10_000):
+        if world.quiescent():
+            break
+        got = world.progress_n(max_frames=1)
+        assert got <= 1
+        total += got
+    assert world.quiescent()
+    for r in range(1, 4):
+        got = 0
+        while engines[r].pickup_next() is not None:
+            got += 1
+        assert got == 1
+    world.close()
+
+
+def test_engine_progress_returns_at_first_fruitless_turn():
+    """The single-engine face must not spin on other engines'
+    traffic: with nothing addressed to it, progress() returns 0."""
+    world = NativeWorld(4, latency=0, seed=3)
+    engines = [NativeEngine(world, r) for r in range(4)]
+    t0 = time.perf_counter()
+    assert engines[2].progress() == 0
+    assert time.perf_counter() - t0 < 1.0
+    world.close()
+
+
+def test_progress_n_deadline_is_a_poll_wait():
+    """With a deadline armed the call keeps polling through idleness —
+    the GIL-released serving-pump shape."""
+    world = NativeWorld(2, latency=0, seed=1)
+    engines = [NativeEngine(world, r) for r in range(2)]
+    t0 = time.perf_counter()
+    assert world.progress_n(deadline_usec=50_000) == 0
+    elapsed = time.perf_counter() - t0
+    assert 0.02 <= elapsed < 10.0
+    del engines
+    world.close()
+
+
+def test_arq_due_heap_gates_and_recovers_loss():
+    """Loss still recovers exactly as before (the heap only gates the
+    sweep), and idle ticks ride the O(1) peek."""
+    world = NativeWorld(4, latency=0, seed=13)
+    engines = [NativeEngine(world, r) for r in range(4)]
+    for e in engines:
+        e.enable_arq(500, max_retries=12)
+    world.drop_next(0, 1, 2)
+    for i in range(3):
+        engines[0].bcast(b"m%d" % i)
+    world.drain()
+    retx = sum(e.arq_retransmits for e in engines)
+    assert retx >= 2  # the dropped frames really were retransmitted
+    for r in range(1, 4):
+        got = 0
+        while engines[r].pickup_next() is not None:
+            got += 1
+        assert got == 3  # exactly once despite the loss
+    assert all(e.arq_unacked == 0 for e in engines)
+    # a long-rto engine parks its wake-ups in the future: every
+    # subsequent tick is gated on the heap peek
+    for e in engines:
+        e.enable_arq(60_000_000)
+    engines[0].bcast(b"tail")
+    world.drain()
+    g0 = engines[0].arq_scan_gated
+    for _ in range(50):
+        world.progress_all()
+    assert engines[0].arq_scan_gated > g0
+    assert engines[0].arq_heap_len >= 0
+    world.close()
+
+
+def test_frames_dispatched_counts_every_polled_frame():
+    world = NativeWorld(3, latency=0, seed=2)
+    engines = [NativeEngine(world, r) for r in range(3)]
+    base = sum(e.frames_dispatched for e in engines)
+    assert base == 0
+    engines[0].bcast(b"count-me")
+    world.drain()
+    assert sum(e.frames_dispatched for e in engines) >= 2
+    world.close()
+
+
+@pytest.mark.parametrize("latency", [0, 7])
+def test_batched_run_is_deterministic(latency):
+    """Same seed + same batched call sequence => identical delivery
+    order and counters run to run (latency worlds included: the
+    dead-time skip must preserve the virtual delivery schedule)."""
+
+    def run():
+        world = NativeWorld(4, latency=latency, seed=21)
+        engines = [NativeEngine(world, r) for r in range(4)]
+        for e in engines:
+            e.enable_arq(60_000_000)
+            e.enable_metrics()
+        out = []
+        for rnd in range(3):
+            for e in engines:
+                e.bcast(b"r%d" % rnd)
+            world.progress_n()
+            world.drain()
+            for r, e in enumerate(engines):
+                while (m := e.pickup_next()) is not None:
+                    out.append((r, m.origin, m.data))
+        counters = [e.metrics()["counters"] for e in engines]
+        world.close()
+        return out, counters
+
+    assert run() == run()
