@@ -48,9 +48,16 @@ def info(value):
 
 
 def run_leg(n: int, n_req: int, seed: int, kill_at=None,
-            decode_interval: float = 0.5, limit: float = 600.0):
+            decode_interval: float = 0.5, limit: float = 600.0,
+            arrivals=None):
     """One fabric run to drain: returns (drain vtime, events,
-    requeues, fleet e2e mean usec, wall seconds)."""
+    requeues, fleet e2e mean usec, wall seconds).
+
+    ``arrivals`` switches the client load from the historical
+    seeded-rng mix (None — byte-identical to the committed
+    BENCH_fabric.json legs) to explicit ``(t, gateway, prompt,
+    max_new)`` rows — the trace-driven path fed by
+    rlo_tpu/workloads/traces.py (``Trace.fabric_arrivals``)."""
     import logging
     logging.getLogger("rlo_tpu").setLevel(logging.ERROR)
     from rlo_tpu.engine import EngineManager, ProgressEngine
@@ -70,10 +77,20 @@ def run_leg(n: int, n_req: int, seed: int, kill_at=None,
     rng = Random(seed * 9_176_867 + 5)
     victim = 0 if kill_at is not None else None
     gateways = [r for r in range(n) if r != victim]
-    # client arrivals spread over the first 12 vtime units
-    arrivals = sorted(
-        (round(rng.uniform(1.0, 12.0), 3), rng.choice(gateways))
-        for _ in range(n_req))
+    if arrivals is None:
+        # client arrivals spread over the first 12 vtime units
+        arrivals = sorted(
+            (round(rng.uniform(1.0, 12.0), 3), rng.choice(gateways))
+            for _ in range(n_req))
+        rows = None
+    else:
+        rows = sorted(arrivals, key=lambda a: a[0])
+        if not rows:
+            raise ValueError(
+                "empty arrivals: the trace holds no requests (a "
+                "fully torn JSONL file loads as an empty Trace)")
+        arrivals = [(t, g) for t, g, _, _ in rows]
+        n_req = len(arrivals)
     submitted = []
     live = set(range(n))
     killed = False
@@ -84,11 +101,16 @@ def run_leg(n: int, n_req: int, seed: int, kill_at=None,
     while world.now < limit:
         while ai < len(arrivals) and arrivals[ai][0] <= world.now:
             t, g = arrivals[ai]
+            if rows is None:
+                plen = rng.randrange(3, 10)
+                prompt = tuple(rng.randrange(1, 1 << 15)
+                               for _ in range(plen))
+                max_new = rng.randrange(6, 30)
+            else:
+                prompt = tuple(int(x) for x in rows[ai][2])
+                max_new = int(rows[ai][3])
             ai += 1
-            plen = rng.randrange(3, 10)
-            prompt = tuple(rng.randrange(1, 1 << 15)
-                           for _ in range(plen))
-            rid = fabrics[g].submit(prompt, rng.randrange(6, 30))
+            rid = fabrics[g].submit(prompt, max_new)
             submitted.append(rid)
         if kill_at is not None and not killed and \
                 world.now >= kill_at:
@@ -117,12 +139,66 @@ def run_leg(n: int, n_req: int, seed: int, kill_at=None,
             e2e_mean, wall)
 
 
+def trace_doc(trace, n: int, time_scale: float = 1.0,
+              decode_interval: float = 0.5):
+    """Run one trace-driven fabric leg (rlo_tpu/workloads traces
+    mapped onto gateways via ``Trace.fabric_arrivals``) and return a
+    perf_gate document whose metrics — including the trace digest —
+    all gate exact. benchmarks/workload_bench.py commits one of these
+    into BENCH_workload.json."""
+    rows = trace.fabric_arrivals(list(range(n)),
+                                 time_scale=time_scale)
+    vt, events, requeues, e2e, wall = run_leg(
+        n=n, n_req=len(rows), seed=trace.seed, arrivals=rows,
+        decode_interval=decode_interval)
+    print(f"trace[{trace.kind}]: {len(rows)} reqs, drain {vt:.2f} "
+          f"vtime, {events} events, {requeues} requeues, "
+          f"wall {wall:.2f}s", file=sys.stderr)
+    pfx = f"trace_{trace.kind}"
+    return {
+        "suite": "fabric_bench",
+        "config": {"trace_kind": trace.kind,
+                   "trace_seed": trace.seed, "n": n,
+                   "time_scale": time_scale},
+        "metrics": {
+            f"{pfx}.digest": exact(trace.digest()),
+            f"{pfx}.requests": exact(len(rows)),
+            f"{pfx}.drain_vtime": exact(round(vt, 9)),
+            f"{pfx}.events": exact(events),
+            f"{pfx}.requeues": exact(requeues),
+            f"{pfx}.e2e_mean_usec": exact(round(e2e, 3)),
+            f"{pfx}.wall_events_per_sec": info(
+                round(events / wall, 1) if wall > 0 else 0.0),
+        },
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--quick", action="store_true",
                     help="drop the 8-rank leg (unit-test config)")
+    ap.add_argument("--trace",
+                    help="run ONE trace-driven leg from a workloads "
+                         "JSONL trace instead of the committed legs "
+                         "(abstract trace time -> vtime; the document "
+                         "pins the trace digest)")
+    ap.add_argument("--trace-ranks", type=int, default=4)
+    ap.add_argument("--time-scale", type=float, default=1.0)
     ap.add_argument("--out", help="write benchmark JSON here")
     args = ap.parse_args(argv)
+
+    if args.trace:
+        from rlo_tpu.workloads.traces import Trace
+        doc = trace_doc(Trace.load_jsonl(args.trace),
+                        n=args.trace_ranks,
+                        time_scale=args.time_scale)
+        text = json.dumps(doc, indent=1, sort_keys=True)
+        if args.out:
+            with open(args.out, "w") as fh:
+                fh.write(text + "\n")
+        else:
+            print(text)
+        return 0
 
     metrics = {}
     legs = [("steady4", dict(n=4, n_req=16, seed=0)),

@@ -33,9 +33,21 @@ wall tokens/s is recorded informationally. No eos is used, so decode
 lengths are budget-fixed and the exact metrics are machine- and
 model-output-independent.
 
+The Poisson trace itself now comes from the workloads subsystem
+(``rlo_tpu/workloads/traces.py poisson_compat`` — the byte-identical
+relocation of the generator that used to live inline here), and the
+committed legs' trace digests are pinned in ``_PINNED_COMPAT``:
+generator drift fails the bench at the source, not just the gate.
+``--trace FILE`` instead drives the open loop from any serialized
+workloads trace (diurnal waves, MMPP tenant bursts, flash crowds,
+prefix swarms — docs/DESIGN.md §14), pinning the trace digest in the
+emitted document; benchmarks/workload_bench.py gates one such leg in
+BENCH_workload.json.
+
 Usage: python benchmarks/serve_bench.py [--tiny] [--n-req N]
        python benchmarks/serve_bench.py --tiny --arrivals poisson \
            --out BENCH_serve.json
+       python benchmarks/serve_bench.py --tiny --trace t.jsonl --paged
 """
 
 import argparse
@@ -55,6 +67,8 @@ from rlo_tpu.models.generate import generate  # noqa: E402
 from rlo_tpu.models.serve import DecodeServer  # noqa: E402
 from rlo_tpu.models.transformer import (TransformerConfig,  # noqa: E402
                                         init_params)
+from rlo_tpu.workloads.traces import (Trace, compat_digest,  # noqa: E402
+                                      poisson_compat)
 
 
 def exact(value):
@@ -65,38 +79,40 @@ def info(value):
     return {"value": value, "direction": "higher", "tolerance": None}
 
 
+#: Trace digests of the COMMITTED BENCH_serve.json legs (tiny config,
+#: n_req=8, rate=1.5): the dense + paged legs replay the seed-0 trace,
+#: the prefix-heavy leg the seed-1 prefix trace. The generator now
+#: lives in rlo_tpu/workloads/traces.py (poisson_compat); these pins
+#: prove the migration — and any later generator edit — keeps the
+#: committed legs byte-identical instead of silently re-rolling them
+#: (the perf gate would catch the metric drift; this catches it at the
+#: SOURCE with a named cause).
+_PINNED_COMPAT = {
+    ("dense", 8, 1.5, 0, 0): "2e170cbc3e3069f4f24598ed9b4e250b"
+                             "70ec6245e1346814b928f82e3b36cb6a",
+    ("prefix", 8, 1.5, 1, 8): "b7018e756d78af9db7232d1b353eba48"
+                              "0224d7aabb0e32ab668b777bdd325214",
+}
+
+
 def _poisson_trace(cfg, *, n_req, rate, seed, max_len, buckets,
                    prefix_len=0):
-    """The seed-deterministic open-loop trace: bimodal requests plus
-    per-round Poisson arrival counts. ``prefix_len`` > 0 prepends a
-    SHARED system prefix of that many tokens to ~70% of the prompts
-    (the prefix-heavy variant the radix cache serves); 0 reproduces
-    the original dense-leg trace byte-for-byte."""
-    rng = np.random.default_rng(seed)
-    prefix = (rng.integers(0, cfg.vocab, (prefix_len,))
-              if prefix_len else None)
-    reqs = []
-    for _ in range(n_req):
-        if rng.random() < 0.7:  # short interactive
-            plen = int(rng.integers(3, 9))
-            budget = int(rng.integers(4, 13))
-        else:                   # long batch
-            plen = int(rng.integers(8, min(15, buckets[-1] + 1)))
-            budget = int(rng.integers(24, min(41, max_len - plen)))
-        prompt = rng.integers(0, cfg.vocab, (plen,))
-        if prefix is not None and rng.random() < 0.7:
-            prompt = np.concatenate([prefix, prompt])
-        if prefix is not None and reqs and rng.random() < 0.25:
-            # an exact resubmission: the full-prefix radix hit whose
-            # first decode write lands in a shared page — the COW path
-            prompt = reqs[rng.integers(0, len(reqs))][0]
-        reqs.append((prompt, budget))
-    # arrival round of each request: cumulative Poisson per round
-    arrival, rnd = [], 0
-    while len(arrival) < n_req:
-        k = int(rng.poisson(rate))
-        arrival.extend([rnd] * min(k, n_req - len(arrival)))
-        rnd += 1
+    """Compatibility wrapper over the relocated generator
+    (rlo_tpu/workloads/traces.py poisson_compat — byte-identical draw
+    sequence): returns the historical (requests, arrival) pair and
+    asserts the committed-leg trace digests still pin."""
+    reqs, arrival = poisson_compat(
+        cfg.vocab, n_req=n_req, rate=rate, seed=seed, max_len=max_len,
+        buckets=buckets, prefix_len=prefix_len)
+    key = ("prefix" if prefix_len else "dense", n_req, rate, seed,
+           prefix_len)
+    pinned = _PINNED_COMPAT.get(key)
+    if pinned is not None and cfg.vocab == 128:
+        got = compat_digest(reqs, arrival)
+        assert got == pinned, (
+            f"poisson_compat drifted for committed leg {key}: trace "
+            f"digest {got} != pinned {pinned} — the generator no "
+            f"longer reproduces BENCH_serve.json's traffic")
     return reqs, arrival
 
 
@@ -131,6 +147,68 @@ def _drive_open_loop(srv, reqs, arrival):
     p99 = e2e_rounds[min(len(e2e_rounds) - 1,
                          (len(e2e_rounds) * 99) // 100)]
     return occ_mean, p50, p99, wall
+
+
+def trace_leg(params, cfg, trace, *, tiny, slots, round_len, max_len,
+              buckets, paged=False, page_size=8):
+    """Open-loop leg driven by a workloads trace (rlo_tpu/workloads):
+    request arrival ROUNDS are the trace's abstract times floored, so
+    every scheduling metric is a function of the trace alone and gates
+    exact — alongside the trace digest itself, pinning the traffic
+    seed-exact (docs/DESIGN.md §14). ``paged=True`` runs the paged
+    server (the swarm kind's shared prefixes then exercise the radix
+    cache, reported in ``prefix_hits``/``cow_copies``)."""
+    from rlo_tpu.utils.metrics import Registry
+
+    reqs, arrival = trace.serve_requests()
+    if not reqs:
+        raise ValueError(
+            f"trace {trace.kind!r} (seed {trace.seed}) holds no "
+            f"requests (a fully torn JSONL file loads as an empty "
+            f"Trace)")
+    useful = sum(m for _, m in reqs)
+    reg = Registry()
+    kw = (dict(paged=True, page_size=page_size) if paged
+          else dict(prompt_buckets=buckets))
+    srv = DecodeServer(params, cfg, n_slots=slots, max_len=max_len,
+                       round_len=round_len, metrics=reg, **kw)
+    occ, p50, p99, wall = _drive_open_loop(srv, reqs, arrival)
+    eff = useful / (srv.steps_run * slots)
+    pfx = f"trace_{trace.kind}"
+    print(f"{pfx}: {len(reqs)} reqs, {srv.rounds_run} rounds, "
+          f"occupancy {occ:.1f}%, efficiency {eff:.3f}, e2e p50/p99 "
+          f"{p50}/{p99} rounds, digest {trace.digest()[:12]}",
+          file=sys.stderr)
+    metrics = {
+        f"{pfx}.digest": exact(trace.digest()),
+        f"{pfx}.requests": exact(len(reqs)),
+        f"{pfx}.useful_tokens": exact(useful),
+        f"{pfx}.rounds": exact(srv.rounds_run),
+        f"{pfx}.occupancy_mean_pct": exact(round(occ, 6)),
+        f"{pfx}.slot_step_efficiency": exact(round(eff, 6)),
+        f"{pfx}.e2e_rounds_p50": exact(p50),
+        f"{pfx}.e2e_rounds_p99": exact(p99),
+        f"{pfx}.sustained_tokens_per_sec": info(
+            round(useful / wall, 1)),
+    }
+    if paged:
+        snap = reg.snapshot()["counters"]
+        metrics.update({
+            f"{pfx}.prefix_hits": exact(
+                snap.get("serve.prefix_hits", 0)),
+            f"{pfx}.prefix_tokens_shared": exact(
+                snap.get("serve.prefix_tokens_shared", 0)),
+            f"{pfx}.cow_copies": exact(
+                snap.get("serve.cow_copies", 0)),
+        })
+    return {
+        "suite": "serve_bench",
+        "config": {"tiny": tiny, "arrivals": "trace",
+                   "kind": trace.kind, "seed": trace.seed,
+                   "slots": slots, "round_len": round_len,
+                   "paged": bool(paged)},
+        "metrics": metrics,
+    }
 
 
 def poisson_leg(params, cfg, *, tiny, n_req, slots, round_len,
@@ -280,8 +358,14 @@ def main():
                          "beat dense) and the prefix-heavy radix-"
                          "reuse leg (docs/DESIGN.md §12)")
     ap.add_argument("--seed", type=int, default=0)
-    ap.add_argument("--out", help="poisson: write the benchmark JSON "
-                                  "here instead of stdout")
+    ap.add_argument("--trace",
+                    help="drive the open-loop leg from a workloads "
+                         "JSONL trace (rlo_tpu/workloads/traces.py) "
+                         "instead of the synthetic arrival mixes; "
+                         "abstract trace time = decode rounds. The "
+                         "emitted document pins the trace digest.")
+    ap.add_argument("--out", help="poisson/trace: write the benchmark "
+                                  "JSON here instead of stdout")
     args = ap.parse_args()
 
     if args.tiny:
@@ -298,6 +382,20 @@ def main():
                                                256, (64,))
 
     params = init_params(jax.random.PRNGKey(0), cfg)
+
+    if args.trace:
+        trace = Trace.load_jsonl(args.trace)
+        doc = trace_leg(params, cfg, trace, tiny=args.tiny,
+                        slots=slots, round_len=round_len,
+                        max_len=max_len, buckets=buckets,
+                        paged=args.paged)
+        text = json.dumps(doc, indent=1, sort_keys=True)
+        if args.out:
+            with open(args.out, "w") as fh:
+                fh.write(text + "\n")
+        else:
+            print(text)
+        return
 
     if args.arrivals == "poisson":
         doc = poisson_leg(params, cfg, tiny=args.tiny, n_req=n_req,

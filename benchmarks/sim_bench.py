@@ -14,6 +14,14 @@ and records two curves:
     kill to every survivor holding the converged view (heartbeats,
     FAILURE flood, overlay re-form, re-flood all included) — again
     seed-exact.
+  - **churn-rate vs. convergence** (docs/DESIGN.md §14): sustained
+    kill/rejoin churn from a seeded weather schedule at several rates;
+    the fleet's total "dirty" (divergent-view) virtual time, span
+    count and rejoin volume gate exact.
+  - **ARQ retransmit storms under correlated loss**: the same average
+    loss rate applied iid vs as Gilbert burst loss — the retransmit
+    counts and completion vtimes gate exact, pinning the storm
+    amplification factor correlation causes.
 
 Wall-clock events/sec per size is recorded with a generous tolerance
 (machine-dependent). The driver uses targeted stepping: only the rank
@@ -49,6 +57,20 @@ FANOUT_NS_QUICK = (4, 16, 64, 256)
 FANOUT_NS_FULL = (4, 16, 64, 256, 1024)
 MEMBER_NS_QUICK = (4, 8, 16)
 MEMBER_NS_FULL = (4, 16, 64, 256, 1024)
+#: churn-rate-vs-convergence curve (docs/DESIGN.md §14): (n, kills
+#: per virtual second) legs of sustained kill/rejoin churn. The first
+#: two sit inside the regime the rejoin protocol handles (they end
+#: converged); the last sits PAST the measured knee — mid-rejoin
+#: ranks stop heartbeating, get re-declared failed, and the fleet
+#: collapses into a rejoin cascade (final_converged pins 0 and the
+#: dirty-time/rejoin volume pin the collapse shape, so the knee can
+#: only move under a deliberate baseline regen). See DESIGN.md §14
+#: "churn findings".
+CHURN_LEGS_QUICK = ((16, 0.02),)
+CHURN_LEGS_FULL = ((32, 0.01), (32, 0.02), (16, 0.05))
+#: ARQ-storm legs: iid loss vs correlated (Gilbert) burst loss at the
+#: SAME average loss rate — the storm is in the correlation
+STORM_N = 16
 
 
 def exact(value):
@@ -64,14 +86,19 @@ def wall(value):
     return {"value": value, "direction": "higher", "tolerance": None}
 
 
-def bench_fanout(n: int, n_bcast: int = 3, seed: int = 0):
+def bench_fanout(n: int, n_bcast: int = 3, seed: int = 0,
+                 scheduler: str = "heap"):
     """Virtual-time bcast fan-out latency at n ranks (protocol-only
     fast path + targeted stepping). Returns (mean vtime per bcast,
-    TOTAL schedule events, broadcasts run, wall seconds)."""
+    TOTAL schedule events, broadcasts run, wall seconds).
+    ``scheduler`` selects the event queue — results are identical by
+    the §14 oracle-equivalence rule; the calendar queue is what makes
+    n >= 10,000 tractable (benchmarks/workload_bench.py)."""
     from rlo_tpu.engine import EngineManager, ProgressEngine
     from rlo_tpu.transport.sim import SimWorld
 
-    world = SimWorld(n, seed=seed, protocol_only=True)
+    world = SimWorld(n, seed=seed, protocol_only=True,
+                     scheduler=scheduler)
     mgr = EngineManager()
     engines = [ProgressEngine(world.transport(r), manager=mgr,
                               clock=world.clock) for r in range(n)]
@@ -103,7 +130,8 @@ def bench_fanout(n: int, n_bcast: int = 3, seed: int = 0):
 
 def bench_membership(n: int, seed: int = 0, kill_at: float = 2.0,
                      failure_timeout: float = 3.0,
-                     heartbeat: float = 1.0, limit: float = 120.0):
+                     heartbeat: float = 1.0, limit: float = 120.0,
+                     scheduler: str = "heap"):
     """Virtual time from a crash-stop kill of rank n-1 to every
     survivor's membership view converging on the survivor set.
     Targeted stepping + a full progress sweep every heartbeat/2 keeps
@@ -112,7 +140,8 @@ def bench_membership(n: int, seed: int = 0, kill_at: float = 2.0,
     from rlo_tpu.engine import EngineManager, ProgressEngine
     from rlo_tpu.transport.sim import SimWorld
 
-    world = SimWorld(n, seed=seed, protocol_only=True)
+    world = SimWorld(n, seed=seed, protocol_only=True,
+                     scheduler=scheduler)
     mgr = EngineManager()
     engines = [ProgressEngine(world.transport(r), manager=mgr,
                               clock=world.clock,
@@ -126,7 +155,12 @@ def bench_membership(n: int, seed: int = 0, kill_at: float = 2.0,
     last_full = world.now
 
     def converged():
-        return all(engines[r]._alive == want for r in range(n - 1))
+        # O(1)-per-rank length screen before the O(n) list compare:
+        # pre-convergence views still hold n entries, and the full
+        # equality walk at n=10k costs ~100M comparisons per sweep
+        return all(len(engines[r]._alive) == n - 1
+                   for r in range(n - 1)) and \
+            all(engines[r]._alive == want for r in range(n - 1))
 
     t_conv = None
     while world.now < limit:
@@ -162,6 +196,161 @@ def bench_membership(n: int, seed: int = 0, kill_at: float = 2.0,
     return (t_conv, events, wall_dt)
 
 
+def bench_churn(n: int, rate: float, seed: int = 0,
+                duration: float = 120.0,
+                failure_timeout: float = 3.0, heartbeat: float = 1.0):
+    """Membership convergence under sustained churn RATE (not one
+    scripted kill): a seeded weather churn schedule
+    (rlo_tpu/workloads/weather.py, exponential kill/rejoin
+    interarrivals) runs against n full engines; measured are the
+    total virtual time the fleet spends with a divergent view
+    ("dirty" spans: from a fault event until every live view equals
+    the live set again), the span count, churn volume, and the
+    schedule length — all seed-exact. Returns (dirty_vtime, spans,
+    kills, rejoins, events, final_converged, wall)."""
+    from rlo_tpu.engine import EngineManager, ProgressEngine
+    from rlo_tpu.transport.sim import SimWorld
+    from rlo_tpu.workloads.weather import churn_script
+
+    script = churn_script(seed + 1, world_size=n, rate=rate,
+                          duration=duration, start=8.0,
+                          mean_down=20.0,
+                          min_down=failure_timeout * 3 + 4.0,
+                          min_live=max(2, n - max(2, n // 8)),
+                          settle=50.0)
+    kills = sum(1 for s in script if s[1] == "kill")
+    world = SimWorld(n, seed=seed, protocol_only=True)
+    mgr = EngineManager()
+    kw = dict(clock=world.clock, failure_timeout=failure_timeout,
+              heartbeat_interval=heartbeat, arq_rto=1.5,
+              arq_max_retries=6, op_deadline=30.0)
+    engines = [ProgressEngine(world.transport(r), manager=mgr, **kw)
+               for r in range(n)]
+    incarnation = [0] * n
+    live = set(range(n))
+    si = 0
+    dirty_since = None
+    dirty_vtime = 0.0
+    spans = 0
+    last_check = world.now
+    t_wall = time.perf_counter()
+
+    def converged() -> bool:
+        want = sorted(live)
+        k = len(want)
+        return all(len(engines[r]._alive) == k for r in want) and \
+            all(engines[r]._alive == want for r in want) and \
+            not any(engines[r]._awaiting_welcome for r in want)
+
+    while world.now < duration:
+        while si < len(script) and script[si][0] <= world.now:
+            _, act, r = script[si]
+            si += 1
+            if act == "kill":
+                world.kill_rank(r)
+                engines[r].cleanup()
+                live.discard(r)
+            else:
+                world.restart_rank(r)
+                incarnation[r] += 1
+                engines[r] = ProgressEngine(
+                    world.transport(r), manager=mgr,
+                    incarnation=incarnation[r], **kw)
+                live.add(r)
+            if dirty_since is None:
+                dirty_since = world.now
+                spans += 1
+        world.step()
+        # targeted stepping (see bench_membership): progress only the
+        # engine with fresh input; the periodic sweep keeps the
+        # time-driven machinery (heartbeats, JOIN probes, watchdogs)
+        # firing on everyone
+        d = world.last_dst
+        if d is not None and d in live:
+            engines[d]._progress_once()
+            while engines[d].pickup_next() is not None:
+                pass
+        if world.now - last_check >= heartbeat / 2.0:
+            last_check = world.now
+            mgr.progress_all()
+            for r in sorted(live):
+                while engines[r].pickup_next() is not None:
+                    pass
+            if dirty_since is not None and converged():
+                dirty_vtime += world.now - dirty_since
+                dirty_since = None
+    wall = time.perf_counter() - t_wall
+    final_ok = converged()
+    if dirty_since is not None:
+        dirty_vtime += world.now - dirty_since
+    rejoins = sum(engines[r].rejoins for r in live)
+    for e in engines:
+        e.cleanup()
+    return (dirty_vtime, spans, kills, rejoins, world.events,
+            final_ok, wall)
+
+
+def bench_storm(n: int, seed: int = 0, correlated: bool = False,
+                n_bcast: int = 30, limit: float = 240.0):
+    """ARQ retransmit behavior under lossy weather: ``n_bcast``
+    staggered broadcasts with ARQ on, under either iid loss or a
+    Gilbert burst-loss profile of the SAME average loss rate
+    (rlo_tpu/workloads/weather.py). Correlated loss concentrates
+    drops into runs that defeat single-retransmit recovery — the
+    retransmit-storm shape — while iid loss of equal intensity heals
+    almost invisibly. Returns (retransmits, gave_up, complete_vtime,
+    events, delivered_frac, wall), all but wall seed-exact."""
+    from rlo_tpu.engine import EngineManager, ProgressEngine
+    from rlo_tpu.transport.sim import SimWorld
+    from rlo_tpu.workloads.weather import GilbertLoss
+
+    # equal average loss: the Gilbert chain is in the bad state
+    # p_enter/(p_enter+p_exit) of sends, dropping loss_bad of them —
+    # mean burst length 1/p_exit sends, long enough to wipe a whole
+    # retransmit batch when one lands inside a bad run
+    p_enter, p_exit, loss_bad = 0.01, 0.08, 0.8
+    avg_loss = loss_bad * p_enter / (p_enter + p_exit)
+    drop_fn = (GilbertLoss(p_enter=p_enter, p_exit=p_exit,
+                           loss_bad=loss_bad) if correlated else None)
+    world = SimWorld(n, seed=seed, protocol_only=True,
+                     drop_fn=drop_fn,
+                     drop_p=0.0 if correlated else avg_loss)
+    mgr = EngineManager()
+    engines = [ProgressEngine(world.transport(r), manager=mgr,
+                              clock=world.clock, arq_rto=1.5,
+                              arq_max_retries=10)
+               for r in range(n)]
+    sent = 0
+    next_send = 1.0
+    got = [0] * n
+    t_wall = time.perf_counter()
+    complete_at = None
+    while world.now < limit:
+        if sent < n_bcast and world.now >= next_send:
+            engines[sent % n].bcast(b"storm%d" % sent)
+            sent += 1
+            next_send += 0.5
+        world.step()
+        mgr.progress_all()
+        for r in range(n):
+            while engines[r].pickup_next() is not None:
+                got[r] += 1
+        if sent == n_bcast and complete_at is None and \
+                sum(got) >= n_bcast * (n - 1):
+            # every rank picked up every broadcast it did not originate
+            complete_at = world.now
+            break
+    wall = time.perf_counter() - t_wall
+    retrans = sum(e.arq_retransmits for e in engines)
+    gave_up = sum(e.arq_gave_up for e in engines)
+    delivered = sum(got) / float(n_bcast * (n - 1))
+    for e in engines:
+        e.cleanup()
+    return (retrans, gave_up,
+            complete_at if complete_at is not None else -1.0,
+            world.events, round(delivered, 6), wall)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--quick", action="store_true",
@@ -194,12 +383,46 @@ def main(argv=None) -> int:
             ev / wdt if wdt > 0 else 0.0)
         print(f"member n={n}: converged {vt:.2f} vsec after kill, "
               f"{ev} events, {wdt:.2f}s wall", file=sys.stderr)
+    churn_legs = (CHURN_LEGS_QUICK if args.quick
+                  else CHURN_LEGS_FULL)
+    for cn, rate in churn_legs:
+        (dirty, spans, kills, rejoins, ev, ok,
+         wdt) = bench_churn(cn, rate)
+        key = f"churn.n{cn}.r{rate}"
+        metrics[f"{key}.dirty_vtime"] = exact(round(dirty, 9))
+        metrics[f"{key}.spans"] = exact(spans)
+        metrics[f"{key}.kills"] = exact(kills)
+        metrics[f"{key}.rejoins"] = exact(rejoins)
+        metrics[f"{key}.events"] = exact(ev)
+        metrics[f"{key}.final_converged"] = exact(int(ok))
+        metrics[f"{key}.wall_events_per_sec"] = wall(
+            ev / wdt if wdt > 0 else 0.0)
+        print(f"churn n={cn} rate={rate}: {kills} kills/"
+              f"{rejoins} rejoins, {dirty:.2f} dirty vsec over "
+              f"{spans} spans, converged={ok}, {ev} events, "
+              f"{wdt:.2f}s wall", file=sys.stderr)
+    for name, corr in (("iid", False), ("burst", True)):
+        (retrans, gave_up, cvt, ev, frac,
+         wdt) = bench_storm(STORM_N, correlated=corr)
+        key = f"storm.n{STORM_N}.{name}"
+        metrics[f"{key}.retransmits"] = exact(retrans)
+        metrics[f"{key}.gave_up"] = exact(gave_up)
+        metrics[f"{key}.complete_vtime"] = exact(round(cvt, 9))
+        metrics[f"{key}.events"] = exact(ev)
+        metrics[f"{key}.delivered_frac"] = exact(frac)
+        metrics[f"{key}.wall_events_per_sec"] = wall(
+            ev / wdt if wdt > 0 else 0.0)
+        print(f"storm {name} n={STORM_N}: {retrans} retransmits, "
+              f"{gave_up} give-ups, complete {cvt:.2f} vsec, "
+              f"{ev} events, delivered {frac:.3f}", file=sys.stderr)
     doc = {
         "suite": "sim_bench",
         "schema": 1,
         "quick": bool(args.quick),
         "config": {"fanout_ns": list(fanout_ns),
                    "member_ns": list(member_ns),
+                   "churn_legs": [list(leg) for leg in churn_legs],
+                   "storm_n": STORM_N,
                    "quick": bool(args.quick)},
         "metrics": metrics,
     }

@@ -62,17 +62,19 @@ echo "== observability smoke (loopback soak -> chrome timeline) =="
 # (flow edges included) — docs/DESIGN.md §7
 JAX_PLATFORMS=cpu python -m rlo_tpu.utils.timeline smoke
 
-echo "== simulator fuzz sweep (25 seeds x 7 chaos scripts) =="
+echo "== simulator fuzz sweep (25 seeds x 9 chaos scripts) =="
 # fixed-seed deterministic sweep over the partition/restart/burst-loss/
 # mixed scenario scripts — exactly-once, termination, and membership
 # convergence checked per run — PLUS the serving-fabric shapes
-# (fabric_kill/fabric_split/fabric_rejoin, docs/DESIGN.md §11):
-# exactly-once request completion with oracle-identical tokens,
-# re-admission after heal, and placement convergence. A violation
-# prints the seed + a replay recipe (docs/DESIGN.md §8). The C engine
-# runs the same protocol shapes via the native loopback fault hooks
-# inside pytest (tests/test_membership.py); the long 500-run sweep is
-# `pytest tests/test_sim.py -m slow`.
+# (fabric_kill/fabric_split/fabric_rejoin/fabric_paged and the
+# weather-driven fabric_churn: sustained kill/rejoin churn from a
+# seeded churn_script, docs/DESIGN.md §11/§14): exactly-once request
+# completion with oracle-identical tokens, re-admission after heal,
+# and placement convergence. A violation prints the seed + a replay
+# recipe with the live pending-event count (docs/DESIGN.md §8). The C
+# engine runs the same protocol shapes via the native loopback fault
+# hooks inside pytest (tests/test_membership.py); the long 500-run
+# sweep is `pytest tests/test_sim.py -m slow`.
 JAX_PLATFORMS=cpu python -m rlo_tpu.transport.sim --seeds 25
 
 echo "== engine bench smoke + perf gate (BENCH_engine.json) =="
@@ -92,7 +94,10 @@ rm -f "$fresh_engine"
 
 echo "== simulator scaling curve + perf gate (BENCH_sim.json) =="
 # protocol-only fast path: fan-out latency + membership convergence vs n
-# up to 1024 simulated ranks; virtual-time metrics gate at zero tolerance
+# up to 1024 simulated ranks, PLUS the round-14 weather curves —
+# churn-rate-vs-convergence (incl. one past-the-knee rejoin-cascade
+# datapoint) and ARQ-retransmit-storm-under-correlated-loss
+# (docs/DESIGN.md §14); virtual-time metrics gate at zero tolerance
 # (same seed => identical schedule), so O(log n) regressions fail here
 fresh_sim=$(mktemp -t rlo_bench_sim.XXXXXX)
 JAX_PLATFORMS=cpu python benchmarks/sim_bench.py \
@@ -112,6 +117,21 @@ JAX_PLATFORMS=cpu python benchmarks/fabric_bench.py \
 JAX_PLATFORMS=cpu python -m rlo_tpu.tools.perf_gate \
     --baseline BENCH_fabric.json --fresh "$fresh_fabric"
 rm -f "$fresh_fabric"
+
+echo "== workload bench + perf gate (BENCH_workload.json, 10k smoke) =="
+# the traffic laboratory (docs/DESIGN.md §14): trace-generator digests
+# for every canned workload shape, the calendar-queue n=10,000-rank
+# protocol-only fan-out AND membership-convergence datapoints (with an
+# in-bench heap-oracle equivalence assertion at n=256), and the
+# trace-driven fabric + DecodeServer serving legs — every metric
+# seed-exact at zero tolerance. The `timeout` IS the wall-time budget
+# for the 10k-rank smoke: the whole bench must finish inside it.
+fresh_workload=$(mktemp -t rlo_bench_workload.XXXXXX)
+JAX_PLATFORMS=cpu timeout 420 python benchmarks/workload_bench.py \
+    --out "$fresh_workload" > /dev/null
+JAX_PLATFORMS=cpu python -m rlo_tpu.tools.perf_gate \
+    --baseline BENCH_workload.json --fresh "$fresh_workload"
+rm -f "$fresh_workload"
 
 echo "== serve bench arrival mix + perf gate (BENCH_serve.json) =="
 # open-loop Poisson production mix on the tiny model: the scheduling

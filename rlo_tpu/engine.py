@@ -405,8 +405,18 @@ class ProgressEngine:
         self.clock = clock
         self.failed: Set[int] = set()
         self.suspected_self = False
-        self._alive: List[int] = list(range(ws))
-        self._v = {r: r for r in range(ws)}  # real rank -> virtual rank
+        # shared identity view (big-world construction path): the
+        # pre-failure alive list and rank->virtual map are identical
+        # for every engine of a world, so they are shared, not copied
+        # — 10k-rank protocol-only sims would otherwise spend gigabytes
+        # and a minute of wall time on per-engine identity dicts. Both
+        # are rebound (never mutated in place) on every view change.
+        self._alive: List[int] = topology.identity_members(ws)
+        self._v = topology.IDENTITY_VMAP  # real rank -> virtual rank
+        # ring-neighbor cache keyed by _alive object identity (see
+        # _ring_neighbors)
+        self._ring_view: Optional[List[int]] = None
+        self._ring_nbrs = (0, 0)
         self._hb_last_sent = float("-inf")
         self._hb_seen: dict = {}  # sender rank -> last heartbeat clock
 
@@ -555,7 +565,11 @@ class ProgressEngine:
             self._alive = group
             self._v = topology.virtual_map(group)
             self._sub_excluded = set(range(ws)) - set(group)
-        self.group = list(self._alive)
+        # full-world engines share the cached identity list (group is
+        # rebound on view changes, never mutated); sub-communicator
+        # engines own their member list
+        self.group = (self._alive if members is None
+                      else list(self._alive))
 
         self.manager = manager
         self.engine_id = manager.append(self)
@@ -1834,7 +1848,16 @@ class ProgressEngine:
         return tuple(alive[v] for v in vt)
 
     def _ring_neighbors(self):
-        return topology.ring_neighbors(self._alive, self.rank)
+        # per-view cache: _alive is rebound (never mutated in place)
+        # on every view change, so object identity is a correct — and
+        # O(1) — staleness check; topology.ring_neighbors itself is an
+        # O(n) list.index walk, too hot for every progress turn at
+        # 10k simulated ranks
+        if self._ring_view is not self._alive:
+            self._ring_view = self._alive
+            self._ring_nbrs = topology.ring_neighbors(self._alive,
+                                                      self.rank)
+        return self._ring_nbrs
 
     def _failure_tick(self) -> None:
         if len(self._alive) < 2:
@@ -1943,9 +1966,9 @@ class ProgressEngine:
                     if self.failure_timeout is not None
                     and len(self._alive) >= 2 else None)
         self.failed.add(rank)
-        self._alive = [r for r in self._alive if r != rank]
-        self._v = topology.virtual_map(self._alive)
-        self.group = list(self._alive)
+        self._alive, self._v = topology.shared_view(
+            tuple(r for r in self._alive if r != rank))
+        self.group = self._alive
         # every failure adoption bumps the membership epoch; the
         # sender-side floor (if it had rejoined before) is obsolete —
         # the failed-sender quarantine now covers it entirely
@@ -2162,8 +2185,23 @@ class ProgressEngine:
                             dst not in self._sub_excluded:
                         self._send_join_probe(dst)
             return
+        # thundering-herd damper (docs/DESIGN.md §14): a joiner
+        # petitions EVERY member, but only the DESIGNATED admitter —
+        # the lowest-ranked member of my alive view (the same
+        # deterministic rule the serving fabric uses for placement
+        # proposals) — launches the IAR admission round. Without
+        # this, n members each run an O(n)-frame consensus round per
+        # probe interval: a quadratic admission storm that stalls
+        # 10k-rank fleets (found by the churn bench). Petitions stay
+        # queued on everyone else, so if the designated admitter dies
+        # mid-admission the next view change re-designates and the
+        # joiner's steady re-petitions keep liveness.
+        # _alive is maintained sorted everywhere, so [0] IS the
+        # minimum — min() would rescan n entries on every progress
+        # turn of every petition-holding member
         if self._pending_joins and \
-                self.my_own_proposal.state != ReqState.IN_PROGRESS:
+                self.my_own_proposal.state != ReqState.IN_PROGRESS \
+                and self._alive[0] == self.rank:
             joiner = next(iter(self._pending_joins))
             inc, jep = self._pending_joins.pop(joiner)
             if joiner in self.failed and joiner not in self._admitting:
@@ -2322,9 +2360,9 @@ class ProgressEngine:
         if joiner not in self.failed:
             return  # view unchanged (concurrent admitting proposer)
         self.failed.discard(joiner)
-        self._alive = sorted(self._alive + [joiner])
-        self._v = topology.virtual_map(self._alive)
-        self.group = list(self._alive)
+        self._alive, self._v = topology.shared_view(
+            tuple(sorted(self._alive + [joiner])))
+        self.group = self._alive
         self.rejoins += 1
         TRACER.emit(self.rank, Ev.ADMIT, joiner, self.epoch, inc)
         logger.info("rank %d admitted rank %d (incarnation %d, epoch "
@@ -2413,11 +2451,10 @@ class ProgressEngine:
                 # epoch: FAILURE notices declared below it are stale
                 self._admit_epoch[m] = max(
                     self._admit_epoch.get(m, 0), new_epoch)
-        self._alive = mem
+        self._alive, self._v = topology.shared_view(tuple(mem))
         self.failed = (set(range(self.world_size)) - set(mem)) | \
             set(self._sub_excluded)
-        self._v = topology.virtual_map(mem)
-        self.group = list(mem)
+        self.group = self._alive
         # clear receive windows and in-flight state; the tx seq
         # counters are PRESERVED (monotone per process lifetime) so a
         # member whose matching admission execution was suppressed as

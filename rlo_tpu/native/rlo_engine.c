@@ -2848,7 +2848,12 @@ static void membership_tick(rlo_engine *e)
         }
         return;
     }
-    if (e->n_pending && e->own.state != RLO_IN_PROGRESS) {
+    /* thundering-herd damper (mirror of ProgressEngine._join_tick,
+     * docs/DESIGN.md §14): only the DESIGNATED admitter — the lowest
+     * alive rank in my view — launches admission rounds; everyone
+     * else keeps the petition queued in case designation shifts. */
+    if (e->n_pending && e->own.state != RLO_IN_PROGRESS &&
+        min_alive(e) == e->rank) {
         int joiner = -1;
         for (int r = 0; r < e->ws; r++)
             if (e->pending_join[r]) {

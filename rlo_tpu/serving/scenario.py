@@ -37,7 +37,11 @@ from typing import Dict, List, Optional, Sequence
 
 from rlo_tpu.serving.backend import StubBackend, stub_tokens
 from rlo_tpu.serving.fabric import DecodeFabric
-from rlo_tpu.transport.sim import SimViolation, SimWorld
+from rlo_tpu.transport.sim import \
+    FABRIC_SCENARIO_KINDS as _FABRIC_SCENARIO_KINDS
+from rlo_tpu.transport.sim import (SimViolation, SimWorld,
+                                   merge_weather, pending_suffix,
+                                   weather_hooks)
 
 #: default engine knobs for fabric runs: the Scenario defaults with a
 #: tighter op deadline so a placement round wedged across a view
@@ -60,11 +64,18 @@ class FabricScenario:
                  check_acceptance: bool = True,
                  paged_stub: bool = False, n_pages: int = 33,
                  page_size: int = 8,
-                 prefix_pool: Optional[Sequence[Sequence[int]]] = None):
+                 prefix_pool: Optional[Sequence[Sequence[int]]] = None,
+                 weather=None, scheduler: str = "heap"):
         self.ws = world_size
         self.seed = seed
         self.duration = duration
-        self.script = sorted(script, key=lambda s: s[0])
+        # weather profile (rlo_tpu/workloads/weather.py): scripted
+        # churn/loss steps merged into the script, delay_fn/drop_fn
+        # handed to the SimWorld — contract and bookkeeping shared
+        # with Scenario via transport.sim.merge_weather/weather_hooks
+        self.weather = weather
+        self.scheduler = scheduler
+        self.script_arg, self.script = merge_weather(script, weather)
         self.drop_p = drop_p
         self.dup_p = dup_p
         self.n_slots = n_slots
@@ -87,20 +98,45 @@ class FabricScenario:
                             [tuple(p) for p in prefix_pool])
 
     def _replay_recipe(self) -> str:
+        # every non-default knob is printed: a recipe that silently
+        # falls back to default slots/round/decode pacing (or drops
+        # the paged-stub config) replays a DIFFERENT schedule than
+        # the one that violated
+        extra = ""
+        for name, val, default in (
+                ("n_slots", self.n_slots, 2),
+                ("round_len", self.round_len, 8),
+                ("decode_interval", self.decode_interval, 0.25),
+                ("engine_kw", self.engine_kw, dict(FABRIC_ENGINE_KW)),
+                ("check_acceptance", self.check_acceptance, True),
+                ("paged_stub", self.paged_stub, False),
+                ("n_pages", self.n_pages, 33),
+                ("page_size", self.page_size, 8),
+                ("prefix_pool", self.prefix_pool, None),
+                ("weather", self.weather, None),
+                ("scheduler", self.scheduler, "heap")):
+            if val != default:
+                extra += f", {name}={val!r}"
         return (f"FabricScenario(world_size={self.ws}, "
                 f"seed={self.seed}, duration={self.duration}, "
-                f"script={self.script!r}, drop_p={self.drop_p}, "
-                f"dup_p={self.dup_p}).run()")
+                f"script={self.script_arg!r}, drop_p={self.drop_p}, "
+                f"dup_p={self.dup_p}{extra}).run()")
 
     def _fail(self, why: str):
         raise SimViolation(
-            f"seed {self.seed}: {why}\nreplay: {self._replay_recipe()}")
+            f"seed {self.seed}: {why}"
+            f"{pending_suffix(getattr(self, '_world', None))}"
+            f"\nreplay: {self._replay_recipe()}")
 
     def run(self) -> Dict:
         from rlo_tpu.engine import EngineManager, ProgressEngine
 
+        delay_fn, drop_fn = weather_hooks(self.weather)
         world = SimWorld(self.ws, seed=self.seed, drop_p=self.drop_p,
-                         dup_p=self.dup_p)
+                         dup_p=self.dup_p, scheduler=self.scheduler,
+                         delay_fn=delay_fn, drop_fn=drop_fn)
+        # exposed for the violation message (pending_events + vtime)
+        self._world = world
         mgr = EngineManager()
         engines: List[ProgressEngine] = [
             ProgressEngine(world.transport(r), manager=mgr,
@@ -289,6 +325,12 @@ def make_fabric_scenario(kind: str, seed: int,
         allocator churn, radix reuse, COW, eviction and admission
         backpressure all run under fail-over, and the end-of-run
         page-leak check proves re-queues never strand a reservation.
+      - 'fabric_churn':  sustained churn RATE, not one scripted kill:
+        a seeded weather profile (workloads/weather.py churn_script,
+        exponential kill/rejoin interarrivals) runs under continuous
+        client load — placement re-forms repeatedly, every accepted
+        request still completes exactly once and the fleet ends
+        converged (docs/DESIGN.md §14).
     """
     import zlib
     rng = Random((zlib.crc32(kind.encode()) & 0xffff) * 1_000_003
@@ -346,6 +388,23 @@ def make_fabric_scenario(kind: str, seed: int,
                               duration=150.0, decode_interval=1.0,
                               paged_stub=True, n_pages=17,
                               page_size=8, prefix_pool=prefixes)
+    if kind == "fabric_churn":
+        # the weather profile owns every fault; the script is pure
+        # client load spread across the churn window
+        from rlo_tpu.workloads.weather import make_weather
+        weather = make_weather("churn", seed, world_size=ws,
+                               rate=0.04, duration=240.0,
+                               mean_down=20.0,
+                               min_live=max(2, ws - 2), settle=80.0)
+        script = (
+            [(2.0 + 2.5 * i, "submit", rng.randrange(ws), 2)
+             for i in range(6)] +
+            [(60.0, "submit", rng.randrange(ws), 2),
+             (100.0, "submit", rng.randrange(ws), 2),
+             (150.0, "submit", rng.randrange(ws), 2)])
+        return FabricScenario(world_size=ws, seed=seed, script=script,
+                              duration=240.0, decode_interval=0.5,
+                              weather=weather)
     if kind == "fabric_rejoin":
         victim = 0  # see fabric_kill: the warm-up owner
         gw = 1 + rng.randrange(ws - 1)
@@ -363,5 +422,7 @@ def make_fabric_scenario(kind: str, seed: int,
     raise ValueError(f"unknown fabric scenario kind {kind!r}")
 
 
-FABRIC_SCENARIO_KINDS = ("fabric_kill", "fabric_split",
-                         "fabric_rejoin", "fabric_paged")
+# single source of truth lives in transport/sim.py (declared there so
+# the CLI sweep can enumerate the kinds without importing the serving
+# layer); re-exported here for the serving-facing surface
+FABRIC_SCENARIO_KINDS = _FABRIC_SCENARIO_KINDS

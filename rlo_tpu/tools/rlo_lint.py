@@ -88,13 +88,19 @@ FABRIC_PY = "rlo_tpu/serving/fabric.py"
 
 #: R5 scope: the seed-deterministic code paths (engine + transports the
 #: simulator drives, plus the serving fabric, which whole fleets replay
-#: inside the simulator — docs/DESIGN.md §11). Launchers, benchmarks,
-#: and observability tooling may use wall clocks freely.
+#: inside the simulator — docs/DESIGN.md §11 — and the workloads
+#: subsystem, whose traces and weather schedules must replay
+#: seed-exact for the perf gate's digest pins — docs/DESIGN.md §14).
+#: Launchers, benchmarks, and observability tooling may use wall
+#: clocks freely.
 R5_FILES = (ENGINE_PY, "rlo_tpu/transport/base.py",
             "rlo_tpu/serving/pages.py",
             "rlo_tpu/transport/loopback.py", "rlo_tpu/transport/sim.py",
             FABRIC_PY, "rlo_tpu/serving/placement.py",
-            "rlo_tpu/serving/backend.py", "rlo_tpu/serving/scenario.py")
+            "rlo_tpu/serving/backend.py", "rlo_tpu/serving/scenario.py",
+            "rlo_tpu/workloads/__init__.py",
+            "rlo_tpu/workloads/traces.py",
+            "rlo_tpu/workloads/weather.py")
 
 PAIRED_ANCHOR = "rlo-lint: paired-with"
 DEFAULT_ROUTE_ANCHOR = "rlo-lint: default-route"

@@ -161,6 +161,51 @@ def virtual_map(alive: Sequence[int]) -> dict:
     return {r: v for v, r in enumerate(alive)}
 
 
+class _IdentityVMap:
+    """Subscript-compatible identity rank->virtual map: the value
+    every engine's ``_v`` holds until its first view change. A 10k-rank
+    simulated fleet would otherwise materialize 10k copies of a
+    10k-entry dict (gigabytes, tens of seconds) just to map r -> r;
+    real dicts from ``virtual_map`` replace it the moment the view
+    actually deviates from identity."""
+    __slots__ = ()
+
+    def __getitem__(self, rank: int) -> int:
+        return rank
+
+    def __repr__(self) -> str:
+        return "IDENTITY_VMAP"
+
+
+#: shared singleton — stateless, so one instance serves every engine
+IDENTITY_VMAP = _IdentityVMap()
+
+
+@functools.lru_cache(maxsize=1024)
+def shared_view(alive: Tuple[int, ...]) -> Tuple[List[int], dict]:
+    """``(member list, virtual map)`` for a sorted member tuple,
+    cached and SHARED across engines. During a view change every
+    surviving engine re-forms the same overlay over the same member
+    set; building a private n-entry dict per engine is the O(n^2)
+    fleet cost that dominates 10k-rank membership sims. Both returned
+    objects must be treated as immutable (engines rebind, never
+    mutate). Bounded cache: an evicted view is simply rebuilt — the
+    engines only ever compare these by value."""
+    members = list(alive)
+    return members, virtual_map(members)
+
+
+@functools.lru_cache(maxsize=None)
+def identity_members(world_size: int) -> List[int]:
+    """The full-world member list ``[0..world_size)``, cached and
+    SHARED across engines (every engine of a big simulated world holds
+    the same pre-failure view; per-engine copies are the construction
+    bottleneck at n >= 10k ranks). Callers must treat it as immutable
+    — the engine only ever REBINDS its ``_alive``/``group`` on view
+    changes, never mutates them in place."""
+    return list(range(world_size))
+
+
 def ring_neighbors(alive: Sequence[int], rank: int) -> Tuple[int, int]:
     """(successor, predecessor) of ``rank`` on the alive ring — the
     heartbeat monitoring edges of the failure detector."""

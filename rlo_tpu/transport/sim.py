@@ -27,6 +27,7 @@ the partition lands are lost, like a real link going dark).
 
 from __future__ import annotations
 
+import bisect
 import hashlib
 import heapq
 import itertools
@@ -36,6 +37,117 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 from rlo_tpu.transport.base import (FAILED_SEND, SendHandle, Transport,
                                     register_transport)
+
+
+# ---------------------------------------------------------------------------
+# Event schedulers: the heapq oracle and the calendar queue
+# (docs/DESIGN.md §14). Both order items — tuples whose layout is
+# (t, ctr, ...) with a globally unique ctr — by (t, ctr), so pop order
+# is total and BYTE-IDENTICAL between the two implementations,
+# timestamp ties included (the tie always resolves by insertion
+# counter before any later tuple field is ever compared).
+# ---------------------------------------------------------------------------
+
+class HeapScheduler:
+    """The reference binary-heap event queue — kept as the oracle the
+    calendar queue is equivalence-tested against (and the default:
+    small worlds gain nothing from slotting)."""
+
+    __slots__ = ("_heap",)
+
+    def __init__(self):
+        self._heap: List = []
+
+    def push(self, item) -> None:
+        heapq.heappush(self._heap, item)
+
+    def pop(self):
+        return heapq.heappop(self._heap)
+
+    def __len__(self) -> int:
+        return len(self._heap)
+
+
+class CalendarScheduler:
+    """Slotted calendar queue with an overflow heap — O(1) amortized
+    push/pop against heapq's O(log n), which is what lets protocol-only
+    sweeps reach n >= 10,000 simulated ranks (docs/DESIGN.md §14).
+
+    A ring of ``nslots`` buckets, each ``width`` virtual seconds wide,
+    covers the rotating window ``[base, base + nslots*width)`` where
+    ``base = _slot_no * width``. Items inside the window live in their
+    slot's sorted list (``bisect.insort``; slots hold a handful of
+    items at the simulator's densities). Items past the window land in
+    the overflow heap and MIGRATE into the ring as the window advances
+    — the invariant after every operation is that nothing in the
+    overflow is due inside the current window, so the head of the
+    first nonempty slot is always the global minimum.
+
+    Pop-order contract: identical to :class:`HeapScheduler` for any
+    push sequence, equal timestamps included — items are full tuples
+    ordered by (t, ctr) and ctr is unique, so both structures sort by
+    exactly the same total order (tested under randomized timestamp
+    ties in tests/test_workloads.py; the SimWorld schedule digest is
+    scheduler-independent).
+    """
+
+    __slots__ = ("width", "nslots", "_ring", "_count", "_slot_no",
+                 "_overflow")
+
+    def __init__(self, width: float, nslots: int = 256):
+        if width <= 0.0 or nslots < 2:
+            raise ValueError(f"need width > 0 and nslots >= 2, got "
+                             f"{width}, {nslots}")
+        self.width = width
+        self.nslots = nslots
+        self._ring: List[List] = [[] for _ in range(nslots)]
+        self._count = 0            # items resident in the ring
+        self._slot_no = 0          # absolute slot index of the cursor
+        self._overflow: List = []  # heap of beyond-window items
+
+    def _migrate(self) -> None:
+        """Pull every overflow item now due inside the window into its
+        ring slot (called after any cursor advance/jump)."""
+        horizon = (self._slot_no + self.nslots) * self.width
+        ov = self._overflow
+        while ov and ov[0][0] < horizon:
+            item = heapq.heappop(ov)
+            bisect.insort(self._ring[int(item[0] // self.width)
+                                     % self.nslots], item)
+            self._count += 1
+
+    def push(self, item) -> None:
+        t = item[0]
+        sn = int(t // self.width)
+        if sn >= self._slot_no + self.nslots:
+            heapq.heappush(self._overflow, item)
+            return
+        if sn < self._slot_no:
+            # floating-point guard: virtual time is monotone, so an
+            # item can never be due before the cursor's slot — clamp
+            # into the current slot (sorted insert keeps order exact)
+            sn = self._slot_no
+        bisect.insort(self._ring[sn % self.nslots], item)
+        self._count += 1
+
+    def pop(self):
+        if self._count == 0:
+            if not self._overflow:
+                raise IndexError("pop from empty CalendarScheduler")
+            # ring drained: jump the window straight to the overflow
+            # minimum instead of crawling empty slots
+            self._slot_no = int(self._overflow[0][0] // self.width)
+            self._migrate()
+        while True:
+            slot = self._ring[self._slot_no % self.nslots]
+            if slot:
+                self._count -= 1
+                return slot.pop(0)
+            self._slot_no += 1
+            self._migrate()
+
+    def __len__(self) -> int:
+        return self._count + len(self._overflow)
 
 
 class _SimSend(SendHandle):
@@ -80,7 +192,9 @@ class SimWorld:
     def __init__(self, world_size: int, seed: int = 0,
                  min_delay: float = 0.001, max_delay: float = 0.25,
                  drop_p: float = 0.0, dup_p: float = 0.0,
-                 idle_dt: float = 0.05, protocol_only: bool = False):
+                 idle_dt: float = 0.05, protocol_only: bool = False,
+                 scheduler: str = "heap",
+                 delay_fn=None, drop_fn=None):
         """``protocol_only`` is the fleet-scale fast path (ROADMAP item
         4 / docs/DESIGN.md §10): payloads are passed by reference
         (no defensive copy) and the SHA-256 schedule digest is skipped
@@ -89,7 +203,22 @@ class SimWorld:
         stay seed-deterministic; only ``schedule_digest()`` (which
         returns the "protocol-only" sentinel) is given up, so replay
         ASSERTIONS need the full mode while scaling CURVES
-        (benchmarks/sim_bench.py) use this one."""
+        (benchmarks/sim_bench.py) use this one.
+
+        ``scheduler`` selects the event queue: ``"heap"`` (the heapq
+        oracle, default) or ``"calendar"`` (slotted calendar queue +
+        overflow heap — the n >= 10k fast path). Pop order is
+        byte-identical between the two, ties included, so every
+        schedule digest and seed-exact metric is scheduler-independent
+        (docs/DESIGN.md §14).
+
+        ``delay_fn`` / ``drop_fn`` are the network-weather hooks
+        (rlo_tpu/workloads/weather.py): ``delay_fn(rng) -> delay``
+        replaces the uniform [min_delay, max_delay] draw (the
+        per-channel FIFO clamp still applies), ``drop_fn(rng) -> bool``
+        replaces the iid ``drop_p`` coin (it may keep state — burst
+        loss — but must draw randomness ONLY from the passed rng).
+        Both default to None = the historical draws, byte-identical."""
         if world_size < 2:
             raise ValueError(f"world_size must be >= 2, got {world_size}")
         if not 0.0 < min_delay <= max_delay:
@@ -105,7 +234,20 @@ class SimWorld:
         self.idle_dt = idle_dt
         self.dead: set = set()
         self._group: Optional[Dict[int, int]] = None  # rank -> group id
-        self._heap: List = []
+        if scheduler == "heap":
+            self._q = HeapScheduler()
+        elif scheduler == "calendar":
+            # slot width sized so the delay band spans a few slots and
+            # the window covers it many times over; heartbeat-cadence
+            # far-future pushes ride the overflow heap
+            self._q = CalendarScheduler(width=max(max_delay / 64.0,
+                                                  1e-9))
+        else:
+            raise ValueError(f"unknown scheduler {scheduler!r}; "
+                             f"known: 'heap', 'calendar'")
+        self.scheduler = scheduler
+        self.delay_fn = delay_fn
+        self.drop_fn = drop_fn
         self._ctr = itertools.count()
         self._chan_last: Dict[Tuple[int, int], float] = {}
         self.inboxes: List = [list() for _ in range(world_size)]
@@ -138,7 +280,14 @@ class SimWorld:
             raise ValueError(f"bad destination rank {dst}")
         if src in self.dead or dst in self.dead:
             return FAILED_SEND
-        if self.drop_p and self.rng.random() < self.drop_p:
+        # weather hooks: drop_fn/delay_fn replace (never wrap) the
+        # historical draws, consuming self.rng in the same call slots
+        # — with both None the rng stream is byte-identical to always
+        if self.drop_fn is not None:
+            if self.drop_fn(self.rng):
+                self.dropped_cnt += 1
+                return FAILED_SEND
+        elif self.drop_p and self.rng.random() < self.drop_p:
             self.dropped_cnt += 1
             return FAILED_SEND
         copies = 1
@@ -149,7 +298,10 @@ class SimWorld:
         # one on the same (src, dst) edge (matching MPI and every real
         # transport here); cross-channel order is exactly what the
         # seeded delays perturb
-        t = self.now + self.rng.uniform(self.min_delay, self.max_delay)
+        t = self.now + (self.delay_fn(self.rng)
+                        if self.delay_fn is not None
+                        else self.rng.uniform(self.min_delay,
+                                              self.max_delay))
         last = self._chan_last.get((src, dst), 0.0)
         if t < last:
             t = last
@@ -159,9 +311,8 @@ class SimWorld:
         # hand in immutable bytes and never alias them afterwards
         payload = data if self.protocol_only else bytes(data)
         for _ in range(copies):
-            heapq.heappush(self._heap,
-                           (t, next(self._ctr), src, dst, tag, payload,
-                            h))
+            self._q.push((t, next(self._ctr), src, dst, tag, payload,
+                          h))
         self.sent_cnt += 1
         return h
 
@@ -184,10 +335,10 @@ class SimWorld:
         time-driven machinery (heartbeats, RTOs, deadlines, JOIN
         probes) keeps firing."""
         self.last_dst = None
-        if not self._heap:
+        if not len(self._q):
             self.now += self.idle_dt
             return False
-        t, _, src, dst, tag, data, h = heapq.heappop(self._heap)
+        t, _, src, dst, tag, data, h = self._q.pop()
         if t > self.now:
             self.now = t
         h.delivered = True
@@ -220,8 +371,15 @@ class SimWorld:
             return "protocol-only"
         return self._digest.hexdigest()
 
+    def pending_events(self) -> int:
+        """Scheduled-but-undelivered frame count — O(1) (both
+        schedulers keep a live length). Scenario property-violation
+        messages carry it next to the seed/replay recipe so a wedged
+        run is distinguishable from a drained one at a glance."""
+        return len(self._q)
+
     def quiescent(self) -> bool:
-        return not self._heap and all(
+        return not len(self._q) and all(
             self._inbox_pos[r] >= len(self.inboxes[r])
             for r in range(self.world_size))
 
@@ -270,6 +428,46 @@ class SimWorld:
 # Scenario harness: scripted chaos + property checks + seed replay
 # ---------------------------------------------------------------------------
 
+def merge_weather(script, weather):
+    """``(script_arg, merged)`` for a scenario script and an optional
+    weather profile: the caller's PRE-merge script, sorted (what
+    replay recipes print — the recipe also prints the weather, whose
+    steps re-merge at construction, so printing the merged script
+    would double-apply them on replay), and the execution script with
+    the weather's fault steps merged in. One definition shared by
+    Scenario and FabricScenario so the two can never diverge."""
+    script_arg = sorted(script, key=lambda s: s[0])
+    if weather is not None:
+        script = list(script) + list(
+            getattr(weather, "script", ()) or ())
+    return script_arg, sorted(script, key=lambda s: s[0])
+
+
+def weather_hooks(weather):
+    """``(delay_fn, drop_fn)`` from a weather profile, with any
+    stateful sampler ``reset()`` first: a Gilbert chain reused across
+    runs (two scenarios sharing one Weather, or run() called twice
+    while debugging a violation) would otherwise start mid-burst and
+    break the bit-for-bit replay-from-seed contract."""
+    delay_fn = getattr(weather, "delay_fn", None)
+    drop_fn = getattr(weather, "drop_fn", None)
+    for fn in (delay_fn, drop_fn):
+        reset = getattr(fn, "reset", None)
+        if reset is not None:
+            reset()
+    return delay_fn, drop_fn
+
+
+def pending_suffix(world) -> str:
+    """The live in-flight state a SimViolation message carries next
+    to the seed/replay recipe (None-safe: '' before the world
+    exists)."""
+    if world is None:
+        return ""
+    return (f"\npending events at failure: {world.pending_events()} "
+            f"(vtime {world.now:.3f})")
+
+
 class SimViolation(AssertionError):
     """A simulated run violated a protocol property. The message
     carries the seed and a one-line replay recipe."""
@@ -307,13 +505,20 @@ class Scenario:
                  heartbeat_interval: float = 1.0,
                  arq_rto: float = 1.5, arq_max_retries: int = 6,
                  op_deadline: Optional[float] = 60.0,
-                 check_delivery: bool = True):
+                 check_delivery: bool = True,
+                 weather=None, scheduler: str = "heap"):
         self.ws = world_size
         self.seed = seed
         self.duration = duration
-        self.script = sorted(script, key=lambda s: s[0])
+        # a weather profile (rlo_tpu/workloads/weather.py) contributes
+        # its scripted fault steps (churn kills/rejoins, loss windows)
+        # plus the delay_fn/drop_fn hooks handed to the SimWorld; its
+        # repr is part of the replay recipe
+        self.weather = weather
+        self.script_arg, self.script = merge_weather(script, weather)
         self.drop_p = drop_p
         self.dup_p = dup_p
+        self.scheduler = scheduler
         self.engine_kw = dict(failure_timeout=failure_timeout,
                               heartbeat_interval=heartbeat_interval,
                               arq_rto=arq_rto,
@@ -322,14 +527,23 @@ class Scenario:
         self.check_delivery = check_delivery
 
     def _replay_recipe(self) -> str:
+        extra = ""
+        if self.weather is not None:
+            extra += f", weather={self.weather!r}"
+        if self.scheduler != "heap":
+            extra += f", scheduler={self.scheduler!r}"
         return (f"Scenario(world_size={self.ws}, seed={self.seed}, "
-                f"duration={self.duration}, script={self.script!r}, "
-                f"drop_p={self.drop_p}, dup_p={self.dup_p}).run()")
+                f"duration={self.duration}, "
+                f"script={self.script_arg!r}, "
+                f"drop_p={self.drop_p}, dup_p={self.dup_p}"
+                f"{extra}).run()")
 
     def _fail(self, why: str):
         art = self._dump_violation_artifacts(why)
         raise SimViolation(
-            f"seed {self.seed}: {why}\nreplay: {self._replay_recipe()}"
+            f"seed {self.seed}: {why}"
+            f"{pending_suffix(getattr(self, '_world', None))}"
+            f"\nreplay: {self._replay_recipe()}"
             + (f"\nper-rank metrics snapshot: {art}" if art else ""))
 
     def _dump_violation_artifacts(self, why: str) -> Optional[str]:
@@ -375,8 +589,10 @@ class Scenario:
                                     ReqState)
         from rlo_tpu.wire import Tag
 
+        delay_fn, drop_fn = weather_hooks(self.weather)
         world = SimWorld(self.ws, seed=self.seed, drop_p=self.drop_p,
-                         dup_p=self.dup_p)
+                         dup_p=self.dup_p, scheduler=self.scheduler,
+                         delay_fn=delay_fn, drop_fn=drop_fn)
         mgr = EngineManager()
         engines: List[ProgressEngine] = [
             ProgressEngine(world.transport(r), manager=mgr,
@@ -589,7 +805,8 @@ SCENARIO_KINDS = ("partition", "restart", "burst", "mixed")
 #: here so the CLI sweep covers them without importing the serving
 #: layer up front
 FABRIC_SCENARIO_KINDS = ("fabric_kill", "fabric_split",
-                         "fabric_rejoin", "fabric_paged")
+                         "fabric_rejoin", "fabric_paged",
+                         "fabric_churn")
 
 ALL_SCENARIO_KINDS = SCENARIO_KINDS + FABRIC_SCENARIO_KINDS
 

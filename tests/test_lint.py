@@ -242,6 +242,32 @@ def test_r5_fires_on_page_allocator_wallclock_leak(tree):
                "time.time" in f.msg for f in hits), hits
 
 
+def test_r5_fires_on_trace_generator_wallclock_leak(tree):
+    """The workloads subsystem (docs/DESIGN.md §14) is in the
+    deterministic-replay scope: trace digests are pinned seed-exact in
+    BENCH_workload.json/BENCH_serve.json, so a wall-clock (or
+    module-random) dependency in a generator would unpin every
+    committed trace."""
+    path = tree / "rlo_tpu/workloads/traces.py"
+    path.write_text(path.read_text() +
+                    "\nimport time\n_T0 = time.time()\n")
+    hits = findings_for(tree, "R5")
+    assert any(f.file == "rlo_tpu/workloads/traces.py" and
+               "time.time" in f.msg for f in hits), hits
+
+
+def test_r5_fires_on_weather_module_random_leak(tree):
+    """Weather samplers must draw ONLY from the rng the simulator
+    passes in — module-level randomness would decouple runs from the
+    world seed."""
+    path = tree / "rlo_tpu/workloads/weather.py"
+    path.write_text(path.read_text() +
+                    "\nimport random\n_J = random.random()\n")
+    hits = findings_for(tree, "R5")
+    assert any(f.file == "rlo_tpu/workloads/weather.py" and
+               "random.random" in f.msg for f in hits), hits
+
+
 def test_r5_fires_on_wallclock_leak(tree):
     path = tree / "rlo_tpu/transport/sim.py"
     path.write_text(path.read_text() +

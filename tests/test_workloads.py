@@ -1,0 +1,433 @@
+"""Traffic laboratory (rlo_tpu/workloads + the calendar-queue
+scheduler, docs/DESIGN.md §14).
+
+Four contracts:
+
+  1. **Generator determinism** — every canned trace kind is a pure
+     function of (seed, config): same seed => same digest, different
+     seed => different digest; the serve_bench compatibility shim
+     still reproduces the committed BENCH_serve.json traces.
+  2. **JSONL round-trip** — dumps/loads preserves the digest; a
+     torn-tail (truncated) file loads its surviving prefix loudly
+     instead of raising; garbage headers and newer schemas refuse.
+  3. **Calendar-queue oracle equivalence** — the slotted scheduler
+     pops in BYTE-IDENTICAL order to the heapq oracle for any push
+     sequence, randomized timestamp ties and overflow-window items
+     included; a full-mode SimWorld run digests identically under
+     both schedulers.
+  4. **Weather profiles** — samplers draw only from the passed rng
+     (replayable), burst loss is actually correlated, churn scripts
+     respect their invariants, and the fabric_churn scenario kind
+     (check.sh fuzz sweep) runs its properties clean.
+"""
+
+import json
+from random import Random
+
+import pytest
+
+from rlo_tpu.transport.sim import (ALL_SCENARIO_KINDS, CalendarScheduler,
+                                   FABRIC_SCENARIO_KINDS, HeapScheduler,
+                                   Scenario, SimViolation, SimWorld,
+                                   make_scenario)
+from rlo_tpu.workloads import (TRACE_KINDS, GilbertLoss, HeavyTailDelay,
+                               Trace, TraceError, churn_script,
+                               make_trace, make_weather)
+
+import logging
+
+logging.getLogger("rlo_tpu").setLevel(logging.ERROR)
+
+
+# ---------------------------------------------------------------------------
+# 1. generator determinism
+# ---------------------------------------------------------------------------
+
+class TestGenerators:
+    @pytest.mark.parametrize("kind", TRACE_KINDS)
+    def test_same_seed_same_digest(self, kind):
+        a = make_trace(kind, 7)
+        b = make_trace(kind, 7)
+        assert a.digest() == b.digest()
+        assert [r.row() for r in a.requests] == \
+            [r.row() for r in b.requests]
+        assert len(a.requests) > 0
+
+    @pytest.mark.parametrize("kind", TRACE_KINDS)
+    def test_different_seed_different_digest(self, kind):
+        assert make_trace(kind, 0).digest() != \
+            make_trace(kind, 1).digest()
+
+    def test_config_overrides_change_digest(self):
+        assert make_trace("swarm", 0).digest() != \
+            make_trace("swarm", 0, zipf_alpha=2.0).digest()
+
+    def test_times_sorted_and_bounded(self):
+        for kind in TRACE_KINDS:
+            tr = make_trace(kind, 3, horizon=50.0)
+            ts = [r.t for r in tr.requests]
+            assert ts == sorted(ts)
+            assert all(0.0 <= t < 50.0 for t in ts)
+
+    def test_swarm_prefixes_actually_shared(self):
+        tr = make_trace("swarm", 0)
+        by_tenant = {}
+        for r in tr.requests:
+            by_tenant.setdefault(r.tenant, []).append(r.prompt)
+        shared = 0
+        for prompts in by_tenant.values():
+            if len(prompts) < 2:
+                continue
+            plen = min(len(p) for p in prompts)
+            k = 0
+            while k < plen and len({p[k] for p in prompts}) == 1:
+                k += 1
+            shared = max(shared, k)
+        assert shared >= 8  # at least one full shared system prefix
+
+    def test_unknown_kind_raises(self):
+        with pytest.raises(TraceError):
+            make_trace("tsunami", 0)
+
+    def test_poisson_compat_reproduces_committed_legs(self):
+        """The shim digests must match the pins serve_bench asserts
+        in-bench — double-entry bookkeeping for the committed
+        BENCH_serve.json traffic."""
+        from rlo_tpu.workloads.traces import compat_digest, \
+            poisson_compat
+        dense = compat_digest(*poisson_compat(
+            128, n_req=8, rate=1.5, seed=0, max_len=64, buckets=(16,)))
+        prefix = compat_digest(*poisson_compat(
+            128, n_req=8, rate=1.5, seed=1, max_len=64, buckets=(16,),
+            prefix_len=8))
+        assert dense == ("2e170cbc3e3069f4f24598ed9b4e250b"
+                         "70ec6245e1346814b928f82e3b36cb6a")
+        assert prefix == ("b7018e756d78af9db7232d1b353eba48"
+                          "0224d7aabb0e32ab668b777bdd325214")
+
+
+# ---------------------------------------------------------------------------
+# 2. JSONL round-trip + truncation tolerance
+# ---------------------------------------------------------------------------
+
+class TestJsonl:
+    def test_round_trip_preserves_digest(self, tmp_path):
+        tr = make_trace("mmpp", 5)
+        p = tmp_path / "t.jsonl"
+        tr.dump_jsonl(p)
+        back = Trace.load_jsonl(p)
+        assert back.digest() == tr.digest()
+        assert back.truncated == 0
+        assert back.config == tr.config
+
+    def test_truncated_file_keeps_prefix(self, tmp_path):
+        tr = make_trace("diurnal", 2)
+        text = tr.dumps()
+        p = tmp_path / "torn.jsonl"
+        p.write_text(text[:int(len(text) * 0.6)])  # torn mid-line
+        back = Trace.load_jsonl(p)
+        assert 0 < len(back.requests) < len(tr.requests)
+        assert back.truncated > 0
+        # the surviving prefix is the exact original prefix
+        assert [r.row() for r in back.requests] == \
+            [r.row() for r in tr.requests[:len(back.requests)]]
+
+    def test_header_shortfall_counts_truncated(self, tmp_path):
+        tr = make_trace("flash", 1)
+        lines = tr.dumps().splitlines()
+        p = tmp_path / "short.jsonl"
+        p.write_text("\n".join(lines[:len(lines) // 2]) + "\n")
+        back = Trace.load_jsonl(p)
+        assert back.truncated == len(tr.requests) - len(back.requests)
+
+    def test_bad_header_raises(self, tmp_path):
+        p = tmp_path / "bad.jsonl"
+        p.write_text("not json\n")
+        with pytest.raises(TraceError):
+            Trace.load_jsonl(p)
+        p.write_text("")
+        with pytest.raises(TraceError):
+            Trace.load_jsonl(p)
+
+    def test_newer_schema_refused(self):
+        head = json.dumps({"schema": 99, "kind": "x", "seed": 0,
+                           "n": 0, "config": {}})
+        with pytest.raises(TraceError):
+            Trace.loads(head + "\n")
+
+
+# ---------------------------------------------------------------------------
+# 3. calendar queue == heapq oracle
+# ---------------------------------------------------------------------------
+
+def _drain_equal(pushes, interleave_pops=0, width=0.01, nslots=16):
+    """Feed the same (t, ctr) stream to both schedulers — optionally
+    popping mid-stream — and assert identical pop sequences."""
+    heap, cal = HeapScheduler(), CalendarScheduler(width, nslots)
+    out_h, out_c = [], []
+    for i, item in enumerate(pushes):
+        heap.push(item)
+        cal.push(item)
+        if interleave_pops and i % interleave_pops == 0 and len(heap):
+            out_h.append(heap.pop())
+            out_c.append(cal.pop())
+    while len(heap):
+        out_h.append(heap.pop())
+        out_c.append(cal.pop())
+    assert len(cal) == 0
+    assert out_h == out_c
+    return out_h
+
+
+class TestCalendarOracle:
+    def test_randomized_timestamp_ties(self):
+        # many exact ties: t drawn from a tiny discrete set, so slot
+        # lists and the heap both break ties on the ctr field alone
+        for seed in range(5):
+            rng = Random(seed)
+            pushes = [(rng.choice([0.0, 0.01, 0.02, 0.5, 0.51]),
+                       ctr, "src", ctr % 4, 7, b"x", None)
+                      for ctr in range(200)]
+            out = _drain_equal(pushes)
+            assert [x[:2] for x in out] == sorted(x[:2] for x in out)
+
+    def test_interleaved_pops_and_monotone_pushes(self):
+        rng = Random(42)
+        now, ctr, pushes = 0.0, 0, []
+        for _ in range(300):
+            now += rng.random() * 0.05
+            pushes.append((now + rng.uniform(0.001, 0.25), ctr,
+                           0, 1, 7, b"p", None))
+            ctr += 1
+        _drain_equal(pushes, interleave_pops=3)
+
+    def test_overflow_heap_window(self):
+        # items far beyond the ring window exercise the overflow heap
+        # and its migration on window advance
+        rng = Random(9)
+        pushes = [(rng.uniform(0.0, 50.0), ctr, 0, 1, 7, b"f", None)
+                  for ctr in range(120)]
+        _drain_equal(pushes, width=0.01, nslots=8)
+
+    def test_empty_pop_raises(self):
+        cal = CalendarScheduler(0.01, 8)
+        with pytest.raises(IndexError):
+            cal.pop()
+
+    def test_simworld_digest_scheduler_independent(self):
+        """Full-mode (digest-on) scenario: byte-identical schedule
+        digest under both schedulers — the §14 oracle-equivalence
+        rule end to end."""
+        script = [(2.0 + i, "bcast", i % 4) for i in range(6)] + \
+            [(15.0, "kill", 2), (30.0, "restart", 2)]
+        a = Scenario(world_size=4, seed=13, duration=90.0,
+                     script=script).run()
+        b = Scenario(world_size=4, seed=13, duration=90.0,
+                     script=script, scheduler="calendar").run()
+        assert a["digest"] == b["digest"]
+        assert a["events"] == b["events"]
+        assert a["delivered"] == b["delivered"]
+
+    def test_unknown_scheduler_rejected(self):
+        with pytest.raises(ValueError):
+            SimWorld(4, scheduler="splay")
+
+
+# ---------------------------------------------------------------------------
+# pending_events counter + violation message exposure
+# ---------------------------------------------------------------------------
+
+class TestPendingEvents:
+    def test_counter_tracks_in_flight_frames(self):
+        world = SimWorld(2, seed=0)
+        tr = world.transport(0)
+        assert world.pending_events() == 0
+        for i in range(5):
+            tr.isend(1, 7, bytes([i]))
+        assert world.pending_events() == 5
+        n = world.pending_events()
+        while world.pending_events():
+            world.step()
+            n -= 1
+            assert world.pending_events() == n
+        assert world.quiescent() is False  # inbox still undrained
+
+    def test_violation_message_carries_pending_events(self):
+        sc = Scenario(world_size=4, seed=3)
+        sc._world = SimWorld(4, seed=3)
+        sc._world.transport(0).isend(1, 7, b"x")
+        with pytest.raises(SimViolation) as ei:
+            sc._fail("synthetic")
+        msg = str(ei.value)
+        assert "pending events at failure: 1" in msg
+        assert "replay: Scenario(" in msg
+
+
+# ---------------------------------------------------------------------------
+# 4. weather profiles + the fabric_churn scenario kind
+# ---------------------------------------------------------------------------
+
+class TestWeather:
+    def test_heavy_tail_delay_bounded_and_replayable(self):
+        d = HeavyTailDelay()
+        assert d(Random(5)) == d(Random(5))  # same rng => same sample
+        rng = Random(1)
+        samples = [d(rng) for _ in range(4000)]
+        assert all(d.base <= s <= d.cap for s in samples)
+        # heavy tail: p99 well above the median
+        samples.sort()
+        assert samples[-40] > 5 * samples[2000]
+
+    def test_gilbert_loss_correlated_and_replayable(self):
+        g1, g2 = GilbertLoss(), GilbertLoss()
+        rng_a, rng_b = Random(3), Random(3)
+        s1 = [g1(rng_a) for _ in range(5000)]
+        s2 = [g2(rng_b) for _ in range(5000)]
+        assert s1 == s2
+        assert g1.bad_entries == g2.bad_entries > 0
+        # correlation: drops cluster — the mean run length of drops
+        # exceeds what iid loss at the same rate would produce (~1.07)
+        runs, cur = [], 0
+        for x in s1:
+            if x:
+                cur += 1
+            elif cur:
+                runs.append(cur)
+                cur = 0
+        assert runs and sum(runs) / len(runs) > 1.5
+
+    def test_churn_script_invariants(self):
+        ws, dur, settle, min_down = 8, 200.0, 60.0, 13.0
+        steps = churn_script(11, world_size=ws, rate=0.08,
+                             duration=dur, mean_down=20.0,
+                             min_down=min_down, min_live=4,
+                             settle=settle)
+        assert steps == churn_script(11, world_size=ws, rate=0.08,
+                                     duration=dur, mean_down=20.0,
+                                     min_down=min_down, min_live=4,
+                                     settle=settle)
+        assert steps == sorted(steps, key=lambda s: s[0])
+        live = set(range(ws))
+        down_at = {}
+        for t, act, r in steps:
+            assert t <= dur - settle
+            if act == "kill":
+                assert r in live
+                live.discard(r)
+                down_at[r] = t
+            else:
+                assert act == "restart" and r not in live
+                live.add(r)
+            assert len(live) >= 4
+        assert live == set(range(ws))  # everyone restarted by the end
+
+    def test_weather_repr_is_replay_recipe(self):
+        w = make_weather("churn", 4, world_size=4, rate=0.03,
+                         duration=120.0)
+        w2 = eval(repr(w), {"make_weather": make_weather})
+        assert w2.script == w.script
+
+    def test_stateful_weather_reused_across_runs_replays(self):
+        """A Weather with a stateful sampler (the Gilbert chain) is
+        reset at run start, so reusing ONE object across runs — the
+        natural violation-debugging idiom — still replays bit-for-bit
+        instead of starting the second run mid-burst."""
+        w = make_weather("burst_loss")
+        script = [(2.0 + i, "bcast", i % 4) for i in range(4)]
+        mk = lambda: Scenario(world_size=4, seed=12, duration=40.0,
+                              script=script, weather=w)
+        sc = mk()
+        a = sc.run()
+        assert w.drop_fn.bad_entries >= 0
+        b = sc.run()          # same scenario object, run twice
+        c = mk().run()        # fresh scenario, same weather object
+        assert a["digest"] == b["digest"] == c["digest"]
+
+    def test_scenario_with_wan_weather_replays(self):
+        script = [(2.0 + i, "bcast", i % 4) for i in range(4)]
+        mk = lambda: Scenario(world_size=4, seed=8, duration=40.0,
+                              script=script,
+                              weather=make_weather("wan"))
+        a, b = mk().run(), mk().run()
+        assert a["digest"] == b["digest"]
+        assert a["delivered"] == b["delivered"]
+        # and the weather actually changed the schedule
+        dry = Scenario(world_size=4, seed=8, duration=40.0,
+                       script=script).run()
+        assert dry["digest"] != a["digest"]
+
+    def test_replay_recipe_does_not_double_weather_steps(self):
+        """The recipe prints the PRE-merge script plus the weather:
+        rebuilding from it must merge the weather steps exactly once,
+        not re-apply them on top of an already-merged script."""
+        w = make_weather("churn", 2, world_size=4, rate=0.05,
+                         duration=120.0)
+        sc = Scenario(world_size=4, seed=2, duration=120.0,
+                      script=[(1.0, "bcast", 0)], weather=w)
+        recipe = sc._replay_recipe()
+        assert recipe.endswith(").run()")
+        rebuilt = eval(recipe[:-len(".run()")],
+                       {"Scenario": Scenario,
+                        "make_weather": make_weather})
+        assert rebuilt.script == sc.script
+        assert rebuilt.script_arg == sc.script_arg
+
+    def test_fabric_churn_registered_and_clean(self):
+        assert "fabric_churn" in FABRIC_SCENARIO_KINDS
+        assert "fabric_churn" in ALL_SCENARIO_KINDS
+        res = make_scenario("fabric_churn", 0).run()
+        assert res["rejoins"] > 0  # churn actually churned
+        assert res["submitted"] > 0
+
+    def test_fabric_recipe_replays_digest_identical(self):
+        """The printed FabricScenario recipe carries every non-default
+        knob (decode pacing, slots, paged-stub config, weather), so
+        rebuilding from it replays the violating schedule exactly."""
+        from rlo_tpu.serving.scenario import FabricScenario
+        sc = make_scenario("fabric_kill", 1)
+        a = sc.run()
+        recipe = sc._replay_recipe()
+        rebuilt = eval(recipe[:-len(".run()")],
+                       {"FabricScenario": FabricScenario,
+                        "make_weather": make_weather})
+        b = rebuilt.run()
+        assert a["digest"] == b["digest"]
+        assert a["events"] == b["events"]
+
+    @pytest.mark.slow
+    def test_fabric_churn_sweep(self):
+        for seed in range(25):
+            make_scenario("fabric_churn", seed).run()
+
+
+# ---------------------------------------------------------------------------
+# workload_bench reproducibility (subprocess, like test_perf_gate)
+# ---------------------------------------------------------------------------
+
+class TestWorkloadBench:
+    def test_quick_reproduces_itself(self, tmp_path):
+        import subprocess
+        import sys as _sys
+        from pathlib import Path
+
+        from rlo_tpu.tools.perf_gate import run_gate
+
+        repo = Path(__file__).resolve().parents[1]
+        docs = []
+        for name in ("a", "b"):
+            out = tmp_path / f"{name}.json"
+            proc = subprocess.run(
+                [_sys.executable, "benchmarks/workload_bench.py",
+                 "--quick", "--out", str(out)],
+                capture_output=True, text=True, cwd=repo)
+            assert proc.returncode == 0, proc.stderr
+            docs.append(json.loads(out.read_text()))
+        assert docs[0]["suite"] == "workload_bench"
+        assert run_gate(docs[0], docs[1]) == []
+        # the acceptance surface: generator digests + the scale
+        # datapoints + the trace-driven fabric leg all present
+        keys = docs[0]["metrics"]
+        assert "trace.swarm.digest" in keys
+        assert "oracle.n256.schedulers_match" in keys
+        assert any(k.startswith("fanout.n") for k in keys)
+        assert any(k.startswith("fabric.trace_swarm.") for k in keys)
