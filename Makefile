@@ -1,0 +1,29 @@
+# Convenience entry points for the repo's toolchain.  The native C
+# core has its own Makefile (rlo_tpu/native/Makefile); this one fronts
+# the Python-side analyzers, tests, and the one-shot verifier.
+
+PY ?= python
+
+.PHONY: sentinel lint static native test check
+
+# CFG/dataflow analyzer for the dual engines (docs/DESIGN.md §15):
+# GIL-release safety, wire-input taint, error-path leaks, state-machine
+# absorption, stale-anchor audit.  Exit 0 clean / 1 findings / 2 error.
+sentinel:
+	$(PY) -m rlo_tpu.tools.rlo_sentinel
+
+# static cross-engine conformance (docs/DESIGN.md §9)
+lint:
+	$(PY) -m rlo_tpu.tools.rlo_lint
+
+# both analyzers, the full static gate
+static: lint sentinel
+
+native:
+	$(MAKE) -C rlo_tpu/native
+
+test:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow'
+
+check:
+	sh check.sh

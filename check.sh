@@ -19,7 +19,20 @@ echo "== rlo-lint (static cross-engine conformance) =="
 # wire/metrics/ctypes/dispatch/determinism parity between the Python
 # and C engines, checked without importing or compiling anything —
 # docs/DESIGN.md §9. Also runs inside tier-1 (tests/test_lint.py).
+# Findings print as file:line: diagnostics; --json for CI tooling.
 python -m rlo_tpu.tools.rlo_lint
+
+echo "== rlo-sentinel (CFG/dataflow: GIL safety, taint, leaks, absorption) =="
+# flow-sensitive pass over per-function C CFGs + the Python AST:
+# S1 GIL-release safety (no process-global writes reachable from the
+# batched entry points), S2 wire-input taint with dominating-guard
+# checks, S3 error-path resource leaks against the owns/transfers
+# ownership anchors, S4 proposal state-machine absorption proved
+# identical across both engines, S0 stale-anchor audit over BOTH
+# tools' anchor namespaces — docs/DESIGN.md §15. Also in tier-1
+# (tests/test_sentinel.py). The timeout IS the wall budget: the
+# analyzer must stay fast enough to run on every tree, every time.
+timeout 10 python -m rlo_tpu.tools.rlo_sentinel
 
 echo "== pytest =="
 python -m pytest tests/ -q

@@ -205,9 +205,12 @@ def load() -> C.CDLL:
     sig("rlo_world_quiescent", C.c_int, [p])
     sig("rlo_world_sent_cnt", C.c_int64, [p])
     sig("rlo_world_delivered_cnt", C.c_int64, [p])
-    sig("rlo_engine_progress_n", C.c_int64,
+    # the batched drivers run for the call's whole duration with the
+    # GIL released — rlo-sentinel S1 roots its per-world-ownership
+    # call-graph scan here (docs/DESIGN.md §15)
+    sig("rlo_engine_progress_n", C.c_int64,  # rlo-sentinel: gil-released
         [p, C.c_int64, C.c_uint64])
-    sig("rlo_world_progress_all_n", C.c_int64,
+    sig("rlo_world_progress_all_n", C.c_int64,  # rlo-sentinel: gil-released
         [p, C.c_int64, C.c_uint64])
     sig("rlo_engine_frames_dispatched", C.c_int64, [p])
     sig("rlo_engine_arq_heap_len", C.c_int64, [p])

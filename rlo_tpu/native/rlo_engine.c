@@ -353,6 +353,7 @@ static int32_t trace_ident(int tag, int32_t pid, int32_t vote)
 
 /* ---------------- queue ops ---------------- */
 
+/* rlo-sentinel: transfers(m) — the queue owns it until retired */
 static void q_append(rlo_queue *q, rlo_msg *m)
 {
     m->next = 0;
@@ -402,6 +403,7 @@ static rlo_blob *frame_blob(rlo_world *w, int32_t origin, int32_t pid,
 /* Wrap a received or freshly-encoded frame blob into a message; STEALS
  * the caller's blob ref (unrefs it on failure, storing RLO_ERR_PROTO or
  * RLO_ERR_NOMEM in *err so callers report the true cause). */
+/* rlo-sentinel: owns — returns a caller-owned message */
 static rlo_msg *msg_from_frame(rlo_world *w, int tag, int src,
                                rlo_blob *frame, int *err)
 {
@@ -436,6 +438,7 @@ static rlo_msg *msg_from_frame(rlo_world *w, int tag, int src,
     return m;
 }
 
+/* rlo-sentinel: transfers(p) */
 static void prop_free(rlo_prop *p)
 {
     if (!p)
@@ -447,6 +450,7 @@ static void prop_free(rlo_prop *p)
     free(p);
 }
 
+/* rlo-sentinel: transfers(m) */
 static void msg_free(rlo_msg *m)
 {
     if (!m)
@@ -504,6 +508,7 @@ static void put_le32(uint8_t *dst, int v)
 
 static void arq_heap_push(rlo_engine *e, uint64_t due);
 
+/* rlo-sentinel: transfers(rt) — the retransmit queue owns it */
 static void rtx_link(rlo_engine *e, rlo_rtx *rt)
 {
     rt->prev = 0;
@@ -1467,6 +1472,8 @@ int rlo_bcast(rlo_engine *e, const uint8_t *payload, int64_t len)
 
 /* Forward a received broadcast along the overlay (reference _bc_forward,
  * rootless_ops.c:1104-1225). Returns the number of forwards or <0. */
+/* rlo-sentinel: transfers(m) — queued on success; on rc<0 nothing
+ * was queued and the CALLER reclaims (progress dispatch) */
 static int bc_forward(rlo_engine *e, rlo_msg *m)
 {
     int targets[64];
@@ -1580,6 +1587,7 @@ static void set_err(rlo_engine *e, int err)
 /* Forward a duplicate store-and-forward frame along the overlay with
  * no local processing; parked in the wait-only queue until the sends
  * complete. */
+/* rlo-sentinel: transfers(m) */
 static void bc_forward_only(rlo_engine *e, rlo_msg *m)
 {
     int targets[64];
@@ -1600,6 +1608,7 @@ static void bc_forward_only(rlo_engine *e, rlo_msg *m)
     q_append(&e->q_wait, m);
 }
 
+/* rlo-sentinel: transfers(m) */
 static void on_proposal(rlo_engine *e, rlo_msg *m)
 {
     if (m->origin == e->rank) {
@@ -1781,6 +1790,7 @@ static void complete_own(rlo_engine *e)
         finish_member_round(e);
 }
 
+/* rlo-sentinel: transfers(m) */
 static void on_vote(rlo_engine *e, rlo_msg *m)
 {
     int pid = m->pid, vote = m->vote;
@@ -1857,6 +1867,7 @@ static int round_settled(rlo_engine *e, int32_t pid, int32_t gen)
     return 0;
 }
 
+/* rlo-sentinel: transfers(m) */
 static void on_decision(rlo_engine *e, rlo_msg *m)
 {
     if (m->origin == e->rank) {
@@ -2166,6 +2177,7 @@ static void declare_failed(rlo_engine *e, int rank)
     rlo_trace_emit(e->rank, RLO_EV_FAILURE, rank, 1, (int)age, 0);
 }
 
+/* rlo-sentinel: transfers(m) */
 static void on_failure(rlo_engine *e, rlo_msg *m)
 {
     int rank = m->pid;

@@ -54,6 +54,7 @@ static uint64_t xorshift64(uint64_t *s)
     return *s = x;
 }
 
+/* rlo-sentinel: transfers(n) */
 static void free_node(rlo_wire_node *n)
 {
     rlo_handle_unref(n->handle);
@@ -108,6 +109,7 @@ static int loop_quiescent(const rlo_world *base)
     return ((const rlo_loop_world *)base)->pending == 0;
 }
 
+/* rlo-sentinel: transfers(n) — the inbox owns it until polled */
 static void inbox_push(rlo_loop_world *w, rlo_wire_node *n)
 {
     n->next = 0;
@@ -212,7 +214,14 @@ static int loop_isend(rlo_world *base, int src, int dst, int comm, int tag,
                 w->tick + xorshift64(&w->rng) % (uint64_t)(w->latency + 1);
             rlo_channel *c = get_channel(w, src, dst, comm);
             if (!c) {
+                /* free_node drops the NODE's handle ref only; on this
+                 * error return *out is never set, so the ref reserved
+                 * for the caller must be dropped here too or a
+                 * tracked send leaks its handle (rlo-sentinel S3
+                 * audit, round 15) */
                 free_node(n);
+                if (caller_tracks)
+                    rlo_handle_unref(h);
                 return RLO_ERR_NOMEM;
             }
             if (c->tail)
@@ -226,6 +235,9 @@ static int loop_isend(rlo_world *base, int src, int dst, int comm, int tag,
     }
     if (out)
         *out = h;
+    /* rlo-sentinel: trusted — the copy loop runs at least once
+     * (copy = 0 <= dup), so every node was pushed or freed above;
+     * the zero-iteration path the CFG sees is infeasible */
     return RLO_OK;
 }
 

@@ -926,6 +926,39 @@ static void test_progress_n_threads(void)
     CHECK(fails[1] == 0);
 }
 
+/* Same two-worlds-two-threads shape WITH TRACING ON: the trace ring is
+ * the one piece of process-global mutable state the GIL-released
+ * batched drivers share across worlds (rlo-sentinel S1, round 15 —
+ * the ring is mutex-protected for exactly this shape).  Before the
+ * fix this case was a guaranteed TSan report: both threads emit
+ * BCAST_FWD/DELIVER events concurrently.  Run under TSan via the
+ * `tsan` target like its untraced twin. */
+static void test_progress_n_threads_traced(void)
+{
+    rlo_trace_clear();
+    rlo_trace_set(1);
+    pthread_t t[2];
+    int fails[2] = {0, 0};
+    CHECK(pthread_create(&t[0], 0, progress_n_thread_body,
+                         &fails[0]) == 0);
+    CHECK(pthread_create(&t[1], 0, progress_n_thread_body,
+                         &fails[1]) == 0);
+    pthread_join(t[0], 0);
+    pthread_join(t[1], 0);
+    rlo_trace_set(0);
+    CHECK(fails[0] == 0);
+    CHECK(fails[1] == 0);
+    /* both threads' events landed in the shared ring (drained events +
+     * overflow drops account for every emit; exact counts depend on
+     * interleaving, presence is the contract) */
+    rlo_trace_event ev[256];
+    int drained = 0, got;
+    while ((got = rlo_trace_drain(ev, 256)) > 0)
+        drained += got;
+    CHECK(drained + rlo_trace_dropped() > 0);
+    rlo_trace_clear();
+}
+
 /* S13 writev coalescing + partial-write resume + zero-copy path: a
  * 2-rank TCP world with SO_SNDBUF shrunk to its floor, shipping
  * large ARQ-stamped frames (the isend_hdr gather path) interleaved
@@ -1126,6 +1159,7 @@ int main(void)
     test_progress_budget();
     test_arq_due_heap();
     test_progress_n_threads();
+    test_progress_n_threads_traced();
     test_writev_partial_resume();
     test_tcp_peer_death();
     if (failures) {

@@ -142,6 +142,7 @@ static size_t rec_size(int64_t len)
 
 /* ---- pump: drain all my inbound rings into the local inbox ---- */
 
+/* rlo-sentinel: transfers(n) — the inbox owns it until polled */
 static void shm_inbox_push(rlo_shm_world *w, rlo_wire_node *n)
 {
     n->next = 0;
@@ -173,6 +174,24 @@ static int shm_pump(rlo_shm_world *w)
                 break;
             shm_rec rec;
             ring_read(r, cap, tail, &rec, sizeof(rec));
+            /* rec is WIRE INPUT from a shared segment a crashed or
+             * hostile peer may have scribbled over (rlo-sentinel S2):
+             * every field that sizes an allocation/copy or advances
+             * the consume cursor is validated against the ring
+             * geometry before use — the TCP receive path applies the
+             * same symmetric cap (tcp_pump), including the src pin:
+             * each ring is per (src, me) and senders stamp their own
+             * rank, so any other value is a scribble that would let
+             * frames impersonate a healthy rank past the
+             * failed-sender/epoch quarantine. A violation poisons the
+             * world (abort_flag), it must never poison this process. */
+            if (rec.len < 0 ||
+                rec.len > cap - (int64_t)sizeof(shm_rec) ||
+                rec.size != rec_size(rec.len) ||
+                rec.src != src) {
+                atomic_store(&w->hdr->abort_flag, 1);
+                return RLO_ERR_PROTO;
+            }
             rlo_wire_node *n = (rlo_wire_node *)rlo_pool_alloc(
                 &w->base, sizeof(*n));
             rlo_blob *frame = rlo_blob_new_w(&w->base, rec.len);

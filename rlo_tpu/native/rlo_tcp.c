@@ -424,7 +424,13 @@ static void tcp_pump(rlo_tcp_world *w)
                 p->rhdr_got += (size_t)k;
                 if (p->rhdr_got < sizeof p->rhdr)
                     break;
-                if (p->rhdr.len < 0 || p->rhdr.len > TCP_MAX_FRAME) {
+                if (p->rhdr.len < 0 || p->rhdr.len > TCP_MAX_FRAME ||
+                    p->rhdr.src != r) {
+                    /* len caps the allocation below; src is the
+                     * engine's quarantine key and MUST match the
+                     * socket's rank — a mis-stamped src would smuggle
+                     * frames past the failed-sender/epoch quarantine
+                     * as traffic "from nowhere" (rlo-sentinel S2) */
                     tcp_peer_crashed(w, p);
                     return;
                 }

@@ -213,6 +213,7 @@ int rlo_world_isend_hdr(rlo_world *w, int src, int dst, int comm,
     return rc;
 }
 
+/* rlo-sentinel: owns — the polled node belongs to the caller */
 rlo_wire_node *rlo_world_poll(rlo_world *w, int rank, int comm)
 {
     return w->ops->poll(w, rank, comm);

@@ -408,6 +408,14 @@ class DecodeFabric:
     # record handling
     # ------------------------------------------------------------------
     def _on_record(self, data: bytes, origin: int) -> None:
+        if len(data) <= len(FABRIC_MAGIC):
+            # a magic-only (or truncated) frame: the caller's
+            # startswith(FABRIC_MAGIC) proves nothing about the kind
+            # byte existing — without this guard a 5-byte payload
+            # raises IndexError inside every rank's pump
+            # (rlo-sentinel S2, round 15)
+            self.metrics.counter("fabric.unknown_records").inc()
+            return
         kind = data[len(FABRIC_MAGIC)]
         body = data[len(FABRIC_MAGIC) + 1:]
         if kind == Rec.ADMIT:

@@ -58,9 +58,17 @@ Anchor comments the linter understands:
   # rlo-lint: allow-wallclock               sanctioned wall-clock use
 
 Usage:
-  python -m rlo_tpu.tools.rlo_lint [--root DIR] [--rules R1,R3] [-q]
+  python -m rlo_tpu.tools.rlo_lint [--root DIR] [--rules R1,R3]
+                                   [--json] [-q]
 
 Exit codes: 0 clean, 1 findings, 2 bad invocation / missing inputs.
+
+Since round 15 the mini C parser lives in the shared front end
+``rlo_tpu/tools/csrc.py`` (rlo-sentinel builds its CFG/dataflow layer
+on the same model — docs/DESIGN.md §15), findings ride the shared
+runner (``--json`` for machine-readable output), and every anchor a
+rule *uses* is recorded so rlo-sentinel's S0 stale-anchor audit can
+flag the ones that no longer suppress anything.
 """
 
 from __future__ import annotations
@@ -70,9 +78,14 @@ import ast
 import re
 import struct
 import sys
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from pathlib import Path
 from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from rlo_tpu.tools import csrc
+from rlo_tpu.tools.csrc import CHeader, CProto, parse_c_header  # noqa: F401
+from rlo_tpu.tools.runner import (AnchorRegistry, Finding, ToolError,
+                                  emit, find_anchor)
 
 RULE_IDS = ("R1", "R2", "R3", "R4", "R5")
 
@@ -100,223 +113,32 @@ R5_FILES = (ENGINE_PY, "rlo_tpu/transport/base.py",
             "rlo_tpu/serving/backend.py", "rlo_tpu/serving/scenario.py",
             "rlo_tpu/workloads/__init__.py",
             "rlo_tpu/workloads/traces.py",
-            "rlo_tpu/workloads/weather.py")
+            "rlo_tpu/workloads/weather.py",
+            # the analyzers themselves (round 15): a wall-clock or
+            # module-random dependency in rlo-lint/rlo-sentinel would
+            # make "clean tree" depend on when/where the tool ran —
+            # check.sh times the sentinel from the OUTSIDE instead
+            "rlo_tpu/tools/rlo_lint.py",
+            "rlo_tpu/tools/rlo_sentinel.py",
+            "rlo_tpu/tools/csrc.py", "rlo_tpu/tools/runner.py",
+            "rlo_tpu/tools/perf_gate.py")
 
 PAIRED_ANCHOR = "rlo-lint: paired-with"
 DEFAULT_ROUTE_ANCHOR = "rlo-lint: default-route"
 ALLOW_WALLCLOCK_ANCHOR = "rlo-lint: allow-wallclock"
 
 
-@dataclass
-class Finding:
-    rule: str
-    file: str
-    line: int
-    msg: str
-
-    def __str__(self) -> str:
-        return f"{self.file}:{self.line}: {self.rule} {self.msg}"
-
-
-class LintError(RuntimeError):
+class LintError(ToolError):
     """Unrecoverable analyzer failure (missing input, unparseable
     source) — exit code 2, distinct from findings."""
 
 
-# ---------------------------------------------------------------------------
-# C parsing (regex over comment-stripped text; line numbers preserved)
-# ---------------------------------------------------------------------------
-
-def _strip_c_comments(text: str) -> str:
-    """Replace comments with spaces, preserving every newline so byte
-    offsets keep mapping to the original line numbers."""
-    out = []
-    i, n = 0, len(text)
-    while i < n:
-        if text.startswith("/*", i):
-            j = text.find("*/", i + 2)
-            j = n if j < 0 else j + 2
-            out.append("".join(c if c == "\n" else " " for c in text[i:j]))
-            i = j
-        elif text.startswith("//", i):
-            j = text.find("\n", i)
-            j = n if j < 0 else j
-            out.append(" " * (j - i))
-            i = j
-        else:
-            out.append(text[i])
-            i += 1
-    return "".join(out)
-
-
-def _line_of(text: str, idx: int) -> int:
-    return text.count("\n", 0, idx) + 1
-
-
-@dataclass
-class CProto:
-    name: str
-    ret: str                       # canonical C type, e.g. "int64_t"
-    params: List[str]              # canonical C types
-    line: int
-
-
-@dataclass
-class CHeader:
-    path: str
-    raw: str
-    stripped: str
-    macros: Dict[str, Tuple[int, int]] = field(default_factory=dict)
-    enums: Dict[str, Dict[str, Tuple[int, int]]] = field(
-        default_factory=dict)
-    structs: Dict[str, List[Tuple[str, str, Optional[int], int]]] = field(
-        default_factory=dict)
-    protos: Dict[str, CProto] = field(default_factory=dict)
-    fn_typedefs: Dict[str, Tuple[str, List[str], int]] = field(
-        default_factory=dict)
-
-    def macro(self, name: str) -> int:
-        if name not in self.macros:
-            raise LintError(f"{self.path}: macro {name} not found")
-        return self.macros[name][0]
-
-    def resolve(self, token: str) -> int:
-        """An integer literal or a macro name -> its value."""
-        token = token.strip()
-        if re.fullmatch(r"-?\d+", token):
-            return int(token)
-        return self.macro(token)
-
-
-_CANON_SPACE = re.compile(r"\s+")
-
-
-def _canon_ctype(decl: str) -> str:
-    """'const uint8_t  *payload' -> 'uint8_t*' (drop qualifiers and the
-    parameter name, normalize pointer spacing)."""
-    decl = decl.strip()
-    decl = re.sub(r"\bconst\b|\bvolatile\b|\bstruct\b|\benum\b", " ", decl)
-    stars = decl.count("*")
-    decl = decl.replace("*", " ")
-    toks = _CANON_SPACE.sub(" ", decl).strip().split(" ")
-    # 'unsigned long long x' style does not occur in this header; the
-    # base type is one token, an optional second token is the name
-    if len(toks) > 1:
-        toks = toks[:-1]  # drop the parameter name
-    return "".join(toks) + "*" * stars
-
-
-def _split_params(params: str) -> List[str]:
-    params = params.strip()
-    if params in ("", "void"):
-        return []
-    return [_canon_ctype(p) for p in params.split(",")]
-
-
-def parse_c_header(path: Path, relpath: str) -> CHeader:
-    try:
-        raw = path.read_text()
-    except OSError as e:
-        raise LintError(f"cannot read {relpath}: {e}")
-    stripped = _strip_c_comments(raw)
-    hdr = CHeader(path=relpath, raw=raw, stripped=stripped)
-
-    for m in re.finditer(r"^[ \t]*#[ \t]*define[ \t]+(\w+)[ \t]+(-?\d+)",
-                         stripped, re.M):
-        hdr.macros[m.group(1)] = (int(m.group(2)), _line_of(stripped,
-                                                            m.start()))
-
-    for m in re.finditer(r"\benum\s+(\w+)\s*\{(.*?)\}", stripped, re.S):
-        members: Dict[str, Tuple[int, int]] = {}
-        nextval = 0
-        body_off = m.start(2)
-        for piece in m.group(2).split(","):
-            name_m = re.search(r"(\w+)\s*(?:=\s*(-?\w+))?", piece)
-            if not name_m or not re.match(r"[A-Za-z_]", name_m.group(1)):
-                continue
-            val = (hdr.resolve(name_m.group(2))
-                   if name_m.group(2) is not None else nextval)
-            nextval = val + 1
-            members[name_m.group(1)] = (
-                val, _line_of(stripped, body_off + piece.index(
-                    name_m.group(1))))
-            body_off += len(piece) + 1
-        hdr.enums[m.group(1)] = members
-
-    for m in re.finditer(
-            r"typedef\s+struct\s+(\w+)\s*\{(.*?)\}\s*\w+\s*;",
-            stripped, re.S):
-        fields: List[Tuple[str, str, Optional[int], int]] = []
-        body_off = m.start(2)
-        for stmt in m.group(2).split(";"):
-            stmt_line = _line_of(stripped, body_off)
-            body_off += len(stmt) + 1
-            s = _CANON_SPACE.sub(" ", stmt).strip()
-            if not s:
-                continue
-            decl_m = re.match(r"([\w ]+?)\s+([\w\[\], *]+)$", s)
-            if not decl_m:
-                continue
-            base = _canon_ctype(decl_m.group(1) + " x")
-            for one in decl_m.group(2).split(","):
-                one = one.strip()
-                arr = re.match(r"(\w+)\s*\[\s*(\w+)\s*\]", one)
-                if arr:
-                    fields.append((arr.group(1), base,
-                                   hdr.resolve(arr.group(2)), stmt_line))
-                else:
-                    stars = one.count("*")
-                    fields.append((one.replace("*", "").strip(),
-                                   base + "*" * stars, None, stmt_line))
-        hdr.structs[m.group(1)] = fields
-
-    # function-pointer typedefs: typedef RET (*name)(PARAMS);
-    for m in re.finditer(
-            r"typedef\s+([\w \*]+?)\s*\(\s*\*\s*(\w+)\s*\)\s*\(([^)]*)\)",
-            stripped, re.S):
-        hdr.fn_typedefs[m.group(2)] = (
-            _canon_ctype(m.group(1) + " x"), _split_params(m.group(3)),
-            _line_of(stripped, m.start()))
-
-    # prototypes: top-level after removing braces bodies / # lines
-    flat = re.sub(r"^[ \t]*#.*$", "", stripped, flags=re.M)
-    flat = re.sub(r"\{[^{}]*\}", lambda mm: "\n" * mm.group(0).count("\n"),
-                  flat)  # enum/struct bodies (no nesting in this header)
-    flat = re.sub(r'extern\s+"C"\s*\{', "", flat).replace("{", " ").replace(
-        "}", " ")
-    for m in re.finditer(
-            r"([\w \*\n]+?)\b(rlo_\w+)\s*\(([^()]*)\)\s*;", flat):
-        ret_txt = m.group(1).strip()
-        if not ret_txt or "typedef" in ret_txt:
-            continue
-        # keep only the tail type tokens of the return text (the regex
-        # may swallow the end of a previous statement)
-        ret_tail = re.search(
-            r"((?:\w+[ \n]+)*\w+[ \n\*]*)$", ret_txt)
-        ret = _canon_ctype((ret_tail.group(1) if ret_tail else ret_txt)
-                           + " x")
-        hdr.protos[m.group(2)] = CProto(
-            name=m.group(2), ret=ret, params=_split_params(m.group(3)),
-            line=_line_of(flat, m.start(2)))
-    return hdr
-
-
-def _extract_c_function(stripped: str, name: str) -> Optional[Tuple[str,
-                                                                    int]]:
-    """Body text (brace-matched) + start line of function ``name``."""
-    m = re.search(rf"\b{name}\s*\([^)]*\)\s*\{{", stripped)
-    if not m:
-        return None
-    depth = 0
-    start = stripped.index("{", m.start())
-    for i in range(start, len(stripped)):
-        if stripped[i] == "{":
-            depth += 1
-        elif stripped[i] == "}":
-            depth -= 1
-            if depth == 0:
-                return stripped[start:i + 1], _line_of(stripped, m.start())
-    return None
+# the mini C front end moved to csrc.py in round 15 (rlo-sentinel
+# shares it); keep the historical local names working
+_strip_c_comments = csrc.strip_comments
+_line_of = csrc.line_of
+_canon_ctype = csrc.canon_ctype
+_extract_c_function = csrc.extract_function
 
 
 # ---------------------------------------------------------------------------
@@ -377,14 +199,6 @@ def py_top_assigns(mod: PyModule) -> Dict[str, Tuple[ast.AST, int]]:
                 isinstance(node.targets[0], ast.Name):
             out[node.targets[0].id] = (node.value, node.lineno)
     return out
-
-
-def _line_has_anchor(mod: PyModule, line: int, anchor: str,
-                     lookback: int = 2) -> bool:
-    for ln in range(max(1, line - lookback), line + 1):
-        if anchor in mod.lines[ln - 1]:
-            return True
-    return False
 
 
 def _find_funcdef(tree: ast.AST, name: str) -> Optional[ast.FunctionDef]:
@@ -542,13 +356,16 @@ def _check_pair(findings: List[Finding], rule: str, file_a: str,
             f"= {val_b!r}"))
 
 
-def _require_anchor(findings: List[Finding], mod: PyModule, line: int,
-                    symbol: str) -> None:
-    if not _line_has_anchor(mod, line, PAIRED_ANCHOR):
+def _require_anchor(ctx: "LintContext", findings: List[Finding],
+                    mod: PyModule, line: int, symbol: str) -> None:
+    at = find_anchor(mod.lines, line, PAIRED_ANCHOR)
+    if at is None:
         findings.append(Finding(
             "R1", mod.path, line,
             f"paired constant {symbol} lacks a "
             f"'# {PAIRED_ANCHOR} <file:symbol>' anchor comment"))
+    else:
+        ctx.registry.consume(mod.path, at)
 
 
 def rule_r1(ctx: "LintContext") -> List[Finding]:
@@ -569,7 +386,7 @@ def rule_r1(ctx: "LintContext") -> List[Finding]:
         f.append(Finding("R1", wire.path, fmt_line or 1,
                          "_HEADER = struct.Struct(<literal>) not found"))
         return f
-    _require_anchor(f, wire, fmt_line, "_HEADER")
+    _require_anchor(ctx, f, wire, fmt_line, "_HEADER")
     offsets = [struct.calcsize(fmt[:i + 1]) for i in range(1,
                                                            len(fmt) - 1)]
     offsets.insert(0, 0)
@@ -587,7 +404,7 @@ def rule_r1(ctx: "LintContext") -> List[Finding]:
             continue
         node, line = assigns[py_name]
         val = _const_int(node)
-        _require_anchor(f, wire, line, py_name)
+        _require_anchor(ctx, f, wire, line, py_name)
         _check_pair(f, "R1", wire.path, line, py_name, val, hdr.path,
                     c_name, hdr.macro(c_name))
         _check_pair(f, "R1", wire.path, line, py_name, val, wire.path,
@@ -595,7 +412,7 @@ def rule_r1(ctx: "LintContext") -> List[Finding]:
 
     if "MSG_SIZE_MAX" in assigns:
         node, line = assigns["MSG_SIZE_MAX"]
-        _require_anchor(f, wire, line, "MSG_SIZE_MAX")
+        _require_anchor(ctx, f, wire, line, "MSG_SIZE_MAX")
         _check_pair(f, "R1", wire.path, line, "MSG_SIZE_MAX",
                     _const_int(node), hdr.path, "RLO_MSG_SIZE_MAX",
                     hdr.macro("RLO_MSG_SIZE_MAX"))
@@ -683,6 +500,11 @@ def rule_r1(ctx: "LintContext") -> List[Finding]:
                 "R1", bindings.path, line,
                 f"{py_name} has no {c_name} in {hdr.path}"))
             return
+        # a paired-with anchor on a bindings constant is optional but,
+        # when present, it is consumed by this check (S0 audit)
+        at = find_anchor(bindings.lines, line, PAIRED_ANCHOR)
+        if at is not None:
+            ctx.registry.consume(bindings.path, at)
         _check_pair(f, "R1", bindings.path, line, py_name,
                     _const_int(node), hdr.path, c_name,
                     c_vals[c_name][0])
@@ -704,7 +526,7 @@ def rule_r1(ctx: "LintContext") -> List[Finding]:
         if "HIST_BUCKETS" in assigns_:
             node, line = assigns_["HIST_BUCKETS"]
             if mod is ctx.metrics:
-                _require_anchor(f, mod, line, "HIST_BUCKETS")
+                _require_anchor(ctx, f, mod, line, "HIST_BUCKETS")
             _check_pair(f, "R1", mod.path, line, "HIST_BUCKETS",
                         _const_int(node), hdr.path, "RLO_HIST_BUCKETS",
                         c_hb)
@@ -725,7 +547,7 @@ def rule_r2(ctx: "LintContext") -> List[Finding]:
         return [Finding("R2", metrics.path, 1,
                         "ENGINE_COUNTER_KEYS not defined")]
     node, line = assigns["ENGINE_COUNTER_KEYS"]
-    _require_anchor(f, metrics, line, "ENGINE_COUNTER_KEYS")
+    _require_anchor(ctx, f, metrics, line, "ENGINE_COUNTER_KEYS")
     if not isinstance(node, (ast.Tuple, ast.List)):
         return f + [Finding("R2", metrics.path, line,
                             "ENGINE_COUNTER_KEYS is not a literal tuple")]
@@ -780,7 +602,7 @@ def rule_r2(ctx: "LintContext") -> List[Finding]:
                          "ENGINE_PHASE_KEYS not defined"))
         return f
     pnode, pline = assigns["ENGINE_PHASE_KEYS"]
-    _require_anchor(f, metrics, pline, "ENGINE_PHASE_KEYS")
+    _require_anchor(ctx, f, metrics, pline, "ENGINE_PHASE_KEYS")
     if not isinstance(pnode, (ast.Tuple, ast.List)):
         f.append(Finding("R2", metrics.path, pline,
                          "ENGINE_PHASE_KEYS is not a literal tuple"))
@@ -1076,16 +898,20 @@ def rule_r4(ctx: "LintContext") -> List[Finding]:
             r"tag\s*==\s*RLO_TAG_(\w+)", text)}
         c_catchall = re.search(r"\bdefault\s*:", text) is not None
 
-    def annotated(raw_lines: List[str], line: int) -> bool:
+    def annotated(path: str, raw_lines: List[str], line: int) -> bool:
         """The default-route anchor may sit anywhere in the member's
         trailing comment block — scan forward until the next member
-        definition or the end of the enum."""
+        definition or the end of the enum.  A matched anchor is
+        consumed (S0 audit): an anchor on a member that GAINED an
+        explicit handler is never looked up here, stays unconsumed,
+        and rots visibly."""
         for ln in range(line, min(line + 8, len(raw_lines) + 1)):
             text = raw_lines[ln - 1]
             if ln > line and (re.search(r"\w+\s*=\s*-?\d+", text) or
                               "}" in text):
                 return False
             if DEFAULT_ROUTE_ANCHOR in text:
+                ctx.registry.consume(path, ln)
                 return True
         return False
 
@@ -1093,7 +919,7 @@ def rule_r4(ctx: "LintContext") -> List[Finding]:
     for name, (val, line) in sorted(py_tags.items(),
                                     key=lambda kv: kv[1][0]):
         if name not in py_explicit:
-            if not annotated(wire.lines, line):
+            if not annotated(wire.path, wire.lines, line):
                 f.append(Finding(
                     "R4", wire.path, line,
                     f"Tag.{name} has no handler in ProgressEngine."
@@ -1107,7 +933,7 @@ def rule_r4(ctx: "LintContext") -> List[Finding]:
         c_name = f"RLO_TAG_{name}"
         if c_name in c_tags and name not in c_explicit:
             c_line = c_tags[c_name][1]
-            if not annotated(hdr_lines, c_line):
+            if not annotated(hdr.path, hdr_lines, c_line):
                 f.append(Finding(
                     "R4", hdr.path, c_line,
                     f"{c_name} has no case in rlo_engine_progress_once "
@@ -1140,7 +966,7 @@ def rule_r4(ctx: "LintContext") -> List[Finding]:
             for name, (_, line) in sorted(rec_members.items(),
                                           key=lambda kv: kv[1][0]):
                 if name not in fab_explicit and \
-                        not annotated(fab.lines, line):
+                        not annotated(fab.path, fab.lines, line):
                     f.append(Finding(
                         "R4", fab.path, line,
                         f"Rec.{name} has no branch in DecodeFabric."
@@ -1234,9 +1060,12 @@ def rule_r5(ctx: "LintContext") -> List[Finding]:
             continue
 
         def flag(line: int, msg: str) -> None:
-            if not _line_has_anchor(mod, line, ALLOW_WALLCLOCK_ANCHOR,
-                                    lookback=1):
+            at = find_anchor(mod.lines, line, ALLOW_WALLCLOCK_ANCHOR,
+                             lookback=1)
+            if at is None:
                 f.append(Finding("R5", mod.path, line, msg))
+            else:
+                ctx.registry.consume(mod.path, at)
 
         for n in ast.walk(mod.tree):
             if isinstance(n, ast.Attribute) and \
@@ -1278,9 +1107,12 @@ class LintContext:
     wire_c_stripped: str
     engine_c_stripped: str
     extra_py: Dict[str, PyModule]
+    registry: AnchorRegistry
 
 
-def build_context(root: Path) -> LintContext:
+def build_context(root: Path,
+                  registry: Optional[AnchorRegistry] = None
+                  ) -> LintContext:
     root = Path(root).resolve()
     extra: Dict[str, PyModule] = {}
     engine = parse_py(root / ENGINE_PY, ENGINE_PY)
@@ -1303,6 +1135,7 @@ def build_context(root: Path) -> LintContext:
         wire_c_stripped=_strip_c_comments(wire_c),
         engine_c_stripped=_strip_c_comments(engine_c),
         extra_py=extra,
+        registry=registry if registry is not None else AnchorRegistry(),
     )
 
 
@@ -1310,11 +1143,23 @@ _RULES = {"R1": rule_r1, "R2": rule_r2, "R3": rule_r3, "R4": rule_r4,
           "R5": rule_r5}
 
 
-def run_lint(root: Path, rules: Optional[Sequence[str]] = None
+def audit_files(root: Path) -> List[str]:
+    """Files whose anchors fall under the stale-anchor audit (the
+    files rlo-lint reads; rlo-sentinel unions its own set in)."""
+    fixed = [WIRE_PY, METRICS_PY, ENGINE_PY, BINDINGS_PY, CORE_H,
+             WIRE_C, ENGINE_C]
+    return fixed + [rel for rel in R5_FILES
+                    if (Path(root) / rel).exists()]
+
+
+def run_lint(root: Path, rules: Optional[Sequence[str]] = None,
+             registry: Optional[AnchorRegistry] = None
              ) -> List[Finding]:
     """Run the selected rule families (default: all) against the tree
-    at ``root``; returns findings sorted by file/line."""
-    ctx = build_context(root)
+    at ``root``; returns findings sorted by file/line.  ``registry``
+    (when given) accumulates the anchor lines the rules consumed — the
+    input to rlo-sentinel's S0 stale-anchor audit."""
+    ctx = build_context(root, registry)
     out: List[Finding] = []
     for rid in rules or RULE_IDS:
         if rid not in _RULES:
@@ -1336,6 +1181,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     ap.add_argument("--rules", default=None,
                     help="comma-separated rule families (default: all), "
                          "e.g. --rules R1,R3")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable findings on stdout")
     ap.add_argument("-q", "--quiet", action="store_true",
                     help="findings only, no summary line")
     args = ap.parse_args(argv)
@@ -1343,17 +1190,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
               r.strip()] if args.rules else None)
     try:
         findings = run_lint(args.root, rules)
-    except LintError as e:
+    except ToolError as e:
         print(f"rlo-lint: error: {e}", file=sys.stderr)
         return 2
-    for fnd in findings:
-        print(fnd)
-    if not args.quiet:
-        ran = ",".join(rules or RULE_IDS)
-        print(f"rlo-lint: {len(findings)} finding"
-              f"{'s' if len(findings) != 1 else ''} ({ran}) in "
-              f"{args.root}")
-    return 1 if findings else 0
+    return emit(findings, prog="rlo-lint",
+                ran=",".join(rules or RULE_IDS), root=args.root,
+                as_json=args.json, quiet=args.quiet)
 
 
 if __name__ == "__main__":

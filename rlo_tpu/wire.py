@@ -164,6 +164,9 @@ def restamp_epoch(raw: bytes, epoch: int) -> bytes:
     old traffic is never mistaken for a dead incarnation's). Returns
     ``raw`` itself when the stamp already matches (the common case —
     all link epochs 0 — never copies; mirror of the C send gate)."""
+    # rlo-sentinel: trusted — send-path helper: `raw` is a frame THIS
+    # engine just encoded (>= HEADER_SIZE by construction), not wire
+    # input from a peer
     if struct.unpack_from("<i", raw, EPOCH_OFFSET)[0] == epoch:
         return raw
     buf = bytearray(raw)

@@ -418,3 +418,42 @@ def test_fabric_bench_quick_reproduces_itself(tmp_path):
         if m["direction"] == "exact":
             assert db["metrics"][name]["value"] == m["value"], name
     assert da["metrics"]["failover4.requeues"]["value"] > 0
+
+
+def test_magic_only_record_is_counted_not_crash():
+    """Round-15 regression (rlo-sentinel S2): a payload that is
+    exactly FABRIC_MAGIC — or magic + nothing — passes the pump's
+    startswith() routing but has no kind byte.  Pre-fix, _on_record
+    raised IndexError inside every rank's pump; now it counts an
+    unknown record and the fleet keeps serving."""
+    from rlo_tpu.engine import EngineManager, ProgressEngine
+    from rlo_tpu.serving.fabric import FABRIC_MAGIC
+    from rlo_tpu.transport.sim import SimWorld
+
+    world = SimWorld(2, seed=9)
+    mgr = EngineManager()
+    engines = [ProgressEngine(world.transport(r), manager=mgr,
+                              clock=world.clock) for r in range(2)]
+    fabrics = [DecodeFabric(engines[r], StubBackend(n_slots=1),
+                            decode_interval=1.0) for r in range(2)]
+    # direct hit on the record dispatch (the minimal pre-fix crash)
+    fabrics[0]._on_record(bytes(FABRIC_MAGIC), 1)
+    assert fabrics[0].metrics.snapshot()["counters"][
+        "fabric.unknown_records"] >= 1
+    # and through the real wire path: a hostile/corrupt broadcast
+    engines[1].bcast(bytes(FABRIC_MAGIC))
+    for _ in range(60):
+        world.step()
+        mgr.progress_all()
+        for f in fabrics:
+            f.pump()
+    # the fleet still serves after absorbing the junk frame
+    rid = fabrics[0].submit((3, 3), 4)
+    for _ in range(200):
+        world.step()
+        mgr.progress_all()
+        for f in fabrics:
+            f.pump()
+        if all(f.result(rid) is not None for f in fabrics):
+            break
+    assert fabrics[1].result(rid) == stub_tokens((3, 3), 4, None)

@@ -163,7 +163,8 @@ def test_r3_fires_on_missing_batched_binding(tree):
     declaration must fail R3 (its int64 return would otherwise ride
     the implicit-int default and truncate frame counts)."""
     mutate(tree, "rlo_tpu/native/bindings.py",
-           '    sig("rlo_engine_progress_n", C.c_int64,\n'
+           '    sig("rlo_engine_progress_n", C.c_int64,'
+           '  # rlo-sentinel: gil-released\n'
            '        [p, C.c_int64, C.c_uint64])\n',
            "")
     hits = findings_for(tree, "R3")

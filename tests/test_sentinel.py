@@ -1,0 +1,293 @@
+"""rlo-sentinel self-verification (docs/DESIGN.md §15).
+
+Mirror of tests/test_lint.py's two-halves pattern:
+
+  1. The clean-tree contract: ``run_sentinel`` on this checkout reports
+     zero findings — GIL-release safety, wire-input taint, error-path
+     leaks, state-machine absorption, and the stale-anchor audit all
+     hold on HEAD, in tier-1, on every run.
+
+  2. Mutation fixtures: for each rule family S0–S4 a temp copy of the
+     tree is seeded with exactly one violation and the analyzer must
+     trip with the right rule ID at the right place — a rule that
+     never fires is indistinguishable from no rule.  Each fixture
+     re-creates a real bug class this PR fixed (or proved absent) on
+     the seed tree: the unlocked trace ring (S1), the unvalidated shm
+     record header (S2-C), the magic-only fabric record crash (S2-Py),
+     the early-return pool leak (S3), and a DONE→IDLE escape from a
+     settled proposal state (S4) — injected in either engine alone.
+"""
+
+from __future__ import annotations
+
+import json
+import shutil
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from rlo_tpu.tools.rlo_sentinel import run_sentinel
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+
+_IGNORE = shutil.ignore_patterns(
+    "__pycache__", ".pytest_cache", "*.so", "*.o", "*.pyc",
+    "rlo_selftest*", "rlo_demo", "rlo_demo_mpi", "rlo_demo_tsan",
+    "rlo_demo_asan", "femtompirun")
+
+
+@pytest.fixture()
+def tree(tmp_path):
+    """An analyzable copy of the source tree (sources only, no build
+    artifacts) that fixtures may mutate freely."""
+    shutil.copytree(REPO_ROOT / "rlo_tpu", tmp_path / "rlo_tpu",
+                    ignore=_IGNORE)
+    return tmp_path
+
+
+def mutate(root: Path, rel: str, old: str, new: str) -> int:
+    """Replace ``old`` (must occur exactly once) with ``new``; returns
+    the 1-indexed line of the edit."""
+    path = root / rel
+    text = path.read_text()
+    assert text.count(old) == 1, \
+        f"fixture drift: {old!r} occurs {text.count(old)}x in {rel}"
+    line = text[:text.index(old)].count("\n") + 1
+    path.write_text(text.replace(old, new))
+    return line
+
+
+def findings_for(root: Path, rule: str):
+    return [f for f in run_sentinel(root) if f.rule == rule]
+
+
+# ---------------------------------------------------------------------------
+# 1. clean tree
+# ---------------------------------------------------------------------------
+
+def test_head_is_clean():
+    """Zero findings on this checkout — the tier-1 drift gate."""
+    findings = run_sentinel(REPO_ROOT)
+    assert findings == [], "\n".join(str(f) for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# 2. one seeded violation per rule family
+# ---------------------------------------------------------------------------
+
+def test_s0_fires_on_stale_anchor(tree):
+    """An anchor nothing consumes is annotation rot: an
+    allow-wallclock suppression with no wall-clock use beneath it."""
+    path = tree / "rlo_tpu/engine.py"
+    path.write_text(path.read_text() +
+                    "\n# rlo-lint: allow-wallclock\n_ZZ = 1\n")
+    hits = findings_for(tree, "S0")
+    assert any(f.file == "rlo_tpu/engine.py" and
+               "allow-wallclock" in f.msg and "stale" in f.msg
+               for f in hits), hits
+    # ...and only the injected anchor, not the legitimate ones
+    assert len(hits) == 1, hits
+
+
+def test_s0_fires_on_detached_transfers_anchor(tree):
+    """A transfers() anchor naming a parameter the function does not
+    have attaches to nothing and must be flagged, not silently
+    ignored (that is exactly how ownership facts rot when a function
+    is re-signatured)."""
+    mutate(tree, "rlo_tpu/native/rlo_engine.c",
+           "/* rlo-sentinel: transfers(rt) — the retransmit queue owns it */",
+           "/* rlo-sentinel: transfers(zzz) — renamed param, stale fact */")
+    hits = run_sentinel(tree)
+    assert any(f.rule == "S0" and "transfers(zzz" in f.msg
+               for f in hits), hits
+    # losing the rtx_link fact ALSO resurfaces the S3 leak it declared
+    assert any(f.rule == "S3" and "rt" in f.msg and
+               "eng_isend_frame" in f.msg for f in hits), hits
+
+
+def test_s1_fires_on_global_write_in_gil_released_code(tree):
+    """A file-scope counter bumped inside the batched progress path is
+    the trace-ring bug class: process-global state written from
+    GIL-released code races across worlds."""
+    line = mutate(tree, "rlo_tpu/native/rlo_engine.c",
+                  "int64_t rlo_engine_progress_budget(rlo_engine *e, "
+                  "int64_t max_frames)\n{\n    int64_t polled = 0;",
+                  "static int64_t dbg_turns;\n"
+                  "int64_t rlo_engine_progress_budget(rlo_engine *e, "
+                  "int64_t max_frames)\n{\n    int64_t polled = 0;\n"
+                  "    dbg_turns++;")
+    hits = findings_for(tree, "S1")
+    assert any(f.file == "rlo_tpu/native/rlo_engine.c" and
+               "dbg_turns" in f.msg and
+               "rlo_engine_progress_budget" in f.msg
+               for f in hits), hits
+    assert line > 0
+
+
+def test_s1_guarded_by_anchor_suppresses(tree):
+    """The same injected global, declared lock-protected, is
+    sanctioned — and the anchor is consumed, so S0 stays quiet."""
+    mutate(tree, "rlo_tpu/native/rlo_engine.c",
+           "int64_t rlo_engine_progress_budget(rlo_engine *e, "
+           "int64_t max_frames)\n{\n    int64_t polled = 0;",
+           "/* rlo-sentinel: guarded-by(dbg_mu) */\n"
+           "static int64_t dbg_turns;\n"
+           "int64_t rlo_engine_progress_budget(rlo_engine *e, "
+           "int64_t max_frames)\n{\n    int64_t polled = 0;\n"
+           "    dbg_turns++;")
+    hits = run_sentinel(tree)
+    assert not [f for f in hits if f.rule in ("S0", "S1")], hits
+
+
+def test_s2_fires_on_unvalidated_shm_record(tree):
+    """Dropping the shm receive-record validation re-opens the
+    pre-round-15 hole: a scribbled rec.len sizes an allocation and a
+    ring copy unchecked."""
+    mutate(tree, "rlo_tpu/native/rlo_shm.c",
+           "            if (rec.len < 0 ||\n"
+           "                rec.len > cap - (int64_t)sizeof(shm_rec) ||\n"
+           "                rec.size != rec_size(rec.len) ||\n"
+           "                rec.src != src) {\n"
+           "                atomic_store(&w->hdr->abort_flag, 1);\n"
+           "                return RLO_ERR_PROTO;\n"
+           "            }\n",
+           "")
+    hits = findings_for(tree, "S2")
+    assert any(f.file == "rlo_tpu/native/rlo_shm.c" and
+               "rec.len" in f.msg and "length" in f.msg
+               for f in hits), hits
+
+
+def test_s2_fires_on_unguarded_fabric_record_index(tree):
+    """Dropping the _on_record length guard re-opens the magic-only
+    frame crash: wire bytes indexed without a dominating len check."""
+    mutate(tree, "rlo_tpu/serving/fabric.py",
+           "        if len(data) <= len(FABRIC_MAGIC):\n"
+           "            # a magic-only (or truncated) frame: the caller's\n"
+           "            # startswith(FABRIC_MAGIC) proves nothing about the kind\n"
+           "            # byte existing — without this guard a 5-byte payload\n"
+           "            # raises IndexError inside every rank's pump\n"
+           "            # (rlo-sentinel S2, round 15)\n"
+           "            self.metrics.counter(\"fabric.unknown_records\").inc()\n"
+           "            return\n",
+           "")
+    hits = findings_for(tree, "S2")
+    assert any(f.file == "rlo_tpu/serving/fabric.py" and
+               "_on_record" in f.msg and "IndexError" in f.msg
+               for f in hits), hits
+
+
+def test_s3_fires_on_early_return_pool_leak(tree):
+    """Dropping the error-branch rlo_pool_free re-creates the leak
+    shape S3 exists for: acquire, fail a second acquisition, return
+    without releasing the first."""
+    line = mutate(tree, "rlo_tpu/native/rlo_engine.c",
+                  "            if (!stamped) {\n"
+                  "                rlo_pool_free(rt);\n"
+                  "                return RLO_ERR_NOMEM;\n"
+                  "            }",
+                  "            if (!stamped)\n"
+                  "                return RLO_ERR_NOMEM;")
+    hits = findings_for(tree, "S3")
+    assert any(f.file == "rlo_tpu/native/rlo_engine.c" and
+               "'rt'" in f.msg and "eng_isend_frame" in f.msg
+               for f in hits), hits
+    assert line > 0
+
+
+def test_s4_fires_on_done_to_idle_in_c_engine(tree):
+    """A guarded COMPLETED -> INVALID (DONE -> IDLE) reset injected in
+    the C engine alone breaks absorption: a settled verdict may only
+    re-arm to IN_PROGRESS."""
+    mutate(tree, "rlo_tpu/native/rlo_engine.c",
+           "    p->pid = -1;\n    p->vote = 1;\n"
+           "    p->state = RLO_INVALID;\n}",
+           "    p->pid = -1;\n    p->vote = 1;\n"
+           "    if (p->state == RLO_COMPLETED)\n"
+           "        p->state = RLO_INVALID;\n"
+           "    p->state = RLO_INVALID;\n}")
+    hits = findings_for(tree, "S4")
+    assert any(f.file == "rlo_tpu/native/rlo_engine.c" and
+               "COMPLETED -> INVALID" in f.msg and "settled" in f.msg
+               for f in hits), hits
+
+
+def test_s4_fires_on_done_to_idle_in_py_engine(tree):
+    """The same DONE -> IDLE escape injected in the Python engine
+    alone is caught symmetrically."""
+    mutate(tree, "rlo_tpu/engine.py",
+           "        p = self.my_own_proposal\n"
+           "        if p.state == ReqState.IN_PROGRESS and "
+           "p.decision_pending:",
+           "        p = self.my_own_proposal\n"
+           "        if p.state == ReqState.COMPLETED:\n"
+           "            p.state = ReqState.INVALID\n"
+           "        if p.state == ReqState.IN_PROGRESS and "
+           "p.decision_pending:")
+    hits = findings_for(tree, "S4")
+    assert any(f.file == "rlo_tpu/engine.py" and
+               "COMPLETED -> INVALID" in f.msg and "settled" in f.msg
+               for f in hits), hits
+
+
+def test_s4_fires_on_cross_engine_divergence(tree):
+    """Retargeting one engine's guarded completion makes the two
+    engines' induced relations diverge — flagged even though each
+    relation is individually legal."""
+    mutate(tree, "rlo_tpu/engine.py",
+           "                p.state = ReqState.COMPLETED\n"
+           "                p.decision_pending = False",
+           "                p.state = ReqState.IN_PROGRESS\n"
+           "                p.decision_pending = False")
+    hits = findings_for(tree, "S4")
+    assert any("diverge" in f.msg for f in hits), hits
+
+
+# ---------------------------------------------------------------------------
+# CLI contract
+# ---------------------------------------------------------------------------
+
+def test_cli_exit_codes_and_json(tree):
+    mutate(tree, "rlo_tpu/native/rlo_engine.c",
+           "            if (!stamped) {\n"
+           "                rlo_pool_free(rt);\n"
+           "                return RLO_ERR_NOMEM;\n"
+           "            }",
+           "            if (!stamped)\n"
+           "                return RLO_ERR_NOMEM;")
+    proc = subprocess.run(
+        [sys.executable, "-m", "rlo_tpu.tools.rlo_sentinel",
+         "--root", str(tree)],
+        capture_output=True, text=True, cwd=REPO_ROOT)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "S3" in proc.stdout
+    # findings print as file:line: diagnostics (the check.sh contract)
+    assert any(ln.split(":")[0].endswith(".c") and
+               ln.split(":")[1].isdigit()
+               for ln in proc.stdout.splitlines() if "S3" in ln)
+    # machine-readable output carries the same findings
+    proc = subprocess.run(
+        [sys.executable, "-m", "rlo_tpu.tools.rlo_sentinel",
+         "--root", str(tree), "--json"],
+        capture_output=True, text=True, cwd=REPO_ROOT)
+    assert proc.returncode == 1
+    data = json.loads(proc.stdout)
+    assert any(d["rule"] == "S3" and d["line"] > 0 and
+               d["severity"] == "error" for d in data), data
+    # rule selection: a family that is still clean exits 0
+    proc = subprocess.run(
+        [sys.executable, "-m", "rlo_tpu.tools.rlo_sentinel",
+         "--root", str(tree), "--rules", "S4"],
+        capture_output=True, text=True, cwd=REPO_ROOT)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_lint_json_output():
+    """The shared runner gives rlo-lint the same --json face."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "rlo_tpu.tools.rlo_lint", "--json"],
+        capture_output=True, text=True, cwd=REPO_ROOT)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert json.loads(proc.stdout) == []
