@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: sentinel lint static native test check
+.PHONY: sentinel lint prover static native test check
 
 # CFG/dataflow analyzer for the dual engines (docs/DESIGN.md §15):
 # GIL-release safety, wire-input taint, error-path leaks, state-machine
@@ -16,8 +16,14 @@ sentinel:
 lint:
 	$(PY) -m rlo_tpu.tools.rlo_lint
 
-# both analyzers, the full static gate
-static: lint sentinel
+# symbolic collective-schedule verifier + device-layer geometry lint
+# (docs/DESIGN.md §16): permutation validity, delivery/reduction token
+# algebra, Pallas geometry, axis discipline, lane/page constant pins.
+prover:
+	$(PY) -m rlo_tpu.tools.rlo_prover
+
+# all three analyzers, the full static gate
+static: lint sentinel prover
 
 native:
 	$(MAKE) -C rlo_tpu/native

@@ -34,6 +34,17 @@ echo "== rlo-sentinel (CFG/dataflow: GIL safety, taint, leaks, absorption) =="
 # analyzer must stay fast enough to run on every tree, every time.
 timeout 10 python -m rlo_tpu.tools.rlo_sentinel
 
+echo "== rlo-prover (symbolic schedules + device-layer geometry) =="
+# P1 permutation validity + P2 delivery/reduction token algebra for
+# every committed ppermute schedule (n <= 64, every bcast origin),
+# P3 Pallas BlockSpec/index_map geometry under committed shape
+# bindings (hostile scalar-prefetch values included), P4 shard_map
+# axis discipline, P5 128-lane page-contract constant pins —
+# docs/DESIGN.md §16. Also in tier-1 (tests/test_prover.py).
+# Findings print as file:line: diagnostics; --json for CI tooling.
+# The timeout IS the wall budget for the full n <= 64 sweep.
+timeout 10 python -m rlo_tpu.tools.rlo_prover
+
 echo "== pytest =="
 python -m pytest tests/ -q
 

@@ -27,7 +27,7 @@ try:  # pltpu only imports on TPU-enabled builds
 except ImportError:  # pragma: no cover
     pltpu = None
 
-_LANE = 128
+_LANE = 128  # rlo-prover: lane-pinned (XLA lane width; page contract)
 # 2048*128*4B = 1 MB/operand per grid block. Block-shape sweep on the
 # tunneled v5e (2026-07-30, 256 MB fp32 operands, k=256 chained timing,
 # benchmarks/pallas_sweep.py): 2048 rows ~731 GB/s vs 512 rows ~657 and

@@ -120,6 +120,7 @@ R5_FILES = (ENGINE_PY, "rlo_tpu/transport/base.py",
             # check.sh times the sentinel from the OUTSIDE instead
             "rlo_tpu/tools/rlo_lint.py",
             "rlo_tpu/tools/rlo_sentinel.py",
+            "rlo_tpu/tools/rlo_prover.py",
             "rlo_tpu/tools/csrc.py", "rlo_tpu/tools/runner.py",
             "rlo_tpu/tools/perf_gate.py")
 

@@ -1113,12 +1113,23 @@ def _closure(edges: Set[Tuple[str, str]], start: str) -> Set[str]:
 # ---------------------------------------------------------------------------
 
 def rule_s0(ctx: SentinelContext) -> List[Finding]:
-    from rlo_tpu.tools import rlo_lint
-    # run every lint rule purely for its anchor-consumption footprint
+    from rlo_tpu.tools import rlo_lint, rlo_prover
+    # run every lint + prover rule purely for the anchor-consumption
+    # footprint (the shared grammar in runner.ANCHOR_PREFIXES spans
+    # all three analyzers' namespaces)
     try:
         rlo_lint.run_lint(ctx.root, registry=ctx.registry)
     except rlo_lint.LintError as e:
         raise ToolError(f"stale-anchor audit needs a lintable tree: {e}")
+    try:
+        # only the anchor-consuming families — the P1/P2 schedule
+        # sweep and P3 interpretation record nothing in the registry
+        # and check.sh already runs the full prover as its own step
+        rlo_prover.run_prover(ctx.root, rules=rlo_prover.ANCHOR_RULES,
+                              registry=ctx.registry)
+    except rlo_prover.ProverError as e:
+        raise ToolError(f"stale-anchor audit needs a provable tree: "
+                        f"{e}")
     files: Dict[str, Sequence[str]] = {}
     for path, lines in ctx.model.raw_lines.items():
         files[path] = lines
@@ -1126,7 +1137,8 @@ def rule_s0(ctx: SentinelContext) -> List[Finding]:
         files[rel] = lines
     hdr_raw = ctx.header.raw.splitlines()
     files[CORE_H] = hdr_raw
-    for rel in rlo_lint.audit_files(ctx.root):
+    for rel in (rlo_lint.audit_files(ctx.root)
+                + rlo_prover.audit_files(ctx.root)):
         if rel not in files:
             try:
                 files[rel] = (ctx.root / rel).read_text().splitlines()
@@ -1142,9 +1154,10 @@ def _is_real_anchor(line_text: str, path: str) -> bool:
     backtick-quoted spellings are documentation, Python anchors must
     sit in a '#' comment, and the analyzers' own sources (which quote
     anchor spellings as string literals) are out of audit scope."""
+    from rlo_tpu.tools.runner import ANCHOR_PREFIXES
     if path.startswith("rlo_tpu/tools/"):
         return False
-    for prefix in ("rlo-lint:", "rlo-sentinel:"):
+    for prefix in ANCHOR_PREFIXES:
         at = line_text.find(prefix)
         if at < 0:
             continue

@@ -1,18 +1,20 @@
-"""runner — shared driver plumbing for rlo-lint and rlo-sentinel.
+"""runner — shared driver plumbing for rlo-lint, rlo-sentinel and
+rlo-prover.
 
-Both analyzers produce the same artifact: a sorted list of findings,
-each anchored at a file:line, printed as compiler-style diagnostics
-(``file:line: RULE message``) or — with ``--json`` — as a
+All three analyzers produce the same artifact: a sorted list of
+findings, each anchored at a file:line, printed as compiler-style
+diagnostics (``file:line: RULE message``) or — with ``--json`` — as a
 machine-readable array for CI tooling.  Exit codes are shared too:
 0 clean, 1 findings, 2 bad invocation / unparseable inputs.
 
 This module also owns the **anchor-consumption registry** behind the
 stale-anchor audit (rlo-sentinel S0): every time a rule *uses* a
 suppression/annotation anchor (``rlo-lint: paired-with``,
-``rlo-sentinel: guarded-by``, ...), it records the anchor's exact
-(file, line); the audit then scans every analyzed source file for
-anchor spellings and flags the ones no rule consumed — an anchor that
-no longer suppresses anything is rot waiting to mask a real finding.
+``rlo-sentinel: guarded-by``, ``rlo-prover: lane-pinned``, ...), it
+records the anchor's exact (file, line); the audit then scans every
+analyzed source file for anchor spellings and flags the ones no rule
+consumed — an anchor that no longer suppresses anything is rot
+waiting to mask a real finding.
 """
 
 from __future__ import annotations
@@ -47,7 +49,7 @@ class ToolError(RuntimeError):
 #: anchor prefixes the audit scans for.  Anything matching
 #: ``<prefix><word>`` in an analyzed source file is an anchor
 #: occurrence and must be consumed by some rule.
-ANCHOR_PREFIXES = ("rlo-lint:", "rlo-sentinel:")
+ANCHOR_PREFIXES = ("rlo-lint:", "rlo-sentinel:", "rlo-prover:")
 
 
 @dataclass
