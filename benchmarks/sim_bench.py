@@ -86,6 +86,14 @@ def wall(value):
     return {"value": value, "direction": "higher", "tolerance": None}
 
 
+def info(value):
+    """Informational cost counter (lower is better, never gated):
+    recorded so `perf_gate --report` prints its drift every check.sh
+    run — the heal-cost counters live here because the item-4 healing
+    work is SUPPOSED to move them."""
+    return {"value": value, "direction": "lower", "tolerance": None}
+
+
 def bench_fanout(n: int, n_bcast: int = 3, seed: int = 0,
                  scheduler: str = "heap"):
     """Virtual-time bcast fan-out latency at n ranks (protocol-only
@@ -284,10 +292,30 @@ def bench_churn(n: int, rate: float, seed: int = 0,
     if dirty_since is not None:
         dirty_vtime += world.now - dirty_since
     rejoins = sum(engines[r].rejoins for r in live)
+    # heal-cost counters (docs/DESIGN.md §17): the committed baseline
+    # of what the cascade COSTS — the numbers ROADMAP item 4's healing
+    # work (epoch catch-up, joiner heartbeats, incremental re-flood)
+    # must drive down. Informational in BENCH_sim.json: they move
+    # whenever the heal protocol improves, which is the point.
+    heal = {
+        "view_changes": sum(engines[r].view_changes for r in live),
+        "reflood_frames": sum(engines[r].reflood_frames
+                              for r in live),
+        "admission_rounds": sum(engines[r].admission_rounds
+                                for r in live),
+        "epoch_lag_max": max((engines[r].epoch_lag_max
+                              for r in live), default=0),
+        "quar_mid_rejoin": sum(engines[r].quar_mid_rejoin
+                               for r in live),
+        "quar_failed_sender": sum(engines[r].quar_failed_sender
+                                  for r in live),
+        "quar_below_floor": sum(engines[r].quar_below_floor
+                                for r in live),
+    }
     for e in engines:
         e.cleanup()
     return (dirty_vtime, spans, kills, rejoins, world.events,
-            final_ok, wall)
+            final_ok, wall, heal)
 
 
 def bench_storm(n: int, seed: int = 0, correlated: bool = False,
@@ -387,7 +415,7 @@ def main(argv=None) -> int:
                   else CHURN_LEGS_FULL)
     for cn, rate in churn_legs:
         (dirty, spans, kills, rejoins, ev, ok,
-         wdt) = bench_churn(cn, rate)
+         wdt, heal) = bench_churn(cn, rate)
         key = f"churn.n{cn}.r{rate}"
         metrics[f"{key}.dirty_vtime"] = exact(round(dirty, 9))
         metrics[f"{key}.spans"] = exact(spans)
@@ -397,10 +425,15 @@ def main(argv=None) -> int:
         metrics[f"{key}.final_converged"] = exact(int(ok))
         metrics[f"{key}.wall_events_per_sec"] = wall(
             ev / wdt if wdt > 0 else 0.0)
+        # heal-cost counters (docs/DESIGN.md §17): informational, so
+        # the item-4 healing work starts against a committed baseline
+        # of the cascade's cost (perf_gate --report prints the drift)
+        for hk, hv in sorted(heal.items()):
+            metrics[f"{key}.heal.{hk}"] = info(hv)
         print(f"churn n={cn} rate={rate}: {kills} kills/"
               f"{rejoins} rejoins, {dirty:.2f} dirty vsec over "
               f"{spans} spans, converged={ok}, {ev} events, "
-              f"{wdt:.2f}s wall", file=sys.stderr)
+              f"{wdt:.2f}s wall; heal cost {heal}", file=sys.stderr)
     for name, corr in (("iid", False), ("burst", True)):
         (retrans, gave_up, cvt, ev, frac,
          wdt) = bench_storm(STORM_N, correlated=corr)

@@ -86,6 +86,41 @@ echo "== observability smoke (loopback soak -> chrome timeline) =="
 # (flow edges included) — docs/DESIGN.md §7
 JAX_PLATFORMS=cpu python -m rlo_tpu.utils.timeline smoke
 
+echo "== fleet telescope smoke (rlo-top --json, 8-rank sim fleet) =="
+# in-band telemetry plane (docs/DESIGN.md §17): drive a seeded 8-rank
+# sim fleet, converge the Tag.TELEM digests, and self-check the view
+# from rank 0 — every live rank's digest present and fleet rollups
+# equal to the sum of the per-rank captures (exit 1 on drift)
+JAX_PLATFORMS=cpu python -m rlo_tpu.tools.rlo_top --json --ranks 8 \
+    --vtime 12 > /dev/null
+
+echo "== incident watchdog mutation fixture (canary rule must trip) =="
+# a watchdog that never fires is indistinguishable from none: hand a
+# healthy fleet an SLO mutated down to a threshold ordinary traffic
+# crosses, and require the trip plus a complete incident bundle
+# (rule + fleet view + traces) — the check.sh-sized mirror of
+# tests/test_observe.py's churn-cascade leg
+JAX_PLATFORMS=cpu python - <<'EOF'
+import json, os, tempfile
+from rlo_tpu.tools.rlo_top import run_fleet
+d = tempfile.mkdtemp(prefix="rlo_incident.")
+fleet = run_fleet(4, seed=0,
+                  watchdog_rules=["canary: sum(sent_bcast) >= 1"],
+                  incident_dir=d)
+fleet.drive(8.0)
+fleet.converge()
+incs = [i for p in fleet.planes if p.watchdog
+        for i in p.watchdog.incidents]
+assert incs, "mutated canary SLO never tripped"
+first = next(i for i in incs if i.bundle_dir)
+names = sorted(os.listdir(first.bundle_dir))
+assert "incident.json" in names and "fleet_view.json" in names, names
+doc = json.load(open(os.path.join(first.bundle_dir, "incident.json")))
+assert doc["name"] == "canary" and doc["value"] >= 1, doc
+fleet.cleanup()
+print(f"canary tripped at vtime {first.vtime:.1f}; bundle: {names}")
+EOF
+
 echo "== simulator fuzz sweep (25 seeds x 9 chaos scripts) =="
 # fixed-seed deterministic sweep over the partition/restart/burst-loss/
 # mixed scenario scripts — exactly-once, termination, and membership
@@ -113,7 +148,7 @@ fresh_engine=$(mktemp -t rlo_bench_engine.XXXXXX)
 JAX_PLATFORMS=cpu python benchmarks/engine_bench.py --quick \
     --out "$fresh_engine" > /dev/null
 JAX_PLATFORMS=cpu python -m rlo_tpu.tools.perf_gate \
-    --baseline BENCH_engine.json --fresh "$fresh_engine"
+    --baseline BENCH_engine.json --fresh "$fresh_engine" --report
 rm -f "$fresh_engine"
 
 echo "== simulator scaling curve + perf gate (BENCH_sim.json) =="
@@ -127,7 +162,7 @@ fresh_sim=$(mktemp -t rlo_bench_sim.XXXXXX)
 JAX_PLATFORMS=cpu python benchmarks/sim_bench.py \
     --out "$fresh_sim" > /dev/null
 JAX_PLATFORMS=cpu python -m rlo_tpu.tools.perf_gate \
-    --baseline BENCH_sim.json --fresh "$fresh_sim"
+    --baseline BENCH_sim.json --fresh "$fresh_sim" --report
 rm -f "$fresh_sim"
 
 echo "== serving-fabric bench + perf gate (BENCH_fabric.json) =="
@@ -139,7 +174,7 @@ fresh_fabric=$(mktemp -t rlo_bench_fabric.XXXXXX)
 JAX_PLATFORMS=cpu python benchmarks/fabric_bench.py \
     --out "$fresh_fabric" > /dev/null
 JAX_PLATFORMS=cpu python -m rlo_tpu.tools.perf_gate \
-    --baseline BENCH_fabric.json --fresh "$fresh_fabric"
+    --baseline BENCH_fabric.json --fresh "$fresh_fabric" --report
 rm -f "$fresh_fabric"
 
 echo "== workload bench + perf gate (BENCH_workload.json, 10k smoke) =="
@@ -154,7 +189,7 @@ fresh_workload=$(mktemp -t rlo_bench_workload.XXXXXX)
 JAX_PLATFORMS=cpu timeout 420 python benchmarks/workload_bench.py \
     --out "$fresh_workload" > /dev/null
 JAX_PLATFORMS=cpu python -m rlo_tpu.tools.perf_gate \
-    --baseline BENCH_workload.json --fresh "$fresh_workload"
+    --baseline BENCH_workload.json --fresh "$fresh_workload" --report
 rm -f "$fresh_workload"
 
 echo "== serve bench arrival mix + perf gate (BENCH_serve.json) =="
@@ -168,7 +203,7 @@ fresh_serve=$(mktemp -t rlo_bench_serve.XXXXXX)
 JAX_PLATFORMS=cpu python benchmarks/serve_bench.py --tiny \
     --arrivals poisson --paged --out "$fresh_serve"
 JAX_PLATFORMS=cpu python -m rlo_tpu.tools.perf_gate \
-    --baseline BENCH_serve.json --fresh "$fresh_serve"
+    --baseline BENCH_serve.json --fresh "$fresh_serve" --report
 rm -f "$fresh_serve"
 
 echo "== manual-ring validation (8 virtual devices) =="

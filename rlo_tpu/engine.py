@@ -73,8 +73,10 @@ MEMBER_PID_BASE = -2
 #: Tags a user may hand to ``send_direct``: delivered via the
 #: ``_on_other`` pickup route at the destination, never interpreted by
 #: the engine (Tag.SERVE is the serving fabric's load-report channel,
-#: docs/DESIGN.md §11).
-DIRECT_TAGS = frozenset({Tag.SERVE, Tag.P2P, Tag.DATA, Tag.SYS})
+#: docs/DESIGN.md §11; Tag.TELEM carries the telemetry plane's
+#: delta-encoded digests, docs/DESIGN.md §17).
+DIRECT_TAGS = frozenset({Tag.SERVE, Tag.P2P, Tag.DATA, Tag.SYS,
+                         Tag.TELEM})
 
 #: Incarnation-partitioned sequence spaces: a restarted rank's fresh
 #: broadcast seqs and round generations start at ``incarnation << 20``,
@@ -479,6 +481,17 @@ class ProgressEngine:
         self.epoch = 0
         self.epoch_quarantined = 0
         self.rejoins = 0
+        # heal-cost counters (docs/DESIGN.md §17): always-live plain
+        # ints like every other counter — the telemetry plane and the
+        # churn benches read them through metrics(); rlo-lint R2 pins
+        # the schema against the C engine's rlo_stats
+        self.view_changes = 0
+        self.reflood_frames = 0
+        self.epoch_lag_max = 0
+        self.quar_mid_rejoin = 0
+        self.quar_failed_sender = 0
+        self.quar_below_floor = 0
+        self.admission_rounds = 0
         self._epoch_floor: dict = {}    # sender -> min accepted epoch
         self._awaiting_welcome = incarnation > 0
         self._join_last_probe = float("-inf")
@@ -923,6 +936,13 @@ class ProgressEngine:
             "epoch": self.epoch,
             "epoch_quarantined": self.epoch_quarantined,
             "rejoins": self.rejoins,
+            "view_changes": self.view_changes,
+            "reflood_frames": self.reflood_frames,
+            "epoch_lag_max": self.epoch_lag_max,
+            "quar_mid_rejoin": self.quar_mid_rejoin,
+            "quar_failed_sender": self.quar_failed_sender,
+            "quar_below_floor": self.quar_below_floor,
+            "admission_rounds": self.admission_rounds,
         }
         # the phase-profiler schema contract with the C engine: literal
         # keys here, ENGINE_PHASE_KEYS, and the rlo_phase_stats field
@@ -1247,14 +1267,17 @@ class ProgressEngine:
             # must not touch link state, liveness, or app state
             if self._awaiting_welcome:
                 self.epoch_quarantined += 1
+                self.quar_mid_rejoin += 1
                 continue
             if 0 <= src < self.world_size:
                 if src in self.failed:
                     self.epoch_quarantined += 1
+                    self.quar_failed_sender += 1
                     continue
                 floor = self._epoch_floor.get(src)
                 if floor is not None and msg.frame.epoch < floor:
                     self.epoch_quarantined += 1
+                    self.quar_below_floor += 1
                     # stale-sender nack: an ALIVE sender stamping
                     # below our floor missed its welcome — show it
                     # the winning view so it re-petitions (closes the
@@ -1266,6 +1289,13 @@ class ProgressEngine:
                         self._stale_probe_last[src] = now
                         self._send_join_probe(src)
                     continue
+                # heal-cost signal (docs/DESIGN.md §17): how far my
+                # view epoch has outrun the link-epoch stamp of frames
+                # I still ACCEPT — a laggard edge (its last link reset
+                # predates recent view churn) shows up as growing lag
+                lag = self.epoch - msg.frame.epoch
+                if lag > self.epoch_lag_max:
+                    self.epoch_lag_max = lag
             if self.failure_timeout is not None and 0 <= src < \
                     self.world_size:
                 # ANY accepted frame proves the sender alive — under
@@ -1969,6 +1999,7 @@ class ProgressEngine:
         self._alive, self._v = topology.shared_view(
             tuple(r for r in self._alive if r != rank))
         self.group = self._alive
+        self.view_changes += 1
         # every failure adoption bumps the membership epoch; the
         # sender-side floor (if it had rejoined before) is obsolete —
         # the failed-sender quarantine now covers it entirely
@@ -2011,6 +2042,7 @@ class ProgressEngine:
                     # through the ARQ gate: the re-flood gets FRESH
                     # link seqs (it is a new transmission, not a
                     # retransmit); app-level dedup absorbs the copies
+                    self.reflood_frames += 1
                     self._send_raw(dst, tag, raw)
 
     def _discount_failed_voter(self, rank: int) -> None:
@@ -2222,6 +2254,7 @@ class ProgressEngine:
                     deadline = max(
                         4 * (self.failure_timeout or 0.0),
                         20 * self.join_interval)
+                self.admission_rounds += 1
                 self.submit_proposal(payload,
                                      pid=self._member_pid(joiner),
                                      deadline=deadline)
@@ -2364,6 +2397,7 @@ class ProgressEngine:
             tuple(sorted(self._alive + [joiner])))
         self.group = self._alive
         self.rejoins += 1
+        self.view_changes += 1
         TRACER.emit(self.rank, Ev.ADMIT, joiner, self.epoch, inc)
         logger.info("rank %d admitted rank %d (incarnation %d, epoch "
                     "%d); members now %s", self.rank, joiner, inc,
@@ -2482,6 +2516,7 @@ class ProgressEngine:
                 pm.prop_state.state = ReqState.FAILED
                 self.queue_iar_pending.remove(pm)
         self.rejoins += 1
+        self.view_changes += 1
         self._join_last_probe = float("-inf")
         TRACER.emit(self.rank, Ev.ADMIT, self.rank, self.epoch, inc,
                     msg.src)

@@ -115,6 +115,13 @@ class _Stats(C.Structure):
                 ("arq_gave_up", C.c_int64), ("arq_unacked", C.c_int64),
                 ("epoch", C.c_int64), ("epoch_quarantined", C.c_int64),
                 ("rejoins", C.c_int64),
+                ("view_changes", C.c_int64),
+                ("reflood_frames", C.c_int64),
+                ("epoch_lag_max", C.c_int64),
+                ("quar_mid_rejoin", C.c_int64),
+                ("quar_failed_sender", C.c_int64),
+                ("quar_below_floor", C.c_int64),
+                ("admission_rounds", C.c_int64),
                 ("q_wait", C.c_int64), ("q_pickup", C.c_int64),
                 ("q_wait_and_pickup", C.c_int64),
                 ("q_iar_pending", C.c_int64),
@@ -178,6 +185,16 @@ def load() -> C.CDLL:
     sig("rlo_engine_stats", C.c_int, [p, C.POINTER(_Stats)])
     sig("rlo_engine_enable_profiler", C.c_int, [p, C.c_int])
     sig("rlo_engine_phase_stats", C.c_int, [p, C.POINTER(_PhaseStats)])
+    # telemetry digest codec + engine origination (docs/DESIGN.md §17)
+    sig("rlo_telem_encode", C.c_int64,
+        [u8p, C.c_int64, C.c_int32, C.c_int32, C.c_uint32, C.c_int,
+         C.POINTER(C.c_int64), C.POINTER(C.c_int64)])
+    sig("rlo_telem_decode", C.c_int64,
+        [u8p, C.c_int64, C.POINTER(C.c_int32), C.POINTER(C.c_int32),
+         C.POINTER(C.c_uint32), C.POINTER(C.c_int), C.POINTER(C.c_int64),
+         C.POINTER(C.c_uint32)])
+    sig("rlo_telem_key_name", C.c_char_p, [C.c_int])
+    sig("rlo_engine_telem_digest", C.c_int64, [p, C.c_int, u8p, C.c_int64])
     sig("rlo_engine_link_stats", C.c_int,
         [p, C.POINTER(_LinkStats), C.c_int])
     sig("rlo_engine_enable_failure_detection", C.c_int,
@@ -848,6 +865,22 @@ class NativeEngine:
                        for k in ENGINE_PHASE_KEYS},
         }
 
+    def telem_digest(self, full: bool = False) -> bytes:
+        """Originate one telemetry digest from the C engine's own
+        telemetry (docs/DESIGN.md §17): delta-encoded vs the last
+        digest this engine emitted, first call always a full
+        snapshot. The bytes are a Tag.TELEM frame payload the
+        telemetry plane (rlo_tpu/observe/) decodes and merges like
+        any Python-originated digest."""
+        from rlo_tpu.wire import TELEM_HEADER_SIZE, TELEM_KEYS
+        cap = TELEM_HEADER_SIZE + 10 * len(TELEM_KEYS)
+        buf = (C.c_uint8 * cap)()
+        n = self._lib.rlo_engine_telem_digest(
+            self._e, 1 if full else 0, buf, cap)
+        if n < 0:
+            raise RuntimeError(f"rlo_engine_telem_digest failed ({n})")
+        return bytes(buf[:n])
+
     def set_fanout(self, mode: int) -> None:
         """Select the bcast/IAR spanning-tree shape (FANOUT_SKIP_RING /
         FANOUT_FLAT, rlo_core.h RLO_FANOUT_*) — only while the engine
@@ -1036,6 +1069,60 @@ def frame_set_epoch(raw: bytes, epoch: int) -> bytes:
     buf = _buf(raw)
     load().rlo_frame_set_epoch(buf, epoch)
     return bytes(buf)
+
+
+def telem_encode(rank: int, epoch: int, seq: int, values,
+                 prev=None, full: bool = False) -> bytes:
+    """Encode one telemetry digest through the C codec — the byte-
+    parity twin of wire.encode_telem (docs/DESIGN.md §17). ``values``
+    (and optional ``prev``) are sequences in wire.TELEM_KEYS order."""
+    from rlo_tpu.wire import TELEM_HEADER_SIZE, TELEM_KEYS
+    if len(values) != len(TELEM_KEYS):
+        raise ValueError(f"need {len(TELEM_KEYS)} values, got "
+                         f"{len(values)}")
+    lib = load()
+    cap = TELEM_HEADER_SIZE + 10 * len(TELEM_KEYS)
+    buf = (C.c_uint8 * cap)()
+    vals = (C.c_int64 * len(TELEM_KEYS))(*[int(v) for v in values])
+    pv = None
+    if prev is not None and not full:
+        pv = (C.c_int64 * len(TELEM_KEYS))(*[int(v) for v in prev])
+    n = lib.rlo_telem_encode(buf, cap, rank, epoch, seq,
+                             1 if (full or prev is None) else 0,
+                             vals, pv)
+    if n < 0:
+        raise ValueError(f"rlo_telem_encode failed ({n})")
+    return bytes(buf[:n])
+
+
+def telem_decode(raw: bytes):
+    """Decode one digest through the C codec: ``(rank, epoch, seq,
+    full, {key: delta})`` — the parity twin of wire.decode_telem."""
+    from rlo_tpu.wire import TELEM_KEYS
+    lib = load()
+    rank = C.c_int32()
+    epoch = C.c_int32()
+    seq = C.c_uint32()
+    full = C.c_int()
+    deltas = (C.c_int64 * len(TELEM_KEYS))()
+    mask = C.c_uint32()
+    n = lib.rlo_telem_decode(_buf(raw), len(raw), C.byref(rank),
+                             C.byref(epoch), C.byref(seq),
+                             C.byref(full), deltas, C.byref(mask))
+    if n < 0:
+        raise ValueError(f"rlo_telem_decode failed ({n})")
+    out = {k: deltas[i] for i, k in enumerate(TELEM_KEYS)
+           if mask.value & (1 << i)}
+    return rank.value, epoch.value, seq.value, bool(full.value), out
+
+
+def telem_key_names():
+    """The C codec's schema key table (rlo_wire.c k_telem_keys) — the
+    runtime face of the rlo-lint R2 TELEM pin."""
+    from rlo_tpu.wire import TELEM_KEYS
+    lib = load()
+    return tuple(lib.rlo_telem_key_name(i).decode()
+                 for i in range(len(TELEM_KEYS)))
 
 
 def run_judged_proposal(world_size: int, payload: bytes, proposer: int,

@@ -68,6 +68,12 @@ enum rlo_tag {
                              * ARQ-stamped, epoch-gated, delivered
                              * straight to pickup.
                              * rlo-lint: default-route */
+    RLO_TAG_TELEM = 18,     /* in-band telemetry digest (docs/DESIGN.md
+                             * S17): ARQ-stamped, epoch-gated,
+                             * delivered straight to pickup; payload =
+                             * a delta-encoded digest (rlo_telem_encode
+                             * below), consumed by the telemetry plane.
+                             * rlo-lint: default-route */
 };
 
 /* ---- request/proposal states (reference RLO_Req_stat) ---- */
@@ -466,6 +472,14 @@ typedef struct rlo_stats {
      * dropped by the stale-epoch / failed-sender quarantine, and
      * admissions executed (or adopted, joiner side) */
     int64_t epoch, epoch_quarantined, rejoins;
+    /* heal-cost block (docs/DESIGN.md S17): membership-view rebinds,
+     * frames re-sent by the view-change re-flood, the high-water mark
+     * of (my epoch - accepted frame's link epoch), the per-reason
+     * breakdown of epoch_quarantined (the three sum to it), and IAR
+     * admission rounds LAUNCHED here (designated-admitter side) */
+    int64_t view_changes, reflood_frames, epoch_lag_max;
+    int64_t quar_mid_rejoin, quar_failed_sender, quar_below_floor;
+    int64_t admission_rounds;
     int64_t q_wait, q_pickup, q_wait_and_pickup, q_iar_pending;
     rlo_hist bcast_complete, proposal_resolve, pickup_wait;
 } rlo_stats;
@@ -501,6 +515,41 @@ typedef struct rlo_phase_stats {
 
 int rlo_engine_enable_profiler(rlo_engine *e, int on);
 int rlo_engine_phase_stats(const rlo_engine *e, rlo_phase_stats *out);
+
+/* ------------------------------------------------------------------ */
+/* Telemetry digest codec (docs/DESIGN.md S17) — the C half of the    */
+/* byte-pinned layout in rlo_tpu/wire.py (encode_telem/decode_telem): */
+/*   [magic "RLOT\x01":5][flags:u8 bit0=FULL][rank:i32][epoch:i32]    */
+/*   [seq:u32][mask:u32][zigzag-LEB128 delta per set mask bit]        */
+/* Key order = wire.py TELEM_KEYS: the rlo_stats counter fields       */
+/* (ENGINE_COUNTER_KEYS) followed by the extras in k_telem_keys       */
+/* (rlo_wire.c) — rlo-lint R2 pins the three against each other.      */
+/* ------------------------------------------------------------------ */
+#define RLO_TELEM_MAGIC "RLOT\x01"
+#define RLO_TELEM_HEADER_SIZE 22
+#define RLO_TELEM_NKEYS 25
+/* Pure codec (no engine): encode vals[RLO_TELEM_NKEYS] as a digest,
+ * delta vs prev (NULL or full != 0 => full snapshot, deltas vs zero).
+ * Returns bytes written or RLO_ERR_TOO_BIG/RLO_ERR_ARG. */
+int64_t rlo_telem_encode(uint8_t *dst, int64_t cap, int32_t rank,
+                         int32_t epoch, uint32_t seq, int full,
+                         const int64_t *vals, const int64_t *prev);
+/* Decode: fills deltas[RLO_TELEM_NKEYS] (unset keys stay untouched),
+ * *mask says which. Returns bytes consumed or RLO_ERR_ARG. */
+int64_t rlo_telem_decode(const uint8_t *raw, int64_t rawlen,
+                         int32_t *rank, int32_t *epoch, uint32_t *seq,
+                         int *full, int64_t *deltas, uint32_t *mask);
+/* schema key name for mask bit i (NULL out of range) — the parity
+ * surface rlo-lint R2 checks against wire.py's TELEM_KEYS */
+const char *rlo_telem_key_name(int i);
+/* Engine-originated digest: samples the engine's own telemetry
+ * (counters + link rollups + queue depths; the serving page keys are
+ * always 0 in C), delta-encodes vs the last digest THIS call emitted,
+ * bumps the per-engine digest seq, and writes the frame payload into
+ * buf. full != 0 forces a full snapshot (the first call always is).
+ * Returns bytes written or a negative rlo_err. */
+int64_t rlo_engine_telem_digest(rlo_engine *e, int full, uint8_t *buf,
+                                int64_t cap);
 
 /* ------------------------------------------------------------------ */
 /* Engine snapshot/restore (mirror of the checkpoint subsystem's        */

@@ -225,6 +225,18 @@ struct rlo_engine {
     int32_t epoch;          /* monotone membership view counter */
     int64_t quarantined;    /* frames dropped by the epoch quarantine */
     int64_t rejoins_cnt;    /* admissions executed/adopted here */
+    /* heal-cost counters (docs/DESIGN.md S17; mirror of engine.py's
+     * view_changes/reflood_frames/... block — rlo-lint R2 pins the
+     * rlo_stats schema): always-live plain counters */
+    int64_t view_changes;   /* membership-view rebinds */
+    int64_t reflood_frames; /* frames re-sent by the view-change flood */
+    int64_t epoch_lag_max;  /* max(my epoch - accepted frame epoch) */
+    int64_t quar_mid_rejoin, quar_failed_sender, quar_below_floor;
+    int64_t admission_rounds; /* IAR admission rounds launched here */
+    /* telemetry digest origination state (rlo_engine_telem_digest):
+     * last-emitted sample (the delta base) + per-engine digest seq */
+    int64_t telem_prev[RLO_TELEM_NKEYS];
+    uint32_t telem_seq;
     int incarnation;        /* this engine's life at its rank */
     int awaiting_welcome;   /* joiner mode: quarantine + petition */
     int32_t welcome_epoch;  /* epoch of the last ADOPTED welcome */
@@ -1396,8 +1408,10 @@ static void reflood_recent(rlo_engine *e)
         if (!b)
             continue;
         for (int dst = 0; dst < e->ws; dst++)
-            if (dst != e->rank && !e->failed[dst])
+            if (dst != e->rank && !e->failed[dst]) {
+                e->reflood_frames++;
                 eng_isend_frame(e, dst, e->recent_tag[i], b, 0);
+            }
     }
 }
 
@@ -2093,6 +2107,7 @@ static int mark_failed(rlo_engine *e, int rank)
     ring_neighbors(e, &old_succ, &old_pred);
     e->failed[rank] = 1;
     e->n_failed++;
+    e->view_changes++;
     e->hb_seen[rank] = 0;
     /* every failure adoption bumps the membership epoch; the edge's
      * floor/link-epoch bookkeeping is obsolete — the failed-sender
@@ -2339,6 +2354,13 @@ int rlo_engine_stats(const rlo_engine *e, rlo_stats *out)
     out->epoch = e->epoch;
     out->epoch_quarantined = e->quarantined;
     out->rejoins = e->rejoins_cnt;
+    out->view_changes = e->view_changes;
+    out->reflood_frames = e->reflood_frames;
+    out->epoch_lag_max = e->epoch_lag_max;
+    out->quar_mid_rejoin = e->quar_mid_rejoin;
+    out->quar_failed_sender = e->quar_failed_sender;
+    out->quar_below_floor = e->quar_below_floor;
+    out->admission_rounds = e->admission_rounds;
     out->q_wait = e->q_wait.len;
     out->q_pickup = e->q_pickup.len;
     out->q_wait_and_pickup = e->q_wait_pickup.len;
@@ -2357,6 +2379,79 @@ int rlo_engine_link_stats(const rlo_engine *e, rlo_link_stats *out,
     int n = cap < e->ws ? cap : e->ws; /* partial fill, per header */
     memcpy(out, e->links, (size_t)n * sizeof(rlo_link_stats));
     return e->ws;
+}
+
+/* Engine-originated telemetry digest (docs/DESIGN.md S17): sample the
+ * engine's own telemetry into the wire.py TELEM_KEYS order — the
+ * rlo_stats counter block, then the extras (link rollups, worst RTT
+ * EWMA, queue depth, pickup backlog; the serving page keys are always
+ * 0 here — the C engine hosts no paged server) — and delta-encode vs
+ * the last digest THIS engine emitted (rlo_telem_encode). */
+int64_t rlo_engine_telem_digest(rlo_engine *e, int full, uint8_t *buf,
+                                int64_t cap)
+{
+    if (!e || !buf)
+        return RLO_ERR_ARG;
+    int64_t v[RLO_TELEM_NKEYS];
+    int i = 0;
+    v[i++] = e->sent_bcast;
+    v[i++] = e->recved_bcast;
+    v[i++] = e->total_pickup;
+    v[i++] = 0; /* ops_failed: op deadlines are Python-side */
+    v[i++] = e->arq_retx;
+    v[i++] = e->arq_dup;
+    v[i++] = e->arq_gaveup;
+    v[i++] = e->arq_unacked_cnt;
+    v[i++] = e->epoch;
+    v[i++] = e->quarantined;
+    v[i++] = e->rejoins_cnt;
+    v[i++] = e->view_changes;
+    v[i++] = e->reflood_frames;
+    v[i++] = e->epoch_lag_max;
+    v[i++] = e->quar_mid_rejoin;
+    v[i++] = e->quar_failed_sender;
+    v[i++] = e->quar_below_floor;
+    v[i++] = e->admission_rounds;
+    int64_t tx = 0, rx = 0;
+    double rtt = 0.0;
+    for (int r = 0; r < e->ws; r++) {
+        tx += e->links[r].tx_frames;
+        rx += e->links[r].rx_frames;
+        if (e->links[r].rtt_ewma_usec > rtt)
+            rtt = e->links[r].rtt_ewma_usec;
+    }
+    v[i++] = tx;
+    v[i++] = rx;
+    v[i++] = (int64_t)rtt;
+    v[i++] = e->q_wait.len;
+    v[i++] = e->q_pickup.len + e->q_wait_pickup.len;
+    v[i++] = 0; /* pages_in_use */
+    v[i++] = 0; /* pages_free */
+    /* digest seqs are incarnation-partitioned like the broadcast
+     * seqs (mirror of TelemetryPlane): re-base on a bumped life and
+     * re-anchor receivers with a full snapshot; the first digest of
+     * any life is always full */
+    uint32_t base = (uint32_t)e->incarnation << 20;
+    if (e->telem_seq <= base) {
+        if (e->telem_seq < base)
+            e->telem_seq = base;
+        full = 1;
+    }
+    /* full_every=8 cadence (mirror of TelemetryPlane's default): a
+     * receiver that lost a delta parks the entry as `gap` and ONLY a
+     * full snapshot heals it — without the cadence one lost digest
+     * would stale this rank in every fleet view for the rest of the
+     * run (the base is 8-aligned, so the mod matches Python's) */
+    if ((e->telem_seq & 7u) == 0)
+        full = 1;
+    int64_t n = rlo_telem_encode(buf, cap, e->rank, e->epoch,
+                                 e->telem_seq, full, v,
+                                 full ? 0 : e->telem_prev);
+    if (n < 0)
+        return n;
+    memcpy(e->telem_prev, v, sizeof(v));
+    e->telem_seq++;
+    return n;
 }
 
 int rlo_engine_enable_profiler(rlo_engine *e, int on)
@@ -2599,6 +2694,7 @@ static void execute_admission(rlo_engine *e, int joiner, int inc,
     e->failed[joiner] = 0;
     e->n_failed--;
     e->rejoins_cnt++;
+    e->view_changes++;
     rlo_trace_emit(e->rank, RLO_EV_ADMIT, joiner, e->epoch, inc, 0);
     if (!getenv("RLO_QUIET"))
         fprintf(stderr,
@@ -2831,6 +2927,7 @@ static void on_welcome(rlo_engine *e, rlo_msg *m)
         pm = nm;
     }
     e->rejoins_cnt++;
+    e->view_changes++;
     e->join_last = 0;
     rlo_trace_emit(e->rank, RLO_EV_ADMIT, e->rank, e->epoch, inc,
                    m->src);
@@ -2889,6 +2986,7 @@ static void membership_tick(rlo_engine *e)
                 put_le32(payload + RLO_MEMBER_MAGIC_LEN + 4,
                          e->pending_inc[joiner]);
                 put_le32(payload + RLO_MEMBER_MAGIC_LEN + 8, new_epoch);
+                e->admission_rounds++;
                 rlo_submit_proposal(e, payload, sizeof(payload),
                                     member_pid(e, joiner));
                 /* arm the membership watchdog: if the round wedges
@@ -3185,12 +3283,14 @@ int64_t rlo_engine_progress_budget(rlo_engine *e, int64_t max_frames)
          * touch link state, liveness, or app state */
         if (e->awaiting_welcome) {
             e->quarantined++;
+            e->quar_mid_rejoin++;
             msg_free(m);
             continue;
         }
         if (m->src >= 0 && m->src < e->ws) {
             if (e->failed[m->src]) {
                 e->quarantined++;
+                e->quar_failed_sender++;
                 msg_free(m);
                 continue;
             }
@@ -3198,6 +3298,7 @@ int64_t rlo_engine_progress_budget(rlo_engine *e, int64_t max_frames)
                 rlo_frame_epoch(m->frame->data) <
                     e->epoch_floor[m->src]) {
                 e->quarantined++;
+                e->quar_below_floor++;
                 /* stale-sender nack: an ALIVE sender stamping below
                  * our floor missed its one-shot JOIN_WELCOME — show
                  * it the winning view so it re-petitions (no heal
@@ -3211,6 +3312,13 @@ int64_t rlo_engine_progress_budget(rlo_engine *e, int64_t max_frames)
                 msg_free(m);
                 continue;
             }
+            /* heal-cost signal (docs/DESIGN.md S17): how far my view
+             * epoch has outrun the link-epoch stamp of frames I
+             * still ACCEPT (mirror of engine.py's epoch_lag_max) */
+            int64_t lag =
+                (int64_t)e->epoch - rlo_frame_epoch(m->frame->data);
+            if (lag > e->epoch_lag_max)
+                e->epoch_lag_max = lag;
         }
         /* ANY accepted frame proves the sender alive — prevents
          * heartbeat starvation when membership views transiently

@@ -223,6 +223,14 @@ typedef struct rlo_transport_ops {
      * for transports with an injected-latency clock (loopback);
      * real-time transports leave it NULL. */
     int64_t (*advance)(rlo_world *w);
+    /* OPTIONAL test-support direct delivery (rlo_world_inject): place
+     * one frame in dst's inbox bypassing latency and fault injection —
+     * the mirror of LoopbackWorld.inject, where src MAY be a dead rank
+     * (a dead incarnation's stale frame arriving late is the point of
+     * the quarantine scenarios). NULL = rlo_world_inject falls back to
+     * ops->isend, which applies fault injection. */
+    int (*inject)(rlo_world *w, int src, int dst, int comm, int tag,
+                  rlo_blob *frame);
 } rlo_transport_ops;
 
 /* Payload size (bytes) at which the ARQ send gate switches from the

@@ -393,6 +393,38 @@ static int64_t loop_advance(rlo_world *base)
     return moved;
 }
 
+/* Direct delivery for rlo_world_inject: bypasses latency and fault
+ * injection so a DEAD rank can source a stale frame (the quarantine
+ * scenarios) — only a dead destination rejects, its inbox is gone.
+ * Mirrors LoopbackWorld.inject: delivered_cnt counts it, sent_cnt
+ * does not (it never crossed a channel). */
+static int loop_inject(rlo_world *base, int src, int dst, int comm,
+                       int tag, rlo_blob *frame)
+{
+    rlo_loop_world *w = (rlo_loop_world *)base;
+    if (w->dead[dst])
+        return RLO_ERR_ARG;
+    rlo_handle *h = rlo_handle_new_w(base, 1);
+    rlo_wire_node *n =
+        (rlo_wire_node *)rlo_pool_alloc(base, sizeof(*n));
+    if (!h || !n) {
+        rlo_pool_free(h);
+        rlo_pool_free(n);
+        return RLO_ERR_NOMEM;
+    }
+    n->next = 0;
+    n->src = src;
+    n->dst = dst;
+    n->tag = tag;
+    n->comm = comm;
+    n->due = 0;
+    n->handle = h;
+    n->frame = rlo_blob_ref(frame);
+    w->pending++;
+    inbox_push(w, n);
+    return RLO_OK;
+}
+
 static rlo_wire_node *loop_poll(rlo_world *base, int rank, int comm)
 {
     rlo_loop_world *w = (rlo_loop_world *)base;
@@ -432,6 +464,7 @@ static const rlo_transport_ops LOOP_OPS = {
     .revive = loop_revive,
     .free_ = loop_free,
     .advance = loop_advance,
+    .inject = loop_inject,
 };
 
 rlo_world *rlo_world_new(int world_size, int latency, uint64_t seed)

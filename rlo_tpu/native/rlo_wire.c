@@ -91,3 +91,120 @@ void rlo_frame_set_epoch(uint8_t *raw, int32_t epoch)
 {
     put_i32(raw + RLO_EPOCH_OFFSET, epoch);
 }
+
+/* ------------------------------------------------------------------ */
+/* Telemetry digest codec (docs/DESIGN.md S17) — byte-identical to    */
+/* wire.py encode_telem/decode_telem; parity asserted by              */
+/* tests/test_observe.py. Layout:                                     */
+/*   [magic:5][flags:u8][rank:i32][epoch:i32][seq:u32][mask:u32]      */
+/*   [zigzag LEB128 varint per set mask bit, ascending]               */
+/* ------------------------------------------------------------------ */
+
+/* schema key names, mask-bit order: the rlo_stats counter fields
+ * (ENGINE_COUNTER_KEYS) followed by the extras — rlo-lint R2 pins
+ * this table against wire.py's TELEM_KEYS literal */
+static const char *const k_telem_keys[RLO_TELEM_NKEYS] = {
+    "sent_bcast", "recved_bcast", "total_pickup", "ops_failed",
+    "arq_retransmits", "arq_dup_drops", "arq_gave_up", "arq_unacked",
+    "epoch", "epoch_quarantined", "rejoins",
+    "view_changes", "reflood_frames", "epoch_lag_max",
+    "quar_mid_rejoin", "quar_failed_sender", "quar_below_floor",
+    "admission_rounds",
+    "tx_frames", "rx_frames", "rtt_ewma_max_usec",
+    "q_wait", "pickup_backlog", "pages_in_use", "pages_free",
+};
+
+const char *rlo_telem_key_name(int i)
+{
+    if (i < 0 || i >= RLO_TELEM_NKEYS)
+        return 0;
+    return k_telem_keys[i];
+}
+
+static void put_u32(uint8_t *p, uint32_t v)
+{
+    p[0] = (uint8_t)(v & 0xff);
+    p[1] = (uint8_t)((v >> 8) & 0xff);
+    p[2] = (uint8_t)((v >> 16) & 0xff);
+    p[3] = (uint8_t)((v >> 24) & 0xff);
+}
+
+static uint32_t get_u32(const uint8_t *p)
+{
+    return (uint32_t)p[0] | ((uint32_t)p[1] << 8) |
+           ((uint32_t)p[2] << 16) | ((uint32_t)p[3] << 24);
+}
+
+int64_t rlo_telem_encode(uint8_t *dst, int64_t cap, int32_t rank,
+                         int32_t epoch, uint32_t seq, int full,
+                         const int64_t *vals, const int64_t *prev)
+{
+    if (!dst || !vals || cap < RLO_TELEM_HEADER_SIZE)
+        return RLO_ERR_ARG;
+    if (!prev)
+        full = 1;
+    memcpy(dst, RLO_TELEM_MAGIC, 5);
+    dst[5] = full ? 1 : 0;
+    put_i32(dst + 6, rank);
+    put_i32(dst + 10, epoch);
+    put_u32(dst + 14, seq);
+    uint32_t mask = 0;
+    int64_t pos = RLO_TELEM_HEADER_SIZE;
+    for (int i = 0; i < RLO_TELEM_NKEYS; i++) {
+        int64_t d = vals[i] - (full ? 0 : prev[i]);
+        if (!full && d == 0)
+            continue;
+        mask |= (uint32_t)1 << i;
+        /* zigzag, then LEB128 */
+        uint64_t u = ((uint64_t)d << 1) ^ (uint64_t)(d >> 63);
+        do {
+            if (pos >= cap)
+                return RLO_ERR_TOO_BIG;
+            dst[pos++] = (uint8_t)((u & 0x7f) | (u >= 0x80 ? 0x80 : 0));
+            u >>= 7;
+        } while (u);
+    }
+    put_u32(dst + 18, mask);
+    return pos;
+}
+
+int64_t rlo_telem_decode(const uint8_t *raw, int64_t rawlen,
+                         int32_t *rank, int32_t *epoch, uint32_t *seq,
+                         int *full, int64_t *deltas, uint32_t *mask)
+{
+    if (!raw || rawlen < RLO_TELEM_HEADER_SIZE ||
+        memcmp(raw, RLO_TELEM_MAGIC, 5) != 0)
+        return RLO_ERR_ARG;
+    uint32_t m = get_u32(raw + 18);
+    if (RLO_TELEM_NKEYS < 32 && (m >> RLO_TELEM_NKEYS))
+        return RLO_ERR_ARG; /* mask bits beyond the schema */
+    if (rank)
+        *rank = get_i32(raw + 6);
+    if (epoch)
+        *epoch = get_i32(raw + 10);
+    if (seq)
+        *seq = get_u32(raw + 14);
+    if (full)
+        *full = raw[5] & 1;
+    if (mask)
+        *mask = m;
+    int64_t pos = RLO_TELEM_HEADER_SIZE;
+    for (int i = 0; i < RLO_TELEM_NKEYS; i++) {
+        if (!(m & ((uint32_t)1 << i)))
+            continue;
+        uint64_t u = 0;
+        int shift = 0;
+        for (;;) {
+            if (pos >= rawlen || shift > 63)
+                return RLO_ERR_ARG; /* truncated/overlong varint */
+            uint8_t b = raw[pos++];
+            u |= (uint64_t)(b & 0x7f) << shift;
+            shift += 7;
+            if (!(b & 0x80))
+                break;
+        }
+        if (deltas)
+            deltas[i] = (int64_t)(u >> 1) ^ -(int64_t)(u & 1);
+    }
+    return pos;
+}

@@ -121,7 +121,12 @@ int rlo_world_inject(rlo_world *w, int src, int dst, int comm, int tag,
     if (!b)
         return RLO_ERR_NOMEM;
     memcpy(b->data, raw, (size_t)len);
-    int rc = rlo_world_isend(w, src, dst, comm, tag, b, 0);
+    /* prefer the transport's direct-delivery hook: it bypasses latency
+     * and fault injection, so src may be a dead rank (mirror of
+     * LoopbackWorld.inject — the stale-frame quarantine scenarios) */
+    int rc = w->ops->inject
+                 ? w->ops->inject(w, src, dst, comm, tag, b)
+                 : rlo_world_isend(w, src, dst, comm, tag, b, 0);
     rlo_blob_unref(b);
     return rc;
 }

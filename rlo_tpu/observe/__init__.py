@@ -1,0 +1,43 @@
+"""Fleet telescope: the rootless in-band telemetry plane
+(docs/DESIGN.md §17).
+
+The PR-2 flight recorder and the PR-5 phase profiler are strictly
+per-rank: every engine can answer "what happened HERE", but nobody in
+the fleet can see the fleet. This package closes that gap with the
+paper's own machinery — no scrape endpoint, no metrics sidecar, no
+designated collector rank:
+
+  - :class:`TelemetryPlane` — each rank periodically emits a compact
+    delta-encoded digest of its engine telemetry (``wire.encode_telem``,
+    byte-pinned so the C engine originates the identical bytes) on
+    ``Tag.TELEM`` and store-and-forwards foreign digests along the
+    existing skip-ring overlay, so ANY rank converges on an
+    eventually-consistent :class:`FleetView`.
+  - :class:`FleetView` — per-rank last-digest values plus fleet
+    rollups, staleness-stamped by membership epoch and digest age.
+  - :class:`Watchdog` / :class:`Rule` — declarative SLO rules
+    (retransmit storms, epoch-lag ceilings, rejoin-cascade rates,
+    pickup-backlog growth) evaluated against the fleet view; a
+    tripped rule dumps a self-contained incident bundle (per-rank
+    trace JSONL, merged Chrome trace, metrics snapshots, the fleet
+    view, and the seeded replay recipe).
+
+Everything here is OFF by default and lives entirely outside the
+engine hot path: an engine without an attached plane runs zero new
+code beyond the always-live plain-int heal-cost counters
+(docs/DESIGN.md §7 overhead contract), and the plane itself draws
+time only from the engine's injectable clock, so whole instrumented
+fleets replay bit-for-bit inside the deterministic simulator.
+"""
+
+from rlo_tpu.observe.telemetry import (FleetView, TelemetryPlane,
+                                       merge_counter_dicts,
+                                       merge_histograms)
+from rlo_tpu.observe.watchdog import (DEFAULT_RULES, Incident, Rule,
+                                      Watchdog, parse_rule)
+
+__all__ = [
+    "FleetView", "TelemetryPlane", "merge_counter_dicts",
+    "merge_histograms", "Rule", "Watchdog", "Incident", "DEFAULT_RULES",
+    "parse_rule",
+]

@@ -43,7 +43,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 from rlo_tpu.engine import (INCARNATION_SHIFT, ProgressEngine, ReqState,
                             UserMsg)
 from rlo_tpu.serving.placement import (Placement, owner_of, pick_owner)
-from rlo_tpu.utils.metrics import HIST_BUCKETS, Registry, hist_summary
+from rlo_tpu.utils.metrics import Registry, hist_summary
 from rlo_tpu.wire import Tag
 
 #: Prefix marking a payload as a serving-fabric record (the serving
@@ -200,6 +200,9 @@ class DecodeFabric:
         self._next_place = float("-inf")
         self._my_place_pid = FABRIC_PID_BASE + self.rank
         self._proposed: Optional[Placement] = None
+        #: attached telemetry plane (rlo_tpu/observe/, docs/DESIGN.md
+        #: §17): pump() feeds it Tag.TELEM pickups and ticks it
+        self.telemetry = None
         #: the agreed slot-ownership record; construction-time members
         #: (identical everywhere) seed it, IAR rounds replace it
         self.placement = Placement(
@@ -294,6 +297,26 @@ class DecodeFabric:
     # ------------------------------------------------------------------
     # the pump (the fabric's progress turn)
     # ------------------------------------------------------------------
+    def offer_record(self, m: UserMsg) -> bool:
+        """Feed one engine pickup to the fabric's record dispatch;
+        True when it was a fabric record or a placement-round outcome
+        (consumed), False for the embedding app's traffic. The one
+        classification ``pump()`` uses — harnesses that drain pickups
+        themselves (FleetHarness.converge) route through this so
+        records landing outside a pump are never dropped."""
+        if m.type in (int(Tag.BCAST), int(Tag.SERVE)) and \
+                m.data.startswith(FABRIC_MAGIC):
+            self._on_record(m.data, m.origin)
+            return True
+        if m.type in (int(Tag.IAR_DECISION), int(Tag.ABORT)) and \
+                FABRIC_PID_BASE <= m.pid < \
+                FABRIC_PID_BASE + self.engine.world_size:
+            # placement-round outcome: _action already adopted the
+            # decision (an abort just frees the pid for the retry
+            # the staleness check in pump schedules)
+            return True
+        return False
+
     def pump(self) -> List[UserMsg]:
         """One fabric turn: drain engine pickups, reconcile placement
         and ownership, run a decode round and the load gossip when
@@ -306,22 +329,15 @@ class DecodeFabric:
             return []
         unhandled: List[UserMsg] = []
         while (m := eng.pickup_next()) is not None:
-            if m.type in (int(Tag.BCAST), int(Tag.SERVE)) and \
-                    m.data.startswith(FABRIC_MAGIC):
-                self._on_record(m.data, m.origin)
-            elif m.type in (int(Tag.IAR_DECISION), int(Tag.ABORT)) \
-                    and FABRIC_PID_BASE <= m.pid < \
-                    FABRIC_PID_BASE + eng.world_size:
-                # placement-round outcome: _action already adopted the
-                # decision (an abort just frees the pid for the retry
-                # the staleness check below schedules)
+            if self.telemetry is not None and self.telemetry.offer(m):
+                continue  # a Tag.TELEM digest: the plane consumed it
+            if self.offer_record(m):
                 continue
-            else:
-                # everything else — the embedding app's traffic,
-                # INCLUDING Tag.FAILURE/foreign-abort notices (the
-                # fabric reacts off the engine's adopted view, but the
-                # app may be watching rank deaths through pickup)
-                unhandled.append(m)
+            # everything else — the embedding app's traffic,
+            # INCLUDING Tag.FAILURE/foreign-abort notices (the
+            # fabric reacts off the engine's adopted view, but the
+            # app may be watching rank deaths through pickup)
+            unhandled.append(m)
 
         # proposer-side adoption: the engine fires action_cb on relays
         # only; the proposer adopts its own approved record here
@@ -384,6 +400,8 @@ class DecodeFabric:
         if self.done_ttl is not None:
             self._evict_done(now)
         self.metrics.gauge("fabric.pending").set(len(self.requests))
+        if self.telemetry is not None:
+            self.telemetry.tick()
         return unhandled
 
     def _evict_done(self, now: float) -> None:
@@ -553,6 +571,29 @@ class DecodeFabric:
     # ------------------------------------------------------------------
     # telemetry
     # ------------------------------------------------------------------
+    def attach_telemetry(self, plane) -> None:
+        """Join the in-band telemetry plane (docs/DESIGN.md §17):
+        ``pump()`` feeds the plane its Tag.TELEM pickups and ticks it
+        once per turn, and the plane's digest extras come from this
+        fabric's paged-pool occupancy (``telemetry_extra``) unless the
+        plane already has an extras source."""
+        if plane.engine is not self.engine:
+            raise ValueError("telemetry plane must share this "
+                             "fabric's engine")
+        if plane.extra is None:
+            plane.extra = self.telemetry_extra
+        self.telemetry = plane
+
+    def telemetry_extra(self) -> dict:
+        """Digest extras for the TELEM schema's serving keys: the
+        paged pool's occupancy, when this rank's backend has one
+        (zeros otherwise — the schema is fixed fleet-wide)."""
+        pages = self.backend.stats().get("pages")
+        if not isinstance(pages, dict):
+            return {"pages_in_use": 0, "pages_free": 0}
+        return {"pages_in_use": int(pages.get("pages_in_use", 0)),
+                "pages_free": int(pages.get("pages_free", 0))}
+
     def stats(self) -> dict:
         """Per-rank fabric snapshot: counters/gauges verbatim,
         histograms as percentile summaries (the DecodeServer.stats()
@@ -571,33 +612,37 @@ class DecodeFabric:
         return snap
 
 
-def fleet_stats(fabrics: Sequence[DecodeFabric]) -> dict:
+def fleet_stats(fabrics: Sequence[DecodeFabric],
+                view=None) -> dict:
     """Fleet-level rollup over live fabric nodes: summed counters, a
     merged end-to-end latency summary (submit -> last token, re-queue
     and fail-over time included — the first-class fail-over-cost
-    metric), and the per-rank snapshots."""
-    ranks = {str(f.rank): f.stats() for f in fabrics}
-    counters: Dict[str, int] = {}
-    merged = {"count": 0, "sum": 0.0, "min": 0.0, "max": 0.0,
-              "buckets": None}
-    for f in fabrics:
-        snap = f.metrics.snapshot()
-        for k, v in snap["counters"].items():
-            counters[k] = counters.get(k, 0) + v
-        h = snap["histograms"].get("fabric.e2e_usec")
-        if h and h["count"]:
-            if merged["count"] == 0:
-                merged["min"], merged["max"] = h["min"], h["max"]
-                merged["buckets"] = list(h["buckets"])
-            else:
-                merged["min"] = min(merged["min"], h["min"])
-                merged["max"] = max(merged["max"], h["max"])
-                for i, b in enumerate(h["buckets"]):
-                    merged["buckets"][i] += b
-            merged["count"] += h["count"]
-            merged["sum"] += h["sum"]
-    if merged["buckets"] is None:
-        merged["buckets"] = [0] * HIST_BUCKETS
-    return {"counters": counters,
-            "e2e_usec": hist_summary(merged),
-            "ranks": ranks}
+    metric), and the per-rank snapshots.
+
+    Since round 17 this is a CONSUMER of the observe layer's merge
+    helpers (rlo_tpu/observe/telemetry.py) rather than a bespoke
+    merge, and it composes with the in-band telemetry plane: pass a
+    :class:`~rlo_tpu.observe.FleetView` (or any of the attached
+    planes' ``.view``) as ``view`` and the rollup gains a
+    ``fleet_view`` block — the ENGINE-level fleet picture (frames,
+    retransmits, heal-cost counters, page occupancy) as seen from one
+    rank, digest coverage and staleness included."""
+    from rlo_tpu.observe.telemetry import (merge_counter_dicts,
+                                           merge_histograms)
+    snaps = [f.metrics.snapshot() for f in fabrics]
+    out = {
+        "counters": merge_counter_dicts(
+            [s["counters"] for s in snaps]),
+        "e2e_usec": merge_histograms(
+            [s["histograms"].get("fabric.e2e_usec") for s in snaps]),
+        "ranks": {str(f.rank): f.stats() for f in fabrics},
+    }
+    if view is None and fabrics:
+        plane = fabrics[0].telemetry
+        if plane is not None:
+            view = plane.view
+    if view is not None:
+        clock = fabrics[0].clock if fabrics else (lambda: 0.0)
+        epoch = fabrics[0].engine.epoch if fabrics else None
+        out["fleet_view"] = view.snapshot(clock(), self_epoch=epoch)
+    return out

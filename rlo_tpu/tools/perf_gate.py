@@ -155,6 +155,35 @@ def run_gate(baseline: Dict, fresh: Dict) -> List[str]:
     return findings
 
 
+def report_informational(baseline: Dict, fresh: Dict) -> List[str]:
+    """Drift-table lines for the INFORMATIONAL metrics (tolerance
+    null, non-exact direction): recorded-but-never-gated numbers —
+    wall throughputs, ``arq_scan_*`` observations, heal-cost counters
+    — printed so they get eyeballed on every check.sh run instead of
+    drifting silently until someone regenerates a baseline."""
+    lines: List[str] = []
+    fresh_metrics = fresh.get("metrics", {})
+    for name, base in sorted(baseline["metrics"].items()):
+        if base.get("tolerance") is not None or \
+                base.get("direction") == "exact":
+            continue
+        bval = base.get("value")
+        entry = fresh_metrics.get(name)
+        fval = entry.get("value") if isinstance(entry, dict) else None
+        if isinstance(bval, (int, float)) and \
+                isinstance(fval, (int, float)) and bval:
+            drift = f"{(fval - bval) / abs(bval) * 100.0:+8.1f}%"
+        else:
+            drift = "       —"
+        lines.append(f"  {name:<44} {bval!r:>14} -> {fval!r:>14} "
+                     f"{drift}")
+    if lines:
+        lines.insert(0, f"informational drift "
+                        f"({baseline['suite']}, {len(lines)} "
+                        f"ungated metrics; baseline -> fresh):")
+    return lines
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m rlo_tpu.tools.perf_gate",
@@ -165,6 +194,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                          " BENCH_sim.json)")
     ap.add_argument("--fresh", required=True, type=Path,
                     help="freshly produced benchmark JSON")
+    ap.add_argument("--report", action="store_true",
+                    help="also print the drift table for "
+                         "informational (tolerance-null) metrics")
     ap.add_argument("-q", "--quiet", action="store_true",
                     help="findings only, no summary line")
     args = ap.parse_args(argv)
@@ -175,6 +207,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     except GateError as e:
         print(f"perf-gate: error: {e}", file=sys.stderr)
         return 2
+    if args.report:
+        for line in report_informational(baseline, fresh):
+            print(line)
     for msg in findings:
         print(msg)
     if not args.quiet:

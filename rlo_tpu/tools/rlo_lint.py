@@ -114,6 +114,14 @@ R5_FILES = (ENGINE_PY, "rlo_tpu/transport/base.py",
             "rlo_tpu/workloads/__init__.py",
             "rlo_tpu/workloads/traces.py",
             "rlo_tpu/workloads/weather.py",
+            # the telemetry plane + watchdog (round 17): digests pace
+            # on the engine clock and watchdog trips are part of the
+            # deterministic schedule — a wall-clock/module-random
+            # dependency would unpin every instrumented replay
+            "rlo_tpu/observe/__init__.py",
+            "rlo_tpu/observe/telemetry.py",
+            "rlo_tpu/observe/watchdog.py",
+            "rlo_tpu/tools/rlo_top.py",
             # the analyzers themselves (round 15): a wall-clock or
             # module-random dependency in rlo-lint/rlo-sentinel would
             # make "clean tree" depend on when/where the tool ran —
@@ -308,7 +316,8 @@ STRUCT_MIRRORS = {
 
 _SCALAR_CTYPES = {
     "int": "c_int", "int32_t": "c_int32", "int64_t": "c_int64",
-    "uint8_t": "c_uint8", "uint64_t": "c_uint64", "long": "c_long",
+    "uint8_t": "c_uint8", "uint32_t": "c_uint32",
+    "uint64_t": "c_uint64", "long": "c_long",
     "float": "c_float", "double": "c_double", "char": "c_char",
 }
 
@@ -670,6 +679,134 @@ def rule_r2(ctx: "LintContext") -> List[Finding]:
                 "R2", metrics.path, pline,
                 f"phase {key!r} has no _phobs() observation site in "
                 f"{ctx.engine.path}"))
+
+    f.extend(_r2_telem(ctx, keys))
+    return f
+
+
+def _consume_pair_anchor(ctx: "LintContext", findings: List[Finding],
+                         mod: PyModule, line: int,
+                         symbol: str) -> None:
+    """R2's paired-with anchor consumption (mirror of R1's
+    _require_anchor, reporting under R2)."""
+    at = find_anchor(mod.lines, line, PAIRED_ANCHOR)
+    if at is None:
+        findings.append(Finding(
+            "R2", mod.path, line,
+            f"paired constant {symbol} lacks a "
+            f"'# {PAIRED_ANCHOR} <file:symbol>' anchor comment"))
+    else:
+        ctx.registry.consume(mod.path, at)
+
+
+def _r2_telem(ctx: "LintContext",
+              counter_keys: Tuple[str, ...]) -> List[Finding]:
+    """Telemetry-digest schema parity (docs/DESIGN.md §17):
+    wire.py's TELEM_KEYS (= ENGINE_COUNTER_KEYS + TELEM_EXTRA_KEYS)
+    <-> the C codec's k_telem_keys name table (rlo_wire.c) <->
+    RLO_TELEM_NKEYS, plus the byte-layout constants (magic bytes,
+    header size). A digest key added on one side only would decode
+    into the wrong slots fleet-wide — this is the same class of drift
+    R2's counter check pins, one layer up."""
+    f: List[Finding] = []
+    wire, hdr = ctx.wire, ctx.header
+    assigns = py_top_assigns(wire)
+
+    # TELEM_EXTRA_KEYS: literal tuple + anchor
+    if "TELEM_EXTRA_KEYS" not in assigns:
+        return [Finding("R2", wire.path, 1,
+                        "TELEM_EXTRA_KEYS not defined")]
+    enode, eline = assigns["TELEM_EXTRA_KEYS"]
+    _consume_pair_anchor(ctx, f, wire, eline, "TELEM_EXTRA_KEYS")
+    if not isinstance(enode, (ast.Tuple, ast.List)):
+        return f + [Finding("R2", wire.path, eline,
+                            "TELEM_EXTRA_KEYS is not a literal tuple")]
+    extras = tuple(e.value for e in enode.elts
+                   if isinstance(e, ast.Constant))
+
+    # TELEM_KEYS must be exactly the concatenation of the two schema
+    # tuples (so the counter block can never be reordered or elided)
+    if "TELEM_KEYS" not in assigns:
+        f.append(Finding("R2", wire.path, 1, "TELEM_KEYS not defined"))
+        return f
+    knode, kline = assigns["TELEM_KEYS"]
+    if not (isinstance(knode, ast.BinOp) and
+            isinstance(knode.op, ast.Add) and
+            isinstance(knode.left, ast.Name) and
+            knode.left.id == "ENGINE_COUNTER_KEYS" and
+            isinstance(knode.right, ast.Name) and
+            knode.right.id == "TELEM_EXTRA_KEYS"):
+        f.append(Finding(
+            "R2", wire.path, kline,
+            "TELEM_KEYS must be ENGINE_COUNTER_KEYS + "
+            "TELEM_EXTRA_KEYS (the digest schema embeds the counter "
+            "schema verbatim)"))
+    full = tuple(counter_keys) + extras
+    if len(full) > 32:
+        f.append(Finding(
+            "R2", wire.path, kline,
+            f"TELEM schema has {len(full)} keys; the digest mask is "
+            f"a u32 (max 32)"))
+
+    # RLO_TELEM_NKEYS + header size + magic bytes
+    try:
+        nkeys = hdr.macro("RLO_TELEM_NKEYS")
+    except csrc.CParseError:
+        f.append(Finding("R2", hdr.path, 1,
+                         "RLO_TELEM_NKEYS not defined"))
+        return f
+    if nkeys != len(full):
+        f.append(Finding(
+            "R2", hdr.path, hdr.macros["RLO_TELEM_NKEYS"][1],
+            f"RLO_TELEM_NKEYS = {nkeys} but the wire.py schema has "
+            f"{len(full)} keys"))
+    if "TELEM_HEADER_SIZE" in assigns:
+        hnode, hline = assigns["TELEM_HEADER_SIZE"]
+        _consume_pair_anchor(ctx, f, wire, hline, "TELEM_HEADER_SIZE")
+        _check_pair(f, "R2", wire.path, hline, "TELEM_HEADER_SIZE",
+                    _const_int(hnode), hdr.path,
+                    "RLO_TELEM_HEADER_SIZE",
+                    hdr.macro("RLO_TELEM_HEADER_SIZE"))
+    else:
+        f.append(Finding("R2", wire.path, 1,
+                         "TELEM_HEADER_SIZE not defined"))
+    if "TELEM_MAGIC" in assigns:
+        mnode, mline = assigns["TELEM_MAGIC"]
+        _consume_pair_anchor(ctx, f, wire, mline, "TELEM_MAGIC")
+        py_magic = (mnode.value if isinstance(mnode, ast.Constant)
+                    and isinstance(mnode.value, bytes) else None)
+        cm = re.search(r'#define\s+RLO_TELEM_MAGIC\s+'
+                       r'"((?:[^"\\]|\\.)*)"', hdr.raw)
+        if cm is None:
+            f.append(Finding("R2", hdr.path, 1,
+                             "RLO_TELEM_MAGIC string macro not found"))
+        else:
+            c_magic = cm.group(1).encode().decode(
+                "unicode_escape").encode("latin1")
+            if py_magic != c_magic:
+                f.append(Finding(
+                    "R2", wire.path, mline,
+                    f"TELEM_MAGIC {py_magic!r} != RLO_TELEM_MAGIC "
+                    f"{c_magic!r} ({hdr.path})"))
+    else:
+        f.append(Finding("R2", wire.path, 1,
+                         "TELEM_MAGIC not defined"))
+
+    # the C codec's key-name table (rlo_wire.c) must list the SAME
+    # keys in the SAME mask-bit order
+    km = re.search(r"k_telem_keys\s*\[\s*RLO_TELEM_NKEYS\s*\]\s*=\s*"
+                   r"\{(.*?)\}\s*;", ctx.wire_c_stripped, re.S)
+    if km is None:
+        f.append(Finding(
+            "R2", WIRE_C, 1,
+            "k_telem_keys[RLO_TELEM_NKEYS] name table not found"))
+        return f
+    c_keys = tuple(re.findall(r'"([^"]*)"', km.group(1)))
+    if c_keys != full:
+        f.append(Finding(
+            "R2", WIRE_C, _line_of(ctx.wire_c_stripped, km.start()),
+            f"k_telem_keys {c_keys} != wire.py TELEM schema {full} — "
+            f"the mask-bit order IS the decode contract"))
     return f
 
 

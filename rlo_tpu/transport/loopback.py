@@ -191,6 +191,18 @@ class LoopbackWorld:
         with self.lock:
             self._dups[(src, dst)] = self._dups.get((src, dst), 0) + count
 
+    def inject(self, src: int, dst: int, tag: int, raw: bytes) -> None:
+        """Test support: deliver one raw frame as if ``src`` sent it —
+        the duplicate/stale-frame scenario hook (mirror of the C
+        world's rlo_world_inject). Bypasses latency and fault
+        injection; ``src`` may be a dead rank (that is the point: a
+        dead incarnation's stale frame arriving late)."""
+        if not 0 <= dst < self.world_size or dst in self.dead:
+            raise ValueError(f"bad destination rank {dst}")
+        with self.lock:
+            self.inboxes[dst].append((src, tag, bytes(raw)))
+            self.delivered_cnt += 1
+
     def set_burst_loss(self, p: float, burst_len: int = 3) -> None:
         """Seeded random burst loss on every channel: each sent message
         starts a loss burst with probability ``p``, silently dropping

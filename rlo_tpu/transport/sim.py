@@ -506,10 +506,35 @@ class Scenario:
                  arq_rto: float = 1.5, arq_max_retries: int = 6,
                  op_deadline: Optional[float] = 60.0,
                  check_delivery: bool = True,
-                 weather=None, scheduler: str = "heap"):
+                 weather=None, scheduler: str = "heap",
+                 telemetry: bool = False,
+                 telemetry_interval: float = 2.0,
+                 watchdog_rules: Optional[Sequence] = None,
+                 incident_dir: Optional[str] = None):
         self.ws = world_size
         self.seed = seed
         self.duration = duration
+        # in-band telemetry plane (docs/DESIGN.md §17): one
+        # TelemetryPlane per engine, pumped in the drive loop — the
+        # planes draw time only from the world clock, so instrumented
+        # runs replay bit-for-bit like uninstrumented ones (digest
+        # frames ARE part of the schedule, so the digests' presence is
+        # itself replay-pinned); violation artifacts then include the
+        # fleet view and the result carries the rollups
+        self.telemetry = telemetry
+        self.telemetry_interval = telemetry_interval
+        # incident watchdog (docs/DESIGN.md §17): rides RANK 0's
+        # telemetry plane (keep rank 0 alive — churn_script's
+        # immortal= — for uninterrupted coverage); normalized to
+        # grammar strings so the replay recipe reproduces the rules
+        if watchdog_rules is not None and not telemetry:
+            raise ValueError("watchdog_rules needs telemetry=True")
+        if watchdog_rules is not None:
+            from rlo_tpu.observe import parse_rule
+            watchdog_rules = [parse_rule(r).spec()
+                              for r in watchdog_rules]
+        self.watchdog_rules = watchdog_rules
+        self.incident_dir = incident_dir
         # a weather profile (rlo_tpu/workloads/weather.py) contributes
         # its scripted fault steps (churn kills/rejoins, loss windows)
         # plus the delay_fn/drop_fn hooks handed to the SimWorld; its
@@ -527,11 +552,30 @@ class Scenario:
         self.check_delivery = check_delivery
 
     def _replay_recipe(self) -> str:
+        import inspect
         extra = ""
+        # non-default engine knobs and property toggles are part of
+        # the schedule: a recipe that omits them replays a DIFFERENT
+        # scenario (the incident bundle's replay must be
+        # self-contained). Defaults come from the __init__ signature
+        # itself so this can never drift from it.
+        params = inspect.signature(type(self).__init__).parameters
+        for k in self.engine_kw:
+            if self.engine_kw[k] != params[k].default:
+                extra += f", {k}={self.engine_kw[k]!r}"
+        if self.check_delivery != params["check_delivery"].default:
+            extra += f", check_delivery={self.check_delivery!r}"
         if self.weather is not None:
             extra += f", weather={self.weather!r}"
         if self.scheduler != "heap":
             extra += f", scheduler={self.scheduler!r}"
+        if self.telemetry:
+            extra += (f", telemetry=True, telemetry_interval="
+                      f"{self.telemetry_interval}")
+        if self.watchdog_rules is not None:
+            # incident_dir is deliberately omitted: trips replay
+            # identically without writing bundles
+            extra += f", watchdog_rules={self.watchdog_rules!r}"
         return (f"Scenario(world_size={self.ws}, seed={self.seed}, "
                 f"duration={self.duration}, "
                 f"script={self.script_arg!r}, "
@@ -579,6 +623,14 @@ class Scenario:
                                 for e in engines
                                 if e.rank not in
                                 (world.dead if world else ())},
+                    # the fleet view at failure, when a telemetry
+                    # plane was riding the run (docs/DESIGN.md §17)
+                    "fleet_view": (next(
+                        (p.view.snapshot(world.now if world else 0.0)
+                         for r, p in sorted(
+                             getattr(self, "_planes", {}).items())
+                         if world is None or r not in world.dead),
+                        None)),
                 }, fh, indent=1)
         except OSError:
             return None
@@ -600,6 +652,27 @@ class Scenario:
             for r in range(self.ws)]
         # exposed for the violation artifact dump (_fail)
         self._world, self._engines = world, engines
+        planes = {}
+        if self.telemetry:
+            from rlo_tpu.observe import TelemetryPlane
+            # per-link accounting on: the digest's tx/rx/RTT extras
+            # read the metrics registry — without this every fleet
+            # view would show a fleet that apparently sent no frames
+            for e in engines:
+                e.enable_metrics()
+            planes = {r: TelemetryPlane(
+                engines[r], interval=self.telemetry_interval)
+                for r in range(self.ws)}
+        self._planes = planes
+        self._watchdog = None
+        if planes and self.watchdog_rules is not None:
+            from rlo_tpu.observe import Watchdog
+            # engines passed by reference: restarts replace entries in
+            # place, so bundles snapshot the CURRENT fleet
+            self._watchdog = Watchdog(
+                planes[0], self.watchdog_rules,
+                incident_dir=self.incident_dir,
+                replay=self._replay_recipe, engines=engines)
         incarnation = [0] * self.ws
         live = set(range(self.ws))
         ever_disturbed: set = set()   # ranks killed/restarted at any point
@@ -649,6 +722,21 @@ class Scenario:
                         world.transport(r), manager=mgr,
                         clock=world.clock,
                         incarnation=incarnation[r], **self.engine_kw)
+                    if planes:
+                        # the restarted life gets a fresh plane (its
+                        # digest seq space is incarnation-partitioned
+                        # like the engine's broadcast seqs)
+                        from rlo_tpu.observe import TelemetryPlane
+                        engines[r].enable_metrics()
+                        planes[r] = TelemetryPlane(
+                            engines[r],
+                            interval=self.telemetry_interval)
+                        if r == 0 and self._watchdog is not None:
+                            # the watchdog follows rank 0's plane
+                            # across restarts (trips/cooldowns
+                            # survive; rate histories reset — the
+                            # fresh view rebuilding is not a surge)
+                            self._watchdog.rebind(planes[0])
                     live.add(r)
                 elif act == "bcast":
                     r = args[0]
@@ -671,10 +759,17 @@ class Scenario:
             world.step()
             mgr.progress_all()
             for r in list(live):
-                e = engines[r]
-                while (m := e.pickup_next()) is not None:
-                    if m.type == int(Tag.BCAST):
-                        delivered[r].append((m.origin, m.data))
+                if planes:
+                    # the plane owns the pickup loop: digests are
+                    # consumed, everything else comes back out
+                    for m in planes[r].pump():
+                        if m.type == int(Tag.BCAST):
+                            delivered[r].append((m.origin, m.data))
+                else:
+                    e = engines[r]
+                    while (m := e.pickup_next()) is not None:
+                        if m.type == int(Tag.BCAST):
+                            delivered[r].append((m.origin, m.data))
 
         # -- property checks ------------------------------------------
         for r in range(self.ws):
@@ -712,7 +807,7 @@ class Scenario:
                                 f"from rank {origin} (clean-window "
                                 f"broadcast)")
         views = {r: tuple(sorted(engines[r]._alive)) for r in live}
-        return {
+        out = {
             "seed": self.seed,
             "digest": world.schedule_digest(),
             "events": world.events,
@@ -723,6 +818,18 @@ class Scenario:
             "quarantined": sum(engines[r].epoch_quarantined
                                for r in live),
         }
+        if planes and live:
+            # the fleet as the lowest live rank's plane sees it —
+            # the eventually-consistent view any rank can serve
+            viewer = min(live)
+            out["fleet_view"] = planes[viewer].view.snapshot(
+                world.now, self_epoch=engines[viewer].epoch)
+            out["telemetry"] = {r: planes[r].stats()
+                                for r in sorted(live)}
+        if self._watchdog is not None:
+            out["incidents"] = [i.to_dict()
+                                for i in self._watchdog.incidents]
+        return out
 
 
 # ---------------------------------------------------------------------------

@@ -43,11 +43,30 @@ HIST_BUCKETS = 28  # rlo-lint: paired-with rlo_core.h:RLO_HIST_BUCKETS
 #: failed-sender quarantine, and ``rejoins`` counts membership
 #: admissions executed (or adopted, on the joiner side) —
 #: docs/DESIGN.md §8.
+#:
+#: The heal-cost block (docs/DESIGN.md §17 — the signals the
+#: rejoin-cascade work of ROADMAP item 4 steers by):
+#:   ``view_changes``       membership-view rebinds (failure adoptions
+#:                          + admissions + welcome adoptions)
+#:   ``reflood_frames``     frames re-sent by the view-change re-flood
+#:                          (the O(n²·ring) heal cost, per frame×dst)
+#:   ``epoch_lag_max``      high-water mark of (my epoch − the link
+#:                          epoch stamped in an ACCEPTED frame): how
+#:                          far this rank's view has outrun the edges
+#:                          it still hears from (laggard pressure)
+#:   ``quar_mid_rejoin`` / ``quar_failed_sender`` / ``quar_below_floor``
+#:                          the per-reason breakdown of
+#:                          ``epoch_quarantined`` (they sum to it)
+#:   ``admission_rounds``   IAR admission rounds LAUNCHED here (the
+#:                          designated-admitter's proposer-side count)
 # rlo-lint: paired-with rlo_core.h:rlo_stats
 ENGINE_COUNTER_KEYS = (
     "sent_bcast", "recved_bcast", "total_pickup", "ops_failed",
     "arq_retransmits", "arq_dup_drops", "arq_gave_up", "arq_unacked",
     "epoch", "epoch_quarantined", "rejoins",
+    "view_changes", "reflood_frames", "epoch_lag_max",
+    "quar_mid_rejoin", "quar_failed_sender", "quar_below_floor",
+    "admission_rounds",
 )
 
 #: The in-engine phase-profiler schema, in snapshot order — the single

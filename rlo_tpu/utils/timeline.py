@@ -28,6 +28,7 @@ CLI::
 
     python -m rlo_tpu.utils.timeline merge --out trace.json r0.jsonl r1.jsonl
     python -m rlo_tpu.utils.timeline smoke   # loopback soak -> validate
+    python -m rlo_tpu.utils.timeline stats trace.json  # per-rank totals
 """
 
 from __future__ import annotations
@@ -211,6 +212,66 @@ def count_flow_edges(trace: Dict) -> int:
                if e.get("ph") == "s")
 
 
+def trace_stats(trace: Dict) -> Dict:
+    """Per-rank totals from a merged Chrome trace — the quick triage
+    view an incident bundle links to (docs/DESIGN.md §17): protocol
+    event counts by kind, phase-profiler slice counts + total usec by
+    stage, and flow edges sent/received per rank."""
+    ranks: Dict[int, Dict] = {}
+
+    def ent(tid) -> Dict:
+        e = ranks.get(tid)
+        if e is None:
+            e = ranks[tid] = {"events": {}, "phases": {},
+                              "flows_out": 0, "flows_in": 0}
+        return e
+
+    for e in trace.get("traceEvents", []):
+        ph = e.get("ph")
+        tid = e.get("tid", -1)
+        if ph == "X":
+            if e.get("cat") == "phase":
+                slot = ent(tid)["phases"].setdefault(
+                    e.get("name", "?"), {"count": 0, "usec": 0})
+                slot["count"] += 1
+                slot["usec"] += int(e.get("args", {}).get(
+                    "usec", e.get("dur", 0)))
+            else:
+                evs = ent(tid)["events"]
+                name = e.get("name", "?")
+                evs[name] = evs.get(name, 0) + 1
+        elif ph == "s":
+            ent(tid)["flows_out"] += 1
+        elif ph == "f":
+            ent(tid)["flows_in"] += 1
+    return {"ranks": {str(r): ranks[r] for r in sorted(ranks)},
+            "events_total": sum(
+                sum(e["events"].values()) for e in ranks.values()),
+            "flow_edges": count_flow_edges(trace)}
+
+
+def render_trace_stats(stats: Dict) -> str:
+    """Text table for :func:`trace_stats`."""
+    kinds: List[str] = sorted({k for e in stats["ranks"].values()
+                               for k in e["events"]})
+    lines = [f"timeline stats — {stats['events_total']} protocol "
+             f"events, {stats['flow_edges']} flow edges"]
+    hdr = "rank " + " ".join(f"{k:>12}" for k in kinds) + \
+        "   flows(out/in)   phase slices (total usec)"
+    lines.append(hdr)
+    lines.append("-" * len(hdr))
+    for r, e in stats["ranks"].items():
+        row = f"{r:>4} " + " ".join(
+            f"{e['events'].get(k, 0):>12}" for k in kinds)
+        row += f"   {e['flows_out']:>5}/{e['flows_in']:<5}"
+        if e["phases"]:
+            tot = sum(p["count"] for p in e["phases"].values())
+            usec = sum(p["usec"] for p in e["phases"].values())
+            row += f"   {tot} ({usec} us)"
+        lines.append(row)
+    return "\n".join(lines)
+
+
 def validate_chrome_trace(trace: Dict) -> None:
     """Validate the Chrome trace-event JSON schema (the subset this
     module emits): raises ValueError on the first violation. Checks
@@ -327,7 +388,22 @@ def main(argv: Optional[List[str]] = None) -> int:
     sp = sub.add_parser("smoke", help="loopback soak -> timeline -> "
                                       "schema validation")
     sp.add_argument("--out", default=None)
+    st = sub.add_parser("stats", help="per-rank frame/phase totals "
+                                      "from a merged trace (the "
+                                      "incident-bundle triage view)")
+    st.add_argument("trace", help="merged Chrome trace JSON (the "
+                                  "merge subcommand's --out, or an "
+                                  "incident bundle's trace.json)")
+    st.add_argument("--json", action="store_true")
     args = ap.parse_args(argv)
+    if args.cmd == "stats":
+        with open(args.trace) as f:
+            stats = trace_stats(json.load(f))
+        if args.json:
+            print(json.dumps(stats))
+        else:
+            print(render_trace_stats(stats))
+        return 0
     if args.cmd == "merge":
         trace = merge_timeline(args.inputs, out_path=args.out)
         validate_chrome_trace(trace)

@@ -42,6 +42,9 @@ from __future__ import annotations
 import enum
 import struct
 from dataclasses import dataclass, field
+from typing import Dict, Optional, Sequence, Tuple
+
+from rlo_tpu.utils.metrics import ENGINE_COUNTER_KEYS
 
 
 class Tag(enum.IntEnum):
@@ -82,6 +85,14 @@ class Tag(enum.IntEnum):
                      # reports, docs/DESIGN.md §11): reliable (ARQ-
                      # stamped), epoch-gated, delivered via pickup —
                      # the payload is a fabric record, not engine state
+    TELEM = 18       # rlo-lint: default-route
+                     # in-band telemetry digest (docs/DESIGN.md §17):
+                     # reliable (ARQ-stamped), epoch-gated, delivered
+                     # via pickup to the telemetry plane
+                     # (rlo_tpu/observe/), which store-and-forwards it
+                     # along the broadcast overlay — the payload is a
+                     # delta-encoded digest (encode_telem below), not
+                     # engine state
 
 
 #: Tags that are store-and-forward broadcast over the skip-ring overlay.
@@ -179,3 +190,138 @@ def restamp_link(raw: bytes, seq: int, epoch: int) -> bytes:
     buf = bytearray(raw)
     struct.pack_into("<ii", buf, SEQ_OFFSET, seq, epoch)
     return bytes(buf)
+
+
+# ---------------------------------------------------------------------------
+# Telemetry digest codec (docs/DESIGN.md §17). One digest = one rank's
+# compact, delta-encoded telemetry sample, carried in a Tag.TELEM
+# frame payload and store-and-forwarded by the telemetry plane
+# (rlo_tpu/observe/telemetry.py) so any rank converges on an
+# eventually-consistent fleet view. The byte layout is PINNED so the C
+# engine can originate byte-identical digests (rlo_wire.c
+# rlo_telem_encode / rlo_engine.c rlo_engine_telem_digest; parity
+# asserted by tests/test_observe.py):
+#
+#   offset 0   magic  "RLOT\x01"                      (5 bytes)
+#   offset 5   flags  u8    bit0 = FULL snapshot (deltas vs zero)
+#   offset 6   rank   i32le origin rank of the sample
+#   offset 10  epoch  i32le origin's membership epoch at emit time
+#   offset 14  seq    u32le per-origin digest sequence (0, 1, 2, ...)
+#   offset 18  mask   u32le bit i set => TELEM_KEYS[i] delta present
+#   offset 22  deltas       one unsigned LEB128 varint per set mask
+#                           bit (ascending bit order), zigzag-encoded
+#                           (value - previous emitted value; a FULL
+#                           digest encodes the absolute values, i.e.
+#                           deltas vs zero, with every bit set)
+#
+# Receivers apply a digest only when it is FULL or exactly one seq
+# past the last applied one — a gap (lost delta) parks the rank's
+# view entry as stale until the origin's next full snapshot heals it.
+# ---------------------------------------------------------------------------
+
+#: digest magic prefix (the Tag.TELEM payload discriminator)
+# rlo-lint: paired-with rlo_core.h:RLO_TELEM_MAGIC
+TELEM_MAGIC = b"RLOT\x01"
+
+#: fixed header size before the varint delta section
+# rlo-lint: paired-with rlo_core.h:RLO_TELEM_HEADER_SIZE
+TELEM_HEADER_SIZE = 22
+
+#: digest keys beyond the engine-counter schema: per-link rollups
+#: (frames both ways, the worst ack-measured RTT EWMA in usec), live
+#: queue depth and pickup backlog, and the serving layer's paged-pool
+#: occupancy (zero on ranks without a paged server — the C engine
+#: always emits 0 here).
+# rlo-lint: paired-with rlo_wire.c:k_telem_keys
+TELEM_EXTRA_KEYS = (
+    "tx_frames", "rx_frames", "rtt_ewma_max_usec",
+    "q_wait", "pickup_backlog", "pages_in_use", "pages_free",
+)
+
+#: The full digest schema, in mask-bit order: the engine-counter
+#: schema (so every rlo-lint R2-pinned counter rides the digest — the
+#: heal-cost counters included) followed by the extras. Bounded at 32
+#: keys by the u32 mask; rlo-lint R2 pins this tuple against the C
+#: codec's key-name table (rlo_wire.c k_telem_keys).
+TELEM_KEYS = ENGINE_COUNTER_KEYS + TELEM_EXTRA_KEYS
+assert len(TELEM_KEYS) <= 32, "TELEM mask is a u32: at most 32 keys"
+
+_TELEM_HDR = struct.Struct("<BiiII")  # flags, rank, epoch, seq, mask
+
+
+def _zigzag(n: int) -> int:
+    return (n << 1) if n >= 0 else ((-n << 1) - 1)
+
+
+def _unzigzag(u: int) -> int:
+    return (u >> 1) if (u & 1) == 0 else -((u + 1) >> 1)
+
+
+def _varint(out: bytearray, u: int) -> None:
+    while u >= 0x80:
+        out.append((u & 0x7F) | 0x80)
+        u >>= 7
+    out.append(u)
+
+
+def encode_telem(rank: int, epoch: int, seq: int,
+                 values: Sequence[int],
+                 prev: Optional[Sequence[int]] = None,
+                 full: bool = False) -> bytes:
+    """Encode one telemetry digest. ``values`` are the CURRENT sample
+    in TELEM_KEYS order; ``prev`` the previously EMITTED sample (the
+    delta base). ``full`` (or ``prev=None``) emits a full snapshot —
+    absolute values, every mask bit set — which is what heals a
+    receiver that lost a delta."""
+    if len(values) != len(TELEM_KEYS):
+        raise ValueError(f"need {len(TELEM_KEYS)} values in TELEM_KEYS "
+                         f"order, got {len(values)}")
+    if prev is None:
+        full = True
+    out = bytearray(TELEM_MAGIC)
+    mask = 0
+    deltas = bytearray()
+    for i, v in enumerate(values):
+        d = int(v) - (0 if full else int(prev[i]))
+        if full or d != 0:
+            mask |= 1 << i
+            _varint(deltas, _zigzag(d))
+    out += _TELEM_HDR.pack(1 if full else 0, rank, epoch,
+                           seq & 0xFFFFFFFF, mask)
+    out += deltas
+    return bytes(out)
+
+
+def decode_telem(raw: bytes) -> Tuple[int, int, int, bool,
+                                      Dict[str, int]]:
+    """Decode one digest: ``(rank, epoch, seq, full, {key: delta})``.
+    Raises ValueError on a malformed payload (bad magic, truncated
+    header or varint section, mask bits beyond the schema)."""
+    if len(raw) < TELEM_HEADER_SIZE or \
+            raw[:len(TELEM_MAGIC)] != TELEM_MAGIC:
+        raise ValueError("not a telemetry digest")
+    flags, rank, epoch, seq, mask = _TELEM_HDR.unpack_from(
+        raw, len(TELEM_MAGIC))
+    if mask >> len(TELEM_KEYS):
+        raise ValueError(f"digest mask {mask:#x} has bits beyond the "
+                         f"{len(TELEM_KEYS)}-key schema")
+    deltas: Dict[str, int] = {}
+    pos = TELEM_HEADER_SIZE
+    for i, key in enumerate(TELEM_KEYS):
+        if not mask & (1 << i):
+            continue
+        u = 0
+        shift = 0
+        while True:
+            # same validity bound as the C decoder (rlo_wire.c):
+            # a varint past 64 payload bits is malformed, not a bigint
+            if pos >= len(raw) or shift > 63:
+                raise ValueError("truncated/overlong digest varint")
+            b = raw[pos]
+            pos += 1
+            u |= (b & 0x7F) << shift
+            shift += 7
+            if not b & 0x80:
+                break
+        deltas[key] = _unzigzag(u)
+    return rank, epoch, seq, bool(flags & 1), deltas

@@ -148,6 +148,32 @@ def test_r2_fires_on_phobs_key_typo(tree):
                for f in hits), hits
 
 
+def test_r2_fires_on_telem_key_drift(tree):
+    """Dropping a digest key from wire.py's TELEM schema must trip
+    the §17 extension: the C codec's k_telem_keys name table and
+    RLO_TELEM_NKEYS now disagree with the mask-bit order — the drift
+    that would decode every fleet digest into the wrong slots."""
+    mutate(tree, "rlo_tpu/wire.py",
+           '"q_wait", "pickup_backlog", "pages_in_use", "pages_free",',
+           '"q_wait", "pickup_backlog", "pages_in_use",')
+    hits = findings_for(tree, "R2")
+    assert any(f.file == "rlo_tpu/native/rlo_core.h" and
+               "RLO_TELEM_NKEYS" in f.msg for f in hits), hits
+    assert any(f.file == "rlo_tpu/native/rlo_wire.c" and
+               "k_telem_keys" in f.msg for f in hits), hits
+
+
+def test_r2_fires_on_telem_header_drift(tree):
+    """The byte-pinned digest header size is a paired constant: a
+    Python-side bump without the C twin is a finding at the
+    assignment line."""
+    line = mutate(tree, "rlo_tpu/wire.py",
+                  "TELEM_HEADER_SIZE = 22", "TELEM_HEADER_SIZE = 23")
+    hits = findings_for(tree, "R2")
+    assert any(f.file == "rlo_tpu/wire.py" and f.line == line and
+               "TELEM_HEADER_SIZE" in f.msg for f in hits), hits
+
+
 def test_r3_fires_on_missing_binding(tree):
     mutate(tree, "rlo_tpu/native/bindings.py",
            '    sig("rlo_engine_set_fanout", C.c_int, [p, C.c_int])\n',
@@ -267,6 +293,20 @@ def test_r5_fires_on_weather_module_random_leak(tree):
     hits = findings_for(tree, "R5")
     assert any(f.file == "rlo_tpu/workloads/weather.py" and
                "random.random" in f.msg for f in hits), hits
+
+
+def test_r5_fires_on_telemetry_wallclock_leak(tree):
+    """The telemetry plane is in the deterministic-replay scope
+    (docs/DESIGN.md §17): emission paces on the engine clock so
+    instrumented fleets replay bit-for-bit from the seed — a
+    wall-clock read in observe/ would unpin every instrumented
+    schedule (and every watchdog trip vtime)."""
+    path = tree / "rlo_tpu/observe/telemetry.py"
+    path.write_text(path.read_text() +
+                    "\nimport time\n_T0 = time.time()\n")
+    hits = findings_for(tree, "R5")
+    assert any(f.file == "rlo_tpu/observe/telemetry.py" and
+               "time.time" in f.msg for f in hits), hits
 
 
 def test_r5_fires_on_wallclock_leak(tree):

@@ -1,0 +1,464 @@
+"""Fleet telescope (docs/DESIGN.md §17): telemetry digest codec
+(Python ⇔ C byte parity), the in-band telemetry plane's fleet-view
+convergence, cross-engine heal-counter parity, and the incident
+watchdog's deterministic trip on the churn cascade.
+"""
+
+import json
+import random
+
+import pytest
+
+from rlo_tpu import wire
+from rlo_tpu.engine import EngineManager, ProgressEngine, drain
+from rlo_tpu.native import bindings as nb
+from rlo_tpu.observe import (DEFAULT_RULES, FleetView, Rule,
+                             TelemetryPlane, Watchdog, parse_rule)
+from rlo_tpu.transport.loopback import LoopbackWorld
+from rlo_tpu.transport.sim import Scenario, SimViolation, SimWorld
+from rlo_tpu.utils.metrics import ENGINE_COUNTER_KEYS
+from rlo_tpu.wire import (TELEM_KEYS, Frame, Tag, decode_telem,
+                          encode_telem)
+
+
+# ---------------------------------------------------------------------------
+# digest codec: round-trip + Python ⇔ C byte parity
+# ---------------------------------------------------------------------------
+
+class TestTelemCodec:
+    def test_python_roundtrip_full_and_delta(self):
+        vals = list(range(10, 10 + len(TELEM_KEYS)))
+        raw = encode_telem(3, 2, 7, vals, None)
+        rank, epoch, seq, full, deltas = decode_telem(raw)
+        assert (rank, epoch, seq, full) == (3, 2, 7, True)
+        assert [deltas[k] for k in TELEM_KEYS] == vals
+        # delta digest carries only the changed keys
+        prev = list(vals)
+        vals[0] += 5
+        vals[3] -= 2
+        raw = encode_telem(3, 2, 8, vals, prev)
+        rank, epoch, seq, full, deltas = decode_telem(raw)
+        assert not full
+        assert deltas == {TELEM_KEYS[0]: 5, TELEM_KEYS[3]: -2}
+
+    def test_python_c_byte_parity(self):
+        """The acceptance pin: both codecs produce IDENTICAL bytes for
+        identical inputs, and each decodes the other's output."""
+        rng = random.Random(17)
+        for trial in range(100):
+            vals = [rng.randrange(0, 2 ** 40)
+                    for _ in range(len(TELEM_KEYS))]
+            prev = [v - rng.randrange(-1000, 1000) for v in vals]
+            full = trial % 3 == 0
+            py = encode_telem(9, 4, trial, vals, prev, full=full)
+            c = nb.telem_encode(9, 4, trial, vals, prev, full=full)
+            assert py == c
+            assert decode_telem(c) == nb.telem_decode(py)
+
+    def test_c_key_table_matches_schema(self):
+        assert nb.telem_key_names() == TELEM_KEYS
+
+    def test_malformed_digests_raise(self):
+        good = encode_telem(0, 0, 0, [1] * len(TELEM_KEYS))
+        with pytest.raises(ValueError):
+            decode_telem(b"XXXX" + good[4:])      # bad magic
+        with pytest.raises(ValueError):
+            decode_telem(good[:10])               # truncated header
+        with pytest.raises(ValueError):
+            decode_telem(good[:-1])               # truncated varints
+        if len(TELEM_KEYS) < 32:
+            bad = bytearray(good)
+            bad[18 + 3] |= 0x80                   # mask bit 31
+            with pytest.raises(ValueError):
+                decode_telem(bytes(bad))
+        # overlong varint (> 64 payload bits): malformed in BOTH
+        # codecs, never a Python bigint the C side would reject
+        overlong = good[:22] + b"\x80" * 10 + b"\x00"
+        with pytest.raises(ValueError):
+            decode_telem(overlong)
+        with pytest.raises(ValueError):
+            nb.telem_decode(overlong)
+
+    def test_schema_embeds_counter_keys(self):
+        assert TELEM_KEYS[:len(ENGINE_COUNTER_KEYS)] == \
+            ENGINE_COUNTER_KEYS
+        assert len(TELEM_KEYS) <= 32
+
+    def test_native_engine_originates_digests(self):
+        """The C engine's digests decode into its own metrics() —
+        full snapshot first, then a correct delta — through the same
+        FleetView merge the Python plane uses."""
+        with nb.NativeWorld(4) as world:
+            engines = [nb.NativeEngine(world, r) for r in range(4)]
+            for e in engines:
+                e.enable_metrics()
+            engines[0].bcast(b"one")
+            world.drain()
+            view = FleetView(4, self_rank=99)
+            raw = engines[0].telem_digest()
+            rank, epoch, seq, full, deltas = decode_telem(raw)
+            assert (rank, full) == (0, True)
+            view.entry(0).apply(epoch, seq, full, deltas, 0.0)
+            m = engines[0].metrics()["counters"]
+            for k in ENGINE_COUNTER_KEYS:
+                if k == "arq_unacked":
+                    continue  # live value; may move with drains
+                assert view.entry(0).values[k] == m[k], k
+            # more traffic -> a DELTA digest that applies cleanly
+            engines[0].bcast(b"two")
+            world.drain()
+            rank, epoch, seq2, full, deltas = decode_telem(
+                engines[0].telem_digest())
+            assert seq2 == seq + 1 and not full
+            view.entry(0).apply(epoch, seq2, full, deltas, 1.0)
+            m = engines[0].metrics()["counters"]
+            assert view.entry(0).values["sent_bcast"] == \
+                m["sent_bcast"] == 2
+
+
+# ---------------------------------------------------------------------------
+# fleet view mechanics
+# ---------------------------------------------------------------------------
+
+class TestFleetView:
+    def test_gap_parks_entry_until_full_snapshot(self):
+        view = FleetView(4, 0)
+        ent = view.entry(1)
+        base = {k: 10 for k in TELEM_KEYS}
+        assert ent.apply(0, 0, True, base, 0.0)
+        assert ent.apply(0, 1, False, {"sent_bcast": 2}, 1.0)
+        assert ent.values["sent_bcast"] == 12
+        # seq 2 lost; seq 3 must NOT apply (it would corrupt values)
+        assert not ent.apply(0, 3, False, {"sent_bcast": 1}, 2.0)
+        assert ent.gap and ent.values["sent_bcast"] == 12
+        # the next full snapshot heals
+        assert ent.apply(0, 8, True, {k: 20 for k in TELEM_KEYS}, 3.0)
+        assert not ent.gap and ent.values["sent_bcast"] == 20
+
+    def test_rollups_sum_and_max(self):
+        view = FleetView(4, 0)
+        view.entry(0).apply(0, 0, True, {k: 1 for k in TELEM_KEYS}, 0)
+        view.entry(1).apply(0, 0, True, {k: 5 for k in TELEM_KEYS}, 0)
+        assert view.rollups()["sent_bcast"] == 6
+        assert view.rollup_max()["sent_bcast"] == 5
+        snap = view.snapshot(2.0, self_epoch=3)
+        assert snap["present"] == 2
+        assert snap["ranks"]["1"]["stale_epochs"] == 3
+
+
+# ---------------------------------------------------------------------------
+# the acceptance criterion: 8-rank sim fleet, every digest present,
+# rollups == sum of per-rank metrics()
+# ---------------------------------------------------------------------------
+
+class TestFleetConvergence:
+    def test_8rank_rollups_equal_metrics_sums(self):
+        from rlo_tpu.tools.rlo_top import run_fleet
+        fleet = run_fleet(8, seed=3)
+        fleet.drive(15.0)
+        captured = fleet.converge()
+        plane = fleet.planes[2]  # ANY rank serves the fleet view
+        snap = plane.view.snapshot(fleet.world.now,
+                                   self_epoch=fleet.engines[2].epoch)
+        assert snap["present"] == 8
+        sums = {k: sum(c[k] for c in captured) for k in TELEM_KEYS}
+        for k in TELEM_KEYS:
+            assert snap["rollups"][k] == sums[k], k
+        # and the captures ARE the engines' metrics() at flush time:
+        # per-rank counter values in the view match the digest capture
+        for r, cap in enumerate(captured):
+            ent = snap["ranks"][str(r)]["values"]
+            for k in TELEM_KEYS:
+                assert ent[k] == cap[k], (r, k)
+        assert sums["sent_bcast"] > 0  # traffic actually flowed
+        fleet.cleanup()
+
+    def test_rlo_top_json_cli(self, capsys):
+        from rlo_tpu.tools import rlo_top
+        rc = rlo_top.main(["--json", "--vtime", "6", "--ranks", "4",
+                           "--from-rank", "3"])
+        out = json.loads(capsys.readouterr().out)
+        assert rc == 0 and out["ok"] and out["problems"] == []
+        assert out["fleet"]["present"] == 4
+        assert out["from_rank"] == 3
+
+    def test_rlo_top_bad_invocation(self):
+        from rlo_tpu.tools import rlo_top
+        assert rlo_top.main(["--ranks", "1"]) == 2
+
+    def test_scenario_telemetry_through_kill_restart(self, tmp_path):
+        s = Scenario(world_size=4, seed=3, duration=120.0,
+                     script=[(2.0, "bcast", 0), (20.0, "kill", 2),
+                             (45.0, "restart", 2),
+                             (100.0, "bcast", 3)],
+                     telemetry=True)
+        res = s.run()
+        fv = res["fleet_view"]
+        assert fv["present"] == 4  # the REJOINED rank reports too
+        assert fv["rollups"]["rejoins"] >= 4
+        assert fv["rollups"]["view_changes"] >= 4
+        assert fv["rollups"]["reflood_frames"] > 0
+        assert res["telemetry"][0]["malformed"] == 0
+
+    def test_fabric_fleet_stats_is_view_consumer(self):
+        from rlo_tpu.serving.fabric import fleet_stats
+        from rlo_tpu.tools.rlo_top import run_fleet
+        fleet = run_fleet(4, seed=1, fabric=True)
+        fleet.drive(12.0)
+        fleet.converge()
+        fs = fleet_stats(fleet.fabrics)
+        # the merged-counters face is unchanged...
+        assert fs["counters"]["fabric.requests_admitted"] > 0
+        assert "e2e_usec" in fs and "ranks" in fs
+        # ...and the attached planes make it a view consumer: the
+        # engine-level fleet picture rides along, page occupancy
+        # included (the paged stub backend feeds the digest extras)
+        fv = fs["fleet_view"]
+        assert fv["present"] == 4
+        assert fv["rollup_max"]["pages_free"] > 0
+        fleet.cleanup()
+
+
+# ---------------------------------------------------------------------------
+# cross-engine parity: every new heal-cost counter, same scenario,
+# same values from the Python and C engines
+# ---------------------------------------------------------------------------
+
+NEW_KEYS = ("view_changes", "reflood_frames", "epoch_lag_max",
+            "quar_mid_rejoin", "quar_failed_sender",
+            "quar_below_floor", "admission_rounds")
+
+
+def _drive_heal_scenario_python():
+    ws = 8
+    world = LoopbackWorld(ws)
+    mgr = EngineManager()
+    engines = [ProgressEngine(world.transport(r), manager=mgr,
+                              failure_timeout=(0.05 if r == 0
+                                               else None))
+               for r in range(ws)]
+    for i in range(3):
+        engines[2].bcast(b"m%d" % i)
+    drain([world], engines)
+    for e in engines:
+        while e.pickup_next() is not None:
+            pass
+    world.kill_rank(ws - 1)
+    engines[-1].cleanup()  # a dead process stops turning its gears
+    import time
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline and not all(
+            len(e.failed) == 1 for e in engines[:-1]):
+        mgr.progress_all()
+    assert all(len(e.failed) == 1 for e in engines[:-1])
+    drain([world], engines[:-1])
+    # a stale frame from the dead rank -> failed-sender quarantine
+    world.inject(ws - 1, 0, int(Tag.BCAST),
+                 Frame(origin=ws - 1, vote=999).encode())
+    # an old-epoch frame from a live rank -> accepted, epoch lag
+    world.inject(1, 0, int(Tag.BCAST),
+                 Frame(origin=1, vote=998, epoch=0).encode())
+    mgr.progress_all()
+    drain([world], engines[:-1])
+    snaps = [e.metrics() for e in engines[:-1]]
+    for e in engines:
+        e.cleanup()
+    return snaps
+
+
+def _drive_heal_scenario_native():
+    import time
+    ws = 8
+    with nb.NativeWorld(ws) as world:
+        engines = [nb.NativeEngine(world, r) for r in range(ws)]
+        engines[0].enable_failure_detection(timeout_usec=50_000,
+                                            interval_usec=12_500)
+        for i in range(3):
+            engines[2].bcast(b"m%d" % i)
+        world.drain()
+        for e in engines:
+            while e.pickup_next() is not None:
+                pass
+        world.kill_rank(ws - 1)
+        engines[-1].close()
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and not all(
+                e.failed_count == 1 for e in engines[:-1]):
+            world.progress_all()
+        assert all(e.failed_count == 1 for e in engines[:-1])
+        world.drain()
+        raw = nb.frame_roundtrip(ws - 1, -1, 999, b"")[4]
+        world.inject(ws - 1, 0, int(Tag.BCAST), raw)
+        raw = nb.frame_roundtrip(1, -1, 998, b"")[4]
+        world.inject(1, 0, int(Tag.BCAST), raw)
+        world.progress_all()
+        world.drain()
+        return [e.metrics() for e in engines[:-1]]
+
+
+def test_cross_engine_heal_counter_parity():
+    """The churn-flavored parity leg: one kill detected by rank 0 and
+    adopted fleet-wide, the view-change re-flood, a stale frame from
+    the dead rank (failed-sender quarantine) and an old-epoch frame
+    from a live one (epoch lag) — every NEW counter must come out
+    IDENTICAL from the two engines, every rank."""
+    py = _drive_heal_scenario_python()
+    nat = _drive_heal_scenario_native()
+    for r in range(7):  # rank 7 is dead
+        pc = py[r]["counters"]
+        ncs = nat[r]["counters"]
+        for k in NEW_KEYS:
+            assert pc[k] == ncs[k], (r, k, pc[k], ncs[k])
+    # and the values are the deterministic ones the scenario pins:
+    # every survivor re-formed once and re-flooded its 3-deep log to
+    # 6 peers; only rank 0 saw the injected frames
+    for r in range(7):
+        assert py[r]["counters"]["view_changes"] == 1
+        assert py[r]["counters"]["reflood_frames"] == 18
+    assert py[0]["counters"]["quar_failed_sender"] == 1
+    assert py[0]["counters"]["epoch_lag_max"] == 1
+    assert py[0]["counters"]["quar_mid_rejoin"] == 0
+    assert py[0]["counters"]["quar_below_floor"] == 0
+    # breakdown sums to the total at every rank
+    for r in range(7):
+        c = py[r]["counters"]
+        assert (c["quar_mid_rejoin"] + c["quar_failed_sender"] +
+                c["quar_below_floor"]) == c["epoch_quarantined"]
+
+
+# ---------------------------------------------------------------------------
+# watchdog: grammar, determinism, the churn-cascade trip + bundle
+# ---------------------------------------------------------------------------
+
+class TestWatchdogRules:
+    def test_grammar_roundtrip(self):
+        r = parse_rule("rejoin-cascade: sum(rejoins) / 30s >= 0.5")
+        assert (r.name, r.key, r.agg, r.mode, r.window,
+                r.op, r.threshold) == \
+            ("rejoin-cascade", "rejoins", "sum", "rate", 30.0,
+             ">=", 0.5)
+        assert parse_rule(r.spec()) == r
+        lvl = parse_rule("lag: max(epoch_lag_max) >= 8")
+        assert lvl.mode == "level" and lvl.agg == "max"
+        for rule in DEFAULT_RULES:
+            assert parse_rule(rule).spec()  # all defaults parse
+
+    def test_grammar_rejects(self):
+        with pytest.raises(ValueError):
+            parse_rule("bad rule text")
+        with pytest.raises(ValueError):
+            parse_rule("x: sum(not_a_key) >= 1")
+        with pytest.raises(ValueError):
+            Rule("x", "rejoins", 1.0, agg="median")
+
+    def test_level_rule_trips_with_cooldown(self):
+        world = SimWorld(2, seed=0)
+        mgr = EngineManager()
+        engines = [ProgressEngine(world.transport(r), manager=mgr,
+                                  clock=world.clock)
+                   for r in range(2)]
+        plane = TelemetryPlane(engines[0], interval=0.5)
+        wd = Watchdog(plane, ["sent: sum(sent_bcast) >= 2"],
+                      cooldown=10.0)
+        assert wd.check() == []
+        engines[0].bcast(b"a")
+        engines[0].bcast(b"b")
+        plane.emit()
+        fired = wd.check()
+        assert [i.rule.name for i in fired] == ["sent"]
+        assert fired[0].value >= 2
+        assert wd.check() == []  # cooldown holds
+        for e in engines:
+            e.cleanup()
+
+
+def _cascade_scenario(seed, incident_dir=None):
+    from rlo_tpu.workloads.weather import make_weather
+    w = make_weather("churn", seed=1, world_size=16, rate=0.05,
+                     duration=60.0, start=8.0, mean_down=20.0,
+                     min_down=13.0, min_live=14, settle=25.0,
+                     immortal=(0,))
+    return Scenario(
+        world_size=16, seed=seed, duration=60.0, weather=w,
+        failure_timeout=3.0, heartbeat_interval=1.0, arq_rto=1.5,
+        arq_max_retries=6, op_deadline=30.0, check_delivery=False,
+        telemetry=True,
+        watchdog_rules=["rejoin-cascade: sum(rejoins) / 30s >= 0.5"],
+        incident_dir=incident_dir)
+
+
+class TestCascadeWatchdog:
+    def test_trips_deterministically_with_complete_bundle(
+            self, tmp_path):
+        """The acceptance criterion: the watchdog trips on the
+        churn.n16.r0.05 cascade, writes a complete incident bundle,
+        and the embedded replay recipe reproduces the trip."""
+        s = _cascade_scenario(0, incident_dir=str(tmp_path))
+        with pytest.raises(SimViolation):
+            s.run()  # the cascade IS a property violation at the end
+        incs = s._watchdog.incidents
+        assert [i.rule.name for i in incs][:1] == ["rejoin-cascade"]
+        first = incs[0]
+        assert first.bundle_dir is not None
+        bundle = json.load(open(f"{first.bundle_dir}/incident.json"))
+        # bundle completeness: rule + value + vtime + replay + fleet
+        # view + per-rank traces + merged Chrome trace
+        assert bundle["name"] == "rejoin-cascade"
+        assert bundle["value"] >= 0.5
+        assert bundle["vtime"] == first.vtime
+        assert "Scenario(" in bundle["replay"]
+        fv = json.load(open(f"{first.bundle_dir}/fleet_view.json"))
+        assert fv["present"] >= 2
+        trace = json.load(open(f"{first.bundle_dir}/trace.json"))
+        assert "traceEvents" in trace
+        import os
+        names = sorted(os.listdir(first.bundle_dir))
+        assert "incident.json" in names and "trace.json" in names
+        assert any(n.startswith("rank") and n.endswith(".jsonl")
+                   for n in names)
+
+        # the replay recipe replays: same seed => same trip vtime
+        ns = {}
+        from rlo_tpu.transport import sim as sim_mod
+        from rlo_tpu.workloads.weather import make_weather
+        ns["Scenario"] = sim_mod.Scenario
+        ns["make_weather"] = make_weather
+        expr = bundle["replay"]
+        assert expr.endswith(".run()")
+        s2 = eval(expr[:-len(".run()")], ns)  # noqa: S307 - own recipe
+        with pytest.raises(SimViolation):
+            s2.run()
+        assert s2._watchdog.incidents[0].vtime == first.vtime
+        assert s2._watchdog.incidents[0].value == first.value
+
+    def test_no_false_trip_across_watched_rank_restart(self):
+        """An ordinary kill/restart of the WATCHED rank must not trip
+        the rate rules: the fresh plane's view rebuild is not a storm
+        (the watchdog rebind clears rate histories), and a burst
+        denominated over a short retained history is not a rate
+        (Δ is divided by the NOMINAL window)."""
+        s = Scenario(world_size=4, seed=3, duration=120.0,
+                     script=[(2.0, "bcast", 1), (20.0, "kill", 0),
+                             (45.0, "restart", 0),
+                             (100.0, "bcast", 3)],
+                     telemetry=True,
+                     watchdog_rules=[
+                         "retransmit-storm: sum(arq_retransmits)"
+                         " / 10s >= 5.0",
+                         "rejoin-cascade: sum(rejoins) / 30s >= 0.5"],
+                     check_delivery=False)
+        s.run()
+        assert s._watchdog.incidents == []
+
+    def test_healthy_fleet_never_trips_defaults(self):
+        """The default SLO thresholds stay quiet on a clean fleet —
+        a watchdog that cries wolf is worse than none."""
+        from rlo_tpu.tools.rlo_top import run_fleet
+        fleet = run_fleet(4, seed=0,
+                          watchdog_rules=list(DEFAULT_RULES))
+        fleet.drive(12.0)
+        fleet.converge()
+        for plane in fleet.planes:
+            if plane.watchdog is not None:
+                assert plane.watchdog.incidents == []
+        fleet.cleanup()
