@@ -58,16 +58,17 @@ FANOUT_NS_FULL = (4, 16, 64, 256, 1024)
 MEMBER_NS_QUICK = (4, 8, 16)
 MEMBER_NS_FULL = (4, 16, 64, 256, 1024)
 #: churn-rate-vs-convergence curve (docs/DESIGN.md §14): (n, kills
-#: per virtual second) legs of sustained kill/rejoin churn. The first
-#: two sit inside the regime the rejoin protocol handles (they end
-#: converged); the last sits PAST the measured knee — mid-rejoin
-#: ranks stop heartbeating, get re-declared failed, and the fleet
-#: collapses into a rejoin cascade (final_converged pins 0 and the
-#: dirty-time/rejoin volume pin the collapse shape, so the knee can
-#: only move under a deliberate baseline regen). See DESIGN.md §14
-#: "churn findings".
+#: per virtual second) legs of sustained kill/rejoin churn. Before
+#: the §18 healing work (epoch catch-up, joiner liveness grace,
+#: incremental re-flood, batched admissions) the r=0.05 leg sat PAST
+#: the measured knee — mid-rejoin ranks stopped heartbeating, got
+#: re-declared failed, and the fleet collapsed into a rejoin cascade
+#: (final_converged pinned 0). §18 moved the knee: the whole curve
+#: now ends converged at n=32, and the heal-cost counters pin HOW
+#: (reflood_skipped replacing reflood_frames, epoch_syncs replacing
+#: full rejoins). See DESIGN.md §14 "churn findings" and §18.
 CHURN_LEGS_QUICK = ((16, 0.02),)
-CHURN_LEGS_FULL = ((32, 0.01), (32, 0.02), (16, 0.05))
+CHURN_LEGS_FULL = ((32, 0.01), (32, 0.02), (32, 0.05))
 #: ARQ-storm legs: iid loss vs correlated (Gilbert) burst loss at the
 #: SAME average loss rate — the storm is in the correlation
 STORM_N = 16
@@ -292,14 +293,22 @@ def bench_churn(n: int, rate: float, seed: int = 0,
     if dirty_since is not None:
         dirty_vtime += world.now - dirty_since
     rejoins = sum(engines[r].rejoins for r in live)
-    # heal-cost counters (docs/DESIGN.md §17): the committed baseline
-    # of what the cascade COSTS — the numbers ROADMAP item 4's healing
-    # work (epoch catch-up, joiner heartbeats, incremental re-flood)
-    # must drive down. Informational in BENCH_sim.json: they move
+    # heal-cost counters (docs/DESIGN.md §17/§18): the committed
+    # record of what healing COSTS. The §18 work (epoch catch-up,
+    # joiner liveness grace, incremental re-flood, batched
+    # admissions) drove reflood_frames and admission_rounds down
+    # against the pre-§18 cascade baseline; the new counters
+    # (epoch_syncs, reflood_skipped, batched_admits) pin where the
+    # avoided work went. Informational in BENCH_sim.json: they move
     # whenever the heal protocol improves, which is the point.
     heal = {
         "view_changes": sum(engines[r].view_changes for r in live),
         "reflood_frames": sum(engines[r].reflood_frames
+                              for r in live),
+        "reflood_skipped": sum(engines[r].reflood_skipped
+                               for r in live),
+        "epoch_syncs": sum(engines[r].epoch_syncs for r in live),
+        "batched_admits": sum(engines[r].batched_admits
                               for r in live),
         "admission_rounds": sum(engines[r].admission_rounds
                                 for r in live),
@@ -425,9 +434,9 @@ def main(argv=None) -> int:
         metrics[f"{key}.final_converged"] = exact(int(ok))
         metrics[f"{key}.wall_events_per_sec"] = wall(
             ev / wdt if wdt > 0 else 0.0)
-        # heal-cost counters (docs/DESIGN.md §17): informational, so
-        # the item-4 healing work starts against a committed baseline
-        # of the cascade's cost (perf_gate --report prints the drift)
+        # heal-cost counters (docs/DESIGN.md §17/§18): informational
+        # drift record of what healing costs per leg (perf_gate
+        # --report prints the movement every check.sh run)
         for hk, hv in sorted(heal.items()):
             metrics[f"{key}.heal.{hk}"] = info(hv)
         print(f"churn n={cn} rate={rate}: {kills} kills/"

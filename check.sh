@@ -121,10 +121,13 @@ fleet.cleanup()
 print(f"canary tripped at vtime {first.vtime:.1f}; bundle: {names}")
 EOF
 
-echo "== simulator fuzz sweep (25 seeds x 9 chaos scripts) =="
+echo "== simulator fuzz sweep (25 seeds x 10 chaos scripts) =="
 # fixed-seed deterministic sweep over the partition/restart/burst-loss/
 # mixed scenario scripts — exactly-once, termination, and membership
-# convergence checked per run — PLUS the serving-fabric shapes
+# convergence checked per run — plus the churn_weather healing shape
+# (sustained churn_script kills/rejoins UNDER Gilbert burst loss with
+# the default watchdog SLOs armed: any incident is a sweep violation,
+# docs/DESIGN.md §18) — PLUS the serving-fabric shapes
 # (fabric_kill/fabric_split/fabric_rejoin/fabric_paged and the
 # weather-driven fabric_churn: sustained kill/rejoin churn from a
 # seeded churn_script, docs/DESIGN.md §11/§14): exactly-once request
@@ -154,10 +157,12 @@ rm -f "$fresh_engine"
 echo "== simulator scaling curve + perf gate (BENCH_sim.json) =="
 # protocol-only fast path: fan-out latency + membership convergence vs n
 # up to 1024 simulated ranks, PLUS the round-14 weather curves —
-# churn-rate-vs-convergence (incl. one past-the-knee rejoin-cascade
-# datapoint) and ARQ-retransmit-storm-under-correlated-loss
-# (docs/DESIGN.md §14); virtual-time metrics gate at zero tolerance
-# (same seed => identical schedule), so O(log n) regressions fail here
+# churn-rate-vs-convergence (every leg now ends converged: the §18
+# healing work moved the knee past r=0.05 at n=32, pinned by the
+# heal-cost counters) and ARQ-retransmit-storm-under-correlated-loss
+# (docs/DESIGN.md §14, §18); virtual-time metrics gate at zero
+# tolerance (same seed => identical schedule), so O(log n)
+# regressions fail here
 fresh_sim=$(mktemp -t rlo_bench_sim.XXXXXX)
 JAX_PLATFORMS=cpu python benchmarks/sim_bench.py \
     --out "$fresh_sim" > /dev/null

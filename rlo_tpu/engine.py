@@ -60,7 +60,28 @@ _PHASE_IDX = {k: i for i, k in enumerate(ENGINE_PHASE_KEYS)}
 #: these itself (the rootless consensus op voting on its own
 #: membership) instead of handing them to the application callbacks.
 #: Admission rounds use pids in the reserved NEGATIVE pid namespace.
-MEMBER_MAGIC = b"RLOJ\x01"
+#: Version 2 (docs/DESIGN.md §18) is a BATCHED record — one round
+#: admits every queued petition at once:
+#:   MAGIC + <ii>(new_epoch, k) + k x <ii>(joiner, incarnation)
+MEMBER_MAGIC = b"RLOJ\x02"
+
+#: Tag.MSYNC payload kind bytes (docs/DESIGN.md §18): the view-state
+#: sync channel multiplexes a catch-up request/response pair and the
+#: digest-scoped re-flood advert/want pair over one epoch- and
+#: ARQ-exempt tag.
+#:   REQ  = <B> + <ii>(requester epoch, requester incarnation)
+#:   RSP  = <B> + <ii>(epoch, n) + n x <iii>(member, reset_epoch,
+#:          admitted_inc) + the responder's recent-log advert tail
+#:          (<i>count + count x <iii> entry identities)
+#:   AD   = <B> + <i>count + count x <iii>(tag, a, b) identities:
+#:          BCAST -> (origin, seq); DECISION/ABORT -> (pid, gen);
+#:          FAILURE -> (failed rank, declarer epoch)
+#:   WANT = <B> + <i>count + count x <iii> — the advert entries the
+#:          receiver provably misses, echoed back verbatim
+MSYNC_REQ = 0
+MSYNC_RSP = 1
+MSYNC_AD = 2
+MSYNC_WANT = 3
 
 #: Membership admission rounds live in the reserved pid namespace
 #: pid <= MEMBER_PID_BASE; app pids are >= -1 (-1 is the unset
@@ -492,6 +513,9 @@ class ProgressEngine:
         self.quar_failed_sender = 0
         self.quar_below_floor = 0
         self.admission_rounds = 0
+        self.epoch_syncs = 0
+        self.reflood_skipped = 0
+        self.batched_admits = 0
         self._epoch_floor: dict = {}    # sender -> min accepted epoch
         self._awaiting_welcome = incarnation > 0
         self._join_last_probe = float("-inf")
@@ -531,6 +555,20 @@ class ProgressEngine:
         # the stale island re-petitions instead of being silently
         # quarantined forever (rate-limited per sender)
         self._stale_probe_last: dict = {}
+        # Tag.MSYNC view-state catch-up (docs/DESIGN.md §18): per-dst
+        # sync-request cadence stamp (one REQ per join_interval — the
+        # request repeats until the view catches up or falls back to a
+        # full rejoin, so losing one costs a cadence tick, not heal)
+        self._sync_req_last: dict = {}
+        # member -> the admission epoch of the last admission round
+        # this rank EXECUTED for it — unlike ``_admit_epoch`` (the
+        # stale-notice floor, inflated wholesale by welcome/sync
+        # adoption) this is only ever a CERTIFIED link-reset epoch, so
+        # a sync response built from it can safely tell a laggard
+        # which floor to set for that member. Cleared on our own
+        # welcome/sync adoption: a rank that just adopted a foreign
+        # view no longer certifies anyone else's reset history.
+        self._reset_epoch: dict = {}
 
         # metrics registry (docs/DESIGN.md §7): per-link frame/byte/
         # retransmit/RTT accounting + op-latency histograms, snapshot
@@ -943,6 +981,9 @@ class ProgressEngine:
             "quar_failed_sender": self.quar_failed_sender,
             "quar_below_floor": self.quar_below_floor,
             "admission_rounds": self.admission_rounds,
+            "epoch_syncs": self.epoch_syncs,
+            "reflood_skipped": self.reflood_skipped,
+            "batched_admits": self.batched_admits,
         }
         # the phase-profiler schema contract with the C engine: literal
         # keys here, ENGINE_PHASE_KEYS, and the rlo_phase_stats field
@@ -1260,6 +1301,8 @@ class ProgressEngine:
                     self._on_join(msg)
                 elif tag == Tag.JOIN_WELCOME:
                     self._on_welcome(msg)
+                elif tag == Tag.MSYNC:
+                    self._on_msync(msg)
                 continue
             # stale-epoch / failed-sender quarantine, BEFORE ACK
             # handling and the ARQ dedup: a dead incarnation's traffic
@@ -1680,10 +1723,11 @@ class ProgressEngine:
         """Proposer broadcasts the final decision (~_iar_decision_bcast
         :908-917) — a regular rootless broadcast with the decision in the
         vote field and the round generation in the payload. Membership
-        rounds append the admission record (MEMBER_MAGIC + joiner/
-        incarnation/epoch) so every member can execute the admission
-        from the decision alone, even if it never saw the proposal
-        (generation readers only unpack the first 4 bytes)."""
+        rounds append the admission record (MEMBER_MAGIC + agreed
+        epoch + the batch of (joiner, incarnation) pairs) so every
+        member can execute the admissions from the decision alone,
+        even if it never saw the proposal (generation readers only
+        unpack the first 4 bytes)."""
         payload = struct.pack("<i", p.gen)
         if p.pid <= MEMBER_PID_BASE:
             payload += self.my_proposal_payload
@@ -1708,11 +1752,12 @@ class ProgressEngine:
         self._p_prop_born = None  # phase timers track successes only
         TRACER.emit(self.rank, Ev.DECISION, p.pid, -1, p.gen)
         if p.pid <= MEMBER_PID_BASE:
-            # aborted admission round: free the joiner for a retry
-            # (its next JOIN probe re-petitions)
-            joiner = self._member_joiner(p.pid)
-            if joiner is not None:
-                self._admitting.discard(joiner)
+            # aborted admission round: free every batched joiner for a
+            # retry (their next JOIN probes re-petition)
+            adm = self._member_decode(self.my_proposal_payload)
+            if adm is not None:
+                for joiner, _inc in adm[1]:
+                    self._admitting.discard(joiner)
         self.bcast(struct.pack("<i", p.gen), tag=Tag.ABORT, pid=p.pid)
 
     def _on_abort(self, msg: _Msg) -> None:
@@ -1791,11 +1836,13 @@ class ProgressEngine:
                 self.queue_iar_pending.remove(pm)
             adm = self._member_decode(msg.frame.payload[4:])
             if adm is not None:
-                joiner, inc, ep = adm
-                self._admitting.discard(joiner)
-                self._pending_joins.pop(joiner, None)
-                if vote:
-                    self._execute_admission(joiner, inc, ep)
+                new_epoch, recs = adm
+                for joiner, inc in recs:
+                    self._admitting.discard(joiner)
+                    self._pending_joins.pop(joiner, None)
+                    if vote and self._execute_admission(
+                            joiner, inc, new_epoch) and len(recs) > 1:
+                        self.batched_admits += 1
             self.queue_wait.append(msg)
             return
         if pm is not None:
@@ -2006,6 +2053,7 @@ class ProgressEngine:
         self.epoch += 1
         self._epoch_floor.pop(rank, None)
         self._link_epoch.pop(rank, None)
+        self._reset_epoch.pop(rank, None)
         self._pending_joins.pop(rank, None)
         self._hb_seen.pop(rank, None)
         # ARQ: a dead peer will never ack — stop retransmitting at it
@@ -2027,23 +2075,91 @@ class ProgressEngine:
         return True
 
     def _reflood_recent_bcasts(self) -> None:
-        """Plug forwarding holes a dead relay left: re-send every recent
-        BCAST and IAR_DECISION frame this rank initiated or forwarded,
-        point-to-point to every alive rank. Receivers drop the
-        duplicates ((origin, seq) for broadcasts, the settled (pid,
-        gen) ring for decisions) — together the flood + dedup upgrade
-        delivery across view changes to exactly-once for any initiator
-        that survived. Covering decisions is what lets parent-died
-        relayed rounds stay parked (see _abort_orphaned_proposals): the
-        decision that clears them survives the loss of any one relay."""
-        for tag, raw in list(self._recent_bcasts):
-            for dst in self._alive:
-                if dst != self.rank:
-                    # through the ARQ gate: the re-flood gets FRESH
-                    # link seqs (it is a new transmission, not a
-                    # retransmit); app-level dedup absorbs the copies
-                    self.reflood_frames += 1
-                    self._send_raw(dst, tag, raw)
+        """Plug forwarding holes a dead relay left — digest-scoped
+        (docs/DESIGN.md §18). The pre-PR-16 heal re-sent every recent
+        BCAST/DECISION/ABORT/FAILURE frame point-to-point to every
+        alive rank on every view change: O(log·n) frames per change,
+        O(n²·ring) per churn episode, and the dominant term of the
+        measured rejoin cascade. Now each view change sends one MSYNC
+        advert per alive peer carrying only the log entries'
+        IDENTITIES ((origin, seq) for broadcasts, (pid, gen) for
+        decisions/aborts, (rank, declarer epoch) for failure notices);
+        a peer answers with a WANT naming exactly the entries it
+        provably misses, and only those payloads are re-sent (through
+        the ARQ gate, with fresh link seqs). An empty log sends
+        nothing at all — kill-only fleets heal for free. Delivery
+        exactly-once still composes the same way: the WANT check reads
+        the same dedup state ((origin, seq) windows + the settled
+        ring) that would have dropped the blast's duplicates, and
+        parent-died relayed rounds still stay parked because a relay
+        missing a decision WANTs it (see _abort_orphaned_proposals).
+        Adverts are best-effort (ARQ-exempt): every later view change
+        re-adverts, and the admission replay / welcome path covers the
+        rejoin side independently."""
+        payload = self._advert_payload()
+        if payload is None:
+            return
+        raw = Frame(origin=self.rank, payload=payload).encode()
+        for dst in self._alive:
+            if dst != self.rank:
+                self._send_raw(dst, int(Tag.MSYNC), raw)
+
+    def _log_entry_ident(self, tag: int, raw: bytes):
+        """(tag, a, b) wire identity of one recent-log entry — the
+        coordinates the advert/WANT pair exchanges instead of
+        payloads. None for entries with no recoverable identity."""
+        f = Frame.decode(raw)
+        if tag == int(Tag.BCAST):
+            return (tag, f.origin, f.vote)  # (origin, bcast seq)
+        if tag in (int(Tag.IAR_DECISION), int(Tag.ABORT)):
+            gen = struct.unpack_from("<i", f.payload)[0] \
+                if len(f.payload) >= 4 else -1
+            return (tag, f.pid, gen) if gen >= 0 else None
+        if tag == int(Tag.FAILURE):
+            return (tag, f.pid, f.vote)  # (failed rank, declarer epoch)
+        return None
+
+    def _advert_payload(self) -> Optional[bytes]:
+        """MSYNC_AD payload for the current recent-broadcast log, or
+        None when the log holds nothing advertisable."""
+        idents = []
+        for tag, raw in self._recent_bcasts:
+            ident = self._log_entry_ident(tag, raw)
+            if ident is not None:
+                idents.append(ident)
+        if not idents:
+            return None
+        out = bytearray(struct.pack("<Bi", MSYNC_AD, len(idents)))
+        for t, a, b in idents:
+            out += struct.pack("<iii", t, a, b)
+        return bytes(out)
+
+    def _have_log_entry(self, t: int, a: int, b: int) -> bool:
+        """Does this rank provably already hold the advertised entry?
+        Reads exactly the dedup state that would have dropped the old
+        blast's duplicate — an entry this returns True for would have
+        been a wasted re-flood frame (counted in reflood_skipped)."""
+        if t == int(Tag.BCAST):
+            if a == self.rank or b < 0:
+                return True  # my own, or unstamped (not recoverable)
+            ent = self._seen_bcast.get(a)
+            return ent is not None and (b <= ent[0] or b in ent[1])
+        if t in (int(Tag.IAR_DECISION), int(Tag.ABORT)):
+            if t == int(Tag.IAR_DECISION) and a <= MEMBER_PID_BASE:
+                # membership decisions are never WANTed: the welcome /
+                # sync-response member records are the authoritative
+                # channel, and a stale admission about a since-
+                # re-failed rank must not resurrect it (the same rule
+                # _replay_recent applies)
+                return True
+            return b < 0 or (a, b) in self._settled_set
+        if t == int(Tag.FAILURE):
+            # a = failed rank, b = declarer epoch: already adopted,
+            # about myself (heal probes cover self-failure learning),
+            # or stale against an admission executed since
+            return (a == self.rank or a in self.failed or
+                    b < self._admit_epoch.get(a, 0))
+        return True
 
     def _discount_failed_voter(self, rank: int) -> None:
         """A consensus participant died mid-round: its subtree's merged
@@ -2124,12 +2240,20 @@ class ProgressEngine:
 
     @staticmethod
     def _member_decode(payload: bytes):
-        """(joiner, incarnation, new_epoch) from an admission payload
-        (MEMBER_MAGIC + <iii>), or None."""
+        """(new_epoch, [(joiner, incarnation), ...]) from a batched
+        admission record (MEMBER_MAGIC + <ii>(new_epoch, k) +
+        k x <ii>(joiner, inc)), or None."""
         if not payload.startswith(MEMBER_MAGIC) or \
-                len(payload) < len(MEMBER_MAGIC) + 12:
+                len(payload) < len(MEMBER_MAGIC) + 8:
             return None
-        return struct.unpack_from("<iii", payload, len(MEMBER_MAGIC))
+        new_epoch, k = struct.unpack_from("<ii", payload,
+                                          len(MEMBER_MAGIC))
+        if k < 1 or len(payload) < len(MEMBER_MAGIC) + 8 + 8 * k:
+            return None
+        recs = [struct.unpack_from("<ii", payload,
+                                   len(MEMBER_MAGIC) + 8 + 8 * i)
+                for i in range(k)]
+        return new_epoch, recs
 
     def _view_key(self):
         """Total order on membership views: higher epoch wins, then
@@ -2190,13 +2314,18 @@ class ProgressEngine:
         return inc
 
     def _send_join_probe(self, dst: int) -> None:
-        # (incarnation, epoch, min-alive-rank, petition): petition=1
-        # marks a JOINER's plea (it has reset itself and quarantines
-        # everything) vs a survivor's heal probe at a failed peer
+        # (incarnation, epoch, min-alive-rank, petition, member):
+        # petition=1 marks a JOINER's plea (it has reset itself and
+        # quarantines everything) vs a survivor's heal probe at a
+        # failed peer; member=1 tells dst it is ALIVE in the sender's
+        # view — a losing-view receiver then catches up with a
+        # Tag.MSYNC view sync instead of a full rejoin (§18). Old
+        # 4-field probes parse as member=0 (full rejoin: status quo).
         payload = struct.pack(
-            "<iiii", self.incarnation, self.epoch,
+            "<iiiii", self.incarnation, self.epoch,
             min(self._alive) if self._alive else self.rank,
-            1 if self._awaiting_welcome else 0)
+            1 if self._awaiting_welcome else 0,
+            0 if (self._awaiting_welcome or dst in self.failed) else 1)
         self._send_raw(dst, int(Tag.JOIN),
                        Frame(origin=self.rank, payload=payload).encode())
         TRACER.emit(self.rank, Ev.JOIN, dst, 1, self.incarnation,
@@ -2234,16 +2363,30 @@ class ProgressEngine:
         if self._pending_joins and \
                 self.my_own_proposal.state != ReqState.IN_PROGRESS \
                 and self._alive[0] == self.rank:
-            joiner = next(iter(self._pending_joins))
-            inc, jep = self._pending_joins.pop(joiner)
-            if joiner in self.failed and joiner not in self._admitting:
-                self._admitting.add(joiner)
-                # the agreed post-admission epoch: above BOTH sides'
-                # views, so the joiner's fresh frames clear every
-                # member's floor and its old life's frames never do
-                new_epoch = max(self.epoch, jep) + 1
+            # batched admissions (docs/DESIGN.md §18): drain EVERY
+            # servable queued petition into one IAR round — under
+            # churn the petitions arrive in bursts (every victim of a
+            # partition heals at once), and k sequential rounds were
+            # the measured admission_rounds amplifier
+            batch = []
+            max_jep = self.epoch
+            for joiner in list(self._pending_joins):
+                inc, jep = self._pending_joins.pop(joiner)
+                if joiner in self.failed and \
+                        joiner not in self._admitting:
+                    batch.append((joiner, inc))
+                    if jep > max_jep:
+                        max_jep = jep
+            if batch:
+                # the agreed post-admission epoch: above EVERY side's
+                # view, so each joiner's fresh frames clear every
+                # member's floor and their old lives' frames never do
+                new_epoch = max_jep + 1
                 payload = MEMBER_MAGIC + struct.pack(
-                    "<iii", joiner, inc, new_epoch)
+                    "<ii", new_epoch, len(batch))
+                for joiner, inc in batch:
+                    self._admitting.add(joiner)
+                    payload += struct.pack("<ii", joiner, inc)
                 # membership watchdog (mirror of the C engine's
                 # own_deadline): an engine-initiated round straddling
                 # a view change can park into a cyclic mixed-view
@@ -2256,7 +2399,7 @@ class ProgressEngine:
                         20 * self.join_interval)
                 self.admission_rounds += 1
                 self.submit_proposal(payload,
-                                     pid=self._member_pid(joiner),
+                                     pid=self._member_pid(batch[0][0]),
                                      deadline=deadline)
         # cadence gate first: the set difference allocates, and this
         # runs every progress turn while any peer is failed
@@ -2284,6 +2427,10 @@ class ProgressEngine:
             return
         inc, ep, malive, petition = struct.unpack_from("<iiii",
                                                        f.payload)
+        # 5th field (PR-16): dst-is-a-member flag; absent on old
+        # 4-field probes, which parse as 0 (full rejoin: status quo)
+        member = struct.unpack_from("<i", f.payload, 16)[0] \
+            if len(f.payload) >= 20 else 0
         TRACER.emit(self.rank, Ev.JOIN, src, 0, inc, ep)
         if self._awaiting_welcome:
             return  # mid-rejoin ourselves; the winning side sorts us
@@ -2292,6 +2439,13 @@ class ProgressEngine:
             (my_key == their_key and self.rank < src)
         if src in self.failed:
             if not mine_wins:
+                if member:
+                    # the winning view still holds me as a member: I
+                    # am merely epoch-lagging, not excluded — catch up
+                    # with a view-state sync instead of the full
+                    # rejoin that used to strand every laggard (§18)
+                    self._request_sync(src)
+                    return
                 self._become_joiner()
                 return
             if inc < self._admitted.get(src, -1):
@@ -2300,8 +2454,24 @@ class ProgressEngine:
                 return  # a round for it is already queued/in flight
             self._pending_joins[src] = (inc, ep)
         elif not mine_wins:
+            if member:
+                self._request_sync(src)
+                return
             self._become_joiner()
         elif petition:
+            admitted_inc = self._admitted.get(src, -1)
+            if inc < admitted_inc:
+                return  # stale petition from an already-replaced life
+            if inc == admitted_inc and self._reset_epoch.get(src, 0):
+                # sync-supersedes-welcome (§18): this exact life was
+                # already admitted here, so its JOIN_WELCOME was lost
+                # in flight. The old answer — re-declare it failed and
+                # re-admit — was the measured rejoin-cascade
+                # amplifier; a view-state sync response carries
+                # everything the welcome did and repeats for free on
+                # the petition cadence until one lands.
+                self._msync_serve(src)
+                return
             # a rank we consider ALIVE is petitioning against our
             # winning view: it has reset itself and quarantines our
             # traffic, so it is effectively failed here — adopt +
@@ -2309,8 +2479,7 @@ class ProgressEngine:
             # this, a lone stale-view winner would answer petitions
             # with probes forever and nobody would ever admit anyone)
             self._announce_failed(src)
-            if inc >= self._admitted.get(src, -1) and \
-                    src not in self._admitting:
+            if inc >= admitted_inc and src not in self._admitting:
                 self._pending_joins[src] = (inc, ep)
         else:
             # the prober holds a losing view yet thinks we are alive
@@ -2318,22 +2487,26 @@ class ProgressEngine:
             self._send_join_probe(src)
 
     def _finish_member_round(self, p: ProposalState) -> None:
-        """Admitting proposer's epilogue: execute the admission, then
-        welcome + replay to the joiner."""
+        """Admitting proposer's epilogue: execute the batch of
+        admissions, then welcome + replay to each joiner."""
         adm = self._member_decode(self.my_proposal_payload)
         if adm is None:
             return
-        joiner, inc, new_epoch = adm
-        self._admitting.discard(joiner)
-        self._pending_joins.pop(joiner, None)
+        new_epoch, recs = adm
+        for joiner, _inc in recs:
+            self._admitting.discard(joiner)
+            self._pending_joins.pop(joiner, None)
         if not p.vote:
             return
-        self._execute_admission(joiner, inc, new_epoch)
-        self._send_welcome(joiner, inc, new_epoch)
-        self._replay_recent(joiner)
+        for joiner, inc in recs:
+            if self._execute_admission(joiner, inc, new_epoch) and \
+                    len(recs) > 1:
+                self.batched_admits += 1
+            self._send_welcome(joiner, inc, new_epoch)
+            self._replay_recent(joiner)
 
     def _execute_admission(self, joiner: int, inc: int,
-                           new_epoch: int) -> None:
+                           new_epoch: int) -> bool:
         """Adopt an admission decision into the membership view
         (idempotent): re-form the overlay to include the joiner, raise
         the epoch to the agreed value, set the joiner's epoch floor
@@ -2343,17 +2516,22 @@ class ProgressEngine:
         misread as duplicates. The send-side seq counter is never
         reset (monotone for this process's lifetime), so a peer that
         keeps its window across our reset can never misread our fresh
-        frames as duplicates either."""
+        frames as duplicates either. Returns True when the admission
+        actually executed (passed the idempotence guard)."""
         if not (0 <= joiner < self.world_size) or joiner == self.rank \
                 or joiner in self._sub_excluded:
-            return
+            return False
         if new_epoch <= self._admit_epoch.get(joiner, 0):
             # stale or duplicate admission artifact (an old decision
             # re-flooded out of a replaced view): executing it would
             # re-run the link reset ONE-SIDED and permanently desync
             # the ARQ windows on that edge
-            return
+            return False
         self._admit_epoch[joiner] = new_epoch
+        # a CERTIFIED link-reset epoch (unlike the wholesale welcome
+        # inflation of _admit_epoch): sync responses built from it can
+        # tell a laggard which floor is safe for this member (§18)
+        self._reset_epoch[joiner] = new_epoch
         self.epoch = max(self.epoch, new_epoch)
         self._admitted[joiner] = max(inc, self._admitted.get(joiner, -1))
         self._epoch_floor[joiner] = new_epoch
@@ -2373,9 +2551,15 @@ class ProgressEngine:
         self._tx_skip.pop(joiner, None)
         self._rx_seen.pop(joiner, None)
         self._ack_due.discard(joiner)
-        # fresh heartbeat grace — the joiner may be our new predecessor
-        # and a stale stamp would re-declare it instantly
-        self._hb_seen[joiner] = self.clock()
+        # joiner-liveness grace (§18): a mid-rejoin joiner does not
+        # heartbeat until its JOIN_WELCOME (or superseding sync)
+        # lands, so a plain now-stamp re-declares it failed whenever
+        # the welcome leg outlasts failure_timeout — the self-
+        # reinforcing half of the rejoin cascade. Date the stamp into
+        # the future by half the admission-round deadline; any
+        # accepted frame from the joiner refreshes it to a live stamp.
+        self._hb_seen[joiner] = self.clock() + max(
+            2 * (self.failure_timeout or 0.0), 10 * self.join_interval)
         # abandoned concurrent admission rounds for this joiner (their
         # proposer's watchdog fired, or the round wedged in a
         # mixed-view tree) are settled by THIS admission: unpark
@@ -2391,7 +2575,7 @@ class ProgressEngine:
         # re-flooded: it would kill the fresh incarnation
         self._purge_stale_failures({joiner})
         if joiner not in self.failed:
-            return  # view unchanged (concurrent admitting proposer)
+            return True  # view unchanged (concurrent admitting proposer)
         self.failed.discard(joiner)
         self._alive, self._v = topology.shared_view(
             tuple(sorted(self._alive + [joiner])))
@@ -2405,6 +2589,7 @@ class ProgressEngine:
         # plug forwarding holes across the overlay re-form, exactly
         # like the failure path does
         self._reflood_recent_bcasts()
+        return True
 
     def _send_welcome(self, joiner: int, inc: int,
                       new_epoch: int) -> None:
@@ -2471,6 +2656,19 @@ class ProgressEngine:
             # desynced ARQ window) — the exact mirror of the members'
             # _admit_epoch idempotence rule.
             return
+        self._adopt_view(new_epoch, members, inc, msg.src)
+
+    def _adopt_view(self, new_epoch: int, members, inc: int,
+                    src: int) -> None:
+        """Wholesale view adoption — the shared core of JOIN_WELCOME
+        and the sync-supersede path (§18): a certified admission of
+        THIS life at ``new_epoch`` whose notification reached us
+        either as the welcome itself or as a sync response after the
+        welcome was lost. Adopts epoch, member list, fresh link state
+        and heartbeat grace everywhere, per-member epoch floors at the
+        agreed epoch (members only send to us AFTER executing the
+        admission, so everything below the floor is pre-partition
+        leftovers)."""
         # out-of-range entries (corrupt/foreign frame) are dropped,
         # not adopted — the C on_welcome filters identically
         mem = sorted({m for m in members
@@ -2507,6 +2705,11 @@ class ProgressEngine:
                              if m != self.rank}
         self._link_epoch = {m: new_epoch for m in mem
                             if m != self.rank}
+        # our pre-adoption link-reset certifications described a view
+        # we just replaced wholesale; serving sync floors from them
+        # would hand laggards one-sided floors (§18)
+        self._reset_epoch.clear()
+        self._sync_req_last.clear()
         self._purge_stale_failures(set(mem))
         # relayed rounds whose proposer is outside the adopted view
         # can never resolve here — unpark them as FAILED (the mirror
@@ -2518,11 +2721,233 @@ class ProgressEngine:
         self.rejoins += 1
         self.view_changes += 1
         self._join_last_probe = float("-inf")
+        # advertise the log retained across the rejoin: this rank may
+        # be the SOLE holder of its old life's entries (e.g. an abort
+        # flooded while partitioned alone), and no later view change
+        # is guaranteed to occur here — the WANT-side guards
+        # (_have_log_entry) make stale entries harmless
+        self._reflood_recent_bcasts()
         TRACER.emit(self.rank, Ev.ADMIT, self.rank, self.epoch, inc,
-                    msg.src)
+                    src)
         logger.info("rank %d rejoined at epoch %d (welcomed by rank "
-                    "%d); members %s", self.rank, self.epoch, msg.src,
+                    "%d); members %s", self.rank, self.epoch, src,
                     mem)
+
+    # -- Tag.MSYNC: view-state sync (docs/DESIGN.md §18) ---------------
+
+    def _request_sync(self, dst: int) -> None:
+        """Ask an up-to-date peer for a view-state sync: the epoch
+        catch-up path that replaces the full rejoin a laggard used to
+        be stranded into. Rate-limited per destination at
+        join_interval — the probes that trigger it repeat on the
+        peer's heal-probe cadence, so one outstanding REQ per peer is
+        enough and loss costs one cadence interval, never progress."""
+        now = self.clock()
+        if now - self._sync_req_last.get(dst, float("-inf")) < \
+                self.join_interval:
+            return
+        self._sync_req_last[dst] = now
+        payload = struct.pack("<Bii", MSYNC_REQ, self.epoch,
+                              self.incarnation)
+        self._send_raw(dst, int(Tag.MSYNC),
+                       Frame(origin=self.rank, payload=payload).encode())
+
+    def _on_msync(self, msg: _Msg) -> None:
+        """Dispatch a Tag.MSYNC frame by kind byte. MSYNC is ARQ- and
+        epoch-exempt exactly like JOIN — REQs repeat on the probe
+        cadence, adverts are re-issued on every view change — so a
+        lost frame costs latency, never correctness."""
+        src = msg.src
+        if not (0 <= src < self.world_size) or src == self.rank or \
+                src in self._sub_excluded:
+            return
+        p = msg.frame.payload
+        if len(p) < 1:
+            return
+        kind = p[0]
+        if kind == MSYNC_REQ:
+            if len(p) < 9:
+                return
+            _req_ep, inc = struct.unpack_from("<ii", p, 1)
+            if src in self.failed:
+                # can't certify link state toward a rank this view
+                # holds failed: show it the winning view so it
+                # petitions for readmission instead
+                self._send_join_probe(src)
+                return
+            if inc < self._admitted.get(src, -1):
+                return  # stale REQ from an already-replaced life
+            self._msync_serve(src)
+        elif kind == MSYNC_RSP:
+            self._msync_adopt(msg, p)
+        elif kind == MSYNC_AD:
+            # a joiner's dedup state is mid-reset and a failed peer's
+            # link is quarantined: neither side can exchange WANTs
+            if not self._awaiting_welcome and src not in self.failed:
+                self._msync_advert(src, p, 1)
+        elif kind == MSYNC_WANT:
+            if not self._awaiting_welcome and src not in self.failed:
+                self._msync_want(src, p)
+
+    def _msync_serve(self, dst: int) -> None:
+        """Build + send a MSYNC_RSP: epoch, member records, and the
+        recent-log advert. Per-member records carry only CERTIFIED
+        link-reset epochs (_reset_epoch, set solely by
+        _execute_admission) — never the wholesale welcome inflation of
+        _admit_epoch, which would hand the laggard a one-sided floor
+        for members whose links were never actually reset (§18)."""
+        if self._awaiting_welcome:
+            return  # mid-rejoin: nothing certifiable to serve
+        payload = bytearray(struct.pack(
+            "<Bii", MSYNC_RSP, self.epoch, len(self._alive)))
+        for m in self._alive:
+            if m == self.rank:
+                payload += struct.pack("<iii", m, self._welcome_epoch,
+                                       self.incarnation)
+            else:
+                payload += struct.pack(
+                    "<iii", m, self._reset_epoch.get(m, 0),
+                    self._admitted.get(m, -1))
+        ad = self._advert_payload()
+        # embedded advert tail: same <i>count + <iii>-triple body as a
+        # standalone MSYNC_AD, minus its kind byte
+        payload += ad[1:] if ad is not None else struct.pack("<i", 0)
+        if len(payload) + 64 > MSG_SIZE_MAX:
+            # view too large for one frame (pathological world_size):
+            # fall back to the full-rejoin path rather than truncate
+            self._send_join_probe(dst)
+            return
+        self._send_raw(dst, int(Tag.MSYNC),
+                       Frame(origin=self.rank,
+                             payload=bytes(payload)).encode())
+
+    def _msync_adopt(self, msg: _Msg, p: bytes) -> None:
+        """A MSYNC_RSP arrived: catch up to the responder's view
+        without a full rejoin. Three cases: (1) the response certifies
+        an admission of THIS life we never saw the welcome for —
+        wholesale adoption, exactly as the welcome would have done
+        (sync-supersedes-welcome); (2) we are a mere epoch laggard —
+        execute the certified per-member admissions we missed and
+        adopt the responder's failures; (3) nothing certifiable heals
+        the link to the responder — fall back to a full rejoin, the
+        pre-§18 status quo, so every sync exchange strictly
+        progresses."""
+        src = msg.src
+        if len(p) < 9:
+            return
+        rsp_epoch, n = struct.unpack_from("<ii", p, 1)
+        if n < 0 or len(p) < 9 + 12 * n:
+            return
+        # staleness, judged at ARRIVAL epoch (adoption below may raise
+        # it): a response no newer than my view means I progressed
+        # past the request in flight — I am not the laggard anymore
+        stale = rsp_epoch <= self.epoch
+        recs = [struct.unpack_from("<iii", p, 9 + 12 * i)
+                for i in range(n)]
+        ad_off = 9 + 12 * n
+        mine = next(((aep, ainc) for m, aep, ainc in recs
+                     if m == self.rank), None)
+        if mine is None:
+            # the responder's view does not hold me at all: if it
+            # wins, only a full rejoin gets me back in
+            if rsp_epoch > self.epoch:
+                self._become_joiner()
+            return
+        aep, ainc = mine
+        adopted = False
+        if ainc == self.incarnation and aep > self._welcome_epoch:
+            # lost-welcome supersede: the responder certifies THIS
+            # life was admitted at aep but no welcome ever landed —
+            # adopt the view wholesale with the welcome's exact
+            # semantics (un-wedges _awaiting_welcome, satellite a)
+            self._adopt_view(aep, [m for m, _a, _i in recs],
+                             self.incarnation, src)
+            self.epoch = max(self.epoch, rsp_epoch)
+            adopted = True
+        elif self._awaiting_welcome:
+            # mid-rejoin and the response does not certify this life:
+            # keep petitioning — only an admission can help now
+            return
+        else:
+            # laggard catch-up: execute certified admissions (aep > 0
+            # entries only; a zero means "no reset I can vouch for")
+            for m, maep, mainc in recs:
+                if m != self.rank and maep > 0 and \
+                        maep > self._admit_epoch.get(m, 0):
+                    if self._execute_admission(m, mainc, maep):
+                        adopted = True
+            if rsp_epoch > self.epoch:
+                # adopt the responder's failures: ranks alive here but
+                # absent from its strictly-newer view, unless an
+                # admission we already executed post-dates it
+                present = {m for m, _a, _i in recs}
+                for r in [r for r in self._alive if r != self.rank
+                          and r not in present]:
+                    if rsp_epoch > self._admit_epoch.get(r, 0):
+                        self._mark_failed(r)
+                self.epoch = max(self.epoch, rsp_epoch)
+                adopted = True
+        if src in self.failed:
+            if stale:
+                # the RSP predates local progress: dropping it is
+                # safe — my frames at the responder trigger ITS sync
+                # or rejoin, and becoming a joiner off stale state
+                # can wedge the whole fleet in joiner mode (the
+                # last member self-demoting leaves no admitter)
+                return
+            # progress fallback: nothing in the response re-certified
+            # the responder's link, so the two views cannot converge
+            # by sync alone — full rejoin (status quo ante)
+            self._become_joiner()
+            return
+        if adopted:
+            self.epoch_syncs += 1
+        if len(p) >= ad_off + 4:
+            self._msync_advert(src, p, ad_off)
+
+    def _msync_advert(self, src: int, p: bytes, off: int) -> None:
+        """MSYNC_AD body at ``off``: <i>count + count x <iii>(tag, a,
+        b) recent-log identities. Answer with a WANT naming exactly
+        the entries this rank provably misses; each entry already held
+        is a re-flood frame the old blast would have wasted
+        (reflood_skipped)."""
+        if len(p) < off + 4:
+            return
+        cnt = struct.unpack_from("<i", p, off)[0]
+        if cnt < 0 or len(p) < off + 4 + 12 * cnt:
+            return
+        want = []
+        for i in range(cnt):
+            t, a, b = struct.unpack_from("<iii", p, off + 4 + 12 * i)
+            if self._have_log_entry(t, a, b):
+                self.reflood_skipped += 1
+            else:
+                want.append((t, a, b))
+        if not want:
+            return
+        out = bytearray(struct.pack("<Bi", MSYNC_WANT, len(want)))
+        for t, a, b in want:
+            out += struct.pack("<iii", t, a, b)
+        self._send_raw(src, int(Tag.MSYNC),
+                       Frame(origin=self.rank,
+                             payload=bytes(out)).encode())
+
+    def _msync_want(self, src: int, p: bytes) -> None:
+        """A WANT reply to our advert: re-send exactly the named
+        recent-log entries (through the ARQ gate, fresh link seqs —
+        a new transmission, not a retransmit; app-level dedup absorbs
+        any crossing duplicates)."""
+        if len(p) < 5:
+            return
+        cnt = struct.unpack_from("<i", p, 1)[0]
+        if cnt < 0 or len(p) < 5 + 12 * cnt:
+            return
+        wanted = {struct.unpack_from("<iii", p, 5 + 12 * i)
+                  for i in range(cnt)}
+        for tag, raw in list(self._recent_bcasts):
+            if self._log_entry_ident(tag, raw) in wanted:
+                self.reflood_frames += 1
+                self._send_raw(src, tag, raw)
 
     def _on_other(self, msg: _Msg) -> None:
         """Unknown/aux tags go straight to pickup (reference prints and

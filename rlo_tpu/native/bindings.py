@@ -122,6 +122,9 @@ class _Stats(C.Structure):
                 ("quar_failed_sender", C.c_int64),
                 ("quar_below_floor", C.c_int64),
                 ("admission_rounds", C.c_int64),
+                ("epoch_syncs", C.c_int64),
+                ("reflood_skipped", C.c_int64),
+                ("batched_admits", C.c_int64),
                 ("q_wait", C.c_int64), ("q_pickup", C.c_int64),
                 ("q_wait_and_pickup", C.c_int64),
                 ("q_iar_pending", C.c_int64),

@@ -57,8 +57,12 @@ enum rlo_tag {
                              * (documented divergence, rlo_engine.c).
                              * rlo-lint: default-route */
     RLO_TAG_JOIN = 15,      /* membership probe/petition: payload =
-                             * (incarnation, epoch, min-alive, petition),
-                             * 4 x le32 (docs/DESIGN.md S8) */
+                             * (incarnation, epoch, min-alive, petition,
+                             * member), 5 x le32 (docs/DESIGN.md S8/S18;
+                             * member=1 tells the DESTINATION it is
+                             * alive in the sender's view — catch up
+                             * via MSYNC, not a full rejoin. Old
+                             * 4-field probes parse as member=0) */
     RLO_TAG_JOIN_WELCOME = 16, /* admission notice: payload = (epoch,
                              * incarnation echo, n) + n member ranks;
                              * followed by a point-to-point replay of
@@ -74,6 +78,12 @@ enum rlo_tag {
                              * a delta-encoded digest (rlo_telem_encode
                              * below), consumed by the telemetry plane.
                              * rlo-lint: default-route */
+    RLO_TAG_MSYNC = 19,     /* membership view-state sync (docs/
+                             * DESIGN.md S18): payload = kind byte
+                             * (0 REQ / 1 RSP / 2 AD / 3 WANT) +
+                             * kind-specific body. ARQ- and epoch-
+                             * exempt like JOIN: the catch-up channel
+                             * must cross the quarantine it heals. */
 };
 
 /* ---- request/proposal states (reference RLO_Req_stat) ---- */
@@ -480,6 +490,12 @@ typedef struct rlo_stats {
     int64_t view_changes, reflood_frames, epoch_lag_max;
     int64_t quar_mid_rejoin, quar_failed_sender, quar_below_floor;
     int64_t admission_rounds;
+    /* churn-proof healing (docs/DESIGN.md S18): epoch catch-ups
+     * adopted via Tag.MSYNC (instead of full rejoins), advert entries
+     * a re-flood receiver already held (frames the pre-S18 blast
+     * would have wasted), and joiners admitted through multi-joiner
+     * batched admission records */
+    int64_t epoch_syncs, reflood_skipped, batched_admits;
     int64_t q_wait, q_pickup, q_wait_and_pickup, q_iar_pending;
     rlo_hist bcast_complete, proposal_resolve, pickup_wait;
 } rlo_stats;
@@ -527,7 +543,7 @@ int rlo_engine_phase_stats(const rlo_engine *e, rlo_phase_stats *out);
 /* ------------------------------------------------------------------ */
 #define RLO_TELEM_MAGIC "RLOT\x01"
 #define RLO_TELEM_HEADER_SIZE 22
-#define RLO_TELEM_NKEYS 25
+#define RLO_TELEM_NKEYS 28
 /* Pure codec (no engine): encode vals[RLO_TELEM_NKEYS] as a digest,
  * delta vs prev (NULL or full != 0 => full snapshot, deltas vs zero).
  * Returns bytes written or RLO_ERR_TOO_BIG/RLO_ERR_ARG. */

@@ -233,6 +233,9 @@ struct rlo_engine {
     int64_t epoch_lag_max;  /* max(my epoch - accepted frame epoch) */
     int64_t quar_mid_rejoin, quar_failed_sender, quar_below_floor;
     int64_t admission_rounds; /* IAR admission rounds launched here */
+    int64_t epoch_syncs;      /* MSYNC view adoptions (no full rejoin) */
+    int64_t reflood_skipped;  /* advertised log entries already held */
+    int64_t batched_admits;   /* joiners admitted in multi-joiner rounds */
     /* telemetry digest origination state (rlo_engine_telem_digest):
      * last-emitted sample (the delta base) + per-engine digest seq */
     int64_t telem_prev[RLO_TELEM_NKEYS];
@@ -256,6 +259,13 @@ struct rlo_engine {
     uint8_t *sub_excluded;  /* never probed/admitted (engine_new_sub) */
     uint8_t *gave_scratch;  /* per dst: ARQ give-up escalation flags */
     uint64_t *stale_probe_last; /* per src: last stale-sender nack */
+    /* membership healing (docs/DESIGN.md S18): per member the CERTIFIED
+     * link-reset epoch — set only when an admission executes HERE, so it
+     * can seed third-party floors during MSYNC catch-up (the wholesale
+     * welcome adoption cannot: it inflates admit_epoch for members whose
+     * links were never reset) — plus the per-dst MSYNC request limiter */
+    int32_t *reset_epoch;
+    uint64_t *sync_req_last;
     int n_pending;          /* pending_join population */
     int n_excluded;         /* sub_excluded population */
 };
@@ -263,17 +273,45 @@ struct rlo_engine {
 /* Membership admission rounds live in the reserved pid namespace
  * pid <= RLO_MEMBER_PID_BASE (app pids are >= -1); pid =
  * BASE - (joiner * ws + proposer) keeps concurrent admissions of one
- * joiner by different proposers on distinct pids. Payload =
- * MAGIC + [joiner:i32][incarnation:i32][new_epoch:i32]. */
+ * joiner by different proposers on distinct pids (a BATCHED round uses
+ * the first joiner's pid). Record v2 (docs/DESIGN.md S18) admits k
+ * queued joiners in ONE round: payload =
+ * MAGIC + [new_epoch:i32][k:i32] + k x ([joiner:i32][incarnation:i32]).
+ * Byte-identical to engine.py's MEMBER_MAGIC record. */
 #define RLO_MEMBER_PID_BASE (-2)
 #define RLO_MEMBER_MAGIC_LEN 5
 static const uint8_t RLO_MEMBER_MAGIC[RLO_MEMBER_MAGIC_LEN] = {
-    'R', 'L', 'O', 'J', 1};
+    'R', 'L', 'O', 'J', 2};
+
+/* MSYNC payload kind byte (first payload octet; mirrors engine.py's
+ * MSYNC_REQ/RSP/AD/WANT constants — docs/DESIGN.md S18) */
+#define RLO_MSYNC_REQ 0  /* <B><ii> requester epoch, incarnation */
+#define RLO_MSYNC_RSP 1  /* <B><ii> epoch, n + n x <iii> + advert tail */
+#define RLO_MSYNC_AD 2   /* <B><i> count + count x <iii> log idents */
+#define RLO_MSYNC_WANT 3 /* <B><i> count + count x <iii> wanted idents */
 
 static int32_t get_le32(const uint8_t *p)
 {
     return (int32_t)((uint32_t)p[0] | ((uint32_t)p[1] << 8) |
                      ((uint32_t)p[2] << 16) | ((uint32_t)p[3] << 24));
+}
+
+/* Decode a batched admission record (see RLO_MEMBER_MAGIC above):
+ * returns k >= 1 with *new_epoch set and *recs pointing at the
+ * k x [joiner:i32][inc:i32] body, or 0 on malformed/foreign payloads
+ * (mirror of engine.py _member_decode). */
+static int member_decode(const uint8_t *payload, int64_t len,
+                         int32_t *new_epoch, const uint8_t **recs)
+{
+    if (!payload || len < RLO_MEMBER_MAGIC_LEN + 8 ||
+        memcmp(payload, RLO_MEMBER_MAGIC, RLO_MEMBER_MAGIC_LEN))
+        return 0;
+    int k = get_le32(payload + RLO_MEMBER_MAGIC_LEN + 4);
+    if (k < 1 || len < RLO_MEMBER_MAGIC_LEN + 8 + 8 * (int64_t)k)
+        return 0;
+    *new_epoch = get_le32(payload + RLO_MEMBER_MAGIC_LEN);
+    *recs = payload + RLO_MEMBER_MAGIC_LEN + 8;
+    return k;
 }
 
 /* ---------------- metrics helpers ---------------- */
@@ -565,7 +603,8 @@ static void rtx_release(rlo_engine *e, rlo_rtx *rt)
 static int arq_exempt(int tag)
 {
     return tag == RLO_TAG_HEARTBEAT || tag == RLO_TAG_ACK ||
-           tag == RLO_TAG_JOIN || tag == RLO_TAG_JOIN_WELCOME;
+           tag == RLO_TAG_JOIN || tag == RLO_TAG_JOIN_WELCOME ||
+           tag == RLO_TAG_MSYNC;
 }
 
 /* isend one already-encoded frame blob; when track_in != NULL the
@@ -775,6 +814,9 @@ rlo_engine *rlo_engine_new(rlo_world *w, int rank, int comm,
     e->gave_scratch = (uint8_t *)calloc((size_t)e->ws, 1);
     e->stale_probe_last =
         (uint64_t *)calloc((size_t)e->ws, sizeof(uint64_t));
+    e->reset_epoch = (int32_t *)calloc((size_t)e->ws, sizeof(int32_t));
+    e->sync_req_last =
+        (uint64_t *)calloc((size_t)e->ws, sizeof(uint64_t));
     if (e->seen_contig)
         for (int r = 0; r < e->ws; r++)
             e->seen_contig[r] = -1;
@@ -795,7 +837,7 @@ rlo_engine *rlo_engine_new(rlo_world *w, int rank, int comm,
         !e->admit_epoch || !e->admitted_inc || !e->admitting ||
         !e->pending_join || !e->pending_inc || !e->pending_ep ||
         !e->sub_excluded || !e->gave_scratch ||
-        !e->stale_probe_last ||
+        !e->stale_probe_last || !e->reset_epoch || !e->sync_req_last ||
         rlo_world_register(w, e) != RLO_OK) {
         free(e->failed);
         free(e->hb_seen);
@@ -821,6 +863,8 @@ rlo_engine *rlo_engine_new(rlo_world *w, int rank, int comm,
         free(e->sub_excluded);
         free(e->gave_scratch);
         free(e->stale_probe_last);
+        free(e->reset_epoch);
+        free(e->sync_req_last);
         free(e);
         return 0;
     }
@@ -914,6 +958,8 @@ void rlo_engine_free(rlo_engine *e)
     free(e->sub_excluded);
     free(e->gave_scratch);
     free(e->stale_probe_last);
+    free(e->reset_epoch);
+    free(e->sync_req_last);
     while (e->rtx_head)
         rtx_release(e, e->rtx_head);
     free(e->rtx_by_dst);
@@ -1020,9 +1066,12 @@ static int round_settled_peek(const rlo_engine *e, int32_t pid,
                               int32_t gen);
 static int announce_failed(rlo_engine *e, int rank);
 static void become_joiner(rlo_engine *e);
-static void execute_admission(rlo_engine *e, int joiner, int inc,
-                              int32_t new_epoch);
+static int execute_admission(rlo_engine *e, int joiner, int inc,
+                             int32_t new_epoch);
 static void finish_member_round(rlo_engine *e);
+static void request_sync(rlo_engine *e, int dst);
+static void msync_serve(rlo_engine *e, int dst);
+static void on_msync(rlo_engine *e, rlo_msg *m);
 
 /* ---------------- exactly-once broadcast dedup -------------------- */
 
@@ -1072,6 +1121,21 @@ static int window_record(int64_t *contig, uint64_t *mask, int64_t seq)
         (*contig)++;
     }
     return 0;
+}
+
+/* Check-only variant of window_record: never mutates the window, so it
+ * is safe inside the MSYNC advert filter (have_log_entry) — recording
+ * there would poison the dedup against the real frame that the WANT
+ * round is about to fetch. */
+static int window_peek(const int64_t *contig, const uint64_t *mask,
+                       int64_t seq)
+{
+    if (seq <= *contig)
+        return 1;
+    int64_t off = seq - *contig - 1;
+    if (off >= RLO_SEEN_BITS)
+        return 0;
+    return (mask[off >> 6] & (1ull << (off & 63))) != 0;
 }
 
 /* (origin, seq) receipt check for BCAST frames. The initiator never
@@ -1397,22 +1461,133 @@ static void recent_log_push(rlo_engine *e, rlo_blob *frame, int tag)
     e->recent_pos = (e->recent_pos + 1) % RLO_RECENT_LOG;
 }
 
-/* Plug forwarding holes a dead relay left: re-send every logged frame
- * point-to-point to every alive rank; receivers drop the (origin, seq)
- * duplicates. Together flood + dedup make BCAST delivery exactly-once
- * across view changes for any initiator that survived. */
+/* (tag, a, b) wire identity of one recent-log entry — the coordinates
+ * the MSYNC advert/WANT pair exchanges instead of payloads (mirror of
+ * engine.py _log_entry_ident). Returns 0 for entries with no
+ * recoverable identity. The C log holds BCAST/IAR_DECISION/FAILURE
+ * entries only (no ABORT receive path — see abort_own_round). */
+static int log_entry_ident(const rlo_engine *e, int idx, int32_t *t,
+                           int32_t *a, int32_t *b)
+{
+    rlo_blob *blob = e->recent[idx];
+    if (!blob)
+        return 0;
+    int tag = e->recent_tag[idx];
+    int32_t origin, pid, vote;
+    const uint8_t *pl;
+    int64_t plen = rlo_frame_decode(blob->data, blob->len, &origin,
+                                    &pid, &vote, 0, &pl);
+    if (plen < 0)
+        return 0;
+    if (tag == RLO_TAG_BCAST) {
+        *t = tag;
+        *a = origin; /* (origin, bcast seq) */
+        *b = vote;
+        return 1;
+    }
+    if (tag == RLO_TAG_IAR_DECISION || tag == RLO_TAG_ABORT) {
+        if (plen < 4)
+            return 0;
+        *t = tag;
+        *a = pid; /* (pid, gen) */
+        *b = get_le32(pl);
+        return *b >= 0;
+    }
+    if (tag == RLO_TAG_FAILURE) {
+        *t = tag;
+        *a = pid; /* (failed rank, declarer epoch) */
+        *b = vote;
+        return 1;
+    }
+    return 0;
+}
+
+/* Build the MSYNC_AD payload ([kind:u8][count:i32] + count x
+ * [tag:i32][a:i32][b:i32]) for the current recent log into `out`
+ * (cap >= 5 + 12 * RLO_RECENT_LOG); returns the payload length, or 0
+ * when the log holds nothing advertisable. */
+static int64_t advert_payload(const rlo_engine *e, uint8_t *out)
+{
+    int cnt = 0;
+    int64_t pos = 5;
+    for (int i = 0; i < RLO_RECENT_LOG; i++) {
+        int32_t t, a, b;
+        if (!log_entry_ident(e, i, &t, &a, &b))
+            continue;
+        put_le32(out + pos, t);
+        put_le32(out + pos + 4, a);
+        put_le32(out + pos + 8, b);
+        pos += 12;
+        cnt++;
+    }
+    if (!cnt)
+        return 0;
+    out[0] = RLO_MSYNC_AD;
+    put_le32(out + 1, cnt);
+    return pos;
+}
+
+/* Does this rank provably already hold the advertised entry? Reads
+ * exactly the dedup state that would have dropped the old blast's
+ * duplicate (mirror of engine.py _have_log_entry) — an entry this
+ * returns 1 for would have been a wasted re-flood frame (counted in
+ * reflood_skipped). Check-only: window_peek and round_settled_peek
+ * never record. */
+static int have_log_entry(const rlo_engine *e, int32_t t, int32_t a,
+                          int32_t b)
+{
+    if (t == RLO_TAG_BCAST) {
+        if (a == e->rank || b < 0 || a < 0 || a >= e->ws)
+            return 1; /* my own, or unstamped (not recoverable) */
+        return window_peek(&e->seen_contig[a],
+                           &e->seen_mask[(size_t)a * RLO_SEEN_WORDS],
+                           b);
+    }
+    if (t == RLO_TAG_IAR_DECISION || t == RLO_TAG_ABORT) {
+        if (t == RLO_TAG_IAR_DECISION && a <= RLO_MEMBER_PID_BASE)
+            /* membership decisions are never WANTed: the welcome /
+             * sync-response member records are the authoritative
+             * channel, and a stale admission about a since-re-failed
+             * rank must not resurrect it (the same rule replay_recent
+             * applies) */
+            return 1;
+        return b < 0 || round_settled_peek(e, a, b);
+    }
+    if (t == RLO_TAG_FAILURE) {
+        if (a < 0 || a >= e->ws)
+            return 1;
+        /* a = failed rank, b = declarer epoch: already adopted, about
+         * myself (heal probes cover self-failure learning), or stale
+         * against an admission executed since */
+        return a == e->rank || e->failed[a] || b < e->admit_epoch[a];
+    }
+    return 1;
+}
+
+/* Plug forwarding holes a dead relay left — digest-scoped
+ * (docs/DESIGN.md S18). The pre-PR-16 heal re-sent every recent
+ * frame point-to-point to every alive rank on every view change:
+ * O(n^2 * ring) frames per churn episode, the dominant term of the
+ * measured rejoin cascade. Now each view change sends one MSYNC
+ * advert per alive peer carrying only the log entries' IDENTITIES; a
+ * peer answers with a WANT naming exactly the entries it provably
+ * misses, and only those payloads are re-sent. An empty log sends
+ * nothing at all — kill-only fleets heal for free. Delivery
+ * exactly-once composes the same way: the WANT check reads the same
+ * dedup state that would have dropped the blast's duplicates.
+ * Adverts are best-effort (ARQ-exempt): every later view change
+ * re-adverts, and the admission replay / welcome path covers the
+ * rejoin side independently. */
 static void reflood_recent(rlo_engine *e)
 {
-    for (int i = 0; i < RLO_RECENT_LOG; i++) {
-        rlo_blob *b = e->recent[i];
-        if (!b)
-            continue;
-        for (int dst = 0; dst < e->ws; dst++)
-            if (dst != e->rank && !e->failed[dst]) {
-                e->reflood_frames++;
-                eng_isend_frame(e, dst, e->recent_tag[i], b, 0);
-            }
-    }
+    uint8_t ad[5 + 12 * RLO_RECENT_LOG];
+    int64_t n = advert_payload(e, ad);
+    if (!n)
+        return;
+    for (int dst = 0; dst < e->ws; dst++)
+        if (dst != e->rank && !e->failed[dst])
+            eng_isend(e, dst, RLO_TAG_MSYNC, e->rank, -1, -1, ad, n,
+                      0);
 }
 
 /* ---------------- rootless broadcast ---------------- */
@@ -1916,21 +2091,24 @@ static void on_decision(rlo_engine *e, rlo_msg *m)
             q_remove(&e->q_iar_pending, pm);
             msg_free(pm);
         }
-        if (m->len >= 4 + RLO_MEMBER_MAGIC_LEN + 12 &&
-            !memcmp(m->payload + 4, RLO_MEMBER_MAGIC,
-                    RLO_MEMBER_MAGIC_LEN)) {
-            int joiner = get_le32(m->payload + 4 + RLO_MEMBER_MAGIC_LEN);
-            int inc = get_le32(m->payload + 8 + RLO_MEMBER_MAGIC_LEN);
-            int32_t ep = get_le32(m->payload + 12 + RLO_MEMBER_MAGIC_LEN);
-            if (joiner >= 0 && joiner < e->ws) {
-                e->admitting[joiner] = 0;
-                if (e->pending_join[joiner]) {
-                    e->pending_join[joiner] = 0;
-                    e->n_pending--;
-                }
-                if (m->vote)
-                    execute_admission(e, joiner, inc, ep);
+        int32_t new_epoch;
+        const uint8_t *recs;
+        int k = m->len >= 4 ? member_decode(m->payload + 4, m->len - 4,
+                                            &new_epoch, &recs)
+                            : 0;
+        for (int j = 0; j < k; j++) {
+            int joiner = get_le32(recs + 8 * j);
+            int inc = get_le32(recs + 8 * j + 4);
+            if (joiner < 0 || joiner >= e->ws)
+                continue;
+            e->admitting[joiner] = 0;
+            if (e->pending_join[joiner]) {
+                e->pending_join[joiner] = 0;
+                e->n_pending--;
             }
+            if (m->vote &&
+                execute_admission(e, joiner, inc, new_epoch) && k > 1)
+                e->batched_admits++;
         }
         q_append(&e->q_wait, m);
         return;
@@ -2115,6 +2293,9 @@ static int mark_failed(rlo_engine *e, int rank)
     e->epoch++;
     e->epoch_floor[rank] = 0;
     e->link_epoch[rank] = 0;
+    /* the certified link-reset record dies with the link: a sync
+     * response must never vouch floors for a failed member (S18) */
+    e->reset_epoch[rank] = 0;
     if (e->pending_join[rank]) {
         e->pending_join[rank] = 0;
         e->n_pending--;
@@ -2173,7 +2354,8 @@ static void declare_failed(rlo_engine *e, int rank)
      * last-seen heartbeat age is what makes a false-positive
      * declaration diagnosable after the fact */
     uint64_t now = rlo_now_usec();
-    uint64_t age = (rank >= 0 && rank < e->ws && e->hb_seen[rank])
+    uint64_t age = (rank >= 0 && rank < e->ws && e->hb_seen[rank] &&
+                    now > e->hb_seen[rank])
                        ? now - e->hb_seen[rank]
                        : (uint64_t)INT32_MAX;
     if (age > (uint64_t)INT32_MAX)
@@ -2266,7 +2448,10 @@ static void failure_tick(rlo_engine *e)
         e->hb_seen[pred] = now; /* grace on first watch */
         return;
     }
-    if (now - e->hb_seen[pred] > e->fd_timeout)
+    /* hb_seen may sit in the FUTURE for a freshly admitted joiner
+     * (admission grace, docs/DESIGN.md S18) — the unsigned subtraction
+     * must not underflow into an instant re-declaration */
+    if (now > e->hb_seen[pred] && now - e->hb_seen[pred] > e->fd_timeout)
         declare_failed(e, pred);
 }
 
@@ -2361,6 +2546,9 @@ int rlo_engine_stats(const rlo_engine *e, rlo_stats *out)
     out->quar_failed_sender = e->quar_failed_sender;
     out->quar_below_floor = e->quar_below_floor;
     out->admission_rounds = e->admission_rounds;
+    out->epoch_syncs = e->epoch_syncs;
+    out->reflood_skipped = e->reflood_skipped;
+    out->batched_admits = e->batched_admits;
     out->q_wait = e->q_wait.len;
     out->q_pickup = e->q_pickup.len;
     out->q_wait_and_pickup = e->q_wait_pickup.len;
@@ -2412,6 +2600,9 @@ int64_t rlo_engine_telem_digest(rlo_engine *e, int full, uint8_t *buf,
     v[i++] = e->quar_failed_sender;
     v[i++] = e->quar_below_floor;
     v[i++] = e->admission_rounds;
+    v[i++] = e->epoch_syncs;
+    v[i++] = e->reflood_skipped;
+    v[i++] = e->batched_admits;
     int64_t tx = 0, rx = 0;
     double rtt = 0.0;
     for (int r = 0; r < e->ws; r++) {
@@ -2521,12 +2712,17 @@ static void abort_own_round(rlo_engine *e)
     e->p_prop_born = 0; /* phase timers track successes only */
     e->own_deadline = 0;
     rlo_trace_emit(e->rank, RLO_EV_DECISION, p->pid, -1, p->gen, 0);
-    if (p->pid <= RLO_MEMBER_PID_BASE && p->payload &&
-        p->len >= RLO_MEMBER_MAGIC_LEN + 12 &&
-        !memcmp(p->payload, RLO_MEMBER_MAGIC, RLO_MEMBER_MAGIC_LEN)) {
-        int joiner = get_le32(p->payload + RLO_MEMBER_MAGIC_LEN);
-        if (joiner >= 0 && joiner < e->ws)
-            e->admitting[joiner] = 0;
+    if (p->pid <= RLO_MEMBER_PID_BASE && p->payload) {
+        /* aborted admission round: free every batched joiner for a
+         * retry (their next JOIN probes re-petition) */
+        int32_t new_epoch;
+        const uint8_t *recs;
+        int k = member_decode(p->payload, p->len, &new_epoch, &recs);
+        for (int j = 0; j < k; j++) {
+            int joiner = get_le32(recs + 8 * j);
+            if (joiner >= 0 && joiner < e->ws)
+                e->admitting[joiner] = 0;
+        }
     }
 }
 
@@ -2581,17 +2777,26 @@ static void become_joiner(rlo_engine *e)
     e->join_last = 0; /* probe immediately */
 }
 
-/* (incarnation, epoch, min-alive-rank, petition): petition=1 marks a
- * JOINER's plea (it has reset itself and quarantines everything) vs a
- * survivor's heal probe at a failed peer. */
+/* (incarnation, epoch, min-alive-rank, petition, member): petition=1
+ * marks a JOINER's plea (it has reset itself and quarantines
+ * everything) vs a survivor's heal probe at a failed peer; member=1
+ * tells dst it is ALIVE in the sender's view — a losing-view receiver
+ * then catches up with a Tag.MSYNC view sync instead of a full rejoin
+ * (docs/DESIGN.md S18). Old 4-field probes parse as member=0 (full
+ * rejoin: status quo). */
 static void send_join_probe(rlo_engine *e, int dst)
 {
-    uint8_t payload[16];
+    uint8_t payload[20];
     put_le32(payload, e->incarnation);
     put_le32(payload + 4, e->epoch);
     put_le32(payload + 8, min_alive(e));
     put_le32(payload + 12, e->awaiting_welcome ? 1 : 0);
-    eng_isend(e, dst, RLO_TAG_JOIN, e->rank, -1, -1, payload, 16, 0);
+    put_le32(payload + 16,
+             (e->awaiting_welcome ||
+              (dst >= 0 && dst < e->ws && e->failed[dst]))
+                 ? 0
+                 : 1);
+    eng_isend(e, dst, RLO_TAG_JOIN, e->rank, -1, -1, payload, 20, 0);
     rlo_trace_emit(e->rank, RLO_EV_JOIN, dst, 1, e->incarnation,
                    e->epoch);
 }
@@ -2635,20 +2840,25 @@ static void purge_stale_failure_rank(rlo_engine *e, int rank)
  * which the old window would misread as duplicates. The send-side
  * seq counter is never reset (monotone for this process's lifetime),
  * so a peer that keeps its window across our reset can never misread
- * our fresh frames as duplicates either. */
-static void execute_admission(rlo_engine *e, int joiner, int inc,
-                              int32_t new_epoch)
+ * our fresh frames as duplicates either. Returns 1 when the admission
+ * actually executed (passed the idempotence guard). */
+static int execute_admission(rlo_engine *e, int joiner, int inc,
+                             int32_t new_epoch)
 {
     if (joiner < 0 || joiner >= e->ws || joiner == e->rank ||
         e->sub_excluded[joiner])
-        return;
+        return 0;
     if (new_epoch <= e->admit_epoch[joiner])
         /* stale or duplicate admission artifact (an old decision
          * re-flooded out of a replaced view): executing it would
          * re-run the link reset ONE-SIDED and permanently desync the
          * ARQ windows on that edge */
-        return;
+        return 0;
     e->admit_epoch[joiner] = new_epoch;
+    /* a CERTIFIED link-reset epoch (unlike the wholesale welcome
+     * inflation of admit_epoch): sync responses built from it can
+     * tell a laggard which floor is safe for this member (S18) */
+    e->reset_epoch[joiner] = new_epoch;
     if (new_epoch > e->epoch)
         e->epoch = new_epoch;
     if (inc > e->admitted_inc[joiner])
@@ -2671,9 +2881,20 @@ static void execute_admission(rlo_engine *e, int joiner, int inc,
     memset(&e->rx_mask[(size_t)joiner * RLO_SEEN_WORDS], 0,
            RLO_SEEN_WORDS * sizeof(uint64_t));
     e->ack_due[joiner] = 0;
-    /* fresh heartbeat grace — the joiner may be our new predecessor
-     * and a stale stamp would re-declare it instantly */
-    e->hb_seen[joiner] = rlo_now_usec();
+    /* joiner-liveness grace (S18): a mid-rejoin joiner does not
+     * heartbeat until its JOIN_WELCOME (or superseding sync) lands,
+     * so a plain now-stamp re-declares it failed whenever the welcome
+     * leg outlasts fd_timeout — the self-reinforcing half of the
+     * rejoin cascade. Date the stamp into the future by half the
+     * admission-round deadline; any accepted frame from the joiner
+     * refreshes it to a live stamp. */
+    {
+        uint64_t grace = 2 * e->fd_timeout;
+        uint64_t g2 = 10 * join_iv(e);
+        if (g2 > grace)
+            grace = g2;
+        e->hb_seen[joiner] = rlo_now_usec() + grace;
+    }
     /* abandoned concurrent admission rounds for this joiner (their
      * proposer's watchdog fired, or the round wedged in a mixed-view
      * tree) are settled by THIS admission: unpark their parked relays
@@ -2690,7 +2911,7 @@ static void execute_admission(rlo_engine *e, int joiner, int inc,
     }
     purge_stale_failure_rank(e, joiner);
     if (!e->failed[joiner])
-        return; /* view unchanged (concurrent admitting proposer) */
+        return 1; /* view unchanged (concurrent admitting proposer) */
     e->failed[joiner] = 0;
     e->n_failed--;
     e->rejoins_cnt++;
@@ -2704,6 +2925,7 @@ static void execute_admission(rlo_engine *e, int joiner, int inc,
     /* plug forwarding holes across the overlay re-form, exactly like
      * the failure path does */
     reflood_recent(e);
+    return 1;
 }
 
 static void send_welcome(rlo_engine *e, int joiner, int inc,
@@ -2751,29 +2973,40 @@ static void replay_recent(rlo_engine *e, int joiner)
     }
 }
 
-/* Admitting proposer's epilogue: execute the admission, then welcome
- * + replay to the joiner. */
+/* Admitting proposer's epilogue: execute the batch of admissions,
+ * then welcome + replay to each joiner. */
 static void finish_member_round(rlo_engine *e)
 {
     rlo_prop *p = &e->own;
-    if (!p->payload || p->len < RLO_MEMBER_MAGIC_LEN + 12 ||
-        memcmp(p->payload, RLO_MEMBER_MAGIC, RLO_MEMBER_MAGIC_LEN))
+    int32_t new_epoch;
+    const uint8_t *recs;
+    int k = p->payload
+                ? member_decode(p->payload, p->len, &new_epoch, &recs)
+                : 0;
+    if (!k)
         return;
-    int joiner = get_le32(p->payload + RLO_MEMBER_MAGIC_LEN);
-    int inc = get_le32(p->payload + RLO_MEMBER_MAGIC_LEN + 4);
-    int32_t new_epoch = get_le32(p->payload + RLO_MEMBER_MAGIC_LEN + 8);
-    if (joiner < 0 || joiner >= e->ws)
-        return;
-    e->admitting[joiner] = 0;
-    if (e->pending_join[joiner]) {
-        e->pending_join[joiner] = 0;
-        e->n_pending--;
+    for (int j = 0; j < k; j++) {
+        int joiner = get_le32(recs + 8 * j);
+        if (joiner < 0 || joiner >= e->ws)
+            continue;
+        e->admitting[joiner] = 0;
+        if (e->pending_join[joiner]) {
+            e->pending_join[joiner] = 0;
+            e->n_pending--;
+        }
     }
     if (!p->vote)
         return;
-    execute_admission(e, joiner, inc, new_epoch);
-    send_welcome(e, joiner, inc, new_epoch);
-    replay_recent(e, joiner);
+    for (int j = 0; j < k; j++) {
+        int joiner = get_le32(recs + 8 * j);
+        int inc = get_le32(recs + 8 * j + 4);
+        if (joiner < 0 || joiner >= e->ws)
+            continue;
+        if (execute_admission(e, joiner, inc, new_epoch) && k > 1)
+            e->batched_admits++;
+        send_welcome(e, joiner, inc, new_epoch);
+        replay_recent(e, joiner);
+    }
 }
 
 /* A JOIN probe/petition arrived: compare view keys. If the sender's
@@ -2792,12 +3025,23 @@ static void on_join(rlo_engine *e, rlo_msg *m)
     int32_t ep = get_le32(m->payload + 4);
     int malive = get_le32(m->payload + 8);
     int petition = get_le32(m->payload + 12);
+    /* 5th field (PR-16): dst-is-a-member flag; absent on old 4-field
+     * probes, which parse as 0 (full rejoin: status quo) */
+    int member = m->len >= 20 ? get_le32(m->payload + 16) : 0;
     rlo_trace_emit(e->rank, RLO_EV_JOIN, src, 0, inc, ep);
     if (e->awaiting_welcome)
         return; /* mid-rejoin ourselves; the winning side sorts us */
     int mine_wins = view_wins(e, ep, malive, src);
     if (e->failed[src]) {
         if (!mine_wins) {
+            if (member) {
+                /* the winning view still holds me as a member: I am
+                 * merely epoch-lagging, not excluded — catch up with
+                 * a view-state sync instead of the full rejoin that
+                 * used to strand every laggard (S18) */
+                request_sync(e, src);
+                return;
+            }
             become_joiner(e);
             return;
         }
@@ -2810,8 +3054,25 @@ static void on_join(rlo_engine *e, rlo_msg *m)
         e->pending_ep[src] = ep;
         e->n_pending++;
     } else if (!mine_wins) {
+        if (member) {
+            request_sync(e, src);
+            return;
+        }
         become_joiner(e);
     } else if (petition) {
+        if (inc < e->admitted_inc[src])
+            return; /* stale petition from an already-replaced life */
+        if (inc == e->admitted_inc[src] && e->reset_epoch[src]) {
+            /* sync-supersedes-welcome (S18): this exact life was
+             * already admitted here, so its JOIN_WELCOME was lost in
+             * flight. The old answer — re-declare it failed and
+             * re-admit — was the measured rejoin-cascade amplifier; a
+             * view-state sync response carries everything the welcome
+             * did and repeats for free on the petition cadence until
+             * one lands. */
+            msync_serve(e, src);
+            return;
+        }
         /* a rank we consider ALIVE is petitioning against our winning
          * view: it has reset itself and quarantines our traffic, so
          * it is effectively failed here — adopt + announce that, then
@@ -2834,44 +3095,18 @@ static void on_join(rlo_engine *e, rlo_msg *m)
     }
 }
 
-/* The admitting proposer's JOIN_WELCOME: adopt its membership view
- * wholesale — epoch, member list, fresh link state and heartbeat
- * grace everywhere, per-member epoch floors at the agreed epoch
- * (members only send to us AFTER executing the admission, so
- * everything below the floor is pre-partition leftovers). The replay
- * of the proposer's recent-broadcast log follows on the same FIFO
- * channel. Does NOT consume m. */
-static void on_welcome(rlo_engine *e, rlo_msg *m)
+/* Wholesale view adoption — the shared core of JOIN_WELCOME and the
+ * sync-supersede path (docs/DESIGN.md S18): a certified admission of
+ * THIS life at `new_epoch` whose notification reached us either as
+ * the welcome itself or as a sync response after the welcome was
+ * lost. `mem` is a ws-sized member-flag array (self included).
+ * Adopts epoch, member list, fresh link state and heartbeat grace
+ * everywhere, per-member epoch floors at the agreed epoch (members
+ * only send to us AFTER executing the admission, so everything below
+ * the floor is pre-partition leftovers). */
+static void adopt_view(rlo_engine *e, int32_t new_epoch,
+                       const uint8_t *mem, int inc, int src)
 {
-    if (m->len < 12)
-        return;
-    int32_t new_epoch = get_le32(m->payload);
-    int inc = get_le32(m->payload + 4);
-    int n = get_le32(m->payload + 8);
-    if (inc != e->incarnation)
-        return; /* welcome addressed to an older life of this rank */
-    if (n < 0 || m->len < 12 + 4 * (int64_t)n)
-        return;
-    if (!e->awaiting_welcome && new_epoch <= e->welcome_epoch)
-        /* duplicate/stale welcome (concurrent admitting proposers).
-         * Deliberately compared against the last ADOPTED welcome
-         * epoch, not e->epoch: our own epoch can outrun the round's
-         * agreed epoch via local declarations, and rejecting the
-         * welcome then would leave the admitting side's link-state
-         * reset one-sided (a permanently desynced ARQ window) — the
-         * exact mirror of the members' admit_epoch idempotence rule */
-        return;
-    uint8_t *mem = (uint8_t *)calloc((size_t)e->ws, 1);
-    if (!mem) {
-        set_err(e, RLO_ERR_NOMEM);
-        return;
-    }
-    mem[e->rank] = 1;
-    for (int i = 0; i < n; i++) {
-        int r = get_le32(m->payload + 12 + 4 * i);
-        if (r >= 0 && r < e->ws)
-            mem[r] = 1;
-    }
     e->awaiting_welcome = 0;
     e->suspected_self = 0;
     if (new_epoch > e->welcome_epoch)
@@ -2903,6 +3138,11 @@ static void on_welcome(rlo_engine *e, rlo_msg *m)
         int in_view = mem[r] && r != e->rank;
         e->epoch_floor[r] = in_view ? new_epoch : 0;
         e->link_epoch[r] = in_view ? new_epoch : 0;
+        /* our pre-adoption link-reset certifications described a view
+         * we just replaced wholesale; serving sync floors from them
+         * would hand laggards one-sided floors (S18) */
+        e->reset_epoch[r] = 0;
+        e->sync_req_last[r] = 0;
     }
     memset(e->rx_mask, 0,
            (size_t)e->ws * RLO_SEEN_WORDS * sizeof(uint64_t));
@@ -2929,14 +3169,413 @@ static void on_welcome(rlo_engine *e, rlo_msg *m)
     e->rejoins_cnt++;
     e->view_changes++;
     e->join_last = 0;
+    /* advertise the log retained across the rejoin: this rank may be
+     * the SOLE holder of its old life's entries (e.g. an abort
+     * flooded while partitioned alone), and no later view change is
+     * guaranteed to occur here — the WANT-side guards
+     * (have_log_entry) make stale entries harmless */
+    reflood_recent(e);
     rlo_trace_emit(e->rank, RLO_EV_ADMIT, e->rank, e->epoch, inc,
-                   m->src);
+                   src);
     if (!getenv("RLO_QUIET"))
         fprintf(stderr,
                 "rlo_tpu: rank %d rejoined at epoch %d (welcomed by "
                 "rank %d)\n",
-                e->rank, (int)e->epoch, m->src);
+                e->rank, (int)e->epoch, src);
+}
+
+/* The admitting proposer's JOIN_WELCOME: validate + adopt its
+ * membership view wholesale (adopt_view). The replay of the
+ * proposer's recent-broadcast log follows on the same FIFO channel.
+ * Does NOT consume m. */
+static void on_welcome(rlo_engine *e, rlo_msg *m)
+{
+    if (m->len < 12)
+        return;
+    int32_t new_epoch = get_le32(m->payload);
+    int inc = get_le32(m->payload + 4);
+    int n = get_le32(m->payload + 8);
+    if (inc != e->incarnation)
+        return; /* welcome addressed to an older life of this rank */
+    if (n < 0 || m->len < 12 + 4 * (int64_t)n)
+        return;
+    if (!e->awaiting_welcome && new_epoch <= e->welcome_epoch)
+        /* duplicate/stale welcome (concurrent admitting proposers).
+         * Deliberately compared against the last ADOPTED welcome
+         * epoch, not e->epoch: our own epoch can outrun the round's
+         * agreed epoch via local declarations, and rejecting the
+         * welcome then would leave the admitting side's link-state
+         * reset one-sided (a permanently desynced ARQ window) — the
+         * exact mirror of the members' admit_epoch idempotence rule */
+        return;
+    uint8_t *mem = (uint8_t *)calloc((size_t)e->ws, 1);
+    if (!mem) {
+        set_err(e, RLO_ERR_NOMEM);
+        return;
+    }
+    mem[e->rank] = 1;
+    for (int i = 0; i < n; i++) {
+        int r = get_le32(m->payload + 12 + 4 * i);
+        if (r >= 0 && r < e->ws)
+            mem[r] = 1;
+    }
+    adopt_view(e, new_epoch, mem, inc, m->src);
     free(mem);
+}
+
+/* -- Tag.MSYNC: view-state sync (docs/DESIGN.md S18) ----------------
+ * Byte-compatible with engine.py's MSYNC_REQ/RSP/AD/WANT payloads;
+ * ARQ- and epoch-exempt exactly like JOIN, so a lost frame costs
+ * latency, never correctness. */
+
+/* Ask an up-to-date peer for a view-state sync: the epoch catch-up
+ * path that replaces the full rejoin a laggard used to be stranded
+ * into. Rate-limited per destination at the join-probe cadence — the
+ * probes that trigger it repeat on the peer's heal-probe cadence, so
+ * one outstanding REQ per peer is enough and loss costs one cadence
+ * interval, never progress. */
+static void request_sync(rlo_engine *e, int dst)
+{
+    uint64_t now = rlo_now_usec();
+    if (e->sync_req_last[dst] &&
+        now - e->sync_req_last[dst] < join_iv(e))
+        return;
+    e->sync_req_last[dst] = now;
+    uint8_t payload[9];
+    payload[0] = RLO_MSYNC_REQ;
+    put_le32(payload + 1, e->epoch);
+    put_le32(payload + 5, e->incarnation);
+    eng_isend(e, dst, RLO_TAG_MSYNC, e->rank, -1, -1, payload, 9, 0);
+}
+
+/* Build + send a MSYNC_RSP: epoch, member records, and the recent-log
+ * advert. Per-member records carry only CERTIFIED link-reset epochs
+ * (reset_epoch, set solely by execute_admission) — never the
+ * wholesale welcome inflation of admit_epoch, which would hand the
+ * laggard a one-sided floor for members whose links were never
+ * actually reset (S18). */
+static void msync_serve(rlo_engine *e, int dst)
+{
+    if (e->awaiting_welcome)
+        return; /* mid-rejoin: nothing certifiable to serve */
+    int64_t cap = 9 + 12 * (int64_t)e->ws + 5 + 12 * RLO_RECENT_LOG;
+    uint8_t *payload = (uint8_t *)malloc((size_t)cap);
+    if (!payload)
+        return; /* best-effort: the next petition retries */
+    int n = 0;
+    int64_t pos = 9;
+    for (int r = 0; r < e->ws; r++) {
+        if (r != e->rank && e->failed[r])
+            continue;
+        put_le32(payload + pos, r);
+        if (r == e->rank) {
+            put_le32(payload + pos + 4, e->welcome_epoch);
+            put_le32(payload + pos + 8, e->incarnation);
+        } else {
+            put_le32(payload + pos + 4, e->reset_epoch[r]);
+            put_le32(payload + pos + 8, e->admitted_inc[r]);
+        }
+        pos += 12;
+        n++;
+    }
+    payload[0] = RLO_MSYNC_RSP;
+    put_le32(payload + 1, e->epoch);
+    put_le32(payload + 5, n);
+    /* embedded advert tail: same [count:i32] + triple body as a
+     * standalone MSYNC_AD, minus its kind byte */
+    uint8_t ad[5 + 12 * RLO_RECENT_LOG];
+    int64_t adlen = advert_payload(e, ad);
+    if (adlen > 0) {
+        memcpy(payload + pos, ad + 1, (size_t)(adlen - 1));
+        pos += adlen - 1;
+    } else {
+        put_le32(payload + pos, 0);
+        pos += 4;
+    }
+    if (pos + 64 > e->msg_size_max) {
+        /* view too large for one frame (pathological world_size):
+         * fall back to the full-rejoin path rather than truncate */
+        free(payload);
+        send_join_probe(e, dst);
+        return;
+    }
+    eng_isend(e, dst, RLO_TAG_MSYNC, e->rank, -1, -1, payload, pos, 0);
+    free(payload);
+}
+
+/* MSYNC_AD body at `off`: [count:i32] + count x [tag][a][b]
+ * recent-log identities. Answer with a WANT naming exactly the
+ * entries this rank provably misses; each entry already held is a
+ * re-flood frame the old blast would have wasted (reflood_skipped);
+ * every read below is dominated by a length guard. */
+static void msync_advert(rlo_engine *e, int src, const uint8_t *p,
+                         int64_t plen, int64_t off)
+{
+    if (plen < off + 4)
+        return;
+    int cnt = get_le32(p + off);
+    if (cnt < 0 || plen < off + 4 + 12 * (int64_t)cnt)
+        return;
+    uint8_t *out = (uint8_t *)malloc((size_t)(5 + 12 * (int64_t)cnt));
+    if (!out)
+        return;
+    int nw = 0;
+    for (int i = 0; i < cnt; i++) {
+        int32_t t = get_le32(p + off + 4 + 12 * i);
+        int32_t a = get_le32(p + off + 4 + 12 * i + 4);
+        int32_t b = get_le32(p + off + 4 + 12 * i + 8);
+        if (have_log_entry(e, t, a, b)) {
+            e->reflood_skipped++;
+        } else {
+            put_le32(out + 5 + 12 * nw, t);
+            put_le32(out + 5 + 12 * nw + 4, a);
+            put_le32(out + 5 + 12 * nw + 8, b);
+            nw++;
+        }
+    }
+    if (nw) {
+        out[0] = RLO_MSYNC_WANT;
+        put_le32(out + 1, nw);
+        eng_isend(e, src, RLO_TAG_MSYNC, e->rank, -1, -1, out,
+                  5 + 12 * (int64_t)nw, 0);
+    }
+    free(out);
+}
+
+/* A WANT reply to our advert: re-send exactly the named recent-log
+ * entries (through the ARQ gate, fresh link seqs — a new
+ * transmission, not a retransmit; app-level dedup absorbs any
+ * crossing duplicates). */
+static void msync_want(rlo_engine *e, int src, const uint8_t *p,
+                       int64_t plen)
+{
+    if (plen < 5)
+        return;
+    int cnt = get_le32(p + 1);
+    if (cnt < 0 || plen < 5 + 12 * (int64_t)cnt)
+        return;
+    for (int i = 0; i < RLO_RECENT_LOG; i++) {
+        int32_t t, a, b;
+        if (!log_entry_ident(e, i, &t, &a, &b))
+            continue;
+        for (int j = 0; j < cnt; j++)
+            if (get_le32(p + 5 + 12 * j) == t &&
+                get_le32(p + 5 + 12 * j + 4) == a &&
+                get_le32(p + 5 + 12 * j + 8) == b) {
+                e->reflood_frames++;
+                eng_isend_frame(e, src, e->recent_tag[i],
+                                e->recent[i], 0);
+                break;
+            }
+    }
+}
+
+/* A MSYNC_RSP arrived: catch up to the responder's view without a
+ * full rejoin. Three cases: (1) the response certifies an admission
+ * of THIS life we never saw the welcome for — wholesale adoption,
+ * exactly as the welcome would have done (sync-supersedes-welcome);
+ * (2) we are a mere epoch laggard — execute the certified per-member
+ * admissions we missed and adopt the responder's failures; (3)
+ * nothing certifiable heals the link to the responder — fall back to
+ * a full rejoin, the pre-S18 status quo, so every sync exchange
+ * strictly progresses. */
+static void msync_adopt(rlo_engine *e, int src, const uint8_t *p,
+                        int64_t plen)
+{
+    if (plen < 9)
+        return;
+    int32_t rsp_epoch = get_le32(p + 1);
+    int n = get_le32(p + 5);
+    if (n < 0 || plen < 9 + 12 * (int64_t)n)
+        return;
+    /* staleness, judged at ARRIVAL epoch (adoption below may raise
+     * it): a response no newer than my view means I progressed past
+     * the request in flight — I am not the laggard anymore */
+    int stale = rsp_epoch <= e->epoch;
+    int64_t ad_off = 9 + 12 * (int64_t)n;
+    int32_t my_aep = 0, my_ainc = 0;
+    int have_mine = 0;
+    for (int i = 0; i < n; i++)
+        if (get_le32(p + 9 + 12 * i) == e->rank) {
+            my_aep = get_le32(p + 9 + 12 * i + 4);
+            my_ainc = get_le32(p + 9 + 12 * i + 8);
+            have_mine = 1;
+            break;
+        }
+    if (!have_mine) {
+        /* the responder's view does not hold me at all: if it wins,
+         * only a full rejoin gets me back in */
+        if (rsp_epoch > e->epoch)
+            become_joiner(e);
+        return;
+    }
+    int adopted = 0;
+    if (my_ainc == e->incarnation && my_aep > e->welcome_epoch) {
+        /* lost-welcome supersede: the responder certifies THIS life
+         * was admitted at my_aep but no welcome ever landed — adopt
+         * the view wholesale with the welcome's exact semantics
+         * (un-wedges awaiting_welcome) */
+        uint8_t *mem = (uint8_t *)calloc((size_t)e->ws, 1);
+        if (!mem)
+            return;
+        mem[e->rank] = 1;
+        for (int i = 0; i < n; i++) {
+            int r = get_le32(p + 9 + 12 * i);
+            if (r >= 0 && r < e->ws)
+                mem[r] = 1;
+        }
+        adopt_view(e, my_aep, mem, e->incarnation, src);
+        free(mem);
+        if (rsp_epoch > e->epoch)
+            e->epoch = rsp_epoch;
+        adopted = 1;
+    } else if (e->awaiting_welcome) {
+        /* mid-rejoin and the response does not certify this life:
+         * keep petitioning — only an admission can help now */
+        return;
+    } else {
+        /* laggard catch-up: execute certified admissions (aep > 0
+         * entries only; a zero means "no reset I can vouch for") */
+        for (int i = 0; i < n; i++) {
+            int r = get_le32(p + 9 + 12 * i);
+            int32_t aep = get_le32(p + 9 + 12 * i + 4);
+            int ainc = get_le32(p + 9 + 12 * i + 8);
+            if (r != e->rank && aep > 0 && r >= 0 && r < e->ws &&
+                aep > e->admit_epoch[r] &&
+                execute_admission(e, r, ainc, aep))
+                adopted = 1;
+        }
+        if (rsp_epoch > e->epoch) {
+            /* adopt the responder's failures: ranks alive here but
+             * absent from its strictly-newer view, unless an
+             * admission we already executed post-dates it */
+            for (int r = 0; r < e->ws; r++) {
+                if (r == e->rank || e->failed[r])
+                    continue;
+                int present = 0;
+                for (int i = 0; i < n; i++)
+                    if (get_le32(p + 9 + 12 * i) == r) {
+                        present = 1;
+                        break;
+                    }
+                if (!present && rsp_epoch > e->admit_epoch[r])
+                    mark_failed(e, r);
+            }
+            if (rsp_epoch > e->epoch)
+                e->epoch = rsp_epoch;
+            adopted = 1;
+        }
+    }
+    if (e->failed[src]) {
+        /* a stale RSP (predates local progress) is dropped, not
+         * acted on: becoming a joiner off stale state can wedge the
+         * whole fleet in joiner mode (the last member self-demoting
+         * leaves no admitter) — my frames at the responder trigger
+         * ITS sync or rejoin instead */
+        if (stale)
+            return;
+        /* progress fallback: nothing in the response re-certified
+         * the responder's link, so the two views cannot converge by
+         * sync alone — full rejoin (status quo ante) */
+        become_joiner(e);
+        return;
+    }
+    if (adopted)
+        e->epoch_syncs++;
+    if (plen >= ad_off + 4)
+        msync_advert(e, src, p, plen, ad_off);
+}
+
+/* Dispatch a Tag.MSYNC frame by kind byte. Does NOT consume m;
+ * every payload read is dominated by a length guard. */
+static void on_msync(rlo_engine *e, rlo_msg *m)
+{
+    int src = m->src;
+    if (src < 0 || src >= e->ws || src == e->rank ||
+        e->sub_excluded[src] || m->len < 1)
+        return;
+    int kind = m->payload[0];
+    if (kind == RLO_MSYNC_REQ) {
+        if (m->len < 9)
+            return;
+        if (e->failed[src]) {
+            /* can't certify link state toward a rank this view holds
+             * failed: show it the winning view so it petitions for
+             * readmission instead */
+            send_join_probe(e, src);
+            return;
+        }
+        if (get_le32(m->payload + 5) < e->admitted_inc[src])
+            return; /* stale REQ from an already-replaced life */
+        msync_serve(e, src);
+    } else if (kind == RLO_MSYNC_RSP) {
+        msync_adopt(e, src, m->payload, m->len);
+    } else if (kind == RLO_MSYNC_AD) {
+        /* a joiner's dedup state is mid-reset and a failed peer's
+         * link is quarantined: neither side can exchange WANTs */
+        if (!e->awaiting_welcome && !e->failed[src])
+            msync_advert(e, src, m->payload, m->len, 1);
+    } else if (kind == RLO_MSYNC_WANT) {
+        if (!e->awaiting_welcome && !e->failed[src])
+            msync_want(e, src, m->payload, m->len);
+    }
+}
+
+/* Designated admitter's launch: drain EVERY servable queued petition
+ * into one IAR round. Batched admissions (docs/DESIGN.md S18) —
+ * under churn the petitions arrive in bursts (every victim of a
+ * partition heals at once), and k sequential rounds were the
+ * measured admission_rounds amplifier. */
+static void launch_admission_round(rlo_engine *e, uint64_t now,
+                                   uint64_t iv)
+{
+    int64_t cap = RLO_MEMBER_MAGIC_LEN + 8 + 8 * (int64_t)e->ws;
+    uint8_t *payload = (uint8_t *)malloc((size_t)cap);
+    if (!payload)
+        return;
+    int k = 0, first = -1;
+    int32_t max_jep = e->epoch;
+    for (int r = 0; r < e->ws; r++) {
+        if (!e->pending_join[r])
+            continue;
+        e->pending_join[r] = 0;
+        e->n_pending--;
+        if (!e->failed[r] || e->admitting[r])
+            continue;
+        if (first < 0)
+            first = r;
+        e->admitting[r] = 1;
+        put_le32(payload + RLO_MEMBER_MAGIC_LEN + 8 + 8 * k, r);
+        put_le32(payload + RLO_MEMBER_MAGIC_LEN + 12 + 8 * k,
+                 e->pending_inc[r]);
+        if (e->pending_ep[r] > max_jep)
+            max_jep = e->pending_ep[r];
+        k++;
+    }
+    if (k) {
+        /* the agreed post-admission epoch: above EVERY side's
+         * view, so each joiner's fresh frames clear every
+         * member's floor and their old lives' frames never
+         * do. The round rides the FIRST joiner's pid slot. */
+        int32_t new_epoch = max_jep + 1;
+        memcpy(payload, RLO_MEMBER_MAGIC, RLO_MEMBER_MAGIC_LEN);
+        put_le32(payload + RLO_MEMBER_MAGIC_LEN, new_epoch);
+        put_le32(payload + RLO_MEMBER_MAGIC_LEN + 4, k);
+        e->admission_rounds++;
+        rlo_submit_proposal(e, payload,
+                            RLO_MEMBER_MAGIC_LEN + 8 + 8 * (int64_t)k,
+                            member_pid(e, first));
+        /* arm the membership watchdog: if the round wedges
+         * (mixed-view vote-tree cycle), fail it and let the
+         * joiners' next probes retry on the settled view */
+        if (e->own.state == RLO_IN_PROGRESS) {
+            uint64_t budget = 4 * e->fd_timeout;
+            if (20 * iv > budget)
+                budget = 20 * iv;
+            e->own_deadline = now + budget;
+        }
+    }
+    free(payload);
 }
 
 /* Joiner side: petition every potential member at join_interval.
@@ -2962,45 +3601,8 @@ static void membership_tick(rlo_engine *e)
      * alive rank in my view — launches admission rounds; everyone
      * else keeps the petition queued in case designation shifts. */
     if (e->n_pending && e->own.state != RLO_IN_PROGRESS &&
-        min_alive(e) == e->rank) {
-        int joiner = -1;
-        for (int r = 0; r < e->ws; r++)
-            if (e->pending_join[r]) {
-                joiner = r;
-                break;
-            }
-        if (joiner >= 0) {
-            e->pending_join[joiner] = 0;
-            e->n_pending--;
-            if (e->failed[joiner] && !e->admitting[joiner]) {
-                e->admitting[joiner] = 1;
-                /* the agreed post-admission epoch: above BOTH sides'
-                 * views, so the joiner's fresh frames clear every
-                 * member's floor and its old life's frames never do */
-                int32_t jep = e->pending_ep[joiner];
-                int32_t new_epoch =
-                    (e->epoch > jep ? e->epoch : jep) + 1;
-                uint8_t payload[RLO_MEMBER_MAGIC_LEN + 12];
-                memcpy(payload, RLO_MEMBER_MAGIC, RLO_MEMBER_MAGIC_LEN);
-                put_le32(payload + RLO_MEMBER_MAGIC_LEN, joiner);
-                put_le32(payload + RLO_MEMBER_MAGIC_LEN + 4,
-                         e->pending_inc[joiner]);
-                put_le32(payload + RLO_MEMBER_MAGIC_LEN + 8, new_epoch);
-                e->admission_rounds++;
-                rlo_submit_proposal(e, payload, sizeof(payload),
-                                    member_pid(e, joiner));
-                /* arm the membership watchdog: if the round wedges
-                 * (mixed-view vote-tree cycle), fail it and let the
-                 * joiner's next probe retry on the settled view */
-                if (e->own.state == RLO_IN_PROGRESS) {
-                    uint64_t budget = 4 * e->fd_timeout;
-                    if (20 * iv > budget)
-                        budget = 20 * iv;
-                    e->own_deadline = now + budget;
-                }
-            }
-        }
-    }
+        min_alive(e) == e->rank)
+        launch_admission_round(e, now, iv);
     int probe = 0;
     for (int r = 0; r < e->ws; r++)
         if (e->failed[r] && !e->sub_excluded[r])
@@ -3274,6 +3876,15 @@ int64_t rlo_engine_progress_budget(rlo_engine *e, int64_t max_frames)
         }
         if (m->tag == RLO_TAG_JOIN_WELCOME) {
             on_welcome(e, m);
+            msg_free(m);
+            continue;
+        }
+        if (m->tag == RLO_TAG_MSYNC) {
+            /* epoch-exempt like JOIN: a sync response must reach a
+             * mid-rejoin laggard (sync-supersedes-welcome) and a REQ
+             * must cross the failed-sender boundary; on_msync guards
+             * per kind (docs/DESIGN.md S18) */
+            on_msync(e, m);
             msg_free(m);
             continue;
         }

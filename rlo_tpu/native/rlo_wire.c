@@ -110,6 +110,7 @@ static const char *const k_telem_keys[RLO_TELEM_NKEYS] = {
     "view_changes", "reflood_frames", "epoch_lag_max",
     "quar_mid_rejoin", "quar_failed_sender", "quar_below_floor",
     "admission_rounds",
+    "epoch_syncs", "reflood_skipped", "batched_admits",
     "tx_frames", "rx_frames", "rtt_ewma_max_usec",
     "q_wait", "pickup_backlog", "pages_in_use", "pages_free",
 };

@@ -46,6 +46,15 @@ _COLS = (
     ("rejoin", "rejoins", 6), ("reflood", "reflood_frames", 7),
 )
 
+#: the §18 heal-counter block ``--fabric`` appends per rank: epoch
+#: catch-up adoptions (MSYNC), advert re-flood entries skipped at the
+#: receiver, and joiners admitted through multi-joiner batch records —
+#: the serving fleet's healing-cost readout next to its page columns
+_HEAL_COLS = (
+    ("syncs", "epoch_syncs", 5), ("rfskip", "reflood_skipped", 6),
+    ("badm", "batched_admits", 5),
+)
+
 
 class FleetHarness:
     """A driven sim fleet with one telemetry plane per rank — what
@@ -173,14 +182,16 @@ def run_fleet(world_size: int = 8, seed: int = 0,
     return FleetHarness(world, mgr, engines, planes, fabrics)
 
 
-def render(snap: Dict) -> str:
-    """Text table for one FleetView snapshot."""
+def render(snap: Dict, heal: bool = False) -> str:
+    """Text table for one FleetView snapshot. ``heal=True`` (the
+    ``--fabric`` view) appends the §18 heal-counter block."""
+    cols = _COLS + (_HEAL_COLS if heal else ())
     lines = [
         f"rlo-top — fleet view from rank {snap['from_rank']} "
         f"({snap['present']}/{snap['world_size']} ranks reporting)",
         "",
     ]
-    hdr = "rank " + " ".join(f"{h:>{w}}" for h, _, w in _COLS) + \
+    hdr = "rank " + " ".join(f"{h:>{w}}" for h, _, w in cols) + \
         "   age  seq  stale gap"
     lines.append(hdr)
     lines.append("-" * len(hdr))
@@ -188,7 +199,7 @@ def render(snap: Dict) -> str:
                          int(kv[0])):
         v = ent["values"]
         row = f"{r:>4} " + " ".join(
-            f"{v.get(k, 0):>{w}}" for _, k, w in _COLS)
+            f"{v.get(k, 0):>{w}}" for _, k, w in cols)
         age = ent.get("age")
         stale = ent.get("stale_epochs")
         row += (f"  {age:5.1f}" if age is not None else "      ")
@@ -199,10 +210,10 @@ def render(snap: Dict) -> str:
     roll = snap["rollups"]
     lines.append("-" * len(hdr))
     lines.append("sum  " + " ".join(
-        f"{roll.get(k, 0):>{w}}" for _, k, w in _COLS))
+        f"{roll.get(k, 0):>{w}}" for _, k, w in cols))
     rmax = snap["rollup_max"]
     lines.append("max  " + " ".join(
-        f"{rmax.get(k, 0):>{w}}" for _, k, w in _COLS))
+        f"{rmax.get(k, 0):>{w}}" for _, k, w in cols))
     return "\n".join(lines)
 
 
@@ -274,7 +285,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             else:
                 print(f"\n== frame {frame} (vtime "
                       f"{fleet.world.now:.1f}) ==")
-                print(render(snap))
+                print(render(snap, heal=args.fabric))
         fleet.cleanup()
         return 0
 
@@ -292,7 +303,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 fleet.fabrics)["counters"]
         print(json.dumps(out))
     else:
-        print(render(snap))
+        print(render(snap, heal=args.fabric))
         if problems:
             print("\nSELF-CHECK FAILED:", file=sys.stderr)
             for p in problems:

@@ -839,7 +839,9 @@ class Scenario:
 def make_scenario(kind: str, seed: int, world_size: int = 4):
     """One of the canned chaos shapes, deterministically derived from
     (kind, seed): 'partition' (split-brain + heal), 'restart' (kill +
-    elastic rejoin), 'burst' (loss window), 'mixed' (all of it).
+    elastic rejoin), 'burst' (loss window), 'mixed' (all of it),
+    'churn_weather' (sustained churn_script kills/rejoins under
+    Gilbert burst loss, default watchdog SLOs armed — §18).
     Serving-fabric kinds ('fabric_kill', 'fabric_split',
     'fabric_rejoin' — docs/DESIGN.md §11) return a ``FabricScenario``
     with the same ``run()`` contract and property-violation
@@ -896,6 +898,31 @@ def make_scenario(kind: str, seed: int, world_size: int = 4):
             (190.0, "bcast", 0),
             (195.0, "propose", 1),
         ]
+    elif kind == "churn_weather":
+        # sustained kill/rejoin churn UNDER correlated Gilbert burst
+        # loss (docs/DESIGN.md §18): the healing-path stress shape —
+        # epoch catch-up, batched admissions and the advert-scoped
+        # re-flood all fire here. The default watchdog SLOs ride rank
+        # 0's telemetry plane (churn_script immortal=) and any trip is
+        # a sweep violation: churn at this rate is ORDINARY weather,
+        # not an incident, once healing is cheap.
+        from rlo_tpu.workloads.weather import make_weather
+        weather = make_weather(
+            "churn", seed + 17, world_size=ws, rate=0.04,
+            duration=170.0, start=12.0, mean_down=25.0,
+            min_down=22.0, min_live=max(2, ws - 2), settle=70.0,
+            immortal=(0,), max_kills=2,
+            gilbert=dict(p_enter=0.01, p_exit=0.25, loss_bad=0.5))
+        script = traffic + [
+            (170.0, "bcast", rng.randrange(ws)),
+            (175.0, "propose", 0),
+        ]
+        from rlo_tpu.observe import DEFAULT_RULES
+        return Scenario(world_size=ws, seed=seed, script=script,
+                        duration=240.0, weather=weather,
+                        telemetry=True,
+                        watchdog_rules=list(DEFAULT_RULES),
+                        check_delivery=False)
     else:
         raise ValueError(f"unknown scenario kind {kind!r}")
     # burst-loss windows make "every clean broadcast delivered
@@ -906,7 +933,8 @@ def make_scenario(kind: str, seed: int, world_size: int = 4):
                     check_delivery=(kind in ("partition", "restart")))
 
 
-SCENARIO_KINDS = ("partition", "restart", "burst", "mixed")
+SCENARIO_KINDS = ("partition", "restart", "burst", "mixed",
+                  "churn_weather")
 
 #: serving-fabric scenario kinds (rlo_tpu/serving/scenario.py); listed
 #: here so the CLI sweep covers them without importing the serving
@@ -927,6 +955,13 @@ def fuzz_sweep(seeds: Sequence[int],
     for kind in kinds:
         for seed in seeds:
             res = make_scenario(kind, seed, world_size).run()
+            if res.get("incidents"):
+                names = sorted({i["name"] for i in res["incidents"]})
+                raise SimViolation(
+                    f"watchdog tripped under {kind!r}: {names} — the "
+                    f"default SLOs must stay quiet under scripted "
+                    f"weather; replay: make_scenario({kind!r}, "
+                    f"{seed}, {world_size}).run()")
             runs += 1
             total_rejoins += res["rejoins"]
             total_events += res["events"]

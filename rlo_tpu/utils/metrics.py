@@ -59,6 +59,16 @@ HIST_BUCKETS = 28  # rlo-lint: paired-with rlo_core.h:RLO_HIST_BUCKETS
 #:                          ``epoch_quarantined`` (they sum to it)
 #:   ``admission_rounds``   IAR admission rounds LAUNCHED here (the
 #:                          designated-admitter's proposer-side count)
+#:   ``epoch_syncs``        view-state catch-up adoptions executed via
+#:                          Tag.MSYNC (an epoch-lagging but alive
+#:                          member healed WITHOUT a full rejoin)
+#:   ``reflood_skipped``    view-change re-flood advert entries the
+#:                          receiving side already held — the work the
+#:                          digest-scoped re-flood avoided (each would
+#:                          have been one blast frame pre-PR-16)
+#:   ``batched_admits``     joiners admitted through a MULTI-joiner
+#:                          admission record (one IAR round admitting
+#:                          k queued petitions at once)
 # rlo-lint: paired-with rlo_core.h:rlo_stats
 ENGINE_COUNTER_KEYS = (
     "sent_bcast", "recved_bcast", "total_pickup", "ops_failed",
@@ -67,6 +77,7 @@ ENGINE_COUNTER_KEYS = (
     "view_changes", "reflood_frames", "epoch_lag_max",
     "quar_mid_rejoin", "quar_failed_sender", "quar_below_floor",
     "admission_rounds",
+    "epoch_syncs", "reflood_skipped", "batched_admits",
 )
 
 #: The in-engine phase-profiler schema, in snapshot order — the single

@@ -72,10 +72,14 @@ class Tag(enum.IntEnum):
     ACK = 13         # cumulative link ACK; vote = highest contiguous seq
     ABORT = 14       # rootless op-abort notification (deadline expiry);
                      # pid = aborted pid, payload = round generation
-    JOIN = 15        # membership probe/petition; payload = 4 x le32
-                     # (incarnation, epoch, min-alive-rank, petition)
-                     # of the sender's view — petition=1 marks a
-                     # joiner's plea vs a survivor's heal probe
+    JOIN = 15        # membership probe/petition; payload = 5 x le32
+                     # (incarnation, epoch, min-alive-rank, petition,
+                     # member) of the sender's view — petition=1 marks
+                     # a joiner's plea vs a survivor's heal probe;
+                     # member=1 tells the DESTINATION it is alive in
+                     # the sender's view, steering a losing-view
+                     # receiver to a Tag.MSYNC catch-up instead of a
+                     # full rejoin (old 4-field probes parse member=0)
     JOIN_WELCOME = 16  # admission notice from the admitting proposer:
                      # payload = (epoch, incarnation echo, member list);
                      # followed by a point-to-point replay of the
@@ -93,6 +97,16 @@ class Tag(enum.IntEnum):
                      # along the broadcast overlay — the payload is a
                      # delta-encoded digest (encode_telem below), not
                      # engine state
+    MSYNC = 19       # membership view-state sync (docs/DESIGN.md §18):
+                     # a kind byte discriminates REQ (epoch-lagging
+                     # member asks an up-to-date peer for its view),
+                     # RSP (epoch + member admission records + a
+                     # recent-log advert), AD (view-change re-flood
+                     # advert: log-entry identities, not payloads) and
+                     # WANT (the advert entries the receiver provably
+                     # misses). ARQ- and epoch-exempt like JOIN: it
+                     # crosses the membership boundaries it heals, and
+                     # REQs repeat at join_interval until answered
 
 
 #: Tags that are store-and-forward broadcast over the skip-ring overlay.
@@ -106,12 +120,16 @@ BCAST_TAGS = frozenset({Tag.BCAST, Tag.IAR_PROPOSAL, Tag.IAR_DECISION,
 #: repeat at their own cadence until answered, and a lost WELCOME is
 #: replaced when the joiner's next probe arrives — both must also work
 #: across the membership boundary where ARQ link state is being reset.
+#: MSYNC shares the JOIN rationale: sync REQs repeat at join_interval
+#: until the view catches up, and an ARQ-stamped frame into a
+#: quarantining receiver would never be acked (a retransmit-then-give-
+#: up loop that itself declares failures).
 ARQ_EXEMPT_TAGS = frozenset({Tag.HEARTBEAT, Tag.ACK, Tag.JOIN,
-                             Tag.JOIN_WELCOME})
+                             Tag.JOIN_WELCOME, Tag.MSYNC})
 
 #: Tags exempt from the stale-epoch quarantine: the membership frames
 #: themselves must cross partition/incarnation boundaries to heal them.
-EPOCH_EXEMPT_TAGS = frozenset({Tag.JOIN, Tag.JOIN_WELCOME})
+EPOCH_EXEMPT_TAGS = frozenset({Tag.JOIN, Tag.JOIN_WELCOME, Tag.MSYNC})
 
 # origin, pid, vote, seq, epoch, data_len
 # rlo-lint: paired-with rlo_core.h:RLO_HEADER_SIZE

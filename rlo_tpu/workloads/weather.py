@@ -105,7 +105,8 @@ def churn_script(seed: int, *, world_size: int, rate: float,
                  duration: float, start: float = 10.0,
                  mean_down: float = 20.0, min_down: float = 13.0,
                  min_live: int = 2, settle: float = 70.0,
-                 immortal: Sequence[int] = ()) -> List[Tuple]:
+                 immortal: Sequence[int] = (),
+                 max_kills: Optional[int] = None) -> List[Tuple]:
     """Sustained-churn fault schedule: kill events with exponential
     interarrivals at ``rate`` per virtual second from ``start``, each
     followed by that rank's restart after an exponential ``mean_down``
@@ -117,6 +118,11 @@ def churn_script(seed: int, *, world_size: int, rate: float,
     churn scenario ends healed and the convergence properties stay
     checkable. Returns ordinary ``(t, "kill"|"restart", rank)``
     Scenario steps, sorted.
+
+    ``max_kills`` caps the total fault budget (None = unlimited): a
+    watchdog-armed sweep scenario wants sustained-churn SHAPE with a
+    bounded epoch advance, because every kill/heal cycle permanently
+    raises the fleet epoch that the epoch-lag SLO is levelled against.
 
     ``min_down`` models the real-world floor on crash-restart
     turnaround AND must exceed the fleet's failure_timeout: a rank
@@ -132,10 +138,13 @@ def churn_script(seed: int, *, world_size: int, rate: float,
     steps: List[Tuple] = []
     live = set(range(world_size))
     down_until = {}
+    kills = 0
     t = start
     while True:
         t += rng.expovariate(rate)
         if t >= last_event:
+            break
+        if max_kills is not None and kills >= max_kills:
             break
         # restarts that came due before this kill
         for r in sorted(down_until):
@@ -159,6 +168,7 @@ def churn_script(seed: int, *, world_size: int, rate: float,
                 continue
             back = last_event
         down_until[v] = back
+        kills += 1
     for r in sorted(down_until):
         steps.append((round(down_until[r], 6), "restart", r))
     steps.sort(key=lambda s: s[0])
@@ -191,7 +201,9 @@ def make_weather(name: str, seed: int = 0, **kwargs) -> Weather:
       - ``"wan"``        — heavy-tailed WAN delay (HeavyTailDelay);
       - ``"burst_loss"`` — correlated Gilbert burst loss;
       - ``"churn"``      — sustained kill/rejoin churn script
-        (requires ``world_size=``; accepts the churn_script knobs);
+        (requires ``world_size=``; accepts the churn_script knobs;
+        ``gilbert=dict(...)`` additionally rides GilbertLoss burst
+        drops under the churn — the §18 healing-path stress shape);
       - ``"storm"``      — burst loss AND heavy-tailed delay together
         (the ARQ-storm worst case).
 
@@ -207,11 +219,15 @@ def make_weather(name: str, seed: int = 0, **kwargs) -> Weather:
     if name == "churn":
         if "world_size" not in kwargs:
             raise ValueError("churn weather needs world_size=")
+        gilbert = kwargs.pop("gilbert", None)
         kw = dict(rate=kwargs.pop("rate", 0.05),
                   duration=kwargs.pop("duration", 240.0), **kwargs)
+        rkw = dict(kw, **({"gilbert": gilbert} if gilbert else {}))
         return Weather(name, seed,
                        script=tuple(churn_script(seed, **kw)),
-                       kwargs=kw)
+                       drop_fn=(GilbertLoss(**gilbert)
+                                if gilbert else None),
+                       kwargs=rkw)
     if name == "storm":
         return Weather(name, seed, delay_fn=HeavyTailDelay(),
                        drop_fn=GilbertLoss(**kwargs), kwargs=kwargs)

@@ -148,6 +148,19 @@ def test_r2_fires_on_phobs_key_typo(tree):
                for f in hits), hits
 
 
+def test_r2_fires_on_heal_counter_drift(tree):
+    """The §18 healing counters (epoch_syncs / reflood_skipped /
+    batched_admits) ride the same schema chain as every other engine
+    counter: dropping one from ENGINE_COUNTER_KEYS must break the
+    tuple <-> rlo_stats field-order pin AND the metrics() assembly."""
+    mutate(tree, "rlo_tpu/utils/metrics.py",
+           '"epoch_syncs", "reflood_skipped", "batched_admits",',
+           '"epoch_syncs", "batched_admits",')
+    hits = findings_for(tree, "R2")
+    assert any(f.file == "rlo_tpu/utils/metrics.py" and
+               "reflood_skipped" in f.msg for f in hits), hits
+
+
 def test_r2_fires_on_telem_key_drift(tree):
     """Dropping a digest key from wire.py's TELEM schema must trip
     the §17 extension: the C codec's k_telem_keys name table and

@@ -83,12 +83,24 @@ class TestEpochs:
         # most once, via the (origin, seq) dedup — the quarantine is
         # a link-level gate on the immediate sender, not an
         # origin-level censor (that would desync delivery across
-        # ranks and break the admission replay).
+        # ranks and break the admission replay). Hold rank 0's heal
+        # probes off so the quarantine window stays open: since the
+        # PR-16 batched heal, one probe re-converges the whole fleet
+        # within a single tick.
         engines[0]._mark_failed(1)
+        engines[0]._join_last_probe = float("inf")
         before = engines[0].epoch_quarantined
         engines[1].bcast(b"from the dead")
+        # the overlay routes origin-1 traffic to rank 0 through a live
+        # relay; send the direct copy too so the link-level gate on
+        # the immediate sender is actually exercised
+        engines[1]._send_raw(
+            0, int(Tag.BCAST),
+            Frame(origin=1, vote=engines[1]._bcast_seq - 1,
+                  payload=b"from the dead").encode())
         spin(mgr, clock, 10)
         assert engines[0].epoch_quarantined > before
+        assert engines[0].quar_failed_sender > 0
         drained = list(iter(engines[0].pickup_next, None))
         assert sum(m.data == b"from the dead" for m in drained) <= 1
         m = engines[0].metrics()["counters"]
@@ -164,6 +176,113 @@ class TestEpochs:
                  if e.a == victim and e.b == 1]
         assert fails, "give-up did not escalate to a declaration"
         TRACER.clear()
+
+
+class TestHealing:
+    """The §18 churn-proof healing paths: epoch catch-up without full
+    rejoin, sync-supersedes-welcome, and batched-admission
+    determinism."""
+
+    @staticmethod
+    def _deafen(engine, drop_tags):
+        """Drop inbound frames with the given tags at one rank —
+        deterministic loss (ARQ is off on this world, so nothing
+        retransmits). Mutate ``drop_tags`` to change phases."""
+        orig = engine.transport.poll
+
+        def poll():
+            m = orig()
+            while m is not None and m[1] in drop_tags:
+                m = orig()
+            return m
+
+        engine.transport.poll = poll
+
+    def test_epoch_catchup_without_full_rejoin(self):
+        """An epoch-lagging but ALIVE member syncs back via MSYNC
+        instead of being torn down for a full rejoin — root cause 1
+        of the rejoin cascade. Rank 2 misses a failure adoption AND
+        the readmission decision; the readmitted rank's below-floor
+        quarantine of rank 2's traffic triggers a stale probe, rank 2
+        answers with a sync REQUEST (the probe says it is still a
+        member), adopts the view state, and never rejoins."""
+        world, mgr, engines, clock = make_world(4)
+        spin(mgr, clock, 3)
+        drop = {int(Tag.FAILURE), int(Tag.IAR_DECISION),
+                int(Tag.MSYNC)}
+        self._deafen(engines[2], drop)
+        # false-positive declaration of rank 3: ranks 0/1 adopt it
+        # (and later readmit 3); rank 2 hears none of it
+        engines[0]._announce_failed(3)
+        for _ in range(80):
+            spin(mgr, clock, 1)
+            if engines[3].rejoins >= 1 and \
+                    not engines[3]._awaiting_welcome and \
+                    sorted(engines[0]._alive) == [0, 1, 2, 3]:
+                break
+        assert engines[3].rejoins >= 1
+        # rank 2 is lagging: it saw 3's petition (announced the
+        # failure itself) but missed the admission decision
+        assert engines[2].epoch < engines[0].epoch
+        drop.clear()  # loss window over
+        spin(mgr, clock, 40)
+        for e in engines:
+            assert sorted(e._alive) == [0, 1, 2, 3], \
+                f"rank {e.rank} view {e._alive}"
+        assert len({e.epoch for e in engines}) == 1
+        # the laggard caught up WITHOUT a rejoin: the fleet ran
+        # exactly ONE admission round (rank 3's) — a torn-down rank 2
+        # would have needed a second — and rank 2 kept incarnation 0
+        assert sum(e.admission_rounds for e in engines) == 1
+        assert engines[2].incarnation == 0
+        assert engines[2].epoch_syncs >= 1
+        assert not engines[2]._awaiting_welcome
+
+    def test_sync_supersedes_lost_welcome(self):
+        """A joiner whose WELCOME was lost re-petitions; the admitter
+        that ALREADY admitted it (same incarnation, certified link
+        reset) answers with a view-state sync instead of burning a
+        second admission round — the sync-supersedes-welcome path."""
+        world, mgr, engines, clock = make_world(4)
+        spin(mgr, clock, 3)
+        # every welcome from the admitter vanishes
+        engines[0]._send_welcome = lambda *a, **k: None
+        engines[0]._announce_failed(3)  # false positive; 3 rejoins
+        spin(mgr, clock, 120)
+        assert not engines[3]._awaiting_welcome, \
+            "joiner stayed wedged behind the lost welcome"
+        assert engines[3].rejoins >= 1
+        assert engines[3].epoch_syncs >= 1  # un-wedged via MSYNC
+        # ONE admission round: the re-petition was answered with a
+        # sync, not a second failure/admission cycle
+        assert engines[0].admission_rounds == 1
+        for e in engines:
+            assert sorted(e._alive) == [0, 1, 2, 3], \
+                f"rank {e.rank} view {e._alive}"
+        assert len({e.epoch for e in engines}) == 1
+
+    def test_batched_admission_is_deterministic(self):
+        """k queued joiners ride ONE admission record; the whole
+        healed run replays byte-identically (same schedule digest)
+        and the batch shows up in the batched_admits counter."""
+        from rlo_tpu.transport.sim import Scenario
+        # three joiners: the first petition opens a round, the other
+        # two queue behind it and ride the next record as ONE batch
+        script = [(2.0, "bcast", 0),
+                  (10.0, "partition", [[0, 1], [2, 3, 4]]),
+                  (40.0, "heal"),
+                  (140.0, "bcast", 1)]
+        runs = []
+        for _ in range(2):
+            s = Scenario(world_size=5, seed=7, duration=180.0,
+                         script=script, telemetry=True,
+                         check_delivery=False)
+            runs.append(s.run())
+        assert runs[0]["digest"] == runs[1]["digest"]
+        roll = runs[0]["fleet_view"]["rollups"]
+        assert roll["batched_admits"] >= 2
+        assert runs[0]["views"] == {r: (0, 1, 2, 3, 4)
+                                    for r in range(5)}
 
 
 # ---------------------------------------------------------------------------

@@ -197,7 +197,11 @@ class TestFleetConvergence:
         assert fv["present"] == 4  # the REJOINED rank reports too
         assert fv["rollups"]["rejoins"] >= 4
         assert fv["rollups"]["view_changes"] >= 4
-        assert fv["rollups"]["reflood_frames"] > 0
+        # §18 advert-scoped re-flood: nobody actually lost a frame
+        # here, so the adverts suppress every retransmission — the
+        # suppression itself is the telemetry signal
+        assert fv["rollups"]["reflood_frames"] == 0
+        assert fv["rollups"]["reflood_skipped"] > 0
         assert res["telemetry"][0]["malformed"] == 0
 
     def test_fabric_fleet_stats_is_view_consumer(self):
@@ -226,7 +230,8 @@ class TestFleetConvergence:
 
 NEW_KEYS = ("view_changes", "reflood_frames", "epoch_lag_max",
             "quar_mid_rejoin", "quar_failed_sender",
-            "quar_below_floor", "admission_rounds")
+            "quar_below_floor", "admission_rounds",
+            "epoch_syncs", "reflood_skipped", "batched_admits")
 
 
 def _drive_heal_scenario_python():
@@ -310,11 +315,14 @@ def test_cross_engine_heal_counter_parity():
         for k in NEW_KEYS:
             assert pc[k] == ncs[k], (r, k, pc[k], ncs[k])
     # and the values are the deterministic ones the scenario pins:
-    # every survivor re-formed once and re-flooded its 3-deep log to
-    # 6 peers; only rank 0 saw the injected frames
+    # every survivor re-formed once and ADVERTISED its 3-deep log to
+    # 6 peers (§18 incremental re-flood) — nobody lost a frame, so
+    # each receiver skips all 6x3 advertised entries and not one
+    # retransmission goes out; only rank 0 saw the injected frames
     for r in range(7):
         assert py[r]["counters"]["view_changes"] == 1
-        assert py[r]["counters"]["reflood_frames"] == 18
+        assert py[r]["counters"]["reflood_frames"] == 0
+        assert py[r]["counters"]["reflood_skipped"] == 18
     assert py[0]["counters"]["quar_failed_sender"] == 1
     assert py[0]["counters"]["epoch_lag_max"] == 1
     assert py[0]["counters"]["quar_mid_rejoin"] == 0
@@ -383,19 +391,25 @@ def _cascade_scenario(seed, incident_dir=None):
         failure_timeout=3.0, heartbeat_interval=1.0, arq_rto=1.5,
         arq_max_retries=6, op_deadline=30.0, check_delivery=False,
         telemetry=True,
-        watchdog_rules=["rejoin-cascade: sum(rejoins) / 30s >= 0.5"],
+        # hair-trigger threshold: the §18 healing work cured the
+        # genuine cascade this leg used to produce (the run now ENDS
+        # CONVERGED), so the trip machinery is exercised against the
+        # ordinary-churn rejoin rate instead of a pathology
+        watchdog_rules=["rejoin-cascade: sum(rejoins) / 30s >= 0.02"],
         incident_dir=incident_dir)
 
 
 class TestCascadeWatchdog:
     def test_trips_deterministically_with_complete_bundle(
             self, tmp_path):
-        """The acceptance criterion: the watchdog trips on the
-        churn.n16.r0.05 cascade, writes a complete incident bundle,
-        and the embedded replay recipe reproduces the trip."""
+        """The watchdog trips deterministically, writes a complete
+        incident bundle, and the embedded replay recipe reproduces
+        the trip. (The churn.n16.r0.05 leg this rides used to END
+        UNCONVERGED — a rejoin cascade — and the run itself raised;
+        since the §18 healing work it converges, so the scenario arms
+        a hair-trigger threshold to exercise the same machinery.)"""
         s = _cascade_scenario(0, incident_dir=str(tmp_path))
-        with pytest.raises(SimViolation):
-            s.run()  # the cascade IS a property violation at the end
+        s.run()  # converges now — the §18 acceptance, not a violation
         incs = s._watchdog.incidents
         assert [i.rule.name for i in incs][:1] == ["rejoin-cascade"]
         first = incs[0]
@@ -404,7 +418,7 @@ class TestCascadeWatchdog:
         # bundle completeness: rule + value + vtime + replay + fleet
         # view + per-rank traces + merged Chrome trace
         assert bundle["name"] == "rejoin-cascade"
-        assert bundle["value"] >= 0.5
+        assert bundle["value"] >= 0.02
         assert bundle["vtime"] == first.vtime
         assert "Scenario(" in bundle["replay"]
         fv = json.load(open(f"{first.bundle_dir}/fleet_view.json"))
@@ -426,8 +440,7 @@ class TestCascadeWatchdog:
         expr = bundle["replay"]
         assert expr.endswith(".run()")
         s2 = eval(expr[:-len(".run()")], ns)  # noqa: S307 - own recipe
-        with pytest.raises(SimViolation):
-            s2.run()
+        s2.run()
         assert s2._watchdog.incidents[0].vtime == first.vtime
         assert s2._watchdog.incidents[0].value == first.value
 
