@@ -121,6 +121,17 @@ fleet.cleanup()
 print(f"canary tripped at vtime {first.vtime:.1f}; bundle: {names}")
 EOF
 
+echo "== causal trace smoke (rlo-trace --json, seeded 8-rank fabric_kill) =="
+# request-scoped causal tracing (docs/DESIGN.md §19): run the seeded
+# fabric_kill failover shape with every rid sampled, reconstruct the
+# span trees, and require a complete report — every traced request
+# delivered and stage attribution telescoping exactly to e2e (exit 1
+# on analyzer findings, 2 on tool error). The same (kind, seed) pair
+# is pinned bit-for-bit across runs by tests/test_spans.py. The
+# timeout IS the wall budget.
+JAX_PLATFORMS=cpu timeout 10 python -m rlo_tpu.tools.rlo_trace \
+    --scenario fabric_kill --seed 7 --world-size 8 --json > /dev/null
+
 echo "== simulator fuzz sweep (25 seeds x 10 chaos scripts) =="
 # fixed-seed deterministic sweep over the partition/restart/burst-loss/
 # mixed scenario scripts — exactly-once, termination, and membership

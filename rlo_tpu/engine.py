@@ -46,8 +46,8 @@ from rlo_tpu.utils.metrics import (ENGINE_COUNTER_KEYS, ENGINE_PHASE_KEYS,
                                    Histogram, LinkStats)
 from rlo_tpu.utils.tracing import TRACER, Ev
 from rlo_tpu.wire import (ARQ_EXEMPT_TAGS, BCAST_TAGS, EPOCH_EXEMPT_TAGS,
-                          Frame, MSG_SIZE_MAX, Tag, restamp_epoch,
-                          restamp_link)
+                          Frame, MSG_SIZE_MAX, SPAN_CTX_SIZE, Tag,
+                          decode_span_ctx, restamp_epoch, restamp_link)
 
 logger = logging.getLogger("rlo_tpu.engine")
 
@@ -1242,6 +1242,16 @@ class ProgressEngine:
         if TRACER.enabled:
             TRACER.emit(self.rank, Ev.DELIVER, msg.tag, msg.frame.origin,
                         _trace_ident(msg.tag, msg.frame), msg.src)
+            # wire-hop receipt marker for a sampled request riding this
+            # payload (span-context trailer, docs/DESIGN.md §19): b=-1
+            # distinguishes the hop from a stage-boundary span
+            pl = msg.frame.payload
+            if len(pl) >= SPAN_CTX_SIZE:
+                span = decode_span_ctx(pl, len(pl) - SPAN_CTX_SIZE)
+                if span is not None:
+                    TRACER.emit(self.rank, Ev.SPAN, span[1], -1,
+                                span[3], span[2],
+                                ts_usec=int(self.clock() * 1e6))
         return self._to_user(msg)
 
     @staticmethod

@@ -59,6 +59,7 @@ from rlo_tpu.models.generate import (block_decode, decode_step,
                                      init_kv_cache, prefill,
                                      _decode_cfg)
 from rlo_tpu.models.transformer import TransformerConfig
+from rlo_tpu.observe.spans import Stage
 from rlo_tpu.utils.metrics import Registry, SERVING, hist_summary
 
 
@@ -152,6 +153,12 @@ class DecodeServer:
         self._completed_log: List[Tuple[int, np.ndarray]] = []
         self.rounds_run = 0
         self.steps_run = 0
+        # optional rlo-trace hooks (docs/DESIGN.md §19): a SpanRecorder
+        # plus a server-rid -> fabric-rid resolver, attached by
+        # ModelBackend when the owning fabric traces. None => the
+        # scheduler runs zero span code (one is-None test per chunk).
+        self.spans = None
+        self.span_rid_of = None
 
         cfg_d = _decode_cfg(cfg)
         if paged:
@@ -491,6 +498,8 @@ class DecodeServer:
                 n = end - a
                 toks = np.zeros((1, ps), np.int32)
                 toks[0, :n] = req.prompt[a:end]
+                t_chunk = (time.perf_counter()
+                           if self.spans is not None else 0.0)
                 logits, self.pools = self._chunk(
                     self.params, self.pools,
                     jnp.asarray(self.table[slot:slot + 1]),
@@ -499,6 +508,10 @@ class DecodeServer:
                 budget -= n
                 progressed = True
                 self.metrics.counter("serve.prefill_chunks").inc()
+                if self.spans is not None:
+                    self._span(self.req_of_slot[slot],
+                               Stage.PREFILL_CHUNK, t_chunk,
+                               time.perf_counter())
             if st["next"] < plen:
                 continue  # budget spent; more chunks next round
             # prefill complete: seed the first token, open decoding
@@ -531,6 +544,17 @@ class DecodeServer:
             self.allocator.pages_in_use)
         self.metrics.gauge("serve.pages_free").set(
             self.allocator.free_pages)
+
+    def _span(self, rid: Optional[int], stage: int, t0: float,
+              t1: float) -> None:
+        """Emit a scheduler-stage span for server rid ``rid`` when the
+        fabric attached a recorder AND the fabric-level request is
+        sampled. Off the traced path this method is never called."""
+        if rid is None or self.span_rid_of is None:
+            return
+        frid = self.span_rid_of(rid)
+        if frid is not None and self.spans.sampled(frid):
+            self.spans.emit(frid, stage, t0, t1)
 
     def _retire_if_done(self, slot: int):
         rid = self.req_of_slot[slot]

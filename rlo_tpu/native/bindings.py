@@ -195,8 +195,15 @@ def load() -> C.CDLL:
     sig("rlo_telem_decode", C.c_int64,
         [u8p, C.c_int64, C.POINTER(C.c_int32), C.POINTER(C.c_int32),
          C.POINTER(C.c_uint32), C.POINTER(C.c_int), C.POINTER(C.c_int64),
-         C.POINTER(C.c_uint32)])
+         C.POINTER(C.c_uint64)])
     sig("rlo_telem_key_name", C.c_char_p, [C.c_int])
+    # span context codec (docs/DESIGN.md §19)
+    sig("rlo_span_encode", C.c_int64,
+        [u8p, C.c_int64, C.c_int32, C.c_int32, C.c_int, C.c_int,
+         C.c_uint64])
+    sig("rlo_span_decode", C.c_int64,
+        [u8p, C.c_int64, C.POINTER(C.c_int32), C.POINTER(C.c_int32),
+         C.POINTER(C.c_int), C.POINTER(C.c_int), C.POINTER(C.c_uint64)])
     sig("rlo_engine_telem_digest", C.c_int64, [p, C.c_int, u8p, C.c_int64])
     sig("rlo_engine_link_stats", C.c_int,
         [p, C.POINTER(_LinkStats), C.c_int])
@@ -1108,7 +1115,7 @@ def telem_decode(raw: bytes):
     seq = C.c_uint32()
     full = C.c_int()
     deltas = (C.c_int64 * len(TELEM_KEYS))()
-    mask = C.c_uint32()
+    mask = C.c_uint64()
     n = lib.rlo_telem_decode(_buf(raw), len(raw), C.byref(rank),
                              C.byref(epoch), C.byref(seq),
                              C.byref(full), deltas, C.byref(mask))
@@ -1126,6 +1133,39 @@ def telem_key_names():
     lib = load()
     return tuple(lib.rlo_telem_key_name(i).decode()
                  for i in range(len(TELEM_KEYS)))
+
+
+def span_encode(gateway: int, seq: int, stage: int, t_usec: int,
+                flags: int = 1) -> bytes:
+    """Encode one span-context trailer through the C codec — the
+    byte-parity twin of wire.encode_span_ctx (docs/DESIGN.md §19)."""
+    from rlo_tpu.wire import SPAN_CTX_SIZE
+    lib = load()
+    buf = (C.c_uint8 * SPAN_CTX_SIZE)()
+    n = lib.rlo_span_encode(buf, SPAN_CTX_SIZE, gateway, seq, stage,
+                            flags, t_usec)
+    if n < 0:
+        raise ValueError(f"rlo_span_encode failed ({n})")
+    return bytes(buf[:n])
+
+
+def span_decode(raw: bytes):
+    """Decode a span context through the C codec: ``(flags, stage,
+    gateway, seq, t_usec)`` or None when ``raw`` does not start with
+    one — the parity twin of wire.decode_span_ctx."""
+    lib = load()
+    gateway = C.c_int32()
+    seq = C.c_int32()
+    stage = C.c_int()
+    flags = C.c_int()
+    t_usec = C.c_uint64()
+    n = lib.rlo_span_decode(_buf(raw), len(raw), C.byref(gateway),
+                            C.byref(seq), C.byref(stage),
+                            C.byref(flags), C.byref(t_usec))
+    if n < 0:
+        return None
+    return (flags.value, stage.value, gateway.value, seq.value,
+            t_usec.value)
 
 
 def run_judged_proposal(world_size: int, payload: bytes, proposer: int,

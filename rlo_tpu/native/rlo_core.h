@@ -536,14 +536,14 @@ int rlo_engine_phase_stats(const rlo_engine *e, rlo_phase_stats *out);
 /* Telemetry digest codec (docs/DESIGN.md S17) — the C half of the    */
 /* byte-pinned layout in rlo_tpu/wire.py (encode_telem/decode_telem): */
 /*   [magic "RLOT\x01":5][flags:u8 bit0=FULL][rank:i32][epoch:i32]    */
-/*   [seq:u32][mask:u32][zigzag-LEB128 delta per set mask bit]        */
+/*   [seq:u32][mask:u64][zigzag-LEB128 delta per set mask bit]        */
 /* Key order = wire.py TELEM_KEYS: the rlo_stats counter fields       */
 /* (ENGINE_COUNTER_KEYS) followed by the extras in k_telem_keys       */
 /* (rlo_wire.c) — rlo-lint R2 pins the three against each other.      */
 /* ------------------------------------------------------------------ */
 #define RLO_TELEM_MAGIC "RLOT\x01"
-#define RLO_TELEM_HEADER_SIZE 22
-#define RLO_TELEM_NKEYS 28
+#define RLO_TELEM_HEADER_SIZE 26
+#define RLO_TELEM_NKEYS 33
 /* Pure codec (no engine): encode vals[RLO_TELEM_NKEYS] as a digest,
  * delta vs prev (NULL or full != 0 => full snapshot, deltas vs zero).
  * Returns bytes written or RLO_ERR_TOO_BIG/RLO_ERR_ARG. */
@@ -554,10 +554,34 @@ int64_t rlo_telem_encode(uint8_t *dst, int64_t cap, int32_t rank,
  * *mask says which. Returns bytes consumed or RLO_ERR_ARG. */
 int64_t rlo_telem_decode(const uint8_t *raw, int64_t rawlen,
                          int32_t *rank, int32_t *epoch, uint32_t *seq,
-                         int *full, int64_t *deltas, uint32_t *mask);
+                         int *full, int64_t *deltas, uint64_t *mask);
 /* schema key name for mask bit i (NULL out of range) — the parity
  * surface rlo-lint R2 checks against wire.py's TELEM_KEYS */
 const char *rlo_telem_key_name(int i);
+
+/* ------------------------------------------------------------------ */
+/* Span context codec (docs/DESIGN.md S19) — the C half of the        */
+/* byte-pinned trailer in rlo_tpu/wire.py (encode_span_ctx):          */
+/*   [magic "RLOS\x01":5][flags:u8 bit0=sampled][stage:u8]            */
+/*   [gateway:i32][seq:i32][t_usec:u64 stage start, origin clock]     */
+/* Appended as a TRAILER to fabric record payloads; SIZE % 4 == 3     */
+/* makes it structurally unambiguous against i32-word record bodies.  */
+/* The engine's pickup path decodes it to emit RLO_EV_SPAN wire-hop   */
+/* events — zero cost when tracing is off.                            */
+/* ------------------------------------------------------------------ */
+#define RLO_SPAN_MAGIC "RLOS\x01"
+#define RLO_SPAN_CTX_SIZE 23
+/* Pure codec: write one span context into dst. Returns bytes written
+ * (RLO_SPAN_CTX_SIZE) or RLO_ERR_ARG on a short buffer. */
+int64_t rlo_span_encode(uint8_t *dst, int64_t cap, int32_t gateway,
+                        int32_t seq, int stage, int flags,
+                        uint64_t t_usec);
+/* Decode a span context at raw[0..RLO_SPAN_CTX_SIZE): returns bytes
+ * consumed or RLO_ERR_ARG when the bytes are not a span context
+ * (absence is the common case, not corruption). */
+int64_t rlo_span_decode(const uint8_t *raw, int64_t rawlen,
+                        int32_t *gateway, int32_t *seq, int *stage,
+                        int *flags, uint64_t *t_usec);
 /* Engine-originated digest: samples the engine's own telemetry
  * (counters + link rollups + queue depths; the serving page keys are
  * always 0 in C), delta-encodes vs the last digest THIS call emitted,
@@ -732,6 +756,10 @@ enum rlo_ev {
                             * (usec, clamped to int32); the timeline
                             * merger renders a duration slice ENDING at
                             * ts_usec */
+    RLO_EV_SPAN = 15,      /* request-scoped causal span (docs/DESIGN.md
+                            * S19): a = stage id, b = duration (usec;
+                            * -1 = wire-hop receipt of a span-stamped
+                            * record), c = rid seq, d = rid gateway */
 };
 
 typedef struct rlo_trace_event {

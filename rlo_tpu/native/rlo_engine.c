@@ -2618,6 +2618,11 @@ int64_t rlo_engine_telem_digest(rlo_engine *e, int full, uint8_t *buf,
     v[i++] = e->q_pickup.len + e->q_wait_pickup.len;
     v[i++] = 0; /* pages_in_use */
     v[i++] = 0; /* pages_free */
+    v[i++] = 0; /* serve_inflight: the serving fabric is Python-side */
+    v[i++] = 0; /* ttft_p50_usec */
+    v[i++] = 0; /* ttft_p99_usec */
+    v[i++] = 0; /* e2e_p50_usec */
+    v[i++] = 0; /* e2e_p99_usec */
     /* digest seqs are incarnation-partitioned like the broadcast
      * seqs (mirror of TelemetryPlane): re-base on a bumped life and
      * re-anchor receivers with a full snapshot; the first digest of
@@ -3716,6 +3721,17 @@ static void pickup_retire(rlo_engine *e, rlo_msg *m, int from_wait)
     }
     rlo_trace_emit(e->rank, RLO_EV_DELIVER, m->tag, m->origin,
                    trace_ident(m->tag, m->pid, m->vote), m->src);
+    /* span-stamped fabric record? emit the wire-hop span (b = -1
+     * marks a hop receipt, not a stage boundary). The trailer check
+     * runs only when tracing is on — zero cost on the disabled path. */
+    if (rlo_trace_enabled() && m->len >= RLO_SPAN_CTX_SIZE) {
+        int32_t gw, sq;
+        int st, fl;
+        if (rlo_span_decode(m->payload + m->len - RLO_SPAN_CTX_SIZE,
+                            RLO_SPAN_CTX_SIZE, &gw, &sq, &st, &fl,
+                            0) >= 0)
+            rlo_trace_emit(e->rank, RLO_EV_SPAN, st, -1, sq, gw);
+    }
     if (m == e->peeked)
         e->peeked = 0;
     if (from_wait) {

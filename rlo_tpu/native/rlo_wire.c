@@ -96,7 +96,7 @@ void rlo_frame_set_epoch(uint8_t *raw, int32_t epoch)
 /* Telemetry digest codec (docs/DESIGN.md S17) — byte-identical to    */
 /* wire.py encode_telem/decode_telem; parity asserted by              */
 /* tests/test_observe.py. Layout:                                     */
-/*   [magic:5][flags:u8][rank:i32][epoch:i32][seq:u32][mask:u32]      */
+/*   [magic:5][flags:u8][rank:i32][epoch:i32][seq:u32][mask:u64]      */
 /*   [zigzag LEB128 varint per set mask bit, ascending]               */
 /* ------------------------------------------------------------------ */
 
@@ -113,6 +113,8 @@ static const char *const k_telem_keys[RLO_TELEM_NKEYS] = {
     "epoch_syncs", "reflood_skipped", "batched_admits",
     "tx_frames", "rx_frames", "rtt_ewma_max_usec",
     "q_wait", "pickup_backlog", "pages_in_use", "pages_free",
+    "serve_inflight", "ttft_p50_usec", "ttft_p99_usec",
+    "e2e_p50_usec", "e2e_p99_usec",
 };
 
 const char *rlo_telem_key_name(int i)
@@ -149,13 +151,13 @@ int64_t rlo_telem_encode(uint8_t *dst, int64_t cap, int32_t rank,
     put_i32(dst + 6, rank);
     put_i32(dst + 10, epoch);
     put_u32(dst + 14, seq);
-    uint32_t mask = 0;
+    uint64_t mask = 0;
     int64_t pos = RLO_TELEM_HEADER_SIZE;
     for (int i = 0; i < RLO_TELEM_NKEYS; i++) {
         int64_t d = vals[i] - (full ? 0 : prev[i]);
         if (!full && d == 0)
             continue;
-        mask |= (uint32_t)1 << i;
+        mask |= (uint64_t)1 << i;
         /* zigzag, then LEB128 */
         uint64_t u = ((uint64_t)d << 1) ^ (uint64_t)(d >> 63);
         do {
@@ -165,19 +167,19 @@ int64_t rlo_telem_encode(uint8_t *dst, int64_t cap, int32_t rank,
             u >>= 7;
         } while (u);
     }
-    put_u32(dst + 18, mask);
+    put_u64(dst + 18, mask);
     return pos;
 }
 
 int64_t rlo_telem_decode(const uint8_t *raw, int64_t rawlen,
                          int32_t *rank, int32_t *epoch, uint32_t *seq,
-                         int *full, int64_t *deltas, uint32_t *mask)
+                         int *full, int64_t *deltas, uint64_t *mask)
 {
     if (!raw || rawlen < RLO_TELEM_HEADER_SIZE ||
         memcmp(raw, RLO_TELEM_MAGIC, 5) != 0)
         return RLO_ERR_ARG;
-    uint32_t m = get_u32(raw + 18);
-    if (RLO_TELEM_NKEYS < 32 && (m >> RLO_TELEM_NKEYS))
+    uint64_t m = get_u64(raw + 18);
+    if (RLO_TELEM_NKEYS < 64 && (m >> RLO_TELEM_NKEYS))
         return RLO_ERR_ARG; /* mask bits beyond the schema */
     if (rank)
         *rank = get_i32(raw + 6);
@@ -191,7 +193,7 @@ int64_t rlo_telem_decode(const uint8_t *raw, int64_t rawlen,
         *mask = m;
     int64_t pos = RLO_TELEM_HEADER_SIZE;
     for (int i = 0; i < RLO_TELEM_NKEYS; i++) {
-        if (!(m & ((uint32_t)1 << i)))
+        if (!(m & ((uint64_t)1 << i)))
             continue;
         uint64_t u = 0;
         int shift = 0;
@@ -208,4 +210,46 @@ int64_t rlo_telem_decode(const uint8_t *raw, int64_t rawlen,
             deltas[i] = (int64_t)(u >> 1) ^ -(int64_t)(u & 1);
     }
     return pos;
+}
+
+/* ------------------------------------------------------------------ */
+/* Span context codec (docs/DESIGN.md S19) — byte-identical to        */
+/* wire.py encode_span_ctx/decode_span_ctx; parity asserted by        */
+/* tests/test_spans.py. Layout:                                       */
+/*   [magic:5][flags:u8][stage:u8][gateway:i32][seq:i32][t_usec:u64]  */
+/* ------------------------------------------------------------------ */
+
+int64_t rlo_span_encode(uint8_t *dst, int64_t cap, int32_t gateway,
+                        int32_t seq, int stage, int flags,
+                        uint64_t t_usec)
+{
+    if (!dst || cap < RLO_SPAN_CTX_SIZE)
+        return RLO_ERR_ARG;
+    memcpy(dst, RLO_SPAN_MAGIC, 5);
+    dst[5] = (uint8_t)(flags & 0xff);
+    dst[6] = (uint8_t)(stage & 0xff);
+    put_i32(dst + 7, gateway);
+    put_i32(dst + 11, seq & 0x7fffffff);
+    put_u64(dst + 15, t_usec);
+    return RLO_SPAN_CTX_SIZE;
+}
+
+int64_t rlo_span_decode(const uint8_t *raw, int64_t rawlen,
+                        int32_t *gateway, int32_t *seq, int *stage,
+                        int *flags, uint64_t *t_usec)
+{
+    if (!raw || rawlen < RLO_SPAN_CTX_SIZE ||
+        memcmp(raw, RLO_SPAN_MAGIC, 5) != 0)
+        return RLO_ERR_ARG;
+    if (flags)
+        *flags = raw[5];
+    if (stage)
+        *stage = raw[6];
+    if (gateway)
+        *gateway = get_i32(raw + 7);
+    if (seq)
+        *seq = get_i32(raw + 11);
+    if (t_usec)
+        *t_usec = get_u64(raw + 15);
+    return RLO_SPAN_CTX_SIZE;
 }

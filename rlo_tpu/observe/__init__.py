@@ -30,6 +30,7 @@ time only from the engine's injectable clock, so whole instrumented
 fleets replay bit-for-bit inside the deterministic simulator.
 """
 
+from rlo_tpu.observe.spans import STAGE_NAMES, SpanRecorder, Stage
 from rlo_tpu.observe.telemetry import (FleetView, TelemetryPlane,
                                        merge_counter_dicts,
                                        merge_histograms)
@@ -39,5 +40,5 @@ from rlo_tpu.observe.watchdog import (DEFAULT_RULES, Incident, Rule,
 __all__ = [
     "FleetView", "TelemetryPlane", "merge_counter_dicts",
     "merge_histograms", "Rule", "Watchdog", "Incident", "DEFAULT_RULES",
-    "parse_rule",
+    "parse_rule", "Stage", "STAGE_NAMES", "SpanRecorder",
 ]

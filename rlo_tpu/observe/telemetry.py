@@ -207,7 +207,12 @@ class TelemetryPlane:
         vals += [tx, rx, int(rtt), int(q["wait"]),
                  int(q["pickup"]) + int(q["wait_and_pickup"]),
                  int(ex.get("pages_in_use", 0)),
-                 int(ex.get("pages_free", 0))]
+                 int(ex.get("pages_free", 0)),
+                 int(ex.get("serve_inflight", 0)),
+                 int(ex.get("ttft_p50_usec", 0)),
+                 int(ex.get("ttft_p99_usec", 0)),
+                 int(ex.get("e2e_p50_usec", 0)),
+                 int(ex.get("e2e_p99_usec", 0))]
         return vals
 
     def emit(self, full: bool = False) -> Dict[str, int]:

@@ -299,6 +299,16 @@ class Watchdog:
                 paths, out_path=os.path.join(d, "trace.json"))
             validate_chrome_trace(trace)
             doc["trace_events"] = trace["otherData"]["events"]
+            # request-span dump (docs/DESIGN.md §19): every Ev.SPAN in
+            # the ring, all ranks — rlo-trace consumes this directly,
+            # so a tripped TTFT SLO ships the offending requests'
+            # waterfalls inside the bundle
+            from rlo_tpu.utils.tracing import Ev
+            span_events = TRACER.events(Ev.SPAN)
+            with open(os.path.join(d, "spans.jsonl"), "w") as f:
+                for ev in span_events:
+                    f.write(json.dumps(ev.to_dict()) + "\n")
+            doc["span_events"] = len(span_events)
             with open(os.path.join(d, "incident.json"), "w") as f:
                 json.dump(doc, f, indent=1)
             inc.bundle_dir = d

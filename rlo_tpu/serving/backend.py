@@ -8,7 +8,13 @@ with the slot-server face
     cancel(key) -> bool
     has_work() -> bool
     load() -> (free_slots, queue_depth)
+    active_keys() -> [key, ...]                  # keys holding a slot
     stats() -> dict
+
+``active_keys`` is the queue->active boundary the fabric's latency
+attribution reads (docs/DESIGN.md §19): a key that appears there (or
+completes) for the first time after a ``step_round()`` has just ended
+its queue residency.
 
 Two implementations:
 
@@ -107,6 +113,9 @@ class StubBackend:
 
     def load(self) -> Tuple[int, int]:
         return (self.n_slots - len(self._active), len(self._queue))
+
+    def active_keys(self) -> List:
+        return list(self._active)
 
     def stats(self) -> dict:
         return {"backend": "stub", "n_slots": self.n_slots,
@@ -285,6 +294,17 @@ class ModelBackend:
 
     def load(self) -> Tuple[int, int]:
         return (self.server.free_slots(), self.server.queue_depth())
+
+    def active_keys(self) -> List:
+        return [self._key_of[r] for r in self.server.req_of_slot
+                if r is not None and r in self._key_of]
+
+    def attach_spans(self, recorder) -> None:
+        """rlo-trace (docs/DESIGN.md §19): hand the server's paged
+        scheduler the fabric's SpanRecorder so it emits prefill_chunk
+        spans, resolving server rids back to fabric rids."""
+        self.server.spans = recorder
+        self.server.span_rid_of = self._key_of.get
 
     def stats(self) -> dict:
         return {"backend": "decode_server", **self.server.stats()}

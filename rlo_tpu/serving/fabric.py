@@ -42,9 +42,11 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 from rlo_tpu.engine import (INCARNATION_SHIFT, ProgressEngine, ReqState,
                             UserMsg)
+from rlo_tpu.observe.spans import SpanRecorder, Stage
 from rlo_tpu.serving.placement import (Placement, owner_of, pick_owner)
 from rlo_tpu.utils.metrics import Registry, hist_summary
-from rlo_tpu.wire import Tag
+from rlo_tpu.wire import (SPAN_F_SAMPLED, Tag, encode_span_ctx,
+                          split_span_ctx)
 
 #: Prefix marking a payload as a serving-fabric record (the serving
 #: analogue of the engine's MEMBER_MAGIC): ADMIT/DONE ride Tag.BCAST,
@@ -76,9 +78,13 @@ class Rec(enum.IntEnum):
 
 
 class _FabReq:
-    """One admitted request as every member tracks it."""
+    """One admitted request as every member tracks it. ``t_enq`` is
+    the start of the CURRENT queue residency (reset when a failover
+    re-queues the request), ``t_active`` the decode round that first
+    ran it here (None while queued), ``traced`` whether the sampled
+    bit rode in on the ADMIT record's span context."""
     __slots__ = ("prompt", "max_new", "eos_id", "gateway", "owner",
-                 "t_admit")
+                 "t_admit", "t_enq", "t_active", "traced")
 
     def __init__(self, prompt: Tuple[int, ...], max_new: int,
                  eos_id: int, gateway: int, owner: int,
@@ -89,25 +95,32 @@ class _FabReq:
         self.gateway = gateway
         self.owner = owner
         self.t_admit = t_admit
+        self.t_enq = t_admit
+        self.t_active: Optional[float] = None
+        self.traced = False
 
 
 def _enc_admit(rid: Rid, owner: int, max_new: int, eos_id: int,
-               prompt: Sequence[int]) -> bytes:
+               prompt: Sequence[int], ctx: bytes = b"") -> bytes:
+    """``ctx`` is the optional span-context trailer (docs/DESIGN.md
+    §19) — ``b""`` (tracing off) keeps the record byte-identical to
+    the pre-span wire format."""
     p = tuple(int(t) for t in prompt)
     return (FABRIC_MAGIC + bytes([Rec.ADMIT]) +
             struct.pack(f"<iiiii{len(p)}i", rid[0], rid[1], owner,
-                        max_new, eos_id, *p))
+                        max_new, eos_id, *p) + ctx)
 
 
-def _enc_done(rid: Rid, decoder: int,
-              tokens: Sequence[int]) -> bytes:
+def _enc_done(rid: Rid, decoder: int, tokens: Sequence[int],
+              ctx: bytes = b"") -> bytes:
     t = tuple(int(x) for x in tokens)
     return (FABRIC_MAGIC + bytes([Rec.DONE]) +
-            struct.pack(f"<iii{len(t)}i", rid[0], rid[1], decoder, *t))
+            struct.pack(f"<iii{len(t)}i", rid[0], rid[1], decoder,
+                        *t) + ctx)
 
 
-def _enc_place(place: Placement) -> bytes:
-    return FABRIC_MAGIC + bytes([Rec.PLACE]) + place.encode()
+def _enc_place(place: Placement, ctx: bytes = b"") -> bytes:
+    return FABRIC_MAGIC + bytes([Rec.PLACE]) + place.encode() + ctx
 
 
 def _enc_load(free: int, depth: int) -> bytes:
@@ -149,7 +162,8 @@ class DecodeFabric:
                  load_interval: float = 1.0,
                  place_retry: float = 2.0,
                  done_ttl: Optional[float] = None,
-                 metrics: Optional[Registry] = None):
+                 metrics: Optional[Registry] = None,
+                 spans: Optional[SpanRecorder] = None):
         self.engine = engine
         self.backend = backend
         self.rank = engine.rank
@@ -159,6 +173,15 @@ class DecodeFabric:
         self.place_retry = place_retry
         self.done_ttl = done_ttl
         self.metrics = Registry() if metrics is None else metrics
+        #: attached span recorder (docs/DESIGN.md §19) — None (the
+        #: default) is the zero-cost disabled path: no trailers are
+        #: stamped and every instrumentation site is one `is None`
+        #: branch
+        self.spans = spans
+        self._proposed_ctx: Optional[Tuple[int, int, int, int, int]] \
+            = None
+        if spans is not None and hasattr(backend, "attach_spans"):
+            backend.attach_spans(spans)  # ModelBackend: prefill spans
 
         #: PENDING requests only — entries are evicted at completion
         #: (the prompt is dead weight once decoded), so every per-pump
@@ -226,10 +249,20 @@ class DecodeFabric:
         owner = pick_owner(self.rank, self.placement.members,
                            self._loads)
         eos = -1 if eos_id is None else int(eos_id)
+        ctx = b""
+        tup = None
+        if self.spans is not None:
+            sampled = self.spans.sampled(rid)
+            t0 = int(round(self.clock() * 1e6))
+            tup = (SPAN_F_SAMPLED if sampled else 0,
+                   int(Stage.ADMIT_BCAST), rid[0],
+                   rid[1] & 0x7FFFFFFF, t0)
+            ctx = encode_span_ctx(rid[0], rid[1], Stage.ADMIT_BCAST,
+                                  t0, tup[0])
         self._apply_admit(rid, owner, int(max_new), eos,
-                          tuple(int(t) for t in prompt))
+                          tuple(int(t) for t in prompt), tup)
         self.engine.bcast(_enc_admit(rid, owner, int(max_new), eos,
-                                     prompt))
+                                     prompt, ctx))
         return rid
 
     def result(self, rid: Rid) -> Optional[Tuple[int, ...]]:
@@ -267,14 +300,18 @@ class DecodeFabric:
         if payload.startswith(FABRIC_MAGIC):
             place = Placement.decode(payload, len(FABRIC_MAGIC) + 1)
             if place is not None:
-                self._adopt_place(place)
+                _, span = split_span_ctx(payload,
+                                         len(FABRIC_MAGIC) + 1)
+                self._adopt_place(place, span)
             return None
         prev_action = self._prev_app[1]
         if prev_action is None:
             return None
         return prev_action(payload, self._prev_app[2])
 
-    def _adopt_place(self, place: Placement) -> None:
+    def _adopt_place(self, place: Placement,
+                     span: Optional[Tuple[int, int, int, int, int]]
+                     = None) -> None:
         """Newest-wins adoption ((version, proposer) order): stale
         records re-flooded out of replaced views can never regress
         routing; equal-key records are byte-identical by construction
@@ -285,13 +322,27 @@ class DecodeFabric:
         self.metrics.counter("fabric.placements_adopted").inc()
         self.metrics.gauge("fabric.placement_version").set(
             place.version)
+        if self.spans is not None and span is not None and \
+                span[0] & SPAN_F_SAMPLED:
+            # fleet-level span keyed rid = (-1, placement version):
+            # propose (the trailer's stamp) -> adopted here
+            self.spans.emit((span[2], span[3]), Stage.PLACEMENT_IAR,
+                            span[4] / 1e6, self.clock())
 
     def _propose_place(self, members: Tuple[int, ...]) -> None:
         place = Placement(version=self.engine.epoch,
                           proposer=self.rank, members=members)
         self._proposed = place
         self.metrics.counter("fabric.placements_proposed").inc()
-        self.engine.submit_proposal(_enc_place(place),
+        ctx = b""
+        if self.spans is not None:
+            t0 = int(round(self.clock() * 1e6))
+            self._proposed_ctx = (SPAN_F_SAMPLED,
+                                  int(Stage.PLACEMENT_IAR), -1,
+                                  place.version & 0x7FFFFFFF, t0)
+            ctx = encode_span_ctx(-1, place.version,
+                                  Stage.PLACEMENT_IAR, t0)
+        self.engine.submit_proposal(_enc_place(place, ctx),
                                     pid=self._my_place_pid)
 
     # ------------------------------------------------------------------
@@ -346,8 +397,9 @@ class DecodeFabric:
                 p.pid == self._my_place_pid and \
                 p.state != ReqState.IN_PROGRESS:
             if p.state == ReqState.COMPLETED and p.vote:
-                self._adopt_place(self._proposed)
+                self._adopt_place(self._proposed, self._proposed_ctx)
             self._proposed = None  # declined/failed: retried below
+            self._proposed_ctx = None
 
         now = self.clock()
         view = tuple(sorted(eng.group))
@@ -378,7 +430,9 @@ class DecodeFabric:
 
         if now >= self._next_decode and self.backend.has_work():
             self._next_decode = now + self.decode_interval
-            for rid, toks in self.backend.step_round():
+            completed = self.backend.step_round()
+            self._observe_dequeues(now, completed)
+            for rid, toks in completed:
                 self._local.discard(rid)
                 if rid in self.done:
                     # completed elsewhere while my round ran (an
@@ -403,6 +457,32 @@ class DecodeFabric:
         if self.telemetry is not None:
             self.telemetry.tick()
         return unhandled
+
+    def _observe_dequeues(self, now: float,
+                          completed: Sequence[Tuple[Rid, tuple]]
+                          ) -> None:
+        """Queue->active boundary bookkeeping after a decode round:
+        the first round that runs a request here ends its queue
+        residency. Always on (the ``fabric.queue_wait_usec`` /
+        ``fabric.ttft_usec`` parity twins of the server-side
+        ``serve.queue_wait_usec``, on the engine clock); the queue
+        SPAN is emitted only for traced rids. A request that finished
+        within its first round shows up in ``completed`` rather than
+        ``active_keys()`` — its queue ended when this round ran."""
+        newly = list(self.backend.active_keys())
+        newly += [rid for rid, _ in completed]
+        for rid in newly:
+            req = self.requests.get(rid)
+            if req is None or req.t_active is not None or \
+                    rid not in self._local:
+                continue
+            req.t_active = now
+            self.metrics.histogram("fabric.queue_wait_usec").observe(
+                (now - req.t_enq) * 1e6)
+            self.metrics.histogram("fabric.ttft_usec").observe(
+                (now - req.t_admit) * 1e6)
+            if self.spans is not None and req.traced:
+                self.spans.emit(rid, Stage.QUEUE, req.t_enq, now)
 
     def _evict_done(self, now: float) -> None:
         """Age the completion cache past the ``done_ttl`` horizon (the
@@ -445,7 +525,8 @@ class DecodeFabric:
             # path): newest-wins adoption is idempotent
             place = Placement.decode(body)
             if place is not None:
-                self._adopt_place(place)
+                _, span = split_span_ctx(body, 0)
+                self._adopt_place(place, span)
         elif kind == Rec.LOAD:
             if len(body) >= 8:
                 self._loads[origin] = struct.unpack_from("<ii", body)
@@ -455,8 +536,9 @@ class DecodeFabric:
     def _on_admit(self, body: bytes, origin: int) -> None:
         if len(body) < 20:
             return
+        end, span = split_span_ctx(body, 20)
         g, s, owner, max_new, eos = struct.unpack_from("<iiiii", body)
-        n = (len(body) - 20) // 4
+        n = (end - 20) // 4
         prompt = struct.unpack_from(f"<{n}i", body, 20)
         rid: Rid = (g, s)
         if rid in self.done:
@@ -474,29 +556,56 @@ class DecodeFabric:
             return
         if rid in self.requests:
             return  # duplicate admission: rid-level exactly-once
-        self._apply_admit(rid, owner, max_new, eos, prompt)
+        self._apply_admit(rid, owner, max_new, eos, prompt, span)
 
     def _apply_admit(self, rid: Rid, owner: int, max_new: int,
-                     eos: int, prompt: Tuple[int, ...]) -> None:
-        self.requests[rid] = _FabReq(prompt, max_new, eos, rid[0],
-                                     owner, self.clock())
+                     eos: int, prompt: Tuple[int, ...],
+                     span: Optional[Tuple[int, int, int, int, int]]
+                     = None) -> None:
+        now = self.clock()
+        req = _FabReq(prompt, max_new, eos, rid[0], owner, now)
+        self.requests[rid] = req
         self.metrics.counter("fabric.requests_admitted").inc()
+        if self.spans is not None and span is not None and \
+                span[0] & SPAN_F_SAMPLED:
+            # admission broadcast span: gateway submit (the trailer's
+            # stamp) -> this rank applied the ADMIT
+            req.traced = True
+            self.spans.emit(rid, Stage.ADMIT_BCAST, span[4] / 1e6,
+                            now)
 
     def _on_done(self, body: bytes) -> None:
         if len(body) < 12:
             return
+        end, span = split_span_ctx(body, 12)
         g, s, decoder = struct.unpack_from("<iii", body)
-        n = (len(body) - 12) // 4
+        n = (end - 12) // 4
         toks = struct.unpack_from(f"<{n}i", body, 12)
-        self._record_done((g, s), decoder, toks)
+        self._record_done((g, s), decoder, toks, span)
 
     def _complete(self, rid: Rid, toks: Tuple[int, ...]) -> None:
         """My backend finished ``rid``: record + broadcast the DONE."""
-        self._record_done(rid, self.rank, toks)
-        self.engine.bcast(_enc_done(rid, self.rank, toks))
+        ctx = b""
+        span = None
+        if self.spans is not None:
+            req = self.requests.get(rid)
+            if req is not None and req.traced:
+                now = self.clock()
+                start = req.t_enq if req.t_active is None \
+                    else req.t_active
+                self.spans.emit(rid, Stage.DECODE_ROUND, start, now)
+                t0 = int(round(now * 1e6))
+                span = (SPAN_F_SAMPLED, int(Stage.DELIVER), rid[0],
+                        rid[1] & 0x7FFFFFFF, t0)
+                ctx = encode_span_ctx(rid[0], rid[1], Stage.DELIVER,
+                                      t0)
+        self._record_done(rid, self.rank, toks, span)
+        self.engine.bcast(_enc_done(rid, self.rank, toks, ctx))
 
     def _record_done(self, rid: Rid, decoder: int,
-                     toks: Tuple[int, ...]) -> None:
+                     toks: Tuple[int, ...],
+                     span: Optional[Tuple[int, int, int, int, int]]
+                     = None) -> None:
         if rid in self.done or rid in self._evicted:
             # a DONE copy for a settled rid (heal re-broadcasts, a
             # direct reply racing the broadcast, or a replay for a rid
@@ -520,8 +629,15 @@ class DecodeFabric:
         self.metrics.counter("fabric.requests_completed").inc()
         req = self.requests.pop(rid, None)  # evict: decoded == done
         if req is not None:
+            now = self.clock()
             self.metrics.histogram("fabric.e2e_usec").observe(
-                (self.clock() - req.t_admit) * 1e6)
+                (now - req.t_admit) * 1e6)
+            if self.spans is not None and req.traced and \
+                    span is not None and rid[0] == self.rank:
+                # gateway-side delivery span: owner DONE broadcast
+                # (the trailer's stamp) -> delivered here
+                self.spans.emit(rid, Stage.DELIVER, span[4] / 1e6,
+                                now)
         if rid in self._local:
             # completed elsewhere first: stop decoding it here
             self.backend.cancel(rid)
@@ -542,6 +658,15 @@ class DecodeFabric:
                     if req.owner != self.rank:
                         self.requeues += 1
                         self.metrics.counter("fabric.requeued").inc()
+                        # failover lineage: the re-queue restarts the
+                        # queue clock; the zero-duration marker is the
+                        # link between the dead owner's last stage and
+                        # the new owner's queue span
+                        req.t_enq = self.clock()
+                        req.t_active = None
+                        if self.spans is not None and req.traced:
+                            self.spans.emit(rid, Stage.REQUEUE,
+                                            req.t_enq, req.t_enq)
                     self.backend.submit(
                         rid, req.prompt, req.max_new,
                         None if req.eos_id < 0 else req.eos_id)
@@ -586,13 +711,25 @@ class DecodeFabric:
 
     def telemetry_extra(self) -> dict:
         """Digest extras for the TELEM schema's serving keys: the
-        paged pool's occupancy, when this rank's backend has one
-        (zeros otherwise — the schema is fixed fleet-wide)."""
+        paged pool's occupancy when this rank's backend has one, plus
+        the latency block rlo-top's ``--serve`` view renders —
+        in-flight requests on this rank's backend and the p50/p99 of
+        the fabric TTFT / e2e histograms (log2-bucket estimates,
+        zero while empty; the schema is fixed fleet-wide and the C
+        engine emits zeros for all of these)."""
         pages = self.backend.stats().get("pages")
-        if not isinstance(pages, dict):
-            return {"pages_in_use": 0, "pages_free": 0}
-        return {"pages_in_use": int(pages.get("pages_in_use", 0)),
-                "pages_free": int(pages.get("pages_free", 0))}
+        out = {"pages_in_use": 0, "pages_free": 0}
+        if isinstance(pages, dict):
+            out["pages_in_use"] = int(pages.get("pages_in_use", 0))
+            out["pages_free"] = int(pages.get("pages_free", 0))
+        out["serve_inflight"] = len(self._local)
+        ttft = self.metrics.histogram("fabric.ttft_usec")
+        e2e = self.metrics.histogram("fabric.e2e_usec")
+        out["ttft_p50_usec"] = int(ttft.p50() or 0)
+        out["ttft_p99_usec"] = int(ttft.p99() or 0)
+        out["e2e_p50_usec"] = int(e2e.p50() or 0)
+        out["e2e_p99_usec"] = int(e2e.p99() or 0)
+        return out
 
     def stats(self) -> dict:
         """Per-rank fabric snapshot: counters/gauges verbatim,
@@ -635,6 +772,9 @@ def fleet_stats(fabrics: Sequence[DecodeFabric],
             [s["counters"] for s in snaps]),
         "e2e_usec": merge_histograms(
             [s["histograms"].get("fabric.e2e_usec") for s in snaps]),
+        "queue_wait_usec": merge_histograms(
+            [s["histograms"].get("fabric.queue_wait_usec")
+             for s in snaps]),
         "ranks": {str(f.rank): f.stats() for f in fabrics},
     }
     if view is None and fabrics:

@@ -35,8 +35,10 @@ from __future__ import annotations
 from random import Random
 from typing import Dict, List, Optional, Sequence
 
+from rlo_tpu.observe.spans import SpanRecorder
 from rlo_tpu.serving.backend import StubBackend, stub_tokens
 from rlo_tpu.serving.fabric import DecodeFabric
+from rlo_tpu.utils.tracing import Tracer
 from rlo_tpu.transport.sim import \
     FABRIC_SCENARIO_KINDS as _FABRIC_SCENARIO_KINDS
 from rlo_tpu.transport.sim import (SimViolation, SimWorld,
@@ -65,7 +67,8 @@ class FabricScenario:
                  paged_stub: bool = False, n_pages: int = 33,
                  page_size: int = 8,
                  prefix_pool: Optional[Sequence[Sequence[int]]] = None,
-                 weather=None, scheduler: str = "heap"):
+                 weather=None, scheduler: str = "heap",
+                 trace_sample: Optional[int] = None):
         self.ws = world_size
         self.seed = seed
         self.duration = duration
@@ -96,6 +99,13 @@ class FabricScenario:
         self.page_size = page_size
         self.prefix_pool = (None if prefix_pool is None else
                             [tuple(p) for p in prefix_pool])
+        # rlo-trace (docs/DESIGN.md §19): trace_sample=1/N attaches a
+        # SpanRecorder per rank (shared seed => every rank samples the
+        # same rid set) emitting into ``self.tracer`` — a private ring,
+        # so the process-wide TRACER's enabled state is untouched.
+        # None (the default) runs the zero-cost disabled path.
+        self.trace_sample = trace_sample
+        self.tracer: Optional[Tracer] = None
 
     def _replay_recipe(self) -> str:
         # every non-default knob is printed: a recipe that silently
@@ -114,7 +124,8 @@ class FabricScenario:
                 ("page_size", self.page_size, 8),
                 ("prefix_pool", self.prefix_pool, None),
                 ("weather", self.weather, None),
-                ("scheduler", self.scheduler, "heap")):
+                ("scheduler", self.scheduler, "heap"),
+                ("trace_sample", self.trace_sample, None)):
             if val != default:
                 extra += f", {name}={val!r}"
         return (f"FabricScenario(world_size={self.ws}, "
@@ -152,10 +163,23 @@ class FabricScenario:
             return StubBackend(n_slots=self.n_slots,
                                round_len=self.round_len)
 
+        # span recorders persist across a rank's restarts (the rid
+        # sample set and the ring are properties of the RUN, not of
+        # one engine incarnation)
+        recorders: List[Optional[SpanRecorder]] = [None] * self.ws
+        if self.trace_sample is not None:
+            self.tracer = Tracer(capacity=1 << 20, enabled=True)
+            recorders = [
+                SpanRecorder(r, world.clock,
+                             sample=self.trace_sample,
+                             seed=self.seed, tracer=self.tracer)
+                for r in range(self.ws)]
+
         def make_fabric(r: int) -> DecodeFabric:
             return DecodeFabric(
                 engines[r], make_backend(),
-                decode_interval=self.decode_interval)
+                decode_interval=self.decode_interval,
+                spans=recorders[r])
 
         fabrics: List[DecodeFabric] = [make_fabric(r)
                                        for r in range(self.ws)]

@@ -98,6 +98,7 @@ CORE_H = "rlo_tpu/native/rlo_core.h"
 WIRE_C = "rlo_tpu/native/rlo_wire.c"
 ENGINE_C = "rlo_tpu/native/rlo_engine.c"
 FABRIC_PY = "rlo_tpu/serving/fabric.py"
+TRACING_PY = "rlo_tpu/utils/tracing.py"
 
 #: R5 scope: the seed-deterministic code paths (engine + transports the
 #: simulator drives, plus the serving fabric, which whole fleets replay
@@ -121,6 +122,11 @@ R5_FILES = (ENGINE_PY, "rlo_tpu/transport/base.py",
             "rlo_tpu/observe/__init__.py",
             "rlo_tpu/observe/telemetry.py",
             "rlo_tpu/observe/watchdog.py",
+            # request-span recorder (round 19): sampling salt and span
+            # timestamps are part of the deterministic replay — a
+            # module-random draw or wall-clock stamp would unpin the
+            # bit-for-bit rlo-trace acceptance property
+            "rlo_tpu/observe/spans.py",
             "rlo_tpu/tools/rlo_top.py",
             # the analyzers themselves (round 15): a wall-clock or
             # module-random dependency in rlo-lint/rlo-sentinel would
@@ -528,6 +534,82 @@ def rule_r1(ctx: "LintContext") -> List[Finding]:
     for py_name in ("FANOUT_SKIP_RING", "FANOUT_FLAT"):
         const_pair(py_name, f"RLO_{py_name}", fanouts)
 
+    # span-context trailer pins (docs/DESIGN.md §19): size, magic and
+    # packed layout must match the C codec byte-for-byte — a drifted
+    # trailer mis-frames EVERY record of a traced fleet, and a size
+    # whose % 4 != 3 destroys the structural discrimination against
+    # clean i32-word record bodies
+    span_fmt = None
+    if "_SPAN_CTX" in assigns:
+        snode, _ = assigns["_SPAN_CTX"]
+        if isinstance(snode, ast.Call) and snode.args and \
+                isinstance(snode.args[0], ast.Constant):
+            span_fmt = snode.args[0].value
+    py_span_magic = None
+    if "SPAN_MAGIC" in assigns:
+        mnode, mline = assigns["SPAN_MAGIC"]
+        _require_anchor(ctx, f, wire, mline, "SPAN_MAGIC")
+        py_span_magic = (mnode.value if isinstance(mnode, ast.Constant)
+                         and isinstance(mnode.value, bytes) else None)
+        cm = re.search(r'#define\s+RLO_SPAN_MAGIC\s+'
+                       r'"((?:[^"\\]|\\.)*)"', hdr.raw)
+        if cm is None:
+            f.append(Finding("R1", hdr.path, 1,
+                             "RLO_SPAN_MAGIC string macro not found"))
+        else:
+            c_magic = cm.group(1).encode().decode(
+                "unicode_escape").encode("latin1")
+            if py_span_magic != c_magic:
+                f.append(Finding(
+                    "R1", wire.path, mline,
+                    f"SPAN_MAGIC {py_span_magic!r} != RLO_SPAN_MAGIC "
+                    f"{c_magic!r} ({hdr.path})"))
+    else:
+        f.append(Finding("R1", wire.path, 1, "SPAN_MAGIC not defined"))
+    if "SPAN_CTX_SIZE" in assigns:
+        node, line = assigns["SPAN_CTX_SIZE"]
+        val = _const_int(node)
+        _require_anchor(ctx, f, wire, line, "SPAN_CTX_SIZE")
+        _check_pair(f, "R1", wire.path, line, "SPAN_CTX_SIZE", val,
+                    hdr.path, "RLO_SPAN_CTX_SIZE",
+                    hdr.macro("RLO_SPAN_CTX_SIZE"))
+        if py_span_magic is not None and isinstance(span_fmt, str):
+            _check_pair(f, "R1", wire.path, line, "SPAN_CTX_SIZE",
+                        val, wire.path,
+                        f"len(SPAN_MAGIC) + calcsize({span_fmt!r})",
+                        len(py_span_magic) + struct.calcsize(span_fmt))
+        if val is not None and val % 4 != 3:
+            f.append(Finding(
+                "R1", wire.path, line,
+                f"SPAN_CTX_SIZE = {val} but % 4 must be 3: record "
+                f"bodies are whole i32 words, so only a %4==3 "
+                f"trailer is structurally unambiguous"))
+    else:
+        f.append(Finding("R1", wire.path, 1,
+                         "SPAN_CTX_SIZE not defined"))
+
+    # Ev <-> enum rlo_ev (both directions, value equality): the two
+    # tracer rings merge into ONE timeline, so a kind renumbered on
+    # one side corrupts every merged trace
+    py_evs = py_enum_members(ctx.tracing, "Ev")
+    c_evs = hdr.enums.get("rlo_ev", {})
+    for name, (val, line) in py_evs.items():
+        c_name = f"RLO_EV_{name}"
+        if c_name not in c_evs:
+            f.append(Finding(
+                "R1", ctx.tracing.path, line,
+                f"Ev.{name} has no {c_name} in {hdr.path}"))
+        elif c_evs[c_name][0] != val:
+            f.append(Finding(
+                "R1", ctx.tracing.path, line,
+                f"Ev.{name} = {val} but {c_name} = "
+                f"{c_evs[c_name][0]} ({hdr.path}:{c_evs[c_name][1]})"))
+    for c_name, (val, line) in c_evs.items():
+        if c_name.replace("RLO_EV_", "") not in py_evs:
+            f.append(Finding(
+                "R1", hdr.path, line,
+                f"{c_name} has no Ev member in utils/tracing.py"))
+
     # HIST_BUCKETS triple (metrics.py / bindings.py / RLO_HIST_BUCKETS)
     m_assigns = py_top_assigns(ctx.metrics)
     c_hb = hdr.macro("RLO_HIST_BUCKETS")
@@ -742,11 +824,11 @@ def _r2_telem(ctx: "LintContext",
             "TELEM_EXTRA_KEYS (the digest schema embeds the counter "
             "schema verbatim)"))
     full = tuple(counter_keys) + extras
-    if len(full) > 32:
+    if len(full) > 64:
         f.append(Finding(
             "R2", wire.path, kline,
             f"TELEM schema has {len(full)} keys; the digest mask is "
-            f"a u32 (max 32)"))
+            f"a u64 (max 64)"))
 
     # RLO_TELEM_NKEYS + header size + magic bytes
     try:
@@ -1241,6 +1323,7 @@ class LintContext:
     metrics: PyModule
     engine: PyModule
     bindings: PyModule
+    tracing: PyModule
     header: CHeader
     wire_c_stripped: str
     engine_c_stripped: str
@@ -1269,6 +1352,7 @@ def build_context(root: Path,
         metrics=parse_py(root / METRICS_PY, METRICS_PY),
         engine=engine,
         bindings=parse_py(root / BINDINGS_PY, BINDINGS_PY),
+        tracing=parse_py(root / TRACING_PY, TRACING_PY),
         header=parse_c_header(root / CORE_H, CORE_H),
         wire_c_stripped=_strip_c_comments(wire_c),
         engine_c_stripped=_strip_c_comments(engine_c),
@@ -1284,8 +1368,8 @@ _RULES = {"R1": rule_r1, "R2": rule_r2, "R3": rule_r3, "R4": rule_r4,
 def audit_files(root: Path) -> List[str]:
     """Files whose anchors fall under the stale-anchor audit (the
     files rlo-lint reads; rlo-sentinel unions its own set in)."""
-    fixed = [WIRE_PY, METRICS_PY, ENGINE_PY, BINDINGS_PY, CORE_H,
-             WIRE_C, ENGINE_C]
+    fixed = [WIRE_PY, METRICS_PY, ENGINE_PY, BINDINGS_PY, TRACING_PY,
+             CORE_H, WIRE_C, ENGINE_C]
     return fixed + [rel for rel in R5_FILES
                     if (Path(root) / rel).exists()]
 

@@ -55,6 +55,16 @@ _HEAL_COLS = (
     ("badm", "batched_admits", 5),
 )
 
+#: the serving-latency block ``--serve`` appends: in-flight requests
+#: plus the per-rank p50/p99 TTFT and e2e latency gauges the fabric
+#: publishes through the TELEM_EXTRA_KEYS digest extras
+#: (docs/DESIGN.md §19) — fleet latency posture with no scrape path
+_SERVE_COLS = (
+    ("infl", "serve_inflight", 5),
+    ("ttft50", "ttft_p50_usec", 8), ("ttft99", "ttft_p99_usec", 8),
+    ("e2e50", "e2e_p50_usec", 8), ("e2e99", "e2e_p99_usec", 8),
+)
+
 
 class FleetHarness:
     """A driven sim fleet with one telemetry plane per rank — what
@@ -182,10 +192,13 @@ def run_fleet(world_size: int = 8, seed: int = 0,
     return FleetHarness(world, mgr, engines, planes, fabrics)
 
 
-def render(snap: Dict, heal: bool = False) -> str:
+def render(snap: Dict, heal: bool = False,
+           serve: bool = False) -> str:
     """Text table for one FleetView snapshot. ``heal=True`` (the
-    ``--fabric`` view) appends the §18 heal-counter block."""
-    cols = _COLS + (_HEAL_COLS if heal else ())
+    ``--fabric`` view) appends the §18 heal-counter block;
+    ``serve=True`` appends the §19 serving-latency block."""
+    cols = _COLS + (_HEAL_COLS if heal else ()) + \
+        (_SERVE_COLS if serve else ())
     lines = [
         f"rlo-top — fleet view from rank {snap['from_rank']} "
         f"({snap['present']}/{snap['world_size']} ranks reporting)",
@@ -254,6 +267,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     ap.add_argument("--fabric", action="store_true",
                     help="drive a StubBackend serving fabric on top "
                          "(page occupancy rides the digests)")
+    ap.add_argument("--serve", action="store_true",
+                    help="append the serving-latency block (in-flight "
+                         "+ p50/p99 TTFT/e2e from the digest extras); "
+                         "implies --fabric")
     ap.add_argument("--watch", type=int, default=0, metavar="N",
                     help="render N live frames while driving instead "
                          "of one converged snapshot")
@@ -264,6 +281,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         print("rlo-top: error: need --ranks >= 2 and --from-rank in "
               "range", file=sys.stderr)
         return 2
+    if args.serve:
+        args.fabric = True  # the latency gauges ride the fabric
 
     import logging
     logging.getLogger("rlo_tpu").setLevel(logging.ERROR)
@@ -285,7 +304,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             else:
                 print(f"\n== frame {frame} (vtime "
                       f"{fleet.world.now:.1f}) ==")
-                print(render(snap, heal=args.fabric))
+                print(render(snap, heal=args.fabric,
+                             serve=args.serve))
         fleet.cleanup()
         return 0
 
@@ -303,7 +323,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 fleet.fabrics)["counters"]
         print(json.dumps(out))
     else:
-        print(render(snap, heal=args.fabric))
+        print(render(snap, heal=args.fabric, serve=args.serve))
         if problems:
             print("\nSELF-CHECK FAILED:", file=sys.stderr)
             for p in problems:

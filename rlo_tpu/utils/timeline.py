@@ -53,6 +53,10 @@ FLOW_TAGS = {0: "bcast", 2: "proposal", 4: "decision",
 #: timeline can never drift from the schema; utils.metrics has no
 #: engine/jax dependencies, keeping this module standalone-importable)
 from rlo_tpu.utils.metrics import ENGINE_PHASE_KEYS as PHASE_NAMES
+#: request-span stage names, indexed by the Ev.SPAN ``a`` field —
+#: imported for the same no-drift reason (observe.spans depends only
+#: on utils.tracing + wire, both engine/jax-free)
+from rlo_tpu.observe.spans import STAGE_NAMES as SPAN_STAGE_NAMES
 
 Source = Union[str, Path, Iterable[Dict]]
 
@@ -134,6 +138,27 @@ def merge_timeline(sources: List[Source],
             {"ph": "M", "name": "thread_name", "pid": 0, "tid": r,
              "ts": 0, "args": {"name": f"rank {r}"}})
 
+    # request-span tracks (docs/DESIGN.md §19): every traced rid gets
+    # its own thread under a second "requests" process — stage slices
+    # land there, wire-hop receipt markers stay on the rank tracks
+    span_rids = sorted({(e.get("d", 0), e.get("c", 0)) for e in events
+                        if e.get("kind") == "SPAN"
+                        and e.get("b", 0) >= 0})
+    rid_tid = {rid: i for i, rid in enumerate(span_rids)}
+    if span_rids:
+        trace_events.append(
+            {"ph": "M", "name": "process_name", "pid": 1, "tid": 0,
+             "ts": 0, "args": {"name": "requests"}})
+        for rid, tid in rid_tid.items():
+            label = (f"placement v{rid[1]}" if rid[0] < 0
+                     else f"req {rid[0]}:{rid[1]}")
+            trace_events.append(
+                {"ph": "M", "name": "thread_name", "pid": 1,
+                 "tid": tid, "ts": 0, "args": {"name": label}})
+    # rid -> [(end_ts, start_ts, stage, rank, slice_ts)] for the
+    # per-request flow edges
+    span_chain: Dict = {}
+
     # one X slice per protocol event (instants become short slices so
     # flow events have something to bind to)
     # send-side anchors: (tag, origin, ident) -> {rank: sorted [ts]}
@@ -154,6 +179,31 @@ def merge_timeline(sources: List[Source],
                 "tid": e["rank"], "ts": max(0, ts - dur), "dur": dur,
                 "args": {"usec": e.get("b", 0)},
             })
+            continue
+        if e.get("kind") == "SPAN":
+            stage = e.get("a", -1)
+            name = SPAN_STAGE_NAMES.get(stage, f"stage{stage}")
+            rid = (e.get("d", 0), e.get("c", 0))
+            rid_s = f"{rid[0]}:{rid[1]}"
+            dur = int(e.get("b", 0))
+            if dur < 0:
+                # wire-hop receipt of a span-stamped record: an
+                # instant on the RANK track, not a stage boundary
+                trace_events.append({
+                    "ph": "X", "cat": "span_hop",
+                    "name": f"hop {name}", "pid": 0,
+                    "tid": e["rank"], "ts": ts, "dur": slice_usec,
+                    "args": {"rid": rid_s}})
+                continue
+            slice_ts = max(0, ts - dur)
+            trace_events.append({
+                "ph": "X", "cat": "span", "name": name, "pid": 1,
+                "tid": rid_tid[rid], "ts": slice_ts,
+                "dur": max(dur, slice_usec),
+                "args": {"rid": rid_s, "rank": e["rank"],
+                         "usec": dur}})
+            span_chain.setdefault(rid, []).append(
+                (ts, ts - dur, stage, e["rank"], slice_ts))
             continue
         trace_events.append({
             "ph": "X", "cat": "proto", "name": e["kind"],
@@ -197,6 +247,25 @@ def merge_timeline(sources: List[Source],
                              "name": label, "id": flow_id, "pid": 0,
                              "tid": e["rank"], "ts": recv_ts})
 
+    # per-request causal chain: arrows between consecutive spans of a
+    # rid in the analyzer's (end, start, stage, rank) total order —
+    # the same order rlo-trace walks, so the rendered chain IS the
+    # attribution chain
+    for rid, chain in span_chain.items():
+        chain.sort()
+        tid = rid_tid[rid]
+        label = f"req {rid[0]}:{rid[1]}"
+        for (a_end, *_r1, a_slice), (b_end, _bs, _st, _rk, b_slice) \
+                in zip(chain, chain[1:]):
+            flow_id += 1
+            trace_events.append(
+                {"ph": "s", "cat": "span_flow", "name": label,
+                 "id": flow_id, "pid": 1, "tid": tid, "ts": a_end})
+            trace_events.append(
+                {"ph": "f", "bp": "e", "cat": "span_flow",
+                 "name": label, "id": flow_id, "pid": 1, "tid": tid,
+                 "ts": max(b_slice, a_end)})
+
     trace = {"traceEvents": trace_events, "displayTimeUnit": "ms",
              "otherData": {"generator": "rlo_tpu.utils.timeline",
                            "ranks": ranks, "events": len(events),
@@ -226,28 +295,49 @@ def trace_stats(trace: Dict) -> Dict:
                               "flows_out": 0, "flows_in": 0}
         return e
 
+    #: per-request span totals (--by-request): rid -> stage usec/count
+    requests: Dict[str, Dict] = {}
+
+    def req_ent(rid: str) -> Dict:
+        e = requests.get(rid)
+        if e is None:
+            e = requests[rid] = {"spans": 0, "hops": 0, "stages": {}}
+        return e
+
     for e in trace.get("traceEvents", []):
         ph = e.get("ph")
         tid = e.get("tid", -1)
         if ph == "X":
-            if e.get("cat") == "phase":
+            cat = e.get("cat")
+            if cat == "phase":
                 slot = ent(tid)["phases"].setdefault(
                     e.get("name", "?"), {"count": 0, "usec": 0})
                 slot["count"] += 1
                 slot["usec"] += int(e.get("args", {}).get(
                     "usec", e.get("dur", 0)))
+            elif cat == "span":
+                r = req_ent(e.get("args", {}).get("rid", "?"))
+                r["spans"] += 1
+                name = e.get("name", "?")
+                slot = r["stages"].setdefault(
+                    name, {"count": 0, "usec": 0})
+                slot["count"] += 1
+                slot["usec"] += int(e.get("args", {}).get("usec", 0))
+            elif cat == "span_hop":
+                req_ent(e.get("args", {}).get("rid", "?"))["hops"] += 1
             else:
                 evs = ent(tid)["events"]
                 name = e.get("name", "?")
                 evs[name] = evs.get(name, 0) + 1
-        elif ph == "s":
+        elif ph == "s" and e.get("cat") != "span_flow":
             ent(tid)["flows_out"] += 1
-        elif ph == "f":
+        elif ph == "f" and e.get("cat") != "span_flow":
             ent(tid)["flows_in"] += 1
     return {"ranks": {str(r): ranks[r] for r in sorted(ranks)},
             "events_total": sum(
                 sum(e["events"].values()) for e in ranks.values()),
-            "flow_edges": count_flow_edges(trace)}
+            "flow_edges": count_flow_edges(trace),
+            "requests": {r: requests[r] for r in sorted(requests)}}
 
 
 def render_trace_stats(stats: Dict) -> str:
@@ -268,6 +358,30 @@ def render_trace_stats(stats: Dict) -> str:
             tot = sum(p["count"] for p in e["phases"].values())
             usec = sum(p["usec"] for p in e["phases"].values())
             row += f"   {tot} ({usec} us)"
+        lines.append(row)
+    return "\n".join(lines)
+
+
+def render_request_stats(stats: Dict) -> str:
+    """Text table for the per-request block of :func:`trace_stats`
+    (``stats --by-request``): one row per traced rid with its span /
+    hop counts and per-stage usec totals — the incident-bundle triage
+    view for a tripped latency SLO (docs/DESIGN.md §19)."""
+    reqs = stats.get("requests", {})
+    lines = [f"timeline stats --by-request — {len(reqs)} traced "
+             f"requests"]
+    if not reqs:
+        return lines[0]
+    stages = sorted({s for r in reqs.values() for s in r["stages"]})
+    hdr = f"{'rid':>12} {'spans':>6} {'hops':>5} " + \
+        " ".join(f"{s:>14}" for s in stages)
+    lines.append(hdr)
+    lines.append("-" * len(hdr))
+    for rid, r in reqs.items():
+        row = f"{rid:>12} {r['spans']:>6} {r['hops']:>5} "
+        row += " ".join(
+            f"{r['stages'][s]['usec']:>14}" if s in r["stages"]
+            else f"{'-':>14}" for s in stages)
         lines.append(row)
     return "\n".join(lines)
 
@@ -395,12 +509,18 @@ def main(argv: Optional[List[str]] = None) -> int:
                                   "merge subcommand's --out, or an "
                                   "incident bundle's trace.json)")
     st.add_argument("--json", action="store_true")
+    st.add_argument("--by-request", action="store_true",
+                    help="per-rid span/stage totals instead of the "
+                         "per-rank table (traced runs, docs/DESIGN.md "
+                         "§19)")
     args = ap.parse_args(argv)
     if args.cmd == "stats":
         with open(args.trace) as f:
             stats = trace_stats(json.load(f))
         if args.json:
             print(json.dumps(stats))
+        elif args.by_request:
+            print(render_request_stats(stats))
         else:
             print(render_trace_stats(stats))
         return 0

@@ -67,6 +67,15 @@ class Ev(IntEnum):
     #                     b = duration (usec, clamped to int32); the
     #                     timeline merger renders it as a Chrome
     #                     duration slice ENDING at ts_usec
+    SPAN = 15           # request-scoped causal span (docs/DESIGN.md
+    #                     §19): a = stage id (observe.spans.Stage),
+    #                     b = stage duration (usec, clamped to int32;
+    #                     -1 marks a wire-hop receipt of a span-stamped
+    #                     record rather than a stage boundary),
+    #                     c = rid seq, d = rid gateway. Emitted with an
+    #                     explicit engine-clock ts_usec (stage END) so
+    #                     traced fleets replay bit-for-bit in the
+    #                     deterministic simulator
 
 
 @dataclass
@@ -94,14 +103,19 @@ class Tracer:
     dropped: int = 0
 
     def emit(self, rank: int, kind: Ev, a: int = 0, b: int = 0,
-             c: int = 0, d: int = 0) -> None:
+             c: int = 0, d: int = 0,
+             ts_usec: Optional[int] = None) -> None:
+        """``ts_usec`` overrides the wall-clock stamp — span emitters
+        pass the engine's injectable clock so traced runs stay
+        deterministic under the simulator (R5)."""
         if not self.enabled:
             return
         if len(self._events) >= self.capacity:
             self._events.popleft()
             self.dropped += 1
         self._events.append(
-            Event(int(time.time() * 1e6), rank, kind, a, b, c, d))
+            Event(int(time.time() * 1e6) if ts_usec is None else ts_usec,
+                  rank, kind, a, b, c, d))
 
     def events(self, kind: Optional[Ev] = None,
                rank: Optional[int] = None) -> List[Event]:

@@ -225,8 +225,8 @@ def restamp_link(raw: bytes, seq: int, epoch: int) -> bytes:
 #   offset 6   rank   i32le origin rank of the sample
 #   offset 10  epoch  i32le origin's membership epoch at emit time
 #   offset 14  seq    u32le per-origin digest sequence (0, 1, 2, ...)
-#   offset 18  mask   u32le bit i set => TELEM_KEYS[i] delta present
-#   offset 22  deltas       one unsigned LEB128 varint per set mask
+#   offset 18  mask   u64le bit i set => TELEM_KEYS[i] delta present
+#   offset 26  deltas       one unsigned LEB128 varint per set mask
 #                           bit (ascending bit order), zigzag-encoded
 #                           (value - previous emitted value; a FULL
 #                           digest encodes the absolute values, i.e.
@@ -243,28 +243,32 @@ TELEM_MAGIC = b"RLOT\x01"
 
 #: fixed header size before the varint delta section
 # rlo-lint: paired-with rlo_core.h:RLO_TELEM_HEADER_SIZE
-TELEM_HEADER_SIZE = 22
+TELEM_HEADER_SIZE = 26
 
 #: digest keys beyond the engine-counter schema: per-link rollups
 #: (frames both ways, the worst ack-measured RTT EWMA in usec), live
-#: queue depth and pickup backlog, and the serving layer's paged-pool
-#: occupancy (zero on ranks without a paged server — the C engine
-#: always emits 0 here).
+#: queue depth and pickup backlog, the serving layer's paged-pool
+#: occupancy, and the fabric latency block (in-flight requests plus
+#: p50/p99 TTFT and e2e from the fabric's log2-bucket histograms —
+#: docs/DESIGN.md §19). All serving keys are zero on ranks without an
+#: attached fabric — the C engine always emits 0 here.
 # rlo-lint: paired-with rlo_wire.c:k_telem_keys
 TELEM_EXTRA_KEYS = (
     "tx_frames", "rx_frames", "rtt_ewma_max_usec",
     "q_wait", "pickup_backlog", "pages_in_use", "pages_free",
+    "serve_inflight", "ttft_p50_usec", "ttft_p99_usec",
+    "e2e_p50_usec", "e2e_p99_usec",
 )
 
 #: The full digest schema, in mask-bit order: the engine-counter
 #: schema (so every rlo-lint R2-pinned counter rides the digest — the
-#: heal-cost counters included) followed by the extras. Bounded at 32
-#: keys by the u32 mask; rlo-lint R2 pins this tuple against the C
+#: heal-cost counters included) followed by the extras. Bounded at 64
+#: keys by the u64 mask; rlo-lint R2 pins this tuple against the C
 #: codec's key-name table (rlo_wire.c k_telem_keys).
 TELEM_KEYS = ENGINE_COUNTER_KEYS + TELEM_EXTRA_KEYS
-assert len(TELEM_KEYS) <= 32, "TELEM mask is a u32: at most 32 keys"
+assert len(TELEM_KEYS) <= 64, "TELEM mask is a u64: at most 64 keys"
 
-_TELEM_HDR = struct.Struct("<BiiII")  # flags, rank, epoch, seq, mask
+_TELEM_HDR = struct.Struct("<BiiIQ")  # flags, rank, epoch, seq, mask
 
 
 def _zigzag(n: int) -> int:
@@ -343,3 +347,84 @@ def decode_telem(raw: bytes) -> Tuple[int, int, int, bool,
                 break
         deltas[key] = _unzigzag(u)
     return rank, epoch, seq, bool(flags & 1), deltas
+
+
+# ---------------------------------------------------------------------------
+# Span context codec (docs/DESIGN.md §19). One span context = the
+# compact causal stamp a traced request carries in-band: appended as a
+# TRAILER to existing fabric record payloads (ADMIT / DONE / PLACE —
+# never a new record kind, never a header change), so every rank the
+# record reaches can emit a stage-boundary span into the PR-2 tracer
+# rings without any side channel. The byte layout is PINNED so the C
+# engine can recognise and decode the trailer on its wire-hop path
+# (rlo_wire.c rlo_span_encode / rlo_span_decode; parity asserted by
+# tests/test_spans.py):
+#
+#   offset 0   magic   "RLOS\x01"                     (5 bytes)
+#   offset 5   flags   u8    bit0 = sampled (emit spans for this rid)
+#   offset 6   stage   u8    observe.spans.Stage of the record boundary
+#   offset 7   gateway i32le rid gateway rank (-1 on fleet-level spans,
+#                            e.g. placement rounds keyed by version)
+#   offset 11  seq     i32le rid sequence (low 31 bits — the trailer
+#                            identifies, the full-width rid lives in
+#                            the record body)
+#   offset 15  t_usec  u64le stage START on the ORIGIN's engine clock
+#
+# Discrimination is structural: every fabric record body is its fixed
+# header plus a whole number of i32 words, so (len - base) % 4 == 0 on
+# a clean record and == SPAN_CTX_SIZE % 4 == 3 with a trailer — the
+# magic check then confirms. Records without a trailer are
+# byte-identical to the pre-span wire format (the zero-overhead
+# contract the bench gates pin).
+# ---------------------------------------------------------------------------
+
+#: span-context trailer magic
+# rlo-lint: paired-with rlo_core.h:RLO_SPAN_MAGIC
+SPAN_MAGIC = b"RLOS\x01"
+
+#: fixed trailer size; % 4 == 3 is what makes the trailer structurally
+#: unambiguous against i32-word record payloads
+# rlo-lint: paired-with rlo_core.h:RLO_SPAN_CTX_SIZE
+SPAN_CTX_SIZE = 23
+
+_SPAN_CTX = struct.Struct("<BBiiQ")  # flags, stage, gateway, seq, t_usec
+
+#: flags bit0 — this rid was selected by the deterministic sampler
+SPAN_F_SAMPLED = 1
+
+
+def encode_span_ctx(gateway: int, seq: int, stage: int, t_usec: int,
+                    flags: int = SPAN_F_SAMPLED) -> bytes:
+    """Encode one span-context trailer (SPAN_CTX_SIZE bytes)."""
+    return SPAN_MAGIC + _SPAN_CTX.pack(
+        flags & 0xFF, stage & 0xFF, gateway, seq & 0x7FFFFFFF,
+        t_usec & 0xFFFFFFFFFFFFFFFF)
+
+
+def decode_span_ctx(raw: bytes, off: int = 0) \
+        -> Optional[Tuple[int, int, int, int, int]]:
+    """Decode a span context at ``raw[off:]``: ``(flags, stage,
+    gateway, seq, t_usec)``, or None when the bytes there are not a
+    span context (wrong magic / too short) — absence is the common
+    case, not an error."""
+    if len(raw) - off < SPAN_CTX_SIZE or \
+            raw[off:off + len(SPAN_MAGIC)] != SPAN_MAGIC:
+        return None
+    flags, stage, gateway, seq, t_usec = _SPAN_CTX.unpack_from(
+        raw, off + len(SPAN_MAGIC))
+    return flags, stage, gateway, seq, t_usec
+
+
+def split_span_ctx(body: bytes, base: int) \
+        -> Tuple[int, Optional[Tuple[int, int, int, int, int]]]:
+    """Split a fabric record body into ``(payload_end, ctx)`` where
+    ``base`` is the record kind's fixed-header size and the payload
+    after it is a whole number of i32 words. Returns ``(len(body),
+    None)`` for clean records — one modulo and one compare on the hot
+    path, nothing else."""
+    if len(body) >= base + SPAN_CTX_SIZE and \
+            (len(body) - base) % 4 == SPAN_CTX_SIZE % 4:
+        ctx = decode_span_ctx(body, len(body) - SPAN_CTX_SIZE)
+        if ctx is not None:
+            return len(body) - SPAN_CTX_SIZE, ctx
+    return len(body), None

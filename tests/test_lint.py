@@ -106,6 +106,36 @@ def test_r1_fires_on_fanout_drift(tree):
                for f in hits), hits
 
 
+def test_r1_fires_on_span_ctx_size_drift(tree):
+    """The span trailer's fixed size is pinned twice: against
+    RLO_SPAN_CTX_SIZE and against the actual struct layout — and 24
+    additionally breaks the %4==3 structural discriminator."""
+    line = mutate(tree, "rlo_tpu/wire.py",
+                  "SPAN_CTX_SIZE = 23", "SPAN_CTX_SIZE = 24")
+    hits = findings_for(tree, "R1")
+    assert any(f.file == "rlo_tpu/wire.py" and f.line == line and
+               "SPAN_CTX_SIZE" in f.msg for f in hits), hits
+    assert any("% 4" in f.msg for f in hits), hits
+
+
+def test_r1_fires_on_span_magic_drift(tree):
+    line = mutate(tree, "rlo_tpu/wire.py",
+                  'SPAN_MAGIC = b"RLOS', 'SPAN_MAGIC = b"RLOX')
+    hits = findings_for(tree, "R1")
+    assert any(f.file == "rlo_tpu/wire.py" and f.line == line and
+               "RLO_SPAN_MAGIC" in f.msg for f in hits), hits
+
+
+def test_r1_fires_on_span_event_id_drift(tree):
+    """Ev <-> rlo_ev value parity: renumbering Ev.SPAN without the C
+    tracer is a finding (the merged timeline would mislabel)."""
+    mutate(tree, "rlo_tpu/utils/tracing.py",
+           "SPAN = 15", "SPAN = 99")
+    hits = findings_for(tree, "R1")
+    assert any("Ev.SPAN" in f.msg and "RLO_EV_SPAN" in f.msg
+               for f in hits), hits
+
+
 def test_r2_fires_on_counter_key_drift(tree):
     mutate(tree, "rlo_tpu/utils/metrics.py",
            '"epoch", "epoch_quarantined", "rejoins",',
@@ -181,7 +211,7 @@ def test_r2_fires_on_telem_header_drift(tree):
     Python-side bump without the C twin is a finding at the
     assignment line."""
     line = mutate(tree, "rlo_tpu/wire.py",
-                  "TELEM_HEADER_SIZE = 22", "TELEM_HEADER_SIZE = 23")
+                  "TELEM_HEADER_SIZE = 26", "TELEM_HEADER_SIZE = 27")
     hits = findings_for(tree, "R2")
     assert any(f.file == "rlo_tpu/wire.py" and f.line == line and
                "TELEM_HEADER_SIZE" in f.msg for f in hits), hits

@@ -17,8 +17,8 @@ from rlo_tpu.observe import (DEFAULT_RULES, FleetView, Rule,
 from rlo_tpu.transport.loopback import LoopbackWorld
 from rlo_tpu.transport.sim import Scenario, SimViolation, SimWorld
 from rlo_tpu.utils.metrics import ENGINE_COUNTER_KEYS
-from rlo_tpu.wire import (TELEM_KEYS, Frame, Tag, decode_telem,
-                          encode_telem)
+from rlo_tpu.wire import (TELEM_HEADER_SIZE, TELEM_KEYS, Frame, Tag,
+                          decode_telem, encode_telem)
 
 
 # ---------------------------------------------------------------------------
@@ -66,14 +66,14 @@ class TestTelemCodec:
             decode_telem(good[:10])               # truncated header
         with pytest.raises(ValueError):
             decode_telem(good[:-1])               # truncated varints
-        if len(TELEM_KEYS) < 32:
+        if len(TELEM_KEYS) < 64:
             bad = bytearray(good)
-            bad[18 + 3] |= 0x80                   # mask bit 31
+            bad[18 + 7] |= 0x80                   # mask bit 63
             with pytest.raises(ValueError):
                 decode_telem(bytes(bad))
         # overlong varint (> 64 payload bits): malformed in BOTH
         # codecs, never a Python bigint the C side would reject
-        overlong = good[:22] + b"\x80" * 10 + b"\x00"
+        overlong = good[:TELEM_HEADER_SIZE] + b"\x80" * 10 + b"\x00"
         with pytest.raises(ValueError):
             decode_telem(overlong)
         with pytest.raises(ValueError):
@@ -82,7 +82,7 @@ class TestTelemCodec:
     def test_schema_embeds_counter_keys(self):
         assert TELEM_KEYS[:len(ENGINE_COUNTER_KEYS)] == \
             ENGINE_COUNTER_KEYS
-        assert len(TELEM_KEYS) <= 32
+        assert len(TELEM_KEYS) <= 64
 
     def test_native_engine_originates_digests(self):
         """The C engine's digests decode into its own metrics() —
