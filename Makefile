@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: sentinel lint prover static native test check
+.PHONY: sentinel lint prover model static native test check
 
 # CFG/dataflow analyzer for the dual engines (docs/DESIGN.md §15):
 # GIL-release safety, wire-input taint, error-path leaks, state-machine
@@ -22,8 +22,17 @@ lint:
 prover:
 	$(PY) -m rlo_tpu.tools.rlo_prover
 
-# all three analyzers, the full static gate
-static: lint sentinel prover
+# exhaustive explicit-state model checker for the membership/healing/
+# IAR protocol + cross-engine automaton extraction (docs/DESIGN.md
+# §20): invariants M1-M5 over every interleaving of the small
+# configurations, A1 engine parity, A2 extracted<->explored coverage.
+model:
+	$(PY) -m rlo_tpu.tools.rlo_model
+
+# all four analyzers in one process: one merged findings document
+# (--json for CI tooling) with per-tool wall timing
+static:
+	$(PY) -m rlo_tpu.tools.runner
 
 native:
 	$(MAKE) -C rlo_tpu/native

@@ -15,35 +15,38 @@
 set -e
 cd "$(dirname "$0")"
 
-echo "== rlo-lint (static cross-engine conformance) =="
-# wire/metrics/ctypes/dispatch/determinism parity between the Python
-# and C engines, checked without importing or compiling anything —
-# docs/DESIGN.md §9. Also runs inside tier-1 (tests/test_lint.py).
-# Findings print as file:line: diagnostics; --json for CI tooling.
-python -m rlo_tpu.tools.rlo_lint
+echo "== rlo-model (exhaustive protocol model checking + automaton parity) =="
+# explicit-state exploration of EVERY interleaving of the small
+# membership/healing/IAR configurations (n=3: one-kill-one-rejoin,
+# healed split-brain, crossed stale syncs) against invariants M1-M5,
+# plus the cross-engine membership automaton extracted from BOTH
+# engine.py and rlo_engine.c (A1 parity, A2 extracted<->explored
+# coverage) and the sim-backed mode driving the REAL engines through
+# transport.sim — docs/DESIGN.md §20. Also in tier-1
+# (tests/test_model.py). The timeout IS the wall budget: exhaustive
+# at this scale or not at all.
+timeout 10 python -m rlo_tpu.tools.rlo_model
 
-echo "== rlo-sentinel (CFG/dataflow: GIL safety, taint, leaks, absorption) =="
-# flow-sensitive pass over per-function C CFGs + the Python AST:
-# S1 GIL-release safety (no process-global writes reachable from the
-# batched entry points), S2 wire-input taint with dominating-guard
-# checks, S3 error-path resource leaks against the owns/transfers
-# ownership anchors, S4 proposal state-machine absorption proved
-# identical across both engines, S0 stale-anchor audit over BOTH
-# tools' anchor namespaces — docs/DESIGN.md §15. Also in tier-1
-# (tests/test_sentinel.py). The timeout IS the wall budget: the
-# analyzer must stay fast enough to run on every tree, every time.
-timeout 10 python -m rlo_tpu.tools.rlo_sentinel
-
-echo "== rlo-prover (symbolic schedules + device-layer geometry) =="
-# P1 permutation validity + P2 delivery/reduction token algebra for
-# every committed ppermute schedule (n <= 64, every bcast origin),
-# P3 Pallas BlockSpec/index_map geometry under committed shape
-# bindings (hostile scalar-prefetch values included), P4 shard_map
-# axis discipline, P5 128-lane page-contract constant pins —
-# docs/DESIGN.md §16. Also in tier-1 (tests/test_prover.py).
-# Findings print as file:line: diagnostics; --json for CI tooling.
-# The timeout IS the wall budget for the full n <= 64 sweep.
-timeout 10 python -m rlo_tpu.tools.rlo_prover
+echo "== static analyzers (merged rlo-lint+sentinel+prover+model report) =="
+# all four analyzers in one process via runner.run_static: cross-engine
+# conformance (docs/DESIGN.md §9), CFG/dataflow safety (§15), symbolic
+# schedule/geometry proofs (§16), and the protocol model checker (§20)
+# — one merged --json findings document, consumed here with a per-tool
+# timing line (the timing prints on stderr; the document must parse
+# and be finding-free). Each analyzer also runs inside tier-1
+# (tests/test_{lint,sentinel,prover,model}.py).
+static_json=$(mktemp -t rlo_static.XXXXXX)
+timeout 60 python -m rlo_tpu.tools.runner --json > "$static_json"
+python - "$static_json" <<'EOF'
+import json, sys
+doc = json.load(open(sys.argv[1]))
+tools = {t["tool"]: t for t in doc["tools"]}
+assert set(tools) == {"rlo-lint", "rlo-sentinel", "rlo-prover",
+                      "rlo-model"}, sorted(tools)
+assert doc["findings"] == [], doc["findings"]
+print(" ".join(f"{n}={t['seconds']:.2f}s" for n, t in tools.items()))
+EOF
+rm -f "$static_json"
 
 echo "== pytest =="
 python -m pytest tests/ -q

@@ -517,6 +517,7 @@ class ProgressEngine:
         self.reflood_skipped = 0
         self.batched_admits = 0
         self._epoch_floor: dict = {}    # sender -> min accepted epoch
+        # rlo-model: edge restart->joiner
         self._awaiting_welcome = incarnation > 0
         self._join_last_probe = float("-inf")
         self._admitted: dict = {}       # joiner -> admitted incarnation
@@ -2028,6 +2029,7 @@ class ProgressEngine:
             if not self.suspected_self:
                 self.suspected_self = True
                 self._bc_forward(msg)
+                # rlo-model: edge failure->joiner
                 self._become_joiner()
             return
         if 0 <= declared < self._admit_epoch.get(rank, 0):
@@ -2318,6 +2320,7 @@ class ProgressEngine:
             self._bcast_seq = base
         if self._gen_next <= base:
             self._gen_next = base + 1
+        # rlo-model: edge restart->joiner
         self._become_joiner()
         self._join_last_probe = float("-inf")
         self.manager.progress_all()
@@ -2456,6 +2459,7 @@ class ProgressEngine:
                     # rejoin that used to strand every laggard (§18)
                     self._request_sync(src)
                     return
+                # rlo-model: edge join->joiner
                 self._become_joiner()
                 return
             if inc < self._admitted.get(src, -1):
@@ -2467,6 +2471,7 @@ class ProgressEngine:
             if member:
                 self._request_sync(src)
                 return
+            # rlo-model: edge join->joiner
             self._become_joiner()
         elif petition:
             admitted_inc = self._admitted.get(src, -1)
@@ -2666,6 +2671,7 @@ class ProgressEngine:
             # desynced ARQ window) — the exact mirror of the members'
             # _admit_epoch idempotence rule.
             return
+        # rlo-model: edge welcome->member
         self._adopt_view(new_epoch, members, inc, msg.src)
 
     def _adopt_view(self, new_epoch: int, members, inc: int,
@@ -2861,6 +2867,7 @@ class ProgressEngine:
             # the responder's view does not hold me at all: if it
             # wins, only a full rejoin gets me back in
             if rsp_epoch > self.epoch:
+                # rlo-model: edge msync->joiner
                 self._become_joiner()
             return
         aep, ainc = mine
@@ -2870,6 +2877,7 @@ class ProgressEngine:
             # life was admitted at aep but no welcome ever landed —
             # adopt the view wholesale with the welcome's exact
             # semantics (un-wedges _awaiting_welcome, satellite a)
+            # rlo-model: edge msync->member
             self._adopt_view(aep, [m for m, _a, _i in recs],
                              self.incarnation, src)
             self.epoch = max(self.epoch, rsp_epoch)
@@ -2908,6 +2916,7 @@ class ProgressEngine:
             # progress fallback: nothing in the response re-certified
             # the responder's link, so the two views cannot converge
             # by sync alone — full rejoin (status quo ante)
+            # rlo-model: edge msync->joiner
             self._become_joiner()
             return
         if adopted:

@@ -2398,6 +2398,7 @@ static void on_failure(rlo_engine *e, rlo_msg *m)
             set_err(e, rc0);
             msg_free(m);
         }
+        /* rlo-model: edge failure->joiner */
         become_joiner(e);
         return;
     }
@@ -3047,6 +3048,7 @@ static void on_join(rlo_engine *e, rlo_msg *m)
                 request_sync(e, src);
                 return;
             }
+            /* rlo-model: edge join->joiner */
             become_joiner(e);
             return;
         }
@@ -3063,6 +3065,7 @@ static void on_join(rlo_engine *e, rlo_msg *m)
             request_sync(e, src);
             return;
         }
+        /* rlo-model: edge join->joiner */
         become_joiner(e);
     } else if (petition) {
         if (inc < e->admitted_inc[src])
@@ -3224,6 +3227,7 @@ static void on_welcome(rlo_engine *e, rlo_msg *m)
         if (r >= 0 && r < e->ws)
             mem[r] = 1;
     }
+    /* rlo-model: edge welcome->member */
     adopt_view(e, new_epoch, mem, inc, m->src);
     free(mem);
 }
@@ -3411,6 +3415,7 @@ static void msync_adopt(rlo_engine *e, int src, const uint8_t *p,
         /* the responder's view does not hold me at all: if it wins,
          * only a full rejoin gets me back in */
         if (rsp_epoch > e->epoch)
+            /* rlo-model: edge msync->joiner */
             become_joiner(e);
         return;
     }
@@ -3429,6 +3434,7 @@ static void msync_adopt(rlo_engine *e, int src, const uint8_t *p,
             if (r >= 0 && r < e->ws)
                 mem[r] = 1;
         }
+        /* rlo-model: edge msync->member */
         adopt_view(e, my_aep, mem, e->incarnation, src);
         free(mem);
         if (rsp_epoch > e->epoch)
@@ -3482,6 +3488,7 @@ static void msync_adopt(rlo_engine *e, int src, const uint8_t *p,
         /* progress fallback: nothing in the response re-certified
          * the responder's link, so the two views cannot converge by
          * sync alone — full rejoin (status quo ante) */
+        /* rlo-model: edge msync->joiner */
         become_joiner(e);
         return;
     }
@@ -3640,6 +3647,7 @@ int rlo_engine_set_incarnation(rlo_engine *e, int incarnation)
     if (e->gen_counter < base)
         e->gen_counter = base;
     if (incarnation > 0)
+        /* rlo-model: edge restart->joiner */
         become_joiner(e);
     return RLO_OK;
 }

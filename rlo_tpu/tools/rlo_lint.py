@@ -1164,6 +1164,64 @@ def rule_r4(ctx: "LintContext") -> List[Finding]:
                     f"{c_name} is default-routed but the tag switch "
                     f"has no default label"))
 
+    # --- MSYNC sub-kind dispatch (the PR-16 epoch catch-up plane) ---
+    # The kind byte is routed by an open if/elif chain in BOTH engines
+    # (no catch-all: an unknown kind is ignored on the wire by
+    # design), so a sub-kind that loses its arm goes silent, not
+    # loud.  Every MSYNC_* constant must be explicitly compared in the
+    # dispatcher, and the two engines must agree on the sub-kind set.
+    py_kinds: Dict[str, int] = {}
+    for n in engine.tree.body:
+        if isinstance(n, ast.Assign) and len(n.targets) == 1 and \
+                isinstance(n.targets[0], ast.Name) and \
+                n.targets[0].id.startswith("MSYNC_"):
+            py_kinds[n.targets[0].id] = n.lineno
+    mdisp = _find_funcdef(engine.tree, "_on_msync")
+    if py_kinds and mdisp is None:
+        f.append(Finding("R4", engine.path, 1,
+                         "_on_msync (the MSYNC sub-kind dispatch) "
+                         "not found"))
+    elif py_kinds:
+        py_hit = {
+            cmp_.comparators[0].id
+            for cmp_ in ast.walk(mdisp)
+            if isinstance(cmp_, ast.Compare) and len(cmp_.ops) == 1 and
+            isinstance(cmp_.ops[0], ast.Eq) and
+            isinstance(cmp_.comparators[0], ast.Name)}
+        for name, line in sorted(py_kinds.items(),
+                                 key=lambda kv: kv[1]):
+            if name not in py_hit:
+                f.append(Finding(
+                    "R4", engine.path, line,
+                    f"MSYNC sub-kind {name} has no arm in "
+                    f"ProgressEngine._on_msync"))
+    c_kinds = {
+        m.group(1): _line_of(ctx.engine_c_stripped, m.start())
+        for m in re.finditer(r"#define\s+RLO_(MSYNC_\w+)\s+\d",
+                             ctx.engine_c_stripped)}
+    mbody = _extract_c_function(ctx.engine_c_stripped, "on_msync")
+    if c_kinds and mbody is None:
+        f.append(Finding("R4", ENGINE_C, 1,
+                         "on_msync (the MSYNC sub-kind dispatch) "
+                         "not found"))
+    elif c_kinds:
+        mtext, _ = mbody
+        c_hit = {m.group(1) for m in re.finditer(
+            r"kind\s*==\s*RLO_(MSYNC_\w+)", mtext)}
+        for name, line in sorted(c_kinds.items(),
+                                 key=lambda kv: kv[1]):
+            if name not in c_hit:
+                f.append(Finding(
+                    "R4", ENGINE_C, line,
+                    f"MSYNC sub-kind RLO_{name} has no arm in "
+                    f"on_msync"))
+    for name in sorted(set(py_kinds) ^ set(c_kinds)):
+        f.append(Finding(
+            "R4", engine.path, py_kinds.get(name, 1),
+            f"MSYNC sub-kind {name} is defined in only one engine "
+            f"(engine.py has {sorted(py_kinds)}, rlo_engine.c has "
+            f"{sorted(c_kinds)})"))
+
     # --- fabric record dispatch (serving/fabric.py, when present) ---
     # New Tag values the fabric rides on are covered by the Tag loop
     # above (SERVE is default-routed in both engines); the fabric's

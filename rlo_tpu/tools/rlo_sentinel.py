@@ -110,6 +110,10 @@ RELEASE_FNS = {"free", "rlo_pool_free", "rlo_blob_unref",
 #: C taint sources: functions whose return value derives from wire
 #: bytes (S2)
 C_TAINT_FNS = {"get_le32", "get_i32", "get_u64", "vote_gen"}
+#: decoders whose &out-params are filled from wire bytes: every
+#: &-taken argument at a call site becomes tainted (rlo_span_decode is
+#: the PR-17 span trailer — gateway/seq/stage/flags all attacker-set)
+C_DECODE_FNS = {"rlo_frame_decode", "rlo_span_decode"}
 #: receive-record struct bases: any ``<base>.field`` / ``<base>->field``
 #: chain rooted at one of these names is wire input (the transports'
 #: reassembly headers)
@@ -391,7 +395,7 @@ def _taint_keys_c(fn: csrc.CFunc) -> Dict[str, int]:
             if kind != "id":
                 continue
             nxt = toks[k + 1][1] if k + 1 < len(toks) else ""
-            if text == "rlo_frame_decode" and nxt == "(":
+            if text in C_DECODE_FNS and nxt == "(":
                 close = csrc.match_paren(toks, k + 1)
                 # &out-params are tainted; so is an LHS of the call
                 for j in range(k + 2, close):
@@ -444,8 +448,9 @@ def rule_s2_c(ctx: SentinelContext) -> List[Finding]:
             toks = nd.stmt.toks
             if not toks or nd.stmt.kind in ("if",):
                 continue
+            loop_head = nd.stmt.kind in ("for", "while", "do")
             for key, src_line in keys.items():
-                used_at = _sink_uses_c(toks, key)
+                used_at = _sink_uses_c(toks, key, loop_head=loop_head)
                 for sink_line, what in used_at:
                     guards = guard_cache.setdefault(
                         key, _cond_guards(fn, key))
@@ -463,12 +468,25 @@ def rule_s2_c(ctx: SentinelContext) -> List[Finding]:
     return f
 
 
-def _sink_uses_c(toks: Sequence[csrc.Token],
-                 key: str) -> List[Tuple[int, str]]:
-    """Sink uses of ``key`` in one statement: subscripts and
-    size-taking calls."""
+def _sink_uses_c(toks: Sequence[csrc.Token], key: str,
+                 loop_head: bool = False) -> List[Tuple[int, str]]:
+    """Sink uses of ``key`` in one statement: subscripts, size-taking
+    calls, and (for ``loop_head`` statements) loop-bound comparisons —
+    a wire-set count driving a for/while head is unbounded work unless
+    a dominating check clamps it (the MSYNC_RSP member-record count is
+    the canonical case)."""
     out: List[Tuple[int, str]] = []
     n = len(toks)
+    if loop_head and any(t[1] in _RELOP for t in toks) and \
+            any(c == key for c, _, _ in _chains_in(toks)):
+        # a head that (re)initializes the key is binding a fresh
+        # induction variable of the same name, not reading wire input
+        rebinds = any(
+            toks[k][0] == "id" and toks[k][1] == key and
+            k + 1 < n and toks[k + 1][1] == "="
+            for k in range(n))
+        if not rebinds:
+            out.append((toks[0][2], "a loop bound"))
     for k in range(n):
         kind, text, line = toks[k]
         if text == "[":
@@ -534,18 +552,40 @@ def _is_exit_block(body: List[ast.stmt]) -> bool:
         body[-1], (ast.Return, ast.Raise, ast.Continue, ast.Break))
 
 
+def _struct_consts(trees: Sequence[ast.AST]) -> Set[str]:
+    """Module-level ``NAME = struct.Struct(...)`` constants across the
+    scanned modules.  ``NAME.unpack_from(buf, off)`` parses wire bytes
+    exactly like ``struct.unpack_from`` does (the span-trailer codec's
+    ``_SPAN_CTX`` is the canonical case) — union across modules so an
+    imported Struct constant still counts at its use site."""
+    out: Set[str] = set()
+    for tree in trees:
+        for n in getattr(tree, "body", []):
+            if isinstance(n, ast.Assign) and \
+                    isinstance(n.value, ast.Call) and \
+                    isinstance(n.value.func, ast.Attribute) and \
+                    n.value.func.attr == "Struct" and \
+                    _dotted(n.value.func.value) == "struct":
+                for t in n.targets:
+                    if isinstance(t, ast.Name):
+                        out.add(t.id)
+    return out
+
+
 def rule_s2_py(ctx: SentinelContext) -> List[Finding]:
     f: List[Finding] = []
+    structs = _struct_consts([ctx.py[rel] for rel in PY_TAINT_FILES])
     for rel in PY_TAINT_FILES:
         tree = ctx.py[rel]
         for fn in [n for n in ast.walk(tree)
                    if isinstance(n, ast.FunctionDef)]:
-            f.extend(_s2_py_function(ctx, rel, fn))
+            f.extend(_s2_py_function(ctx, rel, fn, structs))
     return f
 
 
 def _s2_py_function(ctx: SentinelContext, rel: str,
-                    fn: ast.FunctionDef) -> List[Finding]:
+                    fn: ast.FunctionDef,
+                    structs: Set[str] = frozenset()) -> List[Finding]:
     out: List[Finding] = []
     # tainted buffers: wire-bytes parameters + any .payload chain
     bufs: Set[str] = {a.arg for a in fn.args.args
@@ -553,7 +593,7 @@ def _s2_py_function(ctx: SentinelContext, rel: str,
     # tainted ints: targets of struct.unpack/unpack_from
     ints: Set[str] = set()
     for n in ast.walk(fn):
-        if isinstance(n, ast.Assign) and _has_unpack(n.value):
+        if isinstance(n, ast.Assign) and _has_unpack(n.value, structs):
             for tgt in n.targets:
                 for t in ([tgt.elts] if isinstance(
                         tgt, (ast.Tuple, ast.List)) else [[tgt]]):
@@ -648,9 +688,26 @@ def _s2_py_function(ctx: SentinelContext, rel: str,
                             f"wire-tainted '{idx}' used as a subscript "
                             f"in '{fn.name}' without a dominating "
                             f"bounds check"))
-            if _is_unpack_call(n):
-                # unpack and unpack_from both carry the buffer at args[1]
-                barg = n.args[1] if len(n.args) > 1 else None
+            if isinstance(n, ast.Call) and \
+                    isinstance(n.func, ast.Name) and \
+                    n.func.id == "range":
+                for a in n.args:
+                    d = _dotted(a)
+                    if d is not None and d in ints and not any(
+                            _mentions_name(g, d) for g in guards):
+                        if not _trusted(ctx, rel, n.lineno):
+                            out.append(Finding(
+                                "S2", rel, n.lineno,
+                                f"wire-tainted '{d}' used as a "
+                                f"range() loop bound in '{fn.name}' "
+                                f"without a dominating bounds check — "
+                                f"a hostile count drives unbounded "
+                                f"work in the receive path"))
+            if _is_unpack_call(n, structs):
+                # module-form struct.unpack(_from) carries the buffer
+                # at args[1]; Struct-instance method form at args[0]
+                bi = 1 if _dotted(n.func.value) == "struct" else 0
+                barg = n.args[bi] if len(n.args) > bi else None
                 b = buf_of(barg) if barg is not None else None
                 if b is not None and not any(
                         _mentions_len_of(g, b) for g in guards):
@@ -667,15 +724,19 @@ def _s2_py_function(ctx: SentinelContext, rel: str,
     return out
 
 
-def _has_unpack(node: ast.AST) -> bool:
-    return any(_is_unpack_call(n) for n in ast.walk(node))
+def _has_unpack(node: ast.AST,
+                structs: Set[str] = frozenset()) -> bool:
+    return any(_is_unpack_call(n, structs) for n in ast.walk(node))
 
 
-def _is_unpack_call(n: ast.AST) -> bool:
-    return (isinstance(n, ast.Call) and
+def _is_unpack_call(n: ast.AST,
+                    structs: Set[str] = frozenset()) -> bool:
+    if not (isinstance(n, ast.Call) and
             isinstance(n.func, ast.Attribute) and
-            n.func.attr in ("unpack", "unpack_from") and
-            _dotted(n.func.value) == "struct")
+            n.func.attr in ("unpack", "unpack_from")):
+        return False
+    base = _dotted(n.func.value)
+    return base == "struct" or base in structs
 
 
 # ---------------------------------------------------------------------------

@@ -1,11 +1,16 @@
-"""runner — shared driver plumbing for rlo-lint, rlo-sentinel and
-rlo-prover.
+"""runner — shared driver plumbing for rlo-lint, rlo-sentinel,
+rlo-prover and rlo-model, plus the merged static report.
 
-All three analyzers produce the same artifact: a sorted list of
+All four analyzers produce the same artifact: a sorted list of
 findings, each anchored at a file:line, printed as compiler-style
 diagnostics (``file:line: RULE message``) or — with ``--json`` — as a
 machine-readable array for CI tooling.  Exit codes are shared too:
 0 clean, 1 findings, 2 bad invocation / unparseable inputs.
+
+``python -m rlo_tpu.tools.runner`` runs all four in one process and
+emits a single merged findings document: per-tool wall timing, per-tool
+finding counts, and every finding stamped with the tool that produced
+it.  ``make static`` and check.sh's merged static step consume it.
 
 This module also owns the **anchor-consumption registry** behind the
 stale-anchor audit (rlo-sentinel S0): every time a rule *uses* a
@@ -125,3 +130,82 @@ def emit(findings: Sequence[Finding], *, prog: str, ran: str,
                   f"{'s' if len(findings) != 1 else ''} ({ran}) in "
                   f"{root}")
     return 1 if findings else 0
+
+
+# ---------------------------------------------------------------------------
+# merged static report (make static / check.sh)
+# ---------------------------------------------------------------------------
+
+#: the full analyzer suite, in dependency-free run order
+STATIC_TOOLS = (
+    ("rlo-lint", "rlo_tpu.tools.rlo_lint", "run_lint"),
+    ("rlo-sentinel", "rlo_tpu.tools.rlo_sentinel", "run_sentinel"),
+    ("rlo-prover", "rlo_tpu.tools.rlo_prover", "run_prover"),
+    ("rlo-model", "rlo_tpu.tools.rlo_model", "run_model"),
+)
+
+
+def run_static(root) -> List[Tuple[str, float, List[Finding]]]:
+    """Run every analyzer against ``root``; returns ``(tool, seconds,
+    findings)`` per tool.  ToolError propagates (exit 2 at the CLI) —
+    an analyzer that cannot parse its inputs is a broken tree, not a
+    clean one."""
+    import importlib
+    import time
+    out: List[Tuple[str, float, List[Finding]]] = []
+    for tool, modname, fname in STATIC_TOOLS:
+        fn = getattr(importlib.import_module(modname), fname)
+        # per-tool wall timing for the merged report, never part of any
+        # seed-deterministic schedule
+        t0 = time.perf_counter()  # rlo-lint: allow-wallclock
+        findings = fn(root)
+        dt = time.perf_counter() - t0  # rlo-lint: allow-wallclock
+        out.append((tool, dt, findings))
+    return out
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    import argparse
+    from pathlib import Path
+    ap = argparse.ArgumentParser(
+        prog="python -m rlo_tpu.tools.runner",
+        description="Merged static report: rlo-lint + rlo-sentinel + "
+                    "rlo-prover + rlo-model in one process, one "
+                    "findings document, per-tool timing.")
+    ap.add_argument("--root", type=Path,
+                    default=Path(__file__).resolve().parents[2],
+                    help="repo root to analyze (default: this checkout)")
+    ap.add_argument("--json", action="store_true",
+                    help="merged machine-readable document on stdout")
+    args = ap.parse_args(argv)
+    try:
+        results = run_static(args.root)
+    except ToolError as e:
+        print(f"rlo-static: error: {e}", file=sys.stderr)
+        return 2
+    merged = [dict(f.to_json(), tool=tool)
+              for tool, _dt, fs in results for f in fs]
+    timing = " ".join(f"{tool}={dt:.2f}s" for tool, dt, _fs in results)
+    if args.json:
+        json.dump({
+            "root": str(args.root),
+            "tools": [{"tool": tool, "seconds": round(dt, 3),
+                       "findings": len(fs)}
+                      for tool, dt, fs in results],
+            "findings": merged,
+        }, sys.stdout, indent=1)
+        sys.stdout.write("\n")
+        print(f"rlo-static: timing {timing}", file=sys.stderr)
+    else:
+        for tool, _dt, fs in results:
+            for f in fs:
+                print(f"{f.file}:{f.line}: [{tool}] {f.rule} {f.msg}")
+        print(f"rlo-static: timing {timing}")
+        print(f"rlo-static: {len(merged)} finding"
+              f"{'s' if len(merged) != 1 else ''} across "
+              f"{len(results)} analyzers in {args.root}")
+    return 1 if merged else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -28,6 +28,7 @@ the partition lands are lost, like a real link going dark).
 from __future__ import annotations
 
 import bisect
+import copy
 import hashlib
 import heapq
 import itertools
@@ -63,6 +64,17 @@ class HeapScheduler:
 
     def pop(self):
         return heapq.heappop(self._heap)
+
+    def items(self) -> List:
+        """Every queued item, sorted by pop order (t, ctr) — the
+        rlo-model explorer's view of the in-flight frame set."""
+        return sorted(self._heap)
+
+    def remove(self, item) -> None:
+        """Delete one specific queued item (rlo-model targeted
+        deliver/drop/dup). O(n) — explorer worlds are tiny."""
+        self._heap.remove(item)
+        heapq.heapify(self._heap)
 
     def __len__(self) -> int:
         return len(self._heap)
@@ -145,6 +157,29 @@ class CalendarScheduler:
                 return slot.pop(0)
             self._slot_no += 1
             self._migrate()
+
+    def items(self) -> List:
+        """Every queued item, sorted by pop order (t, ctr) — same
+        contract as :meth:`HeapScheduler.items`."""
+        out: List = []
+        for slot in self._ring:
+            out.extend(slot)
+        out.extend(self._overflow)
+        return sorted(out)
+
+    def remove(self, item) -> None:
+        """Delete one specific queued item — same contract as
+        :meth:`HeapScheduler.remove`."""
+        for slot in self._ring:
+            if item in slot:
+                slot.remove(item)
+                self._count -= 1
+                return
+        if item in self._overflow:
+            self._overflow.remove(item)
+            heapq.heapify(self._overflow)
+            return
+        raise ValueError("item not queued")
 
     def __len__(self) -> int:
         return self._count + len(self._overflow)
@@ -422,6 +457,104 @@ class SimWorld:
         for chan in [c for c in self._chan_last
                      if c[0] == rank or c[1] == rank]:
             del self._chan_last[chan]
+
+    # -- explicit-state exploration hooks (rlo-model, DESIGN.md §20) -------
+    def snapshot(self, *attached):
+        """Deterministic state snapshot for DFS exploration: ONE
+        deepcopy of this world plus any attached objects (engines,
+        manager, harness bookkeeping) in a single memo, so every
+        cross-reference — engine clocks bound to this world, in-flight
+        ``SendHandle``s shared between the event queue and engine ARQ
+        state, transports — stays internally consistent inside the
+        copy. Returns ``(world_copy, *attached_copies)``; "restore" is
+        simply continuing from the returned bundle (functional style:
+        one snapshot can seed any number of divergent branches, each
+        via its own fresh ``snapshot()`` of the bundle).
+
+        The schedule digest is carried across via ``hashlib``'s own
+        ``copy()`` (sha256 objects reject deepcopy)."""
+        digest = self._digest
+        self._digest = None
+        try:
+            clone = copy.deepcopy((self,) + attached)
+        finally:
+            self._digest = digest
+        clone[0]._digest = None if digest is None else digest.copy()
+        return clone
+
+    def pending_frames(self) -> List:
+        """Scheduled-but-undelivered frames as raw queue items
+        ``(t, ctr, src, dst, tag, payload, handle)`` sorted by pop
+        order. Read-only view; pair with :meth:`force_step`."""
+        return self._q.items()
+
+    def channel_heads(self) -> List:
+        """The earliest pending frame per (src, dst) channel — the
+        set of frames deliverable next without violating per-channel
+        FIFO. This is the rlo-model explorer's branch alphabet: any
+        interleaving of channel heads is a schedule the real network
+        could produce."""
+        heads: Dict[Tuple[int, int], tuple] = {}
+        for it in self._q.items():
+            key = (it[2], it[3])
+            if key not in heads:   # items() is pop-ordered
+                heads[key] = it
+        return [heads[k] for k in sorted(heads)]
+
+    def force_step(self, item, action: str = "deliver") -> None:
+        """Deliver, drop, or duplicate one SPECIFIC pending frame now
+        (it must be a value from :meth:`pending_frames` /
+        :meth:`channel_heads`). The model checker uses this to explore
+        a chosen interleaving instead of the seeded time order; time
+        advances monotonically to the frame's due time exactly as
+        :meth:`step` would. ``drop`` consumes the frame and fails its
+        send handle (a targeted message-loss fault); ``dup`` delivers
+        it AND re-queues a copy (a targeted duplication fault)."""
+        if action not in ("deliver", "drop", "dup"):
+            raise ValueError(f"unknown force_step action {action!r}")
+        self._q.remove(item)
+        t, _, src, dst, tag, data, h = item
+        self.last_dst = None
+        if t > self.now:
+            self.now = t
+        self.events += 1
+        if action == "drop":
+            if self._digest is not None:
+                self._digest.update(struct.pack(
+                    "<diiii", t, src, dst, tag, 0))
+                self._digest.update(data)
+            h.failed = True
+            self.dropped_cnt += 1
+            return
+        if action == "dup":
+            self._q.push((t, next(self._ctr), src, dst, tag, data, h))
+            self.duplicated_cnt += 1
+        h.delivered = True
+        dead = (src in self.dead or dst in self.dead or
+                (self._group is not None and
+                 self._group.get(src, -1 - src) !=
+                 self._group.get(dst, -1 - dst)))
+        if self._digest is not None:
+            self._digest.update(struct.pack(
+                "<diiii", t, src, dst, tag, 0 if dead else 1))
+            self._digest.update(data)
+        if dead:
+            h.failed = True
+            self.dropped_cnt += 1
+            return
+        self.inboxes[dst].append((src, tag, data))
+        self.delivered_cnt += 1
+        self.last_dst = dst
+
+    def advance(self, dt: float) -> None:
+        """Advance virtual time by ``dt`` WITHOUT delivering anything
+        — the explorer's "let timers fire while frames stay in
+        flight" move (heartbeat timeouts, probe cadences). Frames
+        already due keep their timestamps and deliver 'late', exactly
+        like a congested link."""
+        if dt < 0:
+            raise ValueError("dt must be >= 0")
+        self.now += dt
 
 
 # ---------------------------------------------------------------------------

@@ -288,6 +288,22 @@ def test_r4_fires_on_fabric_record_dispatch_hole(tree):
     assert line > 0
 
 
+def test_r4_fires_on_msync_subkind_hole(tree):
+    """The MSYNC kind byte rides an open if/elif chain in both
+    engines; dropping one arm must name the orphaned sub-kind on each
+    side (there is no catch-all to default-route it to)."""
+    mutate(tree, "rlo_tpu/engine.py",
+           "elif kind == MSYNC_AD:", "elif False:")
+    mutate(tree, "rlo_tpu/native/rlo_engine.c",
+           "} else if (kind == RLO_MSYNC_WANT) {",
+           "} else if (0) {")
+    hits = findings_for(tree, "R4")
+    assert any(f.file == "rlo_tpu/engine.py" and "MSYNC_AD" in f.msg
+               for f in hits), hits
+    assert any(f.file == "rlo_tpu/native/rlo_engine.c" and
+               "RLO_MSYNC_WANT" in f.msg for f in hits), hits
+
+
 def test_r5_fires_on_fabric_wallclock_leak(tree):
     """serving/ is in the deterministic-replay scope: a wall-clock
     read in the fabric would break seed-exact fleet replays."""

@@ -1,0 +1,166 @@
+"""rlo-model self-verification (docs/DESIGN.md §20).
+
+Mirror of tests/test_sentinel.py's two-halves pattern:
+
+  1. The clean-tree contract: ``run_model`` on this checkout reports
+     zero findings — every interleaving of the explored configurations
+     satisfies the invariant catalog M1–M5, the two engines induce the
+     same membership automaton (A1), and the extracted automaton and
+     the explored model agree edge-for-edge (A2) — in tier-1, on
+     every run.
+
+  2. Mutation fixtures: each invariant family must FIRE when its
+     protecting construct is deleted from the real engine source (a
+     rule that never fires is indistinguishable from no rule).  Two
+     fixture classes:
+
+     - engine mutations — delete the stale-RSP guard (M5), delete the
+       joiner-liveness grace (M4), un-batch admissions divergently in
+       one engine (A1): the checker re-extracts its semantics from the
+       mutated tree, so weakening the ENGINE weakens the MODEL and the
+       matching invariant trips with a replayable Scenario recipe;
+     - checker-side knobs (--mutate) — model semantics the engines
+       never had (epoch downgrade, skewed admission certificates,
+       dup-delivery without dedup) that pin M1/M2/M3's detection
+       machinery directly.
+"""
+
+from __future__ import annotations
+
+import json
+import shutil
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from rlo_tpu.tools.rlo_model import run_model
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+
+_IGNORE = shutil.ignore_patterns(
+    "__pycache__", ".pytest_cache", "*.so", "*.o", "*.pyc",
+    "rlo_selftest*", "rlo_demo", "rlo_demo_mpi", "rlo_demo_tsan",
+    "rlo_demo_asan", "femtompirun")
+
+
+@pytest.fixture()
+def tree(tmp_path):
+    """An analyzable copy of the source tree (sources only, no build
+    artifacts) that fixtures may mutate freely.  run_model's sim mode
+    auto-skips on copies (it needs this very checkout), so fixture
+    runs are pure abstract-model explorations."""
+    shutil.copytree(REPO_ROOT / "rlo_tpu", tmp_path / "rlo_tpu",
+                    ignore=_IGNORE)
+    return tmp_path
+
+
+def mutate(root: Path, rel: str, old: str, new: str) -> int:
+    """Replace ``old`` (must occur exactly once) with ``new``; returns
+    the 1-indexed line of the edit."""
+    path = root / rel
+    text = path.read_text()
+    assert text.count(old) == 1, \
+        f"fixture drift: {old!r} occurs {text.count(old)}x in {rel}"
+    line = text[:text.index(old)].count("\n") + 1
+    path.write_text(text.replace(old, new))
+    return line
+
+
+def _only(findings, rule):
+    return [f for f in findings if f.rule == rule]
+
+
+# ---------------------------------------------------------------------------
+# clean tree
+# ---------------------------------------------------------------------------
+
+def test_clean_tree_has_no_findings():
+    """Exhaustive exploration of every configuration, the cross-engine
+    automaton parity check, the coverage audit, and the sim-backed
+    mode all pass on this checkout."""
+    assert run_model(REPO_ROOT) == []
+
+
+def test_cli_clean_json_and_exit_zero():
+    p = subprocess.run(
+        [sys.executable, "-m", "rlo_tpu.tools.rlo_model", "--json",
+         "--root", str(REPO_ROOT), "--no-sim"],
+        capture_output=True, text=True, cwd=REPO_ROOT)
+    assert p.returncode == 0, p.stderr
+    assert json.loads(p.stdout) == []
+
+
+# ---------------------------------------------------------------------------
+# engine-mutation fixtures (extraction-parameterized semantics)
+# ---------------------------------------------------------------------------
+
+def test_m5_fires_when_stale_rsp_guard_deleted(tree):
+    """Deleting the stale-MSYNC_RSP guard re-opens the last-member
+    self-demotion hole: a crossed stale response demotes the fleet's
+    only member to joiner, leaving no admitter."""
+    mutate(tree, "rlo_tpu/engine.py",
+           "            if stale:", "            if stale and False:")
+    hits = _only(run_model(tree, configs=["sync-crossfire"]), "M5")
+    assert hits, "M5 did not fire on the guard-less tree"
+    assert all("replay: Scenario(" in f.msg for f in hits)
+    assert any("sync-crossfire" in f.msg for f in hits)
+
+
+def test_m4_fires_when_joiner_grace_deleted(tree):
+    """Deleting the joiner-liveness grace makes a freshly-admitted
+    member immediately suspectable: the kill-rejoin configuration
+    reaches a closed revocation/readmission livelock with no
+    fault-free escape to a converged view."""
+    mutate(tree, "rlo_tpu/engine.py",
+           "        self._hb_seen[joiner] = self.clock() + max(\n"
+           "            2 * (self.failure_timeout or 0.0), "
+           "10 * self.join_interval)",
+           "        self._hb_seen[joiner] = self.clock()")
+    hits = _only(run_model(tree, rules=["M4"], configs=["kill-rejoin"],
+                           max_states=40_000), "M4")
+    assert hits, "M4 did not fire on the grace-less tree"
+    assert all("replay: Scenario(" in f.msg for f in hits)
+
+
+def test_a1_fires_on_divergently_unbatched_admissions(tree):
+    """Un-batching admissions in ONE engine only (the Python WELCOME
+    pack always claims a single record) splits the two engines'
+    extracted admission semantics: automaton parity must fail."""
+    mutate(tree, "rlo_tpu/engine.py",
+           '"<ii", new_epoch, len(batch))', '"<ii", new_epoch, 1)')
+    hits = _only(run_model(tree, rules=["A1"]), "A1")
+    assert hits, "A1 did not fire on the divergent tree"
+
+
+# ---------------------------------------------------------------------------
+# checker-side knob fixtures (detection machinery)
+# ---------------------------------------------------------------------------
+
+def test_m1_fires_with_sync_downgrade_knob(tree):
+    """Replacing the engines' max-merge epoch adoption with a bare
+    assignment (what the code would do WITHOUT `max`) lets a crossed
+    stale response drag an epoch backwards: M1 trips."""
+    hits = _only(run_model(tree, mutate=("m1-sync-downgrade",),
+                           configs=["sync-crossfire"]), "M1")
+    assert hits, "M1 did not fire under m1-sync-downgrade"
+    assert all("replay: Scenario(" in f.msg for f in hits)
+
+
+def test_m2_fires_with_skewed_decision_knob(tree):
+    """Skewing one admitter's certificate stream models divergent
+    admission execution: co-viewed members disagree on a (member,
+    epoch) certificate and M2 trips."""
+    hits = _only(run_model(tree, mutate=("m2-skewed-decision",),
+                           configs=["kill-rejoin"]), "M2")
+    assert hits, "M2 did not fire under m2-skewed-decision"
+
+
+def test_m3_fires_with_no_dedup_knob(tree):
+    """Disabling the per-incarnation pickup dedup lets a duplicated
+    DECIDE deliver the same proposal twice: M3 trips."""
+    hits = _only(run_model(tree, mutate=("m3-no-dedup",),
+                           configs=["kill-rejoin"]), "M3")
+    assert hits, "M3 did not fire under m3-no-dedup"
+    assert all("replay: Scenario(" in f.msg for f in hits)

@@ -349,18 +349,45 @@ def test_oracle_pow2_symbolic_models(n):
         assert np.allclose(got[r], float((1 << n) - 1))
 
 
-def _shard_map_available():
+def _shard_map_gate():
+    """None when the lowered-program oracle can run, else the skip
+    reason — which must be PROVABLY version-caused.  ``jax.shard_map``
+    is a top-level API from jax 0.6 (mesh.shard_jit also needs its
+    check_vma typing); on an older pin the skip is legitimate.  On a
+    0.6+ jax where the symbol is nonetheless missing something else
+    broke, and a silent skip would let the oracle rot invisibly — so
+    that case asserts instead of skipping."""
     import jax
-    return hasattr(jax, "shard_map")
+    if hasattr(jax, "shard_map"):
+        return None
+    ver = tuple(int(p) for p in jax.__version__.split(".")[:2])
+    assert ver < (0, 6), (
+        f"jax {jax.__version__} should expose jax.shard_map but does "
+        f"not — the oracle's version gate has rotted; investigate "
+        f"instead of skipping")
+    return (f"version gate: jax {jax.__version__} < 0.6 has no "
+            f"top-level jax.shard_map")
+
+
+def test_oracle_skip_is_version_caused():
+    """The oracle may only ever be skipped BY THE VERSION GATE: when
+    the gate returns a reason it names the pinned jax version, and
+    when it returns None the oracle genuinely has jax.shard_map."""
+    import jax
+    reason = _shard_map_gate()
+    if reason is None:
+        assert hasattr(jax, "shard_map")
+    else:
+        assert "version gate" in reason and jax.__version__ in reason
 
 
 @pytest.mark.parametrize("n", [2, 4, 8])
 def test_oracle_lowered_collectives_on_cpu_mesh(n):
     """Where this jax build exposes jax.shard_map, pin the symbolic
     model against the ACTUAL lowered program on a virtual CPU mesh."""
-    if not _shard_map_available():
-        pytest.skip("jax.shard_map unavailable in this environment "
-                    "(pre-existing jax version drift)")
+    reason = _shard_map_gate()
+    if reason is not None:
+        pytest.skip(reason)
     import jax
     from jax.sharding import PartitionSpec as P
     from rlo_tpu.ops import tpu_collectives as tc

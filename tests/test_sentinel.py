@@ -179,6 +179,69 @@ def test_s2_fires_on_unguarded_fabric_record_index(tree):
                for f in hits), hits
 
 
+def test_s2_fires_on_unclamped_msync_record_count_py(tree):
+    """The MSYNC_RSP member-record count is wire input driving a
+    range() loop: dropping its clamp must fire the loop-bound sink."""
+    mutate(tree, "rlo_tpu/engine.py",
+           "        if n < 0 or len(p) < 9 + 12 * n:\n"
+           "            return\n",
+           "")
+    hits = findings_for(tree, "S2")
+    assert any(f.file == "rlo_tpu/engine.py" and "'n'" in f.msg and
+               "loop bound" in f.msg and "_msync_adopt" in f.msg
+               for f in hits), hits
+
+
+def test_s2_fires_on_unclamped_msync_record_count_c(tree):
+    """Same hole, C engine: the record count read by get_le32 bounds
+    the member-record walk; without the clamp a hostile count walks
+    past the payload."""
+    mutate(tree, "rlo_tpu/native/rlo_engine.c",
+           "    if (n < 0 || plen < 9 + 12 * (int64_t)n)\n"
+           "        return;\n",
+           "")
+    hits = findings_for(tree, "S2")
+    assert any(f.file == "rlo_tpu/native/rlo_engine.c" and
+               "'n'" in f.msg and "loop bound" in f.msg and
+               "msync_adopt" in f.msg for f in hits), hits
+
+
+def test_s2_fires_on_unguarded_span_trailer_decode(tree):
+    """The PR-17 span-context trailer is parsed with a Struct-instance
+    unpack (_SPAN_CTX): dropping the length arm of the guard leaves
+    wire bytes unpacked with no dominating len(raw) check."""
+    mutate(tree, "rlo_tpu/wire.py",
+           "    if len(raw) - off < SPAN_CTX_SIZE or \\",
+           "    if False or \\")
+    hits = findings_for(tree, "S2")
+    assert any(f.file == "rlo_tpu/wire.py" and "'raw'" in f.msg and
+               "decode_span_ctx" in f.msg for f in hits), hits
+
+
+def test_s2_fires_on_unchecked_span_field_index(tree):
+    """rlo_span_decode's &out-params are wire bytes: dropping the
+    success check and indexing on the stage byte must fire — the
+    trailer fields are attacker-set."""
+    mutate(tree, "rlo_tpu/native/rlo_engine.c",
+           "        if (rlo_span_decode(m->payload + m->len - "
+           "RLO_SPAN_CTX_SIZE,\n"
+           "                            RLO_SPAN_CTX_SIZE, &gw, &sq, "
+           "&st, &fl,\n"
+           "                            0) >= 0)\n"
+           "            rlo_trace_emit(e->rank, RLO_EV_SPAN, st, -1, "
+           "sq, gw);\n",
+           "        rlo_span_decode(m->payload + m->len - "
+           "RLO_SPAN_CTX_SIZE,\n"
+           "                        RLO_SPAN_CTX_SIZE, &gw, &sq, &st, "
+           "&fl, 0);\n"
+           "        rlo_trace_emit(e->rank, RLO_EV_SPAN, "
+           "span_kind[st], -1, sq, gw);\n")
+    hits = findings_for(tree, "S2")
+    assert any(f.file == "rlo_tpu/native/rlo_engine.c" and
+               "'st'" in f.msg and "array index" in f.msg
+               for f in hits), hits
+
+
 def test_s3_fires_on_early_return_pool_leak(tree):
     """Dropping the error-branch rlo_pool_free re-creates the leak
     shape S3 exists for: acquire, fail a second acquisition, return
