@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: sentinel lint prover model static native test check
+.PHONY: sentinel lint prover model scope static native test check
 
 # CFG/dataflow analyzer for the dual engines (docs/DESIGN.md §15):
 # GIL-release safety, wire-input taint, error-path leaks, state-machine
@@ -28,6 +28,13 @@ prover:
 # configurations, A1 engine parity, A2 extracted<->explored coverage.
 model:
 	$(PY) -m rlo_tpu.tools.rlo_model
+
+# collective data-plane observatory (docs/DESIGN.md §21): seeded
+# instrumented sim run joined against the rlo-prover-checked cost
+# ledger — per-step bandwidth attribution, measured-vs-predicted
+# byte/step deviation findings (S1/S2/S3).
+scope:
+	$(PY) -m rlo_tpu.tools.rlo_scope
 
 # all four analyzers in one process: one merged findings document
 # (--json for CI tooling) with per-tool wall timing

@@ -350,8 +350,15 @@ def bench_multi_chip():
                                    x, k=k)
     info = confirm[winner]
     t_ours = info["t_med"]  # median: coherent with the median ratio
-    # ring allreduce bus traffic per chip: 2*(n-1)/n of the buffer size
-    bus_bytes = 2 * (n_dev - 1) / n_dev * nbytes_per_shard
+    # ring allreduce bus traffic per chip, from the proven cost ledger
+    # (single source of truth — docs/DESIGN.md §21); equals the old
+    # 2*(n-1)/n closed form whenever n divides the buffer, which the
+    # assert pins so a ledger regression can't skew the headline
+    from rlo_tpu.observe.ledger import ledger as coll_ledger
+    bus_bytes = coll_ledger("ring_allreduce", n_dev,
+                            nbytes_per_shard).bytes_per_rank
+    assert bus_bytes == 2 * (n_dev - 1) / n_dev * nbytes_per_shard, \
+        (bus_bytes, n_dev, nbytes_per_shard)
     bw_ours = bus_bytes / t_ours / 1e9
     bw_base = bus_bytes / t_base / 1e9
     print(f"{winner}: {t_ours*1e3:.2f} ms ({bw_ours:.1f} GB/s/chip)  "

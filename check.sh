@@ -135,6 +135,17 @@ echo "== causal trace smoke (rlo-trace --json, seeded 8-rank fabric_kill) =="
 JAX_PLATFORMS=cpu timeout 10 python -m rlo_tpu.tools.rlo_trace \
     --scenario fabric_kill --seed 7 --world-size 8 --json > /dev/null
 
+echo "== collective attribution smoke (rlo-scope --json, seeded 8-rank ring) =="
+# collective data-plane observatory (docs/DESIGN.md §21): run the
+# instrumented ring allreduce on the seeded sim substrate and join the
+# measured Ev.STEP timings against the rlo-prover-checked cost ledger
+# — step identities, per-rank send counts, and payload bytes must all
+# match the ledger exactly (S1/S2) and the reduction must be right
+# (S3); exit 1 on findings, 2 on tool error. The same report is
+# bit-for-bit pinned per (schedule, n, seed) by tests/test_scope.py.
+JAX_PLATFORMS=cpu timeout 10 python -m rlo_tpu.tools.rlo_scope \
+    --schedule ring_allreduce --n 8 --seed 0 --json > /dev/null
+
 echo "== simulator fuzz sweep (25 seeds x 10 chaos scripts) =="
 # fixed-seed deterministic sweep over the partition/restart/burst-loss/
 # mixed scenario scripts — exactly-once, termination, and membership
@@ -224,6 +235,23 @@ JAX_PLATFORMS=cpu python benchmarks/serve_bench.py --tiny \
 JAX_PLATFORMS=cpu python -m rlo_tpu.tools.perf_gate \
     --baseline BENCH_serve.json --fresh "$fresh_serve" --report
 rm -f "$fresh_serve"
+
+echo "== collective bench + perf gate (BENCH_collective.json) =="
+# collective data-plane legs (docs/DESIGN.md §21): instrumented sim
+# runs pin step-event counts, measured-fleet bytes (== the ledger's
+# account), substrate message counts, virtual drain times, and ledger
+# digests at zero tolerance; the jax wall-clock GB/s-vs-psum legs are
+# informational on CPU and become the ROADMAP item 2 bandwidth bar on
+# a real slice. The full (non-quick) run is required: the baseline's
+# wall legs must stay structurally present.
+fresh_coll=$(mktemp -t rlo_bench_coll.XXXXXX)
+PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+    XLA_FLAGS=--xla_force_host_platform_device_count=4 \
+    python benchmarks/collective_bench.py --out "$fresh_coll" \
+    2> /dev/null
+JAX_PLATFORMS=cpu python -m rlo_tpu.tools.perf_gate \
+    --baseline BENCH_collective.json --fresh "$fresh_coll" --report
+rm -f "$fresh_coll"
 
 echo "== manual-ring validation (8 virtual devices) =="
 PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \

@@ -543,7 +543,7 @@ int rlo_engine_phase_stats(const rlo_engine *e, rlo_phase_stats *out);
 /* ------------------------------------------------------------------ */
 #define RLO_TELEM_MAGIC "RLOT\x01"
 #define RLO_TELEM_HEADER_SIZE 26
-#define RLO_TELEM_NKEYS 33
+#define RLO_TELEM_NKEYS 35
 /* Pure codec (no engine): encode vals[RLO_TELEM_NKEYS] as a digest,
  * delta vs prev (NULL or full != 0 => full snapshot, deltas vs zero).
  * Returns bytes written or RLO_ERR_TOO_BIG/RLO_ERR_ARG. */
@@ -760,6 +760,15 @@ enum rlo_ev {
                             * S19): a = stage id, b = duration (usec;
                             * -1 = wire-hop receipt of a span-stamped
                             * record), c = rid seq, d = rid gateway */
+    RLO_EV_STEP = 16,      /* collective data-plane step (docs/DESIGN.md
+                            * S21): a = schedule id (observe.ledger
+                            * ALGORITHMS index), b = step duration
+                            * (usec, clamped to int32), c = op id * 1024
+                            * + step index, d = rank received from (-1
+                            * for send-only steps). The C engine hosts
+                            * no tensor collectives yet and never emits
+                            * it; the id is reserved here so the merged
+                            * timeline's numbering can't be reused. */
 };
 
 typedef struct rlo_trace_event {

@@ -2624,6 +2624,8 @@ int64_t rlo_engine_telem_digest(rlo_engine *e, int full, uint8_t *buf,
     v[i++] = 0; /* ttft_p99_usec */
     v[i++] = 0; /* e2e_p50_usec */
     v[i++] = 0; /* e2e_p99_usec */
+    v[i++] = 0; /* coll_steps: tensor collectives are Python-side */
+    v[i++] = 0; /* coll_bytes */
     /* digest seqs are incarnation-partitioned like the broadcast
      * seqs (mirror of TelemetryPlane): re-base on a bumped life and
      * re-anchor receivers with a full snapshot; the first digest of

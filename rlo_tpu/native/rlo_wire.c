@@ -115,6 +115,7 @@ static const char *const k_telem_keys[RLO_TELEM_NKEYS] = {
     "q_wait", "pickup_backlog", "pages_in_use", "pages_free",
     "serve_inflight", "ttft_p50_usec", "ttft_p99_usec",
     "e2e_p50_usec", "e2e_p99_usec",
+    "coll_steps", "coll_bytes",
 };
 
 const char *rlo_telem_key_name(int i)

@@ -15,6 +15,10 @@ designated collector rank:
     eventually-consistent :class:`FleetView`.
   - :class:`FleetView` — per-rank last-digest values plus fleet
     rollups, staleness-stamped by membership epoch and digest age.
+  - :func:`ledger` / :class:`Ledger` — deterministic per-step cost
+    ledgers for every committed collective schedule (who sends what to
+    whom at each step, and how many bytes), the "predicted" side of
+    rlo-scope's measured-vs-predicted attribution (docs/DESIGN.md §21).
   - :class:`Watchdog` / :class:`Rule` — declarative SLO rules
     (retransmit storms, epoch-lag ceilings, rejoin-cascade rates,
     pickup-backlog growth) evaluated against the fleet view; a
@@ -30,6 +34,9 @@ time only from the engine's injectable clock, so whole instrumented
 fleets replay bit-for-bit inside the deterministic simulator.
 """
 
+from rlo_tpu.observe.ledger import (ALGORITHMS, COMPOSITES, SCHEDULES,
+                                    Edge, Ledger, LedgerError, Step,
+                                    ledger)
 from rlo_tpu.observe.spans import STAGE_NAMES, SpanRecorder, Stage
 from rlo_tpu.observe.telemetry import (FleetView, TelemetryPlane,
                                        merge_counter_dicts,
@@ -41,4 +48,6 @@ __all__ = [
     "FleetView", "TelemetryPlane", "merge_counter_dicts",
     "merge_histograms", "Rule", "Watchdog", "Incident", "DEFAULT_RULES",
     "parse_rule", "Stage", "STAGE_NAMES", "SpanRecorder",
+    "ALGORITHMS", "COMPOSITES", "SCHEDULES", "Edge", "Ledger",
+    "LedgerError", "Step", "ledger",
 ]

@@ -212,7 +212,9 @@ class TelemetryPlane:
                  int(ex.get("ttft_p50_usec", 0)),
                  int(ex.get("ttft_p99_usec", 0)),
                  int(ex.get("e2e_p50_usec", 0)),
-                 int(ex.get("e2e_p99_usec", 0))]
+                 int(ex.get("e2e_p99_usec", 0)),
+                 int(ex.get("coll_steps", 0)),
+                 int(ex.get("coll_bytes", 0))]
         return vals
 
     def emit(self, full: bool = False) -> Dict[str, int]:

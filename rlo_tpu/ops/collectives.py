@@ -40,9 +40,17 @@ from typing import Dict, Generator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from rlo_tpu.observe.ledger import ALG_IDS
 from rlo_tpu.topology import ring_reduce_scatter_chunk
 from rlo_tpu.transport.base import Transport
+from rlo_tpu.utils.tracing import TRACER, Ev
 from rlo_tpu.wire import Frame, Tag
+
+#: hoisted schedule ids for the probe call sites (observe.ledger
+#: ALGORITHMS order — the `a` field of every Ev.STEP event)
+_ALG_RING_RS = ALG_IDS["ring_reduce_scatter"]
+_ALG_RING_AG = ALG_IDS["ring_all_gather"]
+_ALG_RD = ALG_IDS["recursive_doubling"]
 
 OPS = {
     "sum": np.add,
@@ -90,6 +98,40 @@ def _unpack_array(raw: bytes) -> np.ndarray:
     return np.frombuffer(raw, dtype=dt, offset=off).reshape(shape).copy()
 
 
+class StepProbe:
+    """Per-op step timer behind ``Comm.instrument`` (docs/DESIGN.md
+    §21). ``begin()`` arms the clock at op start; ``note()`` emits one
+    Ev.STEP at each schedule-step END with ``b`` = completion-to-
+    completion delta at this rank — so the sum of a rank's step
+    durations is the op's span on that rank's clock. The clock is
+    injectable (SimWorld.clock under the simulator, time.monotonic on
+    threads) and stamps ``ts_usec`` explicitly, keeping traced sim
+    runs bit-for-bit deterministic (R5). Payload bytes are NOT in the
+    event — rlo-scope joins them from the cost ledger."""
+
+    __slots__ = ("clock", "tracer", "rank", "_prev")
+
+    def __init__(self, clock, tracer, rank: int):
+        self.clock = clock
+        self.tracer = tracer
+        self.rank = rank
+        self._prev = 0.0
+
+    def begin(self) -> None:
+        self._prev = self.clock()
+
+    def note(self, alg: int, opid: int, step: int,
+             recv_from: int) -> None:
+        now = self.clock()
+        dur = int((now - self._prev) * 1e6)
+        self._prev = now
+        if dur > 0x7FFFFFFF:
+            dur = 0x7FFFFFFF
+        self.tracer.emit(self.rank, Ev.STEP, a=alg, b=dur,
+                         c=opid * 1024 + step, d=recv_from,
+                         ts_usec=int(now * 1e6))
+
+
 class Comm:
     """One rank's collective communicator over a transport endpoint.
 
@@ -125,9 +167,34 @@ class Comm:
         self._opid = itertools.count()
         # parked out-of-order arrivals: (src, opid, round) -> payload
         self._pending: Dict[Tuple[int, int, int], bytes] = {}
+        # data-plane load counters (always-live plain ints — the PR-2
+        # counter contract): cumulative sends and tensor payload bytes,
+        # surfaced to the telemetry digest as coll_steps/coll_bytes
+        self.coll_steps = 0
+        self.coll_bytes = 0
+        # per-step timing probe; None = disabled (one hoisted branch
+        # per schedule step — docs/DESIGN.md §21 overhead contract)
+        self._probe: Optional[StepProbe] = None
+
+    def instrument(self, clock, tracer=None) -> StepProbe:
+        """Attach a per-step timing probe emitting Ev.STEP into
+        ``tracer`` (default: the process tracer) with timestamps from
+        the injectable ``clock`` (seconds — SimWorld.clock or
+        time.monotonic). Returns the probe; ``comm._probe = None``
+        detaches."""
+        self._probe = StepProbe(clock, TRACER if tracer is None
+                                else tracer, self.real_rank)
+        return self._probe
+
+    def telemetry_extra(self) -> Dict[str, int]:
+        """Data-plane keys for a TelemetryPlane ``extra`` callable."""
+        return {"coll_steps": self.coll_steps,
+                "coll_bytes": self.coll_bytes}
 
     # -- plumbing ----------------------------------------------------------
     def _send(self, dst: int, opid: int, rnd: int, x: np.ndarray) -> None:
+        self.coll_steps += 1
+        self.coll_bytes += x.nbytes
         frame = Frame(origin=self.real_rank, pid=opid, vote=rnd,
                       payload=_pack_array(x))
         self.tp.isend(self.group[dst], int(Tag.DATA), frame.encode())
@@ -193,11 +260,16 @@ class Comm:
             p_rank = rank
         acc = x
         if in_core:
+            probe = self._probe
+            if probe is not None:
+                probe.begin()
             i = 0
             while (1 << i) < p:
                 peer = p_rank ^ (1 << i)
                 other = yield from self._exchange(peer, opid, i + 1, acc)
                 acc = fn(acc, other)
+                if probe is not None:
+                    probe.note(_ALG_RD, opid, i, self.group[peer])
                 i += 1
         # unfold
         if p != ws:
@@ -221,12 +293,17 @@ class Comm:
         ws, rank = self.world_size, self.rank
         nxt, prv = (rank + 1) % ws, (rank - 1) % ws
         chunks = [c.copy() for c in chunks]
+        probe = self._probe
+        if probe is not None:
+            probe.begin()
         for s in range(ws - 1):
             send_idx = ring_reduce_scatter_chunk(ws, rank, s)
             recv_idx = ring_reduce_scatter_chunk(ws, rank, s + 1)
             self._send(nxt, opid, s, chunks[send_idx])
             other = yield from self._recv(prv, opid, s)
             chunks[recv_idx] = fn(chunks[recv_idx], other)
+            if probe is not None:
+                probe.note(_ALG_RING_RS, opid, s, self.group[prv])
         own = (rank + 1) % ws
         return own, chunks[own]
 
@@ -239,10 +316,15 @@ class Comm:
         out: List[Optional[np.ndarray]] = [None] * ws
         out[idx] = chunk
         cur = chunk
+        probe = self._probe
+        if probe is not None:
+            probe.begin()
         for s in range(ws - 1):
             self._send(nxt, opid, s, cur)
             cur = yield from self._recv(prv, opid, s)
             out[(idx - s - 1) % ws] = cur
+            if probe is not None:
+                probe.note(_ALG_RING_AG, opid, s, self.group[prv])
         return out
 
     def reduce_scatter(self, x: np.ndarray, op: str = "sum"):

@@ -49,6 +49,27 @@ def _combiner(op: str, use_pallas: bool) -> Callable:
     return _JNP_OPS[op]
 
 
+#: Trace-time step hook (docs/DESIGN.md §21): called as
+#: ``hook(algorithm, step, ws)`` once per Python-unrolled schedule step
+#: while jax TRACES the collective — not per device execution, which
+#: host code cannot observe per-step (and the fori_loop-rolled ring
+#: bodies trace once regardless of ws, so they are not hooked; their
+#: per-step ledger is exact without instrumentation). Disabled cost:
+#: one branch per traced step, and zero per executed step — the PR-2/
+#: PR-5 overhead contract. ``algorithm`` names observe.ledger
+#: ALGORITHMS entries so rlo-scope can join the ledger directly.
+_STEP_HOOK = None
+
+
+def set_step_hook(fn):
+    """Install ``fn(algorithm, step, ws)`` as the trace-time step hook
+    (None disables). Returns the previous hook for restore."""
+    global _STEP_HOOK
+    prev = _STEP_HOOK
+    _STEP_HOOK = fn
+    return prev
+
+
 # ---------------------------------------------------------------------------
 # Rootless broadcast
 # ---------------------------------------------------------------------------
@@ -78,7 +99,11 @@ def rootless_bcast(x, origin: int, axis: str, *, schedule: str = "binomial"):
         else:
             raise ValueError(f"unknown schedule {schedule!r}")
         idx = lax.axis_index(axis)
-        for rnd in sched.rounds:
+        alg = "binomial_bcast" if schedule == "binomial" \
+            else "skip_ring_bcast"
+        for s, rnd in enumerate(sched.rounds):
+            if _STEP_HOOK is not None:
+                _STEP_HOOK(alg, s, ws)
             recv = lax.ppermute(x, axis, list(rnd))
             dsts = jnp.asarray([d for _, d in rnd])
             is_dst = jnp.any(idx == dsts)
@@ -379,7 +404,9 @@ def _allreduce_rd(x, axis: str, op: str, use_pallas: bool):
     if not topology.is_power_of_2(ws):
         raise ValueError("recursive_doubling requires power-of-2 axis size")
     combine = _combiner(op, use_pallas)
-    for rnd in topology.recursive_doubling_rounds(ws):
+    for s, rnd in enumerate(topology.recursive_doubling_rounds(ws)):
+        if _STEP_HOOK is not None:
+            _STEP_HOOK("recursive_doubling", s, ws)
         other = lax.ppermute(x, axis, list(rnd))
         x = combine(x, other)
     return x
@@ -549,7 +576,9 @@ def _halving_reduce_scatter(chunks, axis: str, op: str, use_pallas: bool):
     idx = lax.axis_index(axis)
     combine = _combiner(op, use_pallas)
     cur = chunks  # my current responsibility range; halves every round
-    for dist in topology.halving_doubling_distances(ws):
+    for s, dist in enumerate(topology.halving_doubling_distances(ws)):
+        if _STEP_HOOK is not None:
+            _STEP_HOOK("halving_reduce_scatter", s, ws)
         perm = list(topology.xor_perm(ws, dist))
         # ranks with bit `dist` set keep the upper half of their range
         in_upper = jnp.bitwise_and(idx, dist) != 0
@@ -576,7 +605,10 @@ def _doubling_all_gather(chunk, axis: str):
     idx = lax.axis_index(axis)
     out = jnp.zeros((ws,) + chunk.shape, chunk.dtype)
     out = lax.dynamic_update_index_in_dim(out, chunk, idx, 0)
-    for dist in reversed(topology.halving_doubling_distances(ws)):
+    for s, dist in enumerate(
+            reversed(topology.halving_doubling_distances(ws))):
+        if _STEP_HOOK is not None:
+            _STEP_HOOK("doubling_all_gather", s, ws)
         perm = list(topology.xor_perm(ws, dist))
         start = (idx // dist) * dist  # my block of `dist` assembled rows
         blk = lax.dynamic_slice_in_dim(out, start, dist, 0)

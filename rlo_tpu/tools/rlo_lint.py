@@ -127,6 +127,12 @@ R5_FILES = (ENGINE_PY, "rlo_tpu/transport/base.py",
             # module-random draw or wall-clock stamp would unpin the
             # bit-for-bit rlo-trace acceptance property
             "rlo_tpu/observe/spans.py",
+            # collective cost ledger + rlo-scope (round 21): ledgers
+            # must be a pure function of (schedule, n, nbytes) and the
+            # scope report bit-for-bit reproducible per (schedule, n,
+            # seed) — wall clocks or module randomness would unpin both
+            "rlo_tpu/observe/ledger.py",
+            "rlo_tpu/tools/rlo_scope.py",
             "rlo_tpu/tools/rlo_top.py",
             # the analyzers themselves (round 15): a wall-clock or
             # module-random dependency in rlo-lint/rlo-sentinel would

@@ -57,6 +57,10 @@ from rlo_tpu.utils.metrics import ENGINE_PHASE_KEYS as PHASE_NAMES
 #: imported for the same no-drift reason (observe.spans depends only
 #: on utils.tracing + wire, both engine/jax-free)
 from rlo_tpu.observe.spans import STAGE_NAMES as SPAN_STAGE_NAMES
+#: collective schedule names, indexed by the Ev.STEP ``a`` field —
+#: imported for the same no-drift reason (observe.ledger depends only
+#: on rlo_tpu.topology, engine/jax-free)
+from rlo_tpu.observe.ledger import ALGORITHMS as COLL_ALGORITHMS
 
 Source = Union[str, Path, Iterable[Dict]]
 
@@ -163,8 +167,31 @@ def merge_timeline(sources: List[Source],
     # flow events have something to bind to)
     # send-side anchors: (tag, origin, ident) -> {rank: sorted [ts]}
     anchors: Dict = {}
+    # collective step-slice starts: (alg, op*1024+step) -> {rank: ts}.
+    # SPMD ranks issue ops in identical order, so the pair names ONE
+    # schedule step globally and every rank contributes one anchor
+    step_anchors: Dict = {}
     for e in events:
         ts = e["ts_usec"] - t0
+        if e.get("kind") == "STEP":
+            # collective data-plane step (docs/DESIGN.md §21): like
+            # PHASE, a duration slice emitted at step END spanning
+            # [end - dur, end]; named algorithm:step so a ring's
+            # per-step slices line up across rank tracks
+            a = e.get("a", -1)
+            alg = (COLL_ALGORITHMS[a] if 0 <= a < len(COLL_ALGORITHMS)
+                   else f"alg{a}")
+            c = e.get("c", 0)
+            dur = max(int(e.get("b", 0)), slice_usec)
+            start = max(0, ts - dur)
+            trace_events.append({
+                "ph": "X", "cat": "coll", "name": f"{alg}:{c % 1024}",
+                "pid": 0, "tid": e["rank"], "ts": start, "dur": dur,
+                "args": {"op": c // 1024, "step": c % 1024,
+                         "usec": e.get("b", 0),
+                         "from": e.get("d", -1)}})
+            step_anchors.setdefault((a, c), {})[e["rank"]] = start
+            continue
         if e.get("kind") == "PHASE":
             # profiler stage sample (docs/DESIGN.md §10): a true
             # duration slice — emitted at stage END with the measured
@@ -247,6 +274,34 @@ def merge_timeline(sources: List[Source],
                              "name": label, "id": flow_id, "pid": 0,
                              "tid": e["rank"], "ts": recv_ts})
 
+    # per-hop collective flow edges (docs/DESIGN.md §21): every step
+    # completion that received data points back at the sender's step
+    # slice START — the sender transmits at the top of its step, so
+    # the receiver's completion is causally no earlier; a violation
+    # (cross-process clock skew) is skipped like a missing dump
+    for e in events:
+        if e.get("kind") != "STEP":
+            continue
+        src = e.get("d", -1)
+        if src < 0:
+            continue  # send-only step: no receive edge to draw
+        key = (e.get("a", -1), e.get("c", 0))
+        send_ts = step_anchors.get(key, {}).get(src)
+        recv_ts = e["ts_usec"] - t0
+        if send_ts is None or recv_ts < send_ts:
+            continue
+        a = e.get("a", -1)
+        alg = (COLL_ALGORITHMS[a] if 0 <= a < len(COLL_ALGORITHMS)
+               else f"alg{a}")
+        label = f"{alg}:{e.get('c', 0) % 1024}"
+        flow_id += 1
+        trace_events.append({"ph": "s", "cat": "coll_flow",
+                             "name": label, "id": flow_id, "pid": 0,
+                             "tid": src, "ts": send_ts})
+        trace_events.append({"ph": "f", "bp": "e", "cat": "coll_flow",
+                             "name": label, "id": flow_id, "pid": 0,
+                             "tid": e["rank"], "ts": recv_ts})
+
     # per-request causal chain: arrows between consecutive spans of a
     # rid in the analyzer's (end, start, stage, rank) total order —
     # the same order rlo-trace walks, so the rendered chain IS the
@@ -285,13 +340,14 @@ def trace_stats(trace: Dict) -> Dict:
     """Per-rank totals from a merged Chrome trace — the quick triage
     view an incident bundle links to (docs/DESIGN.md §17): protocol
     event counts by kind, phase-profiler slice counts + total usec by
-    stage, and flow edges sent/received per rank."""
+    stage, collective step slices + total usec by algorithm (§21),
+    and flow edges sent/received per rank."""
     ranks: Dict[int, Dict] = {}
 
     def ent(tid) -> Dict:
         e = ranks.get(tid)
         if e is None:
-            e = ranks[tid] = {"events": {}, "phases": {},
+            e = ranks[tid] = {"events": {}, "phases": {}, "coll": {},
                               "flows_out": 0, "flows_in": 0}
         return e
 
@@ -323,6 +379,15 @@ def trace_stats(trace: Dict) -> Dict:
                     name, {"count": 0, "usec": 0})
                 slot["count"] += 1
                 slot["usec"] += int(e.get("args", {}).get("usec", 0))
+            elif cat == "coll":
+                # bucket by algorithm (the name's prefix), not per
+                # step — the per-step view is rlo-scope's job
+                alg = e.get("name", "?").rsplit(":", 1)[0]
+                slot = ent(tid)["coll"].setdefault(
+                    alg, {"count": 0, "usec": 0})
+                slot["count"] += 1
+                slot["usec"] += int(e.get("args", {}).get(
+                    "usec", e.get("dur", 0)))
             elif cat == "span_hop":
                 req_ent(e.get("args", {}).get("rid", "?"))["hops"] += 1
             else:
@@ -358,6 +423,10 @@ def render_trace_stats(stats: Dict) -> str:
             tot = sum(p["count"] for p in e["phases"].values())
             usec = sum(p["usec"] for p in e["phases"].values())
             row += f"   {tot} ({usec} us)"
+        if e.get("coll"):
+            tot = sum(p["count"] for p in e["coll"].values())
+            usec = sum(p["usec"] for p in e["coll"].values())
+            row += f"   coll {tot} ({usec} us)"
         lines.append(row)
     return "\n".join(lines)
 

@@ -76,6 +76,20 @@ class Ev(IntEnum):
     #                     explicit engine-clock ts_usec (stage END) so
     #                     traced fleets replay bit-for-bit in the
     #                     deterministic simulator
+    STEP = 16           # collective data-plane step (docs/DESIGN.md
+    #                     §21): a = schedule id (observe.ledger
+    #                     .ALGORITHMS index), b = step duration (usec,
+    #                     clamped to int32) measured completion-to-
+    #                     completion at this rank, c = op id * 1024 +
+    #                     step index (the cross-rank join identity —
+    #                     SPMD ranks issue ops in identical order),
+    #                     d = the rank this step RECEIVED from (-1 for
+    #                     send-only steps). Emitted at step END with an
+    #                     explicit injectable-clock ts_usec; payload
+    #                     bytes are deliberately NOT in the event —
+    #                     rlo-scope joins them from the cost ledger,
+    #                     which instrumentation can therefore never
+    #                     contradict silently
 
 
 @dataclass

@@ -251,13 +251,18 @@ TELEM_HEADER_SIZE = 26
 #: occupancy, and the fabric latency block (in-flight requests plus
 #: p50/p99 TTFT and e2e from the fabric's log2-bucket histograms —
 #: docs/DESIGN.md §19). All serving keys are zero on ranks without an
-#: attached fabric — the C engine always emits 0 here.
+#: attached fabric — the C engine always emits 0 here. The trailing
+#: collective data-plane rollups (cumulative schedule steps executed
+#: and payload bytes sent by the engine-substrate collectives —
+#: docs/DESIGN.md §21) are likewise zero on the C engine: tensor
+#: collectives are Python-side.
 # rlo-lint: paired-with rlo_wire.c:k_telem_keys
 TELEM_EXTRA_KEYS = (
     "tx_frames", "rx_frames", "rtt_ewma_max_usec",
     "q_wait", "pickup_backlog", "pages_in_use", "pages_free",
     "serve_inflight", "ttft_p50_usec", "ttft_p99_usec",
     "e2e_p50_usec", "e2e_p99_usec",
+    "coll_steps", "coll_bytes",
 )
 
 #: The full digest schema, in mask-bit order: the engine-counter

@@ -1,0 +1,233 @@
+"""rlo-scope cost ledgers vs rlo-prover P2 (docs/DESIGN.md §21).
+
+The ledger module replays the committed schedule generators with the
+same token algebra rlo-prover P2 proves them with, additionally
+recording per-step (src -> dst, bytes, cumulative mask) edges.  These
+tests pin the three contracts the tentpole rests on:
+
+  1. **Algebra parity**: for every schedule family and every n <= 64
+     (power-of-2 only where the schedule requires it), the ledger's
+     final token-algebra state equals the matching P2 simulator's
+     return value VERBATIM — the ledger cannot drift from the proofs.
+
+  2. **Cost-model parity**: ``Ledger.bytes_per_rank`` equals
+     ``allreduce_cost``'s total_bytes for ring / recursive-doubling /
+     halving-doubling, including ragged (element-padded) payloads —
+     the byte figures bench.py and BENCH_collective.json consume are
+     the proven ones.
+
+  3. **Mutation sensitivity**: a perturbed schedule generator (the
+     ``topo=`` substitution hook) cannot produce a ledger at all —
+     construction raises :class:`LedgerError` where P2 would record a
+     defect, so wrong byte predictions are unrepresentable.
+"""
+
+import types
+
+import pytest
+
+from rlo_tpu import topology
+from rlo_tpu.observe.ledger import (ALGORITHMS, COMPOSITES, SCHEDULES,
+                                    LedgerError, chunk_nbytes, ledger)
+from rlo_tpu.ops.tpu_collectives import allreduce_cost
+from rlo_tpu.tools import rlo_prover as P
+
+NBYTES = 4096
+POW2 = [n for n in range(2, 65) if n & (n - 1) == 0]
+ALL_N = list(range(2, 65))
+
+
+# ---------------------------------------------------------------------------
+# 1. algebra parity vs rlo-prover P2, all n <= 64
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("kind", ["binomial_bcast", "skip_ring_bcast"])
+def test_bcast_ledger_matches_p2_all_n(kind):
+    gen = (topology.binomial_bcast_schedule if kind == "binomial_bcast"
+           else topology.skip_ring_bcast_schedule)
+    for n in ALL_N:
+        for origin in {0, n // 2, n - 1}:
+            led = ledger(kind, n, NBYTES, origin=origin)
+            sched = gen(n, origin)
+            assert led.final == tuple(
+                P.simulate_bcast(sched.rounds, n))
+            assert led.final == (origin,) * n
+            assert led.num_steps == len(sched.rounds)
+            # every delivery is one full-vector send
+            assert led.total_bytes == NBYTES * sum(
+                len(r) for r in sched.rounds)
+
+
+def test_ring_allreduce_ledger_matches_p2_all_n():
+    for n in ALL_N:
+        led = ledger("ring_allreduce", n, NBYTES)
+        grid, defects = P.simulate_ring_allreduce(n, topology)
+        assert defects == []
+        assert led.final == tuple(tuple(row) for row in grid)
+        assert led.num_steps == 2 * (n - 1)
+
+
+def test_ring_all_gather_ledger_matches_p2_all_n():
+    for n in ALL_N:
+        led = ledger("ring_all_gather", n, NBYTES)
+        grid, defects = P.simulate_ring_all_gather(n, topology)
+        assert defects == []
+        assert led.final == tuple(tuple(row) for row in grid)
+
+
+def test_recursive_doubling_ledger_matches_p2_all_n():
+    for n in POW2:
+        led = ledger("recursive_doubling", n, NBYTES)
+        acc, defects = P.simulate_rd_allreduce(n, topology)
+        assert defects == []
+        assert led.final == tuple(acc)
+        assert led.num_steps == n.bit_length() - 1
+
+
+def test_halving_doubling_ledger_matches_p2_all_n():
+    for n in POW2:
+        rs, defects = P.simulate_halving_reduce_scatter(n, topology)
+        assert defects == []
+        led_rs = ledger("halving_reduce_scatter", n, NBYTES)
+        assert led_rs.final == tuple(rs)
+
+        grid, defects = P.simulate_doubling_all_gather(n, rs, topology)
+        assert defects == []
+        led = ledger("halving_doubling", n, NBYTES)
+        assert led.final == tuple(tuple(row) for row in grid)
+
+        full = (1 << n) - 1
+        led_ag = ledger("doubling_all_gather", n, NBYTES)
+        grid2, defects = P.simulate_doubling_all_gather(
+            n, [(r, full) for r in range(n)], topology)
+        assert defects == []
+        assert led_ag.final == tuple(tuple(row) for row in grid2)
+
+
+# ---------------------------------------------------------------------------
+# 2. cost-model parity (incl. ragged payloads)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("nbytes", [NBYTES, 1000, 4])
+def test_bytes_per_rank_matches_allreduce_cost(nbytes):
+    for n in (2, 3, 4, 7, 8, 16, 31, 64):
+        led = ledger("ring_allreduce", n, nbytes)
+        model = allreduce_cost("ring", n, nbytes)
+        assert led.bytes_per_rank == model["total_bytes"], (n, nbytes)
+        assert led.num_steps == model["steps"]
+        # the ring is uniform: every rank pushes the same bytes
+        assert set(led.sent_bytes_by_rank()) == {led.bytes_per_rank}
+    for n in (2, 4, 8, 16, 32, 64):
+        for alg in ("recursive_doubling", "halving_doubling"):
+            led = ledger(alg, n, nbytes)
+            model = allreduce_cost(alg, n, nbytes)
+            assert led.bytes_per_rank == model["total_bytes"], (
+                alg, n, nbytes)
+            assert led.num_steps == model["steps"]
+
+
+def test_ragged_payload_pads_at_element_granularity():
+    # 1000 B f32 over n=3: 250 elems -> ceil to 84/chunk -> 336 B
+    assert chunk_nbytes(3, 1000, 4) == 336
+    led = ledger("ring_reduce_scatter", 3, 1000)
+    assert all(e.nbytes == 336 for s in led.steps for e in s.edges)
+    with pytest.raises(LedgerError):
+        chunk_nbytes(4, 1001, 4)  # not a multiple of itemsize
+
+
+def test_fleet_accounting_consistency():
+    for sched in SCHEDULES:
+        n = 8
+        led = ledger(sched, n, NBYTES)
+        assert sum(led.sent_bytes_by_rank()) == led.total_bytes
+        assert led.total_bytes == sum(s.nbytes for s in led.steps)
+        for s in led.steps:
+            assert s.edge_nbytes == max(e.nbytes for e in s.edges)
+    # broadcast is the non-uniform family: the origin forwards more
+    led = ledger("binomial_bcast", 8, NBYTES)
+    by_rank = led.sent_bytes_by_rank()
+    assert by_rank[0] == max(by_rank) and min(by_rank) == 0
+
+
+def test_trivial_and_invalid_ledgers():
+    led = ledger("ring_allreduce", 1, NBYTES)
+    assert led.steps == () and led.total_bytes == 0
+    with pytest.raises(LedgerError):
+        ledger("nope", 4, NBYTES)
+    with pytest.raises(LedgerError):
+        ledger("ring_allreduce", 0, NBYTES)
+    with pytest.raises(LedgerError):
+        ledger("binomial_bcast", 4, NBYTES, origin=4)
+    with pytest.raises(LedgerError):
+        ledger("ring_allreduce", 4, NBYTES + 1)  # itemsize misfit
+
+
+def test_schedule_tables_are_closed():
+    # Ev.STEP's ``a`` field indexes ALGORITHMS; composites expand to
+    # atomic phases in execution order
+    for name, phases in COMPOSITES.items():
+        assert all(p in ALGORITHMS for p in phases)
+        led = ledger(name, 8, NBYTES)
+        seen = tuple(dict.fromkeys(s.algorithm for s in led.steps))
+        assert seen == phases
+
+
+# ---------------------------------------------------------------------------
+# 3. digest determinism + mutation sensitivity
+# ---------------------------------------------------------------------------
+
+def test_digest_is_deterministic_and_input_sensitive():
+    a = ledger("ring_allreduce", 8, NBYTES).digest()
+    assert a == ledger("ring_allreduce", 8, NBYTES).digest()
+    assert a != ledger("ring_allreduce", 16, NBYTES).digest()
+    assert a != ledger("ring_allreduce", 8, 2 * NBYTES).digest()
+    assert a != ledger("recursive_doubling", 8, NBYTES).digest()
+
+
+def _perturbed(**overrides):
+    """The mutation hook: rlo_tpu.topology with named generators
+    replaced — a stand-in for a buggy schedule commit."""
+    ns = types.SimpleNamespace()
+    for name in dir(topology):
+        if not name.startswith("_"):
+            setattr(ns, name, getattr(topology, name))
+    for name, fn in overrides.items():
+        setattr(ns, name, fn)
+    return ns
+
+
+def test_perturbed_chunk_map_cannot_produce_a_ledger():
+    # off-by-one chunk selection: reduce-scatter merges misalign
+    bad = _perturbed(ring_reduce_scatter_chunk=lambda n, r, s:
+                     (topology.ring_reduce_scatter_chunk(n, r, s) + 1)
+                     % n)
+    with pytest.raises(LedgerError, match="misalignment"):
+        ledger("ring_allreduce", 8, NBYTES, topo=bad)
+
+
+def test_perturbed_rd_rounds_cannot_produce_a_ledger():
+    # dropping the last round leaves contribution sets incomplete
+    bad = _perturbed(recursive_doubling_rounds=lambda n:
+                     topology.recursive_doubling_rounds(n)[:-1])
+    with pytest.raises(LedgerError):
+        ledger("recursive_doubling", 8, NBYTES, topo=bad)
+
+
+def test_perturbed_bcast_schedule_cannot_produce_a_ledger():
+    real = topology.binomial_bcast_schedule
+
+    def truncated(n, origin):
+        sched = real(n, origin)
+        return type(sched)(n, origin, sched.rounds[:-1])
+
+    bad = _perturbed(binomial_bcast_schedule=truncated)
+    with pytest.raises(LedgerError, match="does not deliver"):
+        ledger("binomial_bcast", 8, NBYTES, topo=bad)
+
+
+def test_perturbed_ring_perm_cannot_produce_a_ledger():
+    # a non-permutation "ring" (two senders to one receiver)
+    bad = _perturbed(ring_perm=lambda n, off=1: tuple(
+        (s, 0) for s in range(n)))
+    with pytest.raises(LedgerError, match="permutation"):
+        ledger("ring_all_gather", 8, NBYTES, topo=bad)

@@ -206,6 +206,21 @@ def test_r2_fires_on_telem_key_drift(tree):
                "k_telem_keys" in f.msg for f in hits), hits
 
 
+def test_r2_fires_on_coll_key_drift(tree):
+    """The §21 collective rollups (coll_steps / coll_bytes) ride the
+    same schema chain as every §17 digest key: dropping one from
+    TELEM_EXTRA_KEYS must break the C codec's name table and the
+    RLO_TELEM_NKEYS pin."""
+    mutate(tree, "rlo_tpu/wire.py",
+           '"coll_steps", "coll_bytes",',
+           '"coll_steps",')
+    hits = findings_for(tree, "R2")
+    assert any(f.file == "rlo_tpu/native/rlo_core.h" and
+               "RLO_TELEM_NKEYS" in f.msg for f in hits), hits
+    assert any(f.file == "rlo_tpu/native/rlo_wire.c" and
+               "k_telem_keys" in f.msg for f in hits), hits
+
+
 def test_r2_fires_on_telem_header_drift(tree):
     """The byte-pinned digest header size is a paired constant: a
     Python-side bump without the C twin is a finding at the
