@@ -16,8 +16,11 @@ deterministic simulator with the stub backend and records, per leg:
   - **wall_events_per_sec**: host throughput, informational.
 
 Legs: ``steady4`` (4 ranks, no faults), ``failover4`` (4 ranks, the
-warm-up owner killed mid-decode), ``steady8`` (8 ranks). Output schema
-shared with engine_bench/sim_bench, consumed by
+warm-up owner killed mid-decode), ``steady8`` (8 ranks), and
+``failover4_remedy`` (the ``remedy_flap`` chaos shape with the §22
+remediation loop armed — the gate pins the schedule digest, the IAR
+decision count, executed quarantines, and the recovered end state).
+Output schema shared with engine_bench/sim_bench, consumed by
 ``rlo_tpu.tools.perf_gate`` (check.sh gates against the committed
 BENCH_fabric.json).
 
@@ -139,6 +142,47 @@ def run_leg(n: int, n_req: int, seed: int, kill_at=None,
             e2e_mean, wall)
 
 
+def remedy_leg(seed: int = 0):
+    """The §22 remediation leg: the ``remedy_flap`` chaos shape (kill
+    + elastic rejoin + a sustained loss window) with telemetry, the
+    DEFAULT watchdog SLOs and the RemedyPolicy armed. The scenario
+    property-checks the remediation invariants internally (min-alive
+    quorum, blast-radius cap, expected quarantine target, drain,
+    recovered admission) and everything it returns is seed-exact, so
+    the gate pins the WHOLE loop at zero tolerance: the schedule
+    digest, the IAR decision count, the executed quarantines, and the
+    fully-recovered end state (no rank quarantined, backpressure back
+    at level 0). A change that delays the trip, re-orders a decision,
+    or wedges the hysteresis moves one of these and fails
+    mechanically."""
+    from rlo_tpu.serving.scenario import make_fabric_scenario
+
+    t_wall = time.perf_counter()
+    res = make_fabric_scenario("remedy_flap", seed).run()
+    wall = time.perf_counter() - t_wall
+    rem = res["remedy"]
+    quar = sum(1 for log in rem["logs"].values()
+               for e in log if e[1] == "QUARANTINE")
+    print(f"failover4_remedy: {res['events']} events, "
+          f"{res['requeues']} requeues, {rem['decided']} decided, "
+          f"{quar} quarantine execs, bp_final {rem['bp_final']}, "
+          f"wall {wall:.2f}s", file=sys.stderr)
+    pfx = "failover4_remedy"
+    return {
+        f"{pfx}.digest": exact(res["digest"]),
+        f"{pfx}.events": exact(res["events"]),
+        f"{pfx}.submitted": exact(res["submitted"]),
+        f"{pfx}.requeues": exact(res["requeues"]),
+        f"{pfx}.remedies_decided": exact(rem["decided"]),
+        f"{pfx}.quarantines_executed": exact(quar),
+        f"{pfx}.final_quarantined": exact(
+            len(rem["final_quarantined"])),
+        f"{pfx}.bp_final": exact(rem["bp_final"]),
+        f"{pfx}.wall_events_per_sec": info(
+            round(res["events"] / wall, 1) if wall > 0 else 0.0),
+    }
+
+
 def trace_doc(trace, n: int, time_scale: float = 1.0,
               decode_interval: float = 0.5):
     """Run one trace-driven fabric leg (rlo_tpu/workloads traces
@@ -216,6 +260,7 @@ def main(argv=None) -> int:
         metrics[f"{name}.e2e_mean_usec"] = exact(round(e2e, 3))
         metrics[f"{name}.wall_events_per_sec"] = info(
             round(events / wall, 1) if wall > 0 else 0.0)
+    metrics.update(remedy_leg(seed=0))
 
     doc = {"suite": "fabric_bench",
            "config": {"quick": bool(args.quick)},

@@ -146,7 +146,7 @@ echo "== collective attribution smoke (rlo-scope --json, seeded 8-rank ring) =="
 JAX_PLATFORMS=cpu timeout 10 python -m rlo_tpu.tools.rlo_scope \
     --schedule ring_allreduce --n 8 --seed 0 --json > /dev/null
 
-echo "== simulator fuzz sweep (25 seeds x 10 chaos scripts) =="
+echo "== simulator fuzz sweep (25 seeds x 13 chaos scripts) =="
 # fixed-seed deterministic sweep over the partition/restart/burst-loss/
 # mixed scenario scripts — exactly-once, termination, and membership
 # convergence checked per run — plus the churn_weather healing shape
@@ -157,7 +157,12 @@ echo "== simulator fuzz sweep (25 seeds x 10 chaos scripts) =="
 # weather-driven fabric_churn: sustained kill/rejoin churn from a
 # seeded churn_script, docs/DESIGN.md §11/§14): exactly-once request
 # completion with oracle-identical tokens, re-admission after heal,
-# and placement convergence. A violation prints the seed + a replay
+# and placement convergence — PLUS the §22 remediation shapes
+# (remedy_flap/remedy_hotspot/remedy_split: default watchdog SLOs AND
+# the consensus-gated RemedyPolicy armed — the fleet must quarantine
+# the flapper through IAR, throttle admissions under the hotspot,
+# never dual-quarantine across a partition, and recover fully once
+# the fault clears). A violation prints the seed + a replay
 # recipe with the live pending-event count (docs/DESIGN.md §8). The C
 # engine runs the same protocol shapes via the native loopback fault
 # hooks inside pytest (tests/test_membership.py); the long 500-run
@@ -199,7 +204,11 @@ echo "== serving-fabric bench + perf gate (BENCH_fabric.json) =="
 # 4/8-rank fabric legs in the deterministic simulator: drain vtime,
 # schedule events, fail-over requeues and fleet e2e latency are all
 # seed-exact and gate at zero tolerance — a protocol change that adds
-# a hop or slows fail-over fails mechanically (docs/DESIGN.md §11)
+# a hop or slows fail-over fails mechanically (docs/DESIGN.md §11).
+# The failover4_remedy leg pins the whole §22 remediation loop the
+# same way: schedule digest, IAR decision count, executed
+# quarantines, and the recovered end state (nothing quarantined,
+# backpressure back at 0)
 fresh_fabric=$(mktemp -t rlo_bench_fabric.XXXXXX)
 JAX_PLATFORMS=cpu python benchmarks/fabric_bench.py \
     --out "$fresh_fabric" > /dev/null

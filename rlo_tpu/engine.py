@@ -2477,14 +2477,26 @@ class ProgressEngine:
             admitted_inc = self._admitted.get(src, -1)
             if inc < admitted_inc:
                 return  # stale petition from an already-replaced life
-            if inc == admitted_inc and self._reset_epoch.get(src, 0):
+            if inc == admitted_inc and \
+                    ep < self._reset_epoch.get(src, 0):
                 # sync-supersedes-welcome (§18): this exact life was
                 # already admitted here, so its JOIN_WELCOME was lost
                 # in flight. The old answer — re-declare it failed and
                 # re-admit — was the measured rejoin-cascade
                 # amplifier; a view-state sync response carries
                 # everything the welcome did and repeats for free on
-                # the petition cadence until one lands.
+                # the petition cadence until one lands. The epoch
+                # guard tells the two ways a known life can petition
+                # apart: a lost-welcome joiner still holds its
+                # pre-admission epoch (the admission round chose
+                # new_epoch strictly above every petitioner's), while
+                # a life that SAW its welcome and later self-demoted
+                # to joiner (asymmetric heal chaos) petitions at
+                # ep >= its reset epoch — serving that one a sync
+                # livelocks, because _msync_adopt rightly refuses any
+                # response that does not certify a fresh admission
+                # for a mid-rejoin life; it needs the re-admission
+                # below.
                 self._msync_serve(src)
                 return
             # a rank we consider ALIVE is petitioning against our

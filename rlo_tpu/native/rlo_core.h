@@ -543,7 +543,7 @@ int rlo_engine_phase_stats(const rlo_engine *e, rlo_phase_stats *out);
 /* ------------------------------------------------------------------ */
 #define RLO_TELEM_MAGIC "RLOT\x01"
 #define RLO_TELEM_HEADER_SIZE 26
-#define RLO_TELEM_NKEYS 35
+#define RLO_TELEM_NKEYS 39
 /* Pure codec (no engine): encode vals[RLO_TELEM_NKEYS] as a digest,
  * delta vs prev (NULL or full != 0 => full snapshot, deltas vs zero).
  * Returns bytes written or RLO_ERR_TOO_BIG/RLO_ERR_ARG. */

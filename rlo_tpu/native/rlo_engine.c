@@ -2626,6 +2626,10 @@ int64_t rlo_engine_telem_digest(rlo_engine *e, int full, uint8_t *buf,
     v[i++] = 0; /* e2e_p99_usec */
     v[i++] = 0; /* coll_steps: tensor collectives are Python-side */
     v[i++] = 0; /* coll_bytes */
+    v[i++] = 0; /* remedies_proposed: remediation is Python-side */
+    v[i++] = 0; /* remedies_executed */
+    v[i++] = 0; /* quarantined */
+    v[i++] = 0; /* backpressure_level */
     /* digest seqs are incarnation-partitioned like the broadcast
      * seqs (mirror of TelemetryPlane): re-base on a bumped life and
      * re-anchor receivers with a full snapshot; the first digest of

@@ -116,6 +116,8 @@ static const char *const k_telem_keys[RLO_TELEM_NKEYS] = {
     "serve_inflight", "ttft_p50_usec", "ttft_p99_usec",
     "e2e_p50_usec", "e2e_p99_usec",
     "coll_steps", "coll_bytes",
+    "remedies_proposed", "remedies_executed",
+    "quarantined", "backpressure_level",
 };
 
 const char *rlo_telem_key_name(int i)

@@ -37,6 +37,9 @@ fleets replay bit-for-bit inside the deterministic simulator.
 from rlo_tpu.observe.ledger import (ALGORITHMS, COMPOSITES, SCHEDULES,
                                     Edge, Ledger, LedgerError, Step,
                                     ledger)
+from rlo_tpu.observe.remedy import (DEFAULT_ACTIONS, REMEDY_KINDS,
+                                    REMEDY_PID_BASE, RemedyPolicy,
+                                    RemedyRecord)
 from rlo_tpu.observe.spans import STAGE_NAMES, SpanRecorder, Stage
 from rlo_tpu.observe.telemetry import (FleetView, TelemetryPlane,
                                        merge_counter_dicts,
@@ -50,4 +53,6 @@ __all__ = [
     "parse_rule", "Stage", "STAGE_NAMES", "SpanRecorder",
     "ALGORITHMS", "COMPOSITES", "SCHEDULES", "Edge", "Ledger",
     "LedgerError", "Step", "ledger",
+    "RemedyRecord", "RemedyPolicy", "REMEDY_PID_BASE", "REMEDY_KINDS",
+    "DEFAULT_ACTIONS",
 ]

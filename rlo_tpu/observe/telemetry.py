@@ -90,6 +90,17 @@ class FleetView:
         return sorted(r for r, e in self.entries.items()
                       if e.applied_seq >= 0)
 
+    def incarnations(self) -> Dict[int, int]:
+        """Per-rank incarnation inferred from the digest seq space:
+        seqs are partitioned ``incarnation << 20`` exactly like the
+        engine's broadcast seqs, so the high bits of the last applied
+        seq ARE the origin's incarnation at emission time. A rank
+        with incarnation >= 1 has restarted at least once — the
+        flapper signal the remediation policy keys on."""
+        return {r: ent.applied_seq >> 20
+                for r, ent in self.entries.items()
+                if ent.applied_seq >= 0}
+
     def rollups(self) -> Dict[str, int]:
         """Fleet-wide SUM per key over every applied rank entry (the
         meaningful aggregate for the counter keys)."""
@@ -214,7 +225,11 @@ class TelemetryPlane:
                  int(ex.get("e2e_p50_usec", 0)),
                  int(ex.get("e2e_p99_usec", 0)),
                  int(ex.get("coll_steps", 0)),
-                 int(ex.get("coll_bytes", 0))]
+                 int(ex.get("coll_bytes", 0)),
+                 int(ex.get("remedies_proposed", 0)),
+                 int(ex.get("remedies_executed", 0)),
+                 int(ex.get("quarantined", 0)),
+                 int(ex.get("backpressure_level", 0))]
         return vals
 
     def emit(self, full: bool = False) -> Dict[str, int]:

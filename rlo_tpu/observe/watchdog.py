@@ -142,6 +142,7 @@ class _RuleState:
     history: deque = field(default_factory=deque)  # (vtime, agg value)
     trips: int = 0
     next_ok: float = float("-inf")                 # cooldown gate
+    forgave_at: float = float("-inf")              # last window reset
 
 
 class Watchdog:
@@ -165,14 +166,32 @@ class Watchdog:
     Attaching: ``Watchdog(plane, ...)`` registers itself as
     ``plane.watchdog``, so ``plane.pump()`` evaluates the rules once
     per emission interval, right after each digest goes out.
+
+    ``forgive_keys``: rate-mode rules over these TELEM keys RESET
+    their sliding window when the fleet's ``view_changes`` rollup
+    bumps — a legitimate membership change (an admitted rejoin, a
+    failure adoption) spends retransmits and rejoin work as its heal
+    cost, and reading that spike as a storm would trip the very SLO
+    whose remediation quarantines the healthy joiner. The reset is
+    clear-then-append (the post-heal value becomes the new window
+    baseline, absorbing the spike) and rate-limited to once per rule
+    window: under a SUSTAINED flap the view changes more often than
+    the window, and forgiving every bump would blind the rule to the
+    cascade it exists to catch.
     """
+
+    #: rate rules over these keys get view-change forgiveness — the
+    #: two churn-cost counters whose heal spike is indistinguishable
+    #: from the failure they watch for (see class docstring)
+    FORGIVE_KEYS = ("arq_retransmits", "rejoins")
 
     def __init__(self, plane,
                  rules: Sequence[Union[str, Rule]] = DEFAULT_RULES, *,
                  incident_dir: Optional[str] = None,
                  cooldown: float = 60.0,
                  replay: Union[None, str, Callable[[], str]] = None,
-                 engines: Optional[Sequence] = None):
+                 engines: Optional[Sequence] = None,
+                 forgive_keys: Optional[Sequence[str]] = None):
         self.plane = plane
         self.rules = [parse_rule(r) for r in rules]
         names = [r.name for r in self.rules]
@@ -187,9 +206,13 @@ class Watchdog:
         # replace engines in place on restart (Scenario) must see the
         # current fleet in the bundle, not the construction-time one
         self.engines = engines
+        self.forgive_keys = frozenset(
+            self.FORGIVE_KEYS if forgive_keys is None else forgive_keys)
         self.incidents: List[Incident] = []
+        self.forgiveness = 0  # window resets granted (see FORGIVE_KEYS)
         self._state: Dict[str, _RuleState] = {
             r.name: _RuleState() for r in self.rules}
+        self._last_vc: Optional[int] = None
         plane.watchdog = self
 
     # ------------------------------------------------------------------
@@ -204,6 +227,7 @@ class Watchdog:
         plane.watchdog = self
         for st in self._state.values():
             st.history.clear()
+        self._last_vc = None
 
     def check(self) -> List[Incident]:
         """Evaluate every rule against the current fleet view; returns
@@ -212,13 +236,17 @@ class Watchdog:
         now = self.plane.clock()
         fired: List[Incident] = []
         # one rollup pass per aggregate per check — this runs once per
-        # plane pump, i.e. on the simulator's drive loop
-        rollups = rollup_max = None
+        # plane pump, i.e. on the simulator's drive loop (the sum
+        # rollup is unconditional: the view-change forgiveness gate
+        # reads it even when every sum rule is in cooldown)
+        rollups = self.plane.view.rollups()
+        rollup_max = None
+        vc = rollups["view_changes"]
+        vc_bumped = self._last_vc is not None and vc != self._last_vc
+        self._last_vc = vc
         for rule in self.rules:
             st = self._state[rule.name]
             if rule.agg == "sum":
-                if rollups is None:
-                    rollups = self.plane.view.rollups()
                 value = float(rollups[rule.key])
             else:
                 if rollup_max is None:
@@ -226,6 +254,16 @@ class Watchdog:
                 value = float(rollup_max[rule.key])
             if rule.mode == "rate":
                 hist = st.history
+                if vc_bumped and rule.key in self.forgive_keys and \
+                        now - st.forgave_at >= rule.window:
+                    # a legitimate membership change: restart this
+                    # rule's window so the heal spike becomes the new
+                    # baseline instead of a rate trip — at most once
+                    # per window, so a sustained flap (view changes
+                    # faster than the window) still accumulates
+                    hist.clear()
+                    st.forgave_at = now
+                    self.forgiveness += 1
                 hist.append((now, value))
                 while hist and hist[0][0] < now - rule.window:
                     hist.popleft()

@@ -42,8 +42,11 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 from rlo_tpu.engine import (INCARNATION_SHIFT, ProgressEngine, ReqState,
                             UserMsg)
+from rlo_tpu.observe.remedy import (REMEDY_KINDS, REMEDY_PID_BASE,
+                                    RemedyRecord)
 from rlo_tpu.observe.spans import SpanRecorder, Stage
-from rlo_tpu.serving.placement import (Placement, owner_of, pick_owner)
+from rlo_tpu.serving.placement import (Placement, healthy_members,
+                                       owner_of, pick_owner)
 from rlo_tpu.utils.metrics import Registry, hist_summary
 from rlo_tpu.wire import (SPAN_F_SAMPLED, Tag, encode_span_ctx,
                           split_span_ctx)
@@ -70,11 +73,17 @@ class Rec(enum.IntEnum):
     """Fabric record kinds, dispatched in ``DecodeFabric._on_record``.
     rlo-lint R4 requires every member to be explicitly dispatched
     there (or annotated ``rlo-lint: default-route``) — the fabric twin
-    of the engine's Tag-dispatch exhaustiveness rule."""
+    of the engine's Tag-dispatch exhaustiveness rule. The remediation
+    kinds (5..8) pin the same values as ``observe.remedy`` — that
+    module owns the vocabulary but must not import the fabric."""
     ADMIT = 1   # gateway accepted a request: rid, owner, budget, prompt
     DONE = 2    # owner finished a request: rid, decoder, tokens
     PLACE = 3   # slot-ownership record (IAR payload; also re-floodable)
     LOAD = 4    # Tag.SERVE gossip: (free_slots, queue_depth)
+    QUARANTINE = 5    # stop routing work to a rank (IAR-decided)
+    UNQUARANTINE = 6  # lift a quarantine (IAR-decided)
+    BACKPRESSURE = 7  # fleet AIMD admission throttle level (IAR-decided)
+    REBALANCE = 8     # force a fresh placement round (IAR-decided)
 
 
 class _FabReq:
@@ -128,6 +137,10 @@ def _enc_load(free: int, depth: int) -> bytes:
             struct.pack("<ii", free, depth))
 
 
+def _enc_remedy(rec: RemedyRecord) -> bytes:
+    return FABRIC_MAGIC + bytes([rec.kind]) + rec.encode()
+
+
 class DecodeFabric:
     """One rank's serving-fabric node: an engine endpoint plus a
     decode backend, driven by ``pump()`` from the harness/server loop
@@ -163,7 +176,13 @@ class DecodeFabric:
                  place_retry: float = 2.0,
                  done_ttl: Optional[float] = None,
                  metrics: Optional[Registry] = None,
-                 spans: Optional[SpanRecorder] = None):
+                 spans: Optional[SpanRecorder] = None,
+                 bp_base: float = 0.5,
+                 bp_window: float = 25.0,
+                 remedy_min_alive: Optional[int] = None,
+                 remedy_blast_frac: float = 0.25,
+                 avoid_lag: int = 4,
+                 avoid_stale: float = 10.0):
         self.engine = engine
         self.backend = backend
         self.rank = engine.rank
@@ -223,6 +242,54 @@ class DecodeFabric:
         self._next_place = float("-inf")
         self._my_place_pid = FABRIC_PID_BASE + self.rank
         self._proposed: Optional[Placement] = None
+
+        # --- remediation state (docs/DESIGN.md §22) ---------------
+        # the remedy pid window sits 1<<10 above the placement window;
+        # a fleet wider than that would alias the two
+        assert engine.world_size <= REMEDY_PID_BASE - FABRIC_PID_BASE
+        self._my_remedy_pid = REMEDY_PID_BASE + self.rank
+        self._proposed_remedy: Optional[RemedyRecord] = None
+        #: the fleet-AGREED quarantine set (IAR-decided records only —
+        #: identical at every member modulo propagation)
+        self.quarantined: set = set()
+        self._quar_ver: Dict[int, Tuple[int, int]] = {}
+        #: latest record per target (either quarantine kind), for the
+        #: view-growth re-broadcast — a restarted victim must learn
+        #: its OWN quarantine from the survivors
+        self._quar_recs: Dict[int, RemedyRecord] = {}
+        #: AIMD admission backpressure: level L throttles local admits
+        #: to one per ``bp_base * 2**(L-1)`` engine-clock seconds
+        #: (multiplicative decrease); one level decays per clean
+        #: ``bp_window`` (additive recovery)
+        self.bp_level = 0
+        self.bp_base = bp_base
+        self.bp_window = bp_window
+        self._bp_ver: Optional[Tuple[int, int]] = None
+        self._bp_rec: Optional[RemedyRecord] = None
+        self._bp_next_decay = float("inf")
+        self._next_admit = float("-inf")
+        self._admit_queue: deque = deque()
+        self._rebal_ver: Optional[Tuple[int, int]] = None
+        self._rebal_pending = False
+        self._remedy_ver_max = 0
+        #: judge invariants: never quarantine below this many live
+        #: non-quarantined members (default = majority of the STATIC
+        #: world — a partitioned minority can never satisfy it), never
+        #: quarantine more than this fraction of the current group
+        self.remedy_min_alive = (max(2, engine.world_size // 2 + 1)
+                                 if remedy_min_alive is None
+                                 else remedy_min_alive)
+        self.remedy_blast_frac = remedy_blast_frac
+        #: advisory fail-over filter thresholds (FleetView epoch lag /
+        #: digest staleness — see placement.owner_of)
+        self.avoid_lag = avoid_lag
+        self.avoid_stale = avoid_stale
+        #: execution audit: (vtime, kind name, target/level,
+        #: group size, quarantine size after) — what the scenario
+        #: property checks read
+        self.remedy_log: List[Tuple] = []
+        #: attached RemedyPolicy (observe/remedy.py), stepped by pump
+        self.remedy = None
         #: attached telemetry plane (rlo_tpu/observe/, docs/DESIGN.md
         #: §17): pump() feeds it Tag.TELEM pickups and ticks it
         self.telemetry = None
@@ -242,13 +309,33 @@ class DecodeFabric:
     def submit(self, prompt: Sequence[int], max_new: int,
                eos_id: Optional[int] = None) -> Rid:
         """Accept a request at this gateway: assign the rid, pick the
-        admit-time owner from the load view, apply locally, and
-        rootlessly broadcast the ADMIT record to the fleet."""
+        admit-time owner from the load view (healthy members only —
+        a quarantined rank is never handed new work), apply locally,
+        and rootlessly broadcast the ADMIT record to the fleet.
+
+        Under admission backpressure (``bp_level`` > 0, an IAR-decided
+        BACKPRESSURE record) the rid is assigned immediately but the
+        admit is queued and drained by ``pump()`` at the throttled
+        rate — ``result(rid)`` simply stays None a little longer."""
         rid: Rid = (self.rank, self._next_seq)
         self._next_seq += 1
-        owner = pick_owner(self.rank, self.placement.members,
-                           self._loads)
         eos = -1 if eos_id is None else int(eos_id)
+        if self.bp_level > 0 or self._admit_queue:
+            self._admit_queue.append(
+                (rid, int(max_new), eos,
+                 tuple(int(t) for t in prompt)))
+            self.metrics.counter("fabric.admits_throttled").inc()
+            return rid
+        self._submit_now(rid, int(max_new), eos,
+                         tuple(int(t) for t in prompt))
+        return rid
+
+    def _submit_now(self, rid: Rid, max_new: int, eos: int,
+                    prompt: Tuple[int, ...]) -> None:
+        owner = pick_owner(
+            self.rank,
+            healthy_members(self.placement.members, self.quarantined),
+            self._loads)
         ctx = b""
         tup = None
         if self.spans is not None:
@@ -259,11 +346,9 @@ class DecodeFabric:
                    rid[1] & 0x7FFFFFFF, t0)
             ctx = encode_span_ctx(rid[0], rid[1], Stage.ADMIT_BCAST,
                                   t0, tup[0])
-        self._apply_admit(rid, owner, int(max_new), eos,
-                          tuple(int(t) for t in prompt), tup)
-        self.engine.bcast(_enc_admit(rid, owner, int(max_new), eos,
+        self._apply_admit(rid, owner, max_new, eos, prompt, tup)
+        self.engine.bcast(_enc_admit(rid, owner, max_new, eos,
                                      prompt, ctx))
-        return rid
 
     def result(self, rid: Rid) -> Optional[Tuple[int, ...]]:
         """Completed tokens for ``rid``, or None while pending (or
@@ -279,8 +364,14 @@ class DecodeFabric:
     # ------------------------------------------------------------------
     def _judge(self, payload: bytes, ctx) -> int:
         if payload.startswith(FABRIC_MAGIC):
-            if len(payload) <= len(FABRIC_MAGIC) or \
-                    payload[len(FABRIC_MAGIC)] != Rec.PLACE:
+            if len(payload) <= len(FABRIC_MAGIC):
+                return 0
+            kind = payload[len(FABRIC_MAGIC)]
+            if kind in REMEDY_KINDS:
+                rec = RemedyRecord.decode(kind, payload,
+                                          len(FABRIC_MAGIC) + 1)
+                return 0 if rec is None else self._judge_remedy(rec)
+            if kind != Rec.PLACE:
                 return 0
             place = Placement.decode(payload, len(FABRIC_MAGIC) + 1)
             if place is None:
@@ -296,8 +387,55 @@ class DecodeFabric:
             return 1
         return prev_judge(payload, self._prev_app[2])
 
+    def _judge_remedy(self, rec: RemedyRecord) -> int:
+        """One rank's vote on a remediation record — the SAME
+        predicate serves relay judgment and the proposer's pre-flight
+        (docs/DESIGN.md §22). Vetoes protect two invariants:
+
+          - membership coherence: a quarantine target I do not see as
+            a member is an action my view contradicts (a mid-flap
+            target retries after it rejoins; an un-quarantine of a
+            dead rank would just re-arm the flap);
+          - blast radius: quarantining may never leave fewer than
+            ``remedy_min_alive`` live non-quarantined members (the
+            min-alive quorum defaults to a STATIC-world majority, so
+            a partitioned minority can never pass it — at most one
+            side of a split can ever decide an action) and may never
+            cover more than ``remedy_blast_frac`` of the group.
+        """
+        group = set(self.engine.group)
+        if rec.kind == Rec.QUARANTINE:
+            if rec.target not in group:
+                return 0
+            q_after = (self.quarantined | {rec.target}) & group
+            if len(group - q_after) < self.remedy_min_alive:
+                return 0
+            cap = max(1, int(self.remedy_blast_frac * len(group)))
+            if rec.target not in self.quarantined and \
+                    len(q_after) > cap:
+                return 0
+            return 1
+        if rec.kind == Rec.UNQUARANTINE:
+            # lifting is only gated on liveness: un-quarantining a
+            # rank nobody routes to anyway is harmless, but lifting a
+            # DEAD rank's quarantine re-arms the flap
+            return 1 if rec.target in group else 0
+        if rec.kind == Rec.BACKPRESSURE:
+            return 1 if 0 <= rec.level <= 16 else 0
+        if rec.kind == Rec.REBALANCE:
+            return 1
+        return 0
+
     def _action(self, payload: bytes, ctx):
         if payload.startswith(FABRIC_MAGIC):
+            kind = (payload[len(FABRIC_MAGIC)]
+                    if len(payload) > len(FABRIC_MAGIC) else -1)
+            if kind in REMEDY_KINDS:
+                rec = RemedyRecord.decode(kind, payload,
+                                          len(FABRIC_MAGIC) + 1)
+                if rec is not None:
+                    self._apply_remedy(rec)
+                return None
             place = Placement.decode(payload, len(FABRIC_MAGIC) + 1)
             if place is not None:
                 _, span = split_span_ctx(payload,
@@ -319,6 +457,7 @@ class DecodeFabric:
         if place.key() <= self.placement.key():
             return
         self.placement = place
+        self._rebal_pending = False  # a fresh record satisfies it
         self.metrics.counter("fabric.placements_adopted").inc()
         self.metrics.gauge("fabric.placement_version").set(
             place.version)
@@ -346,6 +485,102 @@ class DecodeFabric:
                                     pid=self._my_place_pid)
 
     # ------------------------------------------------------------------
+    # IAR face: remediation rounds (docs/DESIGN.md §22)
+    # ------------------------------------------------------------------
+    def next_remedy_version(self) -> int:
+        """A version strictly above every remedy record this rank has
+        seen (and at least the membership epoch): record ordering is
+        newest-wins by (version, proposer), so proposals must outrank
+        the state they intend to replace."""
+        return max(self.engine.epoch, self._remedy_ver_max + 1)
+
+    def propose_remedy(self, rec: RemedyRecord) -> bool:
+        """Submit one remediation record through IAR. False when the
+        engine's single proposal slot is busy (a placement or earlier
+        remedy round in flight) — the policy retries next pump."""
+        if self.engine.my_own_proposal.state == ReqState.IN_PROGRESS \
+                or self._proposed is not None \
+                or self._proposed_remedy is not None:
+            return False
+        self._proposed_remedy = rec
+        self.metrics.counter("fabric.remedies_proposed").inc()
+        self.engine.submit_proposal(_enc_remedy(rec),
+                                    pid=self._my_remedy_pid)
+        return True
+
+    def _apply_remedy(self, rec: RemedyRecord) -> None:
+        """Execute one DECIDED remediation record — idempotent and
+        newest-wins per key-space (per-target for the quarantine
+        kinds, fleet-wide for backpressure/rebalance), so decision
+        fan-out, heal re-broadcasts and replays all converge to the
+        same state in any order."""
+        now = self.clock()
+        if rec.version > self._remedy_ver_max:
+            self._remedy_ver_max = rec.version
+        if rec.kind in (Rec.QUARANTINE, Rec.UNQUARANTINE):
+            cur = self._quar_ver.get(rec.target)
+            if cur is not None and rec.key() <= cur:
+                return
+            self._quar_ver[rec.target] = rec.key()
+            self._quar_recs[rec.target] = rec
+            if rec.kind == Rec.QUARANTINE:
+                self.quarantined.add(rec.target)
+            else:
+                self.quarantined.discard(rec.target)
+            self.metrics.gauge("fabric.quarantined").set(
+                len(self.quarantined))
+        elif rec.kind == Rec.BACKPRESSURE:
+            if self._bp_ver is not None and rec.key() <= self._bp_ver:
+                return
+            self._bp_ver = rec.key()
+            self._bp_rec = rec
+            self.bp_level = max(0, int(rec.level))
+            self._bp_next_decay = (now + self.bp_window
+                                   if self.bp_level else float("inf"))
+            self.metrics.gauge("fabric.backpressure_level").set(
+                self.bp_level)
+        elif rec.kind == Rec.REBALANCE:
+            if self._rebal_ver is not None and \
+                    rec.key() <= self._rebal_ver:
+                return
+            self._rebal_ver = rec.key()
+            self._rebal_pending = True
+            self._next_place = float("-inf")
+        else:
+            return  # unknown remedy kind: forward-compat no-op
+        self.metrics.counter("fabric.remedies_executed").inc()
+        self.remedy_log.append(
+            (now, Rec(rec.kind).name, rec.target, rec.level,
+             len(self.engine.group), len(self.quarantined)))
+
+    def _advisory_avoid(self) -> Tuple[int, ...]:
+        """This rank's ADVISORY fail-over filter: members whose
+        telemetry shows them badly behind (membership epoch lag over
+        ``avoid_lag``) or silent (last digest older than
+        ``avoid_stale``) — laggards that would sit on re-queued
+        orphans. Advisory means per-rank and divergence-tolerant: the
+        no-wedge fallbacks live in ``placement.owner_of``, and a rank
+        NEVER avoids itself (the winner over the agreed set must
+        always claim the work — that asymmetry is what bounds
+        divergence cost at a duplicate decode)."""
+        plane = self.telemetry
+        if plane is None:
+            return ()
+        now = self.clock()
+        my_epoch = self.engine.epoch
+        out = []
+        for r in self.placement.members:
+            if r == self.rank:
+                continue
+            ent = plane.view.entries.get(r)
+            if ent is None or ent.applied_seq < 0:
+                continue  # never reported: no evidence either way
+            if my_epoch - ent.epoch > self.avoid_lag or \
+                    now - ent.updated > self.avoid_stale:
+                out.append(r)
+        return tuple(out)
+
+    # ------------------------------------------------------------------
     # the pump (the fabric's progress turn)
     # ------------------------------------------------------------------
     def offer_record(self, m: UserMsg) -> bool:
@@ -360,11 +595,13 @@ class DecodeFabric:
             self._on_record(m.data, m.origin)
             return True
         if m.type in (int(Tag.IAR_DECISION), int(Tag.ABORT)) and \
-                FABRIC_PID_BASE <= m.pid < \
-                FABRIC_PID_BASE + self.engine.world_size:
-            # placement-round outcome: _action already adopted the
-            # decision (an abort just frees the pid for the retry
-            # the staleness check in pump schedules)
+                (FABRIC_PID_BASE <= m.pid <
+                 FABRIC_PID_BASE + self.engine.world_size or
+                 REMEDY_PID_BASE <= m.pid <
+                 REMEDY_PID_BASE + self.engine.world_size):
+            # placement/remedy-round outcome: _action already applied
+            # the decision (an abort just frees the pid for the retry
+            # the staleness check / remedy policy schedules)
             return True
         return False
 
@@ -400,6 +637,18 @@ class DecodeFabric:
                 self._adopt_place(self._proposed, self._proposed_ctx)
             self._proposed = None  # declined/failed: retried below
             self._proposed_ctx = None
+        if self._proposed_remedy is not None and \
+                p.pid == self._my_remedy_pid and \
+                p.state != ReqState.IN_PROGRESS:
+            # proposer-side remedy adoption (action_cb fires on
+            # relays only, like placement); declined/aborted rounds
+            # go back to the policy, which retries or drops the want
+            rec, self._proposed_remedy = self._proposed_remedy, None
+            decided = bool(p.state == ReqState.COMPLETED and p.vote)
+            if decided:
+                self._apply_remedy(rec)
+            if self.remedy is not None:
+                self.remedy.on_outcome(rec, decided)
 
         now = self.clock()
         view = tuple(sorted(eng.group))
@@ -412,7 +661,8 @@ class DecodeFabric:
                 # every duplicate (docs/DESIGN.md §11 exactly-once)
                 self._rebroadcast()
         if set(self.placement.members) != set(view) or \
-                self.placement.version < eng.epoch:
+                self.placement.version < eng.epoch or \
+                self._rebal_pending:
             # the agreed routing record trails the membership view —
             # wrong members, or decided before the latest view change
             # (the version-vs-epoch check is what re-converges a
@@ -424,7 +674,24 @@ class DecodeFabric:
             if self.rank == min(view) and now >= self._next_place \
                     and p.state != ReqState.IN_PROGRESS:
                 self._next_place = now + self.place_retry
+                self._rebal_pending = False
                 self._propose_place(view)
+
+        # AIMD backpressure: additive recovery (one level per clean
+        # window) and the throttled drain of deferred admissions
+        if self.bp_level > 0 and now >= self._bp_next_decay:
+            self.bp_level -= 1
+            self._bp_next_decay = (now + self.bp_window
+                                   if self.bp_level else float("inf"))
+            self.metrics.gauge("fabric.backpressure_level").set(
+                self.bp_level)
+        while self._admit_queue:
+            if self.bp_level > 0 and now < self._next_admit:
+                break
+            if self.bp_level > 0:
+                self._next_admit = now + self.bp_base * \
+                    (2 ** (self.bp_level - 1))
+            self._submit_now(*self._admit_queue.popleft())
 
         self._reconcile()
 
@@ -456,6 +723,10 @@ class DecodeFabric:
         self.metrics.gauge("fabric.pending").set(len(self.requests))
         if self.telemetry is not None:
             self.telemetry.tick()
+        if self.remedy is not None:
+            # after tick(): the policy reads the watchdog trips this
+            # very pump produced, so trip -> proposal is one turn
+            self.remedy.step()
         return unhandled
 
     def _observe_dequeues(self, now: float,
@@ -530,6 +801,24 @@ class DecodeFabric:
         elif kind == Rec.LOAD:
             if len(body) >= 8:
                 self._loads[origin] = struct.unpack_from("<ii", body)
+        elif kind == Rec.QUARANTINE:
+            # an in-band remedy record (heal re-broadcast): execution
+            # is newest-wins idempotent, same as the decision path
+            rec = RemedyRecord.decode(kind, body)
+            if rec is not None:
+                self._apply_remedy(rec)
+        elif kind == Rec.UNQUARANTINE:
+            rec = RemedyRecord.decode(kind, body)
+            if rec is not None:
+                self._apply_remedy(rec)
+        elif kind == Rec.BACKPRESSURE:
+            rec = RemedyRecord.decode(kind, body)
+            if rec is not None:
+                self._apply_remedy(rec)
+        elif kind == Rec.REBALANCE:
+            rec = RemedyRecord.decode(kind, body)
+            if rec is not None:
+                self._apply_remedy(rec)
         else:
             self.metrics.counter("fabric.unknown_records").inc()
 
@@ -650,9 +939,17 @@ class DecodeFabric:
         """Align my backend with the agreed placement: enqueue every
         pending request the current record says is mine (counting the
         ones I picked up from a departed owner — the re-queue), and
-        withdraw the ones whose ownership moved away."""
+        withdraw the ones whose ownership moved away. Ownership is
+        health-aware (docs/DESIGN.md §22): the agreed quarantine set
+        filters candidates everywhere identically, and this rank's
+        advisory FleetView filter steers fail-over away from laggards
+        (never from itself — see placement.owner_of for why that
+        asymmetry cannot wedge)."""
+        avoid = self._advisory_avoid()
         for rid, req in self.requests.items():
-            owner = owner_of(rid, req.owner, self.placement)
+            owner = owner_of(rid, req.owner, self.placement,
+                             quarantined=self.quarantined,
+                             avoid=avoid)
             if owner == self.rank:
                 if rid not in self._local:
                     if req.owner != self.rank:
@@ -692,6 +989,14 @@ class DecodeFabric:
                 continue  # aged out of the completion cache (done_ttl)
             self.engine.bcast(_enc_done(rid, self.done_by.get(rid, -1),
                                         toks))
+        # remediation catch-up: a restarted victim rebuilds with an
+        # empty remedy state and must learn its OWN quarantine (and
+        # the fleet's backpressure level) from the survivors; newest-
+        # wins keys make every copy idempotent
+        for target in sorted(self._quar_recs):
+            self.engine.bcast(_enc_remedy(self._quar_recs[target]))
+        if self._bp_rec is not None:
+            self.engine.bcast(_enc_remedy(self._bp_rec))
 
     # ------------------------------------------------------------------
     # telemetry
@@ -729,6 +1034,12 @@ class DecodeFabric:
         out["ttft_p99_usec"] = int(ttft.p99() or 0)
         out["e2e_p50_usec"] = int(e2e.p50() or 0)
         out["e2e_p99_usec"] = int(e2e.p99() or 0)
+        out["remedies_proposed"] = \
+            self.metrics.counter("fabric.remedies_proposed").value
+        out["remedies_executed"] = \
+            self.metrics.counter("fabric.remedies_executed").value
+        out["quarantined"] = len(self.quarantined)
+        out["backpressure_level"] = self.bp_level
         return out
 
     def stats(self) -> dict:
@@ -745,6 +1056,14 @@ class DecodeFabric:
         snap["completions"] = len(self.completions)
         snap["requeues"] = self.requeues
         snap["dup_done"] = self.dup_done
+        snap["remedy"] = {
+            "quarantined": sorted(self.quarantined),
+            "backpressure_level": self.bp_level,
+            "admit_queue": len(self._admit_queue),
+            "log": list(self.remedy_log),
+            "policy": (None if self.remedy is None
+                       else self.remedy.stats()),
+        }
         snap["backend"] = self.backend.stats()
         return snap
 

@@ -79,15 +79,50 @@ def rendezvous_owner(gateway: int, seq: int,
     return best
 
 
+def healthy_members(members: Sequence[int],
+                    quarantined: Sequence[int] = ()
+                    ) -> Tuple[int, ...]:
+    """Members minus the fleet-agreed quarantine set. Never empty:
+    when quarantine would exclude everyone, the full member set wins —
+    serving degraded beats not serving at all (and the blast-radius
+    judges make this branch unreachable in a healthy fleet)."""
+    if not quarantined:
+        return tuple(members)
+    out = tuple(m for m in members if m not in set(quarantined))
+    return out if out else tuple(members)
+
+
 def owner_of(rid: Tuple[int, int], admit_owner: int,
-             placement: Placement) -> int:
+             placement: Placement,
+             quarantined: Sequence[int] = (),
+             avoid: Sequence[int] = ()) -> int:
     """Current owner of a request: the admit-time owner while it is
-    still a placement member (the record is authoritative — ownership
-    does not churn under load changes), else the rendezvous
-    re-placement over the current members (the fail-over rule)."""
-    if admit_owner in placement.members:
+    still a HEALTHY placement member (the record is authoritative —
+    ownership does not churn under load changes), else the rendezvous
+    re-placement over the current healthy members (the fail-over
+    rule).
+
+    ``quarantined`` is the fleet-AGREED quarantine set (an IAR-decided
+    record — identical at every rank, so filtering by it preserves the
+    all-ranks-agree property). ``avoid`` is this rank's ADVISORY
+    health filter (FleetView epoch-lag / digest staleness — per-rank,
+    possibly divergent). Advisory filtering must never wedge the
+    fleet, so two fallbacks apply: a rank never avoids itself out of
+    the candidate set's perspective (callers strip self from ``avoid``
+    — see fabric._advisory_avoid), and when avoidance would empty the
+    candidate set it is ignored entirely. Divergent ``avoid`` views
+    cost at most a duplicate decode (rid-level dedup absorbs it),
+    never a dropped request: HRW weights are per-member, so the winner
+    over the agreed set still claims the work even if others skip it.
+    """
+    healthy = healthy_members(placement.members, quarantined)
+    if admit_owner in healthy and admit_owner not in set(avoid):
         return admit_owner
-    return rendezvous_owner(rid[0], rid[1], placement.members)
+    if admit_owner in healthy and not \
+            [m for m in healthy if m not in set(avoid)]:
+        return admit_owner  # avoidance would empty the set: ignore it
+    cands = [m for m in healthy if m not in set(avoid)] or list(healthy)
+    return rendezvous_owner(rid[0], rid[1], cands)
 
 
 def pick_owner(self_rank: int, members: Sequence[int],

@@ -68,7 +68,16 @@ class FabricScenario:
                  page_size: int = 8,
                  prefix_pool: Optional[Sequence[Sequence[int]]] = None,
                  weather=None, scheduler: str = "heap",
-                 trace_sample: Optional[int] = None):
+                 trace_sample: Optional[int] = None,
+                 telemetry: bool = False,
+                 telemetry_interval: float = 1.0,
+                 watchdog_rules: Optional[Sequence] = None,
+                 watchdog_cooldown: float = 60.0,
+                 remedy: bool = False,
+                 remedy_kw: Optional[dict] = None,
+                 expect_quarantine: Optional[int] = None,
+                 expect_backpressure: bool = False,
+                 expect_recovered: bool = True):
         self.ws = world_size
         self.seed = seed
         self.duration = duration
@@ -106,6 +115,23 @@ class FabricScenario:
         # None (the default) runs the zero-cost disabled path.
         self.trace_sample = trace_sample
         self.tracer: Optional[Tracer] = None
+        # remediation control plane (docs/DESIGN.md §22): telemetry
+        # arms a per-rank TelemetryPlane + Watchdog (bundles off —
+        # trips are data here, not artifacts); remedy additionally
+        # attaches a RemedyPolicy per rank, so watchdog trips become
+        # IAR-decided QUARANTINE/BACKPRESSURE/REBALANCE records, and
+        # the end-of-run checks assert the §22 invariants (quarantine
+        # agreement, min-alive floor, blast cap, recovery)
+        self.telemetry = telemetry or remedy
+        self.telemetry_interval = telemetry_interval
+        self.watchdog_rules = (None if watchdog_rules is None
+                               else list(watchdog_rules))
+        self.watchdog_cooldown = watchdog_cooldown
+        self.remedy = remedy
+        self.remedy_kw = dict(remedy_kw or {})
+        self.expect_quarantine = expect_quarantine
+        self.expect_backpressure = expect_backpressure
+        self.expect_recovered = expect_recovered
 
     def _replay_recipe(self) -> str:
         # every non-default knob is printed: a recipe that silently
@@ -125,7 +151,17 @@ class FabricScenario:
                 ("prefix_pool", self.prefix_pool, None),
                 ("weather", self.weather, None),
                 ("scheduler", self.scheduler, "heap"),
-                ("trace_sample", self.trace_sample, None)):
+                ("trace_sample", self.trace_sample, None),
+                ("telemetry", self.telemetry, False),
+                ("telemetry_interval", self.telemetry_interval, 1.0),
+                ("watchdog_rules", self.watchdog_rules, None),
+                ("watchdog_cooldown", self.watchdog_cooldown, 60.0),
+                ("remedy", self.remedy, False),
+                ("remedy_kw", self.remedy_kw, {}),
+                ("expect_quarantine", self.expect_quarantine, None),
+                ("expect_backpressure", self.expect_backpressure,
+                 False),
+                ("expect_recovered", self.expect_recovered, True)):
             if val != default:
                 extra += f", {name}={val!r}"
         return (f"FabricScenario(world_size={self.ws}, "
@@ -176,10 +212,31 @@ class FabricScenario:
                 for r in range(self.ws)]
 
         def make_fabric(r: int) -> DecodeFabric:
-            return DecodeFabric(
+            fab = DecodeFabric(
                 engines[r], make_backend(),
                 decode_interval=self.decode_interval,
                 spans=recorders[r])
+            if self.telemetry:
+                # per-rank observe stack, rebuilt with the fabric on
+                # restart (a fresh life has a fresh view — §17);
+                # incident_dir="" keeps N watchdogs from racing over
+                # one bundle directory (trips are data here)
+                from rlo_tpu.observe import (DEFAULT_RULES,
+                                             RemedyPolicy,
+                                             TelemetryPlane, Watchdog)
+                plane = TelemetryPlane(
+                    engines[r], interval=self.telemetry_interval)
+                fab.attach_telemetry(plane)
+                wd = Watchdog(
+                    plane,
+                    (DEFAULT_RULES if self.watchdog_rules is None
+                     else self.watchdog_rules),
+                    incident_dir="",
+                    cooldown=self.watchdog_cooldown,
+                    replay=self._replay_recipe)
+                if self.remedy:
+                    RemedyPolicy(fab, wd, **self.remedy_kw)
+            return fab
 
         fabrics: List[DecodeFabric] = [make_fabric(r)
                                        for r in range(self.ws)]
@@ -310,6 +367,8 @@ class FabricScenario:
                 if pl != first or pl[1] != want_members:
                     self._fail(f"placement diverged: {places} "
                                f"(live {want_members})")
+        if self.remedy:
+            self._check_remedy(live_fabrics, ends_healed)
         return {
             "seed": self.seed,
             "digest": world.schedule_digest(),
@@ -328,7 +387,112 @@ class FabricScenario:
             "placement_version": max(
                 (f.placement.version for f in live_fabrics),
                 default=-1),
+            # NOTE: remedy evidence lives under "remedy", never under
+            # an "incidents" key — fuzz_sweep treats res["incidents"]
+            # as an unexpected-trip failure, and remedy runs TRIP by
+            # design
+            "remedy": (None if not self.remedy else {
+                "decided": sum(f.remedy.decided for f in live_fabrics
+                               if f.remedy is not None),
+                "proposed": sum(f.remedy.proposed
+                                for f in live_fabrics
+                                if f.remedy is not None),
+                "rejected": sum(f.remedy.rejected
+                                for f in live_fabrics
+                                if f.remedy is not None),
+                "trips": sum(
+                    len(f.telemetry.watchdog.incidents)
+                    for f in live_fabrics
+                    if f.telemetry is not None and
+                    f.telemetry.watchdog is not None),
+                "final_quarantined": sorted(
+                    set().union(*(f.quarantined
+                                  for f in live_fabrics))
+                    if live_fabrics else set()),
+                "bp_final": max((f.bp_level for f in live_fabrics),
+                                default=0),
+                "logs": {f.rank: list(f.remedy_log)
+                         for f in live_fabrics},
+                # the proposer's decision log — what the seed-replay
+                # test pins alongside the schedule digest
+                "decision_log": (live_fabrics[0].remedy.log
+                                 if live_fabrics and
+                                 live_fabrics[0].remedy is not None
+                                 else []),
+            }),
         }
+
+    def _check_remedy(self, live_fabrics, ends_healed: bool) -> None:
+        """The §22 remediation invariants, property-checked on every
+        remedy-armed run (SimViolation + replay recipe on failure —
+        same contract as the §11 fabric properties)."""
+        for f in live_fabrics:
+            for entry in f.remedy_log:
+                _, name, target, _, group_size, quar_after = entry
+                if name not in ("QUARANTINE", "UNQUARANTINE"):
+                    continue
+                if group_size - quar_after < f.remedy_min_alive:
+                    self._fail(
+                        f"rank {f.rank} executed {name} of {target} "
+                        f"leaving {group_size - quar_after} live "
+                        f"non-quarantined members — below the "
+                        f"min-alive quorum {f.remedy_min_alive} "
+                        f"({entry})")
+                cap = max(1, int(f.remedy_blast_frac * group_size))
+                if name == "QUARANTINE" and quar_after > cap:
+                    self._fail(
+                        f"rank {f.rank} executed {name} of {target} "
+                        f"breaching the blast-radius cap {cap} "
+                        f"({entry})")
+        if not ends_healed:
+            return
+        # no dual-act: the agreed quarantine state is identical at
+        # every live member once the run ends healed
+        quar_sets = {f.rank: tuple(sorted(f.quarantined))
+                     for f in live_fabrics}
+        if len(set(quar_sets.values())) > 1:
+            self._fail(f"quarantine state diverged across the fleet: "
+                       f"{quar_sets}")
+        all_logs = [e for f in live_fabrics for e in f.remedy_log]
+        if self.expect_quarantine is not None:
+            hits = [e for e in all_logs
+                    if e[1] == "QUARANTINE" and
+                    e[2] == self.expect_quarantine]
+            if not hits:
+                self._fail(
+                    f"expected rank {self.expect_quarantine} to be "
+                    f"quarantined; remedy logs: "
+                    f"{sorted(set((e[1], e[2]) for e in all_logs))}")
+            decided = sum(f.remedy.decided for f in live_fabrics
+                          if f.remedy is not None)
+            if decided < 1:
+                self._fail("quarantine executed without any "
+                           "IAR-decided remedy round")
+        if self.expect_backpressure:
+            hits = [e for e in all_logs
+                    if e[1] == "BACKPRESSURE" and e[3] >= 1]
+            if not hits:
+                self._fail(
+                    f"expected an IAR-decided BACKPRESSURE level >= "
+                    f"1; remedy logs: "
+                    f"{sorted(set((e[1], e[3]) for e in all_logs))}")
+        if self.expect_recovered:
+            for f in live_fabrics:
+                if f.quarantined:
+                    self._fail(
+                        f"rank {f.rank} still quarantines "
+                        f"{sorted(f.quarantined)} at end of run — "
+                        f"the un-quarantine hysteresis never lifted "
+                        f"it after the fault cleared")
+                if f.bp_level != 0:
+                    self._fail(
+                        f"rank {f.rank} admission backpressure never "
+                        f"recovered (level {f.bp_level} at end)")
+                if f._admit_queue:
+                    self._fail(
+                        f"rank {f.rank} still holds "
+                        f"{len(f._admit_queue)} throttled admits at "
+                        f"end of run")
 
 
 def make_fabric_scenario(kind: str, seed: int,
@@ -429,6 +593,94 @@ def make_fabric_scenario(kind: str, seed: int,
         return FabricScenario(world_size=ws, seed=seed, script=script,
                               duration=240.0, decode_interval=0.5,
                               weather=weather)
+    if kind == "remedy_flap":
+        # the remediation loop end-to-end (docs/DESIGN.md §22): rank
+        # ws-1 flaps (the kill + restart stamps a restarted
+        # incarnation into every fleet view), then a sustained loss
+        # window turns the fabric's reliable traffic into a genuine
+        # retransmit storm. A dead rank alone cannot trip the DEFAULT
+        # storm rule — ARQ to a failed member stops at the 6s
+        # declaration and the view-change forgiveness resets the rate
+        # window — so the trip lands mid-loss with the flapper
+        # identifiable, and the policy maps it to QUARANTINE. A
+        # post-cooldown re-trip finds the flapper already quarantined
+        # and falls back to BACKPRESSURE. The run must then recover:
+        # drain exactly-once, un-quarantine after the clearing
+        # window, decay backpressure to zero.
+        victim = ws - 1
+        gw = rng.randrange(ws - 1)  # never the victim
+        script = (
+            [(2.0 + 1.5 * i, "submit", rng.randrange(ws - 1), 2)
+             for i in range(4)] +
+            [(8.0, "kill", victim),
+             (14.0, "submit", gw, 2),
+             (16.0, "restart", victim),
+             (24.0, "loss", 0.2),
+             (26.0, "submit", gw, 3),
+             (32.0, "submit", rng.randrange(ws - 1), 2),
+             (38.0, "submit", gw, 2),
+             (48.0, "loss", 0.0),
+             (70.0, "submit", rng.randrange(ws - 1), 2),
+             (120.0, "submit", gw, 2)])
+        return FabricScenario(world_size=ws, seed=seed, script=script,
+                              duration=190.0, decode_interval=0.5,
+                              remedy=True, watchdog_cooldown=15.0,
+                              expect_quarantine=victim)
+    if kind == "remedy_hotspot":
+        # a fleet-wide hot spell, no bad actor: 25% loss turns every
+        # link into a retransmit storm with NO restarted incarnation
+        # in sight, so the honest action is AIMD admission
+        # backpressure, not a quarantine. Steady client load keeps
+        # admissions flowing through the throttle; once the loss
+        # clears the additive recovery must walk the level back to
+        # zero and drain the deferred admits.
+        script = (
+            [(2.0 + 3.0 * i, "submit", rng.randrange(ws), 2)
+             for i in range(5)] +
+            [(15.0, "loss", 0.25)] +
+            [(20.0 + 4.0 * i, "submit", rng.randrange(ws), 2)
+             for i in range(5)] +
+            [(40.0, "loss", 0.0),
+             (55.0, "submit", rng.randrange(ws), 2),
+             (70.0, "submit", rng.randrange(ws), 2)])
+        return FabricScenario(world_size=ws, seed=seed, script=script,
+                              duration=170.0, decode_interval=0.5,
+                              remedy=True, watchdog_cooldown=15.0,
+                              expect_backpressure=True)
+    if kind == "remedy_split":
+        # the no-dual-act property under a partition: rank ws-2 flaps
+        # (becoming the identifiable quarantine candidate), then the
+        # fleet splits majority/minority and a loss window storms the
+        # majority's links. BOTH sides' watchdogs may trip; neither
+        # may act — the minority cannot quarantine a rank outside its
+        # own membership view, and the majority's quarantine would
+        # fall below the STATIC min-alive quorum (max(2, ws//2+1))
+        # while the minority is out. The re-tripping storm keeps the
+        # pending want alive through the veto/retry loop; only after
+        # the heal (full membership back) can the quarantine pass the
+        # judges — exactly once, fleet-wide, both sides agreeing on
+        # the quarantine set once healed.
+        victim = ws - 2
+        cut = [[r for r in range(ws) if r != ws - 1], [ws - 1]]
+        gw = rng.randrange(ws - 2)  # never the victim or the minority
+        script = (
+            [(2.0 + 1.5 * i, "submit", rng.randrange(ws - 2), 2)
+             for i in range(3)] +
+            [(5.0, "kill", victim),
+             (12.0, "restart", victim),
+             (20.0, "partition", cut),
+             (25.0, "loss", 0.18),
+             (27.0, "submit", gw, 3),
+             (33.0, "submit", gw, 2),
+             (39.0, "submit", gw, 2),
+             (55.0, "loss", 0.0),
+             (70.0, "heal"),
+             (85.0, "submit", gw, 2),
+             (140.0, "submit", rng.randrange(ws - 2), 2)])
+        return FabricScenario(world_size=ws, seed=seed, script=script,
+                              duration=210.0, decode_interval=0.5,
+                              remedy=True, watchdog_cooldown=15.0,
+                              expect_quarantine=victim)
     if kind == "fabric_rejoin":
         victim = 0  # see fabric_kill: the warm-up owner
         gw = 1 + rng.randrange(ws - 1)

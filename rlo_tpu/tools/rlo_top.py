@@ -55,6 +55,14 @@ _HEAL_COLS = (
     ("badm", "batched_admits", 5),
 )
 
+#: the §22 remediation block rides the heal view too: how many ranks
+#: each member currently quarantines and its AIMD admission-throttle
+#: level — both IAR-decided, so a healthy converged fleet shows one
+#: identical value down each column
+_REMEDY_COLS = (
+    ("quar", "quarantined", 4), ("bp", "backpressure_level", 3),
+)
+
 #: the serving-latency block ``--serve`` appends: in-flight requests
 #: plus the per-rank p50/p99 TTFT and e2e latency gauges the fabric
 #: publishes through the TELEM_EXTRA_KEYS digest extras
@@ -195,9 +203,10 @@ def run_fleet(world_size: int = 8, seed: int = 0,
 def render(snap: Dict, heal: bool = False,
            serve: bool = False) -> str:
     """Text table for one FleetView snapshot. ``heal=True`` (the
-    ``--fabric`` view) appends the §18 heal-counter block;
-    ``serve=True`` appends the §19 serving-latency block."""
-    cols = _COLS + (_HEAL_COLS if heal else ()) + \
+    ``--fabric`` view) appends the §18 heal-counter block and the §22
+    remediation columns (quar/bp); ``serve=True`` appends the §19
+    serving-latency block."""
+    cols = _COLS + (_HEAL_COLS + _REMEDY_COLS if heal else ()) + \
         (_SERVE_COLS if serve else ())
     lines = [
         f"rlo-top — fleet view from rank {snap['from_rank']} "

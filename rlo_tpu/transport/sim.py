@@ -1074,7 +1074,8 @@ SCENARIO_KINDS = ("partition", "restart", "burst", "mixed",
 #: layer up front
 FABRIC_SCENARIO_KINDS = ("fabric_kill", "fabric_split",
                          "fabric_rejoin", "fabric_paged",
-                         "fabric_churn")
+                         "fabric_churn", "remedy_flap",
+                         "remedy_hotspot", "remedy_split")
 
 ALL_SCENARIO_KINDS = SCENARIO_KINDS + FABRIC_SCENARIO_KINDS
 

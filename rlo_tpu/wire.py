@@ -263,6 +263,8 @@ TELEM_EXTRA_KEYS = (
     "serve_inflight", "ttft_p50_usec", "ttft_p99_usec",
     "e2e_p50_usec", "e2e_p99_usec",
     "coll_steps", "coll_bytes",
+    "remedies_proposed", "remedies_executed",
+    "quarantined", "backpressure_level",
 )
 
 #: The full digest schema, in mask-bit order: the engine-counter

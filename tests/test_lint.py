@@ -221,6 +221,22 @@ def test_r2_fires_on_coll_key_drift(tree):
                "k_telem_keys" in f.msg for f in hits), hits
 
 
+def test_r2_fires_on_remedy_key_drift(tree):
+    """The §22 remediation counters (remedies_proposed /
+    remedies_executed / quarantined / backpressure_level) ride the
+    same schema chain as every §17 digest key: dropping one from
+    TELEM_EXTRA_KEYS must break the C codec's name table and the
+    RLO_TELEM_NKEYS pin."""
+    mutate(tree, "rlo_tpu/wire.py",
+           '"remedies_proposed", "remedies_executed",',
+           '"remedies_proposed",')
+    hits = findings_for(tree, "R2")
+    assert any(f.file == "rlo_tpu/native/rlo_core.h" and
+               "RLO_TELEM_NKEYS" in f.msg for f in hits), hits
+    assert any(f.file == "rlo_tpu/native/rlo_wire.c" and
+               "k_telem_keys" in f.msg for f in hits), hits
+
+
 def test_r2_fires_on_telem_header_drift(tree):
     """The byte-pinned digest header size is a paired constant: a
     Python-side bump without the C twin is a finding at the
@@ -301,6 +317,19 @@ def test_r4_fires_on_fabric_record_dispatch_hole(tree):
     assert any(f.file == "rlo_tpu/serving/fabric.py" and
                "Rec.LOAD" in f.msg for f in hits), hits
     assert line > 0
+
+
+def test_r4_fires_on_remedy_record_dispatch_hole(tree):
+    """The remediation record kinds (Rec 5..8, docs/DESIGN.md §22)
+    are full members of the fabric's record vocabulary: deleting a
+    _on_record arm must name the orphaned kind, or a heal
+    re-broadcast would silently drop the very record that keeps the
+    quarantine state convergent."""
+    mutate(tree, "rlo_tpu/serving/fabric.py",
+           "elif kind == Rec.QUARANTINE:", "elif False:")
+    hits = findings_for(tree, "R4")
+    assert any(f.file == "rlo_tpu/serving/fabric.py" and
+               "Rec.QUARANTINE" in f.msg for f in hits), hits
 
 
 def test_r4_fires_on_msync_subkind_hole(tree):

@@ -1,0 +1,589 @@
+"""Consensus-gated remediation (rlo_tpu/observe/remedy.py,
+docs/DESIGN.md §22): the control half of the fleet telescope.
+
+Layers under test, innermost out:
+
+  - the record vocabulary: codec roundtrip, kind-byte alignment with
+    ``fabric.Rec``, newest-wins ``(version, proposer)`` ordering;
+  - the judge predicate (``DecodeFabric._judge_remedy``): membership
+    coherence, the min-alive quorum, the blast-radius cap;
+  - execution (``DecodeFabric._apply_remedy``): idempotent,
+    newest-wins per key-space, stale records can never regress state;
+  - :class:`RemedyPolicy` hysteresis: trip -> want -> proposal on the
+    proposer only, per-action cooldown, veto retry, cause-quiet
+    expiry, un-quarantine only after a full clear window;
+  - watchdog view-change forgiveness (the false-positive fix): a
+    legitimate membership change resets the rate windows of the two
+    churn-cost counters, at most once per rule window;
+  - health-aware placement: quarantined ranks never own work;
+  - the ``remedy_*`` scenarios end to end, including the seed-replay
+    case proving two runs are schedule-digest- AND decision-identical.
+"""
+
+import logging
+from types import SimpleNamespace
+
+import pytest
+
+from rlo_tpu.observe.remedy import (DEFAULT_ACTIONS, KIND_BACKPRESSURE,
+                                    KIND_NAMES, KIND_QUARANTINE,
+                                    KIND_REBALANCE, KIND_UNQUARANTINE,
+                                    REMEDY_KINDS, REMEDY_PID_BASE,
+                                    RemedyPolicy, RemedyRecord)
+from rlo_tpu.observe.watchdog import (DEFAULT_RULES, Incident, Watchdog,
+                                      parse_rule)
+from rlo_tpu.serving.fabric import FABRIC_PID_BASE, DecodeFabric, Rec
+from rlo_tpu.serving.placement import healthy_members, pick_owner
+from rlo_tpu.serving.scenario import make_fabric_scenario
+from rlo_tpu.transport.sim import make_scenario
+
+logging.getLogger("rlo_tpu").setLevel(logging.ERROR)
+
+
+# ---------------------------------------------------------------------------
+# record vocabulary
+# ---------------------------------------------------------------------------
+
+class TestRecordCodec:
+    def test_roundtrip_every_kind(self):
+        for i, kind in enumerate(REMEDY_KINDS):
+            rec = RemedyRecord(kind=kind, target=3 - i, level=i,
+                               version=10 + i, proposer=i % 4)
+            back = RemedyRecord.decode(kind, rec.encode())
+            assert back == rec
+            assert back.name() == KIND_NAMES[kind]
+
+    def test_decode_rejects_garbage(self):
+        rec = RemedyRecord(KIND_QUARANTINE, 1, 0, 5, 0)
+        raw = rec.encode()
+        assert RemedyRecord.decode(99, raw) is None     # unknown kind
+        assert RemedyRecord.decode(KIND_QUARANTINE, raw[:-1]) is None
+        assert RemedyRecord.decode(KIND_QUARANTINE, raw, off=4) is None
+
+    def test_kind_bytes_align_with_fabric_rec(self):
+        # observe.remedy owns the vocabulary but must not import the
+        # fabric; the fabric pins the same values. Drift here would
+        # silently mis-dispatch records in fabric._on_record.
+        assert KIND_QUARANTINE == int(Rec.QUARANTINE) == 5
+        assert KIND_UNQUARANTINE == int(Rec.UNQUARANTINE) == 6
+        assert KIND_BACKPRESSURE == int(Rec.BACKPRESSURE) == 7
+        assert KIND_REBALANCE == int(Rec.REBALANCE) == 8
+        # remedy rounds ride a reserved pid window beside placement
+        assert REMEDY_PID_BASE == FABRIC_PID_BASE + 1024
+
+    def test_newest_wins_key_order(self):
+        a = RemedyRecord(KIND_QUARANTINE, 1, 0, version=4, proposer=2)
+        b = RemedyRecord(KIND_UNQUARANTINE, 1, 0, version=5, proposer=0)
+        tie = RemedyRecord(KIND_QUARANTINE, 1, 0, version=4, proposer=3)
+        assert b.key() > a.key()
+        assert tie.key() > a.key()  # proposer breaks exact version ties
+
+
+# ---------------------------------------------------------------------------
+# the judge predicate (shared by relay judgment and proposer pre-flight)
+# ---------------------------------------------------------------------------
+
+def _judge_stub(group, quarantined=(), min_alive=3, blast=0.25):
+    return SimpleNamespace(
+        engine=SimpleNamespace(group=tuple(group)),
+        quarantined=set(quarantined),
+        remedy_min_alive=min_alive,
+        remedy_blast_frac=blast)
+
+
+def _judge(stub, rec):
+    return DecodeFabric._judge_remedy(stub, rec)
+
+
+class TestJudge:
+    def test_vetoes_nonmember_target(self):
+        s = _judge_stub(group=(0, 1, 2, 3))
+        rec = RemedyRecord(KIND_QUARANTINE, 7, 0, 5, 0)
+        assert _judge(s, rec) == 0
+
+    def test_vetoes_below_min_alive_quorum(self):
+        # 4 members, one already quarantined, min-alive 3: a second
+        # quarantine would leave 2 live non-quarantined members
+        s = _judge_stub(group=(0, 1, 2, 3), quarantined=(3,))
+        assert _judge(s, RemedyRecord(KIND_QUARANTINE, 2, 0, 5, 0)) == 0
+        # the partitioned-minority shape: a 2-member side can never
+        # quarantine anyone against a STATIC-majority quorum
+        side = _judge_stub(group=(2, 3), min_alive=3)
+        assert _judge(side, RemedyRecord(KIND_QUARANTINE, 3, 0, 9, 2)) == 0
+
+    def test_vetoes_blast_radius_cap(self):
+        # 8 members, cap = int(0.25 * 8) = 2, quorum satisfied either
+        # way: the THIRD quarantine breaches the cap
+        s = _judge_stub(group=range(8), quarantined=(1, 2), min_alive=2)
+        assert _judge(s, RemedyRecord(KIND_QUARANTINE, 3, 0, 5, 0)) == 0
+        # re-quarantining an already-quarantined member is idempotent,
+        # not a new casualty — the cap does not veto it
+        assert _judge(s, RemedyRecord(KIND_QUARANTINE, 2, 0, 5, 0)) == 1
+
+    def test_quarantine_allowed_inside_budget(self):
+        s = _judge_stub(group=range(8), min_alive=2)
+        assert _judge(s, RemedyRecord(KIND_QUARANTINE, 5, 0, 5, 0)) == 1
+
+    def test_unquarantine_gated_on_liveness_only(self):
+        s = _judge_stub(group=(0, 1, 2), quarantined=(2,))
+        assert _judge(s, RemedyRecord(KIND_UNQUARANTINE, 2, 0, 6, 0)) == 1
+        # lifting a DEAD rank's quarantine re-arms the flap: veto
+        dead = _judge_stub(group=(0, 1), quarantined=(2,))
+        assert _judge(dead, RemedyRecord(KIND_UNQUARANTINE, 2, 0, 6, 0)) == 0
+
+    def test_backpressure_level_bounds(self):
+        s = _judge_stub(group=(0, 1, 2, 3))
+        assert _judge(s, RemedyRecord(KIND_BACKPRESSURE, -1, 3, 5, 0)) == 1
+        assert _judge(s, RemedyRecord(KIND_BACKPRESSURE, -1, 17, 5, 0)) == 0
+        assert _judge(s, RemedyRecord(KIND_BACKPRESSURE, -1, -2, 5, 0)) == 0
+
+    def test_rebalance_and_unknown(self):
+        s = _judge_stub(group=(0, 1, 2, 3))
+        assert _judge(s, RemedyRecord(KIND_REBALANCE, -1, 2, 5, 0)) == 1
+        assert _judge(s, RemedyRecord(99, -1, 0, 5, 0)) == 0
+
+
+# ---------------------------------------------------------------------------
+# execution: idempotent, newest-wins per key-space
+# ---------------------------------------------------------------------------
+
+class _Counter:
+    def __init__(self):
+        self.value = 0
+
+    def inc(self, n=1):
+        self.value += n
+
+    def set(self, v):
+        self.value = v
+
+
+class _Metrics:
+    def __init__(self):
+        self._m = {}
+
+    def counter(self, name):
+        return self._m.setdefault(name, _Counter())
+
+    gauge = counter
+
+
+def _apply_stub(group=(0, 1, 2, 3)):
+    return SimpleNamespace(
+        clock=lambda: 42.0,
+        engine=SimpleNamespace(group=tuple(group)),
+        quarantined=set(),
+        metrics=_Metrics(),
+        remedy_log=[],
+        bp_level=0,
+        bp_window=25.0,
+        _bp_next_decay=float("inf"),
+        _bp_ver=None, _bp_rec=None,
+        _quar_ver={}, _quar_recs={},
+        _rebal_ver=None, _rebal_pending=False,
+        _next_place=0.0,
+        _remedy_ver_max=0)
+
+
+def _apply(stub, rec):
+    DecodeFabric._apply_remedy(stub, rec)
+
+
+class TestApplyRemedy:
+    def test_stale_record_never_regresses_quarantine(self):
+        f = _apply_stub()
+        _apply(f, RemedyRecord(KIND_QUARANTINE, 2, 0, version=5,
+                               proposer=0))
+        assert f.quarantined == {2}
+        # a stale UNQUARANTINE re-flooded out of an old view: no-op
+        _apply(f, RemedyRecord(KIND_UNQUARANTINE, 2, 0, version=4,
+                               proposer=3))
+        assert f.quarantined == {2}
+        assert len(f.remedy_log) == 1  # the stale record left no trace
+        # the genuinely newer lift wins
+        _apply(f, RemedyRecord(KIND_UNQUARANTINE, 2, 0, version=6,
+                               proposer=1))
+        assert f.quarantined == set()
+        assert len(f.remedy_log) == 2
+
+    def test_quarantine_idempotent_per_target(self):
+        f = _apply_stub()
+        rec = RemedyRecord(KIND_QUARANTINE, 1, 0, 7, 2)
+        _apply(f, rec)
+        _apply(f, rec)  # decision fan-out + heal re-broadcast replay
+        assert f.quarantined == {1}
+        assert len(f.remedy_log) == 1
+
+    def test_backpressure_newest_wins_and_arms_decay(self):
+        f = _apply_stub()
+        _apply(f, RemedyRecord(KIND_BACKPRESSURE, -1, 2, 5, 0))
+        assert f.bp_level == 2 and f._bp_next_decay == 42.0 + 25.0
+        _apply(f, RemedyRecord(KIND_BACKPRESSURE, -1, 5, 4, 0))  # stale
+        assert f.bp_level == 2
+        _apply(f, RemedyRecord(KIND_BACKPRESSURE, -1, 0, 6, 0))
+        assert f.bp_level == 0 and f._bp_next_decay == float("inf")
+
+    def test_rebalance_forces_fresh_placement_round(self):
+        f = _apply_stub()
+        f._next_place = 99.0
+        _apply(f, RemedyRecord(KIND_REBALANCE, -1, 3, 5, 0))
+        assert f._rebal_pending and f._next_place == float("-inf")
+
+    def test_version_high_water_feeds_next_proposal(self):
+        f = _apply_stub()
+        _apply(f, RemedyRecord(KIND_QUARANTINE, 1, 0, version=11,
+                               proposer=0))
+        assert f._remedy_ver_max == 11
+        f.engine.epoch = 2
+        assert DecodeFabric.next_remedy_version(f) == 12
+
+
+# ---------------------------------------------------------------------------
+# RemedyPolicy hysteresis (stubbed fabric + watchdog, manual clock)
+# ---------------------------------------------------------------------------
+
+class _FakeFabric:
+    """The minimal surface RemedyPolicy touches. The judge/propose
+    hooks are recordable and rig-able so every hysteresis branch is
+    reachable without a simulator."""
+
+    def __init__(self, rank=0, group=(0, 1, 2, 3)):
+        self.rank = rank
+        self.engine = SimpleNamespace(group=tuple(group), epoch=1)
+        self.quarantined = set()
+        self.bp_level = 0
+        self.remedy = None
+        self._now = [0.0]
+        self.telemetry = SimpleNamespace(
+            view=SimpleNamespace(incarnations=lambda: dict(self.incs)))
+        self.incs = {}
+        self.judge_verdict = 1
+        self.slot_free = True
+        self.submitted = []
+        self._ver = 0
+
+    def clock(self):
+        return self._now[0]
+
+    def advance(self, dt):
+        self._now[0] += dt
+
+    def _judge_remedy(self, rec):
+        return self.judge_verdict
+
+    def propose_remedy(self, rec):
+        if not self.slot_free:
+            return False
+        self.submitted.append(rec)
+        return True
+
+    def next_remedy_version(self):
+        self._ver += 1
+        return self._ver
+
+
+def _trip(wd, name, vtime):
+    rule = next(r for r in wd.rules if r.name == name)
+    wd.incidents.append(Incident(rule=rule, value=99.0, vtime=vtime,
+                                 trip=0))
+
+
+def _policy(rank=0, **kw):
+    fab = _FakeFabric(rank=rank)
+    wd = SimpleNamespace(rules=[parse_rule(r) for r in DEFAULT_RULES],
+                         incidents=[])
+    pol = RemedyPolicy(fab, wd, **kw)
+    assert fab.remedy is pol  # construction registers itself
+    return fab, wd, pol
+
+
+class TestPolicyHysteresis:
+    def test_storm_trip_quarantines_the_flapper(self):
+        fab, wd, pol = _policy()
+        fab.incs = {1: 0, 2: 2, 3: 1}  # rank 2 flapped twice
+        _trip(wd, "retransmit-storm", 1.0)
+        pol.step()
+        assert [(r.kind, r.target) for r in fab.submitted] == \
+            [(KIND_QUARANTINE, 2)]
+
+    def test_no_flapper_falls_back_to_backpressure(self):
+        fab, wd, pol = _policy()
+        fab.incs = {r: 0 for r in range(4)}  # nobody restarted: load
+        _trip(wd, "retransmit-storm", 1.0)
+        pol.step()
+        assert [r.kind for r in fab.submitted] == [KIND_BACKPRESSURE]
+        assert fab.submitted[0].level == 1  # AIMD: one level up
+
+    def test_backlog_trip_maps_to_backpressure(self):
+        fab, wd, pol = _policy()
+        _trip(wd, "pickup-backlog-growth", 1.0)
+        pol.step()
+        assert [r.kind for r in fab.submitted] == [KIND_BACKPRESSURE]
+
+    def test_epoch_lag_trip_maps_to_rebalance(self):
+        fab, wd, pol = _policy()
+        _trip(wd, "epoch-lag-ceiling", 1.0)
+        pol.step()
+        assert [r.kind for r in fab.submitted] == [KIND_REBALANCE]
+        assert fab.submitted[0].level == fab.engine.epoch
+
+    def test_only_the_proposer_submits(self):
+        fab, wd, pol = _policy(rank=2)  # lowest non-quarantined is 0
+        _trip(wd, "pickup-backlog-growth", 1.0)
+        pol.step()
+        assert fab.submitted == []
+        # the proposer role moves to the next survivor: quarantining
+        # ranks 0 and 1 makes rank 2 the proposer
+        fab.quarantined = {0, 1}
+        pol.step()
+        assert len(fab.submitted) == 1
+
+    def test_cooldown_paces_repeat_proposals(self):
+        fab, wd, pol = _policy(cooldown=12.0)
+        _trip(wd, "pickup-backlog-growth", 1.0)
+        pol.step()
+        _trip(wd, "pickup-backlog-growth", 2.0)  # still tripping
+        fab.advance(5.0)
+        pol.step()  # inside the cooldown: no second submit
+        assert len(fab.submitted) == 1
+        fab.advance(8.0)
+        pol.step()
+        assert len(fab.submitted) == 2
+        assert fab.submitted[1].level == 1  # decide never ran: +1 again
+
+    def test_vetoed_want_survives_and_retries(self):
+        fab, wd, pol = _policy(retry=3.0)
+        fab.incs = {3: 1}
+        fab.judge_verdict = 0  # e.g. target mid-flap, not a member
+        _trip(wd, "retransmit-storm", 1.0)
+        pol.step()
+        assert fab.submitted == []
+        fab.advance(1.0)
+        pol.step()  # inside retry pacing: no pre-flight spam
+        fab.judge_verdict = 1
+        assert fab.submitted == []
+        fab.advance(3.0)
+        pol.step()  # target rejoined, veto lifted: proposal goes out
+        assert [(r.kind, r.target) for r in fab.submitted] == \
+            [(KIND_QUARANTINE, 3)]
+
+    def test_busy_slot_retries_next_pump_without_cooldown(self):
+        fab, wd, pol = _policy()
+        fab.slot_free = False  # a placement round is in flight
+        _trip(wd, "pickup-backlog-growth", 1.0)
+        pol.step()
+        assert fab.submitted == []
+        fab.slot_free = True
+        pol.step()  # no retry pacing for slot-busy: next pump wins
+        assert len(fab.submitted) == 1
+
+    def test_want_expires_when_cause_goes_quiet(self):
+        fab, wd, pol = _policy(clear_window=35.0)
+        fab.judge_verdict = 0  # keep the want un-proposable
+        _trip(wd, "pickup-backlog-growth", 1.0)
+        pol.step()
+        fab.advance(40.0)  # cause quiet past clear_window
+        fab.judge_verdict = 1
+        pol.step()
+        assert fab.submitted == []  # expired, not proposed late
+
+    def test_decided_outcome_drops_the_want(self):
+        fab, wd, pol = _policy()
+        _trip(wd, "pickup-backlog-growth", 1.0)
+        pol.step()
+        rec = fab.submitted[0]
+        pol.on_outcome(rec, True)
+        assert pol.decided == 1 and pol.stats()["wants"] == []
+        pol.on_outcome(rec, False)
+        assert pol.rejected == 1
+        assert pol.log[0][1] == "BACKPRESSURE" and pol.log[0][4] is True
+
+    def test_unquarantine_waits_a_full_clear_window(self):
+        # actions={}: the trip feeds the quiet clock but maps to no
+        # corrective want, isolating the un-quarantine hysteresis
+        fab, wd, pol = _policy(clear_window=35.0, actions={})
+        fab.quarantined = {2}
+        _trip(wd, "retransmit-storm", 0.0)
+        pol.step()  # consume the trip
+        fab.advance(20.0)
+        pol.step()  # rules quiet only 20s: hysteresis holds
+        assert fab.submitted == []
+        fab.advance(20.0)
+        pol.step()  # quiet 40s >= clear_window: lift proposed
+        assert [(r.kind, r.target) for r in fab.submitted] == \
+            [(KIND_UNQUARANTINE, 2)]
+
+    def test_unquarantine_needs_target_back_in_view(self):
+        fab, wd, pol = _policy(clear_window=35.0)
+        fab.quarantined = {9}  # not in the membership view
+        fab.advance(50.0)
+        pol.step()
+        assert fab.submitted == []
+
+    def test_default_actions_cover_every_default_rule(self):
+        assert set(DEFAULT_ACTIONS) == \
+            {parse_rule(r).name for r in DEFAULT_RULES}
+
+
+# ---------------------------------------------------------------------------
+# watchdog view-change forgiveness (the false-positive fix)
+# ---------------------------------------------------------------------------
+
+class _FakePlane:
+    """Just enough TelemetryPlane for Watchdog.check(): a manual clock
+    and scriptable rollups."""
+
+    def __init__(self):
+        self.now = 0.0
+        self.vals = {"arq_retransmits": 0, "rejoins": 0,
+                     "pickup_backlog": 0, "view_changes": 0}
+        self.view = SimpleNamespace(
+            rollups=lambda: dict(self.vals),
+            rollup_max=lambda: dict(self.vals))
+        self.watchdog = None
+
+    def clock(self):
+        return self.now
+
+
+class TestWatchdogForgiveness:
+    RULES = ("retransmit-storm: sum(arq_retransmits) / 10s >= 5.0",
+             "pickup-backlog-growth: sum(pickup_backlog) / 10s >= 20.0")
+
+    def test_heal_spike_with_view_change_is_forgiven(self):
+        plane = _FakePlane()
+        wd = Watchdog(plane, self.RULES, incident_dir="", cooldown=15.0)
+        for _ in range(4):
+            plane.now += 1.0
+            assert wd.check() == []
+        # an admission lands: retransmits spike AND view_changes bumps
+        # in the same pump — that spike is heal cost, not a storm
+        plane.now += 1.0
+        plane.vals["arq_retransmits"] = 120
+        plane.vals["view_changes"] = 1
+        assert wd.check() == []
+        assert wd.forgiveness == 1
+        # the post-heal value is the new baseline: staying flat after
+        # the spike never trips
+        for _ in range(12):
+            plane.now += 1.0
+            assert wd.check() == []
+
+    def test_same_spike_without_view_change_trips(self):
+        plane = _FakePlane()
+        wd = Watchdog(plane, self.RULES, incident_dir="", cooldown=15.0)
+        for _ in range(4):
+            plane.now += 1.0
+            wd.check()
+        plane.now += 1.0
+        plane.vals["arq_retransmits"] = 120  # loss, with no vc bump
+        fired = wd.check()
+        assert [i.rule.name for i in fired] == ["retransmit-storm"]
+        assert wd.forgiveness == 0
+
+    def test_forgiveness_rate_limited_per_window(self):
+        # a SUSTAINED flap bumps the view faster than the window;
+        # forgiving every bump would blind the rule to the cascade
+        plane = _FakePlane()
+        wd = Watchdog(plane, self.RULES, incident_dir="", cooldown=15.0)
+        plane.now = 1.0
+        wd.check()
+        plane.now = 2.0
+        plane.vals["view_changes"] = 1
+        plane.vals["arq_retransmits"] = 30
+        wd.check()
+        assert wd.forgiveness == 1
+        plane.now = 5.0
+        plane.vals["view_changes"] = 2  # second bump INSIDE the window
+        plane.vals["arq_retransmits"] = 160
+        fired = wd.check()
+        assert wd.forgiveness == 1  # not forgiven again
+        assert [i.rule.name for i in fired] == ["retransmit-storm"]
+
+    def test_forgiveness_scoped_to_churn_cost_keys(self):
+        # pickup_backlog is not a FORGIVE_KEY: a backlog surge during
+        # a view change is still a backlog surge
+        plane = _FakePlane()
+        wd = Watchdog(plane, self.RULES, incident_dir="", cooldown=15.0)
+        plane.now = 1.0
+        wd.check()
+        plane.now = 2.0
+        plane.vals["view_changes"] = 1
+        plane.vals["pickup_backlog"] = 500
+        fired = wd.check()
+        assert [i.rule.name for i in fired] == ["pickup-backlog-growth"]
+
+
+# ---------------------------------------------------------------------------
+# health-aware placement
+# ---------------------------------------------------------------------------
+
+class TestHealthyPlacement:
+    def test_healthy_members_filters_quarantined(self):
+        assert healthy_members((0, 1, 2, 3), (2,)) == (0, 1, 3)
+        assert healthy_members((0, 1, 2, 3), ()) == (0, 1, 2, 3)
+
+    def test_never_empty_fallback(self):
+        # quarantine excluding everyone: serving degraded beats not
+        # serving (the blast-radius judges keep this unreachable)
+        assert healthy_members((0, 1), (0, 1)) == (0, 1)
+
+    def test_quarantined_rank_never_picked_as_owner(self):
+        loads = {0: (1, 3), 1: (8, 0), 2: (2, 1)}  # rank 1 least loaded
+        members = healthy_members((0, 1, 2), (1,))
+        for gw in range(3):
+            assert pick_owner(gw, members, loads) != 1
+
+
+# ---------------------------------------------------------------------------
+# the remedy_* scenarios end to end (DEFAULT watchdog rules armed)
+# ---------------------------------------------------------------------------
+
+class TestRemedyScenarios:
+    def test_remedy_flap_quarantines_drains_recovers(self):
+        # run() property-checks §22 internally (min-alive, blast cap,
+        # expected quarantine target, drain, recovery) and raises
+        # SimViolation with a replay recipe on any failure
+        res = make_fabric_scenario("remedy_flap", 0).run()
+        rem = res["remedy"]
+        assert rem["decided"] >= 2  # the quarantine AND its lift
+        assert rem["trips"] >= 1
+        assert rem["final_quarantined"] == []  # hysteresis lifted it
+        names = [e[1] for e in rem["decision_log"] if e[4]]
+        assert "QUARANTINE" in names and "UNQUARANTINE" in names
+
+    def test_remedy_flap_seed_replay_identical(self):
+        # R5 determinism for the whole remediation loop: same seed =>
+        # byte-identical world schedule AND an identical decision
+        # sequence (vtime, kind, target, level, outcome)
+        a = make_fabric_scenario("remedy_flap", 0).run()
+        b = make_fabric_scenario("remedy_flap", 0).run()
+        assert a["digest"] == b["digest"] != "protocol-only"
+        assert a["remedy"]["decision_log"] == b["remedy"]["decision_log"]
+        assert a["remedy"]["decision_log"]  # non-vacuous: decisions ran
+        assert a["remedy"]["logs"] == b["remedy"]["logs"]
+
+    def test_remedy_hotspot_backpressure_applies_and_decays(self):
+        res = make_fabric_scenario("remedy_hotspot", 0).run()
+        rem = res["remedy"]
+        bp = [e for logs in rem["logs"].values() for e in logs
+              if e[1] == "BACKPRESSURE" and e[3] >= 1]
+        assert bp  # the fleet throttled admissions under the hotspot
+        assert rem["bp_final"] == 0  # and additively recovered after
+
+    @pytest.mark.slow
+    def test_remedy_split_no_dual_quarantine(self):
+        # asymmetric partition: the minority side can never satisfy
+        # the min-alive quorum, so at most one side decides; run()
+        # asserts quarantine-state agreement once the run ends healed
+        res = make_fabric_scenario("remedy_split", 0).run()
+        assert res["remedy"]["decided"] >= 1
+        assert res["remedy"]["final_quarantined"] == []
+
+    def test_clean_churn_weather_never_trips(self):
+        # the false-positive regression pin (§22 satellite): ordinary
+        # churn weather — kills, rejoins, burst loss, batched
+        # admissions — must ride the forgiveness path, not trip the
+        # default SLOs (a trip here would quarantine a healthy joiner)
+        res = make_scenario("churn_weather", 0).run()
+        assert res.get("incidents", []) == []
